@@ -1,0 +1,26 @@
+from ray_tpu.core.actor import ActorClass, ActorHandle, ActorMethod, method
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+
+__all__ = [
+    "ActorClass",
+    "ActorDiedError",
+    "ActorError",
+    "ActorHandle",
+    "ActorMethod",
+    "GetTimeoutError",
+    "ObjectLostError",
+    "ObjectRef",
+    "RayTpuError",
+    "RemoteFunction",
+    "TaskError",
+    "method",
+]

@@ -1,0 +1,155 @@
+"""Actor API: @remote classes, handles, methods.
+
+Parity with the reference (ray: python/ray/actor.py — ActorClass:384,
+ActorMethod:98, ActorHandle:1025): ``Cls.remote(...)`` creates the
+actor, ``handle.method.remote(...)`` submits ordered tasks,
+``handle.options(name=...)``/`get_if_exists` for named actors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.runtime import ActorOptions
+from ray_tpu.utils.ids import ActorID
+
+_VALID_ACTOR_OPTIONS = {
+    "num_cpus", "num_tpus", "resources", "name", "get_if_exists",
+    "max_restarts", "max_concurrency", "lifetime", "placement_group",
+    "placement_bundle_index",
+}
+
+_METHOD_OPTION_ATTR = "__raytpu_method_options__"
+
+
+def method(**options):
+    """Decorator for per-method defaults, e.g. @method(num_returns=2)
+    (parity: ray.method)."""
+
+    def wrap(fn):
+        setattr(fn, _METHOD_OPTION_ATTR, options)
+        return fn
+
+    return wrap
+
+
+def collect_method_num_returns(cls: type) -> Dict[str, int]:
+    """@method(num_returns=...) table for a class — shared by direct
+    handles and handles recovered via get_actor."""
+    table: Dict[str, int] = {}
+    for name in dir(cls):
+        fn = getattr(cls, name, None)
+        opts = getattr(fn, _METHOD_OPTION_ATTR, None)
+        if opts and "num_returns" in opts:
+            table[name] = opts["num_returns"]
+    return table
+
+
+def _make_actor_options(defaults: Dict[str, Any], overrides: Dict[str, Any]
+                        ) -> ActorOptions:
+    merged = {**defaults, **overrides}
+    bad = set(merged) - _VALID_ACTOR_OPTIONS
+    if bad:
+        raise ValueError(
+            f"invalid actor option(s) {sorted(bad)}; valid: "
+            f"{sorted(_VALID_ACTOR_OPTIONS)}"
+        )
+    return ActorOptions(**merged)
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        from ray_tpu.core import api
+
+        refs = api.runtime().submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs,
+            num_returns=self._num_returns,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def options(self, *, num_returns: Optional[int] = None) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name,
+                           num_returns or self._num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method {self._name!r} cannot be called directly — use "
+            f".{self._name}.remote(...)"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, cls_name: str,
+                 method_num_returns: Optional[Dict[str, int]] = None,
+                 creation_ref: Optional[ObjectRef] = None):
+        object.__setattr__(self, "_actor_id", actor_id)
+        object.__setattr__(self, "_cls_name", cls_name)
+        object.__setattr__(self, "_method_num_returns", method_num_returns or {})
+        object.__setattr__(self, "_creation_ref", creation_ref)
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(
+            self, name, self._method_num_returns.get(name, 1)
+        )
+
+    def __repr__(self):
+        return f"ActorHandle({self._cls_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._cls_name, self._method_num_returns, None),
+        )
+
+
+class ActorClass:
+    def __init__(self, cls: type, **default_options):
+        self._cls = cls
+        self._default_options = default_options
+        self._method_num_returns = collect_method_num_returns(cls)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__!r} cannot be instantiated "
+            f"directly — use {self._cls.__name__}.remote(...)"
+        )
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._create(args, kwargs, {})
+
+    def options(self, **overrides) -> "_BoundActorOptions":
+        _make_actor_options(self._default_options, overrides)  # validate
+        return _BoundActorOptions(self, overrides)
+
+    def _create(self, args, kwargs, overrides) -> ActorHandle:
+        from ray_tpu.core import api
+
+        opts = _make_actor_options(self._default_options, overrides)
+        shell, creation_ref = api.runtime().create_actor(
+            self._cls, args, kwargs, opts
+        )
+        return ActorHandle(
+            shell.actor_id, self._cls.__name__, self._method_num_returns,
+            creation_ref,
+        )
+
+    @property
+    def underlying(self) -> type:
+        return self._cls
+
+
+class _BoundActorOptions:
+    def __init__(self, ac: ActorClass, overrides: Dict[str, Any]):
+        self._ac = ac
+        self._overrides = overrides
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._ac._create(args, kwargs, self._overrides)

@@ -1,0 +1,63 @@
+"""User-facing error types.
+
+Parity with the reference's exception taxonomy
+(ray: python/ray/exceptions.py): task failures are captured where they
+happen, serialized, and re-raised at every ``get`` of the poisoned ref,
+with the remote traceback attached.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """A task raised; re-raised at ray_tpu.get (parity: RayTaskError)."""
+
+    def __init__(self, function_name: str, cause: BaseException,
+                 remote_tb: Optional[str] = None):
+        self.function_name = function_name
+        self.cause = cause
+        self.remote_tb = remote_tb or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        super().__init__(
+            f"task {function_name!r} failed: {type(cause).__name__}: {cause}\n"
+            f"--- remote traceback ---\n{self.remote_tb}"
+        )
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_repr: str, reason: str = "actor died"):
+        self.actor_repr = actor_repr
+        super().__init__(f"{actor_repr}: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id_hex: str):
+        super().__init__(f"object {object_id_hex} was lost and could not be "
+                         f"reconstructed")
+
+
+class RuntimeNotInitializedError(RayTpuError):
+    def __init__(self):
+        super().__init__(
+            "ray_tpu runtime is not initialized — call ray_tpu.init() first"
+        )

@@ -1,0 +1,69 @@
+"""ObjectRef — the distributed future.
+
+Parity with the reference's ObjectRef (ray: python/ray/_raylet.pyx:252
+``ObjectRef``): a handle to an immutable object that may not exist yet.
+Holds the binary ObjectID plus owner metadata.  ``ray_tpu.get`` resolves
+it through the runtime's object store.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from ray_tpu.utils.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "_owner", "owner_hint")
+
+    def __init__(self, object_id: ObjectID, owner_hint: str = ""):
+        self.id = object_id
+        self.owner_hint = owner_hint  # node/worker that owns the value
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        # Refs serialize by id — ownership bookkeeping happens in the
+        # serialization hooks of the runtime (borrower registration).
+        return (ObjectRef, (self.id, self.owner_hint))
+
+    # Allow `await ref` inside async actors.
+    def __await__(self):
+        from ray_tpu.core import api
+
+        def _get():
+            return api.get(self)
+
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(None, _get).__await__()
+
+
+class ObjectState:
+    """Store-side bookkeeping for one object (local runtime)."""
+
+    __slots__ = ("event", "value_bytes", "error", "in_band")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value_bytes: Optional[bytes] = None
+        self.error: Optional[BaseException] = None
+        self.in_band: Any = None

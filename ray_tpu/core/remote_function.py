@@ -1,0 +1,74 @@
+"""@remote functions.
+
+Parity with the reference's RemoteFunction
+(ray: python/ray/remote_function.py:40; `_remote` :257) and the options
+validation table (ray: python/ray/_private/ray_option_utils.py):
+``f.remote(*args)`` submits through the runtime, ``f.options(...)``
+returns a shallow copy with overridden options.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.runtime import TaskOptions
+
+_VALID_OPTIONS = {
+    "num_cpus", "num_tpus", "resources", "num_returns", "max_retries",
+    "name", "placement_group", "placement_bundle_index",
+}
+
+
+def _make_task_options(defaults: Dict[str, Any], overrides: Dict[str, Any]
+                       ) -> TaskOptions:
+    merged = {**defaults, **overrides}
+    bad = set(merged) - _VALID_OPTIONS
+    if bad:
+        raise ValueError(
+            f"invalid option(s) {sorted(bad)}; valid: {sorted(_VALID_OPTIONS)}"
+        )
+    return TaskOptions(**merged)
+
+
+class RemoteFunction:
+    def __init__(self, fn: Callable, **default_options):
+        if not callable(fn):
+            raise TypeError("@remote must wrap a callable")
+        self._fn = fn
+        self._default_options = default_options
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__!r} cannot be called "
+            f"directly — use {self._fn.__name__}.remote(...)"
+        )
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        return self._submit(args, kwargs, {})
+
+    def options(self, **overrides) -> "_BoundOptions":
+        _make_task_options(self._default_options, overrides)  # validate now
+        return _BoundOptions(self, overrides)
+
+    def _submit(self, args, kwargs, overrides):
+        from ray_tpu.core import api
+
+        opts = _make_task_options(self._default_options, overrides)
+        refs = api.runtime().submit_task(self._fn, args, kwargs, opts)
+        return refs[0] if opts.num_returns == 1 else refs
+
+    @property
+    def underlying(self) -> Callable:
+        return self._fn
+
+
+class _BoundOptions:
+    def __init__(self, rf: RemoteFunction, overrides: Dict[str, Any]):
+        self._rf = rf
+        self._overrides = overrides
+
+    def remote(self, *args, **kwargs):
+        return self._rf._submit(args, kwargs, self._overrides)

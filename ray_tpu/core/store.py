@@ -1,0 +1,124 @@
+"""In-process object store (local runtime backend).
+
+Semantics parity with the reference's two-tier store — the in-process
+memory store for small/inlined values (ray:
+src/ray/core_worker/store_provider/memory_store/memory_store.h:43) and
+plasma for large ones (plasma/store.h:55): objects are immutable,
+created-then-sealed, readable by many, and survive until released.
+
+This Python implementation is the single-process backend; the C++
+shared-memory store (ray_tpu/_native) plugs in behind the same
+interface for the multi-process runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
+from ray_tpu.core.object_ref import ObjectState
+from ray_tpu.utils.ids import ObjectID
+from ray_tpu.utils.serialization import deserialize_object, serialize_object
+
+
+class LocalObjectStore:
+    """Thread-safe map ObjectID → sealed value (serialized or in-band)."""
+
+    def __init__(self, *, serialize_always: bool = True):
+        self._lock = threading.Lock()
+        self._objects: Dict[ObjectID, ObjectState] = {}
+        # Serializing everything (even in local mode) keeps semantics
+        # identical to the distributed path: values are snapshots, and
+        # non-serializable values fail at put-time, not at scale-up time.
+        self._serialize_always = serialize_always
+
+    def _state(self, oid: ObjectID) -> ObjectState:
+        with self._lock:
+            st = self._objects.get(oid)
+            if st is None:
+                st = self._objects[oid] = ObjectState()
+            return st
+
+    # -- producer side -----------------------------------------------------
+
+    def put_value(self, oid: ObjectID, value: Any) -> None:
+        st = self._state(oid)
+        if self._serialize_always:
+            st.value_bytes = serialize_object(value)
+        else:
+            st.in_band = value
+        st.event.set()
+
+    def put_error(self, oid: ObjectID, error: BaseException) -> None:
+        st = self._state(oid)
+        st.error = error
+        st.event.set()
+
+    # -- consumer side -----------------------------------------------------
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            st = self._objects.get(oid)
+        return bool(st and st.event.is_set())
+
+    def get(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
+        st = self._state(oid)
+        if not st.event.wait(timeout):
+            raise GetTimeoutError(f"get timed out after {timeout}s for "
+                                  f"{oid.hex()}")
+        if st.error is not None:
+            raise st.error
+        if st.value_bytes is not None:
+            return deserialize_object(st.value_bytes)
+        return st.in_band
+
+    def wait(
+        self,
+        oids: List[ObjectID],
+        num_returns: int,
+        timeout: Optional[float],
+    ) -> Tuple[List[ObjectID], List[ObjectID]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectID] = []
+        pending = list(oids)
+        while len(ready) < num_returns:
+            progressed = False
+            for oid in list(pending):
+                st = self._state(oid)
+                if st.event.is_set():
+                    ready.append(oid)
+                    pending.remove(oid)
+                    progressed = True
+                    if len(ready) >= num_returns:
+                        break
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if not progressed:
+                # Block on one pending object with a bounded slice.
+                slice_t = 0.05
+                if deadline is not None:
+                    slice_t = min(slice_t, max(0.0, deadline - time.monotonic()))
+                if pending:
+                    self._state(pending[0]).event.wait(slice_t)
+        return ready, pending
+
+    def release(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._objects.pop(oid, None)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            sealed = sum(1 for s in self._objects.values() if s.event.is_set())
+            nbytes = sum(
+                len(s.value_bytes) for s in self._objects.values()
+                if s.value_bytes is not None
+            )
+            return {
+                "num_objects": len(self._objects),
+                "num_sealed": sealed,
+                "bytes": nbytes,
+            }

@@ -1,0 +1,349 @@
+"""Llama-3 family — pure-JAX functional implementation, sharding-aware.
+
+The flagship model for the Train/Serve equivalents (BASELINE.json's
+north-star config).  The reference has no model code of its own — models
+arrive via user torch code (ray: python/ray/train/torch/train_loop_utils.py
+wraps them in DDP/FSDP); here the model is TPU-first by construction:
+
+  * params are a plain pytree with a parallel pytree of *logical axis
+    names* (ray_tpu.parallel.sharding), so any mesh layout (dp/fsdp/tp/sp)
+    is a rule-table choice;
+  * layers are stacked and iterated with ``lax.scan`` (one trace,
+    fast XLA compiles even at 80 layers);
+  * compute in bfloat16 on the MXU, reductions/softmax in float32;
+  * optional per-layer rematerialization for HBM headroom.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import decode_attention, dot_product_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 14_336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    logits_soft_cap: Optional[float] = None
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        d, h = self.dim, self.head_dim
+        attn = d * self.n_heads * h + 2 * d * self.n_kv_heads * h + self.n_heads * h * d
+        mlp = 3 * d * self.mlp_dim
+        per_layer = attn + mlp + 2 * d
+        emb = self.vocab_size * d * (1 if self.tie_embeddings else 2)
+        return self.n_layers * per_layer + emb + d
+
+
+# --- canonical configs ----------------------------------------------------
+
+LLAMA3_8B = LlamaConfig()
+LLAMA3_70B = LlamaConfig(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                         mlp_dim=28672)
+LLAMA3_1B = LlamaConfig(dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+                        mlp_dim=8192, vocab_size=128_256)
+LLAMA_TINY = LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, mlp_dim=128, max_seq_len=128,
+                         remat=False)
+
+CONFIGS = {
+    "llama3-8b": LLAMA3_8B,
+    "llama3-70b": LLAMA3_70B,
+    "llama3-1b": LLAMA3_1B,
+    "tiny": LLAMA_TINY,
+}
+
+
+# --- params ---------------------------------------------------------------
+
+def logical_axes(cfg: LlamaConfig) -> Params:
+    """Pytree of per-dimension logical axis names, mirroring init_params."""
+    layer = {
+        "attn": {
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+        },
+        "mlp": {
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "ln_attn": ("layers", "embed"),
+        "ln_mlp": ("layers", "embed"),
+    }
+    out: Params = {
+        "tok_embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = ("embed", "vocab")
+    return out
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    d, h, kvh, hd, m = cfg.dim, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.mlp_dim
+    L = cfg.n_layers
+    keys = jax.random.split(rng, 8)
+    pd = cfg.param_dtype
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, pd) * (fan_in**-0.5)).astype(pd)
+
+    params: Params = {
+        "tok_embed": norm_init(keys[0], (cfg.vocab_size, d), d),
+        "layers": {
+            "attn": {
+                "wq": norm_init(keys[1], (L, d, h, hd), d),
+                "wk": norm_init(keys[2], (L, d, kvh, hd), d),
+                "wv": norm_init(keys[3], (L, d, kvh, hd), d),
+                "wo": norm_init(keys[4], (L, h, hd, d), h * hd),
+            },
+            "mlp": {
+                "w_gate": norm_init(keys[5], (L, d, m), d),
+                "w_up": norm_init(keys[6], (L, d, m), d),
+                "w_down": norm_init(keys[7], (L, m, d), m),
+            },
+            "ln_attn": jnp.ones((L, d), pd),
+            "ln_mlp": jnp.ones((L, d), pd),
+        },
+        "final_norm": jnp.ones((d,), pd),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm_init(jax.random.fold_in(keys[0], 1),
+                                      (d, cfg.vocab_size), d)
+    return params
+
+
+# --- building blocks ------------------------------------------------------
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def rope_table(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """positions [B, S] → (sin, cos) each [B, S, head_dim//2], float32."""
+    half = cfg.head_dim // 2
+    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    """x [B, S, H, D]; rotate pairs (x1, x2) = (x[..., :half], x[..., half:])."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    sin, cos = sin[:, :, None, :], cos[:, :, None, :]
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1f * cos - x2f * sin, x2f * cos + x1f * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _qkv(x, layer, cfg: LlamaConfig, sin, cos):
+    """Shared q/k/v projection + RoPE (used by train, prefill and decode)."""
+    a = layer["attn"]
+    dt = cfg.dtype
+    q = jnp.einsum("bsd,dhk->bshk", x, a["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", x, a["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", x, a["wv"].astype(dt))
+    return apply_rope(q, sin, cos), apply_rope(k, sin, cos), v
+
+
+def _attn_block(x, layer, cfg: LlamaConfig, sin, cos, segment_ids):
+    """Returns (out, (k, v)) — k/v for cache population during prefill."""
+    q, k, v = _qkv(x, layer, cfg, sin, cos)
+    out = dot_product_attention(q, k, v, causal=True, segment_ids=segment_ids,
+                                logits_soft_cap=cfg.logits_soft_cap)
+    out = jnp.einsum("bshk,hkd->bsd", out, layer["attn"]["wo"].astype(cfg.dtype))
+    return out, (k, v)
+
+
+def _mlp_block(x, layer, cfg: LlamaConfig):
+    m = layer["mlp"]
+    dt = cfg.dtype
+    gate = jnp.einsum("bsd,dm->bsm", x, m["w_gate"].astype(dt))
+    up = jnp.einsum("bsd,dm->bsm", x, m["w_up"].astype(dt))
+    return jnp.einsum("bsm,md->bsd", jax.nn.silu(gate) * up,
+                      m["w_down"].astype(dt))
+
+
+def _layer_fn(cfg: LlamaConfig, x, layer, sin, cos, segment_ids):
+    h = x + _attn_block(rms_norm(x, layer["ln_attn"], cfg.norm_eps), layer,
+                        cfg, sin, cos, segment_ids)[0]
+    return h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
+
+
+# --- forward --------------------------------------------------------------
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Training/prefill forward: tokens [B, S] → logits [B, S, V] (float32)."""
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+
+    def body(carry, layer):
+        fn = _layer_fn
+        if cfg.remat:
+            fn = jax.checkpoint(fn, static_argnums=(0,))
+        return fn(cfg, carry, layer, sin, cos, segment_ids), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    return jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn(
+    params: Params,
+    batch: Dict[str, jax.Array],
+    cfg: LlamaConfig,
+    *,
+    z_loss: float = 0.0,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy. batch: tokens [B,S], optional loss_mask [B,S]."""
+    tokens = batch["tokens"]
+    segment_ids = batch.get("segment_ids")
+    if segment_ids is not None:
+        segment_ids = segment_ids[:, :-1]
+    logits = forward(params, tokens[:, :-1], cfg, segment_ids=segment_ids)
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - tgt_logit
+    if z_loss:
+        nll = nll + z_loss * logz**2
+    mask = batch.get("loss_mask")
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    else:
+        mask = mask[:, 1:].astype(nll.dtype)
+    total = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return total, {"loss": total, "ntokens": jnp.sum(mask)}
+
+
+# --- inference (KV cache) -------------------------------------------------
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict[str, jax.Array]:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "length": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def prefill(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Run the prompt through the model, filling the cache.
+
+    tokens [B, S]; returns (logits_last [B, V], cache).  Assumes all rows
+    use the full S (ragged batching is handled by the serve engine via
+    per-row right-padding + length bookkeeping).
+    """
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+
+    ks, vs = [], []
+
+    def body(carry, layer):
+        x = carry
+        normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        out, (k, v) = _attn_block(normed, layer, cfg, sin, cos, None)
+        h = x + out
+        h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
+        return h, (k, v)
+
+    x, (k_all, v_all) = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bd,dv->bv", x[:, -1], head.astype(cfg.dtype))
+
+    cache = dict(cache)
+    cache["k"] = cache["k"].at[:, :, :S].set(k_all)
+    cache["v"] = cache["v"].at[:, :, :S].set(v_all)
+    cache["length"] = jnp.full((B,), S, jnp.int32)
+    return logits.astype(jnp.float32), cache
+
+
+def decode_step(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One decode step. tokens [B] → (logits [B, V], cache)."""
+    B = tokens.shape[0]
+    positions = cache["length"][:, None]  # [B, 1]
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"].astype(cfg.dtype)[tokens[:, None]]
+    new_len = cache["length"] + 1
+
+    def body(carry, inputs):
+        x = carry
+        layer, k_cache, v_cache = inputs
+        normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _qkv(normed, layer, cfg, sin, cos)
+        # write new k/v at position length (per row)
+        idx = cache["length"]  # [B]
+        k_cache = jax.vmap(lambda c, kk, i: lax.dynamic_update_slice_in_dim(
+            c, kk, i, axis=0))(k_cache, k, idx)
+        v_cache = jax.vmap(lambda c, vv, i: lax.dynamic_update_slice_in_dim(
+            c, vv, i, axis=0))(v_cache, v, idx)
+        out = decode_attention(q, k_cache, v_cache, new_len,
+                               logits_soft_cap=cfg.logits_soft_cap)
+        out = jnp.einsum("bshk,hkd->bsd", out,
+                         layer["attn"]["wo"].astype(cfg.dtype))
+        h = x + out
+        h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
+        return h, (k_cache, v_cache)
+
+    x, (k_new, v_new) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))
+    cache = {"k": k_new, "v": v_new, "length": new_len}
+    return logits.astype(jnp.float32), cache

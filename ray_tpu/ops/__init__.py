@@ -1,0 +1,3 @@
+from ray_tpu.ops.attention import decode_attention, dot_product_attention
+
+__all__ = ["decode_attention", "dot_product_attention"]

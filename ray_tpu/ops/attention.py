@@ -1,0 +1,109 @@
+"""Attention ops.
+
+The XLA einsum path below is the portable reference; the Pallas flash
+kernel (ray_tpu/ops/flash_attention.py) overrides it on TPU for long
+sequences.  No reference counterpart exists — the reference delegates
+attention to user frameworks (see SURVEY.md §5.7); on TPU it is a core
+op of this framework.
+
+Conventions: q [B, S, H, D], k/v [B, S, KVH, D] with H a multiple of
+KVH (grouped-query attention).  Masks are causal and/or segment-based
+(packed sequences).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from einops import rearrange
+
+
+def _gqa_expand(k: jax.Array, groups: int) -> jax.Array:
+    if groups == 1:
+        return k
+    return jnp.repeat(k, groups, axis=2)
+
+
+@partial(jax.jit, static_argnames=("causal",))
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    logits_soft_cap: Optional[float] = None,
+) -> jax.Array:
+    """Numerically-stable softmax attention with GQA and optional packing.
+
+    Computed in float32 regardless of input dtype; output cast back.
+    """
+    orig_dtype = q.dtype
+    *_, n_heads, head_dim = q.shape
+    n_kv = k.shape[2]
+    groups = n_heads // n_kv
+    k = _gqa_expand(k, groups)
+    v = _gqa_expand(v, groups)
+
+    scale = head_dim**-0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+
+    q_len, k_len = logits.shape[-2], logits.shape[-1]
+    mask = None
+    if causal:
+        # offset supports decode: q positions are the last q_len of k_len
+        offset = k_len - q_len
+        qi = jnp.arange(q_len)[:, None] + offset
+        ki = jnp.arange(k_len)[None, :]
+        mask = qi >= ki
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        seg_mask = seg_mask[:, None, :, :]
+        mask = seg_mask if mask is None else (mask[None, None] & seg_mask)
+    if mask is not None:
+        if mask.ndim == 2:
+            mask = mask[None, None]
+        logits = jnp.where(mask, logits, -1e30)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(orig_dtype)
+
+
+def decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    cache_len: jax.Array,
+    *,
+    logits_soft_cap: Optional[float] = None,
+) -> jax.Array:
+    """Single-step attention against a (possibly longer) KV cache.
+
+    q: [B, 1, H, D]; caches: [B, S_max, KVH, D]; cache_len: [B] valid lengths
+    (the new token's k/v must already be written at cache_len-1).
+    """
+    orig_dtype = q.dtype
+    n_heads = q.shape[2]
+    n_kv = k_cache.shape[2]
+    k = _gqa_expand(k_cache, n_heads // n_kv)
+    v = _gqa_expand(v_cache, n_heads // n_kv)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+    ki = jnp.arange(k.shape[1])[None, None, None, :]
+    valid = ki < cache_len[:, None, None, None]
+    logits = jnp.where(valid, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(orig_dtype)

@@ -1,0 +1,112 @@
+"""Logical-axis sharding rules.
+
+The TPU-native replacement for per-framework model wrappers like the
+reference's DDP/FSDP `prepare_model`
+(ray: python/ray/train/torch/train_loop_utils.py:74,100): models annotate
+parameters and activations with *logical* axis names ("embed", "mlp",
+"heads", "batch", "seq", ...) and a rule table maps those to mesh axes.
+Changing the parallelism layout (dp↔fsdp↔tp↔sp↔ep) is a rule-table edit,
+not a model edit — the GSPMD partitioner does the rest.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# rule: logical axis name -> mesh axis | tuple of mesh axes | None (replicate)
+Rules = Dict[str, Union[str, Tuple[str, ...], None]]
+
+# Default rule table for transformer LMs.  Matches how the flagship models
+# in ray_tpu.models name their dimensions.
+DEFAULT_RULES: Rules = {
+    # data
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    # params
+    "vocab": "tp",
+    "embed": "fsdp",
+    "embed_tp": "tp",     # activations' feature dim under tensor parallel
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "expert": "ep",
+    "layers": None,       # used by scan-stacked params; pp handles stages
+    # state-space models
+    "state": None,
+}
+
+
+def spec_for(logical_axes: Sequence[Optional[str]], rules: Optional[Rules] = None) -> P:
+    """Map a tuple of logical axis names (None = replicated dim) to a PartitionSpec."""
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    out = []
+    used = set()
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+            continue
+        if name not in rules:
+            raise KeyError(f"no sharding rule for logical axis {name!r}")
+        axes = rules[name]
+        if axes is None:
+            out.append(None)
+            continue
+        if isinstance(axes, str):
+            axes = (axes,)
+        # A mesh axis may appear only once in a PartitionSpec.
+        axes = tuple(a for a in axes if a not in used)
+        used.update(axes)
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    return P(*out)
+
+
+def sharding_for(
+    mesh: Mesh,
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Rules] = None,
+) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(logical_axes, rules))
+
+
+def tree_shardings(
+    mesh: Mesh,
+    logical_tree: Any,
+    rules: Optional[Rules] = None,
+) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings.
+
+    ``logical_tree`` mirrors the param pytree, with each leaf a tuple of
+    logical axis names (or None) per dimension.
+    """
+    return jax.tree.map(
+        lambda axes: sharding_for(mesh, axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(e, (str, type(None))) for e in x),
+    )
+
+
+def constrain(x: jax.Array, logical_axes: Sequence[Optional[str]],
+              rules: Optional[Rules] = None) -> jax.Array:
+    """with_sharding_constraint by logical axes — use inside jitted code."""
+    return jax.lax.with_sharding_constraint(x, spec_for(logical_axes, rules))
+
+
+def shard_tree(mesh: Mesh, tree: Any, logical_tree: Any,
+               rules: Optional[Rules] = None) -> Any:
+    """Device-put a host pytree onto the mesh with the given logical layout."""
+    shardings = tree_shardings(mesh, logical_tree, rules)
+    return jax.device_put(tree, shardings)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

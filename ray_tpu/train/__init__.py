@@ -1,0 +1,23 @@
+from ray_tpu.train.checkpoint import CheckpointManager
+from ray_tpu.train.state import (
+    TrainState,
+    create_train_state,
+    default_optimizer,
+    state_shardings,
+)
+from ray_tpu.train.step import compile_train_step, make_train_step
+from ray_tpu.train.trainer import JaxTrainer, Result, RunConfig, ScalingConfig
+
+__all__ = [
+    "CheckpointManager",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainState",
+    "compile_train_step",
+    "create_train_state",
+    "default_optimizer",
+    "make_train_step",
+    "state_shardings",
+]

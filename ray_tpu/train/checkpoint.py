@@ -1,0 +1,45 @@
+"""Checkpoint save/restore via orbax.
+
+Parity with the reference checkpoint flow (ray: train/_internal/storage.py
+StorageContext + checkpoint_manager.py keep-top-K): orbax writes sharded
+arrays directly from device memory (each host writes its shards — no
+gather), with a step-numbered directory layout and retention.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, *, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, metrics: Optional[dict] = None,
+             wait: bool = False) -> None:
+        self._mngr.save(step, args=ocp.args.StandardSave(state), metrics=metrics)
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def restore(self, state_like: Any, *, step: Optional[int] = None) -> Any:
+        step = step if step is not None else self._mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        return self._mngr.restore(step, args=ocp.args.StandardRestore(state_like))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def close(self):
+        self._mngr.wait_until_finished()
+        self._mngr.close()

@@ -1,0 +1,191 @@
+"""JaxTrainer — the Train-equivalent entry point.
+
+API parity with the reference's DataParallelTrainer/TorchTrainer
+(ray: python/ray/train/data_parallel_trainer.py:59,
+train/torch/torch_trainer.py:14, base_trainer.py:608 fit()), redesigned
+for SPMD: instead of N worker processes each running a copy of a
+training loop synchronized by NCCL, one logical program is jitted over a
+device mesh; scaling config is a ``MeshSpec`` rather than
+``num_workers``.  Multi-host operation reuses the same code — the actor
+layer (ray_tpu.core) pins one controller process per host and jax's
+distributed runtime makes ``jax.devices()`` span hosts.
+
+``fit()`` is usable standalone (the reference inverts this by routing
+fit() through Tune; see SURVEY.md §7 phase 6 note).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ray_tpu.parallel.mesh import MeshSpec, create_mesh
+from ray_tpu.parallel.sharding import Rules
+from ray_tpu.train.checkpoint import CheckpointManager
+from ray_tpu.train.state import TrainState, create_train_state, default_optimizer
+from ray_tpu.train.step import compile_train_step
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """Parity: air.ScalingConfig(num_workers, use_gpu) → mesh layout."""
+
+    mesh_spec: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    devices: Optional[list] = None  # default: all
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Parity: air.RunConfig (name, storage_path, checkpoint/failure cfg)."""
+
+    name: str = "run"
+    storage_path: Optional[str] = None
+    checkpoint_every: int = 0  # steps; 0 = only final
+    checkpoints_to_keep: int = 3
+    report_every: int = 10
+
+
+@dataclasses.dataclass
+class Result:
+    """Parity: air.Result (metrics, checkpoint path, error)."""
+
+    metrics: Dict[str, float]
+    metrics_history: List[Dict[str, float]]
+    checkpoint_path: Optional[str]
+    error: Optional[BaseException] = None
+
+
+class JaxTrainer:
+    def __init__(
+        self,
+        *,
+        init_params: Callable[[jax.Array], Any],
+        loss_fn: Callable[[Any, Dict[str, jax.Array]], Tuple[jax.Array, Dict]],
+        params_axes: Any,
+        batch_axes: Dict[str, Tuple[Optional[str], ...]],
+        optimizer=None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        rules: Optional[Rules] = None,
+        seed: int = 0,
+    ):
+        self.init_params_fn = init_params
+        self.loss_fn = loss_fn
+        self.params_axes = params_axes
+        self.batch_axes = batch_axes
+        self.tx = optimizer or default_optimizer()
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.rules = rules
+        self.seed = seed
+
+        self.mesh = create_mesh(self.scaling.mesh_spec,
+                                devices=self.scaling.devices)
+        self._state: Optional[TrainState] = None
+        self._step_fn = None
+        self._state_sh = None
+        self._batch_sh = None
+
+    # -- setup -------------------------------------------------------------
+
+    def _build(self):
+        rng = jax.random.key(self.seed)
+        with self.mesh:
+            abstract = jax.eval_shape(
+                lambda r: create_train_state(self.init_params_fn(r), self.tx), rng
+            )
+            # Compile the step against abstract state to get shardings first.
+            self._step_fn, self._state_sh, self._batch_sh = compile_train_step(
+                self.mesh, self.loss_fn, self.tx, abstract, self.params_axes,
+                self.batch_axes, self.rules,
+            )
+            # Init params *directly sharded* — no host-memory full copy, so
+            # 70B-scale states can initialize on the mesh.
+            init = jax.jit(
+                lambda r: create_train_state(self.init_params_fn(r), self.tx),
+                out_shardings=self._state_sh,
+            )
+            self._state = init(rng)
+
+    @property
+    def state(self) -> TrainState:
+        if self._state is None:
+            self._build()
+        return self._state
+
+    def restore(self, path: str) -> int:
+        """Resume from latest checkpoint under ``path``; returns step."""
+        if self._state is None:
+            self._build()
+        mngr = CheckpointManager(path)
+        self._state = mngr.restore(self._state)
+        mngr.close()
+        return int(jax.device_get(self._state.step))
+
+    # -- training ----------------------------------------------------------
+
+    def shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        return jax.device_put(batch, self._batch_sh)
+
+    def fit(
+        self,
+        data: Iterable[Dict[str, np.ndarray]],
+        *,
+        num_steps: int,
+        report: Optional[Callable[[Dict[str, float]], None]] = None,
+    ) -> Result:
+        if self._state is None:
+            self._build()
+        rc = self.run_config
+        ckpt = None
+        if rc.storage_path:
+            ckpt = CheckpointManager(
+                f"{rc.storage_path}/{rc.name}", max_to_keep=rc.checkpoints_to_keep
+            )
+
+        history: List[Dict[str, float]] = []
+        last_metrics: Dict[str, float] = {}
+        it = iter(data)
+        t0 = time.perf_counter()
+        error: Optional[BaseException] = None
+        try:
+            with self.mesh:
+                for i in range(num_steps):
+                    batch = self.shard_batch(next(it))
+                    self._state, metrics = self._step_fn(self._state, batch)
+                    step = i + 1
+                    if step % rc.report_every == 0 or step == num_steps:
+                        m = {k: float(jax.device_get(v)) for k, v in metrics.items()}
+                        m["steps_per_sec"] = step / (time.perf_counter() - t0)
+                        history.append(m)
+                        last_metrics = m
+                        if report:
+                            report(m)
+                    if ckpt and rc.checkpoint_every and step % rc.checkpoint_every == 0:
+                        # sharded arrays go straight to orbax — each host
+                        # writes its own shards, no host gather
+                        ckpt.save(step, self._state)
+        except BaseException as e:  # report partial progress + the failure
+            error = e
+            if not isinstance(e, Exception):
+                raise
+        finally:
+            path = None
+            if ckpt:
+                final_step = int(jax.device_get(self._state.step))
+                if error is None and ckpt.latest_step() != final_step:
+                    ckpt.save(final_step, self._state, wait=True)
+                else:
+                    ckpt._mngr.wait_until_finished()
+                path = f"{rc.storage_path}/{rc.name}"
+                ckpt.close()
+        return Result(
+            metrics=last_metrics,
+            metrics_history=history,
+            checkpoint_path=path,
+            error=error,
+        )

@@ -1,0 +1,25 @@
+from ray_tpu.utils.config import Config, get_config
+from ray_tpu.utils.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu.utils.serialization import deserialize_object, serialize_object
+
+__all__ = [
+    "ActorID",
+    "Config",
+    "JobID",
+    "NodeID",
+    "ObjectID",
+    "PlacementGroupID",
+    "TaskID",
+    "WorkerID",
+    "deserialize_object",
+    "get_config",
+    "serialize_object",
+]

@@ -1,0 +1,145 @@
+"""Central runtime configuration registry.
+
+Parity with the reference's ``RAY_CONFIG`` macro table
+(ray: src/ray/common/ray_config_def.h — 208 env-overridable knobs with
+priority env > _system_config > default).  We keep the same three-level
+priority but as a typed Python dataclass-like registry: every knob is
+declared once with a type and default, is overridable via a
+``RAYTPU_<NAME>`` environment variable, and can be overridden
+programmatically via ``init(system_config={...})``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict
+
+
+def _parse_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+class _Knob:
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name: str, type_: type, default: Any, doc: str = ""):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+
+
+class Config:
+    """Process-wide config. Priority: env RAYTPU_<NAME> > overrides > default."""
+
+    _KNOBS: Dict[str, _Knob] = {}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._overrides: Dict[str, Any] = {}
+
+    @classmethod
+    def declare(cls, name: str, type_: type, default: Any, doc: str = "") -> None:
+        cls._KNOBS[name] = _Knob(name, type_, default, doc)
+
+    def get(self, name: str) -> Any:
+        knob = self._KNOBS[name]
+        env = os.environ.get(f"RAYTPU_{name.upper()}")
+        if env is not None:
+            return _PARSERS[knob.type](env)
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+        return knob.default
+
+    def set(self, name: str, value: Any) -> None:
+        knob = self._KNOBS[name]
+        if not isinstance(value, knob.type):
+            # strings go through the same parsers as env vars, so
+            # set('some_bool', 'false') is False, not bool('false')
+            if isinstance(value, str):
+                value = _PARSERS[knob.type](value)
+            else:
+                value = knob.type(value)
+        with self._lock:
+            self._overrides[name] = value
+
+    def update(self, overrides: Dict[str, Any]) -> None:
+        for k, v in overrides.items():
+            self.set(k, v)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Everything, resolved — shipped to spawned workers at startup."""
+        return {name: self.get(name) for name in self._KNOBS}
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self.get(name)
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+D = Config.declare
+
+# --- Object store ---------------------------------------------------------
+D("object_store_memory_bytes", int, 2 * 1024**3, "Shared-memory arena size per node.")
+D("object_store_min_alloc", int, 64, "Minimum allocation granularity (bytes).")
+D("object_inline_max_bytes", int, 100 * 1024,
+  "Objects at or below this size travel inline in RPCs instead of the store.")
+D("object_spill_threshold", float, 0.8,
+  "Store fullness fraction that triggers spilling to disk.")
+D("object_spill_dir", str, "", "Directory for spilled objects ('' = <session>/spill).")
+
+# --- Scheduler ------------------------------------------------------------
+D("scheduler_spread_threshold", float, 0.5,
+  "Hybrid policy: pack onto a node until this utilization, then spread.")
+D("scheduler_top_k_fraction", float, 0.2,
+  "Hybrid policy: random choice among the top k fraction of candidate nodes.")
+D("worker_lease_timeout_s", float, 30.0, "Worker lease request timeout.")
+D("max_pending_lease_requests_per_scheduling_class", int, 10,
+  "Pipelined lease requests per distinct (fn, resources) class.")
+
+# --- Workers --------------------------------------------------------------
+D("num_workers_soft_limit", int, 0, "0 = num_cpus workers per node.")
+D("worker_register_timeout_s", float, 30.0, "Startup handshake deadline.")
+D("worker_idle_timeout_s", float, 300.0, "Idle worker reap time.")
+
+# --- Control plane --------------------------------------------------------
+D("health_check_period_s", float, 1.0, "Controller→node liveness probe period.")
+D("health_check_failure_threshold", int, 5, "Missed probes before a node is dead.")
+D("task_event_buffer_size", int, 10000, "Ring buffer of task state events.")
+D("pubsub_poll_timeout_s", float, 30.0, "Long-poll timeout for subscribers.")
+
+# --- Fault tolerance ------------------------------------------------------
+D("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")
+D("actor_max_restarts_default", int, 0, "Default actor restarts.")
+D("lineage_max_bytes", int, 256 * 1024**2, "Lineage table cap per owner.")
+
+# --- TPU / mesh -----------------------------------------------------------
+D("tpu_topology", str, "", "Override detected topology, e.g. 'v5p-64'.")
+D("mesh_allow_cpu_fallback", bool, True,
+  "Build meshes over the CPU backend when no TPU is present (tests).")
+D("ici_contiguous_placement", bool, True,
+  "Placement groups prefer ICI-contiguous chips within a slice.")
+
+# --- Metrics / events -----------------------------------------------------
+D("metrics_export_interval_s", float, 10.0, "Metrics flush period.")
+D("event_log_dir", str, "", "Structured event log dir ('' = <session>/events).")
+
+
+GLOBAL_CONFIG = Config()
+
+
+def get_config() -> Config:
+    return GLOBAL_CONFIG

@@ -1,0 +1,202 @@
+"""Hierarchical binary identifiers for jobs, actors, tasks and objects.
+
+Design parity with the reference's deterministic ID hierarchy
+(ray: src/ray/common/id.h, id_def.h): JobID (4 bytes) is a prefix of
+ActorID (16 bytes), which is a prefix of TaskID (24 bytes), which is a
+prefix of ObjectID (28 bytes = TaskID + 4-byte return index).  This lets
+any component recover the owning task/actor/job of an object with pure
+byte slicing — no directory lookups — which is what makes distributed
+ownership tracking cheap.
+
+Unlike the reference we keep IDs as immutable Python objects backed by
+``bytes``; the native object store addresses objects by these same 28
+raw bytes so Python and C++ agree on identity for free.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import ClassVar
+
+JOB_ID_SIZE = 4
+ACTOR_UNIQUE_SIZE = 12  # ActorID = JobID + 12 unique bytes
+ACTOR_ID_SIZE = JOB_ID_SIZE + ACTOR_UNIQUE_SIZE  # 16
+TASK_UNIQUE_SIZE = 8  # TaskID = ActorID + 8 unique bytes
+TASK_ID_SIZE = ACTOR_ID_SIZE + TASK_UNIQUE_SIZE  # 24
+OBJECT_INDEX_SIZE = 4  # ObjectID = TaskID + 4-byte return index
+OBJECT_ID_SIZE = TASK_ID_SIZE + OBJECT_INDEX_SIZE  # 28
+
+_MAX_OBJECT_INDEX = 2**31 - 1
+
+
+class BaseID:
+    """Immutable fixed-width binary id."""
+
+    SIZE: ClassVar[int] = 0
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, binary: bytes):
+        if not isinstance(binary, (bytes, bytearray, memoryview)):
+            raise TypeError(f"expected bytes, got {type(binary)!r}")
+        binary = bytes(binary)
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
+            )
+        object.__setattr__(self, "_bytes", binary)
+        object.__setattr__(self, "_hash", hash((type(self).__name__, binary)))
+
+    def __setattr__(self, name, value):  # immutability
+        raise AttributeError(f"{type(self).__name__} is immutable")
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\xff" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\xff" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __eq__(self, other) -> bool:
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __ne__(self, other) -> bool:
+        return not self.__eq__(other)
+
+    def __lt__(self, other) -> bool:
+        return self._bytes < other._bytes
+
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = JOB_ID_SIZE
+    __slots__ = ()
+
+    _counter_lock = threading.Lock()
+    _counter = 0
+
+    @classmethod
+    def from_int(cls, value: int) -> "JobID":
+        return cls(value.to_bytes(JOB_ID_SIZE, "little"))
+
+    def int_value(self) -> int:
+        return int.from_bytes(self._bytes, "little")
+
+    @classmethod
+    def next(cls) -> "JobID":
+        """Monotonic job ids handed out by the control plane."""
+        with cls._counter_lock:
+            cls._counter += 1
+            return cls.from_int(cls._counter)
+
+
+class ActorID(BaseID):
+    SIZE = ACTOR_ID_SIZE
+    __slots__ = ()
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(job_id.binary() + os.urandom(ACTOR_UNIQUE_SIZE))
+
+    @classmethod
+    def nil_for_job(cls, job_id: JobID) -> "ActorID":
+        """The 'no actor' actor id still carrying the job prefix."""
+        return cls(job_id.binary() + b"\xff" * ACTOR_UNIQUE_SIZE)
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:JOB_ID_SIZE])
+
+
+class TaskID(BaseID):
+    SIZE = TASK_ID_SIZE
+    __slots__ = ()
+
+    @classmethod
+    def of(cls, actor_id: ActorID) -> "TaskID":
+        return cls(actor_id.binary() + os.urandom(TASK_UNIQUE_SIZE))
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        """The implicit root task of a driver: actor part nil, unique part zero."""
+        return cls(ActorID.nil_for_job(job_id).binary() + b"\x00" * TASK_UNIQUE_SIZE)
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[:ACTOR_ID_SIZE])
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:JOB_ID_SIZE])
+
+
+class ObjectID(BaseID):
+    SIZE = OBJECT_ID_SIZE
+    __slots__ = ()
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        if not 0 <= index <= _MAX_OBJECT_INDEX:
+            raise ValueError(f"return index out of range: {index}")
+        return cls(task_id.binary() + index.to_bytes(OBJECT_INDEX_SIZE, "little"))
+
+    @classmethod
+    def from_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # Puts use the high bit of the index word to avoid colliding with returns.
+        if not 0 <= put_index <= _MAX_OBJECT_INDEX:
+            raise ValueError(f"put index out of range: {put_index}")
+        word = put_index | (1 << 31)
+        return cls(task_id.binary() + word.to_bytes(OBJECT_INDEX_SIZE, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:TASK_ID_SIZE])
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:JOB_ID_SIZE])
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._bytes[TASK_ID_SIZE:], "little") & _MAX_OBJECT_INDEX
+
+    def is_put(self) -> bool:
+        return bool(int.from_bytes(self._bytes[TASK_ID_SIZE:], "little") >> 31)
+
+
+class NodeID(BaseID):
+    SIZE = 16
+    __slots__ = ()
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+    __slots__ = ()
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16
+    __slots__ = ()
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "PlacementGroupID":
+        return cls(job_id.binary() + os.urandom(cls.SIZE - JOB_ID_SIZE))
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:JOB_ID_SIZE])

@@ -1,0 +1,117 @@
+"""Serialization for tasks, actors and objects.
+
+Parity with the reference's serialization stack
+(ray: python/ray/_private/serialization.py + vendored cloudpickle):
+cloudpickle for closures/classes, pickle protocol 5 with out-of-band
+buffers so large numpy arrays are written as contiguous buffers that the
+shared-memory object store can hold and readers can map zero-copy.
+
+Wire/store frame (self-describing):
+
+    [u32 meta_len][meta][u64 nbuf][u64 len_i ...][buf_i ...]
+
+``meta`` is the cloudpickle stream with out-of-band ``PickleBuffer``
+records; the tail holds the raw buffers.  ``deserialize_object`` hands
+pickle memoryview slices over the input, so when the input is a mapped
+shared-memory region, numpy arrays reconstruct as zero-copy views.
+
+jax.Array values are converted to numpy on serialize (an explicit
+device→host copy); callers move data back to device deliberately — the
+framework never hides device transfers inside pickling.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+_U32 = struct.Struct("<I")
+
+
+def _to_picklable(value: Any) -> Any:
+    try:
+        import jax
+
+        if isinstance(value, jax.Array):
+            import numpy as np
+
+            return np.asarray(value)
+    except ImportError:  # pragma: no cover
+        pass
+    return value
+
+
+def _flatten(tree: Any) -> Any:
+    """Recursively convert jax arrays inside containers (type-preserving)."""
+    if isinstance(tree, dict):
+        return type(tree)((k, _flatten(v)) for k, v in tree.items())
+    if isinstance(tree, tuple):
+        mapped = [_flatten(v) for v in tree]
+        if hasattr(tree, "_fields"):  # NamedTuple
+            return type(tree)(*mapped)
+        return tuple(mapped)
+    if isinstance(tree, list):
+        return [_flatten(v) for v in tree]
+    return _to_picklable(tree)
+
+
+def serialize_parts(value: Any) -> Tuple[bytes, List[memoryview]]:
+    """(meta, out-of-band buffers) — used when writing straight into the store."""
+    value = _flatten(value)
+    buffers: List[pickle.PickleBuffer] = []
+    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    views = []
+    for b in buffers:
+        raw = b.raw()
+        views.append(raw if raw.format == "B" and raw.ndim == 1 else raw.cast("B"))
+    return meta, views
+
+
+def framed_size(meta: bytes, buffers: List[memoryview]) -> int:
+    return _U32.size + len(meta) + 8 + 8 * len(buffers) + sum(b.nbytes for b in buffers)
+
+
+def write_framed(out: memoryview, meta: bytes, buffers: List[memoryview]) -> int:
+    """Write the frame into ``out`` (e.g. store allocation); returns size."""
+    out = out.cast("B") if (out.format != "B" or out.ndim != 1) else out
+    off = _U32.size
+    out[:off] = _U32.pack(len(meta))
+    out[off : off + len(meta)] = meta
+    off += len(meta)
+    struct.pack_into("<Q", out, off, len(buffers))
+    off += 8
+    for b in buffers:
+        struct.pack_into("<Q", out, off, b.nbytes)
+        off += 8
+    for b in buffers:
+        out[off : off + b.nbytes] = b
+        off += b.nbytes
+    return off
+
+
+def serialize_object(value: Any) -> bytes:
+    meta, buffers = serialize_parts(value)
+    out = bytearray(framed_size(meta, buffers))
+    write_framed(memoryview(out), meta, buffers)
+    return bytes(out)
+
+
+def deserialize_object(data) -> Any:
+    mv = memoryview(data)
+    mv = mv.cast("B") if (mv.format != "B" or mv.ndim != 1) else mv
+    (meta_len,) = _U32.unpack_from(mv, 0)
+    off = _U32.size
+    meta = bytes(mv[off : off + meta_len])
+    off += meta_len
+    (nbuf,) = struct.unpack_from("<Q", mv, off)
+    off += 8
+    lens = struct.unpack_from(f"<{nbuf}Q", mv, off)
+    off += 8 * nbuf
+    bufs = []
+    for n in lens:
+        bufs.append(mv[off : off + n])
+        off += n
+    return pickle.loads(meta, buffers=bufs)

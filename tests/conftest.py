@@ -1,0 +1,35 @@
+"""Test harness: fake an 8-chip mesh on CPU.
+
+The reference tests multi-node behavior by running N raylets as local
+processes (ray: python/ray/cluster_utils.py:108); the TPU analogue is a
+virtual multi-device CPU backend — 8 XLA host devices let every
+sharding/collective path (dp/fsdp/tp/sp/ep) compile and run without
+TPU hardware.  Must be set before jax initializes its backends.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may preset a TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# A sitecustomize may pin jax_platforms to the TPU ("axon"); tests always
+# run on the virtual CPU mesh, so override at config level too.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices

@@ -1,0 +1,306 @@
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import ActorDiedError, GetTimeoutError, TaskError
+
+
+@pytest.fixture()
+def rt():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=False)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_roundtrip(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_chaining_refs_as_args(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    r1 = add.remote(1, 2)
+    r2 = add.remote(r1, 10)  # ref passed as arg resolves to its value
+    assert ray_tpu.get(r2) == 13
+
+
+def test_put_get_numpy(rt):
+    arr = np.arange(100_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out, arr)
+    # objects are immutable snapshots: mutating the source after put
+    # must not affect the stored value
+    arr[0] = 999
+    np.testing.assert_array_equal(ray_tpu.get(ref)[:1], [0.0])
+
+
+def test_num_returns(rt):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_task_error_propagates(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("inner message")
+
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "inner message" in str(ei.value)
+
+
+def test_task_retries(rt):
+    state = {"n": 0}
+
+    @ray_tpu.remote
+    def counter_path():
+        # runs in-process (threads) so shared state is visible
+        state["n"] += 1
+        if state["n"] < 3:
+            raise RuntimeError("flaky")
+        return state["n"]
+
+    ref = counter_path.options(max_retries=5).remote()
+    assert ray_tpu.get(ref) == 3
+
+
+def test_wait(rt):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast = slow.remote(0.01)
+    slower = slow.remote(0.8)
+    ready, pending = ray_tpu.wait([fast, slower], num_returns=1, timeout=0.5)
+    assert ready == [fast] and pending == [slower]
+    ready2, pending2 = ray_tpu.wait([fast, slower], num_returns=2, timeout=5)
+    assert len(ready2) == 2 and not pending2
+
+
+def test_get_timeout(rt):
+    @ray_tpu.remote
+    def forever():
+        time.sleep(60)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(forever.remote(), timeout=0.1)
+
+
+def test_parallelism_resource_limits(rt):
+    # 8 CPUs, tasks of 4 CPUs each: two run concurrently, third waits
+    @ray_tpu.remote(num_cpus=4)
+    def hold():
+        time.sleep(0.3)
+        return time.monotonic()
+
+    t0 = time.monotonic()
+    refs = [hold.remote() for _ in range(4)]
+    ray_tpu.get(refs, timeout=10)
+    dt = time.monotonic() - t0
+    assert dt >= 0.55, dt  # at least two waves
+
+
+def test_infeasible_task_rejected(rt):
+    @ray_tpu.remote(num_cpus=64)
+    def big():
+        return 1
+
+    with pytest.raises(ValueError, match="infeasible"):
+        big.remote()
+
+
+def test_actor_basic(rt):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def get(self):
+            return self.n
+
+    c = Counter.remote(10)
+    refs = [c.inc.remote() for _ in range(5)]
+    assert ray_tpu.get(refs) == [11, 12, 13, 14, 15]  # ordered execution
+    assert ray_tpu.get(c.get.remote()) == 15
+
+
+def test_actor_error_does_not_kill(rt):
+    @ray_tpu.remote
+    class A:
+        def bad(self):
+            raise KeyError("nope")
+
+        def ok(self):
+            return "fine"
+
+    a = A.remote()
+    with pytest.raises(TaskError):
+        ray_tpu.get(a.bad.remote())
+    assert ray_tpu.get(a.ok.remote()) == "fine"
+
+
+def test_named_actor_and_get_if_exists(rt):
+    @ray_tpu.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    r1 = Registry.options(name="reg").remote()
+    assert ray_tpu.get(r1.ping.remote()) == "pong"
+    r2 = ray_tpu.get_actor("reg")
+    assert ray_tpu.get(r2.ping.remote()) == "pong"
+    with pytest.raises(ValueError, match="already taken"):
+        Registry.options(name="reg").remote()
+    r3 = Registry.options(name="reg", get_if_exists=True).remote()
+    assert r3._actor_id == r1._actor_id
+
+
+def test_kill_actor(rt):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == 1
+    ray_tpu.kill(a)
+    time.sleep(0.1)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.ping.remote(), timeout=2)
+
+
+def test_async_actor_method(rt):
+    @ray_tpu.remote
+    class Async:
+        async def compute(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = Async.remote()
+    assert ray_tpu.get(a.compute.remote(21)) == 42
+
+
+def test_method_num_returns(rt):
+    @ray_tpu.remote
+    class M:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return "a", "b"
+
+    m = M.remote()
+    r1, r2 = m.pair.remote()
+    assert ray_tpu.get([r1, r2]) == ["a", "b"]
+
+
+def test_actor_handle_serializable(rt):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.v = 7
+
+        def get(self):
+            return self.v
+
+    @ray_tpu.remote
+    def call_through(handle):
+        return ray_tpu.get(handle.get.remote())
+
+    h = Holder.remote()
+    assert ray_tpu.get(call_through.remote(h)) == 7
+
+
+def test_cluster_resources(rt):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 8.0
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= total["CPU"]
+
+
+def test_invalid_option_rejected(rt):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="invalid option"):
+        f.options(num_gpus=1)
+
+
+def test_direct_call_rejected(rt):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError, match="cannot be called directly"):
+        f()
+
+
+def test_failed_creation_frees_name(rt):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("no")
+
+        def ping(self):
+            return 1
+
+    b = Bad.options(name="fragile").remote()
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(b.ping.remote(), timeout=5)
+    time.sleep(0.2)
+
+    @ray_tpu.remote
+    class Good:
+        def ping(self):
+            return 2
+
+    g = Good.options(name="fragile").remote()  # name must be reusable
+    assert ray_tpu.get(g.ping.remote()) == 2
+
+
+def test_kill_restartable(rt):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.generation = 1
+
+        def gen(self):
+            return self.generation
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.gen.remote()) == 1
+    ray_tpu.kill(p, no_restart=False)
+    time.sleep(0.3)
+    assert ray_tpu.get(p.gen.remote(), timeout=5) == 1  # restarted instance
+
+
+def test_get_actor_method_num_returns(rt):
+    @ray_tpu.remote
+    class M2:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return "x", "y"
+
+    M2.options(name="m2").remote()
+    h = ray_tpu.get_actor("m2")
+    r1, r2 = h.pair.remote()
+    assert ray_tpu.get([r1, r2]) == ["x", "y"]

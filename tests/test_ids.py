@@ -1,0 +1,61 @@
+import pickle
+
+import pytest
+
+from ray_tpu.utils.ids import ActorID, JobID, ObjectID, TaskID
+
+
+def test_hierarchy_sizes():
+    job = JobID.from_int(7)
+    actor = ActorID.of(job)
+    task = TaskID.of(actor)
+    obj = ObjectID.for_task_return(task, 2)
+    assert len(job.binary()) == 4
+    assert len(actor.binary()) == 16
+    assert len(task.binary()) == 24
+    assert len(obj.binary()) == 28
+
+
+def test_prefix_recovery():
+    job = JobID.from_int(42)
+    actor = ActorID.of(job)
+    task = TaskID.of(actor)
+    obj = ObjectID.for_task_return(task, 5)
+    assert obj.task_id() == task
+    assert obj.job_id() == job
+    assert task.actor_id() == actor
+    assert task.job_id() == job
+    assert actor.job_id() == job
+    assert obj.return_index() == 5
+    assert not obj.is_put()
+
+
+def test_put_vs_return_ids_disjoint():
+    task = TaskID.for_driver(JobID.from_int(1))
+    ret = ObjectID.for_task_return(task, 3)
+    put = ObjectID.from_put(task, 3)
+    assert ret != put
+    assert put.is_put() and not ret.is_put()
+    assert put.return_index() == 3
+
+
+def test_equality_hash_pickle():
+    job = JobID.from_int(9)
+    assert JobID.from_int(9) == job
+    assert hash(JobID.from_int(9)) == hash(job)
+    assert pickle.loads(pickle.dumps(job)) == job
+    task = TaskID.for_driver(job)
+    assert pickle.loads(pickle.dumps(task)) == task
+
+
+def test_immutable_and_validated():
+    job = JobID.from_int(1)
+    with pytest.raises(AttributeError):
+        job._bytes = b"xxxx"
+    with pytest.raises(ValueError):
+        JobID(b"toolongforajobid")
+
+
+def test_nil():
+    assert JobID.nil().is_nil()
+    assert not JobID.from_int(1).is_nil()

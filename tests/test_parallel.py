@@ -1,0 +1,122 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel import (
+    MeshSpec,
+    CollectiveGroup,
+    create_mesh,
+    data_axis_size,
+    init_collective_group,
+    get_group,
+    sharding_for,
+    shard_tree,
+    spec_for,
+    tree_shardings,
+)
+
+
+def test_mesh_spec_sizes():
+    spec = MeshSpec(dp=-1, tp=2)
+    sizes = spec.sizes(8)
+    assert sizes == {"pp": 1, "dp": 4, "fsdp": 1, "ep": 1, "sp": 1, "tp": 2}
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3, tp=3).sizes(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=-1).sizes(8)
+
+
+def test_create_mesh(cpu_devices):
+    mesh = create_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
+    assert data_axis_size(mesh) == 4
+
+
+def test_spec_for_rules():
+    # batch maps to (dp, fsdp); embed to fsdp — but fsdp already used by batch,
+    # so embed must come out replicated in the same spec.
+    s = spec_for(("batch", None, "embed"))
+    assert s[0] == ("dp", "fsdp")
+    assert s[2] is None
+    # params don't mention batch, so embed gets fsdp there
+    s2 = spec_for(("embed", "mlp"))
+    assert s2 == P("fsdp", "tp")
+
+
+def test_sharded_matmul(cpu_devices):
+    mesh = create_mesh(MeshSpec(dp=4, tp=2))
+    x = np.ones((8, 16), np.float32)
+    w = np.ones((16, 32), np.float32)
+    xs = jax.device_put(x, sharding_for(mesh, ("batch", None)))
+    ws = jax.device_put(w, sharding_for(mesh, (None, "mlp")))
+
+    @jax.jit
+    def f(x, w):
+        return x @ w
+
+    out = f(xs, ws)
+    np.testing.assert_allclose(np.asarray(out), x @ w)
+    # dim 0 stays sharded over the data axes (XLA may normalize the spec
+    # to drop size-1 axes, so just check dp is in there)
+    spec0 = out.sharding.spec[0]
+    assert "dp" in (spec0 if isinstance(spec0, tuple) else (spec0,))
+
+
+def test_tree_shardings(cpu_devices):
+    mesh = create_mesh(MeshSpec(dp=4, tp=2))
+    params = {"wq": np.zeros((8, 4)), "wo": np.zeros((4, 8))}
+    logical = {"wq": ("embed", "heads"), "wo": ("heads", "embed")}
+    sharded = shard_tree(mesh, params, logical)
+    assert isinstance(sharded["wq"].sharding, NamedSharding)
+    assert sharded["wq"].sharding.spec == P("fsdp", "tp")
+
+
+def test_collective_group_allreduce(cpu_devices):
+    mesh = create_mesh(MeshSpec(dp=8))
+    grp = init_collective_group(mesh, "dp", "g1")
+    assert get_group("g1") is grp
+    assert grp.world_size == 8
+
+    x = jnp.arange(8.0)
+
+    def body(x):
+        return grp.allreduce(x)
+
+    out = grp.run(body, x, in_specs=P("dp"), out_specs=P())
+    np.testing.assert_allclose(np.asarray(out), np.full((1,), np.arange(8.0).sum()))
+
+
+def test_collective_shift_ring(cpu_devices):
+    mesh = create_mesh(MeshSpec(dp=1, sp=8))
+    grp = CollectiveGroup(mesh, "sp")
+
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    def body(x):
+        return grp.shift(x, 1)
+
+    out = grp.run(body, x, in_specs=P("sp"), out_specs=P("sp"))
+    # member i's value goes to member i+1 → output[i] = x[i-1]
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.roll(np.arange(8.0), 1))
+
+
+def test_all_to_all(cpu_devices):
+    mesh = create_mesh(MeshSpec(dp=1, ep=8))
+    grp = CollectiveGroup(mesh, "ep")
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    def body(x):
+        return grp.all_to_all(x, split_axis=1, concat_axis=0)
+
+    out = grp.run(body, x, in_specs=P("ep"), out_specs=P(None, "ep"))
+    assert out.shape == (8, 8)
+
+    # roundtrip: a second all_to_all with swapped axes restores the input
+    def roundtrip(x):
+        y = grp.all_to_all(x, split_axis=1, concat_axis=0)
+        return grp.all_to_all(y, split_axis=0, concat_axis=1)
+
+    back = grp.run(roundtrip, x, in_specs=P("ep"), out_specs=P("ep"))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))

@@ -1,0 +1,66 @@
+import numpy as np
+
+from ray_tpu.utils.serialization import (
+    deserialize_object,
+    serialize_object,
+    serialize_parts,
+)
+
+
+def test_roundtrip_basic():
+    for value in [1, "abc", None, {"a": [1, 2, (3, 4)]}, b"\x00" * 100]:
+        assert deserialize_object(serialize_object(value)) == value
+
+
+def test_roundtrip_numpy_out_of_band():
+    arr = np.arange(10000, dtype=np.float32).reshape(100, 100)
+    meta, bufs = serialize_parts(arr)
+    assert sum(b.nbytes for b in bufs) >= arr.nbytes  # big array out of band
+    out = deserialize_object(serialize_object(arr))
+    np.testing.assert_array_equal(out, arr)
+    assert out.dtype == arr.dtype
+
+
+def test_zero_copy_view_on_memoryview_input():
+    arr = np.arange(4096, dtype=np.int64)
+    frame = serialize_object(arr)
+    out = deserialize_object(memoryview(frame))
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_closure_roundtrip():
+    x = 10
+
+    def f(y):
+        return x + y
+
+    g = deserialize_object(serialize_object(f))
+    assert g(5) == 15
+
+
+def test_jax_array_converted_to_numpy():
+    import jax.numpy as jnp
+
+    val = {"w": jnp.ones((8, 8)), "step": 3}
+    out = deserialize_object(serialize_object(val))
+    assert isinstance(out["w"], np.ndarray)
+    assert out["w"].shape == (8, 8)
+    assert out["step"] == 3
+
+
+def test_config():
+    from ray_tpu.utils.config import Config
+
+    cfg = Config()
+    assert cfg.object_store_min_alloc == 64
+    cfg.set("object_store_min_alloc", 128)
+    assert cfg.get("object_store_min_alloc") == 128
+    import os
+
+    os.environ["RAYTPU_OBJECT_STORE_MIN_ALLOC"] = "256"
+    try:
+        assert cfg.object_store_min_alloc == 256  # env wins
+    finally:
+        del os.environ["RAYTPU_OBJECT_STORE_MIN_ALLOC"]
+    snap = cfg.snapshot()
+    assert "scheduler_spread_threshold" in snap
