@@ -111,7 +111,8 @@ def main():
     # Baseline: same step in float32 — the throughput of a port that
     # ignores the MXU's bf16 preference.  (f32 *without* remat, the truly
     # naive variant, OOMs outright at this size: 34 GB of attention probs.)
-    baseline_cfg = dataclasses.replace(cfg, dtype=jax.numpy.float32)
+    baseline_cfg = dataclasses.replace(cfg, dtype=jax.numpy.float32,
+                                       remat_policy="full")
     try:
         baseline_tps = _measure(baseline_cfg, devices, steps=max(2, steps // 3))
     except Exception:

@@ -27,6 +27,27 @@ def _gqa_expand(k: jax.Array, groups: int) -> jax.Array:
     return jnp.repeat(k, groups, axis=2)
 
 
+def _flash_eligible(q, k, causal, segment_ids, logits_soft_cap) -> bool:
+    from ray_tpu.ops.flash_attention import DEFAULT_BLOCK_KV, DEFAULT_BLOCK_Q
+
+    B, S, H, D = q.shape
+    # must mirror flash_attention's own validation: blocks clamp to S
+    bq = min(DEFAULT_BLOCK_Q, S)
+    bk = min(DEFAULT_BLOCK_KV, S)
+    return (
+        causal
+        and segment_ids is None
+        and logits_soft_cap is None
+        and k.shape[1] == S  # no decode-offset (k longer than q) support
+        and S % bq == 0
+        and S % bk == 0
+        and S >= 256
+        and H % k.shape[2] == 0
+        # pallas TPU kernel: real TPU or the tunneled "axon" TPU platform
+        and jax.devices()[0].platform in ("tpu", "axon")
+    )
+
+
 @partial(jax.jit, static_argnames=("causal",))
 def dot_product_attention(
     q: jax.Array,
@@ -37,10 +58,16 @@ def dot_product_attention(
     segment_ids: Optional[jax.Array] = None,
     logits_soft_cap: Optional[float] = None,
 ) -> jax.Array:
-    """Numerically-stable softmax attention with GQA and optional packing.
+    """Softmax attention with GQA and optional packing.
 
-    Computed in float32 regardless of input dtype; output cast back.
+    Dispatches to the Pallas flash kernel on TPU when eligible (causal,
+    unpacked, block-divisible seq); otherwise the einsum path below,
+    computed in float32 regardless of input dtype.
     """
+    if _flash_eligible(q, k, causal, segment_ids, logits_soft_cap):
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
     orig_dtype = q.dtype
     *_, n_heads, head_dim = q.shape
     n_kv = k.shape[2]
