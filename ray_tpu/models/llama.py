@@ -48,6 +48,9 @@ class LlamaConfig:
     remat_policy: str = "dots"
     logits_soft_cap: Optional[float] = None
     tie_embeddings: bool = False
+    # Shard the sequence over the mesh "sp" axis: attention becomes ring
+    # attention (ray_tpu.ops.ring_attention) over the ICI ring.
+    sequence_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -182,11 +185,28 @@ def _qkv(x, layer, cfg: LlamaConfig, sin, cos):
     return apply_rope(q, sin, cos), apply_rope(k, sin, cos), v
 
 
-def _attn_block(x, layer, cfg: LlamaConfig, sin, cos, segment_ids):
-    """Returns (out, (k, v)) — k/v for cache population during prefill."""
+def _attn_block(x, layer, cfg: LlamaConfig, sin, cos, segment_ids,
+                use_ring: bool = False):
+    """Returns (out, (k, v)) — k/v for cache population during prefill.
+
+    ``use_ring`` is a training-time choice (forward sets it from
+    cfg.sequence_parallel); prefill/decode always use the local path.
+    """
     q, k, v = _qkv(x, layer, cfg, sin, cos)
-    out = dot_product_attention(q, k, v, causal=True, segment_ids=segment_ids,
-                                logits_soft_cap=cfg.logits_soft_cap)
+    if use_ring:
+        if segment_ids is not None or cfg.logits_soft_cap is not None:
+            raise ValueError(
+                "sequence_parallel does not support segment_ids or "
+                "logits_soft_cap yet — ring attention would silently "
+                "ignore them"
+            )
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v)
+    else:
+        out = dot_product_attention(q, k, v, causal=True,
+                                    segment_ids=segment_ids,
+                                    logits_soft_cap=cfg.logits_soft_cap)
     out = jnp.einsum("bshk,hkd->bsd", out, layer["attn"]["wo"].astype(cfg.dtype))
     return out, (k, v)
 
@@ -202,7 +222,8 @@ def _mlp_block(x, layer, cfg: LlamaConfig):
 
 def _layer_fn(cfg: LlamaConfig, x, layer, sin, cos, segment_ids):
     h = x + _attn_block(rms_norm(x, layer["ln_attn"], cfg.norm_eps), layer,
-                        cfg, sin, cos, segment_ids)[0]
+                        cfg, sin, cos, segment_ids,
+                        use_ring=cfg.sequence_parallel)[0]
     return h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
 
 

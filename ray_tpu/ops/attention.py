@@ -27,6 +27,12 @@ def _gqa_expand(k: jax.Array, groups: int) -> jax.Array:
     return jnp.repeat(k, groups, axis=2)
 
 
+def _on_tpu() -> bool:
+    """pallas TPU kernels need a real TPU (or the tunneled "axon" TPU
+    platform); separate so tests can monkeypatch it."""
+    return jax.devices()[0].platform in ("tpu", "axon")
+
+
 def _flash_eligible(q, k, causal, segment_ids, logits_soft_cap) -> bool:
     from ray_tpu.ops.flash_attention import DEFAULT_BLOCK_KV, DEFAULT_BLOCK_Q
 
@@ -43,8 +49,7 @@ def _flash_eligible(q, k, causal, segment_ids, logits_soft_cap) -> bool:
         and S % bk == 0
         and S >= 256
         and H % k.shape[2] == 0
-        # pallas TPU kernel: real TPU or the tunneled "axon" TPU platform
-        and jax.devices()[0].platform in ("tpu", "axon")
+        and _on_tpu()
     )
 
 

@@ -74,16 +74,24 @@ def test_unequal_blocks_causal():
         )
 
 
-def test_eligibility_matches_kernel():
-    from ray_tpu.ops.attention import _flash_eligible
+def test_eligibility_matches_kernel(monkeypatch):
+    from ray_tpu.ops import attention
+
+    # pretend we're on TPU so the shape logic is actually exercised
+    monkeypatch.setattr(attention, "_on_tpu", lambda: True)
 
     mk = lambda s, kl=None: (
         jax.ShapeDtypeStruct((1, s, 4, 64), jnp.bfloat16),
         jax.ShapeDtypeStruct((1, kl or s, 2, 64), jnp.bfloat16),
     )
+    q, k = mk(1024)
+    assert attention._flash_eligible(q, k, True, None, None)
     # S=640 not divisible by the clamped 512 block: must NOT be eligible
     q, k = mk(640)
-    assert not _flash_eligible(q, k, True, None, None)
+    assert not attention._flash_eligible(q, k, True, None, None)
     # decode-offset (k longer than q) must fall back to einsum
     q, k = mk(256, kl=512)
-    assert not _flash_eligible(q, k, True, None, None)
+    assert not attention._flash_eligible(q, k, True, None, None)
+    # packed sequences fall back
+    q, k = mk(1024)
+    assert not attention._flash_eligible(q, k, True, "segs", None)
