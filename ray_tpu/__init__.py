@@ -83,6 +83,10 @@ def nodes():
     return _api().nodes()
 
 
+def placement_group_table():
+    return _api().runtime().placement_group_table()
+
+
 def cluster_resources():
     return _api().cluster_resources()
 

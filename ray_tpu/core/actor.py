@@ -16,8 +16,8 @@ from ray_tpu.utils.ids import ActorID
 
 _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "name", "get_if_exists",
-    "max_restarts", "max_concurrency", "lifetime", "placement_group",
-    "placement_bundle_index",
+    "max_restarts", "max_concurrency", "lifetime", "scheduling_strategy",
+    "placement_group", "placement_bundle_index",
 }
 
 _METHOD_OPTION_ATTR = "__raytpu_method_options__"

@@ -1,0 +1,49 @@
+"""Multi-node cluster fixture for tests and local simulation.
+
+Parity with the reference's single-machine multi-raylet trick
+(ray: python/ray/cluster_utils.py:108 Cluster — N raylets as local
+processes sharing one GCS; cluster.add_node fakes heterogeneous nodes,
+cluster.kill_node exercises failure paths).  Here nodes are logical
+scheduling domains inside one runtime; the failure semantics (actor
+death + restart elsewhere, placement-group bundle rescheduling) follow
+the reference's GCS behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu.utils.ids import NodeID
+
+
+class Cluster:
+    def __init__(self, *, initialize_head: bool = True,
+                 head_node_args: Optional[Dict] = None):
+        from ray_tpu.core import api
+
+        self._api = api
+        self.head_node_id: Optional[NodeID] = None
+        if initialize_head:
+            args = dict(head_node_args or {})
+            rt = api.init(**args)
+            self.head_node_id = rt.head_node_id
+
+    @property
+    def _runtime(self):
+        return self._api.runtime()
+
+    def add_node(self, *, num_cpus: float = 8, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None) -> NodeID:
+        total = dict(resources or {})
+        total.setdefault("CPU", float(num_cpus))
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        total.setdefault("memory", 16 * 1024**3)
+        return self._runtime.add_node(total, labels)
+
+    def kill_node(self, node_id: NodeID) -> None:
+        self._runtime.kill_node(node_id)
+
+    def shutdown(self) -> None:
+        self._api.shutdown()

@@ -1,0 +1,145 @@
+"""Placement groups: gang reservation of resource bundles across nodes.
+
+Parity with the reference's placement-group subsystem
+(ray: python/ray/util/placement_group.py:41,146 — PlacementGroup handle +
+factory; src/ray/gcs/gcs_server/gcs_placement_group_manager.h:225 and
+gcs_placement_group_scheduler.cc — bundle reservation with PACK / SPREAD /
+STRICT_PACK / STRICT_SPREAD policies, raylet/scheduling/policy/
+bundle_scheduling_policy.h:31-98).
+
+TPU twist: nodes labeled with an integer ``ici_index`` are considered in
+coordinate order during reservation, so bundles of one group land on a
+contiguous slice block along the ICI topology (slice-aware gang
+scheduling — the reference only sketches TPU pod-head resources in
+_private/accelerator.py:176-191).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu.utils.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+@dataclasses.dataclass
+class Bundle:
+    """One reserved resource bundle, placed on exactly one node."""
+
+    index: int
+    resources: Dict[str, float]
+    node_id: Any = None  # NodeID once reserved
+    # Per-bundle ledger of what's still free inside the reservation.
+    available: Dict[str, float] = dataclasses.field(default_factory=dict)
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock,
+                                             repr=False)
+
+    def try_acquire(self, demand: Dict[str, float]) -> bool:
+        with self.lock:
+            if all(self.available.get(k, 0) >= v - 1e-9
+                   for k, v in demand.items()):
+                for k, v in demand.items():
+                    self.available[k] = self.available.get(k, 0) - v
+                return True
+            return False
+
+    def release(self, demand: Dict[str, float]) -> None:
+        with self.lock:
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0) + v
+
+
+class PlacementGroup:
+    """Client handle to a placement group (parity: util/placement_group.py:41)."""
+
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str, name: str = ""):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+        self.name = name
+
+    def ready(self):
+        """ObjectRef resolving once all bundles are reserved."""
+        from ray_tpu.core import api
+
+        return api.runtime().pg_ready_ref(self.id)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        from ray_tpu.core import api
+
+        try:
+            api.runtime().get(self.ready(), timeout)
+            return True
+        except TimeoutError:
+            return False
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __repr__(self):
+        return (f"PlacementGroup(id={self.id.hex()[:8]}, "
+                f"strategy={self.strategy}, bundles={self.bundle_specs})")
+
+
+def placement_group(bundles: Sequence[Dict[str, float]], *,
+                    strategy: str = "PACK", name: str = "",
+                    lifetime: Optional[str] = None) -> PlacementGroup:
+    """Reserve resource bundles across the cluster
+    (parity: util/placement_group.py:146)."""
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    bundles = [dict(b) for b in bundles]
+    if not bundles:
+        raise ValueError("placement group needs at least one bundle")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"invalid bundle {b!r}")
+    from ray_tpu.core import api
+
+    return api.runtime().create_placement_group(bundles, strategy, name,
+                                                lifetime)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu.core import api
+
+    api.runtime().remove_placement_group(pg.id)
+
+
+def get_placement_group(name: str) -> PlacementGroup:
+    from ray_tpu.core import api
+
+    return api.runtime().get_named_placement_group(name)
+
+
+# ---------------------------------------------------------------------------
+# Scheduling strategies (parity: python/ray/util/scheduling_strategies.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: PlacementGroup
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclasses.dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: Any  # NodeID or its hex string
+    soft: bool = False
+
+
+@dataclasses.dataclass
+class NodeLabelSchedulingStrategy:
+    hard: Dict[str, str] = dataclasses.field(default_factory=dict)
+    soft: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+# "DEFAULT" (hybrid pack-then-spread) and "SPREAD" are passed as strings.
+SchedulingStrategyT = Any

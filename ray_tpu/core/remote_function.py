@@ -17,7 +17,8 @@ from ray_tpu.core.runtime import TaskOptions
 
 _VALID_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "num_returns", "max_retries",
-    "name", "placement_group", "placement_bundle_index",
+    "name", "scheduling_strategy", "placement_group",
+    "placement_bundle_index",
 }
 
 

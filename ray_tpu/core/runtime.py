@@ -1,18 +1,21 @@
-"""Local runtime: tasks, actors, objects in one process.
+"""Cluster runtime: tasks, actors, objects over logical nodes in one process.
 
-This is the single-process implementation of the runtime interface —
-semantics-first parity with the reference's core: dependency-aware task
+Semantics-first parity with the reference's core: dependency-aware task
 dispatch (ray: raylet/local_task_manager.cc WaitForTaskArgsRequests /
-DispatchScheduledTasksToWorkers), logical resource accounting
-(common/scheduling/resource_instance_set.cc), per-actor ordered
-execution queues (core_worker/transport/actor_scheduling_queue.cc),
-error capture + retries (core_worker/task_manager.h max_retries), and
-named actors (gcs actor directory).
+DispatchScheduledTasksToWorkers), two-phase cluster scheduling with the
+hybrid pack-then-spread policy (raylet/scheduling/cluster_task_manager.cc:44,
+policy/hybrid_scheduling_policy.h:28-50), logical resource accounting
+(common/scheduling/resource_instance_set.cc), per-actor ordered execution
+queues (core_worker/transport/actor_scheduling_queue.cc), error capture +
+retries (core_worker/task_manager.h max_retries), named actors (gcs actor
+directory), placement-group bundle reservation
+(gcs/gcs_server/gcs_placement_group_scheduler.cc), and node membership +
+death propagation (gcs/gcs_server/gcs_node_manager.cc).
 
-The multi-process node runtime (ray_tpu.core.node) reuses the same
-dispatch logic with workers behind an RPC boundary and the C++
-shared-memory store; libraries only ever see the api module, so they
-run unchanged on either.
+The cluster is simulated as N logical nodes inside one process — the same
+trick the reference uses for multi-node tests (python/ray/cluster_utils.py
+Cluster runs N raylets locally).  Libraries only ever see the api module,
+so they run unchanged when workers move behind a process/RPC boundary.
 """
 
 from __future__ import annotations
@@ -28,9 +31,23 @@ from ray_tpu.core.exceptions import (
     TaskError,
 )
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.placement_group import (
+    Bundle,
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+)
 from ray_tpu.core.store import LocalObjectStore
 from ray_tpu.utils.config import get_config
-from ray_tpu.utils.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.utils.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+)
 
 
 @dataclasses.dataclass
@@ -41,6 +58,7 @@ class TaskOptions:
     num_returns: int = 1
     max_retries: int = 0
     name: str = ""
+    scheduling_strategy: Any = "DEFAULT"
     placement_group: Any = None
     placement_bundle_index: int = -1
 
@@ -51,6 +69,13 @@ class TaskOptions:
         if self.num_tpus:
             demand["TPU"] = demand.get("TPU", 0) + self.num_tpus
         return demand
+
+    def effective_strategy(self) -> Any:
+        if self.placement_group is not None:
+            return PlacementGroupSchedulingStrategy(
+                self.placement_group, self.placement_bundle_index
+            )
+        return self.scheduling_strategy
 
 
 @dataclasses.dataclass
@@ -63,6 +88,7 @@ class ActorOptions:
     max_restarts: int = 0
     max_concurrency: int = 1
     lifetime: Optional[str] = None  # None | "detached"
+    scheduling_strategy: Any = "DEFAULT"
     placement_group: Any = None
     placement_bundle_index: int = -1
 
@@ -73,6 +99,13 @@ class ActorOptions:
         if self.num_tpus:
             demand["TPU"] = demand.get("TPU", 0) + self.num_tpus
         return demand
+
+    def effective_strategy(self) -> Any:
+        if self.placement_group is not None:
+            return PlacementGroupSchedulingStrategy(
+                self.placement_group, self.placement_bundle_index
+            )
+        return self.scheduling_strategy
 
 
 class ResourcePool:
@@ -101,6 +134,51 @@ class ResourcePool:
                 self.available[k] = self.available.get(k, 0) + v
             self.cv.notify_all()
 
+    def utilization(self) -> float:
+        """Max over resource kinds of used/total (0 = idle, 1 = full)."""
+        with self._lock:
+            worst = 0.0
+            for k, tot in self.total.items():
+                if tot > 0:
+                    worst = max(worst, (tot - self.available.get(k, 0)) / tot)
+            return worst
+
+
+class NodeState:
+    """One logical node: resources + labels + liveness
+    (parity: GcsNodeManager's node table entry + raylet resource view)."""
+
+    def __init__(self, node_id: NodeID, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None):
+        self.node_id = node_id
+        self.pool = ResourcePool(resources)
+        self.labels = dict(labels or {})
+        self.alive = True
+        self.actor_ids: set = set()
+
+    def matches_labels(self, required: Dict[str, str]) -> bool:
+        return all(self.labels.get(k) == v for k, v in required.items())
+
+
+@dataclasses.dataclass
+class _Allocation:
+    """Where a task/actor's resources came from, for symmetric release."""
+
+    node: Optional[NodeState]
+    bundle: Optional[Bundle]
+    demand: Dict[str, float]
+
+    def release(self):
+        if self.bundle is not None:
+            # If the bundle was relocated to another node after ours died,
+            # the resources this task held died with the node — releasing
+            # into the relocated ledger would over-credit it.
+            if (self.node is not None
+                    and self.bundle.node_id == self.node.node_id):
+                self.bundle.release(self.demand)
+        elif self.node is not None:
+            self.node.pool.release(self.demand)
+
 
 @dataclasses.dataclass
 class _PendingTask:
@@ -120,13 +198,14 @@ class _ActorShell:
 
     def __init__(self, runtime: "LocalRuntime", actor_id: ActorID, cls: type,
                  args: tuple, kwargs: dict, options: ActorOptions,
-                 creation_oid: ObjectID):
+                 creation_oid: ObjectID, allocation: _Allocation):
         self.runtime = runtime
         self.actor_id = actor_id
         self.cls = cls
         self.init_args = args
         self.init_kwargs = kwargs
         self.options = options
+        self.allocation = allocation
         self.instance: Any = None
         self.dead = False
         self.death_reason = ""
@@ -135,6 +214,10 @@ class _ActorShell:
         self.queue: _queue.Queue = _queue.Queue()
         self._creation_oid = creation_oid
         self.thread: Optional[threading.Thread] = None
+
+    @property
+    def node_id(self) -> Optional[NodeID]:
+        return self.allocation.node.node_id if self.allocation.node else None
 
     def start(self):
         """Called after the runtime has registered the actor, so death
@@ -218,16 +301,24 @@ class _ActorShell:
         self.queue.put(None)
 
 
+@dataclasses.dataclass
+class _PGState:
+    pg: PlacementGroup
+    bundles: List[Bundle]
+    ready_oid: ObjectID
+    lifetime: Optional[str] = None
+    removed: bool = False
+
+
 class LocalRuntime:
     def __init__(self, *, resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
                  job_id: Optional[JobID] = None):
         cfg = get_config()
         total = dict(resources or {})
         if "CPU" not in total:
             total["CPU"] = float(cfg.num_workers_soft_limit or 8)
         total.setdefault("memory", 64 * 1024**3)
-        self.resources_total = total
-        self.pool = ResourcePool(total)
         self.store = LocalObjectStore()
         self.job_id = job_id or JobID.next()
         self.driver_task_id = TaskID.for_driver(self.job_id)
@@ -238,11 +329,72 @@ class LocalRuntime:
         self._shutdown = False
         self._actors: Dict[ActorID, _ActorShell] = {}
         self._named_actors: Dict[str, ActorID] = {}
-        self._running_tasks = 0
+        self._nodes: Dict[NodeID, NodeState] = {}
+        self._node_order: List[NodeID] = []  # stable order for hybrid packing
+        self._pgs: Dict[PlacementGroupID, _PGState] = {}
+        self._named_pgs: Dict[str, PlacementGroupID] = {}
+        # Serializes all bundle (re-)reservation: concurrent node events
+        # must not double-place the same pending bundle.
+        self._pg_reserve_lock = threading.Lock()
+        self.head_node_id = self.add_node(total, labels)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="dispatcher", daemon=True
         )
         self._dispatcher.start()
+
+    # -- cluster membership ------------------------------------------------
+
+    def add_node(self, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None) -> NodeID:
+        node_id = NodeID.from_random()
+        node = NodeState(node_id, dict(resources), labels)
+        with self._lock:
+            self._nodes[node_id] = node
+            self._node_order.append(node_id)
+            pending_pgs = [st for st in self._pgs.values()
+                           if not st.removed
+                           and any(b.node_id is None for b in st.bundles)]
+        # New capacity may satisfy pending placement groups
+        # (parity: GcsPlacementGroupManager::OnNodeAdd retry).
+        for st in pending_pgs:
+            self._reserve_bundles(
+                st, [b for b in st.bundles if b.node_id is None]
+            )
+        self._notify()
+        return node_id
+
+    def kill_node(self, node_id: NodeID) -> None:
+        """Mark a node dead; its actors die (restartable ones restart
+        elsewhere), its PG bundles are re-reserved on surviving nodes
+        (parity: GcsNodeManager death → actor fate + bundle reschedule)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            doomed = [self._actors[a] for a in list(node.actor_ids)
+                      if a in self._actors]
+        for shell in doomed:
+            shell.death_reason = "node died"
+            shell.dead = True
+            shell.queue.put(None)
+        # Re-reserve PG bundles that lived on this node.
+        with self._lock:
+            pgs = list(self._pgs.values())
+        for st in pgs:
+            lost = [b for b in st.bundles
+                    if b.node_id == node_id and not st.removed]
+            for b in lost:
+                b.node_id = None
+                with b.lock:
+                    b.available = {}
+            if lost:
+                self._reserve_bundles(st, lost)
+        self._notify()
+
+    def _alive_nodes(self) -> List[NodeState]:
+        return [self._nodes[i] for i in self._node_order
+                if self._nodes[i].alive]
 
     # -- objects -----------------------------------------------------------
 
@@ -293,15 +445,91 @@ class LocalRuntime:
             for oid, v in zip(return_ids, values):
                 self.store.put_value(oid, v)
 
+    # -- scheduling --------------------------------------------------------
+
+    def _cluster_can_fit(self, demand: Dict[str, float]) -> bool:
+        return any(n.pool.can_fit(demand) for n in self._alive_nodes())
+
+    def _try_allocate(self, demand: Dict[str, float],
+                      strategy: Any) -> Optional[_Allocation]:
+        """Cluster phase of the two-phase scheduler: pick a node (or PG
+        bundle) and acquire resources.  Returns None when nothing fits
+        right now (parity: ClusterTaskManager::QueueAndScheduleTask +
+        HybridSchedulingPolicy)."""
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            st = self._pgs.get(strategy.placement_group.id)
+            if st is None or st.removed:
+                raise ValueError("placement group removed or unknown")
+            idx = strategy.placement_group_bundle_index
+            if idx >= len(st.bundles):
+                raise ValueError(
+                    f"bundle index {idx} out of range for a "
+                    f"{len(st.bundles)}-bundle placement group"
+                )
+            candidates = (st.bundles if idx < 0 else [st.bundles[idx]])
+            if not any(all(b.resources.get(k, 0) >= v
+                           for k, v in demand.items())
+                       for b in candidates):
+                raise ValueError(
+                    f"demand {demand} exceeds every candidate bundle's "
+                    f"reservation — infeasible"
+                )
+            for b in candidates:
+                if b.node_id is not None and b.try_acquire(demand):
+                    node = self._nodes.get(b.node_id)
+                    return _Allocation(node, b, demand)
+            return None
+
+        nodes = self._alive_nodes()
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            want = (strategy.node_id.hex()
+                    if isinstance(strategy.node_id, NodeID)
+                    else str(strategy.node_id))
+            exact = [n for n in nodes if n.node_id.hex() == want]
+            if exact and exact[0].pool.try_acquire(demand):
+                return _Allocation(exact[0], None, demand)
+            if not strategy.soft:
+                return None
+            nodes = [n for n in nodes if n.node_id.hex() != want] or nodes
+            strategy = "DEFAULT"
+
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            hard = [n for n in nodes if n.matches_labels(strategy.hard)]
+            soft = [n for n in hard if n.matches_labels(strategy.soft)]
+            for n in soft + [n for n in hard if n not in soft]:
+                if n.pool.try_acquire(demand):
+                    return _Allocation(n, None, demand)
+            return None
+
+        if strategy == "SPREAD":
+            for n in sorted(nodes, key=lambda n: n.pool.utilization()):
+                if n.pool.try_acquire(demand):
+                    return _Allocation(n, None, demand)
+            return None
+
+        # DEFAULT hybrid: pack onto the first (stable-order) node below the
+        # utilization threshold, else fall back to least-utilized
+        # (parity: policy/hybrid_scheduling_policy.h:28-46, threshold 0.5).
+        threshold = 0.5
+        for n in nodes:
+            if n.pool.utilization() < threshold and n.pool.try_acquire(demand):
+                return _Allocation(n, None, demand)
+        for n in sorted(nodes, key=lambda n: n.pool.utilization()):
+            if n.pool.try_acquire(demand):
+                return _Allocation(n, None, demand)
+        return None
+
     # -- tasks -------------------------------------------------------------
 
     def submit_task(self, fn: Callable, args: tuple, kwargs: dict,
                     options: TaskOptions) -> List[ObjectRef]:
         demand = options.resource_demand()
-        if not self.pool.can_fit(demand):
+        strategy = options.effective_strategy()
+        if (not isinstance(strategy, PlacementGroupSchedulingStrategy)
+                and not self._cluster_can_fit(demand)):
             raise ValueError(
-                f"task {fn.__name__!r} demands {demand}, cluster total is "
-                f"{self.pool.total} — infeasible"
+                f"task {getattr(fn, '__name__', fn)!r} demands {demand}, "
+                f"which no node can ever satisfy — infeasible"
             )
         task_id = TaskID.of(ActorID.nil_for_job(self.job_id))
         return_ids = [
@@ -328,18 +556,28 @@ class LocalRuntime:
                     self._dispatch_cv.wait(0.02)
                 if self._shutdown:
                     return
-            self._start_task(runnable)
+            self._start_task(*runnable)
 
-    def _next_runnable_locked(self) -> Optional[_PendingTask]:
+    def _next_runnable_locked(self):
         for pt in self._pending:
             if not self._deps_ready(pt.args, pt.kwargs):
                 continue
-            if self.pool.try_acquire(pt.options.resource_demand()):
+            try:
+                alloc = self._try_allocate(
+                    pt.options.resource_demand(), pt.options.effective_strategy()
+                )
+            except ValueError as e:
                 self._pending.remove(pt)
-                return pt
+                err = TaskError(pt.function_name, e)
+                for oid in pt.return_ids:
+                    self.store.put_error(oid, err)
+                return None
+            if alloc is not None:
+                self._pending.remove(pt)
+                return pt, alloc
         return None
 
-    def _start_task(self, pt: _PendingTask):
+    def _start_task(self, pt: _PendingTask, alloc: _Allocation):
         def run():
             try:
                 args, kwargs = self.resolve_args(pt.args, pt.kwargs)
@@ -358,13 +596,16 @@ class LocalRuntime:
                     for oid in pt.return_ids:
                         self.store.put_error(oid, err)
             finally:
-                self.pool.release(pt.options.resource_demand())
-                with self._dispatch_cv:
-                    self._dispatch_cv.notify_all()
+                alloc.release()
+                self._notify()
 
         threading.Thread(
             target=run, name=f"task-{pt.function_name}", daemon=True
         ).start()
+
+    def _notify(self):
+        with self._dispatch_cv:
+            self._dispatch_cv.notify_all()
 
     # -- actors ------------------------------------------------------------
 
@@ -379,25 +620,33 @@ class LocalRuntime:
                     return shell, ObjectRef(shell._creation_oid)
                 raise ValueError(f"actor name {options.name!r} already taken")
         demand = options.resource_demand()
-        if not self.pool.can_fit(demand):
+        strategy = options.effective_strategy()
+        if (not isinstance(strategy, PlacementGroupSchedulingStrategy)
+                and not self._cluster_can_fit(demand)):
             raise ValueError(
-                f"actor {cls.__name__!r} demands {demand}, cluster total is "
-                f"{self.pool.total} — infeasible"
+                f"actor {cls.__name__!r} demands {demand}, which no node "
+                f"can ever satisfy — infeasible"
             )
-        # Actors hold their resources for their lifetime.
-        while not self.pool.try_acquire(demand):
-            with self.pool.cv:
-                self.pool.cv.wait(0.05)
+        # Actors hold their resources for their lifetime; block until
+        # capacity frees up (woken by _notify on every release).
+        while True:
+            alloc = self._try_allocate(demand, strategy)
+            if alloc is not None:
+                break
+            with self._dispatch_cv:
+                self._dispatch_cv.wait(0.05)
         actor_id = ActorID.of(self.job_id)
         creation_oid = ObjectID.for_task_return(TaskID.of(actor_id), 0)
         shell = _ActorShell(self, actor_id, cls, args, kwargs, options,
-                            creation_oid)
+                            creation_oid, alloc)
         # Register before starting: if __init__ fails instantly, the death
         # path must find (and unregister) the actor, or its name leaks.
         with self._lock:
             self._actors[actor_id] = shell
             if options.name:
                 self._named_actors[options.name] = actor_id
+            if alloc.node is not None:
+                alloc.node.actor_ids.add(actor_id)
         shell.start()
         return shell, ObjectRef(creation_oid)
 
@@ -433,43 +682,291 @@ class LocalRuntime:
         return actor_id
 
     def _on_actor_death(self, shell: _ActorShell):
-        # Restart-in-place (parity: GCS actor FSM RESTARTING→ALIVE,
-        # gcs.proto actor states): keep id + queue, re-construct the
-        # instance on a fresh thread.  Explicit kills and creation
-        # failures don't restart.
+        # Restart (parity: GCS actor FSM RESTARTING→ALIVE, gcs.proto actor
+        # states): keep id + queue, re-construct the instance on a fresh
+        # thread.  If the actor's node died, re-place it on a live node.
+        # Explicit kills and creation failures don't restart.
         restartable = (
             shell.restarts_left > 0
             and not shell.no_restart
             and not shell.death_reason.startswith("creation")
         )
+        node_died = shell.death_reason == "node died"
+        strategy = shell.options.effective_strategy()
+        if restartable and node_died:
+            # Hard affinity to a dead node can never be satisfied
+            # (parity: NodeAffinitySchedulingStrategy hard + node death
+            # → actor unschedulable, fails permanently).
+            if (isinstance(strategy, NodeAffinitySchedulingStrategy)
+                    and not strategy.soft):
+                want = (strategy.node_id.hex()
+                        if isinstance(strategy.node_id, NodeID)
+                        else str(strategy.node_id))
+                with self._lock:
+                    target = next((n for n in self._nodes.values()
+                                   if n.node_id.hex() == want), None)
+                if target is None or not target.alive:
+                    restartable = False
         if restartable:
             shell.restarts_left -= 1
-            shell.dead = False
-            shell.death_reason = ""
-            shell.start()
-            return
-        self.pool.release(shell.options.resource_demand())
+            if node_died:
+                try:
+                    alloc = self._try_allocate(
+                        shell.options.resource_demand(), strategy
+                    )
+                except ValueError:
+                    alloc = None
+                    restartable = False  # e.g. PG was removed
+                if restartable and alloc is None:
+                    # Stay in RESTARTING until capacity appears (parity:
+                    # GCS keeps the actor pending-recreation).
+                    self._await_restart_capacity(shell, strategy)
+                    return
+                if restartable:
+                    shell.allocation = alloc
+                    with self._lock:
+                        if alloc.node is not None:
+                            alloc.node.actor_ids.add(shell.actor_id)
+            if restartable:
+                shell.dead = False
+                shell.death_reason = ""
+                shell.start()
+                return
+        if not node_died:
+            shell.allocation.release()
+        self._finish_actor_removal(shell)
+
+    def _await_restart_capacity(self, shell: _ActorShell, strategy: Any):
+        """Background wait for cluster capacity to restart a displaced
+        actor; the handle keeps working once it comes back."""
+
+        def poll():
+            import time
+
+            while not self._shutdown:
+                try:
+                    alloc = self._try_allocate(
+                        shell.options.resource_demand(), strategy
+                    )
+                except ValueError:
+                    self._finish_actor_removal(shell)
+                    return
+                if alloc is not None:
+                    shell.allocation = alloc
+                    with self._lock:
+                        if alloc.node is not None:
+                            alloc.node.actor_ids.add(shell.actor_id)
+                    shell.dead = False
+                    shell.death_reason = ""
+                    shell.start()
+                    return
+                time.sleep(0.05)
+
+        threading.Thread(target=poll, daemon=True,
+                         name=f"restart-{shell.actor_id.hex()[:8]}").start()
+
+    def _finish_actor_removal(self, shell: _ActorShell):
         with self._lock:
             self._actors.pop(shell.actor_id, None)
+            if shell.allocation.node is not None:
+                shell.allocation.node.actor_ids.discard(shell.actor_id)
             for name, aid in list(self._named_actors.items()):
                 if aid == shell.actor_id:
                     del self._named_actors[name]
+        self._notify()
+
+    # -- placement groups --------------------------------------------------
+
+    def create_placement_group(self, bundles: List[Dict[str, float]],
+                               strategy: str, name: str,
+                               lifetime: Optional[str]) -> PlacementGroup:
+        pg_id = PlacementGroupID.of(self.job_id)
+        pg = PlacementGroup(pg_id, bundles, strategy, name)
+        ready_task = TaskID(pg_id.binary() + b"\x00" * 8)
+        ready_oid = ObjectID.for_task_return(ready_task, 0)
+        st = _PGState(
+            pg=pg,
+            bundles=[Bundle(i, dict(spec)) for i, spec in enumerate(bundles)],
+            ready_oid=ready_oid,
+            lifetime=lifetime,
+        )
+        with self._lock:
+            self._pgs[pg_id] = st
+            if name:
+                if name in self._named_pgs:
+                    raise ValueError(f"placement group name {name!r} taken")
+                self._named_pgs[name] = pg_id
+        self._reserve_bundles(st, st.bundles)
+        return pg
+
+    def _reserve_bundles(self, st: _PGState, bundles: List[Bundle]) -> bool:
+        """Reserve bundles on nodes per the PG strategy.  All-or-nothing
+        with rollback (parity: the 2-phase commit in
+        gcs_placement_group_scheduler.cc, simplified to one process)."""
+        with self._pg_reserve_lock:
+            bundles = [b for b in bundles if b.node_id is None]
+            if not bundles:
+                return True
+            return self._reserve_bundles_locked(st, bundles)
+
+    def _reserve_bundles_locked(self, st: _PGState,
+                                bundles: List[Bundle]) -> bool:
+        strategy = st.pg.strategy
+        nodes = self._alive_nodes()
+        # Nodes already holding this PG's surviving bundles — STRICT_SPREAD
+        # re-reservation must not collapse onto them.
+        occupied = {b.node_id for b in st.bundles if b.node_id is not None}
+        # ICI-aware ordering: nodes labeled with an integer "ici_index"
+        # are considered in coordinate order so PACKed bundles land on a
+        # contiguous slice block.
+        def ici_key(n: NodeState):
+            try:
+                return (0, int(n.labels.get("ici_index", "")))
+            except ValueError:
+                return (1, 0)
+
+        nodes = sorted(nodes, key=ici_key)
+        reserved: List[Tuple[Bundle, NodeState]] = []
+
+        def rollback():
+            for b, n in reserved:
+                n.pool.release(b.resources)
+                b.node_id = None
+                with b.lock:
+                    b.available = {}
+
+        def place_on(b: Bundle, n: NodeState) -> bool:
+            if n.pool.try_acquire(b.resources):
+                b.node_id = n.node_id
+                with b.lock:
+                    b.available = dict(b.resources)
+                reserved.append((b, n))
+                return True
+            return False
+
+        if strategy in ("PACK", "STRICT_PACK"):
+            # Try to land everything on a single node first.
+            for n in nodes:
+                ok = True
+                for b in bundles:
+                    if not place_on(b, n):
+                        ok = False
+                        break
+                if ok:
+                    self._pg_maybe_ready(st)
+                    return True
+                rollback()
+                reserved.clear()
+            if strategy == "STRICT_PACK":
+                return False  # stays pending; bundles unreserved
+            # soft PACK: greedy first-fit across nodes
+            for b in bundles:
+                if not any(place_on(b, n) for n in nodes):
+                    rollback()
+                    return False
+            self._pg_maybe_ready(st)
+            return True
+
+        # SPREAD / STRICT_SPREAD: distinct nodes (best-effort for SPREAD).
+        used: set = set(occupied)
+        for b in bundles:
+            placed = False
+            for n in nodes:
+                if n.node_id in used:
+                    continue
+                if place_on(b, n):
+                    used.add(n.node_id)
+                    placed = True
+                    break
+            if not placed and strategy == "SPREAD":
+                for n in nodes:
+                    if place_on(b, n):
+                        placed = True
+                        break
+            if not placed:
+                rollback()
+                return False
+        self._pg_maybe_ready(st)
+        return True
+
+    def _pg_maybe_ready(self, st: _PGState):
+        if all(b.node_id is not None for b in st.bundles):
+            if not self.store.contains(st.ready_oid):
+                self.store.put_value(st.ready_oid, None)
+
+    def pg_ready_ref(self, pg_id: PlacementGroupID) -> ObjectRef:
+        with self._lock:
+            st = self._pgs.get(pg_id)
+        if st is None:
+            raise ValueError("unknown placement group")
+        return ObjectRef(st.ready_oid)
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            st = self._pgs.get(pg_id)
+            if st is None or st.removed:
+                return
+            st.removed = True
+            if st.pg.name:
+                self._named_pgs.pop(st.pg.name, None)
+        for b in st.bundles:
+            if b.node_id is not None:
+                node = self._nodes.get(b.node_id)
+                if node is not None and node.alive:
+                    node.pool.release(b.resources)
+                b.node_id = None
+        self._notify()
+
+    def get_named_placement_group(self, name: str) -> PlacementGroup:
+        with self._lock:
+            pg_id = self._named_pgs.get(name)
+            if pg_id is None:
+                raise ValueError(f"no placement group named {name!r}")
+            return self._pgs[pg_id].pg
+
+    def placement_group_table(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {}
+            for pg_id, st in self._pgs.items():
+                out[pg_id.hex()] = {
+                    "strategy": st.pg.strategy,
+                    "name": st.pg.name,
+                    "state": ("REMOVED" if st.removed else
+                              "CREATED" if all(b.node_id is not None
+                                               for b in st.bundles)
+                              else "PENDING"),
+                    "bundles": {
+                        b.index: (b.node_id.hex() if b.node_id else None)
+                        for b in st.bundles
+                    },
+                }
+            return out
 
     # -- cluster info ------------------------------------------------------
 
     def cluster_resources(self) -> Dict[str, float]:
-        return dict(self.pool.total)
+        out: Dict[str, float] = {}
+        for n in self._alive_nodes():
+            for k, v in n.pool.total.items():
+                out[k] = out.get(k, 0) + v
+        return out
 
     def available_resources(self) -> Dict[str, float]:
-        with self.pool._lock:
-            return dict(self.pool.available)
+        out: Dict[str, float] = {}
+        for n in self._alive_nodes():
+            with n.pool._lock:
+                for k, v in n.pool.available.items():
+                    out[k] = out.get(k, 0) + v
+        return out
 
     def nodes(self) -> List[Dict[str, Any]]:
-        return [{
-            "NodeID": "local",
-            "Alive": True,
-            "Resources": dict(self.pool.total),
-        }]
+        with self._lock:
+            return [{
+                "NodeID": nid.hex(),
+                "Alive": self._nodes[nid].alive,
+                "Resources": dict(self._nodes[nid].pool.total),
+                "Labels": dict(self._nodes[nid].labels),
+            } for nid in self._node_order]
 
     def shutdown(self):
         with self._dispatch_cv:

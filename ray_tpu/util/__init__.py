@@ -1,0 +1,21 @@
+"""Utility APIs layered on the core (parity: python/ray/util/)."""
+
+from ray_tpu.core.placement_group import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    get_placement_group,
+    placement_group,
+    remove_placement_group,
+)
+
+__all__ = [
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "PlacementGroup",
+    "PlacementGroupSchedulingStrategy",
+    "get_placement_group",
+    "placement_group",
+    "remove_placement_group",
+]
