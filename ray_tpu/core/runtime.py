@@ -115,7 +115,6 @@ class ResourcePool:
         self._lock = threading.Lock()
         self.total = dict(total)
         self.available = dict(total)
-        self.cv = threading.Condition(self._lock)
 
     def can_fit(self, demand: Dict[str, float]) -> bool:
         return all(self.total.get(k, 0) >= v for k, v in demand.items())
@@ -129,10 +128,9 @@ class ResourcePool:
             return False
 
     def release(self, demand: Dict[str, float]) -> None:
-        with self.cv:
+        with self._lock:
             for k, v in demand.items():
                 self.available[k] = self.available.get(k, 0) + v
-            self.cv.notify_all()
 
     def utilization(self) -> float:
         """Max over resource kinds of used/total (0 = idle, 1 = full)."""
@@ -170,12 +168,15 @@ class _Allocation:
 
     def release(self):
         if self.bundle is not None:
-            # If the bundle was relocated to another node after ours died,
-            # the resources this task held died with the node — releasing
-            # into the relocated ledger would over-credit it.
             if (self.node is not None
                     and self.bundle.node_id == self.node.node_id):
                 self.bundle.release(self.demand)
+            elif self.node is not None:
+                # The bundle moved away (PG removed, or relocated after a
+                # node death).  The in-use portion was never returned to
+                # the node when that happened — return it now.  If the
+                # node is dead its pool is inert, so this is harmless.
+                self.node.pool.release(self.demand)
         elif self.node is not None:
             self.node.pool.release(self.demand)
 
@@ -447,8 +448,21 @@ class LocalRuntime:
 
     # -- scheduling --------------------------------------------------------
 
-    def _cluster_can_fit(self, demand: Dict[str, float]) -> bool:
-        return any(n.pool.can_fit(demand) for n in self._alive_nodes())
+    def _cluster_can_fit(self, demand: Dict[str, float],
+                         strategy: Any = "DEFAULT") -> bool:
+        """Strategy-aware feasibility: a hard affinity/label constraint
+        that no live node can ever satisfy must fail at submission, not
+        hang (parity: Ray's unschedulable-task error)."""
+        nodes = self._alive_nodes()
+        if (isinstance(strategy, NodeAffinitySchedulingStrategy)
+                and not strategy.soft):
+            want = (strategy.node_id.hex()
+                    if isinstance(strategy.node_id, NodeID)
+                    else str(strategy.node_id))
+            nodes = [n for n in nodes if n.node_id.hex() == want]
+        elif isinstance(strategy, NodeLabelSchedulingStrategy):
+            nodes = [n for n in nodes if n.matches_labels(strategy.hard)]
+        return any(n.pool.can_fit(demand) for n in nodes)
 
     def _try_allocate(self, demand: Dict[str, float],
                       strategy: Any) -> Optional[_Allocation]:
@@ -526,10 +540,11 @@ class LocalRuntime:
         demand = options.resource_demand()
         strategy = options.effective_strategy()
         if (not isinstance(strategy, PlacementGroupSchedulingStrategy)
-                and not self._cluster_can_fit(demand)):
+                and not self._cluster_can_fit(demand, strategy)):
             raise ValueError(
-                f"task {getattr(fn, '__name__', fn)!r} demands {demand}, "
-                f"which no node can ever satisfy — infeasible"
+                f"task {getattr(fn, '__name__', fn)!r} demands {demand} "
+                f"under {strategy!r}, which no node can ever satisfy — "
+                f"infeasible"
             )
         task_id = TaskID.of(ActorID.nil_for_job(self.job_id))
         return_ids = [
@@ -622,10 +637,10 @@ class LocalRuntime:
         demand = options.resource_demand()
         strategy = options.effective_strategy()
         if (not isinstance(strategy, PlacementGroupSchedulingStrategy)
-                and not self._cluster_can_fit(demand)):
+                and not self._cluster_can_fit(demand, strategy)):
             raise ValueError(
-                f"actor {cls.__name__!r} demands {demand}, which no node "
-                f"can ever satisfy — infeasible"
+                f"actor {cls.__name__!r} demands {demand} under "
+                f"{strategy!r}, which no node can ever satisfy — infeasible"
             )
         # Actors hold their resources for their lifetime; block until
         # capacity frees up (woken by _notify on every release).
@@ -804,6 +819,8 @@ class LocalRuntime:
         with rollback (parity: the 2-phase commit in
         gcs_placement_group_scheduler.cc, simplified to one process)."""
         with self._pg_reserve_lock:
+            if st.removed:  # raced with remove_placement_group
+                return False
             bundles = [b for b in bundles if b.node_id is None]
             if not bundles:
                 return True
@@ -902,19 +919,36 @@ class LocalRuntime:
         return ObjectRef(st.ready_oid)
 
     def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._pg_reserve_lock:
+            with self._lock:
+                st = self._pgs.get(pg_id)
+                if st is None or st.removed:
+                    return
+                st.removed = True
+                if st.pg.name:
+                    self._named_pgs.pop(st.pg.name, None)
+            # Return only the *unused* part of each reservation now; the
+            # in-use part comes back when each holder finishes (see
+            # _Allocation.release) — never oversubscribe the node.
+            bundle_set = set(map(id, st.bundles))
+            for b in st.bundles:
+                if b.node_id is not None:
+                    node = self._nodes.get(b.node_id)
+                    with b.lock:
+                        unused = dict(b.available)
+                        b.available = {}
+                    if node is not None and node.alive:
+                        node.pool.release(unused)
+                    b.node_id = None
+        # Kill actors living inside the group (parity: PG removal kills
+        # the actors/tasks scheduled into it).
         with self._lock:
-            st = self._pgs.get(pg_id)
-            if st is None or st.removed:
-                return
-            st.removed = True
-            if st.pg.name:
-                self._named_pgs.pop(st.pg.name, None)
-        for b in st.bundles:
-            if b.node_id is not None:
-                node = self._nodes.get(b.node_id)
-                if node is not None and node.alive:
-                    node.pool.release(b.resources)
-                b.node_id = None
+            doomed = [s for s in self._actors.values()
+                      if s.allocation.bundle is not None
+                      and id(s.allocation.bundle) in bundle_set]
+        for shell in doomed:
+            shell.restarts_left = 0
+            shell.kill(no_restart=True)
         self._notify()
 
     def get_named_placement_group(self, name: str) -> PlacementGroup:

@@ -1,0 +1,48 @@
+"""ray_tpu.data — streaming distributed datasets
+(parity: python/ray/data; see SURVEY.md §2.3).
+
+Blocks are columnar dicts of numpy arrays (the layout jax.device_put
+wants); execution is lazy and streaming over the core's tasks/actors.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_images,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "ActorPoolStrategy",
+    "Block",
+    "BlockAccessor",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_images",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

@@ -1,0 +1,155 @@
+"""Block model: the unit of distributed data.
+
+Parity with the reference's block abstraction (ray: python/ray/data/block.py:195,216
+— blocks are Arrow tables / pandas frames living in the object store, with a
+BlockAccessor for uniform manipulation).  TPU-first choice: the canonical
+block is a **columnar dict of numpy arrays** — the exact layout
+`jax.device_put` wants, so host→HBM feeding needs no conversion.  Arrow /
+pandas / row inputs are normalized into it at the edges.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+# A Block is Dict[str, np.ndarray]; all columns share length.
+Block = Dict[str, np.ndarray]
+Row = Dict[str, Any]
+
+TENSOR_COLUMN = "__value__"  # single-column datasets (range, numpy)
+
+
+def _to_array(values: Sequence[Any]) -> np.ndarray:
+    arr = np.asarray(values)
+    if arr.dtype.kind == "U":  # keep strings as objects for ragged safety
+        arr = np.asarray(values, dtype=object)
+    return arr
+
+
+class BlockAccessor:
+    """Uniform view over one block (parity: data/block.py BlockAccessor)."""
+
+    def __init__(self, block: Block):
+        if not isinstance(block, dict):
+            raise TypeError(f"block must be a dict of arrays, got {type(block)}")
+        self._block = block
+
+    @staticmethod
+    def from_rows(rows: Sequence[Row]) -> Block:
+        if not rows:
+            return {}
+        if not isinstance(rows[0], dict):
+            rows = [{TENSOR_COLUMN: r} for r in rows]
+        cols = {}
+        for key in rows[0]:
+            cols[key] = _to_array([r[key] for r in rows])
+        return cols
+
+    @staticmethod
+    def from_pandas(df) -> Block:
+        return {c: df[c].to_numpy() for c in df.columns}
+
+    @staticmethod
+    def from_arrow(table) -> Block:
+        out = {}
+        for name in table.column_names:
+            col = table.column(name)
+            try:
+                out[name] = col.to_numpy(zero_copy_only=False)
+            except Exception:
+                out[name] = np.asarray(col.to_pylist(), dtype=object)
+        return out
+
+    @staticmethod
+    def normalize(data: Any) -> Block:
+        """Coerce task/user output into the canonical block format."""
+        if isinstance(data, dict):
+            return {k: np.asarray(v) if not isinstance(v, np.ndarray) else v
+                    for k, v in data.items()}
+        if isinstance(data, np.ndarray):
+            return {TENSOR_COLUMN: data}
+        if isinstance(data, list):
+            return BlockAccessor.from_rows(data)
+        try:
+            import pandas as pd
+
+            if isinstance(data, pd.DataFrame):
+                return BlockAccessor.from_pandas(data)
+        except ImportError:
+            pass
+        try:
+            import pyarrow as pa
+
+            if isinstance(data, pa.Table):
+                return BlockAccessor.from_arrow(data)
+        except ImportError:
+            pass
+        raise TypeError(
+            f"cannot interpret {type(data).__name__} as a block; return a "
+            f"dict of numpy arrays, a numpy array, a list of rows, a pandas "
+            f"DataFrame, or a pyarrow Table"
+        )
+
+    def num_rows(self) -> int:
+        for v in self._block.values():
+            return len(v)
+        return 0
+
+    def columns(self) -> List[str]:
+        return list(self._block)
+
+    def schema(self) -> Dict[str, str]:
+        return {k: str(v.dtype) for k, v in self._block.items()}
+
+    def slice(self, start: int, end: int) -> Block:
+        return {k: v[start:end] for k, v in self._block.items()}
+
+    def take_rows(self, indices: np.ndarray) -> Block:
+        return {k: v[indices] for k, v in self._block.items()}
+
+    def iter_rows(self) -> Iterable[Row]:
+        keys = self.columns()
+        n = self.num_rows()
+        for i in range(n):
+            yield {k: self._block[k][i] for k in keys}
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame({k: list(v) if v.dtype == object else v
+                             for k, v in self._block.items()})
+
+    def size_bytes(self) -> int:
+        total = 0
+        for v in self._block.values():
+            if v.dtype == object:
+                total += sum(len(str(x)) for x in v)  # rough
+            else:
+                total += v.nbytes
+        return total
+
+
+def concat_blocks(blocks: Sequence[Block]) -> Block:
+    blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
+    if not blocks:
+        return {}
+    keys = list(blocks[0])
+    out = {}
+    for k in keys:
+        parts = [b[k] for b in blocks]
+        if any(p.dtype == object for p in parts):
+            out[k] = np.concatenate(
+                [np.asarray(p, dtype=object) for p in parts]
+            )
+        else:
+            out[k] = np.concatenate(parts)
+    return out
+
+
+def split_block(block: Block, num_splits: int) -> List[Block]:
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    bounds = np.linspace(0, n, num_splits + 1).astype(int)
+    return [acc.slice(bounds[i], bounds[i + 1]) for i in range(num_splits)]

@@ -1,0 +1,35 @@
+"""DataContext: per-process execution knobs
+(parity: ray: python/ray/data/context.py singleton DataContext)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    # Target rows per block for synthetic sources (range etc.).
+    target_block_rows: int = 4096
+    # Streaming executor: max concurrently running block tasks
+    # (parity: backpressure via select_operator_to_run,
+    # streaming_executor_state.py:376 — ours is a global in-flight cap).
+    max_in_flight_tasks: int = 8
+    # Max produced-but-unconsumed blocks before the executor pauses
+    # submitting (object-store backpressure analogue).
+    max_buffered_blocks: int = 16
+    # iter_batches read-ahead depth.
+    prefetch_batches: int = 2
+    # CPUs requested per block task.
+    cpus_per_task: float = 1.0
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance

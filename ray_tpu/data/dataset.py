@@ -1,0 +1,358 @@
+"""Dataset: the lazy, streaming distributed dataset facade.
+
+Parity with the reference's Dataset (ray: python/ray/data/dataset.py:178
+— lazy logical plan, transformations return new Datasets, execution is
+streaming and happens on consumption; streaming_split at dataset.py:1149
+feeds Train workers).  Blocks live in the object store; per-block
+transforms run as remote tasks with bounded in-flight windows.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    concat_blocks,
+    split_block,
+)
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.executor import (
+    AllToAllOp,
+    LimitOp,
+    MapOp,
+    Op,
+    ReadOp,
+    StreamingExecutor,
+    make_random_shuffle,
+    make_repartition,
+    make_sort,
+)
+from ray_tpu.data.iterator import (
+    DataIterator,
+    _SplitCoordinator,
+    iter_batches_from_refs,
+)
+
+
+class ActorPoolStrategy:
+    """compute= argument for map_batches (parity: data/_internal/compute.py:156)."""
+
+    def __init__(self, size: int = 2):
+        self.size = size
+
+
+def _batched(fn: Callable, batch_size: Optional[int]) -> Callable[[Block], Block]:
+    """Apply fn to fixed-size sub-batches of each block and re-concat."""
+    if batch_size is None:
+        return lambda block: fn(block)
+
+    def run(block: Block) -> Block:
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        outs = []
+        for start in range(0, n, batch_size):
+            outs.append(BlockAccessor.normalize(
+                fn(acc.slice(start, min(start + batch_size, n)))))
+        return concat_blocks(outs) if outs else block
+
+    return run
+
+
+class Dataset:
+    def __init__(self, ops: List[Op],
+                 cached_refs: Optional[List[Any]] = None):
+        self._ops = ops
+        self._cached_refs = cached_refs
+        self._last_stats: Optional[str] = None
+
+    # -- plan building ----------------------------------------------------
+
+    def _append(self, op: Op) -> "Dataset":
+        if self._cached_refs is not None:
+            base = _ops_from_refs(self._cached_refs)
+            return Dataset(base + [op])
+        return Dataset(self._ops + [op])
+
+    def map_batches(self, fn: Union[Callable, type], *,
+                    batch_size: Optional[int] = None,
+                    compute: Optional[ActorPoolStrategy] = None,
+                    fn_constructor_args: tuple = (),
+                    **_ignored) -> "Dataset":
+        """Transform batches (parity: dataset.py map_batches)."""
+        if isinstance(fn, type):
+            if compute is None:
+                compute = ActorPoolStrategy()
+            ctor = (lambda: fn(*fn_constructor_args))
+            return self._append(MapOp(
+                fn=lambda b: b, name=f"MapBatches({fn.__name__})",
+                actor_pool_size=compute.size,
+                fn_constructor=ctor,
+            ))
+        return self._append(MapOp(_batched(fn, batch_size),
+                                  name=f"MapBatches({_name(fn)})"))
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        def per_block(block: Block) -> Block:
+            rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
+            return BlockAccessor.from_rows(rows)
+
+        return self._append(MapOp(per_block, name=f"Map({_name(fn)})"))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        def per_block(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            keep = np.asarray(
+                [bool(fn(r)) for r in acc.iter_rows()], dtype=bool)
+            return acc.take_rows(np.nonzero(keep)[0])
+
+        return self._append(MapOp(per_block, name=f"Filter({_name(fn)})"))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        def per_block(block: Block) -> Block:
+            rows: List[Dict] = []
+            for r in BlockAccessor(block).iter_rows():
+                rows.extend(fn(r))
+            return BlockAccessor.from_rows(rows)
+
+        return self._append(MapOp(per_block, name=f"FlatMap({_name(fn)})"))
+
+    def add_column(self, name: str, fn: Callable[[Block], np.ndarray]
+                   ) -> "Dataset":
+        def per_block(block: Block) -> Block:
+            out = dict(block)
+            out[name] = np.asarray(fn(block))
+            return out
+
+        return self._append(MapOp(per_block, name=f"AddColumn({name})"))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def per_block(block: Block) -> Block:
+            return {k: v for k, v in block.items() if k not in cols}
+
+        return self._append(MapOp(per_block, name="DropColumns"))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def per_block(block: Block) -> Block:
+            return {k: block[k] for k in cols}
+
+        return self._append(MapOp(per_block, name="SelectColumns"))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._append(make_repartition(num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._append(make_random_shuffle(seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._append(make_sort(key, descending))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(LimitOp(n))
+
+    def union(self, other: "Dataset") -> "Dataset":
+        left = self.materialize()._cached_refs
+        right = other.materialize()._cached_refs
+        return Dataset(_ops_from_refs(list(left) + list(right)))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise join of equal-length datasets."""
+        left = self.materialize()
+        right = other.materialize()
+        lb = [ray_tpu.get(r) for r in left._cached_refs]
+        rb = [ray_tpu.get(r) for r in right._cached_refs]
+        lall, rall = concat_blocks(lb), concat_blocks(rb)
+        ln, rn = BlockAccessor(lall).num_rows(), BlockAccessor(rall).num_rows()
+        if ln != rn:
+            raise ValueError(f"zip needs equal row counts, got {ln} vs {rn}")
+        merged = dict(lall)
+        for k, v in rall.items():
+            merged[k if k not in merged else f"{k}_1"] = v
+        refs = [ray_tpu.put(b) for b in
+                split_block(merged, max(1, len(lb)))]
+        return Dataset(_ops_from_refs(refs), cached_refs=refs)
+
+    # -- execution --------------------------------------------------------
+
+    def _execute(self) -> Iterator[Any]:
+        if self._cached_refs is not None:
+            return iter(self._cached_refs)
+        ex = StreamingExecutor(list(self._ops))
+        stream = ex.execute()
+
+        def tracked():
+            yield from stream
+            self._last_stats = ex.stats_summary()
+
+        return tracked()
+
+    def materialize(self) -> "Dataset":
+        """Execute fully and pin blocks (parity: dataset.materialize)."""
+        if self._cached_refs is not None:
+            return self
+        refs = list(self._execute())
+        return Dataset(_ops_from_refs(refs), cached_refs=refs)
+
+    def stats(self) -> str:
+        return self._last_stats or "(not yet executed)"
+
+    # -- consumption ------------------------------------------------------
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return iter_batches_from_refs(self._execute(), **kwargs)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref in self._execute():
+            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        counting = ray_tpu.remote(num_cpus=0.25)(
+            lambda b: BlockAccessor(b).num_rows())
+        refs = [counting.remote(r) for r in self._execute()]
+        return int(sum(ray_tpu.get(refs))) if refs else 0
+
+    def schema(self) -> Dict[str, str]:
+        for ref in self._execute():
+            block = ray_tpu.get(ref)
+            if BlockAccessor(block).num_rows():
+                return BlockAccessor(block).schema()
+        return {}
+
+    def columns(self) -> List[str]:
+        return list(self.schema())
+
+    def _column_agg(self, col: str, fn: Callable) -> float:
+        blocks = [ray_tpu.get(r) for r in self._execute()]
+        vals = [b[col] for b in blocks if col in b and len(b[col])]
+        if not vals:
+            raise ValueError(f"no data in column {col!r}")
+        return fn(np.concatenate(vals))
+
+    def sum(self, col: str):
+        return self._column_agg(col, np.sum)
+
+    def min(self, col: str):
+        return self._column_agg(col, np.min)
+
+    def max(self, col: str):
+        return self._column_agg(col, np.max)
+
+    def mean(self, col: str):
+        return self._column_agg(col, np.mean)
+
+    def std(self, col: str):
+        return self._column_agg(col, lambda a: float(np.std(a, ddof=1)))
+
+    def unique(self, col: str) -> List[Any]:
+        return list(self._column_agg(col, lambda a: np.unique(a)))
+
+    def to_pandas(self):
+        blocks = [ray_tpu.get(r) for r in self._execute()]
+        return BlockAccessor(concat_blocks(blocks)).to_pandas()
+
+    # -- splits -----------------------------------------------------------
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Materializing equal split (parity: dataset.split)."""
+        mat = self.materialize()
+        blocks = [ray_tpu.get(r) for r in mat._cached_refs]
+        whole = concat_blocks(blocks)
+        out = []
+        for part in split_block(whole, n):
+            refs = [ray_tpu.put(part)]
+            out.append(Dataset(_ops_from_refs(refs), cached_refs=refs))
+        return out
+
+    def streaming_split(self, n: int, *, equal: bool = True,
+                        locality_hints=None) -> List[DataIterator]:
+        """n coordinated iterators over ONE streaming execution
+        (parity: dataset.py:1149 → stream_split_iterator.py:31)."""
+        Coord = ray_tpu.remote(num_cpus=0.5)(_SplitCoordinator)
+        ops = (_ops_from_refs(self._cached_refs)
+               if self._cached_refs is not None else list(self._ops))
+        coord = Coord.remote(ops, n, equal)
+        return [DataIterator(coord, split_id=i) for i in range(n)]
+
+    # -- writes -----------------------------------------------------------
+
+    def _write(self, path: str, ext: str,
+               writer: Callable[[Block, str], None]) -> None:
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            block = ray_tpu.get(ref)
+            if BlockAccessor(block).num_rows():
+                writer(block, os.path.join(path, f"part-{i:05d}.{ext}"))
+
+    def write_parquet(self, path: str) -> None:
+        def w(block: Block, file: str):
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            pq.write_table(pa.table({k: list(v) if v.dtype == object else v
+                                     for k, v in block.items()}), file)
+
+        self._write(path, "parquet", w)
+
+    def write_csv(self, path: str) -> None:
+        self._write(path, "csv",
+                    lambda b, f: BlockAccessor(b).to_pandas().to_csv(
+                        f, index=False))
+
+    def write_json(self, path: str) -> None:
+        self._write(path, "json",
+                    lambda b, f: BlockAccessor(b).to_pandas().to_json(
+                        f, orient="records", lines=True))
+
+    def write_numpy(self, path: str, column: str) -> None:
+        self._write(path, "npy",
+                    lambda b, f: np.save(f, b[column]))
+
+    def __repr__(self):
+        names = []
+        for op in self._ops:
+            names.append(getattr(op, "name", type(op).__name__))
+        return f"Dataset({' -> '.join(names)})"
+
+
+def _name(fn) -> str:
+    return getattr(fn, "__name__", type(fn).__name__)
+
+
+class _RefsSource:
+    """Datasource over already-materialized block refs."""
+
+    def __init__(self, refs: List[Any]):
+        self.refs = refs
+
+    def get_read_tasks(self, parallelism: int):
+        from ray_tpu.data.datasource import ReadTask
+
+        return [ReadTask(lambda r=r: ray_tpu.get(r)) for r in self.refs]
+
+    def estimated_num_rows(self):
+        return None
+
+
+def _ops_from_refs(refs: List[Any]) -> List[Op]:
+    return [ReadOp(_RefsSource(list(refs)), parallelism=len(refs) or 1,
+                   name="FromRefs")]

@@ -1,0 +1,394 @@
+"""Streaming executor: runs a chain of block operators over remote tasks.
+
+Parity with the reference's streaming execution model
+(ray: python/ray/data/_internal/execution/streaming_executor.py:49 — a
+scheduling loop that keeps a bounded number of block tasks in flight and
+yields output blocks as they finish; backpressure via
+streaming_executor_state.py:376 select_operator_to_run).  Consecutive
+per-block stages are fused into one task per block (parity: the logical
+optimizer's MapFusion rule, data/_internal/logical/optimizers.py), so a
+read→map_batches→filter chain costs one task per block.
+
+All-to-all stages (repartition / shuffle / sort) are barrier stages that
+exchange blocks through the object store with map+reduce tasks (parity:
+planner/exchange/, push_based_shuffle.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    concat_blocks,
+    split_block,
+)
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.datasource import Datasource, ReadTask
+
+
+# ---------------------------------------------------------------------------
+# Logical operators
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ReadOp:
+    datasource: Datasource
+    parallelism: int = -1
+    name: str = "Read"
+
+
+@dataclasses.dataclass
+class MapOp:
+    """Per-block transform: fn(Block) -> Block."""
+
+    fn: Callable[[Block], Block]
+    name: str = "Map"
+    # Actor-pool compute: run the transform inside a pool of stateful
+    # actors instead of stateless tasks (parity: ActorPoolMapOperator).
+    actor_pool_size: int = 0
+    fn_constructor: Optional[Callable[[], Any]] = None
+
+
+@dataclasses.dataclass
+class AllToAllOp:
+    """Barrier transform over the full list of block refs."""
+
+    fn: Callable[[List[Any], "StreamingExecutor"], List[Any]]
+    name: str = "AllToAll"
+
+
+@dataclasses.dataclass
+class LimitOp:
+    n: int
+    name: str = "Limit"
+
+
+Op = Any
+
+
+# Remote helpers ------------------------------------------------------------
+
+
+def _chain_block(block: Block, fns: Sequence[Callable[[Block], Block]]) -> Block:
+    for fn in fns:
+        block = BlockAccessor.normalize(fn(block))
+    return block
+
+
+def _chain_read(read_task: ReadTask,
+                fns: Sequence[Callable[[Block], Block]]) -> Block:
+    return _chain_block(BlockAccessor.normalize(read_task()), fns)
+
+
+def _num_rows(block: Block) -> int:
+    return BlockAccessor(block).num_rows()
+
+
+def _slice_block(block: Block, start: int, end: int) -> Block:
+    return BlockAccessor(block).slice(start, end)
+
+
+class _PoolWorker:
+    """Actor holding a stateful callable (parity: ActorPoolMapOperator's
+    pool actors; fn_constructor args of map_batches)."""
+
+    def __init__(self, ctor):
+        self.callable = ctor()
+
+    def apply(self, block: Block,
+              fns_before: Sequence, fns_after: Sequence) -> Block:
+        block = _chain_block(block, fns_before)
+        block = BlockAccessor.normalize(self.callable(block))
+        return _chain_block(block, fns_after)
+
+
+@dataclasses.dataclass
+class StageStats:
+    name: str
+    tasks: int = 0
+    wall_s: float = 0.0
+
+
+class StreamingExecutor:
+    """Executes an op list, yielding block ObjectRefs with bounded
+    in-flight work."""
+
+    def __init__(self, ops: List[Op], ctx: Optional[DataContext] = None):
+        self.ops = ops
+        self.ctx = ctx or DataContext.get_current()
+        self.stats: List[StageStats] = []
+        self._remote_chain_read = ray_tpu.remote(
+            num_cpus=self.ctx.cpus_per_task)(_chain_read)
+        self._remote_chain_block = ray_tpu.remote(
+            num_cpus=self.ctx.cpus_per_task)(_chain_block)
+        self.remote_num_rows = ray_tpu.remote(num_cpus=0.25)(_num_rows)
+        self.remote_slice = ray_tpu.remote(num_cpus=0.25)(_slice_block)
+
+    # -- public -----------------------------------------------------------
+
+    def execute(self) -> Iterator[Any]:
+        """Yield ObjectRefs of output blocks, streaming."""
+        segments = self._segment_ops()
+        stream: Iterator[Any] = iter(())
+        source_done = False
+        for seg in segments:
+            if isinstance(seg, tuple) and seg[0] == "source":
+                stream = self._run_source(seg[1], seg[2])
+            elif isinstance(seg, tuple) and seg[0] == "map":
+                stream = self._run_map_segment(stream, seg[1])
+            elif isinstance(seg, tuple) and seg[0] == "pool":
+                stream = self._run_actor_pool(stream, seg[1])
+            elif isinstance(seg, AllToAllOp):
+                t0 = time.perf_counter()
+                refs = list(stream)
+                refs = seg.fn(refs, self)
+                self.stats.append(StageStats(seg.name, len(refs),
+                                             time.perf_counter() - t0))
+                stream = iter(refs)
+            elif isinstance(seg, LimitOp):
+                stream = self._run_limit(stream, seg.n)
+        return stream
+
+    # -- segmentation -----------------------------------------------------
+
+    def _segment_ops(self):
+        """Group ops into [source+fused maps][all2all][fused maps]..."""
+        segments: List[Any] = []
+        i = 0
+        ops = self.ops
+        if not ops or not isinstance(ops[0], ReadOp):
+            raise ValueError("plan must start with a ReadOp")
+        fused: List[MapOp] = []
+        i = 1
+        while i < len(ops) and isinstance(ops[i], MapOp) \
+                and not ops[i].actor_pool_size:
+            fused.append(ops[i])
+            i += 1
+        segments.append(("source", ops[0], fused))
+        while i < len(ops):
+            op = ops[i]
+            if isinstance(op, MapOp) and op.actor_pool_size:
+                segments.append(("pool", op))
+                i += 1
+            elif isinstance(op, MapOp):
+                fused = [op]
+                i += 1
+                while i < len(ops) and isinstance(ops[i], MapOp) \
+                        and not ops[i].actor_pool_size:
+                    fused.append(ops[i])
+                    i += 1
+                segments.append(("map", fused))
+            elif isinstance(op, (AllToAllOp, LimitOp)):
+                segments.append(op)
+                i += 1
+            else:
+                raise ValueError(f"unknown op {op!r}")
+        return segments
+
+    # -- stages -----------------------------------------------------------
+
+    def _run_source(self, read: ReadOp, fused: List[MapOp]) -> Iterator[Any]:
+        parallelism = read.parallelism
+        if parallelism in (-1, None):
+            parallelism = self.ctx.max_in_flight_tasks * 2
+        tasks = read.datasource.get_read_tasks(parallelism)
+        fns = [m.fn for m in fused]
+        name = "+".join([read.name] + [m.name for m in fused])
+        t0 = time.perf_counter()
+        stat = StageStats(name, len(tasks))
+        self.stats.append(stat)
+        window = self.ctx.max_in_flight_tasks
+        pending = deque()
+        it = iter(tasks)
+        try:
+            for _ in range(window):
+                pending.append(self._remote_chain_read.remote(next(it), fns))
+        except StopIteration:
+            it = None
+        while pending:
+            ref = pending.popleft()
+            if it is not None:
+                try:
+                    pending.append(self._remote_chain_read.remote(next(it), fns))
+                except StopIteration:
+                    it = None
+            yield ref
+        stat.wall_s = time.perf_counter() - t0
+
+    def _run_map_segment(self, stream: Iterator[Any],
+                         fused: List[MapOp]) -> Iterator[Any]:
+        fns = [m.fn for m in fused]
+        name = "+".join(m.name for m in fused)
+        t0 = time.perf_counter()
+        stat = StageStats(name)
+        self.stats.append(stat)
+        window = self.ctx.max_in_flight_tasks
+        pending = deque()
+        exhausted = False
+        while True:
+            while not exhausted and len(pending) < window:
+                try:
+                    up = next(stream)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.append(self._remote_chain_block.remote(up, fns))
+                stat.tasks += 1
+            if not pending:
+                break
+            yield pending.popleft()
+        stat.wall_s = time.perf_counter() - t0
+
+    def _run_actor_pool(self, stream: Iterator[Any], op: MapOp) -> Iterator[Any]:
+        if op.fn_constructor is None:
+            raise ValueError("actor-pool map needs a callable class")
+        Worker = ray_tpu.remote(num_cpus=self.ctx.cpus_per_task)(_PoolWorker)
+        workers = [Worker.remote(op.fn_constructor)
+                   for _ in range(op.actor_pool_size)]
+        t0 = time.perf_counter()
+        stat = StageStats(f"{op.name}(pool={op.actor_pool_size})")
+        self.stats.append(stat)
+        pending = deque()
+        window = max(self.ctx.max_in_flight_tasks, op.actor_pool_size)
+        idx = 0
+        exhausted = False
+        try:
+            while True:
+                while not exhausted and len(pending) < window:
+                    try:
+                        up = next(stream)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    w = workers[idx % len(workers)]
+                    idx += 1
+                    pending.append(w.apply.remote(up, [], []))
+                    stat.tasks += 1
+                if not pending:
+                    break
+                yield pending.popleft()
+        finally:
+            for w in workers:
+                ray_tpu.kill(w)
+        stat.wall_s = time.perf_counter() - t0
+
+    def _run_limit(self, stream: Iterator[Any], n: int) -> Iterator[Any]:
+        remaining = n
+        for ref in stream:
+            if remaining <= 0:
+                break
+            rows = ray_tpu.get(self.remote_num_rows.remote(ref))
+            if rows <= remaining:
+                remaining -= rows
+                yield ref
+            else:
+                yield self.remote_slice.remote(ref, 0, remaining)
+                remaining = 0
+
+    # -- stats ------------------------------------------------------------
+
+    def stats_summary(self) -> str:
+        lines = ["Execution stats:"]
+        for s in self.stats:
+            lines.append(f"  {s.name}: {s.tasks} tasks, {s.wall_s:.3f}s wall")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# All-to-all implementations
+# ---------------------------------------------------------------------------
+
+
+def make_repartition(num_blocks: int) -> AllToAllOp:
+    """Two-stage exchange like the shuffle (split each block into k
+    positional parts, merge part j of every block) — no single-task or
+    driver-memory bottleneck."""
+
+    def run(refs: List[Any], ex: StreamingExecutor) -> List[Any]:
+        if not refs:
+            return []
+
+        def split_k(block: Block, k: int) -> List[Block]:
+            return split_block(block, k)
+
+        split_fn = ray_tpu.remote(num_cpus=1)(split_k)
+        parts_refs = [split_fn.remote(r, num_blocks) for r in refs]
+
+        def merge_j(j: int, *all_parts: List[Block]) -> Block:
+            return concat_blocks([parts[j] for parts in all_parts])
+
+        merge_fn = ray_tpu.remote(num_cpus=1)(merge_j)
+        return [merge_fn.remote(j, *parts_refs) for j in range(num_blocks)]
+
+    return AllToAllOp(run, name=f"Repartition({num_blocks})")
+
+
+def make_random_shuffle(seed: Optional[int]) -> AllToAllOp:
+    """Map-stage splits each block into K random parts; reduce-stage
+    concatenates part j of every block and shuffles locally
+    (parity: push_based_shuffle.py two-stage exchange)."""
+
+    def run(refs: List[Any], ex: StreamingExecutor) -> List[Any]:
+        if not refs:
+            return []
+        k = len(refs)
+        rng_seed = seed if seed is not None else int(time.time() * 1e6) % 2**31
+
+        def split_random(block: Block, k: int, s: int) -> List[Block]:
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            rng = np.random.default_rng(s)
+            assignment = rng.integers(0, k, size=n)
+            return [acc.take_rows(np.nonzero(assignment == j)[0])
+                    for j in range(k)]
+
+        split_fn = ray_tpu.remote(num_cpus=1, num_returns=1)(split_random)
+        parts_refs = [split_fn.remote(r, k, rng_seed + i)
+                      for i, r in enumerate(refs)]
+
+        def merge_j(j: int, s: int, *all_parts: List[Block]) -> Block:
+            merged = concat_blocks([parts[j] for parts in all_parts])
+            acc = BlockAccessor(merged)
+            rng = np.random.default_rng(s)
+            perm = rng.permutation(acc.num_rows())
+            return acc.take_rows(perm)
+
+        merge_fn = ray_tpu.remote(num_cpus=1)(merge_j)
+        return [merge_fn.remote(j, rng_seed ^ j, *parts_refs)
+                for j in range(k)]
+
+    return AllToAllOp(run, name="RandomShuffle")
+
+
+def make_sort(key: str, descending: bool) -> AllToAllOp:
+    """Global sort: sample-free simple implementation — concatenate,
+    argsort, re-split (fine up to driver memory; the reference's range
+    partitioning can replace this later)."""
+
+    def run(refs: List[Any], ex: StreamingExecutor) -> List[Any]:
+        if not refs:
+            return []
+        k = len(refs)
+
+        def sort_all(*blocks: Block) -> List[Block]:
+            merged = concat_blocks(list(blocks))
+            acc = BlockAccessor(merged)
+            order = np.argsort(merged[key], kind="stable")
+            if descending:
+                order = order[::-1]
+            return split_block(acc.take_rows(order), k)
+
+        out_ref = ray_tpu.remote(num_cpus=1)(sort_all).remote(*refs)
+        return [ray_tpu.put(b) for b in ray_tpu.get(out_ref)]
+
+    return AllToAllOp(run, name=f"Sort({key})")

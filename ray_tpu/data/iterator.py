@@ -1,0 +1,210 @@
+"""Data iterators: batch iteration, device prefetch, coordinated splits.
+
+Parity with the reference's consumption layer (ray: python/ray/data/
+iterator.py DataIterator; _internal/iterator/stream_split_iterator.py:31
+— n coordinated iterators over one streaming execution for Train
+ingest).  TPU-first addition: ``device=`` moves batches onto the
+accelerator with `jax.device_put` overlapped one batch ahead, the
+host→HBM pipelining the reference leaves to torch DataLoader.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
+from ray_tpu.data.context import DataContext
+
+
+def iter_batches_from_refs(
+    ref_iter: Iterator[Any],
+    *,
+    batch_size: Optional[int] = None,
+    drop_last: bool = False,
+    batch_format: str = "numpy",
+    local_shuffle_buffer_size: Optional[int] = None,
+    local_shuffle_seed: Optional[int] = None,
+    prefetch_batches: Optional[int] = None,
+    device: Any = None,
+    collate_fn: Optional[Callable[[Block], Any]] = None,
+) -> Iterator[Any]:
+    """Re-batch a stream of block refs into fixed-size batches."""
+    ctx = DataContext.get_current()
+    depth = prefetch_batches if prefetch_batches is not None \
+        else ctx.prefetch_batches
+
+    def raw_batches() -> Iterator[Block]:
+        buffer: List[Block] = []
+        buffered_rows = 0
+        rng = (np.random.default_rng(local_shuffle_seed)
+               if local_shuffle_buffer_size else None)
+
+        def drain(min_rows: int) -> Iterator[Block]:
+            nonlocal buffer, buffered_rows
+            while buffered_rows >= min_rows and (
+                    batch_size is None or buffered_rows >= batch_size):
+                merged = concat_blocks(buffer)
+                acc = BlockAccessor(merged)
+                if rng is not None:
+                    merged = acc.take_rows(rng.permutation(acc.num_rows()))
+                    acc = BlockAccessor(merged)
+                size = batch_size or acc.num_rows()
+                out = acc.slice(0, size)
+                rest = acc.slice(size, acc.num_rows())
+                buffer = [rest] if BlockAccessor(rest).num_rows() else []
+                buffered_rows = BlockAccessor(rest).num_rows() if buffer else 0
+                yield out
+                if batch_size is None:
+                    return
+
+        min_needed = (local_shuffle_buffer_size or 0) + (batch_size or 0)
+        for ref in ref_iter:
+            block = ray_tpu.get(ref)
+            n = BlockAccessor(block).num_rows()
+            if n == 0:
+                continue
+            buffer.append(block)
+            buffered_rows += n
+            yield from drain(max(min_needed, batch_size or 1))
+        # Tail: flush whatever is left.
+        while buffered_rows > 0:
+            merged = concat_blocks(buffer)
+            acc = BlockAccessor(merged)
+            if rng is not None:
+                merged = acc.take_rows(rng.permutation(acc.num_rows()))
+                acc = BlockAccessor(merged)
+                rng = None  # the tail is fully merged; one shuffle suffices
+            size = batch_size or acc.num_rows()
+            if acc.num_rows() < size:
+                if not drop_last:
+                    yield merged
+                return
+            out = acc.slice(0, size)
+            rest = acc.slice(size, acc.num_rows())
+            buffer = [rest]
+            buffered_rows = BlockAccessor(rest).num_rows()
+            yield out
+
+    def convert(batch: Block) -> Any:
+        if collate_fn is not None:
+            return collate_fn(batch)
+        if batch_format == "pandas":
+            return BlockAccessor(batch).to_pandas()
+        if device is not None:
+            import jax
+
+            return jax.device_put(
+                {k: v for k, v in batch.items() if v.dtype != object},
+                device,
+            )
+        return batch
+
+    if depth <= 0:
+        for b in raw_batches():
+            yield convert(b)
+        return
+
+    # Background prefetch thread keeps `depth` converted batches ready —
+    # with device=..., the device_put for batch i+1 overlaps step i.
+    q: _queue.Queue = _queue.Queue(maxsize=depth)
+    DONE = object()
+    err: List[BaseException] = []
+    stop = threading.Event()
+
+    def producer():
+        try:
+            for b in raw_batches():
+                item = convert(b)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # surfaces in consumer
+            err.append(e)
+        finally:
+            try:
+                q.put_nowait(DONE)
+            except _queue.Full:
+                pass  # consumer is gone and stop is set
+
+    t = threading.Thread(target=producer, daemon=True, name="batch-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is DONE:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        # Consumer abandoned the generator: unblock and end the producer.
+        stop.set()
+
+
+class _SplitCoordinator:
+    """Actor multiplexing one streaming execution across n consumers
+    (parity: stream_split_iterator.py SplitCoordinator actor).
+
+    Blocks are dealt round-robin to per-split queues, so with
+    ``equal=True`` every consumer sees the same block count (±1) — the
+    property Train workers in lockstep collectives rely on."""
+
+    def __init__(self, ops, n: int, equal: bool):
+        from ray_tpu.data.executor import StreamingExecutor
+
+        self._executor = StreamingExecutor(ops)
+        self._stream = self._executor.execute()
+        self._lock = threading.Lock()
+        self._done = False
+        self._n = n
+        self._equal = equal
+        self._queues: List[List[Any]] = [[] for _ in range(n)]
+        self._next_split = 0
+
+    def next_block_ref(self, split_id: int):
+        with self._lock:
+            while not self._queues[split_id] and not self._done:
+                try:
+                    ref = next(self._stream)
+                except StopIteration:
+                    self._done = True
+                    break
+                self._queues[self._next_split].append(ref)
+                self._next_split = (self._next_split + 1) % self._n
+            if self._queues[split_id]:
+                return self._queues[split_id].pop(0)
+            return None
+
+
+class DataIterator:
+    """Per-consumer handle (parity: DataIterator returned by
+    streaming_split; used by each Train worker)."""
+
+    def __init__(self, coordinator_handle, split_id: int = 0):
+        self._coord = coordinator_handle
+        self._split_id = split_id
+
+    def _ref_stream(self) -> Iterator[Any]:
+        while True:
+            ref = ray_tpu.get(
+                self._coord.next_block_ref.remote(self._split_id))
+            if ref is None:
+                return
+            yield ref
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return iter_batches_from_refs(self._ref_stream(), **kwargs)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref in self._ref_stream():
+            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()

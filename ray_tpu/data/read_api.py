@@ -1,0 +1,111 @@
+"""Dataset creation API (parity: ray: python/ray/data/read_api.py —
+read_parquet:558, read_images:703, read_json:951, read_csv:1074,
+range/from_items/from_pandas/from_numpy/from_arrow)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.data.block import TENSOR_COLUMN, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.datasource import (
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    ImageDatasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    TextDatasource,
+)
+from ray_tpu.data.executor import ReadOp
+
+
+def read_datasource(ds: Datasource, *, parallelism: int = -1,
+                    name: str = "Read") -> Dataset:
+    return Dataset([ReadOp(ds, parallelism, name=name)])
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    ctx = DataContext.get_current()
+    return read_datasource(RangeDatasource(n, ctx.target_block_rows),
+                           parallelism=parallelism, name="Range")
+
+
+def from_items(items: Sequence[Any], *, parallelism: int = -1) -> Dataset:
+    ctx = DataContext.get_current()
+    return read_datasource(ItemsDatasource(items, ctx.target_block_rows),
+                           parallelism=parallelism, name="FromItems")
+
+
+def from_numpy(arr: np.ndarray, *, column: str = TENSOR_COLUMN) -> Dataset:
+    import ray_tpu
+
+    refs = [ray_tpu.put({column: arr})]
+    from ray_tpu.data.dataset import _ops_from_refs
+
+    return Dataset(_ops_from_refs(refs), cached_refs=refs)
+
+
+def from_pandas(df) -> Dataset:
+    import ray_tpu
+
+    block = BlockAccessor.from_pandas(df)
+    refs = [ray_tpu.put(block)]
+    from ray_tpu.data.dataset import _ops_from_refs
+
+    return Dataset(_ops_from_refs(refs), cached_refs=refs)
+
+
+def from_arrow(table) -> Dataset:
+    import ray_tpu
+
+    block = BlockAccessor.from_arrow(table)
+    refs = [ray_tpu.put(block)]
+    from ray_tpu.data.dataset import _ops_from_refs
+
+    return Dataset(_ops_from_refs(refs), cached_refs=refs)
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 parallelism: int = -1) -> Dataset:
+    return read_datasource(ParquetDatasource(paths, columns),
+                           parallelism=parallelism, name="ReadParquet")
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(CSVDatasource(paths), parallelism=parallelism,
+                           name="ReadCSV")
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(JSONDatasource(paths), parallelism=parallelism,
+                           name="ReadJSON")
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(NumpyDatasource(paths), parallelism=parallelism,
+                           name="ReadNumpy")
+
+
+def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
+                include_paths: bool = False, parallelism: int = -1) -> Dataset:
+    return read_datasource(
+        ImageDatasource(paths, size=size, mode=mode,
+                        include_paths=include_paths),
+        parallelism=parallelism, name="ReadImages")
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(BinaryDatasource(paths), parallelism=parallelism,
+                           name="ReadBinary")
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(TextDatasource(paths), parallelism=parallelism,
+                           name="ReadText")

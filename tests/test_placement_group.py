@@ -163,3 +163,36 @@ def test_ici_contiguous_pack_ordering(cluster):
     indices = sorted(by_hex[h] for h in chosen if h in by_hex)
     # Bundles land on the lowest-indexed ICI coordinates, contiguously.
     assert indices == [0, 1]
+
+
+def test_infeasible_hard_affinity_fails_fast(cluster):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    strat = NodeAffinitySchedulingStrategy(node_id="deadbeef" * 4, soft=False)
+    with pytest.raises(ValueError):
+        one.options(num_cpus=1, scheduling_strategy=strat).remote()
+
+
+def test_remove_pg_kills_actors_and_returns_capacity(cluster):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(timeout=5)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(num_cpus=1, placement_group=pg).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == "pong"
+    before = ray_tpu.available_resources().get("CPU", 0)
+    remove_placement_group(pg)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) >= before + 2 - 1e-6:
+            break
+        time.sleep(0.05)
+    assert ray_tpu.available_resources().get("CPU", 0) >= before + 2 - 1e-6
+    with pytest.raises(ray_tpu.core.ActorDiedError):
+        ray_tpu.get(a.ping.remote(), timeout=5)
