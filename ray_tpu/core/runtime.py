@@ -168,9 +168,18 @@ class _Allocation:
 
     def release(self):
         if self.bundle is not None:
-            if (self.node is not None
-                    and self.bundle.node_id == self.node.node_id):
-                self.bundle.release(self.demand)
+            # node_id must be read under the bundle lock so we can't race
+            # remove_placement_group between its ledger-zeroing and its
+            # node_id reset (which would credit a dead ledger).
+            with self.bundle.lock:
+                still_ours = (self.node is not None
+                              and self.bundle.node_id == self.node.node_id)
+                if still_ours:
+                    for k, v in self.demand.items():
+                        self.bundle.available[k] = \
+                            self.bundle.available.get(k, 0) + v
+            if still_ours:
+                pass
             elif self.node is not None:
                 # The bundle moved away (PG removed, or relocated after a
                 # node death).  The in-use portion was never returned to
@@ -386,8 +395,8 @@ class LocalRuntime:
             lost = [b for b in st.bundles
                     if b.node_id == node_id and not st.removed]
             for b in lost:
-                b.node_id = None
                 with b.lock:
+                    b.node_id = None
                     b.available = {}
             if lost:
                 self._reserve_bundles(st, lost)
@@ -848,14 +857,14 @@ class LocalRuntime:
         def rollback():
             for b, n in reserved:
                 n.pool.release(b.resources)
-                b.node_id = None
                 with b.lock:
+                    b.node_id = None
                     b.available = {}
 
         def place_on(b: Bundle, n: NodeState) -> bool:
             if n.pool.try_acquire(b.resources):
-                b.node_id = n.node_id
                 with b.lock:
+                    b.node_id = n.node_id
                     b.available = dict(b.resources)
                 reserved.append((b, n))
                 return True
@@ -937,9 +946,9 @@ class LocalRuntime:
                     with b.lock:
                         unused = dict(b.available)
                         b.available = {}
+                        b.node_id = None  # atomic with the ledger zeroing
                     if node is not None and node.alive:
                         node.pool.release(unused)
-                    b.node_id = None
         # Kill actors living inside the group (parity: PG removal kills
         # the actors/tasks scheduled into it).
         with self._lock:

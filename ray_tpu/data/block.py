@@ -42,9 +42,20 @@ class BlockAccessor:
             return {}
         if not isinstance(rows[0], dict):
             rows = [{TENSOR_COLUMN: r} for r in rows]
+        # Schema is the union of all rows' keys; missing values become
+        # None (heterogeneous JSON records etc. must not lose columns or
+        # crash on the first absent key).
+        keys: Dict[str, None] = {}
+        for r in rows:
+            for k in r:
+                keys.setdefault(k)
         cols = {}
-        for key in rows[0]:
-            cols[key] = _to_array([r[key] for r in rows])
+        for key in keys:
+            vals = [r.get(key) for r in rows]
+            if any(v is None for v in vals):
+                cols[key] = np.asarray(vals, dtype=object)
+            else:
+                cols[key] = _to_array(vals)
         return cols
 
     @staticmethod

@@ -82,8 +82,7 @@ class Dataset:
     def map_batches(self, fn: Union[Callable, type], *,
                     batch_size: Optional[int] = None,
                     compute: Optional[ActorPoolStrategy] = None,
-                    fn_constructor_args: tuple = (),
-                    **_ignored) -> "Dataset":
+                    fn_constructor_args: tuple = ()) -> "Dataset":
         """Transform batches (parity: dataset.py map_batches)."""
         if isinstance(fn, type):
             if compute is None:
@@ -93,6 +92,7 @@ class Dataset:
                 fn=lambda b: b, name=f"MapBatches({fn.__name__})",
                 actor_pool_size=compute.size,
                 fn_constructor=ctor,
+                batch_size=batch_size,
             ))
         return self._append(MapOp(_batched(fn, batch_size),
                                   name=f"MapBatches({_name(fn)})"))
@@ -161,7 +161,11 @@ class Dataset:
         return Dataset(_ops_from_refs(list(left) + list(right)))
 
     def zip(self, other: "Dataset") -> "Dataset":
-        """Column-wise join of equal-length datasets."""
+        """Column-wise join of equal-length datasets.
+
+        Materializes both sides in the driver to realign rows (fine up to
+        driver memory; a block-aligned remote exchange can replace this
+        later, as repartition/shuffle already do)."""
         left = self.materialize()
         right = other.materialize()
         lb = [ray_tpu.get(r) for r in left._cached_refs]
@@ -273,7 +277,9 @@ class Dataset:
     # -- splits -----------------------------------------------------------
 
     def split(self, n: int) -> List["Dataset"]:
-        """Materializing equal split (parity: dataset.split)."""
+        """Materializing equal split (parity: dataset.split).  Pulls all
+        blocks into the driver to rebalance; use streaming_split for the
+        scalable path."""
         mat = self.materialize()
         blocks = [ray_tpu.get(r) for r in mat._cached_refs]
         whole = concat_blocks(blocks)

@@ -155,7 +155,12 @@ class JSONDatasource(FileDatasource):
         import json
 
         with open(path) as f:
-            head = f.read(1)
+            head = ""
+            while True:  # first non-whitespace char decides the format
+                ch = f.read(1)
+                if not ch or not ch.isspace():
+                    head = ch
+                    break
             f.seek(0)
             if head == "[":
                 rows = json.load(f)

@@ -57,6 +57,7 @@ class MapOp:
     # actors instead of stateless tasks (parity: ActorPoolMapOperator).
     actor_pool_size: int = 0
     fn_constructor: Optional[Callable[[], Any]] = None
+    batch_size: Optional[int] = None  # sub-batching inside pool workers
 
 
 @dataclasses.dataclass
@@ -105,11 +106,18 @@ class _PoolWorker:
     def __init__(self, ctor):
         self.callable = ctor()
 
-    def apply(self, block: Block,
-              fns_before: Sequence, fns_after: Sequence) -> Block:
-        block = _chain_block(block, fns_before)
-        block = BlockAccessor.normalize(self.callable(block))
-        return _chain_block(block, fns_after)
+    def apply(self, block: Block, batch_size: Optional[int]) -> Block:
+        if batch_size is None:
+            return BlockAccessor.normalize(self.callable(block))
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        outs = []
+        for start in range(0, n, batch_size):
+            outs.append(BlockAccessor.normalize(
+                self.callable(acc.slice(start, min(start + batch_size, n)))))
+        from ray_tpu.data.block import concat_blocks as _concat
+
+        return _concat(outs) if outs else block
 
 
 @dataclasses.dataclass
@@ -272,7 +280,7 @@ class StreamingExecutor:
                         break
                     w = workers[idx % len(workers)]
                     idx += 1
-                    pending.append(w.apply.remote(up, [], []))
+                    pending.append(w.apply.remote(up, op.batch_size))
                     stat.tasks += 1
                 if not pending:
                     break

@@ -131,10 +131,15 @@ def iter_batches_from_refs(
         except BaseException as e:  # surfaces in consumer
             err.append(e)
         finally:
-            try:
-                q.put_nowait(DONE)
-            except _queue.Full:
-                pass  # consumer is gone and stop is set
+            # DONE must reach the consumer even when the queue is full of
+            # batches it hasn't drained yet — block with the same
+            # stop-aware retry as data items.
+            while not stop.is_set():
+                try:
+                    q.put(DONE, timeout=0.1)
+                    break
+                except _queue.Full:
+                    continue
 
     t = threading.Thread(target=producer, daemon=True, name="batch-prefetch")
     t.start()
@@ -173,6 +178,15 @@ class _SplitCoordinator:
 
     def next_block_ref(self, split_id: int):
         with self._lock:
+            if not self._equal:
+                # First-come-first-served: fast consumers take more.
+                if self._done:
+                    return None
+                try:
+                    return next(self._stream)
+                except StopIteration:
+                    self._done = True
+                    return None
             while not self._queues[split_id] and not self._done:
                 try:
                     ref = next(self._stream)

@@ -1,0 +1,60 @@
+"""ray_tpu.tune — experiment runner (parity: python/ray/tune;
+see SURVEY.md §2.3)."""
+
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trial import Trial, get_checkpoint, report
+from ray_tpu.tune.tuner import (
+    Result,
+    ResultGrid,
+    RunConfig,
+    Trainable,
+    TuneConfig,
+    TuneController,
+    Tuner,
+    run,
+    with_resources,
+)
+
+__all__ = [
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "Result",
+    "ResultGrid",
+    "RunConfig",
+    "Trainable",
+    "Trial",
+    "TrialScheduler",
+    "TuneConfig",
+    "TuneController",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "report",
+    "run",
+    "sample_from",
+    "uniform",
+    "with_resources",
+]

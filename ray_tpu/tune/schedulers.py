@@ -1,0 +1,189 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT.
+
+Parity with the reference's scheduler suite (ray: python/ray/tune/
+schedulers/ — async_hyperband.py AsyncHyperBandScheduler,
+median_stopping_rule.py, pbt.py PopulationBasedTraining).  Decisions are
+made per reported result: CONTINUE, STOP, or (PBT) EXPLOIT with a new
+config + a source checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.trial import Trial
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial: Trial, result: Dict[str, Any],
+                  all_trials: List[Trial]) -> str:
+        return CONTINUE
+
+    def exploit_target(self, trial: Trial, all_trials: List[Trial]
+                       ) -> Optional[Tuple[Trial, Dict[str, Any]]]:
+        """PBT hook: (source_trial, new_config) or None."""
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (parity: schedulers/async_hyperband.py): successive-halving
+    brackets checked asynchronously at rung boundaries — a trial stops at
+    a rung if its metric is below the top 1/reduction_factor quantile of
+    completed rung entries."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung level -> list of recorded metric values
+        self.rungs: Dict[int, List[float]] = {}
+        rung = grace_period
+        self.rung_levels = []
+        while rung < max_t:
+            self.rung_levels.append(rung)
+            rung = int(rung * self.rf)
+
+    def on_result(self, trial, result, all_trials) -> str:
+        t = result.get(self.time_attr)
+        v = result.get(self.metric)
+        if t is None or v is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for level in self.rung_levels:
+            if t == level:
+                recorded = self.rungs.setdefault(level, [])
+                recorded.append(float(v))
+                if len(recorded) < self.rf:
+                    return CONTINUE  # not enough evidence yet
+                # Keep the top 1/rf quantile (percentile cutoff, matching
+                # the reference's _Bracket.cutoff).
+                import numpy as np
+
+                if self.mode == "max":
+                    cutoff = float(np.percentile(
+                        recorded, 100 * (1 - 1 / self.rf)))
+                    good = v >= cutoff
+                else:
+                    cutoff = float(np.percentile(recorded, 100 / self.rf))
+                    good = v <= cutoff
+                return CONTINUE if good else STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running best is worse than the median of other
+    trials' running bests at the same step
+    (parity: schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+
+    def on_result(self, trial, result, all_trials) -> str:
+        t = result.get(self.time_attr)
+        if t is None or t < self.grace_period:
+            return CONTINUE
+        others = []
+        for other in all_trials:
+            if other.trial_id == trial.trial_id:
+                continue
+            best = other.best_metric(self.metric, self.mode)
+            if best is not None:
+                others.append(best)
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        mine = trial.best_metric(self.metric, self.mode)
+        if mine is None:
+            return CONTINUE
+        bad = mine < median if self.mode == "max" else mine > median
+        return STOP if bad else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (parity: schedulers/pbt.py): at each perturbation interval,
+    bottom-quantile trials EXPLOIT a top-quantile trial's checkpoint and
+    EXPLORE a mutated config."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+
+    def _quantiles(self, all_trials: List[Trial]):
+        scored = [(t.best_metric(self.metric, self.mode), t)
+                  for t in all_trials]
+        scored = [(s, t) for s, t in scored if s is not None]
+        if len(scored) < 2:
+            return [], []
+        scored.sort(key=lambda x: x[0], reverse=(self.mode == "max"))
+        k = max(1, int(len(scored) * self.quantile))
+        top = [t for _, t in scored[:k]]
+        bottom = [t for _, t in scored[-k:]]
+        return top, bottom
+
+    def on_result(self, trial, result, all_trials) -> str:
+        t = result.get(self.time_attr)
+        if t is None or t % self.interval != 0:
+            return CONTINUE
+        top, bottom = self._quantiles(all_trials)
+        if trial in bottom and trial not in top:
+            return "EXPLOIT"
+        return CONTINUE
+
+    def exploit_target(self, trial, all_trials):
+        top, _ = self._quantiles(all_trials)
+        top = [t for t in top if t.trial_id != trial.trial_id]
+        if not top:
+            return None
+        source = self.rng.choice(top)
+        new_config = self._explore(dict(source.config))
+        return source, new_config
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+
+        for key, spec in self.mutations.items():
+            if isinstance(spec, list):
+                config[key] = self.rng.choice(spec)
+            elif isinstance(spec, Domain):
+                config[key] = spec.sample(self.rng)
+            elif callable(spec):
+                config[key] = spec()
+            elif key in config and isinstance(config[key], (int, float)):
+                factor = self.rng.choice([0.8, 1.2])
+                config[key] = config[key] * factor
+        return config

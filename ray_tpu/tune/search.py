@@ -1,0 +1,135 @@
+"""Search spaces + variant generation.
+
+Parity with the reference's basic search layer (ray: python/ray/tune/
+search/basic_variant.py — grid/random variant expansion;
+tune/search/sample.py — Domain objects uniform/loguniform/choice/randint).
+Advanced optimizers (Optuna/HyperOpt/...) plug in behind the same
+``SearchAlgorithm.suggest`` seam.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclasses.dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclasses.dataclass
+class RandInt(Domain):
+    low: int
+    high: int  # exclusive
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclasses.dataclass
+class QUniform(Domain):
+    low: float
+    high: float
+    q: float
+
+    def sample(self, rng):
+        return round(rng.uniform(self.low, self.high) / self.q) * self.q
+
+
+@dataclasses.dataclass
+class Choice(Domain):
+    categories: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+@dataclasses.dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def choice(categories: List[Any]) -> Choice:
+    return Choice(list(categories))
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def sample_from(fn: Callable[[Dict], Any]):
+    return _SampleFrom(fn)
+
+
+@dataclasses.dataclass
+class _SampleFrom(Domain):
+    fn: Callable[[Dict], Any]
+
+    def sample(self, rng):
+        return self.fn({})
+
+
+class BasicVariantGenerator:
+    """Grid axes fully expanded × num_samples random draws of the rest
+    (parity: basic_variant.py semantics)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        grid_keys = [k for k, v in self.param_space.items()
+                     if isinstance(v, GridSearch)]
+        grid_values = [self.param_space[k].values for k in grid_keys]
+        grids = list(itertools.product(*grid_values)) if grid_keys else [()]
+        for _ in range(self.num_samples):
+            for combo in grids:
+                cfg: Dict[str, Any] = {}
+                for k, v in self.param_space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self.rng)
+                    else:
+                        cfg[k] = v
+                yield cfg

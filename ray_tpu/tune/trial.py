@@ -1,0 +1,150 @@
+"""Trial state + the in-trial session channel.
+
+Parity with the reference's Trial FSM (ray: python/ray/tune/experiment/
+trial.py:307 — PENDING/RUNNING/PAUSED/TERMINATED/ERROR) and the
+session.report channel (ray: python/ray/air/session.py,
+train/_internal/session.py:612 — workers stream metrics/checkpoints to
+the driver).  Within our in-process runtime the channel is a thread-safe
+queue registry keyed by trial id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as _queue
+import threading
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    results: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    checkpoint: Any = None  # latest reported checkpoint (dict)
+    actor: Any = None
+    run_ref: Any = None
+    restore_from: Any = None  # checkpoint to hand the next (re)start
+
+    def last_result(self) -> Optional[Dict[str, Any]]:
+        return self.results[-1] if self.results else None
+
+    def best_metric(self, metric: str, mode: str) -> Optional[float]:
+        vals = [r[metric] for r in self.results if metric in r]
+        if not vals:
+            return None
+        return max(vals) if mode == "max" else min(vals)
+
+
+class StopTrial(Exception):
+    """Raised inside a trial when the scheduler decided to stop it."""
+
+
+class _SessionChannel:
+    """report()/get_checkpoint() plumbing between trial threads and the
+    controller.  One registry per process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queues: Dict[str, _queue.Queue] = {}
+        self._stop_flags: Dict[str, threading.Event] = {}
+        self._restore: Dict[str, Any] = {}
+        self._stop_criteria: Dict[str, Dict[str, float]] = {}
+        self._report_counts: Dict[str, int] = {}
+        self._local = threading.local()
+
+    # controller side -----------------------------------------------------
+
+    def register(self, trial_id: str, restore_checkpoint: Any = None,
+                 stop_criteria: Optional[Dict[str, float]] = None):
+        with self._lock:
+            self._queues[trial_id] = _queue.Queue()
+            self._stop_flags[trial_id] = threading.Event()
+            self._restore[trial_id] = restore_checkpoint
+            self._stop_criteria[trial_id] = dict(stop_criteria or {})
+            self._report_counts[trial_id] = 0
+
+    def unregister(self, trial_id: str):
+        with self._lock:
+            self._queues.pop(trial_id, None)
+            self._stop_flags.pop(trial_id, None)
+            self._restore.pop(trial_id, None)
+            self._stop_criteria.pop(trial_id, None)
+            self._report_counts.pop(trial_id, None)
+
+    def request_stop(self, trial_id: str):
+        with self._lock:
+            flag = self._stop_flags.get(trial_id)
+        if flag is not None:
+            flag.set()
+
+    def drain(self, trial_id: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            q = self._queues.get(trial_id)
+        out = []
+        if q is None:
+            return out
+        while True:
+            try:
+                out.append(q.get_nowait())
+            except _queue.Empty:
+                return out
+
+    # trial side ----------------------------------------------------------
+
+    def bind(self, trial_id: str):
+        self._local.trial_id = trial_id
+
+    def current_trial_id(self) -> Optional[str]:
+        return getattr(self._local, "trial_id", None)
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Any = None):
+        tid = self.current_trial_id()
+        if tid is None:
+            raise RuntimeError("tune.report() called outside a trial")
+        metrics = dict(metrics)
+        with self._lock:
+            q = self._queues.get(tid)
+            flag = self._stop_flags.get(tid)
+            criteria = self._stop_criteria.get(tid, {})
+            self._report_counts[tid] = self._report_counts.get(tid, 0) + 1
+            metrics.setdefault("training_iteration", self._report_counts[tid])
+        if q is not None:
+            q.put({"metrics": metrics, "checkpoint": checkpoint})
+        # run_config.stop criteria are enforced synchronously at the
+        # report site so a free-running trial stops at exactly the bound
+        # (the scheduler's early-stop decisions stay asynchronous).
+        if any(k in metrics and metrics[k] >= bound
+               for k, bound in criteria.items()):
+            raise StopTrial()
+        if flag is not None and flag.is_set():
+            raise StopTrial()
+
+    def get_checkpoint(self) -> Any:
+        tid = self.current_trial_id()
+        if tid is None:
+            return None
+        with self._lock:
+            return self._restore.get(tid)
+
+
+SESSION = _SessionChannel()
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Any = None) -> None:
+    """In-trial API (parity: ray.tune.report / session.report)."""
+    SESSION.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Any:
+    """In-trial API (parity: session.get_checkpoint) — the checkpoint to
+    resume from, if the trial was restored/exploited."""
+    return SESSION.get_checkpoint()

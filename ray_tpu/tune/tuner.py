@@ -1,0 +1,377 @@
+"""Tuner + TuneController: experiment execution over trial actors.
+
+Parity with the reference's experiment runner (ray: python/ray/tune/
+tuner.py:59 Tuner; tune/execution/tune_controller.py:81 — the event loop
+that starts trial actors, consumes their results, applies scheduler
+decisions, and retries/perturbs; trainable/trainable.py:76 for the class
+Trainable API).  Trials run as actors on the core runtime; resources per
+trial gate concurrency exactly like placement-group-backed trials do in
+the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.core.exceptions import TaskError
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    STOP,
+    FIFOScheduler,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.trial import (
+    ERROR,
+    PENDING,
+    RUNNING,
+    SESSION,
+    TERMINATED,
+    StopTrial,
+    Trial,
+)
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str = "experiment"
+    stop: Optional[Dict[str, float]] = None  # e.g. {"training_iteration": 10}
+
+
+@dataclasses.dataclass
+class Result:
+    config: Dict[str, Any]
+    metrics: Optional[Dict[str, Any]]
+    error: Optional[str]
+    trial_id: str
+    checkpoint: Any = None
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required")
+        scored = [r for r in self._results
+                  if r.metrics and metric in r.metrics]
+        if not scored:
+            raise ValueError("no trial reported the metric")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = {"trial_id": r.trial_id, "error": r.error}
+            row.update({f"config/{k}": v for k, v in r.config.items()})
+            if r.metrics:
+                row.update(r.metrics)
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Trainable:
+    """Class trainable API (parity: tune/trainable/trainable.py:76).
+    Subclass with setup/step/save_checkpoint/load_checkpoint."""
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> Any:
+        return None
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        pass
+
+
+def with_resources(trainable, resources: Dict[str, float]):
+    """Attach per-trial resources (parity: tune.with_resources)."""
+    setattr(trainable, "__tune_resources__", dict(resources))
+    return trainable
+
+
+class _FnTrialRunner:
+    """Actor wrapping a function trainable: runs it to completion on the
+    actor thread; reports stream through the session channel."""
+
+    def run(self, trial_id: str, fn: Callable, config: Dict[str, Any]):
+        SESSION.bind(trial_id)
+        try:
+            fn(config)
+            return "DONE"
+        except StopTrial:
+            return "STOPPED"
+
+
+class _ClassTrialRunner:
+    """Actor wrapping a class trainable: the controller drives step()."""
+
+    def __init__(self, cls: type, config: Dict[str, Any]):
+        self.obj = cls()
+        self.obj.setup(dict(config))
+
+    def step(self) -> Dict[str, Any]:
+        return self.obj.step()
+
+    def save(self) -> Any:
+        return self.obj.save_checkpoint()
+
+    def restore(self, checkpoint: Any) -> None:
+        self.obj.load_checkpoint(checkpoint)
+
+
+class TuneController:
+    """The experiment event loop (parity: tune_controller.py:81)."""
+
+    def __init__(self, trainable, param_space: Dict[str, Any],
+                 tune_config: TuneConfig, run_config: RunConfig):
+        self.trainable = trainable
+        self.param_space = param_space
+        self.cfg = tune_config
+        self.run_cfg = run_config
+        self.scheduler = tune_config.scheduler or FIFOScheduler()
+        self.is_class = isinstance(trainable, type) and issubclass(
+            trainable, Trainable)
+        self.resources = getattr(trainable, "__tune_resources__",
+                                 {"CPU": 1.0})
+        self._counter = itertools.count()
+        self.trials: List[Trial] = []
+        # trial_id -> pending exploit (source_checkpoint, new_config)
+        self._exploits: Dict[str, Any] = {}
+
+    # -- shared ------------------------------------------------------------
+
+    def _make_trials(self):
+        gen = BasicVariantGenerator(self.param_space,
+                                    self.cfg.num_samples, self.cfg.seed)
+        for config in gen:
+            tid = f"trial_{next(self._counter):05d}"
+            self.trials.append(Trial(trial_id=tid, config=config))
+
+    def _hit_stop_criteria(self, result: Dict[str, Any]) -> bool:
+        for key, bound in (self.run_cfg.stop or {}).items():
+            if key in result and result[key] >= bound:
+                return True
+        return False
+
+    def run(self) -> List[Trial]:
+        self._make_trials()
+        if self.is_class:
+            self._run_class_trials()
+        else:
+            self._run_fn_trials()
+        return self.trials
+
+    # -- function trainables ----------------------------------------------
+
+    def _run_fn_trials(self):
+        Runner = ray_tpu.remote(**_actor_opts(self.resources))(_FnTrialRunner)
+        active: List[Trial] = []
+        pending = list(self.trials)
+        fn = self.trainable
+        while pending or active:
+            while pending and len(active) < self.cfg.max_concurrent_trials:
+                trial = pending.pop(0)
+                self._start_fn_trial(trial, Runner, fn)
+                active.append(trial)
+            time.sleep(0.01)
+            for trial in list(active):
+                self._pump_results(trial)
+                done, _ = ray_tpu.wait([trial.run_ref], timeout=0)
+                if done:
+                    self._pump_results(trial)
+                    self._finish_fn_trial(trial)
+                    if trial.trial_id in self._exploits:
+                        ckpt, cfg = self._exploits.pop(trial.trial_id)
+                        trial.config = cfg
+                        trial.restore_from = ckpt
+                        self._start_fn_trial(trial, Runner, fn)
+                    else:
+                        active.remove(trial)
+
+    def _start_fn_trial(self, trial: Trial, Runner, fn):
+        SESSION.register(trial.trial_id, trial.restore_from,
+                         self.run_cfg.stop)
+        trial.actor = Runner.remote()
+        trial.status = RUNNING
+        trial.run_ref = trial.actor.run.remote(trial.trial_id, fn,
+                                               trial.config)
+
+    def _finish_fn_trial(self, trial: Trial):
+        try:
+            ray_tpu.get(trial.run_ref)
+            trial.status = TERMINATED
+        except TaskError as e:
+            trial.status = ERROR
+            trial.error = str(e)
+        finally:
+            SESSION.unregister(trial.trial_id)
+            ray_tpu.kill(trial.actor)
+            trial.actor = None
+
+    def _pump_results(self, trial: Trial):
+        for item in SESSION.drain(trial.trial_id):
+            metrics = item["metrics"]
+            metrics.setdefault("training_iteration", len(trial.results) + 1)
+            trial.results.append(metrics)
+            if item["checkpoint"] is not None:
+                trial.checkpoint = item["checkpoint"]
+            decision = self.scheduler.on_result(trial, metrics, self.trials)
+            if self._hit_stop_criteria(metrics):
+                decision = STOP
+            if decision == STOP:
+                SESSION.request_stop(trial.trial_id)
+            elif decision == "EXPLOIT":
+                target = self.scheduler.exploit_target(trial, self.trials)
+                if target is not None:
+                    source, new_config = target
+                    self._exploits[trial.trial_id] = (
+                        source.checkpoint, new_config)
+                    SESSION.request_stop(trial.trial_id)
+
+    # -- class trainables --------------------------------------------------
+
+    def _run_class_trials(self):
+        Runner = ray_tpu.remote(**_actor_opts(self.resources))(
+            _ClassTrialRunner)
+        active: List[Trial] = []
+        pending = list(self.trials)
+        step_refs: Dict[str, Any] = {}
+        while pending or active:
+            while pending and len(active) < self.cfg.max_concurrent_trials:
+                trial = pending.pop(0)
+                trial.actor = Runner.remote(self.trainable, trial.config)
+                trial.status = RUNNING
+                step_refs[trial.trial_id] = trial.actor.step.remote()
+                active.append(trial)
+            time.sleep(0.005)
+            for trial in list(active):
+                ref = step_refs.get(trial.trial_id)
+                done, _ = ray_tpu.wait([ref], timeout=0)
+                if not done:
+                    continue
+                try:
+                    metrics = ray_tpu.get(ref)
+                except TaskError as e:
+                    trial.status = ERROR
+                    trial.error = str(e)
+                    ray_tpu.kill(trial.actor)
+                    active.remove(trial)
+                    step_refs.pop(trial.trial_id, None)
+                    continue
+                metrics.setdefault("training_iteration",
+                                   len(trial.results) + 1)
+                trial.results.append(metrics)
+                trial.checkpoint = ray_tpu.get(trial.actor.save.remote())
+                decision = self.scheduler.on_result(trial, metrics,
+                                                    self.trials)
+                if self._hit_stop_criteria(metrics):
+                    decision = STOP
+                if decision == "EXPLOIT":
+                    target = self.scheduler.exploit_target(trial, self.trials)
+                    if target is not None:
+                        source, new_config = target
+                        ray_tpu.kill(trial.actor)
+                        trial.config = new_config
+                        trial.actor = Runner.remote(self.trainable,
+                                                    new_config)
+                        if source.checkpoint is not None:
+                            ray_tpu.get(trial.actor.restore.remote(
+                                source.checkpoint))
+                        step_refs[trial.trial_id] = \
+                            trial.actor.step.remote()
+                        continue
+                    decision = CONTINUE
+                if decision == STOP:
+                    trial.status = TERMINATED
+                    ray_tpu.kill(trial.actor)
+                    active.remove(trial)
+                    step_refs.pop(trial.trial_id, None)
+                else:
+                    step_refs[trial.trial_id] = trial.actor.step.remote()
+
+
+def _actor_opts(resources: Dict[str, float]) -> Dict[str, Any]:
+    opts: Dict[str, Any] = {}
+    res = dict(resources)
+    opts["num_cpus"] = float(res.pop("CPU", 1.0))
+    if "TPU" in res:
+        opts["num_tpus"] = float(res.pop("TPU"))
+    if res:
+        opts["resources"] = res
+    return opts
+
+
+class Tuner:
+    """Public entry (parity: tune/tuner.py:59)."""
+
+    def __init__(self, trainable, *, param_space: Optional[Dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        controller = TuneController(self.trainable, self.param_space,
+                                    self.tune_config, self.run_config)
+        trials = controller.run()
+        results = [
+            Result(config=t.config, metrics=t.last_result(), error=t.error,
+                   trial_id=t.trial_id, checkpoint=t.checkpoint)
+            for t in trials
+        ]
+        return ResultGrid(results, self.tune_config.metric,
+                          self.tune_config.mode)
+
+
+def run(trainable, *, param_space: Optional[Dict] = None,
+        num_samples: int = 1, metric: Optional[str] = None,
+        mode: str = "max", scheduler: Optional[TrialScheduler] = None,
+        stop: Optional[Dict[str, float]] = None,
+        max_concurrent_trials: int = 4) -> ResultGrid:
+    """Functional entry (parity: tune.run, tune/tune.py:293)."""
+    return Tuner(
+        trainable,
+        param_space=param_space,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples,
+                               scheduler=scheduler,
+                               max_concurrent_trials=max_concurrent_trials),
+        run_config=RunConfig(stop=stop),
+    ).fit()
