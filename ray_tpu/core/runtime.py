@@ -203,8 +203,13 @@ class _PendingTask:
 
 
 class _ActorShell:
-    """Server side of one actor: instance + ordered execution thread
-    (parity: ActorSchedulingQueue ordering guarantee)."""
+    """Server side of one actor: instance + execution thread(s).
+
+    max_concurrency == 1 (default): one thread drains the queue in
+    submission order (parity: ActorSchedulingQueue ordering guarantee).
+    max_concurrency > 1: a pool of threads drains the same queue and
+    ordering is NOT guaranteed (parity: threaded actors via
+    BoundedExecutor, core_worker/transport/thread_pool.cc)."""
 
     def __init__(self, runtime: "LocalRuntime", actor_id: ActorID, cls: type,
                  args: tuple, kwargs: dict, options: ActorOptions,
@@ -255,10 +260,33 @@ class _ActorShell:
             )
             self.runtime._on_actor_death(self)
             return
+        # max_concurrency > 1: a pool of threads drains the same queue, so
+        # blocking calls (long-polls, slow requests) don't serialize
+        # (parity: threaded actors via BoundedExecutor,
+        # core_worker/transport/thread_pool.cc — ordering is only
+        # guaranteed for max_concurrency == 1, as in the reference).
+        n = max(1, int(self.options.max_concurrency))
+        extra = [
+            threading.Thread(
+                target=self._serve_loop, daemon=True,
+                name=f"actor-{self.actor_id.hex()[:8]}-c{i + 1}",
+            )
+            for i in range(n - 1)
+        ]
+        for t in extra:
+            t.start()
+        self._serve_loop()
+        for t in extra:
+            t.join()
+        self._drain(ActorDiedError(repr(self.cls), self.death_reason or "killed"))
+        self.runtime._on_actor_death(self)
+
+    def _serve_loop(self):
         while True:
             item = self.queue.get()
-            if item is None:  # kill signal
-                break
+            if item is None:  # kill signal — re-post so sibling threads stop
+                self.queue.put(None)
+                return
             method_name, args, kwargs, return_ids, num_returns = item
             try:
                 resolved_args, resolved_kwargs = self.runtime.resolve_args(
@@ -278,12 +306,11 @@ class _ActorShell:
                 for oid in return_ids:
                     self.runtime.store.put_error(oid, err)
                 if not isinstance(e, Exception):
-                    # actor thread dies on SystemExit et al
+                    # actor dies on SystemExit et al
                     self.dead = True
                     self.death_reason = repr(e)
-                    break
-        self._drain(ActorDiedError(repr(self.cls), self.death_reason or "killed"))
-        self.runtime._on_actor_death(self)
+                    self.queue.put(None)
+                    return
 
     def _drain(self, err: BaseException):
         while True:

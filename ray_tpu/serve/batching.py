@@ -1,0 +1,105 @@
+"""@serve.batch — dynamic request batching.
+
+Parity with the reference (ray: python/ray/serve/batching.py — @serve.batch
+:65, _BatchQueue:337): concurrent callers' single items are grouped into
+one call of the wrapped function (which takes a list and returns a list
+of equal length).  Effective with max_ongoing_requests > 1 so several
+requests are in the replica simultaneously.
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable[[List[Any]], List[Any]],
+                 max_batch_size: int, batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait = batch_wait_timeout_s
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"batch-{getattr(fn, '__name__', 'fn')}",
+        )
+        self._thread.start()
+
+    def submit(self, item: Any) -> Future:
+        fut: Future = Future()
+        self._q.put((item, fut))
+        return fut
+
+    def _loop(self):
+        while True:
+            item, fut = self._q.get()
+            batch = [(item, fut)]
+            # Wait up to batch_wait_timeout_s to fill the batch
+            # (parity: _BatchQueue wait loop).
+            import time
+
+            deadline = time.monotonic() + self._wait
+            while len(batch) < self._max:
+                remaining = deadline - time.monotonic()
+                try:
+                    batch.append(
+                        self._q.get(timeout=max(0.0, remaining))
+                        if remaining > 0 else self._q.get_nowait()
+                    )
+                except queue.Empty:
+                    break
+            items = [b[0] for b in batch]
+            try:
+                results = self._fn(items)
+                if len(results) != len(items):
+                    raise ValueError(
+                        f"batched function returned {len(results)} results "
+                        f"for {len(items)} inputs"
+                    )
+                for (_, f), r in zip(batch, results):
+                    f.set_result(r)
+            except Exception as e:
+                for _, f in batch:
+                    f.set_exception(e)
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: the wrapped fn must take a list of items; callers pass
+    one item and block for their element of the result."""
+
+    def wrap(fn: Callable):
+        queues: dict = {}
+        lock = threading.Lock()
+
+        @functools.wraps(fn)
+        def wrapper(*call_args):
+            # Support bound methods: (self, item) or plain (item,).
+            if len(call_args) == 2:
+                owner, item = call_args
+                bound = functools.partial(fn, owner)
+                key = id(owner)
+            elif len(call_args) == 1:
+                item = call_args[0]
+                bound = fn
+                key = None
+            else:
+                raise TypeError("@serve.batch functions take a single item")
+            with lock:
+                bq = queues.get(key)
+                if bq is None:
+                    bq = queues[key] = _BatchQueue(
+                        bound, max_batch_size, batch_wait_timeout_s
+                    )
+            return bq.submit(item).result()
+
+        wrapper._is_serve_batch = True  # type: ignore[attr-defined]
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

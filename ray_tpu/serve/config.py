@@ -1,0 +1,65 @@
+"""Serve configuration dataclasses.
+
+Parity with the reference (ray: python/ray/serve/config.py
+``AutoscalingConfig``/``DeploymentConfig``; schema objects
+python/ray/serve/schema.py).  Kept as plain dataclasses — declarative
+YAML can be layered on top by parsing into these.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalingConfig:
+    """Queue-length-driven autoscaling (parity: ray
+    serve/_private/autoscaling_policy.py + serve/config.py
+    AutoscalingConfig)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    # How often replicas push their ongoing-request count to the controller.
+    metrics_interval_s: float = 0.2
+    # Average the pushed metrics over this trailing window.
+    look_back_period_s: float = 2.0
+    # A scale decision must hold for this long before it is applied.
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+    def __post_init__(self):
+        if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
+            raise ValueError(
+                f"need 0 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}/{self.max_replicas}"
+            )
+        if self.target_ongoing_requests <= 0:
+            raise ValueError("target_ongoing_requests must be positive")
+
+
+@dataclasses.dataclass(frozen=True)
+class DeploymentConfig:
+    """Per-deployment knobs (parity: ray serve/config.py DeploymentConfig)."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    user_config: Optional[Any] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 5.0
+    graceful_shutdown_timeout_s: float = 5.0
+    # Resources for each replica actor (parity: ray_actor_options).
+    ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.num_replicas < 0:
+            raise ValueError("num_replicas must be >= 0")
+        if self.max_ongoing_requests < 1:
+            raise ValueError("max_ongoing_requests must be >= 1")
+
+    def initial_target_replicas(self) -> int:
+        if self.autoscaling_config is not None:
+            return max(self.autoscaling_config.min_replicas, 1)
+        return self.num_replicas

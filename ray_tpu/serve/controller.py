@@ -1,0 +1,363 @@
+"""Serve controller: deployment reconciliation, health, autoscaling.
+
+Parity with the reference (ray: python/ray/serve/controller.py —
+ServeController:80; serve/_private/deployment_state.py —
+DeploymentState:1155, DeploymentStateManager:2258; application
+lifecycle serve/_private/application_state.py; autoscaling
+serve/_private/autoscaling_policy.py).  A single named actor owns all
+target state and runs a reconcile loop: start/stop/replace replica
+actors until the running set matches the target, health-check them,
+and broadcast routing tables over long-poll.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import api
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.deployment import DeploymentInfo
+from ray_tpu.serve.long_poll import LongPollHost
+from ray_tpu.serve.replica import ReplicaActor
+
+CONTROLLER_NAME = "serve::controller"
+ROUTES_KEY = "routes"
+
+RECONCILE_PERIOD_S = 0.05
+
+
+def replica_set_key(app_name: str, deployment_name: str) -> str:
+    return f"replicas::{app_name}::{deployment_name}"
+
+
+class _Replica:
+    def __init__(self, replica_id: str, handle, creation_ref):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.creation_ref = creation_ref
+        self.state = "STARTING"  # STARTING | RUNNING | STOPPING
+        self.health_ref = None
+        self.last_health_check = time.monotonic()
+
+
+class _DeploymentState:
+    """Target + running state for one deployment (parity:
+    serve/_private/deployment_state.py DeploymentState)."""
+
+    def __init__(self, app_name: str, info: DeploymentInfo):
+        self.app_name = app_name
+        self.info = info
+        self.target_replicas = info.config.initial_target_replicas()
+        self.replicas: Dict[str, _Replica] = {}
+        self.next_replica_idx = 0
+        self.deleting = False
+        # autoscaling bookkeeping
+        self.metrics: Dict[str, Tuple[float, float]] = {}  # id -> (ts, ongoing)
+        self._scale_intent: Optional[Tuple[int, float]] = None
+
+    @property
+    def config(self) -> DeploymentConfig:
+        return self.info.config
+
+    def apply_new_info(self, info: DeploymentInfo) -> None:
+        """Code or config update: lightweight path for user_config-only
+        changes, full rolling replace otherwise."""
+        old = self.info
+        self.info = info
+        self.target_replicas = info.config.initial_target_replicas()
+        same_code = (
+            old.func_or_class is info.func_or_class
+            and old.init_args == info.init_args
+            and old.init_kwargs == info.init_kwargs
+        )
+        if same_code and old.config.user_config != info.config.user_config:
+            for r in self.replicas.values():
+                if r.state == "RUNNING":
+                    r.handle.reconfigure.remote(info.config.user_config)
+        elif not same_code:
+            # Replace everything; reconcile restarts at the new version.
+            for r in self.replicas.values():
+                r.state = "STOPPING"
+
+    # -- autoscaling -------------------------------------------------------
+
+    def record_metric(self, replica_id: str, ongoing: float, ts: float):
+        self.metrics[replica_id] = (ts, ongoing)
+
+    def autoscale(self, now: float) -> None:
+        cfg = self.config.autoscaling_config
+        if cfg is None or self.deleting:
+            return
+        running = [r for r in self.replicas.values() if r.state == "RUNNING"]
+        if not running:
+            return
+        cutoff = now - cfg.look_back_period_s
+        total = 0.0
+        for r in running:
+            m = self.metrics.get(r.replica_id)
+            if m is not None and m[0] >= cutoff:
+                total += m[1]
+        desired = math.ceil(total / cfg.target_ongoing_requests)
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        current = self.target_replicas
+        if desired == current:
+            self._scale_intent = None
+            return
+        delay = (cfg.upscale_delay_s if desired > current
+                 else cfg.downscale_delay_s)
+        if self._scale_intent is None or (
+            (self._scale_intent[0] > current) != (desired > current)
+        ):
+            self._scale_intent = (desired, now)
+            return
+        if now - self._scale_intent[1] >= delay:
+            self.target_replicas = desired
+            self._scale_intent = None
+
+
+class ServeController:
+    """The singleton control-plane actor."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._host = LongPollHost()
+        self._deployments: Dict[Tuple[str, str], _DeploymentState] = {}
+        self._routes: Dict[str, Tuple[str, str]] = {}  # prefix -> (app, ingress)
+        self._app_ingress: Dict[str, str] = {}
+        self._shutdown = threading.Event()
+        threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile"
+        ).start()
+
+    # -- API ---------------------------------------------------------------
+
+    def deploy_application(self, app_name: str, infos: List[DeploymentInfo],
+                           route_prefix: Optional[str]) -> None:
+        with self._lock:
+            new_names = {i.name for i in infos}
+            for (app, dep), st in list(self._deployments.items()):
+                if app == app_name and dep not in new_names:
+                    st.deleting = True
+                    st.target_replicas = 0
+            for info in infos:
+                key = (app_name, info.name)
+                st = self._deployments.get(key)
+                if st is None or st.deleting:
+                    self._deployments[key] = _DeploymentState(app_name, info)
+                else:
+                    st.apply_new_info(info)
+                if info.is_ingress:
+                    self._app_ingress[app_name] = info.name
+            if route_prefix is not None:
+                self._routes = {
+                    p: t for p, t in self._routes.items() if t[0] != app_name
+                }
+                self._routes[route_prefix] = (
+                    app_name, self._app_ingress[app_name]
+                )
+                self._host.notify_changed(ROUTES_KEY, dict(self._routes))
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            for (app, _), st in self._deployments.items():
+                if app == app_name:
+                    st.deleting = True
+                    st.target_replicas = 0
+            self._routes = {
+                p: t for p, t in self._routes.items() if t[0] != app_name
+            }
+            self._host.notify_changed(ROUTES_KEY, dict(self._routes))
+
+    def get_ingress(self, app_name: str) -> str:
+        with self._lock:
+            name = self._app_ingress.get(app_name)
+        if name is None:
+            raise ValueError(f"no application named {app_name!r}")
+        return name
+
+    def long_poll(self, keys_to_ids: Dict[str, int]):
+        # Short server-side timeout; clients immediately re-poll
+        # (parity: LongPollHost listen_for_change timeout).
+        return self._host.listen(keys_to_ids, timeout=1.0)
+
+    def record_autoscaling_metric(self, app_name: str, deployment_name: str,
+                                  replica_id: str, ongoing: float,
+                                  ts: float) -> None:
+        with self._lock:
+            st = self._deployments.get((app_name, deployment_name))
+            if st is not None:
+                st.record_metric(replica_id, ongoing, ts)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {"applications": {}}
+            for (app, dep), st in self._deployments.items():
+                a = out["applications"].setdefault(
+                    app, {"deployments": {}, "ingress": self._app_ingress.get(app)}
+                )
+                running = sum(
+                    1 for r in st.replicas.values() if r.state == "RUNNING"
+                )
+                a["deployments"][dep] = {
+                    "target_replicas": st.target_replicas,
+                    "running_replicas": running,
+                    "status": (
+                        "DELETING" if st.deleting
+                        else "HEALTHY" if running >= st.target_replicas
+                        else "UPDATING"
+                    ),
+                }
+            return out
+
+    def get_routes(self) -> Dict[str, Tuple[str, str]]:
+        with self._lock:
+            return dict(self._routes)
+
+    def graceful_shutdown(self) -> None:
+        with self._lock:
+            for st in self._deployments.values():
+                st.deleting = True
+                st.target_replicas = 0
+
+    def _num_live(self) -> int:
+        with self._lock:
+            return sum(len(st.replicas) for st in self._deployments.values())
+
+    def wait_for_drained(self, timeout_s: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._num_live() == 0:
+                return True
+            time.sleep(0.02)
+        return self._num_live() == 0
+
+    # -- reconcile ---------------------------------------------------------
+
+    def _reconcile_loop(self):
+        while not self._shutdown.wait(RECONCILE_PERIOD_S):
+            try:
+                self._reconcile_once()
+            except Exception:
+                pass
+
+    def _reconcile_once(self):
+        now = time.monotonic()
+        with self._lock:
+            states = list(self._deployments.items())
+        for key, st in states:
+            with self._lock:
+                st.autoscale(now)
+                self._check_started(st)
+                self._check_health(st, now)
+                changed = self._scale(st)
+                if st.deleting and not st.replicas:
+                    self._deployments.pop(key, None)
+                    self._host.drop_key(replica_set_key(st.app_name, st.info.name))
+                    changed = False
+            if changed:
+                self._broadcast(st)
+
+    def _check_started(self, st: _DeploymentState):
+        rt = api.runtime()
+        for r in st.replicas.values():
+            if r.state == "STARTING" and rt.store.contains(r.creation_ref.id):
+                try:
+                    api.get(r.creation_ref)
+                    r.state = "RUNNING"
+                except Exception:
+                    r.state = "STOPPING"  # constructor failed → replace
+
+    def _check_health(self, st: _DeploymentState, now: float):
+        rt = api.runtime()
+        for r in st.replicas.values():
+            if r.state != "RUNNING":
+                continue
+            if r.health_ref is not None and rt.store.contains(r.health_ref.id):
+                try:
+                    api.get(r.health_ref)
+                except Exception:
+                    r.state = "STOPPING"  # unhealthy → replace
+                r.health_ref = None
+            elif (r.health_ref is None
+                  and now - r.last_health_check
+                  >= st.config.health_check_period_s):
+                r.last_health_check = now
+                r.health_ref = r.handle.check_health.remote()
+
+    def _scale(self, st: _DeploymentState) -> bool:
+        changed = False
+        # Stop replicas marked STOPPING, and excess RUNNING ones.
+        running = [r for r in st.replicas.values() if r.state == "RUNNING"]
+        excess = len(running) + sum(
+            1 for r in st.replicas.values() if r.state == "STARTING"
+        ) - st.target_replicas
+        for r in sorted(running, key=lambda r: r.replica_id, reverse=True):
+            if excess <= 0:
+                break
+            r.state = "STOPPING"
+            excess -= 1
+        for r in list(st.replicas.values()):
+            if r.state == "STOPPING":
+                self._stop_replica(st, r)
+                changed = True
+        # Start missing replicas.
+        live = [r for r in st.replicas.values()
+                if r.state in ("STARTING", "RUNNING")]
+        missing = st.target_replicas - len(live)
+        for _ in range(max(0, missing)):
+            self._start_replica(st)
+            changed = True
+        # Newly RUNNING replicas also need a broadcast.
+        if any(r.state == "RUNNING" and not getattr(r, "_announced", False)
+               for r in st.replicas.values()):
+            changed = True
+        return changed
+
+    def _start_replica(self, st: _DeploymentState):
+        idx = st.next_replica_idx
+        st.next_replica_idx += 1
+        replica_id = f"{st.app_name}#{st.info.name}#{idx}"
+        opts = dict(st.config.ray_actor_options)
+        opts.setdefault("num_cpus", 0.1)
+        cfg = st.config
+        metrics_interval = (
+            cfg.autoscaling_config.metrics_interval_s
+            if cfg.autoscaling_config else 0.0
+        )
+        actor_cls = api.remote(ReplicaActor)
+        handle = actor_cls.options(
+            max_concurrency=cfg.max_ongoing_requests + 4, **opts
+        ).remote(
+            st.app_name, st.info.name, replica_id, st.info.func_or_class,
+            st.info.init_args, st.info.init_kwargs, cfg.user_config,
+            metrics_interval,
+        )
+        st.replicas[replica_id] = _Replica(
+            replica_id, handle, handle._creation_ref
+        )
+
+    def _stop_replica(self, st: _DeploymentState, r: _Replica):
+        try:
+            r.handle.prepare_for_shutdown.remote(
+                st.config.graceful_shutdown_timeout_s
+            )
+            api.kill(r.handle, no_restart=True)
+        except Exception:
+            pass
+        st.replicas.pop(r.replica_id, None)
+        st.metrics.pop(r.replica_id, None)
+
+    def _broadcast(self, st: _DeploymentState):
+        table = []
+        for r in st.replicas.values():
+            if r.state == "RUNNING":
+                r._announced = True
+                table.append(
+                    (r.replica_id, r.handle, st.config.max_ongoing_requests)
+                )
+        self._host.notify_changed(
+            replica_set_key(st.app_name, st.info.name), table
+        )

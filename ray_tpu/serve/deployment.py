@@ -1,0 +1,157 @@
+"""@deployment decorator, Deployment, and Application graphs.
+
+Parity with the reference (ray: python/ray/serve/deployment.py
+``Deployment``/``Application``; api.py ``@serve.deployment:...``).
+``D.bind(*args)`` builds a lazy application graph; args that are
+themselves Applications become DeploymentHandles at deploy time
+(parity: serve/_private/deployment_graph_build.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Deployment:
+    """An un-deployed template: callable + config."""
+
+    func_or_class: Callable
+    name: str
+    config: DeploymentConfig
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def options(self, **overrides) -> "Deployment":
+        """Copy with config overrides, e.g. ``D.options(num_replicas=3)``."""
+        name = overrides.pop("name", self.name)
+        cfg_fields = {f.name for f in dataclasses.fields(DeploymentConfig)}
+        bad = set(overrides) - cfg_fields
+        if bad:
+            raise ValueError(f"unknown deployment option(s): {sorted(bad)}")
+        return Deployment(
+            self.func_or_class, name,
+            dataclasses.replace(self.config, **overrides),
+        )
+
+
+class Application:
+    """A bound deployment graph node (parity: serve Application)."""
+
+    def __init__(self, deployment: Deployment, init_args: tuple,
+                 init_kwargs: dict):
+        self.deployment = deployment
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+
+
+def deployment(
+    _func_or_class: Optional[Callable] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Optional[int] = None,
+    max_ongoing_requests: int = 16,
+    user_config: Optional[Any] = None,
+    autoscaling_config: Optional[AutoscalingConfig] = None,
+    health_check_period_s: float = 1.0,
+    graceful_shutdown_timeout_s: float = 5.0,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+) -> Any:
+    """``@serve.deployment`` (parity: ray serve/api.py deployment:...)."""
+    if isinstance(autoscaling_config, dict):
+        autoscaling_config = AutoscalingConfig(**autoscaling_config)
+    if num_replicas is not None and autoscaling_config is not None:
+        raise ValueError(
+            "num_replicas and autoscaling_config are mutually exclusive"
+        )
+
+    def wrap(target: Callable) -> Deployment:
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas if num_replicas is not None else 1,
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config,
+            autoscaling_config=autoscaling_config,
+            health_check_period_s=health_check_period_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            ray_actor_options=dict(ray_actor_options or {}),
+        )
+        return Deployment(target, name or target.__name__, cfg)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+@dataclasses.dataclass
+class DeploymentInfo:
+    """Flattened node of an application graph, ready for the controller."""
+
+    name: str
+    func_or_class: Callable
+    config: DeploymentConfig
+    init_args: tuple
+    init_kwargs: dict
+    is_ingress: bool = False
+
+
+def build_application(app: Application, app_name: str) -> List[DeploymentInfo]:
+    """Flatten an Application graph into deployment infos.
+
+    Nested Applications in init args/kwargs are replaced with
+    ``_HandlePlaceholder``s, resolved into live DeploymentHandles inside
+    each replica (parity: serve/_private/deployment_graph_build.py).
+    """
+    infos: Dict[int, DeploymentInfo] = {}
+    names_seen: Dict[str, int] = {}
+
+    def visit(node: Application) -> "_HandlePlaceholder":
+        key = id(node)
+        if key not in infos:
+            name = node.deployment.name
+            if name in names_seen and names_seen[name] != key:
+                raise ValueError(
+                    f"duplicate deployment name {name!r} in application "
+                    f"{app_name!r} — use .options(name=...) to disambiguate"
+                )
+            names_seen[name] = key
+            # Reserve the slot first so diamond graphs terminate.
+            infos[key] = None  # type: ignore[assignment]
+            args = tuple(_replace(a, visit) for a in node.init_args)
+            kwargs = {k: _replace(v, visit) for k, v in node.init_kwargs.items()}
+            infos[key] = DeploymentInfo(
+                name=name,
+                func_or_class=node.deployment.func_or_class,
+                config=node.deployment.config,
+                init_args=args,
+                init_kwargs=kwargs,
+            )
+        return _HandlePlaceholder(node.deployment.name, app_name)
+
+    visit(app)
+    out = [i for i in infos.values() if i is not None]
+    out[0].is_ingress = True
+    return out
+
+
+def _replace(value: Any, visit: Callable) -> Any:
+    if isinstance(value, Application):
+        return visit(value)
+    if isinstance(value, (list, tuple)):
+        t = type(value)
+        return t(_replace(v, visit) for v in value)
+    if isinstance(value, dict):
+        return {k: _replace(v, visit) for k, v in value.items()}
+    return value
+
+
+@dataclasses.dataclass(frozen=True)
+class _HandlePlaceholder:
+    """Marker swapped for a DeploymentHandle when the replica constructs
+    its user callable."""
+
+    deployment_name: str
+    app_name: str

@@ -1,0 +1,104 @@
+"""DeploymentHandle / DeploymentResponse — the composition API.
+
+Parity with the reference (ray: python/ray/serve/handle.py —
+DeploymentHandle:297, DeploymentResponse:795): ``handle.remote(...)``
+returns a response future; responses can be passed straight into other
+handles' ``.remote(...)`` calls (the downstream replica receives the
+resolved value), mirroring model-composition graphs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.core import api
+from ray_tpu.core.object_ref import ObjectRef
+
+_routers_lock = threading.Lock()
+_routers: Dict[Tuple[str, str], Any] = {}
+
+
+def _router_for(app_name: str, deployment_name: str):
+    from ray_tpu.serve.router import Router
+
+    key = (app_name, deployment_name)
+    with _routers_lock:
+        r = _routers.get(key)
+        if r is None:
+            r = _routers[key] = Router(app_name, deployment_name)
+        return r
+
+
+def _shutdown_routers() -> None:
+    with _routers_lock:
+        for r in _routers.values():
+            r.stop()
+        _routers.clear()
+
+
+class DeploymentResponse:
+    """Future for one request (parity: serve DeploymentResponse)."""
+
+    def __init__(self, ref: ObjectRef):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return api.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self) -> ObjectRef:
+        return self._ref
+
+    def __reduce__(self):
+        # A response travels as its underlying ref; the runtime resolves
+        # refs in task args, so downstream replicas see the value.
+        return (DeploymentResponse, (self._ref,))
+
+
+class DeploymentHandle:
+    """Client-side handle to a deployment (one router per process per
+    deployment, shared across handle copies)."""
+
+    def __init__(self, deployment_name: str, app_name: str,
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+
+    def options(self, *, method_name: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name or self._method_name,
+        )
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        # handle.method.remote(...) sugar (parity: handle method access)
+        return DeploymentHandle(self.deployment_name, self.app_name, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        args = tuple(self._unwrap(a) for a in args)
+        kwargs = {k: self._unwrap(v) for k, v in kwargs.items()}
+        router = _router_for(self.app_name, self.deployment_name)
+        ref, _ = router.assign(self._method_name, args, kwargs)
+        return DeploymentResponse(ref)
+
+    @staticmethod
+    def _unwrap(value: Any) -> Any:
+        # Pass the underlying ref; the actor runtime resolves refs in args
+        # before execution (parity: response-to-upstream-arg resolution).
+        if isinstance(value, DeploymentResponse):
+            return value._to_object_ref()
+        return value
+
+    def __repr__(self):
+        return (f"DeploymentHandle({self.app_name}/{self.deployment_name}"
+                f".{self._method_name})")
+
+    def __reduce__(self):
+        return (
+            DeploymentHandle,
+            (self.deployment_name, self.app_name, self._method_name),
+        )

@@ -1,0 +1,122 @@
+"""HTTP proxy: route-prefix matching onto deployment handles.
+
+Parity with the reference (ray: python/ray/serve/_private/proxy.py —
+HTTPProxy:912 over uvicorn; route matching proxy_router.py).  The
+reference runs one proxy actor per node with an ASGI server; here a
+threaded stdlib HTTP server fronts the same router/handle path (the
+data plane past the socket is identical), keeping the image free of
+server dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.core import api
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.long_poll import LongPollClient
+
+
+class HTTPProxy:
+    """Routes ``POST <route_prefix>`` to the app's ingress deployment.
+
+    Body: JSON → passed as a dict (or raw string if not JSON).
+    Response: JSON-encoded result.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._routes: Dict[str, Tuple[str, str]] = {}
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._lock = threading.Lock()
+        self._subscribe()
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence per-request stderr noise
+                pass
+
+            def do_GET(self):
+                if self.path == "/-/routes":
+                    body = json.dumps(
+                        {p: f"{a}:{d}" for p, (a, d) in proxy._routes.items()}
+                    ).encode()
+                    self._reply(200, body)
+                elif self.path == "/-/healthz":
+                    self._reply(200, b'"ok"')
+                else:
+                    self._handle(b"")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self._handle(self.rfile.read(n))
+
+            def _handle(self, raw: bytes):
+                handle = proxy._match(self.path)
+                if handle is None:
+                    self._reply(404, json.dumps(
+                        {"error": f"no route for {self.path}"}
+                    ).encode())
+                    return
+                try:
+                    payload: Any = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    payload = raw.decode()
+                try:
+                    result = handle.remote(payload).result(timeout_s=60.0)
+                    self._reply(200, json.dumps(result).encode())
+                except Exception as e:
+                    self._reply(500, json.dumps({"error": repr(e)}).encode())
+
+            def _reply(self, code: int, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="http-proxy"
+        )
+        self._thread.start()
+
+    def _subscribe(self):
+        from ray_tpu.serve.controller import CONTROLLER_NAME, ROUTES_KEY
+
+        controller = api.get_actor(CONTROLLER_NAME)
+
+        def listen(seen):
+            return api.get(controller.long_poll.remote(seen))
+
+        def update(routes: Dict[str, Tuple[str, str]]):
+            with self._lock:
+                self._routes = dict(routes)
+                self._handles = {
+                    prefix: DeploymentHandle(dep, app)
+                    for prefix, (app, dep) in routes.items()
+                }
+
+        self._client = LongPollClient(listen, {ROUTES_KEY: update})
+        # Seed synchronously so requests right after startup route.
+        update(api.get(controller.get_routes.remote()))
+
+    def _match(self, path: str) -> Optional[DeploymentHandle]:
+        with self._lock:
+            best = None
+            for prefix in self._handles:
+                norm = prefix.rstrip("/") or "/"
+                if path == norm or path.startswith(
+                    norm if norm.endswith("/") else norm + "/"
+                ) or norm == "/":
+                    if best is None or len(norm) > len(best):
+                        best = prefix
+            return self._handles.get(best) if best is not None else None
+
+    def shutdown(self):
+        self._client.stop()
+        self._server.shutdown()
+        self._server.server_close()

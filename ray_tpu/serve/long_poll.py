@@ -1,0 +1,98 @@
+"""Long-poll config push: controller -> routers/proxies.
+
+Parity with the reference (ray: python/ray/serve/_private/long_poll.py —
+LongPollHost:172, LongPollClient:63): the host keeps a monotonically
+increasing snapshot id per key; clients block in ``listen`` with the ids
+they have seen, and are woken with only the keys that changed.  This is
+how routing tables reach every handle without polling.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Tuple
+
+# Sentinel returned when a listen times out with no changes.
+LISTEN_TIMEOUT = "__listen_timeout__"
+
+
+class LongPollHost:
+    """Lives inside the Serve controller actor."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._snapshots: Dict[str, Tuple[int, Any]] = {}
+        self._next_id = 1
+
+    def notify_changed(self, key: str, value: Any) -> None:
+        with self._cv:
+            self._snapshots[key] = (self._next_id, value)
+            self._next_id += 1
+            self._cv.notify_all()
+
+    def drop_key(self, key: str) -> None:
+        with self._cv:
+            self._snapshots.pop(key, None)
+
+    def listen(self, keys_to_ids: Dict[str, int],
+               timeout: float = 30.0) -> Dict[str, Tuple[int, Any]]:
+        """Block until any subscribed key's snapshot id advances past the
+        caller's; return {key: (new_id, value)} for the changed keys."""
+
+        def changed() -> Dict[str, Tuple[int, Any]]:
+            out = {}
+            for key, seen in keys_to_ids.items():
+                snap = self._snapshots.get(key)
+                if snap is not None and snap[0] > seen:
+                    out[key] = snap
+            return out
+
+        with self._cv:
+            updates = changed()
+            if updates:
+                return updates
+            self._cv.wait(timeout)
+            return changed()
+
+
+class LongPollClient:
+    """Background listener attached to a router/proxy.
+
+    ``callbacks`` maps key -> fn(value); each is invoked with the initial
+    snapshot (if any) and then on every change.
+    """
+
+    def __init__(self, listen_fn: Callable[[Dict[str, int]], Dict],
+                 callbacks: Dict[str, Callable[[Any], None]]):
+        self._listen_fn = listen_fn
+        self._callbacks = dict(callbacks)
+        self._seen: Dict[str, int] = {k: 0 for k in callbacks}
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="long-poll-client"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                updates = self._listen_fn(dict(self._seen))
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                self._stopped.wait(0.1)
+                continue
+            if not updates:
+                continue
+            for key, (snap_id, value) in updates.items():
+                self._seen[key] = snap_id
+                cb = self._callbacks.get(key)
+                if cb is not None and not self._stopped.is_set():
+                    try:
+                        cb(value)
+                    except Exception:
+                        pass

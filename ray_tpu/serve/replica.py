@@ -1,0 +1,154 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Parity with the reference (ray: python/ray/serve/_private/replica.py —
+RayServeReplica:494): constructs the user class, counts ongoing
+requests, pushes autoscaling metrics to the controller, supports
+``reconfigure(user_config)`` and user-defined ``check_health``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.deployment import _HandlePlaceholder
+
+
+def _resolve_placeholders(value: Any) -> Any:
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    if isinstance(value, _HandlePlaceholder):
+        return DeploymentHandle(value.deployment_name, value.app_name)
+    if isinstance(value, (list, tuple)):
+        return type(value)(_resolve_placeholders(v) for v in value)
+    if isinstance(value, dict):
+        return {k: _resolve_placeholders(v) for k, v in value.items()}
+    return value
+
+
+class ReplicaActor:
+    """The actor class every deployment replica runs as."""
+
+    def __init__(self, app_name: str, deployment_name: str, replica_id: str,
+                 func_or_class: Any, init_args: tuple, init_kwargs: dict,
+                 user_config: Any, metrics_interval_s: float = 0.0):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        init_args = _resolve_placeholders(init_args)
+        init_kwargs = _resolve_placeholders(init_kwargs)
+        if inspect.isclass(func_or_class):
+            self._callable = func_or_class(*init_args, **init_kwargs)
+        else:
+            if init_args or init_kwargs:
+                raise ValueError(
+                    "function deployments take no bind() arguments"
+                )
+            self._callable = func_or_class
+        if user_config is not None:
+            self.reconfigure(user_config)
+        self._metrics_stop = threading.Event()
+        if metrics_interval_s > 0:
+            threading.Thread(
+                target=self._push_metrics_loop, args=(metrics_interval_s,),
+                daemon=True, name=f"metrics-{replica_id}",
+            ).start()
+
+    # -- data plane --------------------------------------------------------
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        from ray_tpu.core import api
+        from ray_tpu.core.object_ref import ObjectRef
+
+        # Upstream DeploymentResponses arrive as refs nested inside the
+        # args tuple — resolve them here (parity: the reference resolves
+        # response args before invoking the user method).
+        args = tuple(
+            api.get(a) if isinstance(a, ObjectRef) else a for a in args
+        )
+        kwargs = {
+            k: api.get(v) if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method_name == "__call__":
+                if not callable(self._callable):
+                    raise TypeError(
+                        f"deployment {self.deployment_name!r} is not "
+                        f"callable — define __call__ or route to a named "
+                        f"method"
+                    )
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # -- control plane -----------------------------------------------------
+
+    def get_metadata(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "replica_id": self.replica_id,
+                "ongoing": self._ongoing,
+                "total": self._total,
+            }
+
+    def num_ongoing_requests(self) -> int:
+        with self._lock:
+            return self._ongoing
+
+    def reconfigure(self, user_config: Any) -> None:
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is None:
+            raise ValueError(
+                f"deployment {self.deployment_name!r} got user_config but "
+                f"defines no reconfigure(config) method"
+            )
+        fn(user_config)
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()  # raises on unhealthy (parity: serve health-check contract)
+        return True
+
+    def prepare_for_shutdown(self, timeout_s: float) -> None:
+        """Drain: wait for ongoing requests to finish (parity:
+        graceful_shutdown_timeout_s)."""
+        self._metrics_stop.set()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._ongoing == 0:
+                    return
+            time.sleep(0.01)
+
+    def _push_metrics_loop(self, interval_s: float) -> None:
+        from ray_tpu.core import api
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        while not self._metrics_stop.wait(interval_s):
+            try:
+                controller = api.get_actor(CONTROLLER_NAME)
+                controller.record_autoscaling_metric.remote(
+                    self.app_name, self.deployment_name, self.replica_id,
+                    self.num_ongoing_requests(), time.monotonic(),
+                )
+            except Exception:
+                return  # controller gone — cluster is shutting down

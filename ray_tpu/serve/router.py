@@ -1,0 +1,139 @@
+"""Request router: power-of-two-choices replica scheduling.
+
+Parity with the reference (ray: python/ray/serve/_private/router.py —
+Router:944, PowerOfTwoChoicesReplicaScheduler:330).  The reference
+probes two candidate replicas' queue lengths over RPC; here the router
+tracks its own in-flight count per replica (decremented by a reaper
+thread polling completion), which is the same signal the probe returns
+in the single-router case, without the extra round-trip.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import api
+from ray_tpu.core.object_ref import ObjectRef
+
+
+class _ReplicaInfo:
+    def __init__(self, replica_id: str, handle, max_ongoing: int):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.max_ongoing = max_ongoing
+        self.inflight = 0
+
+
+class Router:
+    """One per DeploymentHandle; subscribes to the controller's routing
+    table via long-poll and assigns requests to replicas."""
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._replicas: Dict[str, _ReplicaInfo] = {}
+        self._outstanding: Dict[ObjectRef, str] = {}
+        self._stopped = threading.Event()
+        self._client = None
+        self._subscribe()
+        threading.Thread(
+            target=self._reaper_loop, daemon=True,
+            name=f"router-reaper-{deployment_name}",
+        ).start()
+
+    # -- routing table -----------------------------------------------------
+
+    def _subscribe(self):
+        from ray_tpu.serve.controller import CONTROLLER_NAME, replica_set_key
+        from ray_tpu.serve.long_poll import LongPollClient
+
+        controller = api.get_actor(CONTROLLER_NAME)
+        key = replica_set_key(self.app_name, self.deployment_name)
+
+        def listen(seen: Dict[str, int]):
+            return api.get(controller.long_poll.remote(seen))
+
+        self._client = LongPollClient(listen, {key: self._update_replicas})
+
+    def _update_replicas(self, table: List[Tuple[str, Any, int]]) -> None:
+        """table: [(replica_id, actor_handle, max_ongoing_requests)]"""
+        with self._cv:
+            fresh: Dict[str, _ReplicaInfo] = {}
+            for replica_id, handle, max_ongoing in table:
+                old = self._replicas.get(replica_id)
+                if old is not None:
+                    old.max_ongoing = max_ongoing
+                    fresh[replica_id] = old
+                else:
+                    fresh[replica_id] = _ReplicaInfo(
+                        replica_id, handle, max_ongoing
+                    )
+            self._replicas = fresh
+            self._cv.notify_all()
+
+    # -- assignment --------------------------------------------------------
+
+    def assign(self, method_name: str, args: tuple, kwargs: dict,
+               timeout: Optional[float] = None) -> Tuple[ObjectRef, str]:
+        """Pick a replica (power of two choices on in-flight counts,
+        respecting max_ongoing_requests backpressure) and submit."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                candidates = [
+                    r for r in self._replicas.values()
+                    if r.inflight < r.max_ongoing
+                ]
+                if candidates:
+                    if len(candidates) > 2:
+                        candidates = random.sample(candidates, 2)
+                    chosen = min(candidates, key=lambda r: r.inflight)
+                    chosen.inflight += 1
+                    break
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"no replica of {self.deployment_name!r} became "
+                        f"available within {timeout}s"
+                    )
+                self._cv.wait(0.05 if remaining is None else min(remaining, 0.05))
+        ref = chosen.handle.handle_request.remote(method_name, args, kwargs)
+        with self._cv:
+            self._outstanding[ref] = chosen.replica_id
+        return ref, chosen.replica_id
+
+    def _reaper_loop(self):
+        """Decrement in-flight counts as results land (parity: the
+        completion callbacks the reference attaches to assignments)."""
+        rt = api.runtime()
+        while not self._stopped.wait(0.002):
+            with self._cv:
+                refs = list(self._outstanding)
+            if not refs:
+                continue
+            done = [r for r in refs if rt.store.contains(r.id)]
+            if not done:
+                continue
+            with self._cv:
+                for ref in done:
+                    replica_id = self._outstanding.pop(ref, None)
+                    info = self._replicas.get(replica_id)
+                    if info is not None and info.inflight > 0:
+                        info.inflight -= 1
+                self._cv.notify_all()
+
+    def num_outstanding(self) -> int:
+        with self._lock:
+            return len(self._outstanding)
+
+    def stop(self):
+        self._stopped.set()
+        if self._client is not None:
+            self._client.stop()
