@@ -63,6 +63,12 @@ class LocalObjectStore:
             st = self._objects.get(oid)
         return bool(st and st.event.is_set())
 
+    def peek_error(self, oid: ObjectID) -> Optional[BaseException]:
+        """Non-blocking: the stored error, if this object resolved to one."""
+        with self._lock:
+            st = self._objects.get(oid)
+        return st.error if st is not None and st.event.is_set() else None
+
     def get(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
         st = self._state(oid)
         if not st.event.wait(timeout):

@@ -342,6 +342,98 @@ def prefill(
     return logits.astype(jnp.float32), cache
 
 
+def prefill_slot(
+    params: Params,
+    tokens: jax.Array,
+    true_len: jax.Array,
+    slot: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Prefill ONE sequence into one slot of a multi-slot cache.
+
+    The continuous-batching primitive (no reference counterpart — the
+    reference serves models via user torch code): tokens [S] is the
+    prompt right-padded to a bucket length; k/v are written into
+    ``cache[:, slot, :S]`` and ``length[slot] = true_len``.  Returns
+    (logits at position true_len-1 [V], cache).  Causality makes the
+    pad positions invisible to positions < true_len.
+    """
+    S = tokens.shape[0]
+    positions = jnp.arange(S)[None, :]
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"].astype(cfg.dtype)[tokens[None, :]]
+
+    def body(carry, layer):
+        x = carry
+        normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        out, (k, v) = _attn_block(normed, layer, cfg, sin, cos, None)
+        h = x + out
+        h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
+        return h, (k[0], v[0])
+
+    x, (k_all, v_all) = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = lax.dynamic_index_in_dim(x[0], true_len - 1, axis=0, keepdims=False)
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = last @ head.astype(cfg.dtype)
+
+    # k_all/v_all: [L, S, kvh, hd] → write at [:, slot, 0:S]
+    cache = dict(cache)
+    cache["k"] = lax.dynamic_update_slice(
+        cache["k"], k_all[:, None], (0, slot, 0, 0, 0)
+    )
+    cache["v"] = lax.dynamic_update_slice(
+        cache["v"], v_all[:, None], (0, slot, 0, 0, 0)
+    )
+    cache["length"] = cache["length"].at[slot].set(true_len)
+    return logits.astype(jnp.float32), cache
+
+
+def decode_slots(
+    params: Params,
+    tokens: jax.Array,
+    active: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One decode step over ALL slots (continuous batching).
+
+    tokens [slots] int32, active [slots] bool → (logits [slots, V],
+    cache).  Inactive slots compute garbage but their length is not
+    advanced, so their cache stays consistent for later reuse.
+    """
+    new_len = jnp.where(active, cache["length"] + 1, cache["length"])
+    positions = cache["length"][:, None]
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"].astype(cfg.dtype)[tokens[:, None]]
+
+    def body(carry, inputs):
+        x = carry
+        layer, k_cache, v_cache = inputs
+        normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _qkv(normed, layer, cfg, sin, cos)
+        idx = cache["length"]
+        k_cache = jax.vmap(lambda c, kk, i: lax.dynamic_update_slice_in_dim(
+            c, kk, i, axis=0))(k_cache, k, idx)
+        v_cache = jax.vmap(lambda c, vv, i: lax.dynamic_update_slice_in_dim(
+            c, vv, i, axis=0))(v_cache, v, idx)
+        out = decode_attention(q, k_cache, v_cache, new_len,
+                               logits_soft_cap=cfg.logits_soft_cap)
+        out = jnp.einsum("bshk,hkd->bsd", out,
+                         layer["attn"]["wo"].astype(cfg.dtype))
+        h = x + out
+        h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
+        return h, (k_cache, v_cache)
+
+    x, (k_new, v_new) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))
+    cache = {"k": k_new, "v": v_new, "length": new_len}
+    return logits.astype(jnp.float32), cache
+
+
 def decode_step(
     params: Params,
     tokens: jax.Array,

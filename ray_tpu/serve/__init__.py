@@ -138,4 +138,8 @@ def shutdown(timeout_s: float = 10.0) -> None:
         _api.get(controller.graceful_shutdown.remote())
         _api.get(controller.wait_for_drained.remote(timeout_s))
     finally:
+        try:
+            _api.get(controller.stop_reconcile.remote(), timeout=5.0)
+        except Exception:
+            pass
         _api.kill(controller, no_restart=True)

@@ -17,9 +17,17 @@ from typing import Any, Callable, List, Optional
 
 
 class _BatchQueue:
+    """One flusher thread per (function, owner).  The owner is held only
+    weakly: when the replica's user object is collected, the thread
+    exits and the queue dies with it (no leak across replica churn)."""
+
     def __init__(self, fn: Callable[[List[Any]], List[Any]],
-                 max_batch_size: int, batch_wait_timeout_s: float):
+                 max_batch_size: int, batch_wait_timeout_s: float,
+                 owner: Any = None):
+        import weakref
+
         self._fn = fn
+        self._owner_ref = (weakref.ref(owner) if owner is not None else None)
         self._max = max_batch_size
         self._wait = batch_wait_timeout_s
         self._q: "queue.Queue" = queue.Queue()
@@ -34,9 +42,22 @@ class _BatchQueue:
         self._q.put((item, fut))
         return fut
 
+    def _bound_fn(self) -> Optional[Callable]:
+        if self._owner_ref is None:
+            return self._fn
+        owner = self._owner_ref()
+        if owner is None:
+            return None
+        return functools.partial(self._fn, owner)
+
     def _loop(self):
         while True:
-            item, fut = self._q.get()
+            try:
+                item, fut = self._q.get(timeout=5.0)
+            except queue.Empty:
+                if self._owner_ref is not None and self._owner_ref() is None:
+                    return  # owner collected — exit
+                continue
             batch = [(item, fut)]
             # Wait up to batch_wait_timeout_s to fill the batch
             # (parity: _BatchQueue wait loop).
@@ -54,7 +75,10 @@ class _BatchQueue:
                     break
             items = [b[0] for b in batch]
             try:
-                results = self._fn(items)
+                bound = self._bound_fn()
+                if bound is None:
+                    raise RuntimeError("batch owner was garbage-collected")
+                results = bound(items)
                 if len(results) != len(items):
                     raise ValueError(
                         f"batched function returned {len(results)} results "
@@ -73,28 +97,33 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
     one item and block for their element of the result."""
 
     def wrap(fn: Callable):
-        queues: dict = {}
         lock = threading.Lock()
+        shared: List[Optional[_BatchQueue]] = [None]  # unbound-case queue
+        attr = f"__batch_queue_{fn.__name__}"
 
         @functools.wraps(fn)
         def wrapper(*call_args):
             # Support bound methods: (self, item) or plain (item,).
             if len(call_args) == 2:
                 owner, item = call_args
-                bound = functools.partial(fn, owner)
-                key = id(owner)
+                with lock:
+                    bq = getattr(owner, attr, None)
+                    if bq is None:
+                        bq = _BatchQueue(
+                            fn, max_batch_size, batch_wait_timeout_s,
+                            owner=owner,
+                        )
+                        setattr(owner, attr, bq)
             elif len(call_args) == 1:
                 item = call_args[0]
-                bound = fn
-                key = None
+                with lock:
+                    if shared[0] is None:
+                        shared[0] = _BatchQueue(
+                            fn, max_batch_size, batch_wait_timeout_s
+                        )
+                    bq = shared[0]
             else:
                 raise TypeError("@serve.batch functions take a single item")
-            with lock:
-                bq = queues.get(key)
-                if bq is None:
-                    bq = queues[key] = _BatchQueue(
-                        bound, max_batch_size, batch_wait_timeout_s
-                    )
             return bq.submit(item).result()
 
         wrapper._is_serve_batch = True  # type: ignore[attr-defined]

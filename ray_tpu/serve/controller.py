@@ -67,7 +67,15 @@ class _DeploymentState:
         changes, full rolling replace otherwise."""
         old = self.info
         self.info = info
-        self.target_replicas = info.config.initial_target_replicas()
+        auto = info.config.autoscaling_config
+        if auto is not None:
+            # Preserve the autoscaled target across idempotent redeploys —
+            # only clamp into the (possibly new) bounds.
+            self.target_replicas = max(
+                auto.min_replicas, min(auto.max_replicas, self.target_replicas)
+            )
+        else:
+            self.target_replicas = info.config.initial_target_replicas()
         same_code = (
             old.func_or_class is info.func_or_class
             and old.init_args == info.init_args
@@ -179,9 +187,11 @@ class ServeController:
         return name
 
     def long_poll(self, keys_to_ids: Dict[str, int]):
-        # Short server-side timeout; clients immediately re-poll
-        # (parity: LongPollHost listen_for_change timeout).
-        return self._host.listen(keys_to_ids, timeout=1.0)
+        # Non-blocking snapshot check: clients poll on a short cadence.
+        # (The reference blocks in an asyncio handler, which holds no
+        # thread; here a blocking listen would pin one controller pool
+        # thread per subscriber, starving control RPCs at scale.)
+        return self._host.listen(keys_to_ids, timeout=0.0)
 
     def record_autoscaling_metric(self, app_name: str, deployment_name: str,
                                   replica_id: str, ongoing: float,
@@ -233,6 +243,11 @@ class ServeController:
                 return True
             time.sleep(0.02)
         return self._num_live() == 0
+
+    def stop_reconcile(self) -> None:
+        """Stop the reconcile thread; called right before the controller
+        actor is killed so no orphan loop keeps mutating state."""
+        self._shutdown.set()
 
     # -- reconcile ---------------------------------------------------------
 

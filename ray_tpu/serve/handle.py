@@ -40,11 +40,24 @@ def _shutdown_routers() -> None:
 class DeploymentResponse:
     """Future for one request (parity: serve DeploymentResponse)."""
 
-    def __init__(self, ref: ObjectRef):
+    def __init__(self, ref: ObjectRef, resubmit=None):
         self._ref = ref
+        self._resubmit = resubmit
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
-        return api.get(self._ref, timeout=timeout_s)
+        from ray_tpu.core.exceptions import ActorDiedError
+
+        # A replica can die between assignment and execution (downscale,
+        # health replacement).  The request never started, so retrying on
+        # a live replica is safe (parity: serve router replica retries).
+        attempts = 3 if self._resubmit is not None else 1
+        for attempt in range(attempts):
+            try:
+                return api.get(self._ref, timeout=timeout_s)
+            except ActorDiedError:
+                if attempt == attempts - 1:
+                    raise
+                self._ref = self._resubmit()
 
     def _to_object_ref(self) -> ObjectRef:
         return self._ref
@@ -78,12 +91,23 @@ class DeploymentHandle:
         # handle.method.remote(...) sugar (parity: handle method access)
         return DeploymentHandle(self.deployment_name, self.app_name, name)
 
+    # Backpressure bound: if no replica frees a slot within this window,
+    # surface a TimeoutError instead of blocking the caller forever.
+    ASSIGN_TIMEOUT_S = 30.0
+
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         args = tuple(self._unwrap(a) for a in args)
         kwargs = {k: self._unwrap(v) for k, v in kwargs.items()}
         router = _router_for(self.app_name, self.deployment_name)
-        ref, _ = router.assign(self._method_name, args, kwargs)
-        return DeploymentResponse(ref)
+        method = self._method_name
+
+        def submit() -> ObjectRef:
+            ref, _ = router.assign(
+                method, args, kwargs, timeout=self.ASSIGN_TIMEOUT_S
+            )
+            return ref
+
+        return DeploymentResponse(submit(), resubmit=submit)
 
     @staticmethod
     def _unwrap(value: Any) -> Any:

@@ -1,0 +1,370 @@
+"""Continuous-batching LLM inference engine for TPU.
+
+The TPU-native heart of the Serve equivalent.  The reference has no
+in-tree inference engine (models are user torch code inside replicas;
+ray: python/ray/serve/_private/replica.py just invokes the callable) —
+on TPU the engine must own the device loop, because XLA wants static
+shapes and hates per-request recompiles.  Design:
+
+  * a fixed number of KV-cache **slots** (the batch dimension of every
+    compiled program) — requests claim a slot, decode advances ALL
+    active slots in one jitted step (MXU stays batched);
+  * **bucketed prefill**: prompts are right-padded to power-of-two
+    buckets, one compile per bucket, causality hides the padding;
+  * sampling happens **on device** (greedy or temperature), so the only
+    per-step host transfer is one int32 per slot;
+  * admission interleaves with decode: a new request prefills between
+    decode steps and joins the running batch (continuous batching à la
+    Orca; cf. PAPERS.md paged/ragged attention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    max_slots: int = 8
+    max_seq_len: int = 1024
+    min_prefill_bucket: int = 32
+    max_new_tokens_default: int = 128
+    eos_id: Optional[int] = None
+    # Decode this many steps per host round-trip (lax.scan on device).
+    # Amortizes host↔device latency; tokens past an EOS inside a chunk
+    # are discarded host-side.  Chunk sizes used: {1, 4, decode_chunk}.
+    decode_chunk: int = 8
+
+    def buckets(self) -> List[int]:
+        out, b = [], self.min_prefill_bucket
+        while b < self.max_seq_len:
+            out.append(b)
+            b *= 2
+        out.append(self.max_seq_len)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineAdapter:
+    """Model plug: how the engine talks to a model family.
+
+    init_cache(slots, max_len) -> cache pytree with int32 "length"[slots]
+    prefill_slot(params, tokens[S], true_len, slot, cache) -> (logits[V], cache)
+    decode_slots(params, tokens[slots], active[slots], cache) -> (logits[slots,V], cache)
+    """
+
+    init_cache: Callable[[int, int], Any]
+    prefill_slot: Callable[..., Tuple[jax.Array, Any]]
+    decode_slots: Callable[..., Tuple[jax.Array, Any]]
+
+
+def llama_adapter(cfg) -> EngineAdapter:
+    from ray_tpu.models import llama
+
+    return EngineAdapter(
+        init_cache=lambda slots, max_len: llama.init_kv_cache(
+            cfg, slots, max_len
+        ),
+        prefill_slot=lambda params, tokens, true_len, slot, cache:
+            llama.prefill_slot(params, tokens, true_len, slot, cfg, cache),
+        decode_slots=lambda params, tokens, active, cache:
+            llama.decode_slots(params, tokens, active, cfg, cache),
+    )
+
+
+def _sample(logits: jax.Array, temperature: jax.Array,
+            key: jax.Array) -> jax.Array:
+    """logits [..., V], temperature broadcastable — greedy at temp 0,
+    categorical otherwise; computed on device."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[..., None]
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+@dataclasses.dataclass
+class Request:
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float
+    stream: "queue.Queue"
+    req_id: int
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+_DONE = object()
+
+
+class CompletionStream:
+    """Client view of one request: iterate tokens as they generate."""
+
+    def __init__(self, req: Request):
+        self._req = req
+        self._done = threading.Event()
+
+    def __iter__(self):
+        while not self._done.is_set():
+            item = self._req.stream.get()
+            if item is _DONE:
+                self._done.set()
+                return
+            yield item
+
+    def result(self, timeout_s: Optional[float] = None) -> List[int]:
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        while not self._done.is_set():
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            item = self._req.stream.get(timeout=remaining)
+            if item is _DONE:
+                self._done.set()
+        return list(self._req.tokens)
+
+    @property
+    def metrics(self) -> Dict[str, Any]:
+        r = self._req
+        return {
+            "ttft_s": r.ttft_s,
+            "total_s": (None if r.finished_at is None
+                        else r.finished_at - r.submitted_at),
+            "num_tokens": len(r.tokens),
+        }
+
+
+class LLMServer:
+    """Ready-made Serve deployment hosting an LLMEngine.
+
+    Request payload: {"tokens": [...], "max_new_tokens"?: int,
+    "temperature"?: float} → {"tokens": [...], "metrics": {...}}.
+    Use with ``serve.deployment(...)(LLMServer).bind(cfg, engine_cfg,
+    param_loader)`` — param_loader runs inside the replica so weights
+    never travel through the object store.
+    """
+
+    def __init__(self, model_cfg: Any, engine_cfg: EngineConfig,
+                 param_loader: Callable[[], Any], *, adapter_factory:
+                 Callable[[Any], EngineAdapter] = None):
+        make_adapter = adapter_factory or llama_adapter
+        self.engine = LLMEngine(
+            param_loader(), make_adapter(model_cfg), engine_cfg
+        )
+
+    def __call__(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        stream = self.engine.submit(
+            payload["tokens"],
+            max_new_tokens=payload.get("max_new_tokens"),
+            temperature=payload.get("temperature", 0.0),
+        )
+        tokens = stream.result()
+        return {"tokens": tokens, "metrics": stream.metrics}
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    def check_health(self) -> None:
+        if self.engine._stopped.is_set():
+            raise RuntimeError("engine stopped")
+
+
+class LLMEngine:
+    """Continuous-batching scheduler around jitted prefill/decode."""
+
+    def __init__(self, params: Any, adapter: EngineAdapter,
+                 config: EngineConfig, *, seed: int = 0):
+        self.config = config
+        self.adapter = adapter
+        self._params = params
+        self._cache = adapter.init_cache(config.max_slots, config.max_seq_len)
+        self._key = jax.random.key(seed)
+        self._waiting: "queue.Queue[Request]" = queue.Queue()
+        self._slot_req: Dict[int, Request] = {}
+        self._free_slots = list(range(config.max_slots))
+        self._cur = np.zeros((config.max_slots,), np.int32)
+        self._temps = np.zeros((config.max_slots,), np.float32)
+        self._req_counter = itertools.count()
+        self._stopped = threading.Event()
+        self._work = threading.Event()
+        self._steps = 0
+        self._tokens_out = 0
+
+        slots = config.max_slots
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_fn(params, cache, tokens, true_len, slot, temp, key):
+            logits, cache = adapter.prefill_slot(
+                params, tokens, true_len, slot, cache
+            )
+            tok = _sample(logits[None, :], temp[None], key)[0]
+            return cache, tok
+
+        @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+        def decode_fn(n_steps, params, cache, cur, active, temps, key):
+            def step(carry, k):
+                cache, cur = carry
+                logits, cache = adapter.decode_slots(params, cur, active, cache)
+                toks = _sample(logits, temps, k)
+                toks = jnp.where(active, toks, cur)
+                return (cache, toks), toks
+
+            keys = jax.random.split(key, n_steps)
+            (cache, _), toks = jax.lax.scan(step, (cache, cur), keys)
+            return cache, toks  # [n_steps, slots]
+
+        self._prefill_fn = prefill_fn
+        self._decode_fn = decode_fn
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="llm-engine"
+        )
+        self._thread.start()
+
+    # -- client API --------------------------------------------------------
+
+    def submit(self, prompt: List[int], *, max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0) -> CompletionStream:
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) >= self.config.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} >= max_seq_len "
+                f"{self.config.max_seq_len}"
+            )
+        req = Request(
+            prompt=list(prompt),
+            max_new_tokens=max_new_tokens or self.config.max_new_tokens_default,
+            temperature=float(temperature),
+            stream=queue.Queue(),
+            req_id=next(self._req_counter),
+        )
+        self._waiting.put(req)
+        self._work.set()
+        return CompletionStream(req)
+
+    def generate(self, prompt: List[int], **kw) -> List[int]:
+        return self.submit(prompt, **kw).result()
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "active_slots": self.config.max_slots - len(self._free_slots),
+            "waiting": self._waiting.qsize(),
+            "steps": self._steps,
+            "tokens_out": self._tokens_out,
+        }
+
+    def shutdown(self):
+        self._stopped.set()
+        self._work.set()
+
+    # -- engine loop -------------------------------------------------------
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.buckets():
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds max bucket")
+
+    def _admit(self):
+        while self._free_slots:
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                return
+            slot = self._free_slots.pop()
+            bucket = self._bucket_for(len(req.prompt))
+            padded = np.zeros((bucket,), np.int32)
+            padded[: len(req.prompt)] = req.prompt
+            self._cache, tok = self._prefill_fn(
+                self._params, self._cache, jnp.asarray(padded),
+                jnp.int32(len(req.prompt)), jnp.int32(slot),
+                jnp.float32(req.temperature), self._next_key(),
+            )
+            tok = int(jax.device_get(tok))
+            req.first_token_at = time.monotonic()
+            self._emit(req, slot, tok)
+            if slot in self._slot_req:  # not finished after first token
+                self._cur[slot] = tok
+                self._temps[slot] = req.temperature
+
+    def _emit(self, req: Request, slot: int, tok: int):
+        """Record one generated token; finish/free the slot if done."""
+        self._slot_req.setdefault(slot, req)
+        req.tokens.append(tok)
+        req.stream.put(tok)
+        self._tokens_out += 1
+        done = (
+            (self.config.eos_id is not None and tok == self.config.eos_id)
+            or len(req.tokens) >= req.max_new_tokens
+            or len(req.prompt) + len(req.tokens) >= self.config.max_seq_len
+        )
+        if done:
+            req.finished_at = time.monotonic()
+            req.stream.put(_DONE)
+            del self._slot_req[slot]
+            self._free_slots.append(slot)
+
+    def _chunk_size(self) -> int:
+        """Largest compiled chunk that no active request can out-finish
+        (so only EOS, never the token budget, can end a request
+        mid-chunk)."""
+        remaining = min(
+            min(
+                req.max_new_tokens - len(req.tokens),
+                self.config.max_seq_len - len(req.prompt) - len(req.tokens),
+            )
+            for req in self._slot_req.values()
+        )
+        for k in (self.config.decode_chunk, 4, 1):
+            if k <= remaining:
+                return k
+        return 1
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            if not self._slot_req and self._waiting.empty():
+                self._work.wait(timeout=0.05)
+                self._work.clear()
+                continue
+            self._admit()
+            if not self._slot_req:
+                continue
+            active = np.zeros((self.config.max_slots,), bool)
+            for slot in self._slot_req:
+                active[slot] = True
+            chunk = self._chunk_size()
+            self._cache, toks = self._decode_fn(
+                chunk, self._params, self._cache, jnp.asarray(self._cur),
+                jnp.asarray(active), jnp.asarray(self._temps),
+                self._next_key(),
+            )
+            self._steps += chunk
+            toks = np.asarray(jax.device_get(toks))  # [chunk, slots]
+            for slot, req in list(self._slot_req.items()):
+                for k in range(chunk):
+                    tok = int(toks[k, slot])
+                    self._emit(req, slot, tok)
+                    self._cur[slot] = tok
+                    if slot not in self._slot_req:  # finished mid-chunk
+                        break

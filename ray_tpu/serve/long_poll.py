@@ -87,6 +87,7 @@ class LongPollClient:
                 self._stopped.wait(0.1)
                 continue
             if not updates:
+                self._stopped.wait(0.02)  # poll cadence
                 continue
             for key, (snap_id, value) in updates.items():
                 self._seen[key] = snap_id
