@@ -97,8 +97,24 @@ def tree_shardings(
 
 def constrain(x: jax.Array, logical_axes: Sequence[Optional[str]],
               rules: Optional[Rules] = None) -> jax.Array:
-    """with_sharding_constraint by logical axes — use inside jitted code."""
-    return jax.lax.with_sharding_constraint(x, spec_for(logical_axes, rules))
+    """with_sharding_constraint by logical axes — use inside jitted code.
+    A no-op outside any mesh context, so model code runs unchanged
+    single-device (e.g. unit tests, one-chip serving).
+
+    Under ``with mesh:`` (the trainer's idiom) only the *physical*
+    thread-resources mesh is populated — jax.sharding.get_abstract_mesh()
+    stays empty — so a bare-PartitionSpec constraint would either raise
+    or be dropped; bind the spec to the concrete mesh instead."""
+    spec = spec_for(logical_axes, rules)
+    abstract = jax.sharding.get_abstract_mesh()
+    if not abstract.empty:
+        return jax.lax.with_sharding_constraint(x, spec)
+    from jax._src import mesh as _mesh_lib
+
+    physical = _mesh_lib.thread_resources.env.physical_mesh
+    if physical.empty:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(physical, spec))
 
 
 def shard_tree(mesh: Mesh, tree: Any, logical_tree: Any,

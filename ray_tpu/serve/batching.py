@@ -101,19 +101,33 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
         shared: List[Optional[_BatchQueue]] = [None]  # unbound-case queue
         attr = f"__batch_queue_{fn.__name__}"
 
+        # Fallback for owners that reject setattr/weakref (__slots__,
+        # frozen dataclasses): strong id-keyed map, the pre-weakref
+        # behavior (leaks across owner churn, but only for such classes).
+        rigid_queues: dict = {}
+
         @functools.wraps(fn)
         def wrapper(*call_args):
             # Support bound methods: (self, item) or plain (item,).
             if len(call_args) == 2:
                 owner, item = call_args
                 with lock:
-                    bq = getattr(owner, attr, None)
+                    bq = getattr(owner, attr, None) or rigid_queues.get(
+                        id(owner)
+                    )
                     if bq is None:
-                        bq = _BatchQueue(
-                            fn, max_batch_size, batch_wait_timeout_s,
-                            owner=owner,
-                        )
-                        setattr(owner, attr, bq)
+                        try:
+                            bq = _BatchQueue(
+                                fn, max_batch_size, batch_wait_timeout_s,
+                                owner=owner,
+                            )
+                            setattr(owner, attr, bq)
+                        except (AttributeError, TypeError):
+                            bq = _BatchQueue(
+                                functools.partial(fn, owner),
+                                max_batch_size, batch_wait_timeout_s,
+                            )
+                            rigid_queues[id(owner)] = bq
             elif len(call_args) == 1:
                 item = call_args[0]
                 with lock:

@@ -50,6 +50,8 @@ class DeploymentResponse:
         # A replica can die between assignment and execution (downscale,
         # health replacement).  The request never started, so retrying on
         # a live replica is safe (parity: serve router replica retries).
+        # The resubmit closure excludes every replica already observed
+        # dead, so retries can't land on the same one.
         attempts = 3 if self._resubmit is not None else 1
         for attempt in range(attempts):
             try:
@@ -73,16 +75,23 @@ class DeploymentHandle:
     deployment, shared across handle copies)."""
 
     def __init__(self, deployment_name: str, app_name: str,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__",
+                 assign_timeout_s: Optional[float] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
+        # None = wait for a free replica slot indefinitely (backpressure,
+        # the reference's behavior); a number bounds the wait.
+        self._assign_timeout_s = assign_timeout_s
 
-    def options(self, *, method_name: Optional[str] = None
+    def options(self, *, method_name: Optional[str] = None,
+                assign_timeout_s: Optional[float] = None
                 ) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name or self._method_name,
+            (assign_timeout_s if assign_timeout_s is not None
+             else self._assign_timeout_s),
         )
 
     def __getattr__(self, name: str):
@@ -91,20 +100,22 @@ class DeploymentHandle:
         # handle.method.remote(...) sugar (parity: handle method access)
         return DeploymentHandle(self.deployment_name, self.app_name, name)
 
-    # Backpressure bound: if no replica frees a slot within this window,
-    # surface a TimeoutError instead of blocking the caller forever.
-    ASSIGN_TIMEOUT_S = 30.0
-
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         args = tuple(self._unwrap(a) for a in args)
         kwargs = {k: self._unwrap(v) for k, v in kwargs.items()}
         router = _router_for(self.app_name, self.deployment_name)
         method = self._method_name
+        timeout = self._assign_timeout_s
+        dead: set = set()
+        last = [None]
 
         def submit() -> ObjectRef:
-            ref, _ = router.assign(
-                method, args, kwargs, timeout=self.ASSIGN_TIMEOUT_S
+            if last[0] is not None:
+                dead.add(last[0])
+            ref, replica_id = router.assign(
+                method, args, kwargs, timeout=timeout, exclude=dead
             )
+            last[0] = replica_id
             return ref
 
         return DeploymentResponse(submit(), resubmit=submit)

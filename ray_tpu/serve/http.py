@@ -96,7 +96,9 @@ class HTTPProxy:
             with self._lock:
                 self._routes = dict(routes)
                 self._handles = {
-                    prefix: DeploymentHandle(dep, app)
+                    # Bounded assign wait: the proxy must return 500,
+                    # never hang a client socket forever.
+                    prefix: DeploymentHandle(dep, app, assign_timeout_s=55.0)
                     for prefix, (app, dep) in routes.items()
                 }
 

@@ -135,7 +135,13 @@ class CompletionStream:
         while not self._done.is_set():
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
-            item = self._req.stream.get(timeout=remaining)
+            try:
+                item = self._req.stream.get(timeout=remaining)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"generation not finished within {timeout_s}s "
+                    f"({len(self._req.tokens)} tokens so far)"
+                ) from None
             if item is _DONE:
                 self._done.set()
         return list(self._req.tokens)

@@ -79,15 +79,21 @@ class Router:
     # -- assignment --------------------------------------------------------
 
     def assign(self, method_name: str, args: tuple, kwargs: dict,
-               timeout: Optional[float] = None) -> Tuple[ObjectRef, str]:
+               timeout: Optional[float] = None,
+               exclude: Optional[set] = None) -> Tuple[ObjectRef, str]:
         """Pick a replica (power of two choices on in-flight counts,
-        respecting max_ongoing_requests backpressure) and submit."""
+        respecting max_ongoing_requests backpressure) and submit.
+        ``exclude``: replica ids observed dead by the caller — never
+        re-picked (ids are unique forever, so this can't starve a healthy
+        replica; if everything is excluded we wait for the controller's
+        replacement broadcast)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while True:
                 candidates = [
                     r for r in self._replicas.values()
                     if r.inflight < r.max_ongoing
+                    and (not exclude or r.replica_id not in exclude)
                 ]
                 if candidates:
                     if len(candidates) > 2:
