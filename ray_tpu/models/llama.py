@@ -264,6 +264,30 @@ def forward(
     return jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype)).astype(jnp.float32)
 
 
+def next_token_loss(
+    logits: jax.Array,
+    tokens: jax.Array,
+    loss_mask: Optional[jax.Array] = None,
+    *,
+    z_loss: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Shifted next-token masked cross-entropy, shared by all model
+    families.  logits [B, S, V], tokens [B, S] → (mean_nll, ntokens)."""
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - tgt_logit
+    if z_loss:
+        nll = nll + z_loss * logz**2
+    if loss_mask is None:
+        mask = jnp.ones_like(nll)
+    else:
+        mask = loss_mask[:, 1:].astype(nll.dtype)
+    total = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return total, jnp.sum(mask)
+
+
 def loss_fn(
     params: Params,
     batch: Dict[str, jax.Array],
@@ -276,20 +300,10 @@ def loss_fn(
     # Run the full sequence length (keeps S block-divisible for the flash
     # kernel) and shift logits instead of inputs.
     logits = forward(params, tokens, cfg, segment_ids=batch.get("segment_ids"))
-    logits = logits[:, :-1]
-    targets = tokens[:, 1:]
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = logz - tgt_logit
-    if z_loss:
-        nll = nll + z_loss * logz**2
-    mask = batch.get("loss_mask")
-    if mask is None:
-        mask = jnp.ones_like(nll)
-    else:
-        mask = mask[:, 1:].astype(nll.dtype)
-    total = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return total, {"loss": total, "ntokens": jnp.sum(mask)}
+    total, ntokens = next_token_loss(
+        logits, tokens, batch.get("loss_mask"), z_loss=z_loss
+    )
+    return total, {"loss": total, "ntokens": ntokens}
 
 
 # --- inference (KV cache) -------------------------------------------------

@@ -247,6 +247,10 @@ def forward(
     sin, cos = rope_table(cfg, positions)
     x = params["tok_embed"].astype(cfg.dtype)[tokens]
 
+    if cfg.remat_policy not in ("dots", "full"):
+        raise ValueError(
+            f"remat_policy must be 'dots' or 'full', got {cfg.remat_policy!r}"
+        )
     policy = (
         jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         if cfg.remat_policy == "dots" else None
@@ -277,19 +281,11 @@ def loss_fn(
     tokens = batch["tokens"]
     logits, aux = forward(params, tokens, cfg,
                           segment_ids=batch.get("segment_ids"))
-    logits = logits[:, :-1]
-    targets = tokens[:, 1:]
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = logz - tgt_logit
-    if z_loss:
-        nll = nll + z_loss * logz**2
-    mask = batch.get("loss_mask")
-    if mask is None:
-        mask = jnp.ones_like(nll)
-    else:
-        mask = mask[:, 1:].astype(nll.dtype)
-    ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    from ray_tpu.models.llama import next_token_loss
+
+    ce, ntokens = next_token_loss(
+        logits, tokens, batch.get("loss_mask"), z_loss=z_loss
+    )
     total = ce + cfg.router_aux_coef * aux
     return total, {"loss": total, "ce_loss": ce, "aux_loss": aux,
-                   "ntokens": jnp.sum(mask)}
+                   "ntokens": ntokens}

@@ -116,7 +116,11 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
                         id(owner)
                     )
                     if bq is None:
+                        # Probe attribute assignment BEFORE starting a
+                        # queue (its flusher thread would leak if setattr
+                        # failed afterwards).
                         try:
+                            setattr(owner, attr, None)
                             bq = _BatchQueue(
                                 fn, max_batch_size, batch_wait_timeout_s,
                                 owner=owner,

@@ -98,7 +98,8 @@ class DeploymentHandle:
         if name.startswith("_"):
             raise AttributeError(name)
         # handle.method.remote(...) sugar (parity: handle method access)
-        return DeploymentHandle(self.deployment_name, self.app_name, name)
+        return DeploymentHandle(self.deployment_name, self.app_name, name,
+                                self._assign_timeout_s)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         args = tuple(self._unwrap(a) for a in args)
@@ -135,5 +136,6 @@ class DeploymentHandle:
     def __reduce__(self):
         return (
             DeploymentHandle,
-            (self.deployment_name, self.app_name, self._method_name),
+            (self.deployment_name, self.app_name, self._method_name,
+             self._assign_timeout_s),
         )
