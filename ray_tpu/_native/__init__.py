@@ -1,0 +1,41 @@
+"""Native (C++) components, compiled on first use.
+
+Parity: the reference builds its C++ core with Bazel into a Cython
+extension (ray: python/setup.py → bazel → _raylet.pyx); here each native
+component is a small C ABI library built with g++ and bound via ctypes
+— no build step at install time, no toolchain beyond a C++ compiler.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "build")
+_build_lock = threading.Lock()
+
+
+def build_library(source: str, libname: str,
+                  extra_flags: Optional[List[str]] = None) -> str:
+    """Compile ``source`` (relative to this dir) into build/<libname>.so,
+    rebuilding when the source is newer.  Returns the .so path."""
+    src = os.path.join(_NATIVE_DIR, source)
+    out = os.path.join(_BUILD_DIR, libname + ".so")
+    with _build_lock:
+        if (os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(src)):
+            return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", out, src,
+            "-lpthread", "-lrt",
+        ] + (extra_flags or [])
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed: {' '.join(cmd)}\n{proc.stderr}"
+            )
+    return out

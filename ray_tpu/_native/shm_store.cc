@@ -1,0 +1,357 @@
+// Shared-memory object store — the plasma equivalent, C++.
+//
+// Parity with the reference's plasma store (ray:
+// src/ray/object_manager/plasma/store.h:55, object_lifecycle_manager.h,
+// eviction_policy.h, plasma_allocator.h): immutable objects in a
+// shared-memory arena, create→seal lifecycle, refcounted gets, LRU
+// eviction of sealed unreferenced objects under pressure.  Differences,
+// deliberate: the arena is one POSIX shm segment mapped by every process
+// (the reference passes fds over a unix socket — fling.cc); the object
+// index lives *inside* the segment guarded by a robust process-shared
+// mutex, so there is no store server process to round-trip to for
+// create/get — TPU-host data loading wants the lowest possible
+// per-object overhead, not a socket protocol.
+//
+// Build: g++ -O2 -shared -fPIC -o libshm_store.so shm_store.cc -lpthread -lrt
+// C ABI for ctypes.  All functions return 0 on success, negative errno-style
+// codes on failure.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x7470755f73746f72ULL;  // "tpu_stor"
+constexpr int kIdSize = 32;
+constexpr uint32_t kFreeListCap = 4096;
+
+enum SlotState : uint32_t {
+  SLOT_EMPTY = 0,
+  SLOT_CREATED = 1,  // allocated, producer writing
+  SLOT_SEALED = 2,   // immutable, readable
+};
+
+struct Slot {
+  uint8_t id[kIdSize];
+  uint32_t state;
+  uint32_t refcount;   // outstanding gets
+  uint64_t offset;     // into data arena
+  uint64_t size;
+  uint64_t lru_tick;   // last touch
+};
+
+struct FreeBlock {
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;       // data arena bytes
+  uint64_t data_start;     // offset of arena from segment base
+  uint32_t num_slots;
+  uint32_t free_count;
+  uint64_t bump;           // high-water mark in arena
+  uint64_t lru_clock;
+  uint64_t bytes_used;
+  uint64_t num_objects;
+  uint64_t evictions;
+  pthread_mutex_t mutex;
+  // followed by: Slot[num_slots], FreeBlock[kFreeListCap], arena
+};
+
+struct Store {
+  Header* hdr;
+  uint8_t* base;
+  uint64_t map_size;
+  int fd;
+  bool owner;
+  char name[256];
+};
+
+Slot* slots(Header* h) {
+  return reinterpret_cast<Slot*>(reinterpret_cast<uint8_t*>(h) + sizeof(Header));
+}
+
+FreeBlock* free_list(Header* h) {
+  return reinterpret_cast<FreeBlock*>(
+      reinterpret_cast<uint8_t*>(slots(h)) + sizeof(Slot) * h->num_slots);
+}
+
+uint8_t* arena(Store* s) { return s->base + s->hdr->data_start; }
+
+class Guard {
+ public:
+  explicit Guard(Header* h) : h_(h) {
+    int rc = pthread_mutex_lock(&h_->mutex);
+    if (rc == EOWNERDEAD) {
+      // A process died holding the lock; state is still consistent for
+      // our operations (single-word transitions), recover the mutex.
+      pthread_mutex_consistent(&h_->mutex);
+    }
+  }
+  ~Guard() { pthread_mutex_unlock(&h_->mutex); }
+
+ private:
+  Header* h_;
+};
+
+Slot* find_slot(Header* h, const uint8_t* id) {
+  Slot* tab = slots(h);
+  for (uint32_t i = 0; i < h->num_slots; i++) {
+    if (tab[i].state != SLOT_EMPTY &&
+        memcmp(tab[i].id, id, kIdSize) == 0) {
+      return &tab[i];
+    }
+  }
+  return nullptr;
+}
+
+Slot* empty_slot(Header* h) {
+  Slot* tab = slots(h);
+  for (uint32_t i = 0; i < h->num_slots; i++) {
+    if (tab[i].state == SLOT_EMPTY) return &tab[i];
+  }
+  return nullptr;
+}
+
+void free_insert(Header* h, uint64_t offset, uint64_t size) {
+  FreeBlock* fl = free_list(h);
+  // Coalesce with an adjacent block if present.
+  for (uint32_t i = 0; i < h->free_count; i++) {
+    if (fl[i].offset + fl[i].size == offset) {
+      fl[i].size += size;
+      return;
+    }
+    if (offset + size == fl[i].offset) {
+      fl[i].offset = offset;
+      fl[i].size += size;
+      return;
+    }
+  }
+  if (h->free_count < kFreeListCap) {
+    fl[h->free_count++] = {offset, size};
+  }
+  // else: the block leaks until restart — bounded by kFreeListCap churn.
+}
+
+// First-fit allocation from free list, then bump pointer.
+int64_t alloc_block(Header* h, uint64_t size) {
+  FreeBlock* fl = free_list(h);
+  for (uint32_t i = 0; i < h->free_count; i++) {
+    if (fl[i].size >= size) {
+      uint64_t off = fl[i].offset;
+      fl[i].offset += size;
+      fl[i].size -= size;
+      if (fl[i].size == 0) {
+        fl[i] = fl[--h->free_count];
+      }
+      return static_cast<int64_t>(off);
+    }
+  }
+  if (h->bump + size <= h->capacity) {
+    uint64_t off = h->bump;
+    h->bump += size;
+    return static_cast<int64_t>(off);
+  }
+  return -1;
+}
+
+// Evict least-recently-used sealed refcount-0 objects until `size` fits.
+// Parity: plasma EvictionPolicy::RequireSpace (eviction_policy.h).
+bool evict_for(Header* h, uint64_t size) {
+  while (true) {
+    FreeBlock* fl = free_list(h);
+    bool fits = (h->bump + size <= h->capacity);
+    for (uint32_t i = 0; !fits && i < h->free_count; i++) {
+      fits = fl[i].size >= size;
+    }
+    if (fits) return true;
+
+    Slot* victim = nullptr;
+    Slot* tab = slots(h);
+    for (uint32_t i = 0; i < h->num_slots; i++) {
+      Slot* s = &tab[i];
+      if (s->state == SLOT_SEALED && s->refcount == 0 &&
+          (victim == nullptr || s->lru_tick < victim->lru_tick)) {
+        victim = s;
+      }
+    }
+    if (victim == nullptr) return false;
+    free_insert(h, victim->offset, victim->size);
+    h->bytes_used -= victim->size;
+    h->num_objects--;
+    h->evictions++;
+    victim->state = SLOT_EMPTY;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or open (owner=0) a store segment.
+int shm_store_open(const char* name, uint64_t capacity, uint32_t num_slots,
+                   int create, Store** out) {
+  int fd;
+  uint64_t meta = sizeof(Header) + sizeof(Slot) * (uint64_t)num_slots +
+                  sizeof(FreeBlock) * (uint64_t)kFreeListCap;
+  uint64_t total = meta + capacity;
+  if (create) {
+    shm_unlink(name);  // stale segment from a crashed run
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return -errno;
+    if (ftruncate(fd, (off_t)total) != 0) {
+      int e = errno;
+      close(fd);
+      shm_unlink(name);
+      return -e;
+    }
+  } else {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return -errno;
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      int e = errno;
+      close(fd);
+      return -e;
+    }
+    total = (uint64_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  Header* h = static_cast<Header*>(mem);
+  if (create) {
+    memset(mem, 0, meta);
+    h->magic = kMagic;
+    h->capacity = capacity;
+    h->data_start = meta;
+    h->num_slots = num_slots;
+    pthread_mutexattr_t attr;
+    pthread_mutexattr_init(&attr);
+    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&h->mutex, &attr);
+    pthread_mutexattr_destroy(&attr);
+  } else if (h->magic != kMagic) {
+    munmap(mem, total);
+    close(fd);
+    return -EINVAL;
+  }
+  Store* s = new Store();
+  s->hdr = h;
+  s->base = static_cast<uint8_t*>(mem);
+  s->map_size = total;
+  s->fd = fd;
+  s->owner = create != 0;
+  strncpy(s->name, name, sizeof(s->name) - 1);
+  *out = s;
+  return 0;
+}
+
+int shm_store_close(Store* s, int unlink_segment) {
+  munmap(s->base, s->map_size);
+  close(s->fd);
+  if (unlink_segment) shm_unlink(s->name);
+  delete s;
+  return 0;
+}
+
+// Allocate an object; returns a writable pointer.  Fails with -EEXIST if
+// the id is live, -ENOMEM if eviction can't make room, -ENOSPC if the
+// slot table is full.
+int shm_obj_create(Store* s, const uint8_t* id, uint64_t size, uint8_t** out) {
+  Guard g(s->hdr);
+  Header* h = s->hdr;
+  if (find_slot(h, id) != nullptr) return -EEXIST;
+  Slot* slot = empty_slot(h);
+  if (slot == nullptr) return -ENOSPC;
+  if (size > h->capacity) return -ENOMEM;
+  if (!evict_for(h, size)) return -ENOMEM;
+  int64_t off = alloc_block(h, size);
+  if (off < 0) return -ENOMEM;
+  memcpy(slot->id, id, kIdSize);
+  slot->state = SLOT_CREATED;
+  slot->refcount = 0;
+  slot->offset = (uint64_t)off;
+  slot->size = size;
+  slot->lru_tick = ++h->lru_clock;
+  h->bytes_used += size;
+  h->num_objects++;
+  *out = arena(s) + off;
+  return 0;
+}
+
+int shm_obj_seal(Store* s, const uint8_t* id) {
+  Guard g(s->hdr);
+  Slot* slot = find_slot(s->hdr, id);
+  if (slot == nullptr) return -ENOENT;
+  if (slot->state != SLOT_CREATED) return -EINVAL;
+  slot->state = SLOT_SEALED;
+  return 0;
+}
+
+// Pin + return a read pointer for a sealed object.  Caller must
+// shm_obj_release when done reading.
+int shm_obj_get(Store* s, const uint8_t* id, uint8_t** out, uint64_t* size) {
+  Guard g(s->hdr);
+  Slot* slot = find_slot(s->hdr, id);
+  if (slot == nullptr) return -ENOENT;
+  if (slot->state != SLOT_SEALED) return -EAGAIN;  // still being written
+  slot->refcount++;
+  slot->lru_tick = ++s->hdr->lru_clock;
+  *out = arena(s) + slot->offset;
+  *size = slot->size;
+  return 0;
+}
+
+int shm_obj_release(Store* s, const uint8_t* id) {
+  Guard g(s->hdr);
+  Slot* slot = find_slot(s->hdr, id);
+  if (slot == nullptr) return -ENOENT;
+  if (slot->refcount > 0) slot->refcount--;
+  return 0;
+}
+
+int shm_obj_contains(Store* s, const uint8_t* id) {
+  Guard g(s->hdr);
+  Slot* slot = find_slot(s->hdr, id);
+  return (slot != nullptr && slot->state == SLOT_SEALED) ? 1 : 0;
+}
+
+// Delete regardless of refcount==0 wait semantics: -EBUSY if referenced.
+int shm_obj_delete(Store* s, const uint8_t* id) {
+  Guard g(s->hdr);
+  Header* h = s->hdr;
+  Slot* slot = find_slot(h, id);
+  if (slot == nullptr) return -ENOENT;
+  if (slot->refcount > 0) return -EBUSY;
+  free_insert(h, slot->offset, slot->size);
+  h->bytes_used -= slot->size;
+  h->num_objects--;
+  slot->state = SLOT_EMPTY;
+  return 0;
+}
+
+int shm_store_stats(Store* s, uint64_t* capacity, uint64_t* used,
+                    uint64_t* num_objects, uint64_t* evictions) {
+  Guard g(s->hdr);
+  *capacity = s->hdr->capacity;
+  *used = s->hdr->bytes_used;
+  *num_objects = s->hdr->num_objects;
+  *evictions = s->hdr->evictions;
+  return 0;
+}
+
+}  // extern "C"

@@ -60,10 +60,17 @@ class ObjectRef:
 class ObjectState:
     """Store-side bookkeeping for one object (local runtime)."""
 
-    __slots__ = ("event", "value_bytes", "error", "in_band")
+    __slots__ = ("event", "value_bytes", "error", "in_band", "in_shm",
+                 "shm_size")
 
     def __init__(self):
         self.event = threading.Event()
         self.value_bytes: Optional[bytes] = None
         self.error: Optional[BaseException] = None
         self.in_band: Any = None
+        # Large objects live in the C++ shared-memory store, keyed by the
+        # ObjectID bytes (parity: plasma promotion for big values).
+        # Reader pins are GC-tied (shm_store.PinnedBuffer), no
+        # bookkeeping here.
+        self.in_shm: bool = False
+        self.shm_size: int = 0

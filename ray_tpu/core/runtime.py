@@ -1047,3 +1047,4 @@ class LocalRuntime:
         for shell in actors:
             shell.restarts_left = 0
             shell.kill()
+        self.store.close()

@@ -1,0 +1,257 @@
+"""Python binding for the C++ shared-memory object store.
+
+Parity with the reference's plasma client (ray:
+src/ray/object_manager/plasma/client.cc; worker-side wrapper
+core_worker/store_provider/plasma_store_provider.h:88): create/seal,
+zero-copy get (memoryview over the mapped arena), release, delete,
+contains, stats.  numpy arrays round-trip zero-copy on the read side
+(np.frombuffer over the arena).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import errno
+import os
+import time
+from typing import Optional, Tuple
+
+ID_SIZE = 32
+
+
+class ShmStoreError(OSError):
+    pass
+
+
+def _load_lib():
+    from ray_tpu._native import build_library
+
+    path = build_library("shm_store.cc", "libshm_store")
+    lib = ctypes.CDLL(path)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.shm_store_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.shm_store_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.shm_obj_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(u8p),
+    ]
+    lib.shm_obj_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_obj_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.shm_obj_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_obj_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_obj_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_stats.argtypes = [ctypes.c_void_p] + [
+        ctypes.POINTER(ctypes.c_uint64)
+    ] * 4
+    for fn in ("shm_store_open", "shm_store_close", "shm_obj_create",
+               "shm_obj_seal", "shm_obj_get", "shm_obj_release",
+               "shm_obj_contains", "shm_obj_delete", "shm_store_stats"):
+        getattr(lib, fn).restype = ctypes.c_int
+    return lib
+
+
+_lib = None
+
+
+def _get_lib():
+    global _lib
+    if _lib is None:
+        _lib = _load_lib()
+    return _lib
+
+
+def _check(rc: int, op: str):
+    if rc < 0:
+        raise ShmStoreError(-rc, f"{op}: {os.strerror(-rc)}")
+    return rc
+
+
+def _pad_id(object_id: bytes) -> bytes:
+    if len(object_id) > ID_SIZE:
+        raise ValueError(f"object id longer than {ID_SIZE} bytes")
+    return object_id.ljust(ID_SIZE, b"\x00")
+
+
+class PinnedBuffer:
+    """A pinned zero-copy read of one object.
+
+    The native refcount is decremented exactly once: by ``release()`` or
+    by the exporter's finalizer when the last aliasing view dies.
+    """
+
+    def __init__(self, store: "SharedMemoryStore", object_id: bytes,
+                 ptr, size: int):
+        import weakref
+
+        self.size = size
+        # ctypes array over the mapped arena; slices of its memoryview
+        # keep it (and thus the pin) alive.
+        self._arr = (ctypes.c_uint8 * size).from_address(
+            ctypes.addressof(ptr.contents)
+        )
+        # Bind to the lib handle, not the store object, so a dropped
+        # SharedMemoryStore wrapper doesn't block unpinning.
+        self._fin = weakref.finalize(
+            self._arr, _finalize_release, store._lib, store._handle,
+            _pad_id(object_id),
+        )
+
+    @property
+    def view(self) -> memoryview:
+        return memoryview(self._arr).cast("B")
+
+    def release(self) -> None:
+        """Explicit unpin (idempotent; safe alongside the finalizer)."""
+        self._fin()
+
+
+def _finalize_release(lib, handle, padded_id: bytes) -> None:
+    try:
+        if handle and handle.value:  # neutered by close()
+            lib.shm_obj_release(handle, padded_id)
+    except Exception:
+        pass
+
+
+class SharedMemoryStore:
+    """One mapped segment; many processes may open the same name."""
+
+    def __init__(self, name: str = None, *, capacity: int = 1 << 30,
+                 num_slots: int = 4096, create: bool = True):
+        self._lib = _get_lib()
+        self.name = name or f"/raytpu-store-{os.getpid()}"
+        if not self.name.startswith("/"):
+            self.name = "/" + self.name
+        self._handle = ctypes.c_void_p()
+        rc = self._lib.shm_store_open(
+            self.name.encode(), capacity, num_slots, 1 if create else 0,
+            ctypes.byref(self._handle),
+        )
+        _check(rc, "shm_store_open")
+        self._owner = create
+
+    @classmethod
+    def connect(cls, name: str) -> "SharedMemoryStore":
+        return cls(name, create=False)
+
+    # -- producer ----------------------------------------------------------
+
+    def create(self, object_id: bytes, size: int) -> memoryview:
+        """Allocate; returns a writable view.  Call seal() when done."""
+        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        rc = self._lib.shm_obj_create(
+            self._handle, _pad_id(object_id), size, ctypes.byref(ptr)
+        )
+        _check(rc, "create")
+        return memoryview(
+            (ctypes.c_uint8 * size).from_address(
+                ctypes.addressof(ptr.contents)
+            )
+        ).cast("B")
+
+    def seal(self, object_id: bytes) -> None:
+        _check(self._lib.shm_obj_seal(self._handle, _pad_id(object_id)),
+               "seal")
+
+    def put_bytes(self, object_id: bytes, data: bytes) -> None:
+        buf = self.create(object_id, len(data))
+        buf[:] = data
+        self.seal(object_id)
+
+    # -- consumer ----------------------------------------------------------
+
+    def get(self, object_id: bytes,
+            timeout: Optional[float] = None) -> "PinnedBuffer":
+        """Zero-copy read, pinned while any view of it is alive.
+
+        The pin (native refcount) drops when .release() is called OR when
+        the buffer exporter is garbage-collected — whichever comes first,
+        exactly once (parity: plasma buffers unpin on Python-object GC).
+        memoryview slices (e.g. zero-copy numpy arrays from deserialize)
+        keep the exporter — and therefore the pin — alive.
+        """
+        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        size = ctypes.c_uint64()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            rc = self._lib.shm_obj_get(
+                self._handle, _pad_id(object_id), ctypes.byref(ptr),
+                ctypes.byref(size),
+            )
+            if rc != -errno.EAGAIN and rc != -errno.ENOENT:
+                _check(rc, "get")
+                break
+            if deadline is None or time.monotonic() >= deadline:
+                _check(rc, "get")
+            time.sleep(0.0005)
+        return PinnedBuffer(self, object_id, ptr, size.value)
+
+    def get_bytes(self, object_id: bytes,
+                  timeout: Optional[float] = None) -> bytes:
+        pb = self.get(object_id, timeout)
+        try:
+            return bytes(pb.view)
+        finally:
+            pb.release()
+
+    def _release_id(self, object_id: bytes) -> None:
+        _check(self._lib.shm_obj_release(self._handle, _pad_id(object_id)),
+               "release")
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(
+            self._lib.shm_obj_contains(self._handle, _pad_id(object_id))
+        )
+
+    def delete(self, object_id: bytes) -> None:
+        _check(self._lib.shm_obj_delete(self._handle, _pad_id(object_id)),
+               "delete")
+
+    def stats(self) -> dict:
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        _check(
+            self._lib.shm_store_stats(self._handle, *map(ctypes.byref, vals)),
+            "stats",
+        )
+        return {
+            "capacity": vals[0].value,
+            "bytes_used": vals[1].value,
+            "num_objects": vals[2].value,
+            "evictions": vals[3].value,
+        }
+
+    def close(self, *, unlink: Optional[bool] = None,
+              keep_mapping: bool = False) -> None:
+        """``keep_mapping=True`` unlinks the segment name but leaves the
+        mapping alive until process exit — required when zero-copy reader
+        arrays may still alias the arena (runtime shutdown path)."""
+        if not self._handle or not self._handle.value:
+            return
+        do_unlink = self._owner if unlink is None else unlink
+        h = self._handle
+        if keep_mapping:
+            if do_unlink:
+                try:
+                    libc = ctypes.CDLL(None, use_errno=True)
+                    libc.shm_unlink(self.name.encode())
+                except Exception:
+                    pass
+        else:
+            self._lib.shm_store_close(h, 1 if do_unlink else 0)
+        # Outstanding PinnedBuffer finalizers captured this c_void_p —
+        # neuter it in place so late finalizers no-op instead of calling
+        # into a freed Store*.
+        h.value = None
+        self._handle = ctypes.c_void_p()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

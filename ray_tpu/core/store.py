@@ -20,19 +20,62 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
 from ray_tpu.core.object_ref import ObjectState
 from ray_tpu.utils.ids import ObjectID
-from ray_tpu.utils.serialization import deserialize_object, serialize_object
+import itertools as _itertools
+
+from ray_tpu.utils.serialization import (
+    deserialize_object,
+    framed_size,
+    serialize_parts,
+    write_framed,
+)
+
+_shm_seq = _itertools.count()
 
 
 class LocalObjectStore:
-    """Thread-safe map ObjectID → sealed value (serialized or in-band)."""
+    """Thread-safe map ObjectID → sealed value (serialized or in-band).
 
-    def __init__(self, *, serialize_always: bool = True):
+    Values whose serialized form exceeds ``shm_threshold`` bytes are
+    promoted into the C++ shared-memory store (ray_tpu.core.shm_store) —
+    the plasma-equivalent tier: zero-copy reads, LRU eviction, visible
+    to other processes that attach to the segment.
+    """
+
+    def __init__(self, *, serialize_always: bool = True,
+                 shm_threshold: int = 256 * 1024,
+                 shm_capacity: int = 4 << 30):
         self._lock = threading.Lock()
         self._objects: Dict[ObjectID, ObjectState] = {}
         # Serializing everything (even in local mode) keeps semantics
         # identical to the distributed path: values are snapshots, and
         # non-serializable values fail at put-time, not at scale-up time.
         self._serialize_always = serialize_always
+        self._shm_threshold = shm_threshold
+        self._shm_capacity = shm_capacity
+        self._shm = None
+        self._shm_failed = False
+        self._shm_lock = threading.Lock()
+
+    def _shm_store(self):
+        """Lazily build/attach the native store (lock: two racing large
+        puts must not each create-and-unlink the segment); None if
+        unbuildable (no g++) — callers fall back to in-process bytes."""
+        with self._shm_lock:
+            if self._shm is None and not self._shm_failed:
+                try:
+                    from ray_tpu.core.shm_store import SharedMemoryStore
+                    import os
+
+                    # Unique name per store instance: several runtimes in
+                    # one process (tests) must not unlink each other.
+                    seq = next(_shm_seq)
+                    self._shm = SharedMemoryStore(
+                        f"/raytpu-{os.getpid()}-{seq}",
+                        capacity=self._shm_capacity, num_slots=65536,
+                    )
+                except Exception:
+                    self._shm_failed = True
+            return self._shm
 
     def _state(self, oid: ObjectID) -> ObjectState:
         with self._lock:
@@ -46,7 +89,24 @@ class LocalObjectStore:
     def put_value(self, oid: ObjectID, value: Any) -> None:
         st = self._state(oid)
         if self._serialize_always:
-            st.value_bytes = serialize_object(value)
+            meta, buffers = serialize_parts(value)
+            size = framed_size(meta, buffers)
+            shm = (self._shm_store()
+                   if size >= self._shm_threshold else None)
+            if shm is not None:
+                try:
+                    # Frame straight into the arena — no intermediate copy.
+                    buf = shm.create(oid.binary(), size)
+                    write_framed(buf, meta, buffers)
+                    shm.seal(oid.binary())
+                    st.in_shm = True
+                    st.shm_size = size
+                except Exception:
+                    shm = None  # full/unavailable → local tier
+            if shm is None:
+                out = bytearray(size)
+                write_framed(memoryview(out), meta, buffers)
+                st.value_bytes = bytes(out)
         else:
             st.in_band = value
         st.event.set()
@@ -76,6 +136,25 @@ class LocalObjectStore:
                                   f"{oid.hex()}")
         if st.error is not None:
             raise st.error
+        if st.in_shm:
+            shm = self._shm_store()
+            if shm is None:  # store closed under a racing reader
+                raise ObjectLostError(
+                    f"object {oid.hex()}: shared-memory store is closed"
+                )
+            try:
+                pinned = shm.get(oid.binary(), timeout=0.0)
+            except OSError:
+                raise ObjectLostError(
+                    f"object {oid.hex()} was evicted from the shared-memory "
+                    f"store (size {st.shm_size}) — increase capacity or "
+                    f"release refs sooner"
+                ) from None
+            # Zero-copy: deserialized arrays alias the arena through the
+            # pinned exporter; the native refcount drops automatically
+            # when the last view is garbage-collected (parity: plasma
+            # buffers unpin on Python-object GC).
+            return deserialize_object(pinned.view)
         if st.value_bytes is not None:
             return deserialize_object(st.value_bytes)
         return st.in_band
@@ -114,7 +193,23 @@ class LocalObjectStore:
 
     def release(self, oid: ObjectID) -> None:
         with self._lock:
-            self._objects.pop(oid, None)
+            st = self._objects.pop(oid, None)
+        if st is not None and st.in_shm and self._shm is not None:
+            try:
+                # EBUSY while readers still hold views — their GC
+                # finalizers drop the pins and LRU reclaims the block.
+                self._shm.delete(oid.binary())
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        if self._shm is not None:
+            # keep_mapping: readers may still hold zero-copy arrays into
+            # the arena; the name is unlinked, the mapping lives until
+            # process exit.
+            self._shm.close(unlink=True, keep_mapping=True)
+            self._shm = None
+        self._shm_failed = True  # don't resurrect after shutdown
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -123,8 +218,11 @@ class LocalObjectStore:
                 len(s.value_bytes) for s in self._objects.values()
                 if s.value_bytes is not None
             )
-            return {
+            out = {
                 "num_objects": len(self._objects),
                 "num_sealed": sealed,
                 "bytes": nbytes,
             }
+        if self._shm is not None:
+            out["shm"] = self._shm.stats()
+        return out

@@ -1,0 +1,197 @@
+"""C++ shared-memory object store tests.
+
+Mirrors the reference's plasma test strategy (ray:
+src/ray/object_manager/plasma/test/, python/ray/tests/test_plasma*):
+lifecycle, zero-copy reads, eviction under pressure, pinning, and a real
+second process attaching to the same segment.
+"""
+
+import multiprocessing
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.shm_store import SharedMemoryStore, ShmStoreError
+
+
+@pytest.fixture
+def store():
+    s = SharedMemoryStore(f"/raytpu-test-{os.getpid()}",
+                          capacity=1 << 20, num_slots=64)
+    yield s
+    s.close(unlink=True)
+
+
+def test_put_get_roundtrip(store):
+    store.put_bytes(b"obj1", b"hello world")
+    assert store.contains(b"obj1")
+    assert store.get_bytes(b"obj1") == b"hello world"
+
+
+def test_create_seal_lifecycle(store):
+    buf = store.create(b"obj2", 5)
+    assert not store.contains(b"obj2")  # not sealed yet
+    buf[:] = b"abcde"
+    store.seal(b"obj2")
+    assert store.get_bytes(b"obj2") == b"abcde"
+
+
+def test_duplicate_create_rejected(store):
+    store.put_bytes(b"dup", b"x")
+    with pytest.raises(ShmStoreError):
+        store.create(b"dup", 1)
+
+
+def test_get_missing_raises(store):
+    with pytest.raises(ShmStoreError):
+        store.get_bytes(b"nope", timeout=0.05)
+
+
+def test_zero_copy_numpy_view(store):
+    arr = np.arange(1000, dtype=np.float32)
+    store.put_bytes(b"arr", arr.tobytes())
+    pb = store.get(b"arr")
+    out = np.frombuffer(pb.view, dtype=np.float32)
+    np.testing.assert_array_equal(out, arr)
+    del out
+    pb.release()
+
+
+def test_pin_drops_on_gc():
+    """The native refcount must fall when the last aliasing view dies —
+    no explicit release (the runtime integration depends on this)."""
+    import gc
+
+    s = SharedMemoryStore(f"/raytpu-gc-{os.getpid()}",
+                          capacity=1 << 20, num_slots=64)
+    try:
+        s.put_bytes(b"g", bytes(300 * 1024))
+        pb = s.get(b"g")
+        arr = np.frombuffer(pb.view, dtype=np.uint8)
+        del pb  # views still alive → still pinned
+        for i in range(8):  # pressure: pinned object must survive
+            s.put_bytes(f"fill{i}".encode(), bytes(200 * 1024))
+        assert s.contains(b"g")
+        del arr
+        gc.collect()
+        # Unpinned now: enough pressure evicts it.
+        for i in range(8, 16):
+            s.put_bytes(f"fill{i}".encode(), bytes(200 * 1024))
+        assert not s.contains(b"g")
+    finally:
+        s.close(unlink=True)
+
+
+def test_eviction_under_pressure(store):
+    # Fill beyond capacity with unreferenced sealed objects: LRU evicts.
+    blob = bytes(200 * 1024)
+    for i in range(10):  # 2 MB total into a 1 MB store
+        store.put_bytes(f"blob{i}".encode(), blob)
+    stats = store.stats()
+    assert stats["evictions"] > 0
+    assert stats["bytes_used"] <= stats["capacity"]
+    # The newest object must still be there; the oldest must be gone.
+    assert store.contains(b"blob9")
+    assert not store.contains(b"blob0")
+
+
+def test_pinned_objects_survive_eviction(store):
+    store.put_bytes(b"pinned", bytes(300 * 1024))
+    pb = store.get(b"pinned")  # refcount = 1
+    blob = bytes(200 * 1024)
+    for i in range(8):
+        store.put_bytes(f"fill{i}".encode(), blob)
+    assert store.contains(b"pinned")  # never evicted while pinned
+    pb.release()
+
+
+def test_delete_and_busy(store):
+    store.put_bytes(b"d", b"1234")
+    pb = store.get(b"d")
+    with pytest.raises(ShmStoreError):
+        store.delete(b"d")  # pinned → EBUSY
+    pb.release()
+    store.delete(b"d")
+    assert not store.contains(b"d")
+
+
+def test_capacity_exceeded_raises(store):
+    with pytest.raises(ShmStoreError):
+        store.create(b"huge", 2 << 20)  # bigger than the whole store
+
+
+def _child_reads(name, q):
+    try:
+        s = SharedMemoryStore.connect(name)
+        q.put(s.get_bytes(b"xproc"))
+        s.put_bytes(b"from-child", b"child-data")
+        s.close(unlink=False)
+    except Exception as e:  # pragma: no cover
+        q.put(e)
+
+
+def test_cross_process_sharing():
+    """A second OS process maps the same segment and reads/writes."""
+    name = f"/raytpu-xproc-{os.getpid()}"
+    s = SharedMemoryStore(name, capacity=1 << 20, num_slots=64)
+    try:
+        s.put_bytes(b"xproc", b"parent-data")
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_reads, args=(name, q))
+        p.start()
+        got = q.get(timeout=60)
+        p.join(timeout=30)
+        assert got == b"parent-data", got
+        assert s.get_bytes(b"from-child") == b"child-data"
+    finally:
+        s.close(unlink=True)
+
+
+def test_stats_accounting(store):
+    before = store.stats()
+    store.put_bytes(b"s1", bytes(1000))
+    after = store.stats()
+    assert after["num_objects"] == before["num_objects"] + 1
+    assert after["bytes_used"] == before["bytes_used"] + 1000
+
+
+# -- integration with the runtime object store ----------------------------
+
+
+def test_runtime_large_objects_go_to_shm():
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        big = np.arange(1 << 20, dtype=np.float32)  # 4 MB > threshold
+        ref = ray_tpu.put(big)
+        out = ray_tpu.get(ref)
+        np.testing.assert_array_equal(out, big)
+        stats = rt.store.stats()
+        assert "shm" in stats and stats["shm"]["num_objects"] >= 1
+        # Small objects stay in the local tier.
+        small_ref = ray_tpu.put(b"tiny")
+        assert ray_tpu.get(small_ref) == b"tiny"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_runtime_shm_roundtrip_through_task():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        big = np.ones(1 << 20, dtype=np.float32)
+        ref = double.remote(ray_tpu.put(big))
+        out = ray_tpu.get(ref)
+        np.testing.assert_array_equal(out, big * 2)
+    finally:
+        ray_tpu.shutdown()
