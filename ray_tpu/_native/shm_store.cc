@@ -34,8 +34,9 @@ constexpr uint32_t kFreeListCap = 4096;
 
 enum SlotState : uint32_t {
   SLOT_EMPTY = 0,
-  SLOT_CREATED = 1,  // allocated, producer writing
-  SLOT_SEALED = 2,   // immutable, readable
+  SLOT_CREATED = 1,    // allocated, producer writing
+  SLOT_SEALED = 2,     // immutable, readable
+  SLOT_TOMBSTONE = 3,  // deleted/evicted; keeps hash probe chains intact
 };
 
 struct Slot {
@@ -63,6 +64,8 @@ struct Header {
   uint64_t bytes_used;
   uint64_t num_objects;
   uint64_t evictions;
+  uint32_t tombstones;
+  uint32_t pad_;
   pthread_mutex_t mutex;
   // followed by: Slot[num_slots], FreeBlock[kFreeListCap], arena
 };
@@ -87,14 +90,19 @@ FreeBlock* free_list(Header* h) {
 
 uint8_t* arena(Store* s) { return s->base + s->hdr->data_start; }
 
+void rebuild_allocator(Header* h);
+
 class Guard {
  public:
   explicit Guard(Header* h) : h_(h) {
     int rc = pthread_mutex_lock(&h_->mutex);
     if (rc == EOWNERDEAD) {
-      // A process died holding the lock; state is still consistent for
-      // our operations (single-word transitions), recover the mutex.
+      // A process died holding the lock.  Allocator mutations are
+      // multi-word, so assume the free list / counters are torn and
+      // rebuild them from the slot table (the authoritative record:
+      // every slot mutation is a single state-word transition last).
       pthread_mutex_consistent(&h_->mutex);
+      rebuild_allocator(h_);
     }
   }
   ~Guard() { pthread_mutex_unlock(&h_->mutex); }
@@ -103,43 +111,156 @@ class Guard {
   Header* h_;
 };
 
+// FNV-1a over the 32-byte id.
+uint64_t hash_id(const uint8_t* id) {
+  uint64_t x = 1469598103934665603ULL;
+  for (int i = 0; i < kIdSize; i++) {
+    x = (x ^ id[i]) * 1099511628211ULL;
+  }
+  return x;
+}
+
+// Open-addressed linear probe: O(1) expected.  TOMBSTONE keeps probe
+// chains intact across deletions; probing stops at a true EMPTY.
 Slot* find_slot(Header* h, const uint8_t* id) {
   Slot* tab = slots(h);
-  for (uint32_t i = 0; i < h->num_slots; i++) {
-    if (tab[i].state != SLOT_EMPTY &&
-        memcmp(tab[i].id, id, kIdSize) == 0) {
-      return &tab[i];
+  uint64_t start = hash_id(id) % h->num_slots;
+  for (uint32_t k = 0; k < h->num_slots; k++) {
+    Slot* s = &tab[(start + k) % h->num_slots];
+    if (s->state == SLOT_EMPTY) return nullptr;
+    if (s->state != SLOT_TOMBSTONE && memcmp(s->id, id, kIdSize) == 0) {
+      return s;
     }
   }
   return nullptr;
 }
 
-Slot* empty_slot(Header* h) {
+// Insert position for a new id: first tombstone on the probe path, else
+// the terminating empty.  nullptr when the table is full.
+Slot* insert_slot(Header* h, const uint8_t* id) {
   Slot* tab = slots(h);
-  for (uint32_t i = 0; i < h->num_slots; i++) {
-    if (tab[i].state == SLOT_EMPTY) return &tab[i];
+  uint64_t start = hash_id(id) % h->num_slots;
+  Slot* reuse = nullptr;
+  for (uint32_t k = 0; k < h->num_slots; k++) {
+    Slot* s = &tab[(start + k) % h->num_slots];
+    if (s->state == SLOT_TOMBSTONE) {
+      if (reuse == nullptr) reuse = s;
+      continue;
+    }
+    if (s->state == SLOT_EMPTY) return reuse ? reuse : s;
   }
-  return nullptr;
+  return reuse;
+}
+
+void clear_slot(Header* h, Slot* s) {
+  s->state = SLOT_TOMBSTONE;
+  h->tombstones++;
+  // Tombstone-heavy tables degrade probes; rehash in place when a
+  // quarter of the table is dead.
+  if (h->tombstones > h->num_slots / 4) {
+    Slot* tab = slots(h);
+    // Copy live slots out (bounded: kMaxRehash live entries on stack
+    // per chunk would be complex; do a simple mark-and-reinsert using
+    // the TOMBSTONE→EMPTY sweep + robin-hood-free reinsert loop).
+    for (uint32_t i = 0; i < h->num_slots; i++) {
+      if (tab[i].state == SLOT_TOMBSTONE) tab[i].state = SLOT_EMPTY;
+    }
+    h->tombstones = 0;
+    // Reinsert every live slot whose probe position moved.
+    for (uint32_t i = 0; i < h->num_slots; i++) {
+      if (tab[i].state == SLOT_EMPTY) continue;
+      Slot tmp = tab[i];
+      tab[i].state = SLOT_EMPTY;
+      Slot* dst = insert_slot(h, tmp.id);
+      *dst = tmp;
+    }
+  }
 }
 
 void free_insert(Header* h, uint64_t offset, uint64_t size) {
   FreeBlock* fl = free_list(h);
-  // Coalesce with an adjacent block if present.
-  for (uint32_t i = 0; i < h->free_count; i++) {
-    if (fl[i].offset + fl[i].size == offset) {
-      fl[i].size += size;
-      return;
+  // Coalesce to fixpoint: merging can make the merged block adjacent to
+  // further entries (eviction order is LRU, not address order).
+  bool merged = true;
+  while (merged) {
+    merged = false;
+    for (uint32_t i = 0; i < h->free_count; i++) {
+      if (fl[i].offset + fl[i].size == offset) {
+        offset = fl[i].offset;
+        size += fl[i].size;
+        fl[i] = fl[--h->free_count];
+        merged = true;
+        break;
+      }
+      if (offset + size == fl[i].offset) {
+        size += fl[i].size;
+        fl[i] = fl[--h->free_count];
+        merged = true;
+        break;
+      }
     }
-    if (offset + size == fl[i].offset) {
-      fl[i].offset = offset;
-      fl[i].size += size;
-      return;
-    }
+  }
+  // A block ending at the high-water mark returns to the bump region.
+  if (offset + size == h->bump) {
+    h->bump = offset;
+    return;
   }
   if (h->free_count < kFreeListCap) {
     fl[h->free_count++] = {offset, size};
   }
   // else: the block leaks until restart — bounded by kFreeListCap churn.
+}
+
+// Rebuild free list + counters from the slot table after a torn
+// allocator mutation (robust-mutex recovery).  Unsealed (CREATED) slots
+// may belong to the dead producer — drop them.
+void rebuild_allocator(Header* h) {
+  Slot* tab = slots(h);
+  h->free_count = 0;
+  h->bytes_used = 0;
+  h->num_objects = 0;
+  uint64_t max_end = 0;
+  for (uint32_t i = 0; i < h->num_slots; i++) {
+    Slot* s = &tab[i];
+    if (s->state == SLOT_CREATED) {
+      s->state = SLOT_TOMBSTONE;
+      h->tombstones++;
+    }
+    if (s->state == SLOT_SEALED) {
+      h->bytes_used += s->size;
+      h->num_objects++;
+      if (s->offset + s->size > max_end) max_end = s->offset + s->size;
+    }
+  }
+  // Free space = everything below the live high-water mark that no
+  // sealed slot covers.  Collect gaps by sorting live extents.
+  h->bump = max_end;
+  // Insertion-sort live extents into a bounded stack array; fall back
+  // to "no free list" (bump-only) if there are too many.
+  constexpr uint32_t kMaxLive = 8192;
+  static thread_local FreeBlock live[kMaxLive];
+  uint32_t n = 0;
+  for (uint32_t i = 0; i < h->num_slots && n < kMaxLive; i++) {
+    if (tab[i].state == SLOT_SEALED) live[n++] = {tab[i].offset, tab[i].size};
+  }
+  if (n < kMaxLive) {
+    for (uint32_t i = 1; i < n; i++) {
+      FreeBlock key = live[i];
+      uint32_t j = i;
+      while (j > 0 && live[j - 1].offset > key.offset) {
+        live[j] = live[j - 1];
+        j--;
+      }
+      live[j] = key;
+    }
+    uint64_t cursor = 0;
+    for (uint32_t i = 0; i < n; i++) {
+      if (live[i].offset > cursor && h->free_count < kFreeListCap) {
+        free_list(h)[h->free_count++] = {cursor, live[i].offset - cursor};
+      }
+      cursor = live[i].offset + live[i].size;
+    }
+  }
 }
 
 // First-fit allocation from free list, then bump pointer.
@@ -189,7 +310,7 @@ bool evict_for(Header* h, uint64_t size) {
     h->bytes_used -= victim->size;
     h->num_objects--;
     h->evictions++;
-    victim->state = SLOT_EMPTY;
+    clear_slot(h, victim);
   }
 }
 
@@ -275,8 +396,9 @@ int shm_obj_create(Store* s, const uint8_t* id, uint64_t size, uint8_t** out) {
   Guard g(s->hdr);
   Header* h = s->hdr;
   if (find_slot(h, id) != nullptr) return -EEXIST;
-  Slot* slot = empty_slot(h);
+  Slot* slot = insert_slot(h, id);
   if (slot == nullptr) return -ENOSPC;
+  if (slot->state == SLOT_TOMBSTONE) h->tombstones--;
   if (size > h->capacity) return -ENOMEM;
   if (!evict_for(h, size)) return -ENOMEM;
   int64_t off = alloc_block(h, size);
@@ -340,7 +462,7 @@ int shm_obj_delete(Store* s, const uint8_t* id) {
   free_insert(h, slot->offset, slot->size);
   h->bytes_used -= slot->size;
   h->num_objects--;
-  slot->state = SLOT_EMPTY;
+  clear_slot(h, slot);
   return 0;
 }
 

@@ -125,6 +125,12 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         return self._create(args, kwargs, {})
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (parity: ray DAGNode bind, dag/class_node.py)."""
+        from ray_tpu.util.dag import bind_class
+
+        return bind_class(self, *args, **kwargs)
+
     def options(self, **overrides) -> "_BoundActorOptions":
         _make_actor_options(self._default_options, overrides)  # validate
         return _BoundActorOptions(self, overrides)

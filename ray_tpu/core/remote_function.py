@@ -50,6 +50,12 @@ class RemoteFunction:
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         return self._submit(args, kwargs, {})
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (parity: ray DAGNode bind, dag/function_node.py)."""
+        from ray_tpu.util.dag import bind_function
+
+        return bind_function(self, *args, **kwargs)
+
     def options(self, **overrides) -> "_BoundOptions":
         _make_task_options(self._default_options, overrides)  # validate now
         return _BoundOptions(self, overrides)

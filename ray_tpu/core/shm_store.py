@@ -95,8 +95,11 @@ class PinnedBuffer:
         self._arr = (ctypes.c_uint8 * size).from_address(
             ctypes.addressof(ptr.contents)
         )
-        # Bind to the lib handle, not the store object, so a dropped
-        # SharedMemoryStore wrapper doesn't block unpinning.
+        # The exporter holds the store strongly: a GC'd store wrapper
+        # must not munmap the arena under live views.  store.close()
+        # checks _live_pins and keeps the mapping if any remain.
+        self._arr._owner_store = store
+        store._live_pins.add(self._arr)
         self._fin = weakref.finalize(
             self._arr, _finalize_release, store._lib, store._handle,
             _pad_id(object_id),
@@ -124,6 +127,8 @@ class SharedMemoryStore:
 
     def __init__(self, name: str = None, *, capacity: int = 1 << 30,
                  num_slots: int = 4096, create: bool = True):
+        import weakref
+
         self._lib = _get_lib()
         self.name = name or f"/raytpu-store-{os.getpid()}"
         if not self.name.startswith("/"):
@@ -135,6 +140,14 @@ class SharedMemoryStore:
         )
         _check(rc, "shm_store_open")
         self._owner = create
+        self._live_pins = weakref.WeakSet()
+
+    def _h(self):
+        """Reject calls after close() — passing the neutered handle into
+        the C library would dereference a freed Store*."""
+        if not self._handle or not self._handle.value:
+            raise ShmStoreError(errno.EBADF, "store is closed")
+        return self._handle
 
     @classmethod
     def connect(cls, name: str) -> "SharedMemoryStore":
@@ -146,7 +159,7 @@ class SharedMemoryStore:
         """Allocate; returns a writable view.  Call seal() when done."""
         ptr = ctypes.POINTER(ctypes.c_uint8)()
         rc = self._lib.shm_obj_create(
-            self._handle, _pad_id(object_id), size, ctypes.byref(ptr)
+            self._h(), _pad_id(object_id), size, ctypes.byref(ptr)
         )
         _check(rc, "create")
         return memoryview(
@@ -156,7 +169,7 @@ class SharedMemoryStore:
         ).cast("B")
 
     def seal(self, object_id: bytes) -> None:
-        _check(self._lib.shm_obj_seal(self._handle, _pad_id(object_id)),
+        _check(self._lib.shm_obj_seal(self._h(), _pad_id(object_id)),
                "seal")
 
     def put_bytes(self, object_id: bytes, data: bytes) -> None:
@@ -181,7 +194,7 @@ class SharedMemoryStore:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             rc = self._lib.shm_obj_get(
-                self._handle, _pad_id(object_id), ctypes.byref(ptr),
+                self._h(), _pad_id(object_id), ctypes.byref(ptr),
                 ctypes.byref(size),
             )
             if rc != -errno.EAGAIN and rc != -errno.ENOENT:
@@ -201,22 +214,22 @@ class SharedMemoryStore:
             pb.release()
 
     def _release_id(self, object_id: bytes) -> None:
-        _check(self._lib.shm_obj_release(self._handle, _pad_id(object_id)),
+        _check(self._lib.shm_obj_release(self._h(), _pad_id(object_id)),
                "release")
 
     def contains(self, object_id: bytes) -> bool:
         return bool(
-            self._lib.shm_obj_contains(self._handle, _pad_id(object_id))
+            self._lib.shm_obj_contains(self._h(), _pad_id(object_id))
         )
 
     def delete(self, object_id: bytes) -> None:
-        _check(self._lib.shm_obj_delete(self._handle, _pad_id(object_id)),
+        _check(self._lib.shm_obj_delete(self._h(), _pad_id(object_id)),
                "delete")
 
     def stats(self) -> dict:
         vals = [ctypes.c_uint64() for _ in range(4)]
         _check(
-            self._lib.shm_store_stats(self._handle, *map(ctypes.byref, vals)),
+            self._lib.shm_store_stats(self._h(), *map(ctypes.byref, vals)),
             "stats",
         )
         return {
@@ -235,6 +248,10 @@ class SharedMemoryStore:
             return
         do_unlink = self._owner if unlink is None else unlink
         h = self._handle
+        # Live pins mean zero-copy views still alias the arena; munmap
+        # would yank memory out from under them — keep the mapping.
+        if not keep_mapping and len(self._live_pins) > 0:
+            keep_mapping = True
         if keep_mapping:
             if do_unlink:
                 try:

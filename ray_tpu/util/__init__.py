@@ -9,12 +9,30 @@ from ray_tpu.core.placement_group import (
     placement_group,
     remove_placement_group,
 )
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.dag import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+)
+from ray_tpu.util.queue import Empty, Full, Queue
 
 __all__ = [
+    "ActorPool",
+    "ClassMethodNode",
+    "ClassNode",
+    "DAGNode",
+    "Empty",
+    "Full",
+    "FunctionNode",
+    "InputNode",
     "NodeAffinitySchedulingStrategy",
     "NodeLabelSchedulingStrategy",
     "PlacementGroup",
     "PlacementGroupSchedulingStrategy",
+    "Queue",
     "get_placement_group",
     "placement_group",
     "remove_placement_group",

@@ -1,0 +1,104 @@
+"""ActorPool — map work over a fixed set of actors.
+
+Parity with the reference (ray: python/ray/util/actor_pool.py —
+ActorPool: submit, map, map_unordered, get_next, get_next_unordered,
+has_next, push/pop idle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+from ray_tpu.core import api
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        if not self._idle:
+            raise ValueError("ActorPool needs at least one actor")
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; runs when an actor is idle."""
+        if not self._idle:
+            # Block until some in-flight task finishes, freeing an actor.
+            self._wait_for_any()
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = (self._next_task_index, actor)
+        self._index_to_future[self._next_task_index] = ref
+        self._next_task_index += 1
+
+    def _wait_for_any(self) -> None:
+        # Only refs whose actor hasn't been reclaimed yet are in flight.
+        pending = [r for r, (_, a) in self._future_to_actor.items()
+                   if a is not None]
+        ready, _ = api.wait(pending, num_returns=1)
+        for ref in ready:
+            idx, actor = self._future_to_actor[ref]
+            if actor is not None:
+                self._idle.append(actor)
+                self._future_to_actor[ref] = (idx, None)
+
+    def has_next(self) -> bool:
+        return self._next_return_index < self._next_task_index
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in submission order.  A timeout leaves the result
+        retrievable by a later call (parity: ray ActorPool)."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ref = self._index_to_future[self._next_return_index]
+        value = api.get(ref, timeout=timeout)  # raises → state untouched
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        entry = self._future_to_actor.pop(ref, None)
+        if entry is not None and entry[1] is not None:
+            self._idle.append(entry[1])
+        return value
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next result in completion order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        refs = list(self._index_to_future.values())
+        ready, _ = api.wait(refs, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        for idx, r in list(self._index_to_future.items()):
+            if r == ref:
+                del self._index_to_future[idx]
+                break
+        self._next_return_index += 1
+        value = api.get(ref)
+        entry = self._future_to_actor.pop(ref, None)
+        if entry is not None and entry[1] is not None:
+            self._idle.append(entry[1])
+        return value
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def push(self, actor: Any) -> None:
+        self._idle.append(actor)
+
+    def pop_idle(self) -> Any:
+        return self._idle.pop() if self._idle else None
+
+    @property
+    def num_idle(self) -> int:
+        return len(self._idle)

@@ -1,0 +1,139 @@
+"""Lazy DAG API — build task/actor call graphs, execute on demand.
+
+Parity with the reference (ray: python/ray/dag/dag_node.py DAGNode;
+function_node.py FunctionNode, class_node.py ClassNode/ClassMethodNode,
+input_node.py InputNode): ``fn.bind(x)`` builds nodes instead of
+executing; ``node.execute(input)`` walks the graph, submits tasks in
+dependency order (diamonds execute once), and returns the final ref.
+Serve deployment graphs and the workflow engine build on this.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import api
+
+
+class DAGNode:
+    def execute(self, *args) -> Any:
+        """Execute the graph rooted here; returns an ObjectRef (or a
+        plain value for InputNode)."""
+        cache: Dict[int, Any] = {}
+        dag_input = args[0] if args else None
+        return _resolve(self, dag_input, cache)
+
+    # -- traversal helpers -------------------------------------------------
+
+    def _children(self) -> List["DAGNode"]:
+        out = []
+
+        def scan(v):
+            if isinstance(v, DAGNode):
+                out.append(v)
+            elif isinstance(v, (list, tuple)):
+                for e in v:
+                    scan(e)
+            elif isinstance(v, dict):
+                for e in v.values():
+                    scan(e)
+
+        for v in getattr(self, "args", ()):  # type: ignore[attr-defined]
+            scan(v)
+        for v in getattr(self, "kwargs", {}).values():  # type: ignore
+            scan(v)
+        return out
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value passed to execute() (parity:
+    dag/input_node.py InputNode)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+
+
+class ClassNode(DAGNode):
+    """A bound actor constructor; method calls on it create
+    ClassMethodNodes sharing one actor instance per execution."""
+
+    def __init__(self, actor_cls, args: tuple, kwargs: dict):
+        self.actor_cls = actor_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodBinder(self, name)
+
+
+class _MethodBinder:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self.class_node = class_node
+        self.method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self.class_node, self.method_name, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method_name: str,
+                 args: tuple, kwargs: dict):
+        self.class_node = class_node
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+
+
+def _map_args(args, kwargs, dag_input, cache):
+    def mp(v):
+        if isinstance(v, DAGNode):
+            return _resolve(v, dag_input, cache)
+        if isinstance(v, (list, tuple)):
+            return type(v)(mp(e) for e in v)
+        if isinstance(v, dict):
+            return {k: mp(e) for k, e in v.items()}
+        return v
+
+    return tuple(mp(a) for a in args), {k: mp(v) for k, v in kwargs.items()}
+
+
+def _resolve(node: DAGNode, dag_input: Any, cache: Dict[int, Any]) -> Any:
+    key = id(node)
+    if key in cache:
+        return cache[key]
+    if isinstance(node, InputNode):
+        result = dag_input
+    elif isinstance(node, FunctionNode):
+        args, kwargs = _map_args(node.args, node.kwargs, dag_input, cache)
+        result = node.remote_fn.remote(*args, **kwargs)
+    elif isinstance(node, ClassNode):
+        args, kwargs = _map_args(node.args, node.kwargs, dag_input, cache)
+        result = node.actor_cls.remote(*args, **kwargs)  # ActorHandle
+    elif isinstance(node, ClassMethodNode):
+        handle = _resolve(node.class_node, dag_input, cache)
+        args, kwargs = _map_args(node.args, node.kwargs, dag_input, cache)
+        result = getattr(handle, node.method_name).remote(*args, **kwargs)
+    else:
+        raise TypeError(f"unknown DAG node {type(node).__name__}")
+    cache[key] = result
+    return result
+
+
+def bind_function(remote_fn, *args, **kwargs) -> FunctionNode:
+    return FunctionNode(remote_fn, args, kwargs)
+
+
+def bind_class(actor_cls, *args, **kwargs) -> ClassNode:
+    return ClassNode(actor_cls, args, kwargs)

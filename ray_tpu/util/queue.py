@@ -1,0 +1,136 @@
+"""Distributed Queue — an actor-backed FIFO shared across tasks/actors.
+
+Parity with the reference (ray: python/ray/util/queue.py — Queue backed
+by a _QueueActor; put/get with block/timeout, qsize/empty/full,
+put_nowait/get_nowait, shutdown).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+from ray_tpu.core import api
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import collections
+
+        self.maxsize = maxsize
+        self._q = collections.deque()
+
+    def qsize(self) -> int:
+        return len(self._q)
+
+    def put(self, item: Any) -> bool:
+        if self.maxsize > 0 and len(self._q) >= self.maxsize:
+            return False
+        self._q.append(item)
+        return True
+
+    def get(self) -> tuple:
+        if not self._q:
+            return (False, None)
+        return (True, self._q.popleft())
+
+    def put_batch(self, items: List[Any]) -> int:
+        n = 0
+        for it in items:
+            if self.maxsize > 0 and len(self._q) >= self.maxsize:
+                break
+            self._q.append(it)
+            n += 1
+        return n
+
+    def get_batch(self, n: int) -> List[Any]:
+        out = []
+        while self._q and len(out) < n:
+            out.append(self._q.popleft())
+        return out
+
+
+class Queue:
+    """Client handle; safe to pass into tasks/actors (pickles by actor)."""
+
+    POLL_S = 0.005
+
+    def __init__(self, maxsize: int = 0, *, _actor=None, _maxsize_hint=0):
+        if _actor is not None:
+            self._actor = _actor
+            self._maxsize = _maxsize_hint
+        else:
+            self._maxsize = maxsize
+            self._actor = api.remote(_QueueActor).options(num_cpus=0).remote(
+                maxsize
+            )
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if api.get(self._actor.put.remote(item)):
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full()
+            time.sleep(self.POLL_S)
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = api.get(self._actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty()
+            time.sleep(self.POLL_S)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_batch(self, items: List[Any]) -> None:
+        items = list(items)
+        while items:
+            n = api.get(self._actor.put_batch.remote(items))
+            items = items[n:]
+            if items:
+                time.sleep(self.POLL_S)
+
+    def get_batch(self, n: int) -> List[Any]:
+        return api.get(self._actor.get_batch.remote(n))
+
+    def qsize(self) -> int:
+        return api.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self._maxsize > 0 and self.qsize() >= self._maxsize
+
+    def shutdown(self) -> None:
+        api.kill(self._actor)
+
+    def __reduce__(self):
+        # Pickling rebuilds the handle around the same queue actor, so a
+        # Queue passed into tasks/actors addresses the shared FIFO.
+        return (_queue_reconstruct, (self._actor, self._maxsize))
+
+
+def _queue_reconstruct(actor_handle, maxsize=0):
+    return Queue(_actor=actor_handle, _maxsize_hint=maxsize)

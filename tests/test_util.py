@@ -1,0 +1,183 @@
+"""Util library tests: ActorPool, distributed Queue, DAG API.
+
+Mirrors the reference's util tests (ray: python/ray/tests/
+test_actor_pool.py, test_queue.py, python/ray/dag/tests/).
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Full, InputNode, Queue
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Worker:
+    def __init__(self, factor=1):
+        self.factor = factor
+
+    def mul(self, x):
+        return x * self.factor
+
+    def slow_mul(self, x):
+        time.sleep(0.05 * (5 - x))  # later submissions finish earlier
+        return x * self.factor
+
+
+# -- ActorPool --------------------------------------------------------------
+
+
+def test_actor_pool_map_ordered():
+    pool = ActorPool([Worker.remote(2) for _ in range(3)])
+    out = list(pool.map(lambda a, v: a.mul.remote(v), range(8)))
+    assert out == [v * 2 for v in range(8)]
+
+
+def test_actor_pool_map_unordered():
+    pool = ActorPool([Worker.remote(10) for _ in range(4)])
+    out = list(pool.map_unordered(
+        lambda a, v: a.slow_mul.remote(v), range(5)
+    ))
+    assert sorted(out) == [v * 10 for v in range(5)]
+
+
+def test_actor_pool_submit_get_next():
+    pool = ActorPool([Worker.remote(1)])
+    pool.submit(lambda a, v: a.mul.remote(v), 7)
+    assert pool.has_next()
+    assert pool.get_next() == 7
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+# -- Queue ------------------------------------------------------------------
+
+
+def test_queue_fifo():
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert [q.get() for _ in range(5)] == list(range(5))
+    assert q.empty()
+
+
+def test_queue_maxsize_and_nowait():
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    assert q.get_nowait() == 1
+    q.put(3)
+    with pytest.raises(Empty):
+        Queue().get_nowait()
+
+
+def test_queue_get_timeout():
+    q = Queue()
+    t0 = time.monotonic()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    assert time.monotonic() - t0 >= 0.2
+
+
+def test_queue_shared_across_tasks():
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    ray_tpu.get(producer.remote(q, 4))
+    assert sorted(q.get_batch(4)) == [0, 1, 2, 3]
+
+
+def test_queue_blocking_put_unblocks():
+    q = Queue(maxsize=1)
+    q.put("a")
+    done = []
+
+    def putter():
+        q.put("b", timeout=5)
+        done.append(True)
+
+    t = threading.Thread(target=putter)
+    t.start()
+    time.sleep(0.1)
+    assert q.get() == "a"
+    t.join(timeout=5)
+    assert done and q.get() == "b"
+
+
+# -- DAG --------------------------------------------------------------------
+
+
+def test_function_dag():
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    with InputNode() as inp:
+        graph = mul.bind(add.bind(inp, 2), 10)
+    assert ray_tpu.get(graph.execute(3)) == 50
+
+
+def test_diamond_dag_executes_shared_node_once():
+    calls = []
+
+    @ray_tpu.remote
+    def base(x):
+        calls.append(1)
+        return x + 1
+
+    @ray_tpu.remote
+    def left(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def right(x):
+        return x * 3
+
+    @ray_tpu.remote
+    def join(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        b = base.bind(inp)
+        graph = join.bind(left.bind(b), right.bind(b))
+    assert ray_tpu.get(graph.execute(1)) == 2 * 2 + 2 * 3
+    assert len(calls) == 1  # diamond: base ran once
+
+
+def test_actor_dag():
+    with InputNode() as inp:
+        w = Worker.bind(5)
+        graph = w.mul.bind(inp)
+    assert ray_tpu.get(graph.execute(4)) == 20
+
+
+def test_dag_reexecution_is_independent():
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    graph = inc.bind(InputNode())
+    assert ray_tpu.get(graph.execute(1)) == 2
+    assert ray_tpu.get(graph.execute(10)) == 11
