@@ -99,7 +99,9 @@ class PinnedBuffer:
         # must not munmap the arena under live views.  store.close()
         # checks _live_pins and keeps the mapping if any remain.
         self._arr._owner_store = store
-        store._live_pins.add(self._arr)
+        # ctypes arrays are unhashable (no WeakSet); a WeakValueDictionary
+        # keyed by id() drops the entry when the exporter is GC'd.
+        store._live_pins[id(self._arr)] = self._arr
         self._fin = weakref.finalize(
             self._arr, _finalize_release, store._lib, store._handle,
             _pad_id(object_id),
@@ -140,7 +142,7 @@ class SharedMemoryStore:
         )
         _check(rc, "shm_store_open")
         self._owner = create
-        self._live_pins = weakref.WeakSet()
+        self._live_pins = weakref.WeakValueDictionary()
 
     def _h(self):
         """Reject calls after close() — passing the neutered handle into
