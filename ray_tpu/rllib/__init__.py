@@ -1,0 +1,31 @@
+"""ray_tpu.rllib — reinforcement learning on the TPU-native runtime.
+
+Capabilities modeled on the reference's RLlib (ray: rllib/ — Algorithm
+:191 in algorithms/algorithm.py, RolloutWorker, replay buffers, V-trace)
+re-architected for XLA: envs are pure jax functions, rollouts compile
+into lax.scan, and learner updates are single jitted programs.
+Distributed sampling uses EnvRunner actors over ray_tpu.core.
+
+    from ray_tpu.rllib import PPOConfig
+    algo = PPOConfig().environment("CartPole-v1").build()
+    for _ in range(10):
+        print(algo.train()["episode_return_mean"])
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms import (DQN, IMPALA, PPO, DQNConfig,
+                                      IMPALAConfig, PPOConfig, vtrace)
+from ray_tpu.rllib.env import (CartPole, ExternalEnv, Pendulum, make_env,
+                               register_env)
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.models import ActorCritic
+from ray_tpu.rllib.replay_buffer import DeviceReplayBuffer, HostReplayBuffer
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig",
+    "PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
+    "vtrace",
+    "CartPole", "Pendulum", "ExternalEnv", "make_env", "register_env",
+    "EnvRunnerGroup", "ActorCritic",
+    "DeviceReplayBuffer", "HostReplayBuffer",
+]

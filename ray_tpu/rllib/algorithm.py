@@ -1,0 +1,185 @@
+"""Algorithm / AlgorithmConfig — the RLlib-equivalent driver API.
+
+Parity with the reference (ray: rllib/algorithms/algorithm.py:191
+``Algorithm`` — a Tune Trainable with train()/save()/restore();
+rllib/algorithms/algorithm_config.py ``AlgorithmConfig`` — fluent
+builder with .environment()/.training()/.env_runners()/.resources()).
+
+TPU redesign: an iteration is one jitted program (sample + learn fused)
+rather than a fleet of Python rollout workers; distributed sampling is
+opt-in via ``.env_runners(num_env_runners=N)`` which places EnvRunner
+actors on the core runtime (used by IMPALA-style algorithms).
+"""
+
+from __future__ import annotations
+
+import copy
+import pickle
+import time
+from typing import Any, Dict, Optional, Type
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.tune.tuner import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent config builder; subclasses add algorithm-specific fields."""
+
+    def __init__(self):
+        self.env = "CartPole-v1"
+        self.env_config: Dict[str, Any] = {}
+        self.num_envs = 16
+        self.rollout_length = 128
+        self.num_env_runners = 0
+        self.gamma = 0.99
+        self.lr = 3e-4
+        self.train_batch_size = 2048
+        self.seed = 0
+        self.hidden = (64, 64)
+        self.num_tpus = 0.0
+
+    # -- fluent sections (each returns self, parity with the reference) --
+
+    def environment(self, env=None, *, env_config: Optional[dict] = None):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def env_runners(self, *, num_env_runners: int = 0,
+                    num_envs: Optional[int] = None,
+                    rollout_length: Optional[int] = None):
+        self.num_env_runners = num_env_runners
+        if num_envs is not None:
+            self.num_envs = num_envs
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def resources(self, *, num_tpus: float = 0.0):
+        self.num_tpus = num_tpus
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in vars(self).items()
+                if not k.startswith("_")}
+
+    def update_from_dict(self, d: Dict[str, Any]) -> "AlgorithmConfig":
+        for k, v in d.items():
+            setattr(self, k, v)
+        return self
+
+    @property
+    def algo_class(self) -> Type["Algorithm"]:
+        raise NotImplementedError
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(config=self)
+
+
+class Algorithm(Trainable):
+    """Base class; subclasses implement _setup() and _train_once().
+
+    Runs standalone (``algo = cfg.build(); algo.train()``) or as a Tune
+    trainable (class-trainable protocol: setup/step/save_checkpoint/
+    load_checkpoint), mirroring the reference where Algorithm IS a
+    Trainable.
+    """
+
+    config_class: Type[AlgorithmConfig] = AlgorithmConfig
+
+    def __init__(self, config: Optional[AlgorithmConfig] = None, **kwargs):
+        if config is None:
+            config = self.config_class()
+        if kwargs:  # tune passes a flat dict config
+            config = config.copy().update_from_dict(kwargs)
+        self.config = config
+        self.env = make_env(config.env, **config.env_config)
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._last_episode_return = float("nan")
+        self._setup()
+
+    # -- Tune class-trainable protocol ------------------------------------
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        # Re-init under tune with the sampled hyperparameters; release
+        # resources (e.g. EnvRunner fleets) held by the first __init__.
+        self.stop()
+        self.__init__(self.config, **config)
+
+    def step(self) -> Dict[str, Any]:
+        return self.train()
+
+    def save_checkpoint(self) -> Any:
+        return pickle.dumps(self.get_state())
+
+    def load_checkpoint(self, checkpoint: Any) -> None:
+        self.set_state(pickle.loads(checkpoint))
+
+    # -- RLlib-parity surface ---------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        metrics = self._train_once()
+        self.iteration += 1
+        self._timesteps_total += int(metrics.pop("_timesteps", 0))
+        ret = metrics.get("episode_return_mean")
+        if ret is not None and ret == ret:  # not NaN
+            self._last_episode_return = ret
+        else:
+            metrics["episode_return_mean"] = self._last_episode_return
+        metrics.update(
+            training_iteration=self.iteration,
+            timesteps_total=self._timesteps_total,
+            time_this_iter_s=time.perf_counter() - t0,
+        )
+        return metrics
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        pass
+
+    def get_state(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def save(self, path: str) -> str:
+        with open(path, "wb") as f:
+            f.write(self.save_checkpoint())
+        return path
+
+    @classmethod
+    def from_checkpoint(cls, path: str, config=None) -> "Algorithm":
+        algo = cls(config=config)
+        with open(path, "rb") as f:
+            algo.load_checkpoint(f.read())
+        return algo
+
+    # -- subclass hooks ----------------------------------------------------
+
+    def _setup(self) -> None:
+        raise NotImplementedError
+
+    def _train_once(self) -> Dict[str, Any]:
+        raise NotImplementedError

@@ -1,0 +1,6 @@
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA",
+           "IMPALAConfig", "vtrace"]

@@ -1,0 +1,246 @@
+"""DQN — double Q-learning with an on-device replay buffer.
+
+Parity target: the reference's DQN/Apex family (ray:
+rllib/algorithms/dqn/dqn.py — replay buffer + target network + double-Q
+loss).  TPU redesign: the replay buffer is device-resident
+(ray_tpu.rllib.replay_buffer.DeviceReplayBuffer) and one ``train()``
+iteration — K env steps interleaved with K/train_freq SGD updates — is a
+single ``lax.scan`` inside one jit, so exploration, buffer writes,
+sampling and learning never leave the chip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import init_q_net, q_values
+from ray_tpu.rllib.replay_buffer import BufferState, DeviceReplayBuffer
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.buffer_capacity = 50_000
+        self.learning_starts = 1_000
+        self.train_batch_size = 64
+        self.train_freq = 4              # env steps between SGD updates
+        self.target_update_freq = 500    # env steps between target syncs
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 10_000
+        self.double_q = True
+        self.steps_per_iteration = 1_024
+        self.num_envs = 8
+
+    @property
+    def algo_class(self):
+        return DQN
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+
+    def _setup(self) -> None:
+        cfg = self.config
+        env = self.env
+        if not env.discrete:
+            raise ValueError("DQN requires a discrete action space")
+        obs_dim, act_dim = env.observation_size, env.action_size
+        key = jax.random.key(cfg.seed)
+        key, k_init, k_reset = jax.random.split(key, 3)
+        self.params = init_q_net(k_init, obs_dim, act_dim, cfg.hidden)
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: x, self.params
+        )
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = DeviceReplayBuffer(cfg.buffer_capacity, {
+            "obs": ((obs_dim,), jnp.float32),
+            "action": ((), jnp.int32),
+            "reward": ((), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "done": ((), jnp.float32),
+        })
+        self.buf_state = self.buffer.init()
+        reset_keys = jax.random.split(k_reset, cfg.num_envs)
+        self.env_state, self.obs = jax.vmap(env.reset)(reset_keys)
+        self.ep_ret = jnp.zeros(cfg.num_envs)
+        self.total_env_steps = jnp.zeros((), jnp.int32)
+        self.key = key
+        self._iteration_fn = jax.jit(
+            partial(_dqn_iteration, env, self.buffer, self.tx,
+                    _static_cfg(cfg))
+        )
+
+    def _train_once(self) -> Dict[str, Any]:
+        self.key, it_key = jax.random.split(self.key)
+        (self.params, self.target_params, self.opt_state, self.buf_state,
+         self.env_state, self.obs, self.ep_ret, self.total_env_steps,
+         metrics) = self._iteration_fn(
+            self.params, self.target_params, self.opt_state,
+            self.buf_state, self.env_state, self.obs, self.ep_ret,
+            self.total_env_steps, it_key,
+        )
+        out = {k: float(v) for k, v in metrics.items()}
+        out["_timesteps"] = (
+            self.config.steps_per_iteration * self.config.num_envs
+        )
+        return out
+
+    def compute_single_action(self, obs, explore: bool = False):
+        cfg = self.config
+        if explore:
+            eps = float(np.clip(
+                cfg.epsilon_start
+                + (cfg.epsilon_end - cfg.epsilon_start)
+                * int(self.total_env_steps) / cfg.epsilon_decay_steps,
+                cfg.epsilon_end, cfg.epsilon_start,
+            ))
+            self.key, k1, k2 = jax.random.split(self.key, 3)
+            if float(jax.random.uniform(k1)) < eps:
+                return int(jax.random.randint(
+                    k2, (), 0, self.env.action_size
+                ))
+        q = q_values(self.params, jnp.asarray(obs))
+        return int(jnp.argmax(q))
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "params": jax.device_get(self.params),
+            "target_params": jax.device_get(self.target_params),
+            "opt_state": jax.device_get(self.opt_state),
+            "iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "total_env_steps": int(self.total_env_steps),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.target_params = jax.device_put(state["target_params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+        self.total_env_steps = jnp.asarray(
+            state["total_env_steps"], jnp.int32
+        )
+
+
+def _static_cfg(cfg: DQNConfig):
+    return (cfg.steps_per_iteration, cfg.train_batch_size, cfg.train_freq,
+            cfg.target_update_freq, cfg.gamma, cfg.epsilon_start,
+            cfg.epsilon_end, cfg.epsilon_decay_steps, cfg.double_q,
+            cfg.learning_starts)
+
+
+def _dqn_iteration(env, buffer, tx, scfg, params, target_params, opt_state,
+                   buf_state, env_state, obs, ep_ret, total_steps, key):
+    (T, batch_size, train_freq, target_freq, gamma, eps0, eps1,
+     eps_decay, double_q, learning_starts) = scfg
+    n_envs = obs.shape[0]
+    v_step = jax.vmap(env.step)
+    v_reset = jax.vmap(env.reset)
+
+    def td_loss(p, tp, mb):
+        q = q_values(p, mb["obs"])
+        q_taken = jnp.take_along_axis(
+            q, mb["action"][:, None], axis=1
+        )[:, 0]
+        q_next_target = q_values(tp, mb["next_obs"])
+        if double_q:
+            a_star = jnp.argmax(q_values(p, mb["next_obs"]), axis=1)
+            q_next = jnp.take_along_axis(
+                q_next_target, a_star[:, None], axis=1
+            )[:, 0]
+        else:
+            q_next = jnp.max(q_next_target, axis=1)
+        target = mb["reward"] + gamma * (1.0 - mb["done"]) * q_next
+        return jnp.mean((q_taken - lax.stop_gradient(target)) ** 2)
+
+    def one_step(carry, step_key):
+        (params, target_params, opt_state, buf_state, env_state, obs,
+         ep_ret, total_steps, ret_sum, ret_cnt) = carry
+        k_eps, k_act, k_reset, k_sample = jax.random.split(step_key, 4)
+        eps = jnp.clip(
+            eps0 + (eps1 - eps0) * total_steps / eps_decay, eps1, eps0
+        )
+        q = q_values(params, obs)
+        greedy = jnp.argmax(q, axis=1).astype(jnp.int32)
+        random_a = jax.random.randint(
+            k_act, (n_envs,), 0, env.action_size
+        )
+        explore = jax.random.uniform(k_eps, (n_envs,)) < eps
+        action = jnp.where(explore, random_a, greedy)
+        next_env_state, next_obs, reward, done = v_step(env_state, action)
+        buf_state = buffer.add_batch(buf_state, {
+            "obs": obs, "action": action, "reward": reward,
+            "next_obs": next_obs, "done": done.astype(jnp.float32),
+        })
+        ep_ret = ep_ret + reward
+        ret_sum = ret_sum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+        ret_cnt = ret_cnt + jnp.sum(done)
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        reset_keys = jax.random.split(k_reset, n_envs)
+        r_state, r_obs = v_reset(reset_keys)
+        next_env_state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(
+                jnp.reshape(done, done.shape + (1,) * (r.ndim - 1)), r, c
+            ),
+            r_state, next_env_state,
+        )
+        next_obs = jnp.where(done[:, None], r_obs, next_obs)
+        total_steps = total_steps + n_envs
+
+        def do_update(args):
+            params, opt_state = args
+            mb = buffer.sample(buf_state, k_sample, batch_size)
+            loss, grads = jax.value_and_grad(td_loss)(
+                params, target_params, mb
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        should_train = (
+            (buf_state.size >= learning_starts)
+            & ((total_steps // n_envs) % train_freq == 0)
+        )
+        params, opt_state, loss = lax.cond(
+            should_train, do_update,
+            lambda args: (args[0], args[1], jnp.float32(0.0)),
+            (params, opt_state),
+        )
+        target_params = lax.cond(
+            (total_steps // n_envs) % max(target_freq // n_envs, 1) == 0,
+            lambda _: params, lambda _: target_params, None,
+        )
+        carry = (params, target_params, opt_state, buf_state,
+                 next_env_state, next_obs, ep_ret, total_steps,
+                 ret_sum, ret_cnt)
+        return carry, loss
+
+    step_keys = jax.random.split(key, T)
+    init = (params, target_params, opt_state, buf_state, env_state, obs,
+            ep_ret, total_steps, jnp.float32(0.0), jnp.int32(0))
+    (params, target_params, opt_state, buf_state, env_state, obs, ep_ret,
+     total_steps, ret_sum, ret_cnt), losses = lax.scan(
+        one_step, init, step_keys)
+    metrics = {
+        "episode_return_mean": jnp.where(
+            ret_cnt > 0, ret_sum / jnp.maximum(ret_cnt, 1), jnp.nan
+        ),
+        "loss_mean": jnp.mean(losses),
+        "buffer_size": buf_state.size,
+        "epsilon": jnp.clip(
+            eps0 + (eps1 - eps0) * total_steps / eps_decay, eps1, eps0
+        ),
+    }
+    return (params, target_params, opt_state, buf_state, env_state, obs,
+            ep_ret, total_steps, metrics)

@@ -1,0 +1,174 @@
+"""Functional jax environments — the RL env layer, TPU-first.
+
+The reference's env layer (ray: rllib/env/env_runner.py:9,
+rllib/evaluation/rollout_worker.py:159) steps Python gym envs one
+``env.step()`` call at a time inside actor processes.  On TPU that
+per-step host round-trip would dominate; here an environment is a pure
+function of (state, action) so the whole rollout — policy forward, env
+dynamics, auto-reset — compiles into ONE ``lax.scan`` and vmaps over
+thousands of parallel envs on the MXU.  External (non-jax) envs still
+work through :class:`ExternalEnv` on CPU actors.
+
+Env protocol (all methods pure, shapes static):
+
+    env.reset(key)          -> (state, obs)
+    env.step(state, action) -> (state, obs, reward, done)
+    env.observation_size / env.action_size / env.discrete
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+State = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class CartPole:
+    """Classic cart-pole balancing (standard dynamics; episode caps at
+    ``max_steps``).  Discrete 2-action, 4-dim observation."""
+
+    gravity: float = 9.8
+    cart_mass: float = 1.0
+    pole_mass: float = 0.1
+    pole_len: float = 0.5  # half-length
+    force_mag: float = 10.0
+    dt: float = 0.02
+    theta_limit: float = 12 * 2 * jnp.pi / 360
+    x_limit: float = 2.4
+    max_steps: int = 500
+
+    observation_size: int = 4
+    action_size: int = 2
+    discrete: bool = True
+
+    def reset(self, key: jax.Array) -> Tuple[State, jax.Array]:
+        obs = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        state = {"obs": obs, "t": jnp.zeros((), jnp.int32)}
+        return state, obs
+
+    def step(self, state: State, action: jax.Array):
+        x, x_dot, theta, theta_dot = state["obs"]
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+        total_mass = self.cart_mass + self.pole_mass
+        pm_len = self.pole_mass * self.pole_len
+        temp = (force + pm_len * theta_dot**2 * sin_t) / total_mass
+        theta_acc = (self.gravity * sin_t - cos_t * temp) / (
+            self.pole_len * (4.0 / 3.0 - self.pole_mass * cos_t**2 / total_mass)
+        )
+        x_acc = temp - pm_len * theta_acc * cos_t / total_mass
+        x = x + self.dt * x_dot
+        x_dot = x_dot + self.dt * x_acc
+        theta = theta + self.dt * theta_dot
+        theta_dot = theta_dot + self.dt * theta_acc
+        obs = jnp.stack([x, x_dot, theta, theta_dot])
+        t = state["t"] + 1
+        done = (
+            (jnp.abs(x) > self.x_limit)
+            | (jnp.abs(theta) > self.theta_limit)
+            | (t >= self.max_steps)
+        )
+        return {"obs": obs, "t": t}, obs, jnp.float32(1.0), done
+
+
+@dataclasses.dataclass(frozen=True)
+class Pendulum:
+    """Torque-controlled pendulum swing-up; continuous 1-dim action in
+    [-max_torque, max_torque], 3-dim observation (cos, sin, theta_dot)."""
+
+    max_speed: float = 8.0
+    max_torque: float = 2.0
+    dt: float = 0.05
+    gravity: float = 10.0
+    mass: float = 1.0
+    length: float = 1.0
+    max_steps: int = 200
+
+    observation_size: int = 3
+    action_size: int = 1
+    discrete: bool = False
+
+    def _obs(self, theta, theta_dot):
+        return jnp.stack([jnp.cos(theta), jnp.sin(theta), theta_dot])
+
+    def reset(self, key: jax.Array):
+        k1, k2 = jax.random.split(key)
+        theta = jax.random.uniform(k1, (), minval=-jnp.pi, maxval=jnp.pi)
+        theta_dot = jax.random.uniform(k2, (), minval=-1.0, maxval=1.0)
+        state = {"theta": theta, "theta_dot": theta_dot,
+                 "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(theta, theta_dot)
+
+    def step(self, state: State, action: jax.Array):
+        u = jnp.clip(jnp.squeeze(action), -self.max_torque, self.max_torque)
+        theta, theta_dot = state["theta"], state["theta_dot"]
+        norm_theta = ((theta + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_theta**2 + 0.1 * theta_dot**2 + 0.001 * u**2
+        g, m, l, dt = self.gravity, self.mass, self.length, self.dt
+        theta_dot = theta_dot + (
+            3 * g / (2 * l) * jnp.sin(theta) + 3.0 / (m * l**2) * u
+        ) * dt
+        theta_dot = jnp.clip(theta_dot, -self.max_speed, self.max_speed)
+        theta = theta + theta_dot * dt
+        t = state["t"] + 1
+        done = t >= self.max_steps
+        new_state = {"theta": theta, "theta_dot": theta_dot, "t": t}
+        return new_state, self._obs(theta, theta_dot), -cost, done
+
+
+class ExternalEnv:
+    """Adapter for Python (gym/gymnasium-style) envs.
+
+    Used by EnvRunner actors on CPU hosts for envs that can't be
+    expressed in jax (parity with the reference's default path).  Not
+    jittable; rollouts fall back to a host loop.
+    """
+
+    def __init__(self, make_env):
+        self._make_env = make_env
+        self._env = make_env()
+        space = self._env.action_space
+        self.discrete = hasattr(space, "n")
+        self.action_size = space.n if self.discrete else space.shape[0]
+        self.observation_size = self._env.observation_space.shape[0]
+
+    def reset(self, seed=None):
+        out = self._env.reset(seed=seed)
+        return out[0] if isinstance(out, tuple) else out
+
+    def step(self, action):
+        out = self._env.step(action)
+        if len(out) == 5:  # gymnasium: obs, r, terminated, truncated, info
+            obs, r, term, trunc, _ = out
+            return obs, r, bool(term or trunc)
+        obs, r, done, _ = out
+        return obs, r, bool(done)
+
+    def clone(self) -> "ExternalEnv":
+        return ExternalEnv(self._make_env)
+
+
+_REGISTRY = {"CartPole-v1": CartPole, "Pendulum-v1": Pendulum}
+
+
+def register_env(name: str, ctor) -> None:
+    """Parity: ray.tune.register_env."""
+    _REGISTRY[name] = ctor
+
+
+def make_env(spec, **config):
+    if isinstance(spec, str):
+        if spec not in _REGISTRY:
+            raise KeyError(
+                f"unknown env {spec!r}; registered: {sorted(_REGISTRY)}"
+            )
+        return _REGISTRY[spec](**config)
+    if isinstance(spec, type):
+        return spec(**config)
+    return spec

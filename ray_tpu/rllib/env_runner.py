@@ -1,0 +1,165 @@
+"""EnvRunner — distributed sampling actors.
+
+Parity with the reference's EnvRunner/RolloutWorker fleet (ray:
+rllib/env/env_runner.py:9, rllib/evaluation/rollout_worker.py:159,
+worker_set.py:80): N actors each own env instances and a policy copy,
+collect trajectories on request, and accept weight broadcasts.  Here
+each runner still executes its rollout as ONE jitted lax.scan (CPU
+backend on plain hosts), and ships time-major numpy batches through the
+object store.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+class _EnvRunnerImpl:
+    """Plain class; wrapped by @ray_tpu.remote in EnvRunnerGroup so the
+    resource request can be chosen at construction time."""
+
+    def __init__(self, env_spec, env_config: Dict[str, Any], net_spec,
+                 num_envs: int, rollout_length: int, seed: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib import sampler
+        from ray_tpu.rllib.env import make_env
+        from ray_tpu.rllib.models import ActorCritic
+
+        from ray_tpu.rllib.env import ExternalEnv
+
+        self.jax, self.jnp = jax, jnp
+        self.env = make_env(env_spec, **env_config)
+        self.net = ActorCritic(
+            self.env.observation_size, self.env.action_size,
+            discrete=self.env.discrete, hidden=net_spec["hidden"],
+        )
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        key = jax.random.key(seed)
+        self.key, k_reset = jax.random.split(key)
+        self._params = None
+        self.is_external = isinstance(self.env, ExternalEnv)
+        if self.is_external:
+            # Host-loop path for Python (gym-style) envs: one env copy
+            # per slot, stepped sequentially each timestep.
+            self._envs = [self.env] + [
+                self.env.clone() for _ in range(num_envs - 1)
+            ]
+            self._host_obs = np.stack([
+                np.asarray(e.reset(seed=seed + i), np.float32)
+                for i, e in enumerate(self._envs)
+            ])
+            self._host_ep_ret = np.zeros(num_envs, np.float32)
+        else:
+            reset_keys = jax.random.split(k_reset, num_envs)
+            self.env_state, self.obs = jax.vmap(self.env.reset)(reset_keys)
+            self.ep_ret = jnp.zeros(num_envs)
+            self.ep_len = jnp.zeros(num_envs, jnp.int32)
+
+            def _unroll(params, env_state, obs, ep_ret, ep_len, k):
+                return sampler.unroll(
+                    self.env, self.net, params, env_state, obs, ep_ret,
+                    ep_len, k, self.rollout_length,
+                )
+
+            self._unroll = jax.jit(_unroll)
+
+    def set_weights(self, params) -> None:
+        self._params = self.jax.device_put(params)
+
+    def sample(self, params: Optional[Any] = None) -> Dict[str, np.ndarray]:
+        """One rollout; returns a time-major numpy SampleBatch dict."""
+        if params is not None:
+            self.set_weights(params)
+        if self._params is None:
+            raise RuntimeError("no weights set on this EnvRunner")
+        if self.is_external:
+            return self._sample_host()
+        self.key, k = self.jax.random.split(self.key)
+        (self.env_state, self.obs, self.ep_ret, self.ep_len,
+         roll) = self._unroll(
+            self._params, self.env_state, self.obs, self.ep_ret,
+            self.ep_len, k,
+        )
+        out = {
+            "obs": roll.obs, "action": roll.action, "reward": roll.reward,
+            "done": roll.done, "log_prob": roll.log_prob,
+            "last_obs": self.obs, "episode_return": roll.episode_return,
+        }
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def _sample_host(self) -> Dict[str, np.ndarray]:
+        """Sequential host loop over Python envs (ExternalEnv path)."""
+        jax, jnp = self.jax, self.jnp
+        T, N = self.rollout_length, self.num_envs
+        obs_buf = np.zeros((T, N) + self._host_obs.shape[1:], np.float32)
+        act_shape = () if self.env.discrete else (self.env.action_size,)
+        act_buf = np.zeros((T, N) + act_shape,
+                           np.int32 if self.env.discrete else np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), bool)
+        logp_buf = np.zeros((T, N), np.float32)
+        eret_buf = np.full((T, N), np.nan, np.float32)
+        for t in range(T):
+            self.key, k = jax.random.split(self.key)
+            act_keys = jax.random.split(k, N)
+            actions, logps = jax.vmap(
+                self.net.sample_action, (None, 0, 0)
+            )(self._params, jnp.asarray(self._host_obs), act_keys)
+            actions, logps = np.asarray(actions), np.asarray(logps)
+            obs_buf[t] = self._host_obs
+            act_buf[t] = actions
+            logp_buf[t] = logps
+            for i, e in enumerate(self._envs):
+                a = (int(actions[i]) if self.env.discrete
+                     else np.asarray(actions[i]))
+                o, r, d = e.step(a)
+                rew_buf[t, i] = r
+                done_buf[t, i] = d
+                self._host_ep_ret[i] += r
+                if d:
+                    eret_buf[t, i] = self._host_ep_ret[i]
+                    self._host_ep_ret[i] = 0.0
+                    o = e.reset()
+                self._host_obs[i] = np.asarray(o, np.float32)
+        return {
+            "obs": obs_buf, "action": act_buf, "reward": rew_buf,
+            "done": done_buf, "log_prob": logp_buf,
+            "last_obs": self._host_obs.copy(),
+            "episode_return": eret_buf,
+        }
+
+
+class EnvRunnerGroup:
+    """Fleet manager (parity: rllib WorkerSet).  Round-robins sample()
+    calls and broadcasts weights; failures surface as task errors the
+    algorithm can retry."""
+
+    def __init__(self, *, num_env_runners: int, env_spec, env_config,
+                 net_spec, num_envs: int, rollout_length: int, seed: int,
+                 num_cpus_per_runner: float = 1.0):
+        runner_cls = ray_tpu.remote(num_cpus=num_cpus_per_runner)(
+            _EnvRunnerImpl
+        )
+        self.runners = [
+            runner_cls.remote(env_spec, dict(env_config), dict(net_spec),
+                              num_envs, rollout_length, seed + 1000 * i)
+            for i in range(num_env_runners)
+        ]
+
+    def set_weights(self, params) -> None:
+        ray_tpu.get([r.set_weights.remote(params) for r in self.runners])
+
+    def sample_async(self, params=None):
+        """Returns one ObjectRef per runner (in-flight rollouts)."""
+        return [r.sample.remote(params) for r in self.runners]
+
+    def stop(self) -> None:
+        for r in self.runners:
+            ray_tpu.kill(r)

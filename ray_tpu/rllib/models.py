@@ -1,0 +1,133 @@
+"""Policy / value networks — pure-jax functional, like ray_tpu.models.
+
+Parity slot: the reference's model catalog + RLModule (ray:
+rllib/core/rl_module/rl_module.py, rllib/models/catalog.py) — a
+framework-agnostic container for policy networks.  Here networks are
+(init, apply) function pairs over plain pytrees so they jit/vmap/grad
+cleanly and slot into the same sharding machinery as the big models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def _dense_init(key, in_dim: int, out_dim: int, scale: float) -> Params:
+    # Orthogonal init (standard for PPO-family stability).
+    w = jax.nn.initializers.orthogonal(scale)(key, (in_dim, out_dim))
+    return {"w": w, "b": jnp.zeros((out_dim,))}
+
+
+def _dense(p: Params, x: jax.Array) -> jax.Array:
+    return x @ p["w"] + p["b"]
+
+
+def init_mlp(key, in_dim: int, hidden: Sequence[int], out_dim: int,
+             final_scale: float = 0.01) -> Params:
+    dims = [in_dim, *hidden]
+    keys = jax.random.split(key, len(dims))
+    layers = [
+        _dense_init(keys[i], dims[i], dims[i + 1], scale=jnp.sqrt(2.0))
+        for i in range(len(dims) - 1)
+    ]
+    layers.append(_dense_init(keys[-1], dims[-1], out_dim, final_scale))
+    return {"layers": layers}
+
+
+def apply_mlp(p: Params, x: jax.Array) -> jax.Array:
+    for layer in p["layers"][:-1]:
+        x = jnp.tanh(_dense(layer, x))
+    return _dense(p["layers"][-1], x)
+
+
+class ActorCritic:
+    """Separate policy and value MLPs; categorical or diagonal-gaussian
+    action head chosen by ``discrete``."""
+
+    def __init__(self, obs_dim: int, act_dim: int, *, discrete: bool,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim, self.act_dim = obs_dim, act_dim
+        self.discrete = discrete
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Params:
+        kp, kv = jax.random.split(key)
+        params = {
+            "pi": init_mlp(kp, self.obs_dim, self.hidden, self.act_dim),
+            "vf": init_mlp(kv, self.obs_dim, self.hidden, 1, final_scale=1.0),
+        }
+        if not self.discrete:
+            params["log_std"] = jnp.zeros((self.act_dim,))
+        return params
+
+    def value(self, params: Params, obs: jax.Array) -> jax.Array:
+        return jnp.squeeze(apply_mlp(params["vf"], obs), -1)
+
+    def action_dist(self, params: Params, obs: jax.Array):
+        out = apply_mlp(params["pi"], obs)
+        if self.discrete:
+            return Categorical(out)
+        return DiagGaussian(out, params["log_std"])
+
+    def sample_action(self, params: Params, obs: jax.Array, key):
+        dist = self.action_dist(params, obs)
+        action = dist.sample(key)
+        return action, dist.log_prob(action)
+
+
+class Categorical:
+    def __init__(self, logits: jax.Array):
+        self.logits = logits
+
+    def sample(self, key) -> jax.Array:
+        return jax.random.categorical(key, self.logits, axis=-1)
+
+    def log_prob(self, action: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            logp, action[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+
+    def entropy(self) -> jax.Array:
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    def mode(self) -> jax.Array:
+        return jnp.argmax(self.logits, axis=-1)
+
+
+class DiagGaussian:
+    def __init__(self, mean: jax.Array, log_std: jax.Array):
+        self.mean, self.log_std = mean, log_std
+
+    def sample(self, key) -> jax.Array:
+        return self.mean + jnp.exp(self.log_std) * jax.random.normal(
+            key, self.mean.shape
+        )
+
+    def log_prob(self, action: jax.Array) -> jax.Array:
+        var = jnp.exp(2 * self.log_std)
+        ll = -0.5 * ((action - self.mean) ** 2 / var
+                     + 2 * self.log_std + jnp.log(2 * jnp.pi))
+        return jnp.sum(ll, axis=-1)
+
+    def entropy(self) -> jax.Array:
+        return jnp.sum(self.log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e),
+                       axis=-1)
+
+    def mode(self) -> jax.Array:
+        return self.mean
+
+
+def init_q_net(key, obs_dim: int, act_dim: int,
+               hidden: Sequence[int] = (64, 64)) -> Params:
+    return init_mlp(key, obs_dim, hidden, act_dim, final_scale=1.0)
+
+
+def q_values(params: Params, obs: jax.Array) -> jax.Array:
+    return apply_mlp(params, obs)

@@ -1,0 +1,87 @@
+"""Replay buffers.
+
+Parity slot: the reference's replay buffers (ray:
+rllib/utils/replay_buffers/replay_buffer.py,
+prioritized_episode_buffer, etc.), which are host-side Python deques.
+TPU-first version: :class:`DeviceReplayBuffer` keeps the whole buffer as
+fixed-shape device arrays so insert (dynamic_update_slice) and uniform
+sampling (random gather) stay inside jit — no host round-trip per
+transition.  :class:`HostReplayBuffer` is the numpy fallback used by
+host-loop env runners.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class BufferState(NamedTuple):
+    data: Dict[str, jax.Array]  # each [capacity, ...]
+    ptr: jax.Array              # next write slot
+    size: jax.Array             # number of valid entries
+
+
+class DeviceReplayBuffer:
+    """Uniform ring buffer living in device memory; all ops jittable."""
+
+    def __init__(self, capacity: int, specs: Dict[str, Tuple[tuple, Any]]):
+        """specs: name -> (shape, dtype) of ONE transition."""
+        self.capacity = capacity
+        self.specs = specs
+
+    def init(self) -> BufferState:
+        data = {
+            k: jnp.zeros((self.capacity,) + tuple(shape), dtype)
+            for k, (shape, dtype) in self.specs.items()
+        }
+        return BufferState(data, jnp.zeros((), jnp.int32),
+                           jnp.zeros((), jnp.int32))
+
+    def add_batch(self, state: BufferState,
+                  batch: Dict[str, jax.Array]) -> BufferState:
+        """Insert a [B, ...] batch (B static).  Wraps around the ring."""
+        n = next(iter(batch.values())).shape[0]
+        idx = (state.ptr + jnp.arange(n)) % self.capacity
+
+        def upd(buf, vals):
+            return buf.at[idx].set(vals)
+
+        data = {k: upd(state.data[k], batch[k]) for k in state.data}
+        ptr = (state.ptr + n) % self.capacity
+        size = jnp.minimum(state.size + n, self.capacity)
+        return BufferState(data, ptr, size)
+
+    def sample(self, state: BufferState, key: jax.Array,
+               batch_size: int) -> Dict[str, jax.Array]:
+        idx = jax.random.randint(key, (batch_size,), 0,
+                                 jnp.maximum(state.size, 1))
+        return {k: v[idx] for k, v in state.data.items()}
+
+
+class HostReplayBuffer:
+    """Numpy ring buffer (parity: the reference's ReplayBuffer)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._storage: list = []
+        self._ptr = 0
+
+    def __len__(self) -> int:
+        return len(self._storage)
+
+    def add(self, item: Any) -> None:
+        if len(self._storage) < self.capacity:
+            self._storage.append(item)
+        else:
+            self._storage[self._ptr] = item
+        self._ptr = (self._ptr + 1) % self.capacity
+
+    def sample(self, batch_size: int, rng: np.random.Generator = None):
+        rng = rng or np.random.default_rng()
+        idx = rng.integers(0, len(self._storage), batch_size)
+        return [self._storage[i] for i in idx]

@@ -1,0 +1,124 @@
+"""Jitted trajectory collection — replaces the reference's RolloutWorker
+sampling loop (ray: rllib/evaluation/rollout_worker.py:159,
+rllib/evaluation/sampler.py) with a single ``lax.scan`` over env steps,
+vmapped over parallel envs.  Auto-reset happens in-graph: when an env
+reports done, its state is re-initialized from a fresh key in the same
+step, so the batch shape never changes and XLA sees one static program.
+
+Also provides GAE (generalized advantage estimation) and episode-return
+bookkeeping computed inside the same compiled program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Rollout(NamedTuple):
+    """Time-major [T, N, ...] trajectory batch (the SampleBatch slot)."""
+
+    obs: jax.Array
+    action: jax.Array
+    reward: jax.Array
+    done: jax.Array
+    log_prob: jax.Array
+    value: jax.Array
+    last_value: jax.Array      # [N] bootstrap value of the final obs
+    episode_return: jax.Array  # [T, N] completed-episode returns (NaN elsewhere)
+    episode_length: jax.Array  # [T, N] completed-episode lengths (0 elsewhere)
+
+
+def unroll(env, net, params, state, obs, ep_ret, ep_len, key,
+           num_steps: int):
+    """Collect ``num_steps`` from N parallel envs (vmapped inside).
+
+    Returns (new_state, new_obs, new_ep_ret, new_ep_len, Rollout).
+    All inputs/outputs batched over N except params/key.
+    """
+    n_envs = obs.shape[0]
+    v_step = jax.vmap(env.step)
+    v_reset = jax.vmap(env.reset)
+
+    def one_step(carry, step_key):
+        state, obs, ep_ret, ep_len = carry
+        k_act, k_reset = jax.random.split(step_key)
+        act_keys = jax.random.split(k_act, n_envs)
+        action, log_prob = jax.vmap(net.sample_action, (None, 0, 0))(
+            params, obs, act_keys
+        )
+        value = net.value(params, obs)
+        next_state, next_obs, reward, done = v_step(state, action)
+        ep_ret = ep_ret + reward
+        ep_len = ep_len + 1
+        # record completed episodes at the step they finish
+        completed_ret = jnp.where(done, ep_ret, jnp.nan)
+        completed_len = jnp.where(done, ep_len, 0)
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        ep_len = jnp.where(done, 0, ep_len)
+        reset_keys = jax.random.split(k_reset, n_envs)
+        reset_state, reset_obs = v_reset(reset_keys)
+        next_state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(
+                jnp.reshape(done, done.shape + (1,) * (r.ndim - done.ndim)),
+                r, c),
+            reset_state, next_state,
+        )
+        next_obs = jnp.where(done[:, None], reset_obs, next_obs)
+        out = (obs, action, reward, done, log_prob, value,
+               completed_ret, completed_len)
+        return (next_state, next_obs, ep_ret, ep_len), out
+
+    step_keys = jax.random.split(key, num_steps)
+    (state, obs, ep_ret, ep_len), outs = lax.scan(
+        one_step, (state, obs, ep_ret, ep_len), step_keys
+    )
+    (obs_t, act_t, rew_t, done_t, logp_t, val_t, cret_t, clen_t) = outs
+    last_value = net.value(params, obs)
+    roll = Rollout(obs_t, act_t, rew_t, done_t, logp_t, val_t,
+                   last_value, cret_t, clen_t)
+    return state, obs, ep_ret, ep_len, roll
+
+
+def gae(reward, done, value, last_value, *, gamma: float, lam: float):
+    """Generalized advantage estimation over a [T, N] rollout.
+
+    Computed as a reverse ``lax.scan`` (no Python loop over T), masking
+    bootstrap across episode boundaries.
+    """
+    next_values = jnp.concatenate([value[1:], last_value[None]], axis=0)
+    not_done = 1.0 - done.astype(jnp.float32)
+    deltas = reward + gamma * next_values * not_done - value
+
+    def backward(adv, inputs):
+        delta, nd = inputs
+        adv = delta + gamma * lam * nd * adv
+        return adv, adv
+
+    _, advs = lax.scan(
+        backward, jnp.zeros_like(last_value), (deltas, not_done),
+        reverse=True,
+    )
+    returns = advs + value
+    return advs, returns
+
+
+def episode_stats(roll: Rollout) -> Dict[str, jax.Array]:
+    """Mean completed-episode return/length within the rollout (NaN if no
+    episode finished — callers carry the previous value forward)."""
+    rets = roll.episode_return
+    count = jnp.sum(~jnp.isnan(rets))
+    mean_ret = jnp.where(
+        count > 0, jnp.nansum(rets) / jnp.maximum(count, 1), jnp.nan
+    )
+    lens = roll.episode_length.astype(jnp.float32)
+    mean_len = jnp.where(
+        count > 0, jnp.sum(lens) / jnp.maximum(count, 1), jnp.nan
+    )
+    return {"episode_return_mean": mean_ret,
+            "episode_len_mean": mean_len,
+            "episodes_this_iter": count}
