@@ -49,8 +49,11 @@ class LlamaConfig:
     logits_soft_cap: Optional[float] = None
     tie_embeddings: bool = False
     # Shard the sequence over the mesh "sp" axis: attention becomes ring
-    # attention (ray_tpu.ops.ring_attention) over the ICI ring.
+    # attention (ray_tpu.ops.ring_attention) over the ICI ring, or
+    # Ulysses all-to-all head scattering (ray_tpu.ops.ulysses) when
+    # sp_backend == "ulysses".
     sequence_parallel: bool = False
+    sp_backend: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -200,9 +203,19 @@ def _attn_block(x, layer, cfg: LlamaConfig, sin, cos, segment_ids,
                 "logits_soft_cap yet — ring attention would silently "
                 "ignore them"
             )
-        from ray_tpu.ops.ring_attention import ring_attention
+        if cfg.sp_backend == "ulysses":
+            from ray_tpu.ops.ulysses import ulysses_attention
 
-        out = ring_attention(q, k, v)
+            out = ulysses_attention(q, k, v)
+        elif cfg.sp_backend == "ring":
+            from ray_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v)
+        else:
+            raise ValueError(
+                f"unknown sp_backend {cfg.sp_backend!r} (want 'ring' or "
+                "'ulysses')"
+            )
     else:
         out = dot_product_attention(q, k, v, causal=True,
                                     segment_ids=segment_ids,

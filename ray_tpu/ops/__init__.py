@@ -1,3 +1,4 @@
 from ray_tpu.ops.attention import decode_attention, dot_product_attention
+from ray_tpu.ops.ulysses import ulysses_attention
 
-__all__ = ["decode_attention", "dot_product_attention"]
+__all__ = ["decode_attention", "dot_product_attention", "ulysses_attention"]

@@ -33,8 +33,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import shard_map_unchecked
 
 from ray_tpu.ops.flash_attention import (
     DEFAULT_BLOCK_KV,
@@ -207,11 +208,10 @@ def ring_attention(
     data = ("dp", "fsdp")
     spec_q = P(data, axis, "tp", None)
     spec_kv = P(data, axis, "tp", None)
-    mapped = shard_map(
+    mapped = shard_map_unchecked(
         local_fn, mesh=mesh,
         in_specs=(spec_q, spec_kv, spec_kv),
         out_specs=spec_q,
-        check_rep=False,
     )
     return mapped(q, k, v)
 

@@ -1,0 +1,117 @@
+"""Ulysses sequence parallelism — all-to-all head scattering.
+
+Absent from the reference (SURVEY.md §5.7: no SP/CP anywhere in it);
+built TPU-first as the sibling of ring attention
+(ray_tpu/ops/ring_attention.py).  Where the ring rotates k/v chunks
+around the ICI ring, Ulysses re-shards in one shot: an ``all_to_all``
+over the "sp" axis turns a [B, S/n, H, D] sequence shard into a
+[B, S, H/n, D] head shard, runs ordinary (full-sequence) attention
+locally, and a second ``all_to_all`` restores the sequence sharding.
+
+Trade-off vs the ring: two all_to_all collectives per attention instead
+of n ppermute steps, but attention itself is the plain dense/flash
+kernel on the full sequence — no per-chunk log-sum-exp merging and no
+causal load imbalance.  Best when H (or KVH after expansion) is
+divisible by the sp size and per-device memory fits the full sequence
+for H/n heads.
+
+Differentiability rides on ``lax.all_to_all``'s built-in transpose —
+no custom_vjp needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import shard_map_unchecked
+
+
+def _heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, S/n, H, D] → [B, S, H/n, D] (scatter heads, gather sequence)."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, S, H/n, D] → [B, S/n, H, D] (gather heads, scatter sequence)."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    """Per-device Ulysses attention for use INSIDE shard_map.
+
+    q [B, Sl, H, D], k/v [B, Sl, KVH, D] — Sl is this device's contiguous
+    sequence chunk (chunks in axis order).  KVH is expanded up to a
+    multiple of the axis size when needed so heads split evenly.
+    """
+    from ray_tpu.ops.attention import dot_product_attention
+
+    n = lax.axis_size(axis_name)
+    H = q.shape[2]
+    KVH = k.shape[2]
+    if H % n:
+        raise ValueError(f"{H} query heads not divisible by {axis_name}={n}")
+    if KVH % n:
+        # Expand k/v all the way to H heads (plain MHA): after the
+        # all_to_all each local q head then pairs 1:1 with its kv head,
+        # so no divisibility/alignment constraint on KVH remains.
+        k = jnp.repeat(k, H // KVH, axis=2)
+        v = jnp.repeat(v, H // KVH, axis=2)
+
+    qh = _heads_to_seq(q, axis_name)
+    kh = _heads_to_seq(k, axis_name)
+    vh = _heads_to_seq(v, axis_name)
+    out = dot_product_attention(qh, kh, vh, causal=causal)
+    return _seq_to_heads(out, axis_name)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    *,
+    axis: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Causal attention with the sequence sharded over ``axis``.
+
+    Same calling convention as ring_attention: q [B, S, H, D],
+    k/v [B, S, KVH, D]; batch sharded over (dp, fsdp), heads over tp,
+    sequence over ``axis``.  Works inside jit — shard_map nests under
+    GSPMD.
+    """
+    if mesh is None:
+        from ray_tpu.ops.ring_attention import _ambient_mesh
+
+        mesh = _ambient_mesh()
+    n = mesh.shape[axis]
+    S = q.shape[1]
+    if S % n:
+        raise ValueError(f"seq len {S} not divisible by {axis} size {n}")
+    tp = mesh.shape.get("tp", 1)
+    if (q.shape[2] // tp) % n:
+        raise ValueError(
+            f"local head count {q.shape[2]}/{tp} not divisible by {axis}={n}"
+        )
+
+    data = ("dp", "fsdp")
+    spec = P(data, axis, "tp", None)
+    mapped = shard_map_unchecked(
+        lambda q, k, v: ulysses_attention_local(q, k, v, axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return mapped(q, k, v)

@@ -137,13 +137,12 @@ class CollectiveGroup:
 
     def run(self, fn: Callable, *args, in_specs=None, out_specs=None):
         """Run ``fn`` shard-mapped over this group's axis."""
-        from jax.experimental.shard_map import shard_map
+        from ray_tpu.parallel.mesh import shard_map_unchecked
 
         in_specs = in_specs if in_specs is not None else P()
         out_specs = out_specs if out_specs is not None else P()
-        mapped = shard_map(
+        mapped = shard_map_unchecked(
             fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-            check_rep=False,
         )
         return mapped(*args)
 

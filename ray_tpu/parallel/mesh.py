@@ -110,6 +110,22 @@ def create_mesh(
     return Mesh(arr, axis_names)
 
 
+def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` with replication checking disabled (our mapped
+    bodies produce per-device values by construction), papering over the
+    jax 0.8 rename of ``check_rep`` → ``check_vma``."""
+    try:
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except (TypeError, AttributeError):  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     dev = device or jax.devices()[0]
     return create_mesh(MeshSpec(dp=1), devices=[dev])

@@ -1,0 +1,149 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over the
+"pp" mesh axis.
+
+The reference has no in-tree pipeline parallelism (SURVEY.md §2.4: PP
+exists only via external Alpa in release tests,
+ray: release/alpa_tests/train_opt_2_7b_minimum.py).  Built TPU-first:
+one SPMD program where each pp-axis device holds one stage's params
+(leading stage axis sharded over "pp") and activations hop stages via
+``lax.ppermute`` each pipeline tick.  XLA overlaps the p2p transfer
+with the next microbatch's compute; gradients flow through the scan +
+ppermute transposes, so the whole pipeline trains under one ``jit``.
+
+Schedule (plain GPipe, n stages, m microbatches, T = m + n - 1 ticks):
+
+    tick t:  stage 0 ingests microbatch t (t < m), every stage applies
+             itself to its current activation, results shift +1 ring
+             step; stage n-1's outputs for ticks >= n-1 are collected.
+
+Bubble fraction is (n-1)/T — amortized by choosing m >> n.  A circular
+(interleaved) schedule can cut it further; plain GPipe keeps the scan
+body a single stage application.
+
+Usage:
+    params = stack_stage_params([init_stage(k) for k in keys])  # [n, ...]
+    y = pipeline_apply(stage_fn, params, x, mesh=mesh,
+                       num_microbatches=8)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import shard_map_unchecked
+
+
+def stack_stage_params(stage_params: Sequence[Any]) -> Any:
+    """Stack per-stage pytrees along a new leading stage axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def stage_param_sharding(mesh: Mesh, params: Any, axis: str = "pp") -> Any:
+    """NamedShardings putting the leading stage axis on ``axis``."""
+    return jax.tree.map(
+        lambda x: NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1)))), params
+    )
+
+
+def _shift_next(x: jax.Array, axis_name: str) -> jax.Array:
+    n = lax.axis_size(axis_name)
+    return lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    num_microbatches: int,
+    axis: str = "pp",
+    data_axes: tuple = ("dp", "fsdp"),
+) -> jax.Array:
+    """Run ``x`` through the staged pipeline.
+
+    stage_fn(params_one_stage, act) -> act, with identical activation
+    shapes across stages (transformer-block style).  ``stacked_params``
+    leaves have leading stage axis n (shard it over ``axis``);
+    x [B, ...] with B divisible by num_microbatches; batch may also be
+    sharded over ``data_axes``.
+    """
+    if mesh is None:
+        from ray_tpu.ops.ring_attention import _ambient_mesh
+
+        mesh = _ambient_mesh()
+    n = mesh.shape[axis]
+    m = num_microbatches
+    data_size = math.prod(mesh.shape.get(a, 1) for a in data_axes)
+    if x.shape[0] % (m * data_size):
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by num_microbatches={m} × "
+            f"data-parallel size {data_size} (the per-device batch is what "
+            f"gets split into microbatches)"
+        )
+
+    p_spec = jax.tree.map(lambda t: P(axis, *([None] * (t.ndim - 1))),
+                          stacked_params)
+    x_spec = P(data_axes, *([None] * (x.ndim - 1)))
+
+    def local_fn(params, xl):
+        # params leaves [1, ...] (this stage's slice), xl [Bl, ...]
+        params = jax.tree.map(lambda t: t[0], params)
+        idx = lax.axis_index(axis)
+        mb = xl.reshape((m, xl.shape[0] // m) + xl.shape[1:])
+        mb_shape = mb.shape[1:]
+
+        def tick(carry, t):
+            state, out = carry
+            # stage 0 ingests microbatch t (clamped; tail ticks feed
+            # garbage that never reaches the output window)
+            feed = lax.dynamic_index_in_dim(
+                mb, jnp.minimum(t, m - 1), axis=0, keepdims=False
+            )
+            state = jnp.where(idx == 0, feed, state)
+            state = stage_fn(params, state)
+            # last stage emits microbatch t - (n - 1)
+            slot = t - (n - 1)
+            out = lax.cond(
+                slot >= 0,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, state.astype(o.dtype), jnp.maximum(slot, 0), axis=0
+                ),
+                lambda o: o,
+                out,
+            )
+            state = _shift_next(state, axis)
+            return (state, out), None
+
+        out0 = jnp.zeros((m,) + mb_shape, dtype=xl.dtype)
+        state0 = jnp.zeros(mb_shape, dtype=xl.dtype)
+        (state, out), _ = lax.scan(
+            tick, (state0, out0), jnp.arange(m + n - 1)
+        )
+        # outputs live on the last stage only; psum over pp replicates
+        # them (one collective on the final activations)
+        out = lax.psum(jnp.where(idx == n - 1, out, 0), axis)
+        return out.reshape(xl.shape)
+
+    mapped = shard_map_unchecked(
+        local_fn, mesh=mesh, in_specs=(p_spec, x_spec), out_specs=x_spec,
+    )
+    return mapped(stacked_params, x)
+
+
+def microbatches_for(batch: int, n_stages: int, *, target_bubble: float = 0.2
+                     ) -> int:
+    """Pick m so the GPipe bubble (n-1)/(m+n-1) <= target_bubble."""
+    if n_stages <= 1:
+        return 1
+    m_min = math.ceil((n_stages - 1) * (1 - target_bubble) / target_bubble)
+    m = 1
+    while m < m_min and m * 2 <= batch and batch % (m * 2) == 0:
+        m *= 2
+    return m

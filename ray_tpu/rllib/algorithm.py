@@ -171,9 +171,15 @@ class Algorithm(Trainable):
 
     @classmethod
     def from_checkpoint(cls, path: str, config=None) -> "Algorithm":
-        algo = cls(config=config)
         with open(path, "rb") as f:
-            algo.load_checkpoint(f.read())
+            state = pickle.loads(f.read())
+        if config is None and "config" in state:
+            # Rebuild the saved config so the env / net shapes / hparams
+            # match the checkpointed params (a default config would
+            # silently rebuild for the wrong env).
+            config = cls.config_class().update_from_dict(state["config"])
+        algo = cls(config=config)
+        algo.set_state(state)
         return algo
 
     # -- subclass hooks ----------------------------------------------------

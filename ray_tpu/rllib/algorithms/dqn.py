@@ -210,7 +210,7 @@ def _dqn_iteration(env, buffer, tx, scfg, params, target_params, opt_state,
 
         should_train = (
             (buf_state.size >= learning_starts)
-            & ((total_steps // n_envs) % train_freq == 0)
+            & ((total_steps // n_envs) % max(train_freq // n_envs, 1) == 0)
         )
         params, opt_state, loss = lax.cond(
             should_train, do_update,

@@ -139,7 +139,12 @@ class ExternalEnv:
         self.observation_size = self._env.observation_space.shape[0]
 
     def reset(self, seed=None):
-        out = self._env.reset(seed=seed)
+        try:
+            out = self._env.reset(seed=seed)
+        except TypeError:  # pre-gymnasium envs take no seed kwarg
+            if seed is not None and hasattr(self._env, "seed"):
+                self._env.seed(seed)
+            out = self._env.reset()
         return out[0] if isinstance(out, tuple) else out
 
     def step(self, action):
