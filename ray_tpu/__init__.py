@@ -87,6 +87,13 @@ def placement_group_table():
     return _api().runtime().placement_group_table()
 
 
+def timeline(filename=None):
+    """Chrome-trace dump of recorded task events (parity: ray.timeline)."""
+    from ray_tpu.util import state as _state
+
+    return _state.timeline(filename)
+
+
 def cluster_resources():
     return _api().cluster_resources()
 

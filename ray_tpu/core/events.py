@@ -1,0 +1,176 @@
+"""Task event buffer + Chrome-trace timeline export.
+
+Parity with the reference's task-event pipeline: every worker batches
+per-task state transitions into a ``TaskEventBuffer``
+(ray: src/ray/core_worker/task_event_buffer.h:199 — AddTaskEvent :206,
+FlushEvents :221) which lands in ``GcsTaskManager``'s bounded in-memory
+ring buffer (ray: src/ray/gcs/gcs_server/gcs_task_manager.h:61, ring
+storage :144).  The state vocabulary mirrors ``common.proto``'s
+TaskStatus, and ``chrome_tracing_dump`` matches the ``ray timeline``
+output (ray: python/ray/_private/state.py:434 chrome_tracing_dump,
+CLI python/ray/scripts/scripts.py:1848).
+
+In the single-process runtime there is no flush RPC: the buffer *is*
+the GCS-side ring.  The interface (record → snapshot) is kept so a
+multi-process deployment can insert a flush boundary without touching
+callers.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# TaskStatus vocabulary (parity: src/ray/protobuf/common.proto TaskStatus).
+PENDING_NODE_ASSIGNMENT = "PENDING_NODE_ASSIGNMENT"
+SUBMITTED_TO_WORKER = "SUBMITTED_TO_WORKER"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+NORMAL_TASK = "NORMAL_TASK"
+ACTOR_TASK = "ACTOR_TASK"
+ACTOR_CREATION_TASK = "ACTOR_CREATION_TASK"
+DRIVER_TASK = "DRIVER_TASK"
+
+_TERMINAL = (FINISHED, FAILED)
+
+
+@dataclasses.dataclass
+class TaskAttempt:
+    """One attempt of one task (parity: rpc::TaskEvents per attempt)."""
+
+    task_id: str
+    attempt: int
+    name: str
+    type: str
+    job_id: str
+    state_ts: Dict[str, float] = dataclasses.field(default_factory=dict)
+    node_id: Optional[str] = None
+    actor_id: Optional[str] = None
+    worker: Optional[str] = None
+    error_message: Optional[str] = None
+    required_resources: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )
+
+    @property
+    def state(self) -> str:
+        """Latest state reached.  Insertion order is the record order
+        (timestamps can collide within one clock tick on coarse clocks)."""
+        return next(reversed(self.state_ts)) if self.state_ts else "NIL"
+
+    def is_terminal(self) -> bool:
+        return any(s in self.state_ts for s in _TERMINAL)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["state"] = self.state
+        d["start_time"] = self.state_ts.get(RUNNING)
+        d["end_time"] = (self.state_ts.get(FINISHED)
+                         or self.state_ts.get(FAILED))
+        return d
+
+
+class TaskEventBuffer:
+    """Bounded ring of task attempts; oldest *terminal* attempts are
+    dropped first when over capacity (parity: GcsTaskManager's
+    ``RAY_task_events_max_num_task_in_gcs`` ring + dropped counter)."""
+
+    def __init__(self, max_tasks: int = 16384):
+        self._lock = threading.Lock()
+        self._max = max_tasks
+        self._attempts: "collections.OrderedDict[tuple, TaskAttempt]" = \
+            collections.OrderedDict()
+        self.num_dropped = 0
+
+    def record(self, task_id: str, state: str, *, name: str = "",
+               type: str = NORMAL_TASK, job_id: str = "", attempt: int = 0,
+               node_id: Optional[str] = None, actor_id: Optional[str] = None,
+               worker: Optional[str] = None, error_message: Optional[str] = None,
+               required_resources: Optional[Dict[str, float]] = None) -> None:
+        key = (task_id, attempt)
+        now = time.time()
+        with self._lock:
+            rec = self._attempts.get(key)
+            if rec is None:
+                rec = TaskAttempt(
+                    task_id=task_id, attempt=attempt, name=name, type=type,
+                    job_id=job_id,
+                    required_resources=dict(required_resources or {}),
+                )
+                self._attempts[key] = rec
+                if len(self._attempts) > self._max:
+                    self._evict_locked()
+            rec.state_ts[state] = now
+            if node_id is not None:
+                rec.node_id = node_id
+            if actor_id is not None:
+                rec.actor_id = actor_id
+            if worker is not None:
+                rec.worker = worker
+            if error_message is not None:
+                rec.error_message = error_message
+
+    def _evict_locked(self) -> None:
+        # Prefer dropping terminal attempts (running ones are still
+        # useful); fall back to strict FIFO.
+        for key, rec in self._attempts.items():
+            if rec.is_terminal():
+                del self._attempts[key]
+                self.num_dropped += 1
+                return
+        self._attempts.popitem(last=False)
+        self.num_dropped += 1
+
+    def snapshot(self) -> List[TaskAttempt]:
+        with self._lock:
+            return [dataclasses.replace(
+                        r, state_ts=dict(r.state_ts),
+                        required_resources=dict(r.required_resources))
+                    for r in self._attempts.values()]
+
+    # -- timeline ----------------------------------------------------------
+
+    def chrome_tracing_dump(self) -> List[Dict[str, Any]]:
+        """Chrome trace-event format (``chrome://tracing`` / Perfetto):
+        one complete ("X") event per finished attempt, rows keyed by
+        node (pid) and worker thread (tid)."""
+        out: List[Dict[str, Any]] = []
+        seen_rows = set()
+        for rec in self.snapshot():
+            start = rec.state_ts.get(RUNNING)
+            end = (rec.state_ts.get(FINISHED) or rec.state_ts.get(FAILED))
+            if start is None:
+                continue
+            pid = (rec.node_id or "driver")[:8]
+            tid = rec.worker or "worker"
+            if pid not in seen_rows:
+                seen_rows.add(pid)
+                out.append({"ph": "M", "pid": pid, "name": "process_name",
+                            "args": {"name": f"node:{pid}"}})
+            out.append({
+                "ph": "X",
+                "name": rec.name or rec.task_id[:8],
+                "cat": rec.type.lower(),
+                "pid": pid,
+                "tid": tid,
+                "ts": start * 1e6,
+                "dur": ((end or time.time()) - start) * 1e6,
+                "args": {
+                    "task_id": rec.task_id,
+                    "attempt": rec.attempt,
+                    "state": rec.state,
+                },
+                "cname": ("thread_state_runnable"
+                          if rec.state != FAILED else "terrible"),
+            })
+        return out
+
+    def dump_json(self, filename: str) -> None:
+        with open(filename, "w") as f:
+            json.dump(self.chrome_tracing_dump(), f)

@@ -26,6 +26,7 @@ import threading
 import queue as _queue
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu.core import events as _ev
 from ray_tpu.core.exceptions import (
     ActorDiedError,
     TaskError,
@@ -248,12 +249,25 @@ class _ActorShell:
 
     def _run(self):
         # Actor creation is the first "task" (parity: actor creation task).
+        ev = self.runtime.events
+        ctid = getattr(self, "creation_task_id", None)
+        if ctid is not None:
+            ev.record(ctid.hex(), _ev.RUNNING,
+                      name=f"{self.cls.__name__}.__init__",
+                      type=_ev.ACTOR_CREATION_TASK,
+                      actor_id=self.actor_id.hex(),
+                      node_id=(self.node_id.hex() if self.node_id else None),
+                      worker=threading.current_thread().name)
         try:
             self._construct()
             self.runtime.store.put_value(self._creation_oid, None)
+            if ctid is not None:
+                ev.record(ctid.hex(), _ev.FINISHED)
         except BaseException as e:
             self.dead = True
             self.death_reason = f"creation failed: {e!r}"
+            if ctid is not None:
+                ev.record(ctid.hex(), _ev.FAILED, error_message=repr(e))
             self.runtime.store.put_error(
                 self._creation_oid,
                 ActorDiedError(repr(self.cls), self.death_reason),
@@ -287,7 +301,16 @@ class _ActorShell:
             if item is None:  # kill signal — re-post so sibling threads stop
                 self.queue.put(None)
                 return
-            method_name, args, kwargs, return_ids, num_returns = item
+            method_name, args, kwargs, return_ids, num_returns = item[:5]
+            task_hex = item[5] if len(item) > 5 else None
+            ev = self.runtime.events
+            qname = f"{self.cls.__name__}.{method_name}"
+            if task_hex:
+                ev.record(task_hex, _ev.RUNNING, name=qname,
+                          type=_ev.ACTOR_TASK, actor_id=self.actor_id.hex(),
+                          node_id=(self.node_id.hex() if self.node_id
+                                   else None),
+                          worker=threading.current_thread().name)
             try:
                 resolved_args, resolved_kwargs = self.runtime.resolve_args(
                     args, kwargs
@@ -301,7 +324,11 @@ class _ActorShell:
 
                     result = asyncio.run(result)
                 self.runtime._store_results(result, return_ids, num_returns)
+                if task_hex:
+                    ev.record(task_hex, _ev.FINISHED)
             except BaseException as e:
+                if task_hex:
+                    ev.record(task_hex, _ev.FAILED, error_message=repr(e))
                 err = TaskError(f"{self.cls.__name__}.{method_name}", e)
                 for oid in return_ids:
                     self.runtime.store.put_error(oid, err)
@@ -322,14 +349,22 @@ class _ActorShell:
                 continue
             for oid in item[3]:
                 self.runtime.store.put_error(oid, err)
+            if len(item) > 5 and item[5]:
+                self.runtime.events.record(item[5], _ev.FAILED,
+                                           error_message=repr(err))
 
-    def submit(self, method_name: str, args, kwargs, return_ids, num_returns):
+    def submit(self, method_name: str, args, kwargs, return_ids, num_returns,
+               task_hex: Optional[str] = None):
         if self.dead:
             err = ActorDiedError(repr(self.cls), self.death_reason or "dead")
             for oid in return_ids:
                 self.runtime.store.put_error(oid, err)
+            if task_hex:
+                self.runtime.events.record(task_hex, _ev.FAILED,
+                                           error_message=repr(err))
             return
-        self.queue.put((method_name, args, kwargs, return_ids, num_returns))
+        self.queue.put((method_name, args, kwargs, return_ids, num_returns,
+                        task_hex))
 
     def kill(self, no_restart: bool = True):
         self.dead = True
@@ -357,6 +392,10 @@ class LocalRuntime:
             total["CPU"] = float(cfg.num_workers_soft_limit or 8)
         total.setdefault("memory", 64 * 1024**3)
         self.store = LocalObjectStore()
+        # GCS-side task-event ring (parity: GcsTaskManager, see events.py).
+        self.events = _ev.TaskEventBuffer(
+            max_tasks=getattr(cfg, "task_events_max_num", 16384)
+        )
         self.job_id = job_id or JobID.next()
         self.driver_task_id = TaskID.for_driver(self.job_id)
         self._put_counter = itertools.count(1)
@@ -370,6 +409,12 @@ class LocalRuntime:
         self._node_order: List[NodeID] = []  # stable order for hybrid packing
         self._pgs: Dict[PlacementGroupID, _PGState] = {}
         self._named_pgs: Dict[str, PlacementGroupID] = {}
+        # Tombstones for the actor state table, bounded (parity: GCS keeps
+        # DEAD actors queryable up to
+        # RAY_maximum_gcs_destroyed_actor_cached_count).
+        import collections as _collections
+
+        self._dead_actors: Any = _collections.deque(maxlen=1024)
         # Serializes all bundle (re-)reservation: concurrent node events
         # must not double-place the same pending bundle.
         self._pg_reserve_lock = threading.Lock()
@@ -592,6 +637,11 @@ class LocalRuntime:
             return_ids=return_ids, retries_left=options.max_retries,
             task_id=task_id, function_name=getattr(fn, "__name__", repr(fn)),
         )
+        self.events.record(
+            task_id.hex(), _ev.PENDING_NODE_ASSIGNMENT,
+            name=pt.function_name, type=_ev.NORMAL_TASK,
+            job_id=self.job_id.hex(), required_resources=demand,
+        )
         with self._dispatch_cv:
             self._pending.append(pt)
             self._dispatch_cv.notify_all()
@@ -622,6 +672,10 @@ class LocalRuntime:
                 err = TaskError(pt.function_name, e)
                 for oid in pt.return_ids:
                     self.store.put_error(oid, err)
+                self.events.record(
+                    pt.task_id.hex(), _ev.FAILED, name=pt.function_name,
+                    error_message=str(e),
+                )
                 return None
             if alloc is not None:
                 self._pending.remove(pt)
@@ -629,12 +683,25 @@ class LocalRuntime:
         return None
 
     def _start_task(self, pt: _PendingTask, alloc: _Allocation):
+        attempt = pt.options.max_retries - pt.retries_left
+
         def run():
+            self.events.record(
+                pt.task_id.hex(), _ev.RUNNING, name=pt.function_name,
+                attempt=attempt, job_id=self.job_id.hex(),
+                node_id=(alloc.node.node_id.hex() if alloc.node else None),
+                worker=threading.current_thread().name,
+                required_resources=pt.options.resource_demand(),
+            )
             try:
                 args, kwargs = self.resolve_args(pt.args, pt.kwargs)
                 result = pt.fn(*args, **kwargs)
                 self._store_results(result, pt.return_ids, pt.options.num_returns)
+                self.events.record(pt.task_id.hex(), _ev.FINISHED,
+                                   attempt=attempt)
             except Exception as e:
+                self.events.record(pt.task_id.hex(), _ev.FAILED,
+                                   attempt=attempt, error_message=repr(e))
                 if pt.retries_left > 0:
                     pt.retries_left -= 1
                     with self._dispatch_cv:
@@ -687,9 +754,18 @@ class LocalRuntime:
             with self._dispatch_cv:
                 self._dispatch_cv.wait(0.05)
         actor_id = ActorID.of(self.job_id)
-        creation_oid = ObjectID.for_task_return(TaskID.of(actor_id), 0)
+        creation_task_id = TaskID.of(actor_id)
+        creation_oid = ObjectID.for_task_return(creation_task_id, 0)
         shell = _ActorShell(self, actor_id, cls, args, kwargs, options,
                             creation_oid, alloc)
+        shell.creation_task_id = creation_task_id
+        self.events.record(
+            creation_task_id.hex(), _ev.PENDING_NODE_ASSIGNMENT,
+            name=f"{cls.__name__}.__init__", type=_ev.ACTOR_CREATION_TASK,
+            job_id=self.job_id.hex(), actor_id=actor_id.hex(),
+            node_id=(alloc.node.node_id.hex() if alloc.node else None),
+            required_resources=demand,
+        )
         # Register before starting: if __init__ fails instantly, the death
         # path must find (and unregister) the actor, or its name leaks.
         with self._lock:
@@ -714,7 +790,14 @@ class LocalRuntime:
             for oid in return_ids:
                 self.store.put_error(oid, err)
         else:
-            shell.submit(method_name, args, kwargs, return_ids, num_returns)
+            self.events.record(
+                task_id.hex(), _ev.SUBMITTED_TO_WORKER,
+                name=f"{shell.cls.__name__}.{method_name}",
+                type=_ev.ACTOR_TASK, job_id=self.job_id.hex(),
+                actor_id=actor_id.hex(),
+            )
+            shell.submit(method_name, args, kwargs, return_ids, num_returns,
+                         task_id.hex())
         return [ObjectRef(oid) for oid in return_ids]
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
@@ -818,6 +901,15 @@ class LocalRuntime:
 
     def _finish_actor_removal(self, shell: _ActorShell):
         with self._lock:
+            self._dead_actors.append({
+                "actor_id": shell.actor_id.hex(),
+                "class_name": shell.cls.__name__,
+                "state": "DEAD",
+                "name": shell.options.name or "",
+                "node_id": (shell.node_id.hex() if shell.node_id else None),
+                "death_cause": shell.death_reason,
+                "job_id": self.job_id.hex(),
+            })
             self._actors.pop(shell.actor_id, None)
             if shell.allocation.node is not None:
                 shell.allocation.node.actor_ids.discard(shell.actor_id)
@@ -1013,6 +1105,29 @@ class LocalRuntime:
             return out
 
     # -- cluster info ------------------------------------------------------
+
+    def actor_table(self) -> List[Dict[str, Any]]:
+        """Live + dead actor entries (parity: GCS ActorTableData rows
+        behind `ray list actors`, gcs.proto actor FSM states)."""
+        with self._lock:
+            live = []
+            for shell in self._actors.values():
+                if not shell.dead:
+                    state = "ALIVE" if shell.instance is not None \
+                        else "PENDING_CREATION"
+                else:
+                    state = "RESTARTING"
+                live.append({
+                    "actor_id": shell.actor_id.hex(),
+                    "class_name": shell.cls.__name__,
+                    "state": state,
+                    "name": shell.options.name or "",
+                    "node_id": (shell.node_id.hex() if shell.node_id
+                                else None),
+                    "death_cause": shell.death_reason or None,
+                    "job_id": self.job_id.hex(),
+                })
+            return live + list(self._dead_actors)
 
     def cluster_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = {}

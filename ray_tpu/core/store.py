@@ -211,6 +211,33 @@ class LocalObjectStore:
             self._shm = None
         self._shm_failed = True  # don't resurrect after shutdown
 
+    def entries(self) -> List[Dict[str, Any]]:
+        """Per-object rows for the state API (parity: `ray list objects`
+        / the cluster reference table behind `ray memory`)."""
+        with self._lock:
+            items = list(self._objects.items())
+        out = []
+        for oid, st in items:
+            if st.error is not None:
+                tier, size = "ERROR", 0
+            elif st.in_shm:
+                tier, size = "SHARED_MEMORY", st.shm_size
+            elif st.value_bytes is not None:
+                tier, size = "IN_PROCESS", len(st.value_bytes)
+            elif st.event.is_set():
+                tier, size = "IN_BAND", 0
+            else:
+                tier, size = "PENDING", 0
+            out.append({
+                "object_id": oid.hex(),
+                "task_id": oid.task_id().hex(),
+                "tier": tier,
+                "size_bytes": size,
+                "sealed": st.event.is_set(),
+                "is_error": st.error is not None,
+            })
+        return out
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             sealed = sum(1 for s in self._objects.values() if s.event.is_set())

@@ -139,11 +139,27 @@ def pipeline_apply(
 
 def microbatches_for(batch: int, n_stages: int, *, target_bubble: float = 0.2
                      ) -> int:
-    """Pick m so the GPipe bubble (n-1)/(m+n-1) <= target_bubble."""
+    """Pick m so the GPipe bubble (n-1)/(m+n-1) <= target_bubble.
+
+    m must divide ``batch``.  Picks the smallest such divisor meeting the
+    target; if no divisor can, returns the largest divisor (best
+    achievable bubble) and warns — callers sizing a pipeline by bubble
+    need the signal, not a silent 3x miss.
+    """
     if n_stages <= 1:
         return 1
     m_min = math.ceil((n_stages - 1) * (1 - target_bubble) / target_bubble)
-    m = 1
-    while m < m_min and m * 2 <= batch and batch % (m * 2) == 0:
-        m *= 2
-    return m
+    divisors = sorted(d for d in range(1, batch + 1) if batch % d == 0)
+    for d in divisors:
+        if d >= m_min:
+            return d
+    best = divisors[-1]
+    import warnings
+
+    warnings.warn(
+        f"microbatches_for: no divisor of batch={batch} reaches "
+        f"target_bubble={target_bubble} with {n_stages} stages; using "
+        f"m={best} (bubble {(n_stages - 1) / (best + n_stages - 1):.2f})",
+        stacklevel=2,
+    )
+    return best

@@ -128,7 +128,12 @@ class Algorithm(Trainable):
         return self.train()
 
     def save_checkpoint(self) -> Any:
-        return pickle.dumps(self.get_state())
+        # Always bundle the config so from_checkpoint can rebuild the
+        # same env/net shapes regardless of what a subclass's
+        # get_state() includes.
+        state = dict(self.get_state())
+        state.setdefault("config", self.config.to_dict())
+        return pickle.dumps(state)
 
     def load_checkpoint(self, checkpoint: Any) -> None:
         self.set_state(pickle.loads(checkpoint))

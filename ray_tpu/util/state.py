@@ -1,0 +1,124 @@
+"""Cluster state API — list/summarize live runtime entities.
+
+Parity with ``ray.util.state`` (ray: python/ray/util/state/api.py —
+list_tasks/list_actors/list_objects/list_nodes/list_placement_groups,
+summarize_* ; datasource fan-out in util/state/state_manager.py:142).
+Here the single runtime holds all state, so the "fan-out" is direct
+introspection of the runtime's GCS-side tables: the task-event ring
+(core/events.py), the actor table, the node table, the PG table, and
+the object store index.
+
+Filters follow the reference's ``[(key, op, value)]`` form with ops
+``=`` and ``!=`` (ray: util/state/common.py supported predicates).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+Filter = Tuple[str, str, Any]
+
+
+def _runtime():
+    from ray_tpu.core import api
+
+    return api.runtime()
+
+
+def _apply_filters(rows: List[Dict[str, Any]],
+                   filters: Optional[List[Filter]],
+                   limit: int) -> List[Dict[str, Any]]:
+    if filters:
+        for key, op, value in filters:
+            if op == "=":
+                rows = [r for r in rows if str(r.get(key)) == str(value)]
+            elif op == "!=":
+                rows = [r for r in rows if str(r.get(key)) != str(value)]
+            else:
+                raise ValueError(f"unsupported filter op {op!r} "
+                                 f"(use '=' or '!=')")
+    return rows[:limit]
+
+
+def list_tasks(filters: Optional[List[Filter]] = None, *,
+               limit: int = 100, detail: bool = False) -> List[Dict[str, Any]]:
+    """Task attempts, newest last (parity: `ray list tasks`)."""
+    rows = [a.to_dict() for a in _runtime().events.snapshot()]
+    if not detail:
+        keep = ("task_id", "attempt", "name", "type", "state", "node_id",
+                "actor_id", "error_message", "job_id")
+        rows = [{k: r.get(k) for k in keep} for r in rows]
+    return _apply_filters(rows, filters, limit)
+
+
+def list_actors(filters: Optional[List[Filter]] = None, *,
+                limit: int = 100) -> List[Dict[str, Any]]:
+    return _apply_filters(_runtime().actor_table(), filters, limit)
+
+
+def list_objects(filters: Optional[List[Filter]] = None, *,
+                 limit: int = 100) -> List[Dict[str, Any]]:
+    return _apply_filters(_runtime().store.entries(), filters, limit)
+
+
+def list_nodes(filters: Optional[List[Filter]] = None, *,
+               limit: int = 100) -> List[Dict[str, Any]]:
+    rows = [{
+        "node_id": n["NodeID"],
+        "state": "ALIVE" if n["Alive"] else "DEAD",
+        "resources": n["Resources"],
+        "labels": n["Labels"],
+    } for n in _runtime().nodes()]
+    return _apply_filters(rows, filters, limit)
+
+
+def list_placement_groups(filters: Optional[List[Filter]] = None, *,
+                          limit: int = 100) -> List[Dict[str, Any]]:
+    table = _runtime().placement_group_table()
+    rows = [{"placement_group_id": pg_id, **entry}
+            for pg_id, entry in table.items()]
+    return _apply_filters(rows, filters, limit)
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """Per-function-name counts by state (parity: `ray summary tasks`)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for a in _runtime().events.snapshot():
+        by_state = out.setdefault(a.name or a.task_id[:8], {})
+        by_state[a.state] = by_state.get(a.state, 0) + 1
+    return out
+
+
+def summarize_actors() -> Dict[str, Dict[str, int]]:
+    out: Dict[str, Dict[str, int]] = {}
+    for row in _runtime().actor_table():
+        by_state = out.setdefault(row["class_name"], {})
+        by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+    return out
+
+
+def summarize_objects() -> Dict[str, Any]:
+    rows = _runtime().store.entries()
+    return {
+        "total_objects": len(rows),
+        "total_size_bytes": sum(r["size_bytes"] for r in rows),
+        "by_tier": _count_by(rows, "tier"),
+    }
+
+
+def _count_by(rows: List[Dict[str, Any]], key: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for r in rows:
+        out[r[key]] = out.get(r[key], 0) + 1
+    return out
+
+
+def timeline(filename: Optional[str] = None) -> Optional[List[Dict[str, Any]]]:
+    """Chrome trace of every recorded task attempt (parity: `ray
+    timeline`, python/ray/_private/state.py:434 chrome_tracing_dump).
+    Returns the event list, or writes it to ``filename`` if given."""
+    buf = _runtime().events
+    if filename is None:
+        return buf.chrome_tracing_dump()
+    buf.dump_json(filename)
+    return None

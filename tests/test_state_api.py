@@ -1,0 +1,179 @@
+"""State API + task events + timeline (parity: ray.util.state +
+`ray timeline`; reference surfaces listed in SURVEY.md §2.2 State API,
+§5.1 task timeline)."""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import events as ev
+from ray_tpu.util import state
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_list_tasks_records_states(rt):
+    @ray_tpu.remote
+    def ok(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("nope")
+
+    assert ray_tpu.get(ok.remote(1)) == 2
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+
+    rows = state.list_tasks()
+    by_name = {r["name"]: r for r in rows}
+    assert by_name["ok"]["state"] == "FINISHED"
+    assert by_name["boom"]["state"] == "FAILED"
+    assert "nope" in by_name["boom"]["error_message"]
+    assert by_name["ok"]["node_id"] is not None
+
+
+def test_task_filters_and_limit(rt):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(5)])
+    finished = state.list_tasks(filters=[("state", "=", "FINISHED")])
+    assert len(finished) >= 5
+    assert all(r["state"] == "FINISHED" for r in finished)
+    assert len(state.list_tasks(limit=2)) == 2
+    with pytest.raises(ValueError):
+        state.list_tasks(filters=[("state", ">", "FINISHED")])
+
+
+def test_retry_attempts_recorded(rt):
+    calls = {"n": 0}
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote()) == "ok"
+    attempts = [r for r in state.list_tasks(limit=1000)
+                if r["name"] == "flaky"]
+    states = sorted((r["attempt"], r["state"]) for r in attempts)
+    assert states == [(0, "FAILED"), (1, "FAILED"), (2, "FINISHED")]
+
+
+def test_list_actors_lifecycle(rt):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(name="counter").remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+
+    rows = state.list_actors(filters=[("class_name", "=", "Counter")])
+    assert rows and rows[0]["state"] == "ALIVE"
+    assert rows[0]["name"] == "counter"
+
+    ray_tpu.kill(c)
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        rows = state.list_actors(filters=[("class_name", "=", "Counter")])
+        if rows and rows[0]["state"] == "DEAD":
+            break
+        time.sleep(0.02)
+    assert rows[0]["state"] == "DEAD"
+
+    # Actor method + creation tasks appear in the event log.
+    tasks = state.list_tasks(limit=1000)
+    names = {r["name"] for r in tasks}
+    assert "Counter.__init__" in names
+    assert "Counter.incr" in names
+    types = {r["name"]: r["type"] for r in tasks}
+    assert types["Counter.__init__"] == ev.ACTOR_CREATION_TASK
+    assert types["Counter.incr"] == ev.ACTOR_TASK
+
+
+def test_list_objects_and_summary(rt):
+    import numpy as np
+
+    small = ray_tpu.put({"a": 1})
+    big = ray_tpu.put(np.zeros(1 << 20, dtype=np.uint8))  # > shm threshold
+    rows = state.list_objects(limit=1000)
+    by_id = {r["object_id"]: r for r in rows}
+    assert by_id[small.id.hex()]["sealed"]
+    assert by_id[big.id.hex()]["size_bytes"] >= 1 << 20
+    summ = state.summarize_objects()
+    assert summ["total_objects"] >= 2
+    assert summ["total_size_bytes"] >= 1 << 20
+    del big  # keep the ref alive until here
+
+
+def test_list_nodes_and_pgs(rt):
+    nodes = state.list_nodes()
+    assert nodes and nodes[0]["state"] == "ALIVE"
+
+    from ray_tpu.util import placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    ray_tpu.get(pg.ready())
+    rows = state.list_placement_groups()
+    assert any(r["state"] == "CREATED" for r in rows)
+
+
+def test_summarize_tasks(rt):
+    @ray_tpu.remote
+    def g():
+        return 0
+
+    ray_tpu.get([g.remote() for _ in range(3)])
+    summ = state.summarize_tasks()
+    assert summ["g"]["FINISHED"] == 3
+
+
+def test_timeline_chrome_trace(rt, tmp_path):
+    @ray_tpu.remote
+    def work():
+        return 42
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    path = tmp_path / "trace.json"
+    ray_tpu.timeline(str(path))
+    events = json.loads(path.read_text())
+    xs = [e for e in events if e.get("ph") == "X" and e["name"] == "work"]
+    assert len(xs) == 3
+    for e in xs:
+        assert e["dur"] >= 0
+        assert e["args"]["state"] == "FINISHED"
+    # Metadata rows name the nodes.
+    assert any(e.get("ph") == "M" for e in events)
+
+
+def test_event_ring_bounded(rt):
+    buf = ev.TaskEventBuffer(max_tasks=10)
+    for i in range(25):
+        buf.record(f"t{i}", ev.RUNNING, name=f"t{i}")
+        buf.record(f"t{i}", ev.FINISHED)
+    assert len(buf.snapshot()) == 10
+    assert buf.num_dropped == 15
+    # Running (non-terminal) attempts survive eviction preferentially.
+    buf2 = ev.TaskEventBuffer(max_tasks=5)
+    buf2.record("keep", ev.RUNNING, name="keep")
+    for i in range(10):
+        buf2.record(f"d{i}", ev.RUNNING)
+        buf2.record(f"d{i}", ev.FINISHED)
+    assert any(r.task_id == "keep" for r in buf2.snapshot())
