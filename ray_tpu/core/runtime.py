@@ -230,6 +230,9 @@ class _ActorShell:
         self.queue: _queue.Queue = _queue.Queue()
         self._creation_oid = creation_oid
         self.thread: Optional[threading.Thread] = None
+        # Restart counter for per-attempt task events (parity: each
+        # restart is a distinct attempt of the creation task).
+        self.creation_attempt = -1
 
     @property
     def node_id(self) -> Optional[NodeID]:
@@ -251,8 +254,10 @@ class _ActorShell:
         # Actor creation is the first "task" (parity: actor creation task).
         ev = self.runtime.events
         ctid = getattr(self, "creation_task_id", None)
+        self.creation_attempt += 1
+        attempt = self.creation_attempt
         if ctid is not None:
-            ev.record(ctid.hex(), _ev.RUNNING,
+            ev.record(ctid.hex(), _ev.RUNNING, attempt=attempt,
                       name=f"{self.cls.__name__}.__init__",
                       type=_ev.ACTOR_CREATION_TASK,
                       actor_id=self.actor_id.hex(),
@@ -262,12 +267,13 @@ class _ActorShell:
             self._construct()
             self.runtime.store.put_value(self._creation_oid, None)
             if ctid is not None:
-                ev.record(ctid.hex(), _ev.FINISHED)
+                ev.record(ctid.hex(), _ev.FINISHED, attempt=attempt)
         except BaseException as e:
             self.dead = True
             self.death_reason = f"creation failed: {e!r}"
             if ctid is not None:
-                ev.record(ctid.hex(), _ev.FAILED, error_message=repr(e))
+                ev.record(ctid.hex(), _ev.FAILED, attempt=attempt,
+                          error_message=repr(e))
             self.runtime.store.put_error(
                 self._creation_oid,
                 ActorDiedError(repr(self.cls), self.death_reason),
@@ -674,6 +680,7 @@ class LocalRuntime:
                     self.store.put_error(oid, err)
                 self.events.record(
                     pt.task_id.hex(), _ev.FAILED, name=pt.function_name,
+                    attempt=pt.options.max_retries - pt.retries_left,
                     error_message=str(e),
                 )
                 return None
@@ -899,17 +906,20 @@ class LocalRuntime:
         threading.Thread(target=poll, daemon=True,
                          name=f"restart-{shell.actor_id.hex()[:8]}").start()
 
+    def _actor_row(self, shell: _ActorShell, state: str) -> Dict[str, Any]:
+        return {
+            "actor_id": shell.actor_id.hex(),
+            "class_name": shell.cls.__name__,
+            "state": state,
+            "name": shell.options.name or "",
+            "node_id": (shell.node_id.hex() if shell.node_id else None),
+            "death_cause": shell.death_reason or None,
+            "job_id": self.job_id.hex(),
+        }
+
     def _finish_actor_removal(self, shell: _ActorShell):
         with self._lock:
-            self._dead_actors.append({
-                "actor_id": shell.actor_id.hex(),
-                "class_name": shell.cls.__name__,
-                "state": "DEAD",
-                "name": shell.options.name or "",
-                "node_id": (shell.node_id.hex() if shell.node_id else None),
-                "death_cause": shell.death_reason,
-                "job_id": self.job_id.hex(),
-            })
+            self._dead_actors.append(self._actor_row(shell, "DEAD"))
             self._actors.pop(shell.actor_id, None)
             if shell.allocation.node is not None:
                 shell.allocation.node.actor_ids.discard(shell.actor_id)
@@ -1117,16 +1127,7 @@ class LocalRuntime:
                         else "PENDING_CREATION"
                 else:
                     state = "RESTARTING"
-                live.append({
-                    "actor_id": shell.actor_id.hex(),
-                    "class_name": shell.cls.__name__,
-                    "state": state,
-                    "name": shell.options.name or "",
-                    "node_id": (shell.node_id.hex() if shell.node_id
-                                else None),
-                    "death_cause": shell.death_reason or None,
-                    "job_id": self.job_id.hex(),
-                })
+                live.append(self._actor_row(shell, state))
             return live + list(self._dead_actors)
 
     def cluster_resources(self) -> Dict[str, float]:

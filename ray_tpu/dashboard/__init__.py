@@ -1,0 +1,13 @@
+"""Dashboard: HTTP observability endpoints over the state API + metrics.
+
+Parity: the reference's dashboard head process (ray: dashboard/head.py:81,
+HTTP routing in dashboard/http_server_head.py; state aggregation
+dashboard/state_aggregator.py:141; Prometheus endpoint via the metrics
+agent, dashboard/modules/metrics/).  The single-process runtime serves
+the same JSON surfaces from the live runtime directly — stdlib
+``http.server`` instead of aiohttp (no external deps in this build).
+"""
+
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

@@ -1,0 +1,134 @@
+"""Dashboard head: threaded HTTP server exposing cluster state.
+
+Endpoints (parity: dashboard REST surfaces + `ray.util.state` fan-out;
+reference routes live in dashboard/modules/*/  — node, actor, state,
+metrics):
+
+  GET /                          tiny HTML index
+  GET /api/cluster_status        {resources, available, nodes}  (parity:
+                                 dashboard/modules/reporter cluster status)
+  GET /api/v0/tasks              state API rows (parity: StateHead routes
+  GET /api/v0/actors              in dashboard/modules/state/state_head.py)
+  GET /api/v0/objects
+  GET /api/v0/nodes
+  GET /api/v0/placement_groups
+  GET /api/v0/tasks/summarize
+  GET /timeline                  Chrome trace JSON
+  GET /metrics                   Prometheus text exposition
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+_INDEX = """<!doctype html><title>ray_tpu dashboard</title>
+<h1>ray_tpu dashboard</h1><ul>
+<li><a href=/api/cluster_status>cluster status</a>
+<li><a href=/api/v0/tasks>tasks</a> (<a href=/api/v0/tasks/summarize>summary</a>)
+<li><a href=/api/v0/actors>actors</a>
+<li><a href=/api/v0/objects>objects</a>
+<li><a href=/api/v0/nodes>nodes</a>
+<li><a href=/api/v0/placement_groups>placement groups</a>
+<li><a href=/timeline>timeline</a> (chrome://tracing)
+<li><a href=/metrics>metrics</a> (prometheus)
+</ul>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+    def _send(self, body: bytes, ctype: str, code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200) -> None:
+        self._send(json.dumps(obj).encode(), "application/json", code)
+
+    def do_GET(self):  # noqa: N802 (stdlib handler API)
+        from ray_tpu.core import api
+        from ray_tpu.util import metrics as _metrics
+        from ray_tpu.util import state as _state
+
+        url = urlparse(self.path)
+        qs = parse_qs(url.query)
+        limit = int(qs.get("limit", ["100"])[0])
+        try:
+            if url.path in ("/", "/index.html"):
+                self._send(_INDEX.encode(), "text/html")
+            elif url.path == "/metrics":
+                self._send(_metrics.export_prometheus().encode(),
+                           "text/plain; version=0.0.4")
+            elif not api.is_initialized():
+                self._json({"error": "runtime not initialized"}, 503)
+            elif url.path == "/api/cluster_status":
+                self._json({
+                    "resources": api.cluster_resources(),
+                    "available": api.available_resources(),
+                    "nodes": _state.list_nodes(limit=limit),
+                })
+            elif url.path == "/api/v0/tasks":
+                self._json({"result": _state.list_tasks(limit=limit)})
+            elif url.path == "/api/v0/tasks/summarize":
+                self._json({"result": _state.summarize_tasks()})
+            elif url.path == "/api/v0/actors":
+                self._json({"result": _state.list_actors(limit=limit)})
+            elif url.path == "/api/v0/objects":
+                self._json({"result": _state.list_objects(limit=limit)})
+            elif url.path == "/api/v0/nodes":
+                self._json({"result": _state.list_nodes(limit=limit)})
+            elif url.path == "/api/v0/placement_groups":
+                self._json({"result": _state.list_placement_groups(
+                    limit=limit)})
+            elif url.path == "/timeline":
+                self._json(_state.timeline())
+            else:
+                self._json({"error": f"no route {url.path}"}, 404)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # surface handler bugs as 500s, not hangs
+            try:
+                self._json({"error": repr(e)}, 500)
+            except Exception:
+                pass
+
+
+class DashboardHead:
+    """Owns the HTTP server thread (parity: DashboardHead lifecycle in
+    dashboard/head.py — minus the agent/GCS plumbing a single process
+    doesn't need)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "DashboardHead":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="dashboard-head",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> DashboardHead:
+    return DashboardHead(host, port).start()

@@ -149,7 +149,12 @@ def microbatches_for(batch: int, n_stages: int, *, target_bubble: float = 0.2
     if n_stages <= 1:
         return 1
     m_min = math.ceil((n_stages - 1) * (1 - target_bubble) / target_bubble)
-    divisors = sorted(d for d in range(1, batch + 1) if batch % d == 0)
+    divisors = set()
+    for d in range(1, int(math.isqrt(batch)) + 1):
+        if batch % d == 0:
+            divisors.add(d)
+            divisors.add(batch // d)
+    divisors = sorted(divisors)
     for d in divisors:
         if d >= m_min:
             return d

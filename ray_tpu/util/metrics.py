@@ -1,0 +1,270 @@
+"""Application + internal metrics with Prometheus exposition.
+
+Parity with the reference's metrics pipeline: the user-facing
+``Counter``/``Gauge``/``Histogram`` API (ray: python/ray/util/metrics.py)
+feeding a process-wide registry (ray: src/ray/stats/metric.h OpenCensus
+views), internal metric definitions (ray: src/ray/stats/metric_defs.cc —
+ray_tasks / ray_actors / object-store gauges), and Prometheus text
+exposition (ray: python/ray/_private/prometheus_exporter.py behind the
+dashboard agent's /metrics).
+
+The single-process runtime needs no export RPC hop (ray:
+stats/metric_exporter.cc → MetricsAgent): the registry is scraped
+directly; internal metrics are computed at scrape time from live
+runtime state, which matches the reference's gauge-callback pattern.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_TagTuple = Tuple[Tuple[str, str], ...]
+
+
+def _tag_tuple(tags: Optional[Dict[str, str]],
+               default_tags: Dict[str, str],
+               tag_keys: Sequence[str]) -> _TagTuple:
+    merged = dict(default_tags)
+    if tags:
+        unknown = set(tags) - set(tag_keys)
+        if unknown:
+            raise ValueError(
+                f"unknown tag keys {sorted(unknown)}; declared {tag_keys}"
+            )
+        merged.update(tags)
+    return tuple(sorted(merged.items()))
+
+
+class Metric:
+    """Base: named metric with declared tag keys (parity:
+    ray.util.metrics.Metric)."""
+
+    _type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys: Tuple[str, ...] = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        _default_registry.register(self)
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        unknown = set(tags) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(
+                f"unknown tag keys {sorted(unknown)}; declared {self.tag_keys}"
+            )
+        self._default_tags = dict(tags)
+        return self
+
+    def _samples(self) -> List[Tuple[str, _TagTuple, float]]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonic counter (parity: ray.util.metrics.Counter)."""
+
+    _type = "counter"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[_TagTuple, float] = {}
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("Counter.inc requires a non-negative value")
+        key = _tag_tuple(tags, self._default_tags, self.tag_keys)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _samples(self):
+        with self._lock:
+            return [(self.name, k, v) for k, v in self._values.items()]
+
+
+class Gauge(Metric):
+    """Point-in-time value (parity: ray.util.metrics.Gauge)."""
+
+    _type = "gauge"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[_TagTuple, float] = {}
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tag_tuple(tags, self._default_tags, self.tag_keys)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def _samples(self):
+        with self._lock:
+            return [(self.name, k, v) for k, v in self._values.items()]
+
+
+class Histogram(Metric):
+    """Bucketed distribution (parity: ray.util.metrics.Histogram;
+    exposition follows the Prometheus histogram convention:
+    cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``)."""
+
+    _type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        if not boundaries or any(b <= 0 for b in boundaries) or \
+                list(boundaries) != sorted(boundaries):
+            raise ValueError("boundaries must be positive and ascending")
+        self.boundaries = list(boundaries)
+        # per tag-set: [bucket counts..., +Inf count], sum
+        self._counts: Dict[_TagTuple, List[int]] = {}
+        self._sums: Dict[_TagTuple, float] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tag_tuple(tags, self._default_tags, self.tag_keys)
+        idx = bisect.bisect_left(self.boundaries, value)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1)
+            )
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def _samples(self):
+        out = []
+        with self._lock:
+            for key, counts in self._counts.items():
+                cum = 0
+                for b, c in zip(self.boundaries, counts):
+                    cum += c
+                    out.append((f"{self.name}_bucket",
+                                key + (("le", repr(float(b))),), float(cum)))
+                cum += counts[-1]
+                out.append((f"{self.name}_bucket",
+                            key + (("le", "+Inf"),), float(cum)))
+                out.append((f"{self.name}_count", key, float(cum)))
+                out.append((f"{self.name}_sum", key, self._sums[key]))
+        return out
+
+
+class MetricsRegistry:
+    """Process-wide metric registry; re-registering a name returns
+    samples from the newest instance (parity: OpenCensus view registry
+    keyed by view name)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Metric] = {}
+
+    def register(self, metric: Metric) -> None:
+        with self._lock:
+            self._metrics[metric.name] = metric
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def collect(self) -> List[Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+
+_default_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _default_registry
+
+
+# -- internal runtime metrics (parity: src/ray/stats/metric_defs.cc) -------
+
+def _internal_samples() -> List[Tuple[str, str, str, _TagTuple, float]]:
+    """(name, type, help, tags, value) computed from live runtime state
+    at scrape time — the reference's gauge-callback pattern."""
+    from ray_tpu.core import api
+
+    if not api.is_initialized():
+        return []
+    rt = api.runtime()
+    out: List[Tuple[str, str, str, _TagTuple, float]] = []
+
+    by_state: Dict[str, int] = {}
+    for a in rt.events.snapshot():
+        by_state[a.state] = by_state.get(a.state, 0) + 1
+    for st, n in sorted(by_state.items()):
+        out.append(("raytpu_tasks", "gauge",
+                    "Current number of task attempts by state.",
+                    (("State", st),), float(n)))
+
+    actor_states: Dict[str, int] = {}
+    for row in rt.actor_table():
+        actor_states[row["state"]] = actor_states.get(row["state"], 0) + 1
+    for st, n in sorted(actor_states.items()):
+        out.append(("raytpu_actors", "gauge",
+                    "Current number of actors by state.",
+                    (("State", st),), float(n)))
+
+    stats = rt.store.stats()
+    out.append(("raytpu_object_store_num_objects", "gauge",
+                "Objects tracked by the in-process store.", (),
+                float(stats["num_objects"])))
+    out.append(("raytpu_object_store_memory", "gauge",
+                "Bytes held by the in-process tier.", (),
+                float(stats["bytes"])))
+    shm = stats.get("shm")
+    if shm:
+        for k in ("used", "capacity"):
+            if k in shm:
+                out.append((f"raytpu_shm_store_{k}_bytes", "gauge",
+                            f"Shared-memory store {k} bytes.", (),
+                            float(shm[k])))
+
+    alive = sum(1 for n in rt.nodes() if n["Alive"])
+    out.append(("raytpu_cluster_nodes", "gauge",
+                "Alive nodes in the cluster.", (), float(alive)))
+    for res, total in rt.cluster_resources().items():
+        avail = rt.available_resources().get(res, 0.0)
+        tag = (("Name", res),)
+        out.append(("raytpu_resources_total", "gauge",
+                    "Total logical resources by kind.", tag, total))
+        out.append(("raytpu_resources_available", "gauge",
+                    "Available logical resources by kind.", tag, avail))
+    return out
+
+
+def _fmt_tags(tags: _TagTuple) -> str:
+    if not tags:
+        return ""
+    body = ",".join(f'{k}="{v}"' for k, v in tags)
+    return "{" + body + "}"
+
+
+def export_prometheus(include_internal: bool = True) -> str:
+    """Prometheus text exposition format 0.0.4 of every registered
+    metric (+ internal runtime metrics)."""
+    lines: List[str] = []
+    for m in _default_registry.collect():
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m._type}")
+        for name, tags, value in m._samples():
+            lines.append(f"{name}{_fmt_tags(tags)} {value}")
+    if include_internal:
+        seen_help = set()
+        for name, typ, help_, tags, value in _internal_samples():
+            if name not in seen_help:
+                seen_help.add(name)
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {typ}")
+            lines.append(f"{name}{_fmt_tags(tags)} {value}")
+    return "\n".join(lines) + "\n"

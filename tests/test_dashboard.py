@@ -1,0 +1,60 @@
+"""Dashboard HTTP surface (parity: dashboard/head.py routes + /metrics)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard
+
+
+@pytest.fixture
+def dash():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    d = start_dashboard()
+    yield d
+    d.stop()
+    ray_tpu.shutdown()
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read()
+
+
+def test_dashboard_routes(dash):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(2)])
+
+    status, body = _get(dash.address + "/api/cluster_status")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["resources"]["CPU"] == 2.0
+    assert payload["nodes"][0]["state"] == "ALIVE"
+
+    status, body = _get(dash.address + "/api/v0/tasks?limit=50")
+    rows = json.loads(body)["result"]
+    assert sum(1 for r in rows if r["name"] == "f") == 2
+
+    status, body = _get(dash.address + "/api/v0/tasks/summarize")
+    assert json.loads(body)["result"]["f"]["FINISHED"] == 2
+
+    status, body = _get(dash.address + "/metrics")
+    assert status == 200
+    assert b"raytpu_cluster_nodes" in body
+
+    status, body = _get(dash.address + "/timeline")
+    assert any(e.get("ph") == "X" for e in json.loads(body))
+
+    status, _ = _get(dash.address + "/")
+    assert status == 200
+
+
+def test_dashboard_404(dash):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(dash.address + "/api/nope")
+    assert ei.value.code == 404

@@ -1,0 +1,71 @@
+"""Metrics API + Prometheus exposition (parity: ray.util.metrics +
+_private/prometheus_exporter.py; internal defs per stats/metric_defs.cc)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    metrics.registry().clear()
+    yield
+    metrics.registry().clear()
+
+
+def test_counter_inc_and_tags():
+    c = metrics.Counter("req_total", "requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    text = metrics.export_prometheus(include_internal=False)
+    assert '# TYPE req_total counter' in text
+    assert 'req_total{route="/a"} 3.0' in text
+    assert 'req_total{route="/b"} 1.0' in text
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.inc(tags={"bogus": "x"})
+
+
+def test_gauge_set_and_default_tags():
+    g = metrics.Gauge("queue_len", tag_keys=("shard",))
+    g.set_default_tags({"shard": "0"})
+    g.set(7)
+    g.set(9, tags={"shard": "1"})
+    text = metrics.export_prometheus(include_internal=False)
+    assert 'queue_len{shard="0"} 7.0' in text
+    assert 'queue_len{shard="1"} 9.0' in text
+
+
+def test_histogram_buckets_cumulative():
+    h = metrics.Histogram("lat_ms", boundaries=[1, 10, 100])
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+    text = metrics.export_prometheus(include_internal=False)
+    assert 'lat_ms_bucket{le="1.0"} 1.0' in text
+    assert 'lat_ms_bucket{le="10.0"} 2.0' in text
+    assert 'lat_ms_bucket{le="100.0"} 3.0' in text
+    assert 'lat_ms_bucket{le="+Inf"} 4.0' in text
+    assert 'lat_ms_count 4.0' in text
+    assert 'lat_ms_sum 555.5' in text
+    with pytest.raises(ValueError):
+        metrics.Histogram("bad", boundaries=[10, 1])
+
+
+def test_internal_runtime_metrics():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ray_tpu.get([f.remote() for _ in range(3)])
+        text = metrics.export_prometheus()
+        assert 'raytpu_tasks{State="FINISHED"} 3.0' in text
+        assert 'raytpu_cluster_nodes 1.0' in text
+        assert 'raytpu_resources_total{Name="CPU"} 2.0' in text
+        assert "raytpu_object_store_num_objects" in text
+    finally:
+        ray_tpu.shutdown()
