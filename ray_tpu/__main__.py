@@ -1,0 +1,7 @@
+"""`python -m ray_tpu` → the CLI (parity: the `ray` console script)."""
+
+import sys
+
+from ray_tpu.scripts.cli import main
+
+sys.exit(main())

@@ -1,0 +1,29 @@
+"""Autoscaler: demand-driven node launch/terminate over provider plugins.
+
+Parity: the reference's autoscaler (ray: python/ray/autoscaler/_private/
+autoscaler.py StandardAutoscaler:171 — update() gathers load, bin-packs
+pending demand onto declared node types via ResourceDemandScheduler
+(resource_demand_scheduler.py:102), launches through a cloud
+NodeProvider plugin (autoscaler/node_provider.py), and terminates nodes
+idle past the timeout; min/max workers + upscaling_speed bound the
+actions).  The test provider mirrors FakeMultiNodeProvider
+(_private/fake_multi_node/node_provider.py:237): nodes are logical
+nodes of the local runtime.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerMonitor,
+    NodeTypeConfig,
+    ResourceDemandScheduler,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+
+__all__ = [
+    "AutoscalerMonitor",
+    "FakeNodeProvider",
+    "NodeProvider",
+    "NodeTypeConfig",
+    "ResourceDemandScheduler",
+    "StandardAutoscaler",
+]

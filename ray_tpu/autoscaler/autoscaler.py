@@ -1,0 +1,251 @@
+"""StandardAutoscaler: bin-pack pending demand onto node types.
+
+Parity: ray: python/ray/autoscaler/_private/autoscaler.py
+(StandardAutoscaler.update :171) + resource_demand_scheduler.py
+(ResourceDemandScheduler.get_nodes_to_launch :102 — greedy first-fit
+bin-packing of unfulfilled demands over declared node types), with the
+same control knobs: per-type min/max workers, global max_workers,
+upscaling_speed (bounds launches per round), idle_node_timeout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+@dataclasses.dataclass
+class NodeTypeConfig:
+    """One entry of available_node_types (parity: the cluster-YAML
+    available_node_types schema, autoscaler/ray-schema.json)."""
+
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def fits(self, demand: Dict[str, float]) -> bool:
+        return all(self.resources.get(k, 0) >= v for k, v in demand.items())
+
+
+class ResourceDemandScheduler:
+    """Greedy first-fit decreasing bin-packing of demands onto node
+    types (parity: resource_demand_scheduler.py get_nodes_to_launch)."""
+
+    def __init__(self, node_types: List[NodeTypeConfig]):
+        self.node_types = {t.name: t for t in node_types}
+
+    def get_nodes_to_launch(
+        self,
+        unfulfilled: List[Dict[str, float]],
+        current_counts: Dict[str, int],
+        global_max: int,
+    ) -> Dict[str, int]:
+        to_launch: Dict[str, int] = {}
+        # Virtual bins: capacity of nodes we plan to launch.
+        bins: List[Dict[str, float]] = []
+        total_now = sum(current_counts.values())
+
+        def can_add(type_name: str) -> bool:
+            t = self.node_types[type_name]
+            planned = current_counts.get(type_name, 0) \
+                + to_launch.get(type_name, 0)
+            all_planned = total_now + sum(to_launch.values())
+            return planned < t.max_workers and all_planned < global_max
+
+        # Largest demands first pack tightest.
+        for demand in sorted(unfulfilled,
+                             key=lambda d: -sum(d.values())):
+            placed = False
+            for b in bins:
+                if all(b.get(k, 0) >= v for k, v in demand.items()):
+                    for k, v in demand.items():
+                        b[k] = b.get(k, 0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            # Pick the first declared type that fits and has headroom.
+            for t in self.node_types.values():
+                if t.fits(demand) and can_add(t.name):
+                    to_launch[t.name] = to_launch.get(t.name, 0) + 1
+                    b = dict(t.resources)
+                    for k, v in demand.items():
+                        b[k] = b.get(k, 0) - v
+                    bins.append(b)
+                    placed = True
+                    break
+            # Unplaceable demand (no type ever fits): skipped — the
+            # runtime reports it as an infeasible task (parity: the
+            # reference logs and skips infeasible demands).
+        return to_launch
+
+
+def _runtime_load_source(runtime) -> List[Dict[str, float]]:
+    """Pending resource demands the cluster can't place right now:
+    queued task demands + unplaced PG bundles (parity: the load the
+    GCS reports to the autoscaler via GcsAutoscalerStateManager)."""
+    demands: List[Dict[str, float]] = []
+    with runtime._dispatch_cv:
+        for pt in runtime._pending:
+            demands.append(pt.options.resource_demand())
+    with runtime._lock:
+        for st in runtime._pgs.values():
+            if not st.removed:
+                for b in st.bundles:
+                    if b.node_id is None:
+                        demands.append(dict(b.resources))
+    return demands
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider,
+                 node_types: List[NodeTypeConfig], *,
+                 max_workers: int = 20,
+                 upscaling_speed: float = 1.0,
+                 idle_node_timeout_s: float = 60.0,
+                 runtime=None,
+                 load_source: Optional[Callable[[], List[Dict[str, float]]]]
+                 = None):
+        self.provider = provider
+        self.node_types = {t.name: t for t in node_types}
+        self.scheduler = ResourceDemandScheduler(node_types)
+        self.max_workers = max_workers
+        self.upscaling_speed = upscaling_speed
+        self.idle_node_timeout_s = idle_node_timeout_s
+        self._runtime = runtime
+        self._load_source = load_source
+        self._idle_since: Dict[str, float] = {}
+
+    def _rt(self):
+        if self._runtime is not None:
+            return self._runtime
+        from ray_tpu.core import api
+
+        return api.runtime()
+
+    def _load(self) -> List[Dict[str, float]]:
+        if self._load_source is not None:
+            return self._load_source()
+        return _runtime_load_source(self._rt())
+
+    def _unfulfilled(self, demands: List[Dict[str, float]]
+                     ) -> List[Dict[str, float]]:
+        """Demands no live node can currently satisfy from its
+        *available* pool — simulated placement against a snapshot
+        (parity: the scheduler's fit check before bin-packing)."""
+        rt = self._rt()
+        with rt._lock:
+            avail = [dict(n.pool.available)
+                     for n in rt._nodes.values() if n.alive]
+        out = []
+        for d in demands:
+            for pool in avail:
+                if all(pool.get(k, 0) >= v for k, v in d.items()):
+                    for k, v in d.items():
+                        pool[k] = pool.get(k, 0) - v
+                    break
+            else:
+                out.append(d)
+        return out
+
+    def update(self) -> Tuple[Dict[str, int], List[str]]:
+        """One reconcile round; returns (launched_by_type,
+        terminated_ids) (parity: StandardAutoscaler.update)."""
+        current = self.provider.non_terminated_nodes()
+        counts: Dict[str, int] = {}
+        for _pid, t in current.items():
+            counts[t] = counts.get(t, 0) + 1
+
+        # -- scale up -------------------------------------------------------
+        unfulfilled = self._unfulfilled(self._load())
+        to_launch = self.scheduler.get_nodes_to_launch(
+            unfulfilled, counts, self.max_workers
+        )
+        # min_workers floor per type.
+        for t in self.node_types.values():
+            have = counts.get(t.name, 0) + to_launch.get(t.name, 0)
+            if have < t.min_workers:
+                to_launch[t.name] = to_launch.get(t.name, 0) \
+                    + (t.min_workers - have)
+        # upscaling_speed bounds launches per round (parity: at most
+        # ceil(upscaling_speed * max(current, 5)) pending launches).
+        budget = max(1, math.ceil(
+            self.upscaling_speed * max(len(current), 5)
+        ))
+        launched: Dict[str, int] = {}
+        for name, n in to_launch.items():
+            n = min(n, budget - sum(launched.values()))
+            if n <= 0:
+                break
+            t = self.node_types[name]
+            for _ in range(n):
+                self.provider.create_node(name, t.resources, t.labels)
+            launched[name] = n
+
+        # -- scale down -----------------------------------------------------
+        terminated: List[str] = []
+        if not launched:
+            terminated = self._terminate_idle(current, counts)
+        return launched, terminated
+
+    def _terminate_idle(self, current: Dict[str, str],
+                        counts: Dict[str, int]) -> List[str]:
+        rt = self._rt()
+        now = time.monotonic()
+        with rt._lock:
+            busy = {n.node_id.hex(): (n.pool.utilization() > 0
+                                      or bool(n.actor_ids))
+                    for n in rt._nodes.values() if n.alive}
+        terminated: List[str] = []
+        for pid, type_name in list(current.items()):
+            if busy.get(pid, True):
+                self._idle_since.pop(pid, None)
+                continue
+            since = self._idle_since.setdefault(pid, now)
+            t = self.node_types.get(type_name)
+            floor = t.min_workers if t else 0
+            if (now - since >= self.idle_node_timeout_s
+                    and counts.get(type_name, 0) > floor):
+                self.provider.terminate_node(pid)
+                counts[type_name] -= 1
+                terminated.append(pid)
+                self._idle_since.pop(pid, None)
+        return terminated
+
+
+class AutoscalerMonitor:
+    """Background reconcile loop (parity: the head-node monitor.py
+    process hosting StandardAutoscaler)."""
+
+    def __init__(self, autoscaler: StandardAutoscaler,
+                 interval_s: float = 0.5):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "AutoscalerMonitor":
+        self._thread = threading.Thread(
+            target=self._loop, name="autoscaler-monitor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.autoscaler.update()
+            except Exception:
+                pass  # keep reconciling (parity: update() errors logged)

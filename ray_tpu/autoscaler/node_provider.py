@@ -1,0 +1,70 @@
+"""Node provider plugin interface + fake provider for tests.
+
+Parity: ray: python/ray/autoscaler/node_provider.py (NodeProvider — the
+cloud plugin surface: create/terminate/list) and the fake multi-node
+provider used in autoscaler tests
+(ray: python/ray/autoscaler/_private/fake_multi_node/node_provider.py:237,
+activated by RAY_FAKE_CLUSTER): fake nodes are logical nodes of the
+in-process runtime, so scheduling against them is real.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Cloud plugin surface.  Implementations: GCE/TPU-pod in
+    production, FakeNodeProvider in tests (parity: aws/gcp/... providers
+    under autoscaler/_private/)."""
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        """provider_node_id → node_type."""
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Adds/kills logical nodes on the live runtime."""
+
+    def __init__(self, runtime=None):
+        self._runtime = runtime
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, str] = {}
+
+    def _rt(self):
+        if self._runtime is not None:
+            return self._runtime
+        from ray_tpu.core import api
+
+        return api.runtime()
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        labels = dict(labels or {})
+        labels["raytpu.io/node-type"] = node_type
+        node_id = self._rt().add_node(dict(resources), labels)
+        pid = node_id.hex()
+        with self._lock:
+            self._nodes[pid] = node_type
+        return pid
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        from ray_tpu.utils.ids import NodeID
+
+        with self._lock:
+            self._nodes.pop(provider_node_id, None)
+        self._rt().kill_node(NodeID.from_hex(provider_node_id))
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._nodes)

@@ -17,7 +17,7 @@ from ray_tpu.utils.ids import ActorID
 _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "name", "get_if_exists",
     "max_restarts", "max_concurrency", "lifetime", "scheduling_strategy",
-    "placement_group", "placement_bundle_index",
+    "placement_group", "placement_bundle_index", "runtime_env",
 }
 
 _METHOD_OPTION_ATTR = "__raytpu_method_options__"
@@ -71,6 +71,8 @@ class ActorMethod:
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=self._num_returns,
         )
+        if self._num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
     def options(self, *, num_returns: Optional[int] = None) -> "ActorMethod":

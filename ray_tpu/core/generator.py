@@ -1,0 +1,88 @@
+"""Streaming object-ref generators.
+
+Parity with the reference's streaming generators
+(ray: python/ray/_raylet.pyx — StreamingObjectRefGenerator:267, the
+streaming-generator task executor :918): a task or actor method
+declared ``num_returns="streaming"`` yields values that are sealed into
+the store one at a time, and the caller iterates ``ObjectRef``s while
+the producer is still running.  The end of the stream is an in-store
+sentinel at the index after the last yield (parity: the
+end-of-stream error object the reference appends).
+
+Generator task retries are not supported (the consumer may already
+have observed a prefix of the stream); submission forces
+``max_retries=0`` — stricter than the reference, which replays with
+idempotency caveats.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.utils.ids import ObjectID, TaskID
+
+STREAMING = "streaming"
+
+
+class EndOfStream(Exception):
+    """Sentinel sealed (as a store-level error) after the last yielded
+    item — lets the consumer detect stream end with a non-deserializing
+    error peek instead of fetching and decoding the value."""
+
+
+class ObjectRefGenerator:
+    """Iterator of ObjectRefs produced by a streaming task.  ``next``
+    blocks until the next yield is sealed, then returns its ref; raises
+    StopIteration on the end-of-stream sentinel.  After an error ref is
+    returned the stream ends (the producer stopped there)."""
+
+    def __init__(self, task_id: TaskID):
+        self._task_id = task_id
+        self._index = 0
+        self._done = False
+
+    @property
+    def task_id(self) -> TaskID:
+        return self._task_id
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._next(timeout=None)
+
+    def next_ready(self, timeout: Optional[float]) -> ObjectRef:
+        """Like next() but bounded: raises GetTimeoutError if the
+        producer hasn't sealed the next item in time."""
+        return self._next(timeout=timeout)
+
+    def _next(self, timeout: Optional[float]) -> ObjectRef:
+        from ray_tpu.core import api
+        from ray_tpu.core.exceptions import GetTimeoutError
+
+        if self._done:
+            raise StopIteration
+        store = api.runtime().store
+        oid = ObjectID.for_task_return(self._task_id, self._index)
+        # Wait for the seal without deserializing the value (the
+        # consumer's ray.get does the one and only decode).
+        ready, _ = store.wait([oid], 1, timeout)
+        if not ready:
+            raise GetTimeoutError(
+                f"stream item {self._index} not produced within {timeout}s"
+            )
+        err = store.peek_error(oid)
+        if isinstance(err, EndOfStream):
+            self._done = True
+            raise StopIteration
+        if err is not None:
+            # Producer errored at this index: surface the ref (its get
+            # raises the error) and end the stream.
+            self._done = True
+        self._index += 1
+        return ObjectRef(oid)
+
+    def __repr__(self) -> str:
+        return (f"ObjectRefGenerator(task={self._task_id.hex()[:12]}, "
+                f"next_index={self._index})")

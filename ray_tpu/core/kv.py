@@ -1,0 +1,102 @@
+"""Internal key-value store.
+
+Parity with the GCS KV service (ray: src/ray/gcs/gcs_server/
+store_client_kv.cc behind GcsKvManager; Python surface
+ray._private.internal_kv / ray.experimental.internal_kv): namespaced
+byte-valued KV used by the function manager, job submission, runtime
+envs, and usage stats.  Lives on the runtime instance so it shares the
+cluster's lifetime (a GCS restart in the reference clears in-memory KV
+the same way).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_NAMESPACE = ""
+
+
+class KvStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[Tuple[str, bytes], bytes] = {}
+
+    @staticmethod
+    def _key(namespace: Optional[str], key: bytes) -> Tuple[str, bytes]:
+        if isinstance(key, str):
+            key = key.encode()
+        return (namespace or _DEFAULT_NAMESPACE, key)
+
+    def put(self, key, value, *, overwrite: bool = True,
+            namespace: Optional[str] = None) -> bool:
+        if isinstance(value, str):
+            value = value.encode()
+        k = self._key(namespace, key)
+        with self._lock:
+            if not overwrite and k in self._data:
+                return False
+            self._data[k] = bytes(value)
+            return True
+
+    def get(self, key, *, namespace: Optional[str] = None
+            ) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(self._key(namespace, key))
+
+    def exists(self, key, *, namespace: Optional[str] = None) -> bool:
+        with self._lock:
+            return self._key(namespace, key) in self._data
+
+    def delete(self, key, *, namespace: Optional[str] = None) -> bool:
+        with self._lock:
+            return self._data.pop(self._key(namespace, key), None) is not None
+
+    def keys(self, prefix=b"", *, namespace: Optional[str] = None
+             ) -> List[bytes]:
+        if isinstance(prefix, str):
+            prefix = prefix.encode()
+        ns = namespace or _DEFAULT_NAMESPACE
+        with self._lock:
+            return sorted(k for (n, k) in self._data if n == ns
+                          and k.startswith(prefix))
+
+    def match(self, pattern: str, *, namespace: Optional[str] = None
+              ) -> List[bytes]:
+        ns = namespace or _DEFAULT_NAMESPACE
+        with self._lock:
+            return sorted(k for (n, k) in self._data if n == ns
+                          and fnmatch.fnmatch(k.decode(errors="replace"),
+                                              pattern))
+
+
+# -- module-level convenience API (parity: ray.experimental.internal_kv) ---
+
+def _kv() -> KvStore:
+    from ray_tpu.core import api
+
+    return api.runtime().kv
+
+
+def internal_kv_put(key, value, *, overwrite: bool = True,
+                    namespace: Optional[str] = None) -> bool:
+    return _kv().put(key, value, overwrite=overwrite, namespace=namespace)
+
+
+def internal_kv_get(key, *, namespace: Optional[str] = None
+                    ) -> Optional[bytes]:
+    return _kv().get(key, namespace=namespace)
+
+
+def internal_kv_exists(key, *, namespace: Optional[str] = None) -> bool:
+    return _kv().exists(key, namespace=namespace)
+
+
+def internal_kv_del(key, *, namespace: Optional[str] = None) -> bool:
+    return _kv().delete(key, namespace=namespace)
+
+
+def internal_kv_list(prefix=b"", *, namespace: Optional[str] = None
+                     ) -> List[bytes]:
+    return _kv().keys(prefix, namespace=namespace)

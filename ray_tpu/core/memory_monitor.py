@@ -1,0 +1,199 @@
+"""Node memory monitor + OOM worker-killing policies.
+
+Parity: the reference's ``MemoryMonitor``
+(ray: src/ray/common/memory_monitor.h:52 — cgroup-aware used/total
+sampling on a timer, threshold callback) and the raylet's policy-based
+OOM killer (ray: src/ray/raylet/worker_killing_policy.cc,
+worker_killing_policy_retriable_fifo.cc,
+worker_killing_policy_group_by_owner.cc): when the node crosses the
+memory threshold, kill retriable work first — grouped by owner so one
+greedy job pays, and LIFO within a group so the shortest-lived work is
+sacrificed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+
+def get_system_memory_bytes() -> Tuple[int, int]:
+    """(used, total) bytes — cgroup v2 limit if present, else
+    /proc/meminfo (parity: MemoryMonitor::GetMemoryBytes cgroup-first)."""
+    try:
+        with open("/sys/fs/cgroup/memory.max") as f:
+            raw = f.read().strip()
+        if raw != "max":
+            total = int(raw)
+            with open("/sys/fs/cgroup/memory.current") as f:
+                used = int(f.read().strip())
+            return used, total
+    except OSError:
+        pass
+    info = {}
+    with open("/proc/meminfo") as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                info[parts[0].rstrip(":")] = int(parts[1]) * 1024
+    total = info.get("MemTotal", 0)
+    avail = info.get("MemAvailable", info.get("MemFree", 0))
+    return total - avail, total
+
+
+class MemoryMonitor:
+    """Polls memory usage on a timer thread; fires ``callback(used,
+    total)`` whenever usage exceeds ``usage_threshold`` (parity:
+    MemoryMonitor's monitor callback driving the OOM killer)."""
+
+    def __init__(self, usage_threshold: float = 0.95,
+                 check_interval_s: float = 0.25,
+                 callback: Optional[Callable[[int, int], None]] = None,
+                 usage_fn: Callable[[], Tuple[int, int]] =
+                 get_system_memory_bytes):
+        self.usage_threshold = usage_threshold
+        self.check_interval_s = check_interval_s
+        self.callback = callback
+        self.usage_fn = usage_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def is_over_threshold(self) -> bool:
+        used, total = self.usage_fn()
+        return total > 0 and used / total > self.usage_threshold
+
+    def start(self) -> "MemoryMonitor":
+        self._thread = threading.Thread(
+            target=self._loop, name="memory-monitor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_interval_s):
+            used, total = self.usage_fn()
+            if total > 0 and used / total > self.usage_threshold \
+                    and self.callback is not None:
+                self.callback(used, total)
+
+
+@dataclasses.dataclass
+class KillCandidate:
+    """One killable unit of work (parity: the raylet's view of a worker:
+    its task's retriability, start time, and owning job/actor)."""
+
+    id: str
+    retriable: bool
+    start_time: float
+    owner_id: str = ""
+
+
+def retriable_fifo_policy(candidates: Sequence[KillCandidate]
+                          ) -> Optional[KillCandidate]:
+    """Retriable tasks first, oldest first (parity:
+    worker_killing_policy_retriable_fifo.cc — FIFO among retriable,
+    then FIFO among the rest)."""
+    if not candidates:
+        return None
+    return min(candidates,
+               key=lambda c: (not c.retriable, c.start_time))
+
+
+def group_by_owner_policy(candidates: Sequence[KillCandidate]
+                          ) -> Optional[KillCandidate]:
+    """Group by owner; prefer a retriable group, break ties by group
+    size (largest pays), kill the newest member so the group loses the
+    least progress (parity: worker_killing_policy_group_by_owner.cc)."""
+    if not candidates:
+        return None
+    groups: dict = {}
+    for c in candidates:
+        groups.setdefault((c.retriable, c.owner_id), []).append(c)
+    # Sort groups: retriable first, then larger groups first.
+    (_, _), members = sorted(
+        groups.items(),
+        key=lambda kv: (not kv[0][0], -len(kv[1])),
+    )[0]
+    return max(members, key=lambda c: c.start_time)
+
+
+def process_rss_bytes(pid: Optional[int] = None) -> int:
+    """Resident set size of a process (parity: MemoryMonitor::
+    GetProcessMemoryBytes reading /proc/<pid>/smaps_rollup or statm)."""
+    pid = pid or os.getpid()
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+class OomKiller:
+    """Wires a MemoryMonitor to a kill policy over the runtime's
+    restartable actors (the killable unit in this runtime — thread-based
+    tasks can't be safely interrupted, matching the reference's rule of
+    only killing *retriable* work).  On pressure: kill one candidate per
+    grace period; its max_restarts budget restarts it when memory frees
+    (parity: raylet WorkerKillingPolicy + actor restart FSM)."""
+
+    def __init__(self, runtime, *, usage_threshold: float = 0.95,
+                 policy=group_by_owner_policy,
+                 check_interval_s: float = 0.25,
+                 grace_period_s: float = 1.0,
+                 usage_fn: Callable[[], Tuple[int, int]] =
+                 get_system_memory_bytes):
+        self.runtime = runtime
+        self.policy = policy
+        self.grace_period_s = grace_period_s
+        self.kills: List[str] = []
+        self._last_kill = 0.0
+        self.monitor = MemoryMonitor(
+            usage_threshold=usage_threshold,
+            check_interval_s=check_interval_s,
+            callback=self._on_pressure, usage_fn=usage_fn,
+        )
+
+    def start(self) -> "OomKiller":
+        self.monitor.start()
+        return self
+
+    def stop(self) -> None:
+        self.monitor.stop()
+
+    def _on_pressure(self, used: int, total: int) -> None:
+        now = time.monotonic()
+        if now - self._last_kill < self.grace_period_s:
+            return
+        with self.runtime._lock:
+            shells = [s for s in self.runtime._actors.values()
+                      if not s.dead]
+        candidates = [
+            KillCandidate(
+                id=s.actor_id.hex(),
+                retriable=s.restarts_left > 0,
+                start_time=getattr(s, "_start_ts", 0.0),
+                owner_id=s.runtime.job_id.hex(),
+            )
+            for s in shells
+        ]
+        victim = self.policy(candidates)
+        if victim is None:
+            return
+        self._last_kill = now
+        self.kills.append(victim.id)
+        for s in shells:
+            if s.actor_id.hex() == victim.id:
+                # no_restart=False: the actor's own max_restarts budget
+                # decides whether it comes back (parity: OOM-killed
+                # retriable tasks are retried).
+                s.kill(no_restart=False)
+                break

@@ -61,13 +61,21 @@ class ObjectState:
     """Store-side bookkeeping for one object (local runtime)."""
 
     __slots__ = ("event", "value_bytes", "error", "in_band", "in_shm",
-                 "shm_size")
+                 "shm_size", "spilled_uri", "last_access", "lost")
 
     def __init__(self):
         self.event = threading.Event()
         self.value_bytes: Optional[bytes] = None
         self.error: Optional[BaseException] = None
         self.in_band: Any = None
+        # True after invalidate(): the primary copy was lost and a
+        # reader should trigger lineage reconstruction (lazy, parity:
+        # ObjectRecoveryManager recovers on fetch, not on node death).
+        self.lost: bool = False
+        # Spilled-to-disk location (parity: spilled_url in the object
+        # directory) and LRU clock for choosing spill victims.
+        self.spilled_uri: Optional[str] = None
+        self.last_access: float = 0.0
         # Large objects live in the C++ shared-memory store, keyed by the
         # ObjectID bytes (parity: plasma promotion for big values).
         # Reader pins are GC-tied (shm_store.PinnedBuffer), no

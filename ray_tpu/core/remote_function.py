@@ -18,7 +18,7 @@ from ray_tpu.core.runtime import TaskOptions
 _VALID_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "num_returns", "max_retries",
     "name", "scheduling_strategy", "placement_group",
-    "placement_bundle_index",
+    "placement_bundle_index", "runtime_env",
 }
 
 
@@ -65,6 +65,8 @@ class RemoteFunction:
 
         opts = _make_task_options(self._default_options, overrides)
         refs = api.runtime().submit_task(self._fn, args, kwargs, opts)
+        if opts.num_returns == "streaming":
+            return refs  # an ObjectRefGenerator
         return refs[0] if opts.num_returns == 1 else refs
 
     @property

@@ -62,6 +62,7 @@ class TaskOptions:
     scheduling_strategy: Any = "DEFAULT"
     placement_group: Any = None
     placement_bundle_index: int = -1
+    runtime_env: Any = None
 
     def resource_demand(self) -> Dict[str, float]:
         demand = dict(self.resources)
@@ -92,6 +93,7 @@ class ActorOptions:
     scheduling_strategy: Any = "DEFAULT"
     placement_group: Any = None
     placement_bundle_index: int = -1
+    runtime_env: Any = None
 
     def resource_demand(self) -> Dict[str, float]:
         demand = dict(self.resources)
@@ -201,6 +203,8 @@ class _PendingTask:
     retries_left: int
     task_id: TaskID
     function_name: str
+    streaming: bool = False
+    on_done: Optional[Callable[[], None]] = None
 
 
 class _ActorShell:
@@ -241,6 +245,11 @@ class _ActorShell:
     def start(self):
         """Called after the runtime has registered the actor, so death
         bookkeeping always sees a registered actor."""
+        import time as _time
+
+        # Age for OOM kill policies (reset per (re)start — parity: the
+        # policies rank by the running task's start time).
+        self._start_ts = _time.monotonic()
         self.thread = threading.Thread(
             target=self._run, name=f"actor-{self.actor_id.hex()[:8]}",
             daemon=True,
@@ -248,7 +257,15 @@ class _ActorShell:
         self.thread.start()
 
     def _construct(self):
-        self.instance = self.cls(*self.init_args, **self.init_kwargs)
+        if self.options.runtime_env:
+            from ray_tpu.runtime_env import materialize
+
+            self._env_ctx = materialize(self.options.runtime_env)
+            with self._env_ctx.applied():
+                self.instance = self.cls(*self.init_args, **self.init_kwargs)
+        else:
+            self._env_ctx = None
+            self.instance = self.cls(*self.init_args, **self.init_kwargs)
 
     def _run(self):
         # Actor creation is the first "task" (parity: actor creation task).
@@ -308,7 +325,8 @@ class _ActorShell:
                 self.queue.put(None)
                 return
             method_name, args, kwargs, return_ids, num_returns = item[:5]
-            task_hex = item[5] if len(item) > 5 else None
+            task_id = item[5] if len(item) > 5 else None
+            task_hex = task_id.hex() if task_id is not None else None
             ev = self.runtime.events
             qname = f"{self.cls.__name__}.{method_name}"
             if task_hex:
@@ -322,14 +340,23 @@ class _ActorShell:
                     args, kwargs
                 )
                 method = getattr(self.instance, method_name)
-                result = method(*resolved_args, **resolved_kwargs)
+                ctx = getattr(self, "_env_ctx", None)
+                if ctx is not None:
+                    with ctx.applied():
+                        result = method(*resolved_args, **resolved_kwargs)
+                else:
+                    result = method(*resolved_args, **resolved_kwargs)
                 import inspect
 
                 if inspect.iscoroutine(result):
                     import asyncio
 
                     result = asyncio.run(result)
-                self.runtime._store_results(result, return_ids, num_returns)
+                if num_returns == "streaming":
+                    self.runtime._stream_results(result, task_id, qname)
+                else:
+                    self.runtime._store_results(result, return_ids,
+                                                num_returns)
                 if task_hex:
                     ev.record(task_hex, _ev.FINISHED)
             except BaseException as e:
@@ -338,6 +365,11 @@ class _ActorShell:
                 err = TaskError(f"{self.cls.__name__}.{method_name}", e)
                 for oid in return_ids:
                     self.runtime.store.put_error(oid, err)
+                if num_returns == "streaming" and task_id is not None:
+                    # See the streaming failure note in _start_task.
+                    self.runtime.store.put_error_if_pending(
+                        ObjectID.for_task_return(task_id, 0), err
+                    )
                 if not isinstance(e, Exception):
                     # actor dies on SystemExit et al
                     self.dead = True
@@ -355,22 +387,31 @@ class _ActorShell:
                 continue
             for oid in item[3]:
                 self.runtime.store.put_error(oid, err)
+            if item[4] == "streaming" and len(item) > 5 and item[5]:
+                # Queued-but-never-started stream: index 0 is unsealed.
+                self.runtime.store.put_error(
+                    ObjectID.for_task_return(item[5], 0), err
+                )
             if len(item) > 5 and item[5]:
-                self.runtime.events.record(item[5], _ev.FAILED,
+                self.runtime.events.record(item[5].hex(), _ev.FAILED,
                                            error_message=repr(err))
 
     def submit(self, method_name: str, args, kwargs, return_ids, num_returns,
-               task_hex: Optional[str] = None):
+               task_id: Optional[TaskID] = None):
         if self.dead:
             err = ActorDiedError(repr(self.cls), self.death_reason or "dead")
             for oid in return_ids:
                 self.runtime.store.put_error(oid, err)
-            if task_hex:
-                self.runtime.events.record(task_hex, _ev.FAILED,
+            if num_returns == "streaming" and task_id is not None:
+                self.runtime.store.put_error(
+                    ObjectID.for_task_return(task_id, 0), err
+                )
+            if task_id is not None:
+                self.runtime.events.record(task_id.hex(), _ev.FAILED,
                                            error_message=repr(err))
             return
         self.queue.put((method_name, args, kwargs, return_ids, num_returns,
-                        task_hex))
+                        task_id))
 
     def kill(self, no_restart: bool = True):
         self.dead = True
@@ -398,6 +439,11 @@ class LocalRuntime:
             total["CPU"] = float(cfg.num_workers_soft_limit or 8)
         total.setdefault("memory", 64 * 1024**3)
         self.store = LocalObjectStore()
+        # Cluster KV (parity: GcsKvManager — function table, job info,
+        # runtime envs and usage stats live here).
+        from ray_tpu.core.kv import KvStore
+
+        self.kv = KvStore()
         # GCS-side task-event ring (parity: GcsTaskManager, see events.py).
         self.events = _ev.TaskEventBuffer(
             max_tasks=getattr(cfg, "task_events_max_num", 16384)
@@ -421,9 +467,27 @@ class LocalRuntime:
         import collections as _collections
 
         self._dead_actors: Any = _collections.deque(maxlen=1024)
+        # Lineage for object reconstruction (parity: TaskManager keeps
+        # specs of finished tasks while their outputs are referenced,
+        # reference_count lineage pinning; bounded like
+        # RAY_max_lineage_bytes).  Keyed by return ObjectID → task spec.
+        self._lineage: "_collections.OrderedDict[ObjectID, _PendingTask]" = \
+            _collections.OrderedDict()
+        self._lineage_cap = 10000
+        # Where each task output's primary copy lives (parity: the
+        # object directory's location view).
+        self._object_locations: Dict[ObjectID, NodeID] = {}
+        # Reconstruction bookkeeping: in-flight task specs (by identity)
+        # and attempts per spec, bounded by max_retries (parity: the
+        # reference counts reconstruction against the retry budget).
+        self._reconstructing: set = set()
+        self._recon_attempts: Dict[int, int] = {}
         # Serializes all bundle (re-)reservation: concurrent node events
         # must not double-place the same pending bundle.
         self._pg_reserve_lock = threading.Lock()
+        # Readers hitting a lost object trigger lazy lineage
+        # reconstruction (parity: recovery on fetch failure).
+        self.store.lost_object_callback = self._reconstruct_object
         self.head_node_id = self.add_node(total, labels)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="dispatcher", daemon=True
@@ -478,7 +542,89 @@ class LocalRuntime:
                     b.available = {}
             if lost:
                 self._reserve_bundles(st, lost)
+        self._recover_lost_objects(node_id)
         self._notify()
+
+    def _recover_lost_objects(self, node_id: NodeID) -> None:
+        """Objects whose primary copy lived on the dead node are
+        invalidated.  Retriable outputs stay in the "lost" state until a
+        reader fetches them, which triggers lazy lineage reconstruction
+        (parity: ObjectRecoveryManager recovers on fetch, not on node
+        death — no eager replay of side effects for outputs nobody
+        reads).  Non-retriable outputs are sealed with ObjectLostError.
+        ray.put objects have no lineage and live on the driver node, so
+        they are never in the location map (parity: put objects are not
+        reconstructable)."""
+        from ray_tpu.core.exceptions import ObjectLostError
+
+        with self._lock:
+            lost = [oid for oid, nid in self._object_locations.items()
+                    if nid == node_id]
+            for oid in lost:
+                del self._object_locations[oid]
+            unrecoverable = [
+                oid for oid in lost
+                if (pt := self._lineage.get(oid)) is None
+                or pt.options.max_retries == 0
+            ]
+        for oid in lost:
+            invalidated = self.store.invalidate(oid)
+            if invalidated and oid in unrecoverable:
+                self.store.put_error(oid, ObjectLostError(oid.hex()))
+
+    def _reconstruct_object(self, oid: ObjectID) -> None:
+        """Resubmit the creating task of a lost object (parity:
+        ObjectRecoveryManager::ReconstructObject via
+        TaskManager::ResubmitTask).  Idempotent while a rebuild is in
+        flight; attempts are bounded by the task's max_retries."""
+        from ray_tpu.core.exceptions import ObjectLostError
+
+        with self._lock:
+            pt = self._lineage.get(oid)
+            if pt is None:
+                pt_missing = True
+            else:
+                pt_missing = False
+                key = id(pt)
+                if key in self._reconstructing:
+                    return
+                attempts = self._recon_attempts.get(key, 0)
+                if attempts >= max(1, pt.options.max_retries):
+                    exhausted = True
+                else:
+                    exhausted = False
+                    self._recon_attempts[key] = attempts + 1
+                    self._reconstructing.add(key)
+                    options = pt.options
+                    strategy = options.effective_strategy()
+                    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+                        want = (strategy.node_id.hex()
+                                if isinstance(strategy.node_id, NodeID)
+                                else str(strategy.node_id))
+                        alive = any(n.alive and n.node_id.hex() == want
+                                    for n in self._nodes.values())
+                        if not alive:
+                            # Pinned node is gone; rebuild anywhere.
+                            options = dataclasses.replace(
+                                options, scheduling_strategy="DEFAULT"
+                            )
+                    fresh = dataclasses.replace(
+                        pt, options=options,
+                        retries_left=options.max_retries,
+                        on_done=lambda k=key: self._reconstructing.discard(k),
+                    )
+        if pt_missing:
+            self.store.put_error_if_pending(oid, ObjectLostError(oid.hex()))
+            return
+        if exhausted:
+            for roid in pt.return_ids:
+                self.store.put_error_if_pending(
+                    roid, ObjectLostError(roid.hex())
+                )
+            return
+        with self._dispatch_cv:
+            self._pending.append(fresh)
+            self._dispatch_cv.notify_all()
 
     def _alive_nodes(self) -> List[NodeState]:
         return [self._nodes[i] for i in self._node_order
@@ -516,6 +662,10 @@ class LocalRuntime:
     def _deps_ready(self, args: tuple, kwargs: dict) -> bool:
         for v in list(args) + list(kwargs.values()):
             if isinstance(v, ObjectRef) and not self.store.contains(v.id):
+                # A lost dependency triggers its own reconstruction
+                # (parity: the dependency resolver's recovery path).
+                if self.store._state(v.id).lost:
+                    self._reconstruct_object(v.id)
                 return False
         return True
 
@@ -532,6 +682,40 @@ class LocalRuntime:
                 )
             for oid, v in zip(return_ids, values):
                 self.store.put_value(oid, v)
+
+    def _stream_results(self, result: Any, task_id: TaskID,
+                        function_name: str) -> None:
+        """Seal each yielded item at its return index as it is produced,
+        then the end-of-stream sentinel (parity: the streaming-generator
+        executor in _raylet.pyx:918).  Mid-stream errors are sealed at
+        the failing index and re-raised."""
+        from ray_tpu.core.generator import EndOfStream
+
+        i = 0
+        try:
+            if not hasattr(result, "__iter__"):
+                raise TypeError(
+                    f"streaming task {function_name!r} must return an "
+                    f"iterable/generator, got {type(result).__name__}"
+                )
+            for item in result:
+                self.store.put_value(
+                    ObjectID.for_task_return(task_id, i), item
+                )
+                i += 1
+        except BaseException as e:
+            # Seal the error at the failing index so the consumer's
+            # next() unblocks with an error ref instead of hanging.
+            self.store.put_error(
+                ObjectID.for_task_return(task_id, i),
+                e if isinstance(e, TaskError) else TaskError(
+                    function_name, e
+                ),
+            )
+            raise
+        self.store.put_error(
+            ObjectID.for_task_return(task_id, i), EndOfStream()
+        )
 
     # -- scheduling --------------------------------------------------------
 
@@ -634,23 +818,43 @@ class LocalRuntime:
                 f"infeasible"
             )
         task_id = TaskID.of(ActorID.nil_for_job(self.job_id))
-        return_ids = [
+        streaming = options.num_returns == "streaming"
+        return_ids = [] if streaming else [
             ObjectID.for_task_return(task_id, i)
             for i in range(options.num_returns)
         ]
         pt = _PendingTask(
             fn=fn, args=args, kwargs=kwargs, options=options,
-            return_ids=return_ids, retries_left=options.max_retries,
+            return_ids=return_ids,
+            # Streaming tasks never retry: the consumer may already have
+            # observed a prefix of the stream (see generator.py).
+            retries_left=0 if streaming else options.max_retries,
             task_id=task_id, function_name=getattr(fn, "__name__", repr(fn)),
+            streaming=streaming,
         )
         self.events.record(
             task_id.hex(), _ev.PENDING_NODE_ASSIGNMENT,
             name=pt.function_name, type=_ev.NORMAL_TASK,
             job_id=self.job_id.hex(), required_resources=demand,
         )
+        if not streaming:
+            with self._lock:
+                for oid in return_ids:
+                    self._lineage[oid] = pt
+                while len(self._lineage) > self._lineage_cap:
+                    # Evicting lineage also drops the location entry and
+                    # reconstruction counters — all three tables stay
+                    # bounded together.
+                    old_oid, old_pt = self._lineage.popitem(last=False)
+                    self._object_locations.pop(old_oid, None)
+                    self._recon_attempts.pop(id(old_pt), None)
         with self._dispatch_cv:
             self._pending.append(pt)
             self._dispatch_cv.notify_all()
+        if streaming:
+            from ray_tpu.core.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_id)
         return [ObjectRef(oid) for oid in return_ids]
 
     def _dispatch_loop(self):
@@ -678,6 +882,10 @@ class LocalRuntime:
                 err = TaskError(pt.function_name, e)
                 for oid in pt.return_ids:
                     self.store.put_error(oid, err)
+                if pt.streaming:
+                    self.store.put_error(
+                        ObjectID.for_task_return(pt.task_id, 0), err
+                    )
                 self.events.record(
                     pt.task_id.hex(), _ev.FAILED, name=pt.function_name,
                     attempt=pt.options.max_retries - pt.retries_left,
@@ -690,9 +898,13 @@ class LocalRuntime:
         return None
 
     def _start_task(self, pt: _PendingTask, alloc: _Allocation):
-        attempt = pt.options.max_retries - pt.retries_left
+        # Streaming tasks force retries_left=0, so derive their attempt
+        # as 0 rather than max_retries - 0.
+        attempt = (0 if pt.streaming
+                   else pt.options.max_retries - pt.retries_left)
 
         def run():
+            requeued = False
             self.events.record(
                 pt.task_id.hex(), _ev.RUNNING, name=pt.function_name,
                 attempt=attempt, job_id=self.job_id.hex(),
@@ -702,15 +914,42 @@ class LocalRuntime:
             )
             try:
                 args, kwargs = self.resolve_args(pt.args, pt.kwargs)
-                result = pt.fn(*args, **kwargs)
-                self._store_results(result, pt.return_ids, pt.options.num_returns)
+                if pt.options.runtime_env:
+                    from ray_tpu.runtime_env import materialize
+
+                    with materialize(pt.options.runtime_env).applied():
+                        result = pt.fn(*args, **kwargs)
+                else:
+                    result = pt.fn(*args, **kwargs)
+                if pt.streaming:
+                    self._stream_results(result, pt.task_id,
+                                         pt.function_name)
+                else:
+                    self._store_results(result, pt.return_ids,
+                                        pt.options.num_returns)
+                    if alloc.node is not None:
+                        with self._lock:
+                            for oid in pt.return_ids:
+                                self._object_locations[oid] = \
+                                    alloc.node.node_id
                 self.events.record(pt.task_id.hex(), _ev.FINISHED,
                                    attempt=attempt)
             except Exception as e:
                 self.events.record(pt.task_id.hex(), _ev.FAILED,
                                    attempt=attempt, error_message=repr(e))
+                if pt.streaming:
+                    # Failures before _stream_results sealed anything
+                    # (arg resolution, calling the function) must still
+                    # unblock the consumer; mid-stream failures already
+                    # sealed the failing index.
+                    self.store.put_error_if_pending(
+                        ObjectID.for_task_return(pt.task_id, 0),
+                        e if isinstance(e, TaskError)
+                        else TaskError(pt.function_name, e),
+                    )
                 if pt.retries_left > 0:
                     pt.retries_left -= 1
+                    requeued = True
                     with self._dispatch_cv:
                         self._pending.append(pt)
                         self._dispatch_cv.notify_all()
@@ -721,6 +960,11 @@ class LocalRuntime:
                     for oid in pt.return_ids:
                         self.store.put_error(oid, err)
             finally:
+                # on_done (the reconstruction in-flight guard) must NOT
+                # fire when the task was re-queued for retry — the work
+                # is still in flight.
+                if pt.on_done is not None and not requeued:
+                    pt.on_done()
                 alloc.release()
                 self._notify()
 
@@ -786,16 +1030,22 @@ class LocalRuntime:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict,
-                          num_returns: int = 1) -> List[ObjectRef]:
+                          num_returns: Any = 1):
         with self._lock:
             shell = self._actors.get(actor_id)
         task_id = TaskID.of(actor_id)
-        return_ids = [ObjectID.for_task_return(task_id, i)
-                      for i in range(num_returns)]
+        streaming = num_returns == "streaming"
+        return_ids = [] if streaming else [
+            ObjectID.for_task_return(task_id, i) for i in range(num_returns)
+        ]
         if shell is None:
             err = ActorDiedError(actor_id.hex(), "no such actor")
             for oid in return_ids:
                 self.store.put_error(oid, err)
+            if streaming:
+                self.store.put_error(
+                    ObjectID.for_task_return(task_id, 0), err
+                )
         else:
             self.events.record(
                 task_id.hex(), _ev.SUBMITTED_TO_WORKER,
@@ -804,7 +1054,11 @@ class LocalRuntime:
                 actor_id=actor_id.hex(),
             )
             shell.submit(method_name, args, kwargs, return_ids, num_returns,
-                         task_id.hex())
+                         task_id)
+        if streaming:
+            from ray_tpu.core.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_id)
         return [ObjectRef(oid) for oid in return_ids]
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):

@@ -1,0 +1,98 @@
+"""External storage for spilled objects.
+
+Parity with the reference's object-spilling IO layer
+(ray: python/ray/_private/external_storage.py — FileSystemStorage :246,
+spill/restore URL scheme, fused multi-object spill files with
+``?offset=..&size=..`` addressing; driven by the raylet's
+LocalObjectManager, src/ray/raylet/local_object_manager.h:41).
+
+Objects are spilled in fused batches: many small objects land in one
+file (parity: ``min_spilling_size`` fusion, external_storage.py
+``spill_objects`` writing url_with_offset) so restore is one seek+read.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import urllib.parse
+from typing import Dict, List, Sequence, Tuple
+
+
+class FileSystemStorage:
+    """Spill directory on local disk (parity: FileSystemStorage,
+    external_storage.py:246)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._seq = 0
+        self._lock = threading.Lock()
+        # fused-file path → dead (offset, size) segments; the file is
+        # unlinked when the whole byte range is dead.
+        self._dead_segments: Dict[str, List[Tuple[int, int]]] = {}
+
+    def _next_path(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return os.path.join(self.directory, f"spill-{self._seq:08d}.bin")
+
+    def spill_objects(self, objects: Sequence[Tuple[bytes, bytes]]
+                      ) -> List[str]:
+        """Write a fused file of (key, payload) pairs; returns one
+        ``file://path?offset=o&size=n`` URI per object, in order."""
+        if not objects:
+            return []
+        path = self._next_path()
+        uris: List[str] = []
+        offset = 0
+        with open(path, "wb") as f:
+            for _key, payload in objects:
+                f.write(payload)
+                uris.append(
+                    f"file://{path}?offset={offset}&size={len(payload)}"
+                )
+                offset += len(payload)
+        return uris
+
+    @staticmethod
+    def _parse(uri: str) -> Tuple[str, int, int]:
+        parsed = urllib.parse.urlparse(uri)
+        if parsed.scheme != "file":
+            raise ValueError(f"unsupported spill URI scheme: {uri}")
+        qs = urllib.parse.parse_qs(parsed.query)
+        return parsed.path, int(qs["offset"][0]), int(qs["size"][0])
+
+    def restore(self, uri: str) -> bytes:
+        path, offset, size = self._parse(uri)
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(size)
+        if len(data) != size:
+            raise IOError(f"short read restoring {uri}: "
+                          f"{len(data)} != {size}")
+        return data
+
+    def delete(self, uris: Sequence[str]) -> None:
+        """Delete spilled data.  A fused file is removed only once every
+        object inside it has been deleted (parity: external_storage
+        tracks fused-file liveness via the url_with_offset refs)."""
+        by_file: Dict[str, List[Tuple[int, int]]] = {}
+        for uri in uris:
+            path, offset, size = self._parse(uri)
+            by_file.setdefault(path, []).append((offset, size))
+        with self._lock:
+            for path, segments in by_file.items():
+                dead = self._dead_segments.setdefault(path, [])
+                dead.extend(segments)
+                try:
+                    file_size = os.path.getsize(path)
+                except OSError:
+                    self._dead_segments.pop(path, None)
+                    continue
+                if sum(s for _, s in dead) >= file_size:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                    self._dead_segments.pop(path, None)

@@ -43,7 +43,12 @@ class LocalObjectStore:
 
     def __init__(self, *, serialize_always: bool = True,
                  shm_threshold: int = 256 * 1024,
-                 shm_capacity: int = 4 << 30):
+                 shm_capacity: int = 4 << 30,
+                 inproc_cap_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        from ray_tpu.utils.config import get_config
+
+        cfg = get_config()
         self._lock = threading.Lock()
         self._objects: Dict[ObjectID, ObjectState] = {}
         # Serializing everything (even in local mode) keeps semantics
@@ -55,6 +60,37 @@ class LocalObjectStore:
         self._shm = None
         self._shm_failed = False
         self._shm_lock = threading.Lock()
+        # Spilling (parity: LocalObjectManager + external_storage.py):
+        # when the in-process tier exceeds the cap, cold sealed objects
+        # are fused into spill files and their bytes dropped.
+        self._inproc_cap = (inproc_cap_bytes
+                            if inproc_cap_bytes is not None
+                            else cfg.object_store_inproc_cap_bytes)
+        # Spill down to this fraction of cap (the low watermark).
+        self._spill_low_frac = cfg.object_spill_threshold
+        self._spill_dir = spill_dir or cfg.object_spill_dir or None
+        self._inproc_bytes = 0
+        self._storage = None
+        # Called with an ObjectID when a reader hits a lost object;
+        # the runtime hooks lineage reconstruction here (parity: the
+        # plasma fetch failure that triggers ObjectRecoveryManager).
+        self.lost_object_callback = None
+        # RLock: _spill_cold_objects holds it while lazily building the
+        # storage via _external_storage (same lock).
+        self._spill_lock = threading.RLock()
+        self.spill_stats = {"spilled_objects": 0, "spilled_bytes": 0,
+                            "restored_objects": 0, "restored_bytes": 0}
+
+    def _external_storage(self):
+        with self._spill_lock:
+            if self._storage is None:
+                import tempfile
+
+                from ray_tpu.core.spill import FileSystemStorage
+
+                d = self._spill_dir or tempfile.mkdtemp(prefix="raytpu-spill-")
+                self._storage = FileSystemStorage(d)
+            return self._storage
 
     def _shm_store(self):
         """Lazily build/attach the native store (lock: two racing large
@@ -106,15 +142,85 @@ class LocalObjectStore:
             if shm is None:
                 out = bytearray(size)
                 write_framed(memoryview(out), meta, buffers)
-                st.value_bytes = bytes(out)
+                st.last_access = time.monotonic()
+                with self._lock:
+                    # Re-puts (actor restart re-sealing its creation
+                    # oid, reconstruction) replace the old bytes — the
+                    # ledger must not count both copies.
+                    if st.value_bytes is not None:
+                        self._inproc_bytes -= len(st.value_bytes)
+                    st.value_bytes = bytes(out)
+                    self._inproc_bytes += size
         else:
             st.in_band = value
+        st.lost = False
         st.event.set()
+        if self._inproc_bytes > self._inproc_cap:
+            self._spill_cold_objects()
+
+    def _spill_cold_objects(self) -> None:
+        """Spill least-recently-used sealed in-process objects until the
+        tier is below ~80% of cap (parity: LocalObjectManager::
+        SpillObjectsOfSize driven by the high/low watermark)."""
+        low_water = int(self._inproc_cap * self._spill_low_frac)
+        with self._spill_lock:
+            with self._lock:
+                if self._inproc_bytes <= low_water:
+                    return
+                victims = sorted(
+                    ((oid, st) for oid, st in self._objects.items()
+                     if st.value_bytes is not None and st.event.is_set()
+                     and st.error is None),
+                    key=lambda kv: kv[1].last_access,
+                )
+                batch = []
+                freed = 0
+                for oid, st in victims:
+                    if self._inproc_bytes - freed <= low_water:
+                        break
+                    batch.append((oid, st, st.value_bytes))
+                    freed += len(st.value_bytes)
+            if not batch:
+                return
+            storage = self._external_storage()
+            uris = storage.spill_objects(
+                [(oid.binary(), payload) for oid, _, payload in batch]
+            )
+            orphaned: List[str] = []
+            with self._lock:
+                for (oid, st, payload), uri in zip(batch, uris):
+                    if st.value_bytes is None:
+                        # Raced with release(): it already adjusted the
+                        # ledger; reclaim the just-written segment.
+                        orphaned.append(uri)
+                        continue
+                    st.spilled_uri = uri
+                    st.value_bytes = None
+                    self._inproc_bytes -= len(payload)
+                    self.spill_stats["spilled_objects"] += 1
+                    self.spill_stats["spilled_bytes"] += len(payload)
+            if orphaned:
+                storage.delete(orphaned)
 
     def put_error(self, oid: ObjectID, error: BaseException) -> None:
         st = self._state(oid)
         st.error = error
+        st.lost = False
         st.event.set()
+
+    def put_error_if_pending(self, oid: ObjectID,
+                             error: BaseException) -> bool:
+        """Seal an error only if the object is still unsealed — used by
+        failure paths that must not clobber already-produced stream
+        items."""
+        st = self._state(oid)
+        with self._lock:
+            if st.event.is_set():
+                return False
+            st.error = error
+            st.lost = False
+            st.event.set()
+            return True
 
     # -- consumer side -----------------------------------------------------
 
@@ -131,12 +237,34 @@ class LocalObjectStore:
 
     def get(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
         st = self._state(oid)
-        if not st.event.wait(timeout):
-            raise GetTimeoutError(f"get timed out after {timeout}s for "
-                                  f"{oid.hex()}")
-        if st.error is not None:
-            raise st.error
-        if st.in_shm:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if st.lost and self.lost_object_callback is not None:
+                # Lazy reconstruction on fetch (parity:
+                # ObjectRecoveryManager::RecoverObject on pull failure).
+                self.lost_object_callback(oid)
+            slice_t = 0.5 if deadline is None else \
+                max(0.0, min(0.5, deadline - time.monotonic()))
+            if not st.event.wait(slice_t):
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError(
+                        f"get timed out after {timeout}s for {oid.hex()}"
+                    )
+                continue
+            # Snapshot under the lock: concurrent spill or invalidate
+            # may flip the representation between our checks.
+            with self._lock:
+                if not st.event.is_set():
+                    continue  # invalidated between wait and snapshot
+                err = st.error
+                shm_flag = st.in_shm
+                vb = st.value_bytes
+                spilled = st.spilled_uri
+                in_band = st.in_band
+            break
+        if err is not None:
+            raise err
+        if shm_flag:
             shm = self._shm_store()
             if shm is None:  # store closed under a racing reader
                 raise ObjectLostError(
@@ -155,9 +283,25 @@ class LocalObjectStore:
             # when the last view is garbage-collected (parity: plasma
             # buffers unpin on Python-object GC).
             return deserialize_object(pinned.view)
-        if st.value_bytes is not None:
-            return deserialize_object(st.value_bytes)
-        return st.in_band
+        if vb is not None:
+            st.last_access = time.monotonic()
+            return deserialize_object(vb)
+        if spilled is not None:
+            # Restore from disk (parity: LocalObjectManager restore via
+            # IO workers; here a direct read).  The restored bytes are
+            # not re-admitted — a hot object will be re-put by its
+            # producer pattern, and not re-admitting avoids spill↔restore
+            # thrash under sustained pressure.
+            try:
+                data = self._external_storage().restore(spilled)
+            except OSError:
+                raise ObjectLostError(
+                    f"object {oid.hex()}: spilled copy unreadable"
+                ) from None
+            self.spill_stats["restored_objects"] += 1
+            self.spill_stats["restored_bytes"] += len(data)
+            return deserialize_object(data)
+        return in_band
 
     def wait(
         self,
@@ -183,6 +327,10 @@ class LocalObjectStore:
             if deadline is not None and time.monotonic() >= deadline:
                 break
             if not progressed:
+                if self.lost_object_callback is not None:
+                    for oid in pending:
+                        if self._state(oid).lost:
+                            self.lost_object_callback(oid)
                 # Block on one pending object with a bounded slice.
                 slice_t = 0.05
                 if deadline is not None:
@@ -191,9 +339,48 @@ class LocalObjectStore:
                     self._state(pending[0]).event.wait(slice_t)
         return ready, pending
 
+    def invalidate(self, oid: ObjectID) -> bool:
+        """Un-seal a sealed object, dropping its bytes everywhere —
+        models loss of the primary copy when its node dies (parity: the
+        owner's view after plasma loss, before ObjectRecoveryManager
+        rebuilds it).  Readers blocked in get() stay blocked until a
+        reconstruction re-seals the id.  Returns False if the object
+        isn't currently sealed."""
+        with self._lock:
+            st = self._objects.get(oid)
+            if st is None or not st.event.is_set():
+                return False
+            if st.value_bytes is not None:
+                self._inproc_bytes -= len(st.value_bytes)
+            spilled, st.spilled_uri = st.spilled_uri, None
+            was_shm, st.in_shm = st.in_shm, False
+            st.value_bytes = None
+            st.in_band = None
+            st.error = None
+            st.lost = True
+            st.event.clear()
+        if spilled is not None and self._storage is not None:
+            self._storage.delete([spilled])
+        if was_shm and self._shm is not None:
+            try:
+                self._shm.delete(oid.binary())
+            except OSError:
+                pass
+        return True
+
     def release(self, oid: ObjectID) -> None:
         with self._lock:
             st = self._objects.pop(oid, None)
+            if st is not None and st.value_bytes is not None:
+                self._inproc_bytes -= len(st.value_bytes)
+                # Null the bytes so an in-flight spill of this object
+                # detects the release instead of double-decrementing.
+                st.value_bytes = None
+        if st is not None and st.spilled_uri is not None \
+                and self._storage is not None:
+            # Owner released the object → spilled bytes are deleted
+            # (parity: LocalObjectManager delete-spilled-on-free).
+            self._storage.delete([st.spilled_uri])
         if st is not None and st.in_shm and self._shm is not None:
             try:
                 # EBUSY while readers still hold views — their GC
@@ -224,6 +411,8 @@ class LocalObjectStore:
                 tier, size = "SHARED_MEMORY", st.shm_size
             elif st.value_bytes is not None:
                 tier, size = "IN_PROCESS", len(st.value_bytes)
+            elif st.spilled_uri is not None:
+                tier, size = "SPILLED", 0
             elif st.event.is_set():
                 tier, size = "IN_BAND", 0
             else:
@@ -250,6 +439,7 @@ class LocalObjectStore:
                 "num_sealed": sealed,
                 "bytes": nbytes,
             }
+            out.update(self.spill_stats)
         if self._shm is not None:
             out["shm"] = self._shm.stats()
         return out

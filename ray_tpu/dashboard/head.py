@@ -58,9 +58,9 @@ class _Handler(BaseHTTPRequestHandler):
         from ray_tpu.util import state as _state
 
         url = urlparse(self.path)
-        qs = parse_qs(url.query)
-        limit = int(qs.get("limit", ["100"])[0])
         try:
+            qs = parse_qs(url.query)
+            limit = int(qs.get("limit", ["100"])[0])
             if url.path in ("/", "/index.html"):
                 self._send(_INDEX.encode(), "text/html")
             elif url.path == "/metrics":
@@ -89,11 +89,71 @@ class _Handler(BaseHTTPRequestHandler):
                     limit=limit)})
             elif url.path == "/timeline":
                 self._json(_state.timeline())
+            elif url.path.startswith("/api/jobs"):
+                self._jobs_get(url.path)
             else:
                 self._json({"error": f"no route {url.path}"}, 404)
         except BrokenPipeError:
             pass
         except Exception as e:  # surface handler bugs as 500s, not hangs
+            try:
+                self._json({"error": repr(e)}, 500)
+            except Exception:
+                pass
+
+    # -- job REST routes (parity: dashboard/modules/job/job_head.py) -------
+
+    def _jobs_get(self, path: str) -> None:
+        import dataclasses
+
+        from ray_tpu.job_submission import job_manager
+
+        jm = job_manager()
+        parts = [p for p in path.split("/") if p][2:]  # after api/jobs
+        try:
+            if not parts:
+                self._json({"jobs": [dataclasses.asdict(i)
+                                     for i in jm.list_jobs()]})
+            elif len(parts) == 1:
+                self._json(dataclasses.asdict(jm.get_job_info(parts[0])))
+            elif len(parts) == 2 and parts[1] == "logs":
+                self._json({"logs": jm.get_job_logs(parts[0])})
+            else:
+                self._json({"error": f"no route {path}"}, 404)
+        except ValueError as e:  # unknown submission id → 404, not 500
+            self._json({"error": str(e)}, 404)
+
+    def do_POST(self):  # noqa: N802 (stdlib handler API)
+        import dataclasses  # noqa: F401
+
+        from ray_tpu.job_submission import job_manager
+
+        url = urlparse(self.path)
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}") \
+                if length else {}
+            jm = job_manager()
+            parts = [p for p in url.path.split("/") if p]
+            if parts[:2] == ["api", "jobs"] and len(parts) == 2:
+                sid = jm.submit_job(
+                    entrypoint=body["entrypoint"],
+                    submission_id=body.get("submission_id"),
+                    metadata=body.get("metadata"),
+                    runtime_env=body.get("runtime_env"),
+                )
+                self._json({"submission_id": sid})
+            elif (parts[:2] == ["api", "jobs"] and len(parts) == 4
+                    and parts[3] == "stop"):
+                try:
+                    self._json({"stopped": jm.stop_job(parts[2])})
+                except ValueError as e:  # unknown id → 404
+                    self._json({"error": str(e)}, 404)
+            else:
+                self._json({"error": f"no route {url.path}"}, 404)
+        except BrokenPipeError:
+            pass
+        except Exception as e:
             try:
                 self._json({"error": repr(e)}, 500)
             except Exception:

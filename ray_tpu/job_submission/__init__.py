@@ -1,0 +1,23 @@
+"""Job submission: run entrypoint scripts on the cluster with tracked
+status and logs.
+
+Parity: the reference's job subsystem (ray: dashboard/modules/job/ —
+JobSubmissionClient sdk.py:40, JobManager job_manager.py:525,
+JobSupervisor actor :140, REST handlers job_head.py).
+"""
+
+from ray_tpu.job_submission.job_manager import (
+    JobInfo,
+    JobManager,
+    JobStatus,
+    job_manager,
+)
+from ray_tpu.job_submission.sdk import JobSubmissionClient
+
+__all__ = [
+    "JobInfo",
+    "JobManager",
+    "JobStatus",
+    "JobSubmissionClient",
+    "job_manager",
+]

@@ -1,0 +1,240 @@
+"""Job manager + per-job supervisor actor.
+
+Parity: ray: dashboard/modules/job/job_manager.py — ``JobManager``
+(:525) creates one detached ``JobSupervisor`` actor (:140) per job; the
+supervisor execs the entrypoint as a subprocess, streams its output to
+a per-job log file, and writes ``JobInfo`` transitions into the GCS KV
+(namespace "job", parity: JobInfoStorageClient).  Status model follows
+ray: dashboard/modules/job/common.py JobStatus.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_KV_NAMESPACE = "job"
+_KV_PREFIX = "job_info:"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    STOPPED = "STOPPED"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+    TERMINAL = (STOPPED, SUCCEEDED, FAILED)
+
+
+@dataclasses.dataclass
+class JobInfo:
+    submission_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    message: str = ""
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    metadata: Dict[str, str] = dataclasses.field(default_factory=dict)
+    runtime_env: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    log_path: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "JobInfo":
+        return cls(**json.loads(raw))
+
+
+def _kv_write(info: JobInfo) -> None:
+    from ray_tpu.core.kv import internal_kv_put
+
+    internal_kv_put(_KV_PREFIX + info.submission_id, info.to_json(),
+                    namespace=_KV_NAMESPACE)
+
+
+def _kv_read(submission_id: str) -> Optional[JobInfo]:
+    from ray_tpu.core.kv import internal_kv_get
+
+    raw = internal_kv_get(_KV_PREFIX + submission_id,
+                          namespace=_KV_NAMESPACE)
+    return JobInfo.from_json(raw) if raw is not None else None
+
+
+class JobSupervisor:
+    """Runs one job's entrypoint as a subprocess and tracks it
+    (parity: the detached JobSupervisor actor, job_manager.py:140 —
+    here driven by a daemon thread inside the actor; stop() kills the
+    process group like the reference's SIGTERM→SIGKILL polling loop)."""
+
+    def __init__(self, submission_id: str):
+        self._submission_id = submission_id
+        self._proc: Optional[subprocess.Popen] = None
+        self._stopped = False
+
+    def run(self) -> None:
+        info = _kv_read(self._submission_id)
+        env = dict(os.environ)
+        env.update(info.runtime_env.get("env_vars", {}))
+        env["RAYTPU_JOB_ID"] = self._submission_id
+        cwd = info.runtime_env.get("working_dir") or None
+        info.status = JobStatus.RUNNING
+        info.start_time = time.time()
+        _kv_write(info)
+        log = open(info.log_path, "wb")
+        try:
+            self._proc = subprocess.Popen(
+                info.entrypoint, shell=True, stdout=log,
+                stderr=subprocess.STDOUT, env=env, cwd=cwd,
+                start_new_session=True,  # own process group for stop()
+            )
+            code = self._proc.wait()
+        except Exception as e:
+            info = _kv_read(self._submission_id)
+            info.status = JobStatus.FAILED
+            info.message = f"supervisor error: {e!r}"
+            info.end_time = time.time()
+            _kv_write(info)
+            return
+        finally:
+            log.close()
+        info = _kv_read(self._submission_id)
+        if self._stopped:
+            info.status = JobStatus.STOPPED
+            info.message = "stopped by user"
+        elif code == 0:
+            info.status = JobStatus.SUCCEEDED
+            info.message = "finished successfully"
+        else:
+            info.status = JobStatus.FAILED
+            info.message = f"entrypoint exited with code {code}"
+        info.end_time = time.time()
+        _kv_write(info)
+
+    def stop(self) -> bool:
+        self._stopped = True
+        if self._proc is not None and self._proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            return True
+        return False
+
+    def ping(self) -> str:
+        return "ok"
+
+
+class JobManager:
+    """Submits and tracks jobs (parity: JobManager, job_manager.py:525).
+    One supervisor actor per job, placed like any actor; job records
+    live in the cluster KV so listing survives supervisor exit."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        self._log_dir = log_dir or os.path.join(
+            tempfile.gettempdir(), "raytpu-job-logs"
+        )
+        os.makedirs(self._log_dir, exist_ok=True)
+        self._supervisors: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   metadata: Optional[Dict[str, str]] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None) -> str:
+        import ray_tpu
+
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        if _kv_read(submission_id) is not None:
+            raise ValueError(f"job {submission_id!r} already exists")
+        info = JobInfo(
+            submission_id=submission_id, entrypoint=entrypoint,
+            metadata=dict(metadata or {}),
+            runtime_env=dict(runtime_env or {}),
+            log_path=os.path.join(self._log_dir, f"{submission_id}.log"),
+        )
+        _kv_write(info)
+        # max_concurrency=2: stop() must not queue behind the blocking
+        # run() (parity: the reference's JobSupervisor is an async actor).
+        supervisor_cls = ray_tpu.remote(num_cpus=0, max_concurrency=2)(
+            JobSupervisor
+        )
+        sup = supervisor_cls.options(
+            name=f"_job_supervisor_{submission_id}"
+        ).remote(submission_id)
+        sup.run.remote()  # async: the supervisor thread owns the subprocess
+        with self._lock:
+            self._supervisors[submission_id] = sup
+        return submission_id
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        info = _kv_read(submission_id)
+        if info is None:
+            raise ValueError(f"no job {submission_id!r}")
+        return info
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id).status
+
+    def list_jobs(self) -> List[JobInfo]:
+        from ray_tpu.core.kv import internal_kv_get, internal_kv_list
+
+        out = []
+        for key in internal_kv_list(_KV_PREFIX, namespace=_KV_NAMESPACE):
+            raw = internal_kv_get(key, namespace=_KV_NAMESPACE)
+            if raw is not None:
+                out.append(JobInfo.from_json(raw))
+        return out
+
+    def stop_job(self, submission_id: str) -> bool:
+        import ray_tpu
+
+        self.get_job_info(submission_id)  # raises on unknown id
+        with self._lock:
+            sup = self._supervisors.get(submission_id)
+        if sup is None:
+            return False
+        return ray_tpu.get(sup.stop.remote())
+
+    def get_job_logs(self, submission_id: str) -> str:
+        info = self.get_job_info(submission_id)
+        try:
+            with open(info.log_path, "r", errors="replace") as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout: float = 60.0) -> JobInfo:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.get_job_info(submission_id)
+            if info.status in JobStatus.TERMINAL:
+                return info
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"job {submission_id!r} still "
+            f"{self.get_job_status(submission_id)} after {timeout}s"
+        )
+
+
+_manager: Optional[JobManager] = None
+_manager_lock = threading.Lock()
+
+
+def job_manager() -> JobManager:
+    """Process-wide manager (parity: the dashboard head owns one)."""
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = JobManager()
+        return _manager

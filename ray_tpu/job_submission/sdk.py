@@ -1,0 +1,101 @@
+"""Job submission client.
+
+Parity: ray: dashboard/modules/job/sdk.py:40 ``JobSubmissionClient`` —
+submit/status/logs/stop/list against a cluster.  Two transports:
+
+* in-process (``address=None``): direct calls on the process-wide
+  ``JobManager`` (the head-node path);
+* HTTP (``address="http://host:port"``): the dashboard's REST job
+  routes (parity: job_head.py handlers), for driving a cluster from
+  outside the driver process.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.job_submission.job_manager import JobInfo, job_manager
+
+
+class JobSubmissionClient:
+    def __init__(self, address: Optional[str] = None):
+        self._address = address.rstrip("/") if address else None
+
+    # -- HTTP helpers ------------------------------------------------------
+
+    def _http(self, method: str, path: str,
+              payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self._address + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    # -- API ---------------------------------------------------------------
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   metadata: Optional[Dict[str, str]] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None) -> str:
+        if self._address:
+            out = self._http("POST", "/api/jobs/", {
+                "entrypoint": entrypoint, "submission_id": submission_id,
+                "metadata": metadata or {},
+                "runtime_env": runtime_env or {},
+            })
+            return out["submission_id"]
+        return job_manager().submit_job(
+            entrypoint=entrypoint, submission_id=submission_id,
+            metadata=metadata, runtime_env=runtime_env,
+        )
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        if self._address:
+            out = self._http("GET", f"/api/jobs/{submission_id}")
+            return JobInfo(**out)
+        return job_manager().get_job_info(submission_id)
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id).status
+
+    def list_jobs(self) -> List[JobInfo]:
+        if self._address:
+            out = self._http("GET", "/api/jobs/")
+            return [JobInfo(**row) for row in out["jobs"]]
+        return job_manager().list_jobs()
+
+    def stop_job(self, submission_id: str) -> bool:
+        if self._address:
+            out = self._http("POST", f"/api/jobs/{submission_id}/stop")
+            return out["stopped"]
+        return job_manager().stop_job(submission_id)
+
+    def get_job_logs(self, submission_id: str) -> str:
+        if self._address:
+            out = self._http("GET", f"/api/jobs/{submission_id}/logs")
+            return out["logs"]
+        return job_manager().get_job_logs(submission_id)
+
+    def tail_job_logs(self, submission_id: str):
+        """Generator of log chunks until the job reaches a terminal
+        state (parity: sdk tail_job_logs polling loop)."""
+        import time
+
+        from ray_tpu.job_submission.job_manager import JobStatus
+
+        seen = 0
+        while True:
+            logs = self.get_job_logs(submission_id)
+            if len(logs) > seen:
+                yield logs[seen:]
+                seen = len(logs)
+            if self.get_job_status(submission_id) in JobStatus.TERMINAL:
+                rest = self.get_job_logs(submission_id)
+                if len(rest) > seen:
+                    yield rest[seen:]
+                return
+            time.sleep(0.1)

@@ -1,0 +1,224 @@
+"""Runtime environments: per-task/actor execution environments.
+
+Parity: the reference's runtime-env system (ray:
+python/ray/runtime_env/runtime_env.py RuntimeEnv; plugins under
+python/ray/_private/runtime_env/{working_dir,py_modules,pip,conda,
+plugin}.py; URI-addressed package cache in
+_private/runtime_env/packaging.py; design doc
+python/ray/runtime_env/ARCHITECTURE.md).
+
+Supported fields:
+  env_vars     dict[str,str] — applied around execution
+  working_dir  path or pkg URI — packaged (zip, content-hash URI),
+               cached, extracted, prepended to sys.path and exported as
+               RAYTPU_WORKING_DIR
+  py_modules   list of paths/URIs — packaged like working_dir, each
+               extracted and importable
+  config       {"setup_timeout_seconds": ...} accepted for parity
+  pip/conda    rejected: this build disallows package installation
+               (the reference shells out to pip/conda in the agent)
+
+Worker model note: the reference materializes envs per worker
+*process*; this runtime executes tasks on threads, so env_vars /
+sys.path application is process-global and serialized under a lock —
+same observable semantics for the common one-env-at-a-time case,
+honest-best-effort under concurrency (documented, like the reference's
+per-process limitation that envs cannot change within a worker).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import zipfile
+from typing import Any, Dict, List, Optional
+
+_KNOWN_FIELDS = {"env_vars", "working_dir", "py_modules", "config",
+                 "pip", "conda"}
+
+_PKG_SCHEME = "pkg://"
+
+
+class RuntimeEnv(dict):
+    """Validated runtime-env spec (parity: ray.runtime_env.RuntimeEnv —
+    a dict subclass with field validation)."""
+
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None,
+                 py_modules: Optional[List[str]] = None,
+                 config: Optional[Dict[str, Any]] = None,
+                 **kwargs):
+        super().__init__()
+        unknown = set(kwargs) - _KNOWN_FIELDS
+        if unknown:
+            raise ValueError(
+                f"unknown runtime_env field(s) {sorted(unknown)}; "
+                f"known: {sorted(_KNOWN_FIELDS)}"
+            )
+        if "pip" in kwargs or "conda" in kwargs:
+            raise NotImplementedError(
+                "pip/conda runtime envs are disabled in this build "
+                "(no package installation); bake dependencies into the "
+                "image instead"
+            )
+        if env_vars:
+            for k, v in env_vars.items():
+                if not isinstance(k, str) or not isinstance(v, str):
+                    raise TypeError("env_vars must be str → str")
+            self["env_vars"] = dict(env_vars)
+        if working_dir:
+            self["working_dir"] = working_dir
+        if py_modules:
+            self["py_modules"] = list(py_modules)
+        if config:
+            self["config"] = dict(config)
+        for k, v in kwargs.items():  # registered plugin fields
+            self[k] = v
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "RuntimeEnv":
+        return cls(**(d or {}))
+
+
+# -- packaging: content-addressed zips (parity: packaging.py) --------------
+
+def _cache_dir() -> str:
+    d = os.path.join(tempfile.gettempdir(), "raytpu-runtime-env-cache")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def package_directory(path: str) -> str:
+    """Zip a directory into the cache, named by content hash; returns a
+    ``pkg://<hash>.zip`` URI (parity: get_uri_for_directory +
+    upload_package_if_needed — the GCS upload hop collapses to the
+    shared cache dir)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"working_dir/py_module {path!r} is not a directory")
+    h = hashlib.sha256()
+    entries = []
+    for root, _dirs, files in os.walk(path):
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            rel = os.path.relpath(full, path)
+            entries.append((full, rel))
+    for full, rel in sorted(entries, key=lambda e: e[1]):
+        h.update(rel.encode())
+        with open(full, "rb") as fh:
+            h.update(fh.read())
+    digest = h.hexdigest()[:32]
+    zip_path = os.path.join(_cache_dir(), f"{digest}.zip")
+    if not os.path.exists(zip_path):
+        tmp = zip_path + ".tmp"
+        with zipfile.ZipFile(tmp, "w") as z:
+            for full, rel in entries:
+                z.write(full, rel)
+        os.replace(tmp, zip_path)
+    return f"{_PKG_SCHEME}{digest}.zip"
+
+
+def ensure_local(uri: str) -> str:
+    """Extract a package URI into the cache (idempotent); returns the
+    local directory (parity: download_and_unpack_package with the
+    per-URI local cache)."""
+    if not uri.startswith(_PKG_SCHEME):
+        raise ValueError(f"not a package URI: {uri!r}")
+    name = uri[len(_PKG_SCHEME):]
+    zip_path = os.path.join(_cache_dir(), name)
+    out_dir = os.path.join(_cache_dir(), name[:-len(".zip")])
+    if not os.path.isdir(out_dir):
+        tmp = out_dir + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        with zipfile.ZipFile(zip_path) as z:
+            z.extractall(tmp)
+        os.replace(tmp, out_dir)
+    return out_dir
+
+
+# -- plugins (parity: _private/runtime_env/plugin.py) ----------------------
+
+class RuntimeEnvPlugin:
+    """Extension point: a named field handled by user code."""
+
+    name: str = ""
+    priority: int = 10
+
+    def create(self, value: Any, ctx: "RuntimeEnvContext") -> None:
+        raise NotImplementedError
+
+
+_plugins: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin needs a name")
+    _plugins[plugin.name] = plugin
+    _KNOWN_FIELDS.add(plugin.name)
+
+
+# -- materialization -------------------------------------------------------
+
+# Serializes process-global mutation (os.environ, sys.path) across
+# concurrently executing tasks — see module docstring.
+_apply_lock = threading.RLock()
+
+
+class RuntimeEnvContext:
+    """Materialized env for one execution (parity:
+    _private/runtime_env/context.py RuntimeEnvContext)."""
+
+    def __init__(self, env: RuntimeEnv):
+        self.env = env
+        self.env_vars: Dict[str, str] = dict(env.get("env_vars", {}))
+        self.sys_paths: List[str] = []
+
+    def build(self) -> "RuntimeEnvContext":
+        wd = self.env.get("working_dir")
+        if wd:
+            uri = wd if wd.startswith(_PKG_SCHEME) else package_directory(wd)
+            local = ensure_local(uri)
+            self.sys_paths.append(local)
+            self.env_vars["RAYTPU_WORKING_DIR"] = local
+        for mod in self.env.get("py_modules", []):
+            uri = (mod if mod.startswith(_PKG_SCHEME)
+                   else package_directory(mod))
+            self.sys_paths.append(ensure_local(uri))
+        for name, plugin in sorted(_plugins.items(),
+                                   key=lambda kv: kv[1].priority):
+            if name in self.env:
+                plugin.create(self.env[name], self)
+        return self
+
+    @contextlib.contextmanager
+    def applied(self):
+        """Apply env vars + sys.path for the duration of one task."""
+        with _apply_lock:
+            saved_env = {k: os.environ.get(k) for k in self.env_vars}
+            os.environ.update(self.env_vars)
+            saved_path = list(sys.path)
+            for p in reversed(self.sys_paths):
+                sys.path.insert(0, p)
+            try:
+                yield self
+            finally:
+                for k, old in saved_env.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
+                sys.path[:] = saved_path
+
+
+def materialize(spec) -> Optional[RuntimeEnvContext]:
+    """spec: None | dict | RuntimeEnv → built context (or None)."""
+    if not spec:
+        return None
+    env = spec if isinstance(spec, RuntimeEnv) else RuntimeEnv.from_dict(spec)
+    return RuntimeEnvContext(env).build()

@@ -1,0 +1,1 @@
+"""CLI package (parity: python/ray/scripts/)."""

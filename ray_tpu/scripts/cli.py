@@ -1,0 +1,226 @@
+"""`python -m ray_tpu` command-line interface.
+
+Parity: the reference's click CLI (ray: python/ray/scripts/scripts.py —
+`ray start` :72+, `ray status`, `ray list/summary` via the state CLI
+(python/ray/util/state/state_cli.py), `ray timeline` :1848, `ray
+memory` :1913, `ray job ...` via dashboard/modules/job/cli.py).
+
+Remote commands talk to a running head's dashboard HTTP API
+(``--address``, default $RAYTPU_ADDRESS or http://127.0.0.1:8265),
+matching the reference where the CLI is a thin client of the
+dashboard/state endpoints.  ``start --head`` hosts a runtime +
+dashboard in the foreground.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+DEFAULT_ADDRESS = "http://127.0.0.1:8265"
+
+
+def _address(args) -> str:
+    return (args.address or os.environ.get("RAYTPU_ADDRESS")
+            or DEFAULT_ADDRESS).rstrip("/")
+
+
+def _get_json(address: str, path: str) -> Any:
+    with urllib.request.urlopen(address + path, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _print_table(rows: List[Dict[str, Any]], columns: List[str],
+                 out) -> None:
+    if not rows:
+        print("(empty)", file=out)
+        return
+    widths = [max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in columns]
+    line = "  ".join(c.ljust(w) for c, w in zip(columns, widths))
+    print(line, file=out)
+    print("-" * len(line), file=out)
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(w)
+                        for c, w in zip(columns, widths)), file=out)
+
+
+# -- commands --------------------------------------------------------------
+
+def cmd_status(args, out) -> int:
+    payload = _get_json(_address(args), "/api/cluster_status")
+    print("======== Cluster status ========", file=out)
+    print(f"Nodes: {len(payload['nodes'])}", file=out)
+    for name, total in sorted(payload["resources"].items()):
+        used = total - payload["available"].get(name, 0.0)
+        print(f"  {name}: {used:g}/{total:g} used", file=out)
+    _print_table(payload["nodes"], ["node_id", "state"], out)
+    return 0
+
+
+_LIST_ROUTES = {
+    "tasks": ("/api/v0/tasks", ["task_id", "name", "state", "type"]),
+    "actors": ("/api/v0/actors",
+               ["actor_id", "class_name", "state", "name"]),
+    "objects": ("/api/v0/objects",
+                ["object_id", "tier", "size_bytes", "sealed"]),
+    "nodes": ("/api/v0/nodes", ["node_id", "state"]),
+    "placement-groups": ("/api/v0/placement_groups",
+                         ["placement_group_id", "strategy", "state"]),
+}
+
+
+def cmd_list(args, out) -> int:
+    route, columns = _LIST_ROUTES[args.entity]
+    rows = _get_json(_address(args),
+                     f"{route}?limit={args.limit}")["result"]
+    _print_table(rows, columns, out)
+    return 0
+
+
+def cmd_summary(args, out) -> int:
+    payload = _get_json(_address(args), "/api/v0/tasks/summarize")["result"]
+    print(json.dumps(payload, indent=2), file=out)
+    return 0
+
+
+def cmd_timeline(args, out) -> int:
+    events = _get_json(_address(args), "/timeline")
+    with open(args.output, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {args.output} "
+          f"(open in chrome://tracing or Perfetto)", file=out)
+    return 0
+
+
+def cmd_memory(args, out) -> int:
+    rows = _get_json(_address(args),
+                     f"/api/v0/objects?limit={args.limit}")["result"]
+    total = sum(r["size_bytes"] for r in rows)
+    _print_table(rows, ["object_id", "tier", "size_bytes", "is_error"], out)
+    print(f"total: {len(rows)} objects, {total} bytes", file=out)
+    return 0
+
+
+def cmd_job(args, out) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(address=_address(args))
+    if args.job_cmd == "submit":
+        import shlex
+
+        words = [w for w in args.entrypoint if w != "--"]
+        sid = client.submit_job(
+            entrypoint=" ".join(shlex.quote(w) for w in words),
+            submission_id=args.submission_id or None,
+        )
+        print(f"submitted job: {sid}", file=out)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.id), file=out)
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.id), file=out, end="")
+    elif args.job_cmd == "stop":
+        stopped = client.stop_job(args.id)
+        print("stopped" if stopped else "not running", file=out)
+    elif args.job_cmd == "list":
+        import dataclasses
+
+        rows = [dataclasses.asdict(i) for i in client.list_jobs()]
+        _print_table(rows, ["submission_id", "status", "entrypoint"], out)
+    return 0
+
+
+def cmd_start(args, out) -> int:
+    import ray_tpu
+    from ray_tpu.dashboard import DashboardHead
+
+    ray_tpu.init(num_cpus=args.num_cpus, ignore_reinit_error=True)
+    dash = DashboardHead(port=args.dashboard_port).start()
+    print(f"ray_tpu head started; dashboard at {dash.address}", file=out)
+    if args.block:
+        import signal
+
+        try:
+            signal.pause()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            dash.stop()
+            ray_tpu.shutdown()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray_tpu",
+        description="ray_tpu cluster CLI (see `<cmd> -h`)",
+    )
+    p.add_argument("--address", default=None,
+                   help="dashboard address of the cluster "
+                        "(default: $RAYTPU_ADDRESS or "
+                        f"{DEFAULT_ADDRESS})")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status", help="cluster resources + nodes")
+
+    lp = sub.add_parser("list", help="list cluster entities")
+    lp.add_argument("entity", choices=sorted(_LIST_ROUTES))
+    lp.add_argument("--limit", type=int, default=100)
+
+    sub.add_parser("summary", help="task summary by function and state")
+
+    tp = sub.add_parser("timeline", help="dump Chrome trace of tasks")
+    tp.add_argument("--output", "-o", default="timeline.json")
+
+    mp = sub.add_parser("memory", help="object store contents")
+    mp.add_argument("--limit", type=int, default=1000)
+
+    jp = sub.add_parser("job", help="submit and manage jobs")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--submission-id", default=None)
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="command to run, after --")
+    for name in ("status", "logs", "stop"):
+        jx = jsub.add_parser(name)
+        jx.add_argument("id")
+    jsub.add_parser("list")
+
+    spp = sub.add_parser("start", help="start a head in this process")
+    spp.add_argument("--head", action="store_true", default=True)
+    spp.add_argument("--num-cpus", type=float, default=None)
+    spp.add_argument("--dashboard-port", type=int, default=8265)
+    spp.add_argument("--block", action="store_true", default=True)
+    spp.add_argument("--no-block", dest="block", action="store_false")
+    return p
+
+
+_DISPATCH = {
+    "status": cmd_status,
+    "list": cmd_list,
+    "summary": cmd_summary,
+    "timeline": cmd_timeline,
+    "memory": cmd_memory,
+    "job": cmd_job,
+    "start": cmd_start,
+}
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out or sys.stdout
+    args = build_parser().parse_args(argv)
+    try:
+        return _DISPATCH[args.cmd](args, out)
+    except urllib.error.URLError as e:
+        print(f"error: cannot reach cluster at {_address(args)} "
+              f"({e.reason if hasattr(e, 'reason') else e}) — is a head "
+              f"running? (`python -m ray_tpu start`)", file=out)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

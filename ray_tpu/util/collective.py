@@ -1,0 +1,266 @@
+"""Collective communication groups over actors.
+
+Parity: the reference's out-of-band collective layer
+(ray: python/ray/util/collective/collective.py —
+init_collective_group:120, create_collective_group:151, allreduce:258,
+broadcast:373, allgather:423, reducescatter:472, send/recv:531+;
+backends nccl_collective_group.py:127 / gloo_collective_group.py:184;
+rendezvous via a named store actor).
+
+TPU mapping (SURVEY.md §5.8): *device-plane* collectives are XLA
+collectives emitted by pjit/shard_map (ray_tpu.parallel) — they never
+go through this module.  This module is the *host-plane* equivalent of
+the reference's Gloo path: CPU tensors exchanged between actors for
+control/rendezvous/eval traffic, implemented over a named rendezvous
+actor (the reference uses a named store actor the same way,
+util/collective/util.py NCCLUniqueIDStore).
+
+Rank context: ``init_collective_group`` binds (group, rank) to the
+calling actor's execution thread; subsequent ops on that thread use it
+(the reference binds per worker process the same way).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# -- reduce ops (parity: types.ReduceOp) -----------------------------------
+
+SUM = "SUM"
+PRODUCT = "PRODUCT"
+MIN = "MIN"
+MAX = "MAX"
+
+_REDUCERS = {
+    SUM: lambda arrs: np.sum(arrs, axis=0),
+    PRODUCT: lambda arrs: np.prod(arrs, axis=0),
+    MIN: lambda arrs: np.min(arrs, axis=0),
+    MAX: lambda arrs: np.max(arrs, axis=0),
+}
+
+
+class _RendezvousStore:
+    """Named actor coordinating one group's rounds (parity: the named
+    store actor in util/collective/util.py).  Each collective round is
+    keyed; ranks park until the round is full."""
+
+    def __init__(self, world_size: int):
+        self._world = world_size
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._rounds: Dict[str, Dict[int, Any]] = {}
+        self._consumed: Dict[str, int] = {}
+
+    def exchange(self, key: str, rank: int, value, timeout: float = 60.0):
+        """Deposit this rank's value; returns {rank: value} once all
+        world_size ranks have arrived."""
+        with self._cv:
+            rnd = self._rounds.setdefault(key, {})
+            if rank in rnd:
+                raise RuntimeError(
+                    f"rank {rank} already contributed to round {key!r}"
+                )
+            rnd[rank] = value
+            self._cv.notify_all()
+            ok = self._cv.wait_for(
+                lambda: len(self._rounds.get(key, rnd)) >= self._world,
+                timeout=timeout,
+            )
+            if not ok:
+                raise TimeoutError(
+                    f"collective round {key!r}: only "
+                    f"{len(rnd)}/{self._world} ranks arrived in {timeout}s"
+                )
+            # Read from the captured round dict: the world-th reader
+            # deletes the registry entry, and a descheduled straggler
+            # must still see the full round.
+            out = dict(rnd)
+            if key in self._rounds:
+                self._consumed[key] = self._consumed.get(key, 0) + 1
+                if self._consumed[key] >= self._world:
+                    del self._rounds[key]
+                    del self._consumed[key]
+            return out
+
+    def put_p2p(self, key: str, value) -> None:
+        with self._cv:
+            self._rounds.setdefault(key, {})[0] = value
+            self._cv.notify_all()
+
+    def take_p2p(self, key: str, timeout: float = 60.0):
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: key in self._rounds and 0 in self._rounds[key],
+                timeout=timeout,
+            )
+            if not ok:
+                raise TimeoutError(f"recv timed out on {key!r}")
+            value = self._rounds.pop(key)[0]
+            return value
+
+
+_STORE_PREFIX = "_collective_store:"
+
+# (group_name, rank) bound per execution thread (see module docstring).
+_ctx = threading.local()
+
+
+class GroupContext:
+    def __init__(self, group_name: str, world_size: int, rank: int,
+                 store_handle):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self.store = store_handle
+        self._seq = 0
+
+    def next_key(self, op: str) -> str:
+        self._seq += 1
+        return f"{op}:{self._seq}"
+
+
+def _groups() -> Dict[str, GroupContext]:
+    if not hasattr(_ctx, "groups"):
+        _ctx.groups = {}
+    return _ctx.groups
+
+
+def _store_actor(group_name: str, world_size: int):
+    import ray_tpu
+
+    name = _STORE_PREFIX + group_name
+    try:
+        return ray_tpu.get_actor(name)
+    except ValueError:
+        # Headroom beyond world_size: every rank may park in exchange()
+        # while p2p calls still need a free serving thread.
+        cls = ray_tpu.remote(num_cpus=0,
+                             max_concurrency=2 * world_size + 2)(
+            _RendezvousStore
+        )
+        try:
+            return cls.options(name=name).remote(world_size)
+        except ValueError:  # raced with another rank creating it
+            return ray_tpu.get_actor(name)
+
+
+def init_collective_group(world_size: int, rank: int, *,
+                          backend: str = "host",
+                          group_name: str = "default") -> None:
+    """Join a collective group from inside an actor/task (parity:
+    collective.init_collective_group:120)."""
+    if backend not in ("host", "gloo"):
+        raise ValueError(
+            f"backend {backend!r} unsupported: device-plane collectives "
+            f"on TPU are XLA collectives via ray_tpu.parallel, not this "
+            f"module (see SURVEY.md §5.8)"
+        )
+    if not (0 <= rank < world_size):
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    handle = _store_actor(group_name, world_size)
+    _groups()[group_name] = GroupContext(group_name, world_size, rank, handle)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _groups().pop(group_name, None)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def _group(group_name: str) -> GroupContext:
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized on this "
+            f"worker — call init_collective_group first"
+        )
+    return g
+
+
+def _exchange(g: GroupContext, op: str, value) -> Dict[int, Any]:
+    import ray_tpu
+
+    key = g.next_key(op)
+    return ray_tpu.get(
+        g.store.exchange.remote(key, g.rank, value), timeout=120
+    )
+
+
+def allreduce(tensor, group_name: str = "default", op: str = SUM):
+    """All ranks contribute; all receive the reduction (parity:
+    collective.allreduce:258)."""
+    g = _group(group_name)
+    got = _exchange(g, f"allreduce_{op}", np.asarray(tensor))
+    return _REDUCERS[op]([got[r] for r in sorted(got)])
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _group(group_name)
+    got = _exchange(g, f"bcast_{src_rank}",
+                    np.asarray(tensor) if g.rank == src_rank else None)
+    return got[src_rank]
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    g = _group(group_name)
+    got = _exchange(g, "allgather", np.asarray(tensor))
+    return [got[r] for r in sorted(got)]
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = SUM):
+    """Reduce across ranks, then each rank keeps its 1/world shard along
+    axis 0 (parity: collective.reducescatter:472)."""
+    g = _group(group_name)
+    got = _exchange(g, f"rs_{op}", np.asarray(tensor))
+    reduced = _REDUCERS[op]([got[r] for r in sorted(got)])
+    shards = np.array_split(reduced, g.world_size, axis=0)
+    return shards[g.rank]
+
+
+def barrier(group_name: str = "default") -> None:
+    g = _group(group_name)
+    _exchange(g, "barrier", None)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0) -> None:
+    import ray_tpu
+
+    g = _group(group_name)
+    key = f"p2p:{g.rank}->{dst_rank}:{tag}"
+    ray_tpu.get(g.store.put_p2p.remote(key, np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    import ray_tpu
+
+    g = _group(group_name)
+    key = f"p2p:{src_rank}->{g.rank}:{tag}"
+    return ray_tpu.get(g.store.take_p2p.remote(key), timeout=120)
+
+
+def create_collective_group(actors, world_size: int, ranks: List[int], *,
+                            backend: str = "host",
+                            group_name: str = "default") -> None:
+    """Declarative group creation from the driver (parity:
+    collective.create_collective_group:151): calls
+    init_collective_group inside each actor.  Actors must expose the
+    conventional ``init_collective(world, rank, backend, name)`` hook
+    or be driven by user code calling init inside a method."""
+    import ray_tpu
+
+    refs = []
+    for actor, rank in zip(actors, ranks):
+        refs.append(actor.init_collective.remote(
+            world_size, rank, backend, group_name
+        ))
+    ray_tpu.get(refs, timeout=120)

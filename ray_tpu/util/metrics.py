@@ -45,7 +45,10 @@ class Metric:
 
     def __init__(self, name: str, description: str = "",
                  tag_keys: Optional[Sequence[str]] = None):
-        if not name or not name.replace("_", "a").isalnum():
+        import re
+
+        # Prometheus metric-name grammar: [a-zA-Z_:][a-zA-Z0-9_:]*
+        if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name or ""):
             raise ValueError(f"invalid metric name {name!r}")
         self.name = name
         self.description = description
@@ -243,10 +246,17 @@ def _internal_samples() -> List[Tuple[str, str, str, _TagTuple, float]]:
     return out
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus text-format label escaping: \\ → \\\\, \" → \\\",
+    newline → \\n (exposition format 0.0.4)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_tags(tags: _TagTuple) -> str:
     if not tags:
         return ""
-    body = ",".join(f'{k}="{v}"' for k, v in tags)
+    body = ",".join(f'{k}="{_escape_label(v)}"' for k, v in tags)
     return "{" + body + "}"
 
 

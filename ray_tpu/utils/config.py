@@ -100,6 +100,8 @@ D("object_inline_max_bytes", int, 100 * 1024,
 D("object_spill_threshold", float, 0.8,
   "Store fullness fraction that triggers spilling to disk.")
 D("object_spill_dir", str, "", "Directory for spilled objects ('' = <session>/spill).")
+D("object_store_inproc_cap_bytes", int, 512 * 1024**2,
+  "In-process tier size that triggers spilling of cold sealed objects.")
 
 # --- Scheduler ------------------------------------------------------------
 D("scheduler_spread_threshold", float, 0.5,

@@ -1,0 +1,140 @@
+"""Durable workflows: exactly-once DAG execution with resume.
+
+Parity: the reference's workflow library (ray: python/ray/workflow —
+api.py run/run_async/resume/get_status/list_all/delete,
+workflow_executor.py, workflow_storage.py).  Build a DAG with
+``fn.bind(...)`` and run it durably:
+
+    @ray_tpu.remote
+    def add(a, b): return a + b
+
+    result = workflow.run(add.bind(1, 2), workflow_id="w1")
+
+Every task result is checkpointed; ``workflow.resume("w1")`` after a
+crash replays only unfinished tasks.  A task may return another DAG
+node as a continuation (parity: workflow.continuation).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, List, Optional, Tuple
+
+from ray_tpu.util.dag import DAGNode
+from ray_tpu.workflow.executor import WorkflowExecutor
+from ray_tpu.workflow.storage import WorkflowStatus, WorkflowStorage
+
+_storage: Optional[WorkflowStorage] = None
+_storage_lock = threading.Lock()
+
+
+def init(storage_dir: Optional[str] = None) -> None:
+    """Set the durable storage location (parity: workflow.init /
+    ``storage=`` URL in ray.init)."""
+    global _storage
+    with _storage_lock:
+        if storage_dir is None:
+            import os
+            import tempfile
+
+            storage_dir = os.path.join(tempfile.gettempdir(),
+                                       "raytpu-workflows")
+        _storage = WorkflowStorage(storage_dir)
+
+
+def _get_storage() -> WorkflowStorage:
+    with _storage_lock:
+        if _storage is None:
+            raise RuntimeError(
+                "workflow storage not initialized — call "
+                "workflow.init(storage_dir) first"
+            )
+        return _storage
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        dag_input: Any = None) -> Any:
+    """Execute a DAG durably; blocks and returns the final result."""
+    storage = _get_storage()
+    workflow_id = workflow_id or f"workflow_{uuid.uuid4().hex[:12]}"
+    storage.save_dag(workflow_id, dag)
+    return WorkflowExecutor(storage, workflow_id).execute(
+        dag, dag_input
+    )
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              dag_input: Any = None):
+    """Like run() but returns an ObjectRef to the final result
+    (parity: workflow.run_async)."""
+    import ray_tpu
+
+    storage = _get_storage()
+    workflow_id = workflow_id or f"workflow_{uuid.uuid4().hex[:12]}"
+    storage.save_dag(workflow_id, dag)
+
+    @ray_tpu.remote(num_cpus=0)
+    def _workflow_driver():
+        return WorkflowExecutor(storage, workflow_id).execute(
+            dag, dag_input
+        )
+
+    return _workflow_driver.remote()
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a stored workflow; checkpointed tasks are skipped
+    (parity: workflow.resume)."""
+    storage = _get_storage()
+    dag = storage.load_dag(workflow_id)
+    return WorkflowExecutor(storage, workflow_id).execute(dag, None)
+
+
+def resume_all() -> List[Tuple[str, Any]]:
+    """Resume every non-successful workflow (parity:
+    workflow.resume_all)."""
+    out = []
+    for wid, status in _get_storage().list_workflows():
+        if status != WorkflowStatus.SUCCESSFUL:
+            out.append((wid, resume(wid)))
+    return out
+
+
+def get_status(workflow_id: str) -> str:
+    return _get_storage().load_status(workflow_id)[0]
+
+
+def get_output(workflow_id: str) -> Any:
+    """Result of a finished workflow without re-running anything: the
+    root task's checkpoint (parity: workflow.get_output)."""
+    storage = _get_storage()
+    status, error = storage.load_status(workflow_id)
+    if status != WorkflowStatus.SUCCESSFUL:
+        raise RuntimeError(
+            f"workflow {workflow_id!r} is {status}: {error or ''}"
+        )
+    return resume(workflow_id)  # pure checkpoint replay, no task runs
+
+
+def list_all() -> List[Tuple[str, str]]:
+    return _get_storage().list_workflows()
+
+
+def delete(workflow_id: str) -> None:
+    _get_storage().delete_workflow(workflow_id)
+
+
+__all__ = [
+    "WorkflowStatus",
+    "WorkflowStorage",
+    "delete",
+    "get_output",
+    "get_status",
+    "init",
+    "list_all",
+    "resume",
+    "resume_all",
+    "run",
+    "run_async",
+]

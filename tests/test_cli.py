@@ -1,0 +1,92 @@
+"""CLI (parity: ray scripts.py commands over the dashboard API)."""
+
+import io
+import json
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard
+from ray_tpu.scripts.cli import main
+
+
+@pytest.fixture
+def cluster_address():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    dash = start_dashboard()
+    yield dash.address
+    dash.stop()
+    ray_tpu.shutdown()
+
+
+def _run(argv):
+    out = io.StringIO()
+    code = main(argv, out=out)
+    return code, out.getvalue()
+
+
+def test_status(cluster_address):
+    code, text = _run(["--address", cluster_address, "status"])
+    assert code == 0
+    assert "Nodes: 1" in text
+    assert "CPU" in text
+
+
+def test_list_and_summary(cluster_address):
+    @ray_tpu.remote
+    def work():
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(2)])
+    code, text = _run(["--address", cluster_address, "list", "tasks"])
+    assert code == 0
+    assert text.count("work") == 2
+    code, text = _run(["--address", cluster_address, "summary"])
+    assert json.loads(text)["work"]["FINISHED"] == 2
+    code, text = _run(["--address", cluster_address, "list", "nodes"])
+    assert "ALIVE" in text
+
+
+def test_timeline_and_memory(cluster_address, tmp_path):
+    @ray_tpu.remote
+    def t():
+        return ray_tpu.put("x")
+
+    ray_tpu.get(t.remote())
+    out_file = tmp_path / "tl.json"
+    code, text = _run(["--address", cluster_address, "timeline",
+                       "-o", str(out_file)])
+    assert code == 0
+    assert json.loads(out_file.read_text())
+    code, text = _run(["--address", cluster_address, "memory"])
+    assert code == 0
+    assert "total:" in text
+
+
+def test_job_cli_roundtrip(cluster_address):
+    code, text = _run([
+        "--address", cluster_address, "job", "submit",
+        sys.executable, "-c", "print(42*271)",
+    ])
+    assert code == 0
+    sid = text.strip().split()[-1]
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        code, text = _run(["--address", cluster_address, "job",
+                           "status", sid])
+        if text.strip() in ("SUCCEEDED", "FAILED", "STOPPED"):
+            break
+        time.sleep(0.2)
+    assert text.strip() == "SUCCEEDED"
+    code, text = _run(["--address", cluster_address, "job", "logs", sid])
+    assert "11382" in text
+    code, text = _run(["--address", cluster_address, "job", "list"])
+    assert sid in text
+
+
+def test_unreachable_cluster():
+    code, text = _run(["--address", "http://127.0.0.1:9", "status"])
+    assert code == 1
+    assert "cannot reach" in text

@@ -1,0 +1,115 @@
+"""Host-plane collective groups (parity: util/collective/collective.py
+over actor groups; device-plane collectives live in ray_tpu.parallel)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(num_cpus=0)
+class Worker:
+    def init_collective(self, world, rank, backend, name):
+        col.init_collective_group(world, rank, backend=backend,
+                                  group_name=name)
+        return rank
+
+    def do_allreduce(self, value, op=col.SUM):
+        return col.allreduce(np.full(4, value, dtype=np.float32), op=op)
+
+    def do_broadcast(self, value):
+        return col.broadcast(np.array([value]), src_rank=0)
+
+    def do_allgather(self, value):
+        return col.allgather(np.array([value]))
+
+    def do_reducescatter(self, row):
+        return col.reducescatter(np.asarray(row, dtype=np.float32))
+
+    def do_send(self, value, dst):
+        col.send(np.array([value]), dst)
+        return "sent"
+
+    def do_recv(self, src):
+        return col.recv(src)
+
+    def rank_info(self):
+        return col.get_rank(), col.get_collective_group_size()
+
+
+def _make_group(n, name="default"):
+    workers = [Worker.remote() for _ in range(n)]
+    col.create_collective_group(workers, n, list(range(n)),
+                                group_name=name)
+    return workers
+
+
+def test_allreduce_sum_and_max(rt):
+    workers = _make_group(4)
+    out = ray_tpu.get([w.do_allreduce.remote(r + 1.0)
+                       for r, w in enumerate(workers)])
+    for arr in out:
+        np.testing.assert_allclose(arr, np.full(4, 10.0))  # 1+2+3+4
+    out = ray_tpu.get([w.do_allreduce.remote(float(r), col.MAX)
+                       for r, w in enumerate(workers)])
+    for arr in out:
+        np.testing.assert_allclose(arr, np.full(4, 3.0))
+
+
+def test_broadcast(rt):
+    workers = _make_group(3)
+    out = ray_tpu.get([w.do_broadcast.remote(100 + r)
+                       for r, w in enumerate(workers)])
+    for arr in out:
+        assert arr[0] == 100  # rank 0's value everywhere
+
+
+def test_allgather_ordered(rt):
+    workers = _make_group(3)
+    out = ray_tpu.get([w.do_allgather.remote(10 * r)
+                       for r, w in enumerate(workers)])
+    for gathered in out:
+        assert [int(a[0]) for a in gathered] == [0, 10, 20]
+
+
+def test_reducescatter(rt):
+    workers = _make_group(2)
+    rows = [[1.0, 2.0, 3.0, 4.0], [10.0, 20.0, 30.0, 40.0]]
+    out = ray_tpu.get([w.do_reducescatter.remote(rows[r])
+                       for r, w in enumerate(workers)])
+    np.testing.assert_allclose(out[0], [11.0, 22.0])  # rank 0 shard
+    np.testing.assert_allclose(out[1], [33.0, 44.0])  # rank 1 shard
+
+
+def test_send_recv(rt):
+    workers = _make_group(2)
+    recv_ref = workers[1].do_recv.remote(0)
+    assert ray_tpu.get(workers[0].do_send.remote(7, 1)) == "sent"
+    assert ray_tpu.get(recv_ref)[0] == 7
+
+
+def test_uninitialized_group_raises(rt):
+    workers = _make_group(2, name="g2")
+    # rank_info reads group "default", but these workers joined "g2".
+    with pytest.raises(Exception, match="not initialized"):
+        ray_tpu.get(workers[0].rank_info.remote())
+
+
+def test_rank_context(rt):
+    workers = [Worker.remote() for _ in range(2)]
+    col.create_collective_group(workers, 2, [0, 1], group_name="default")
+    infos = ray_tpu.get([w.rank_info.remote() for w in workers])
+    assert sorted(infos) == [(0, 2), (1, 2)]
+
+    with pytest.raises(ValueError):
+        col.init_collective_group(2, 5)
+    with pytest.raises(ValueError):
+        col.init_collective_group(2, 0, backend="nccl")

@@ -1,0 +1,121 @@
+"""Job submission (parity: dashboard/modules/job — JobSubmissionClient,
+JobManager, JobSupervisor actor, REST routes)."""
+
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.kv import (
+    internal_kv_del,
+    internal_kv_get,
+    internal_kv_list,
+    internal_kv_put,
+)
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture
+def client():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield JobSubmissionClient()
+    ray_tpu.shutdown()
+
+
+def test_internal_kv(client):
+    assert internal_kv_put("k", b"v1")
+    assert internal_kv_get("k") == b"v1"
+    assert not internal_kv_put("k", b"v2", overwrite=False)
+    assert internal_kv_get("k") == b"v1"
+    internal_kv_put("pre:a", b"1", namespace="ns")
+    internal_kv_put("pre:b", b"2", namespace="ns")
+    assert internal_kv_list("pre:", namespace="ns") == [b"pre:a", b"pre:b"]
+    assert internal_kv_get("pre:a") is None  # namespace isolation
+    assert internal_kv_del("k")
+    assert internal_kv_get("k") is None
+
+
+def test_job_success_and_logs(client):
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"",
+        metadata={"owner": "test"},
+    )
+    from ray_tpu.job_submission import job_manager
+
+    info = job_manager().wait_until_finished(sid, timeout=30)
+    assert info.status == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(sid)
+    assert info.metadata == {"owner": "test"}
+    assert info.start_time is not None and info.end_time is not None
+
+
+def test_job_failure(client):
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'"
+    )
+    from ray_tpu.job_submission import job_manager
+
+    info = job_manager().wait_until_finished(sid, timeout=30)
+    assert info.status == JobStatus.FAILED
+    assert "code 3" in info.message
+
+
+def test_job_stop(client):
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'"
+    )
+    deadline = time.time() + 10
+    while (client.get_job_status(sid) != JobStatus.RUNNING
+           and time.time() < deadline):
+        time.sleep(0.05)
+    assert client.stop_job(sid)
+    from ray_tpu.job_submission import job_manager
+
+    info = job_manager().wait_until_finished(sid, timeout=30)
+    assert info.status == JobStatus.STOPPED
+
+
+def test_job_env_vars_and_list(client):
+    sid = client.submit_job(
+        entrypoint=(f"{sys.executable} -c "
+                    "\"import os; print(os.environ['GREETING'])\""),
+        runtime_env={"env_vars": {"GREETING": "bonjour"}},
+    )
+    from ray_tpu.job_submission import job_manager
+
+    assert job_manager().wait_until_finished(sid, timeout=30).status \
+        == JobStatus.SUCCEEDED
+    assert "bonjour" in client.get_job_logs(sid)
+    assert sid in [j.submission_id for j in client.list_jobs()]
+
+
+def test_job_http_transport(client):
+    from ray_tpu.dashboard import start_dashboard
+
+    dash = start_dashboard()
+    try:
+        http_client = JobSubmissionClient(address=dash.address)
+        sid = http_client.submit_job(
+            entrypoint=f"{sys.executable} -c \"print('over http')\""
+        )
+        deadline = time.time() + 30
+        while (http_client.get_job_status(sid) not in JobStatus.TERMINAL
+               and time.time() < deadline):
+            time.sleep(0.1)
+        assert http_client.get_job_status(sid) == JobStatus.SUCCEEDED
+        assert "over http" in http_client.get_job_logs(sid)
+        assert sid in [j.submission_id for j in http_client.list_jobs()]
+    finally:
+        dash.stop()
+
+
+def test_tail_job_logs(client):
+    sid = client.submit_job(
+        entrypoint=(f"{sys.executable} -u -c "
+                    "\"import time\n"
+                    "for i in range(3): print('line', i); time.sleep(0.2)\"")
+    )
+    chunks = list(client.tail_job_logs(sid))
+    text = "".join(chunks)
+    assert "line 0" in text and "line 2" in text

@@ -1,0 +1,127 @@
+"""Lineage reconstruction of lost objects (parity:
+core_worker/object_recovery_manager.h RecoverObject/ReconstructObject +
+TaskManager::ResubmitTask; test model: python/ray/tests/
+test_reconstruction*.py over cluster_utils.Cluster)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster import Cluster
+from ray_tpu.core.exceptions import ObjectLostError
+from ray_tpu.util import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def _run_on(cluster, node_id, fn_remote, *args):
+    return fn_remote.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id)
+    ).remote(*args)
+
+
+def test_retriable_task_output_reconstructed(cluster):
+    node = cluster.add_node(num_cpus=2)
+    runs = []
+
+    @ray_tpu.remote(max_retries=2)
+    def produce():
+        runs.append(1)
+        return np.arange(1000)
+
+    ref = _run_on(cluster, node, produce)
+    np.testing.assert_array_equal(ray_tpu.get(ref), np.arange(1000))
+    assert len(runs) == 1
+
+    cluster.kill_node(node)
+    # The object is rebuilt by re-executing the task on a live node.
+    np.testing.assert_array_equal(
+        ray_tpu.get(ref, timeout=10), np.arange(1000)
+    )
+    assert len(runs) == 2
+
+
+def test_non_retriable_output_lost(cluster):
+    node = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(max_retries=0)
+    def produce():
+        return "value"
+
+    ref = _run_on(cluster, node, produce)
+    assert ray_tpu.get(ref) == "value"
+    cluster.kill_node(node)
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(ref, timeout=5)
+
+
+def test_put_objects_survive_node_death(cluster):
+    node = cluster.add_node(num_cpus=2)
+    ref = ray_tpu.put({"driver": "owned"})
+    cluster.kill_node(node)
+    assert ray_tpu.get(ref) == {"driver": "owned"}
+
+
+def test_chained_reconstruction(cluster):
+    node = cluster.add_node(num_cpus=4)
+    runs = {"f": 0, "g": 0}
+
+    @ray_tpu.remote(max_retries=1)
+    def f():
+        runs["f"] += 1
+        return 10
+
+    @ray_tpu.remote(max_retries=1)
+    def g(x):
+        runs["g"] += 1
+        return x + 1
+
+    f_ref = _run_on(cluster, node, f)
+    g_ref = _run_on(cluster, node, g, f_ref)
+    assert ray_tpu.get(g_ref) == 11
+    cluster.kill_node(node)
+    # Both outputs lived on the dead node; both chains re-execute.
+    assert ray_tpu.get(g_ref, timeout=10) == 11
+    assert ray_tpu.get(f_ref, timeout=10) == 10
+    assert runs["f"] == 2 and runs["g"] == 2
+
+
+def test_multi_return_reconstruction(cluster):
+    node = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_returns=2, max_retries=1)
+    def pair():
+        return "a", "b"
+
+    r1, r2 = _run_on(cluster, node, pair)
+    assert ray_tpu.get([r1, r2]) == ["a", "b"]
+    cluster.kill_node(node)
+    assert ray_tpu.get([r1, r2], timeout=10) == ["a", "b"]
+
+
+def test_reconstruction_waits_for_capacity(cluster):
+    """Lost object whose rebuild needs capacity: stays pending until a
+    node with room appears (parity: reconstruction tasks queue like any
+    task)."""
+    node = cluster.add_node(num_cpus=2, resources={"special": 1})
+
+    @ray_tpu.remote(max_retries=1, resources={"special": 1})
+    def produce():
+        return 7
+
+    ref = produce.remote()
+    assert ray_tpu.get(ref) == 7
+    cluster.kill_node(node)
+    time.sleep(0.2)
+    # No "special" node yet — get times out while the rebuild is queued.
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=0.3)
+    cluster.add_node(num_cpus=2, resources={"special": 1})
+    assert ray_tpu.get(ref, timeout=10) == 7

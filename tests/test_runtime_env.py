@@ -1,0 +1,122 @@
+"""Runtime environments (parity: python/ray/runtime_env +
+_private/runtime_env — env_vars, working_dir/py_modules packaging with
+URI cache, plugins)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import runtime_env as renv
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_validation():
+    env = renv.RuntimeEnv(env_vars={"A": "1"}, config={"setup_timeout_seconds": 10})
+    assert env["env_vars"] == {"A": "1"}
+    with pytest.raises(ValueError):
+        renv.RuntimeEnv(bogus_field=1)
+    with pytest.raises(TypeError):
+        renv.RuntimeEnv(env_vars={"A": 1})
+    with pytest.raises(NotImplementedError):
+        renv.RuntimeEnv(pip=["requests"])
+
+
+def test_task_env_vars(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "on"}})
+    def read_flag():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_flag.remote()) == "on"
+    # The variable does not leak outside the task.
+    assert "MY_FLAG" not in os.environ
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_plain.remote()) is None
+
+
+def test_actor_env_vars(rt):
+    @ray_tpu.remote
+    class EnvReader:
+        def __init__(self):
+            self.at_init = os.environ.get("ACTOR_VAR")
+
+        def read(self):
+            return self.at_init, os.environ.get("ACTOR_VAR")
+
+    a = EnvReader.options(
+        runtime_env={"env_vars": {"ACTOR_VAR": "yes"}}
+    ).remote()
+    assert ray_tpu.get(a.read.remote()) == ("yes", "yes")
+
+
+def test_working_dir_packaging_and_cache(rt, tmp_path):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "my_wd_module.py").write_text("MAGIC = 12345\n")
+    (proj / "data.txt").write_text("payload")
+
+    uri1 = renv.package_directory(str(proj))
+    uri2 = renv.package_directory(str(proj))
+    assert uri1 == uri2  # content-addressed: same dir → same URI
+    (proj / "data.txt").write_text("payload2")
+    assert renv.package_directory(str(proj)) != uri1  # content changed
+
+    local = renv.ensure_local(uri1)
+    assert (open(os.path.join(local, "data.txt")).read()) == "payload"
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def use_module():
+        import my_wd_module
+
+        return (my_wd_module.MAGIC,
+                os.path.basename(os.environ["RAYTPU_WORKING_DIR"]))
+
+    magic, _wd = ray_tpu.get(use_module.remote())
+    assert magic == 12345
+
+
+def test_py_modules(rt, tmp_path):
+    mod_dir = tmp_path / "libs"
+    mod_dir.mkdir()
+    (mod_dir / "extra_mod.py").write_text("def f():\n    return 'extra'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use():
+        import extra_mod
+
+        return extra_mod.f()
+
+    assert ray_tpu.get(use.remote()) == "extra"
+
+
+def test_plugin(rt):
+    applied = {}
+
+    class MyPlugin(renv.RuntimeEnvPlugin):
+        name = "my_plugin"
+
+        def create(self, value, ctx):
+            applied["value"] = value
+            ctx.env_vars["FROM_PLUGIN"] = str(value)
+
+    renv.register_plugin(MyPlugin())
+    try:
+        @ray_tpu.remote(runtime_env={"my_plugin": 7})
+        def read():
+            return os.environ.get("FROM_PLUGIN")
+
+        assert ray_tpu.get(read.remote()) == "7"
+        assert applied["value"] == 7
+    finally:
+        renv._plugins.pop("my_plugin", None)
+        renv._KNOWN_FIELDS.discard("my_plugin")

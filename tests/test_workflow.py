@@ -1,0 +1,142 @@
+"""Durable workflows (parity: python/ray/workflow — run/resume/
+continuation/exactly-once checkpointing).
+
+Execution counts are tracked on disk (not module globals): resume()
+deserializes the stored DAG, so function state behaves like a fresh
+process — exactly the crash-recovery situation workflows model.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.workflow import WorkflowStatus
+
+COUNTS_DIR = None  # set by fixture; visible to cloudpickled functions
+
+
+def _count(name: str) -> int:
+    """Increment and return a persistent per-name execution counter."""
+    path = os.path.join(os.environ["WF_COUNTS_DIR"], name)
+    n = 1
+    if os.path.exists(path):
+        with open(path) as f:
+            n = int(f.read()) + 1
+    with open(path, "w") as f:
+        f.write(str(n))
+    return n
+
+
+def _get_count(name: str) -> int:
+    path = os.path.join(os.environ["WF_COUNTS_DIR"], name)
+    if not os.path.exists(path):
+        return 0
+    with open(path) as f:
+        return int(f.read())
+
+
+@pytest.fixture
+def wf(tmp_path):
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    workflow.init(str(tmp_path / "storage"))
+    counts = tmp_path / "counts"
+    counts.mkdir()
+    os.environ["WF_COUNTS_DIR"] = str(counts)
+    yield workflow
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def bump(name, value):
+    _count(name)
+    return value
+
+
+@ray_tpu.remote
+def add(a, b):
+    _count("add")
+    return a + b
+
+
+def test_run_basic_dag(wf):
+    dag = add.bind(bump.bind("x", 1), bump.bind("y", 2))
+    assert workflow.run(dag, workflow_id="w1") == 3
+    assert workflow.get_status("w1") == WorkflowStatus.SUCCESSFUL
+    assert (_get_count("x"), _get_count("y"), _get_count("add")) == (1, 1, 1)
+
+
+def test_resume_skips_checkpointed_tasks(wf):
+    @ray_tpu.remote
+    def flaky(x):
+        if _count("flaky") == 1:
+            raise RuntimeError("first run dies")
+        return x * 2
+
+    dag = flaky.bind(bump.bind("a", 21))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == WorkflowStatus.FAILED
+    assert _get_count("a") == 1
+
+    # Resume: 'a' is checkpointed and NOT re-run; flaky retries and wins.
+    assert workflow.resume("w2") == 42
+    assert _get_count("a") == 1
+    assert _get_count("flaky") == 2
+    assert workflow.get_status("w2") == WorkflowStatus.SUCCESSFUL
+
+
+def test_get_output_replays_checkpoints_only(wf):
+    dag = add.bind(1, bump.bind("z", 10))
+    assert workflow.run(dag, workflow_id="w3") == 11
+    before = (_get_count("z"), _get_count("add"))
+    assert workflow.get_output("w3") == 11
+    assert (_get_count("z"), _get_count("add")) == before  # pure replay
+
+    with pytest.raises((RuntimeError, ValueError)):
+        workflow.get_output("never-ran")
+
+
+def test_continuation(wf):
+    @ray_tpu.remote
+    def fib(n):
+        if n <= 1:
+            return n
+        return add.bind(fib.bind(n - 1), fib.bind(n - 2))
+
+    assert workflow.run(fib.bind(6), workflow_id="wfib") == 8
+
+
+def test_run_async_and_list(wf):
+    dag = add.bind(bump.bind("p", 5), 6)
+    ref = workflow.run_async(dag, workflow_id="w4")
+    assert ray_tpu.get(ref) == 11
+    rows = dict(workflow.list_all())
+    assert rows["w4"] == WorkflowStatus.SUCCESSFUL
+
+    workflow.delete("w4")
+    assert "w4" not in dict(workflow.list_all())
+
+
+def test_resume_all(wf):
+    @ray_tpu.remote
+    def once_broken(x):
+        if _count("ob") == 1:
+            raise ValueError("boom")
+        return x
+
+    with pytest.raises(Exception):
+        workflow.run(once_broken.bind(9), workflow_id="w5")
+    workflow.run(add.bind(1, 1), workflow_id="w6")
+    add_runs = _get_count("add")
+    resumed = dict(workflow.resume_all())
+    assert resumed == {"w5": 9}  # successful w6 untouched
+    assert _get_count("add") == add_runs
+
+
+def test_diamond_executes_once(wf):
+    shared = bump.bind("shared", 2)
+    dag = add.bind(add.bind(shared, shared), shared)
+    assert workflow.run(dag, workflow_id="w7") == 6
+    assert _get_count("shared") == 1
