@@ -339,22 +339,24 @@ class _ActorShell:
                 resolved_args, resolved_kwargs = self.runtime.resolve_args(
                     args, kwargs
                 )
-                method = getattr(self.instance, method_name)
-                ctx = getattr(self, "_env_ctx", None)
-                if ctx is not None:
-                    with ctx.applied():
-                        result = method(*resolved_args, **resolved_kwargs)
-                else:
-                    result = method(*resolved_args, **resolved_kwargs)
+                import contextlib
                 import inspect
 
-                if inspect.iscoroutine(result):
-                    import asyncio
+                method = getattr(self.instance, method_name)
+                ctx = getattr(self, "_env_ctx", None)
+                # Env covers the whole body, including a streaming
+                # method's lazy generator execution.
+                with (ctx.applied() if ctx is not None
+                      else contextlib.nullcontext()):
+                    result = method(*resolved_args, **resolved_kwargs)
+                    if inspect.iscoroutine(result):
+                        import asyncio
 
-                    result = asyncio.run(result)
-                if num_returns == "streaming":
-                    self.runtime._stream_results(result, task_id, qname)
-                else:
+                        result = asyncio.run(result)
+                    if num_returns == "streaming":
+                        self.runtime._stream_results(result, task_id,
+                                                     qname)
+                if num_returns != "streaming":
                     self.runtime._store_results(result, return_ids,
                                                 num_returns)
                 if task_hex:
@@ -913,18 +915,23 @@ class LocalRuntime:
                 required_resources=pt.options.resource_demand(),
             )
             try:
+                import contextlib
+
                 args, kwargs = self.resolve_args(pt.args, pt.kwargs)
                 if pt.options.runtime_env:
                     from ray_tpu.runtime_env import materialize
 
-                    with materialize(pt.options.runtime_env).applied():
-                        result = pt.fn(*args, **kwargs)
+                    env_cm = materialize(pt.options.runtime_env).applied()
                 else:
+                    env_cm = contextlib.nullcontext()
+                # The env must cover the whole body — for a streaming
+                # task the generator body runs inside _stream_results.
+                with env_cm:
                     result = pt.fn(*args, **kwargs)
-                if pt.streaming:
-                    self._stream_results(result, pt.task_id,
-                                         pt.function_name)
-                else:
+                    if pt.streaming:
+                        self._stream_results(result, pt.task_id,
+                                             pt.function_name)
+                if not pt.streaming:
                     self._store_results(result, pt.return_ids,
                                         pt.options.num_returns)
                     if alloc.node is not None:

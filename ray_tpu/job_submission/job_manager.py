@@ -87,6 +87,13 @@ class JobSupervisor:
         env.update(info.runtime_env.get("env_vars", {}))
         env["RAYTPU_JOB_ID"] = self._submission_id
         cwd = info.runtime_env.get("working_dir") or None
+        if self._stopped:
+            # stop() won the race before the subprocess existed.
+            info.status = JobStatus.STOPPED
+            info.message = "stopped before start"
+            info.end_time = time.time()
+            _kv_write(info)
+            return
         info.status = JobStatus.RUNNING
         info.start_time = time.time()
         _kv_write(info)
@@ -122,7 +129,11 @@ class JobSupervisor:
 
     def stop(self) -> bool:
         self._stopped = True
-        if self._proc is not None and self._proc.poll() is None:
+        if self._proc is None:
+            # run() hasn't spawned the subprocess yet; the flag makes it
+            # bail out before Popen — stopping succeeded.
+            return True
+        if self._proc.poll() is None:
             try:
                 os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
             except (ProcessLookupError, PermissionError):

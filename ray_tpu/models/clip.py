@@ -1,0 +1,209 @@
+"""CLIP — dual-encoder contrastive vision-language model.
+
+Required by BASELINE.json's config matrix (ViT-L/CLIP).  TPU-first in
+the house style (models/llama.py, models/vit.py): functional params,
+``lax.scan`` towers, bfloat16 matmuls, logical-axis pytrees.  The
+contrastive loss supports cross-device negatives via ``all_gather``
+over the data-parallel mesh axis inside shard_map/pjit (the standard
+global-batch InfoNCE on pods).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models import vit as vit_lib
+from ray_tpu.ops.attention import dot_product_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49_408
+    max_len: int = 77
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    vision: vit_lib.ViTConfig = dataclasses.field(
+        default_factory=lambda: dataclasses.replace(
+            vit_lib.VIT_L16, num_classes=0
+        )
+    )
+    text: CLIPTextConfig = dataclasses.field(default_factory=CLIPTextConfig)
+    proj_dim: int = 768
+    logit_scale_init: float = 2.6592  # ln(1/0.07), the CLIP paper value
+
+
+CLIP_L14_LIKE = CLIPConfig()
+CLIP_TINY = CLIPConfig(
+    vision=dataclasses.replace(vit_lib.VIT_TINY, num_classes=0),
+    text=CLIPTextConfig(vocab_size=256, max_len=16, dim=64, n_layers=2,
+                        n_heads=4, mlp_dim=128),
+    proj_dim=32,
+)
+
+CONFIGS = {"clip-l": CLIP_L14_LIKE, "tiny": CLIP_TINY}
+
+
+def logical_axes(cfg: CLIPConfig) -> Params:
+    t = {
+        "tok_embed": ("vocab", "embed"),
+        "pos_embed": ("seq", "embed"),
+        "layers": {
+            "ln1_scale": ("layers", "embed"), "ln1_bias": ("layers", "embed"),
+            "ln2_scale": ("layers", "embed"), "ln2_bias": ("layers", "embed"),
+            "wqkv": ("layers", "embed", "qkv", "heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "w1": ("layers", "embed", "mlp"), "b1": ("layers", "mlp"),
+            "w2": ("layers", "mlp", "embed"), "b2": ("layers", "embed"),
+        },
+        "ln_f_scale": ("embed",), "ln_f_bias": ("embed",),
+    }
+    return {
+        "vision": vit_lib.logical_axes(cfg.vision),
+        "text": t,
+        "img_proj": ("embed", "proj"),
+        "txt_proj": ("embed", "proj"),
+        "logit_scale": (),
+    }
+
+
+def init_params(rng: jax.Array, cfg: CLIPConfig) -> Params:
+    kv, kt, kp1, kp2 = jax.random.split(rng, 4)
+    tc = cfg.text
+    pd = tc.param_dtype
+
+    def trunc(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, pd)
+                * (fan_in ** -0.5))
+
+    keys = jax.random.split(kt, 6)
+    L, D, H, hd, M = tc.n_layers, tc.dim, tc.n_heads, tc.head_dim, tc.mlp_dim
+    text: Params = {
+        "tok_embed": trunc(keys[0], (tc.vocab_size, D), D),
+        "pos_embed": trunc(keys[1], (tc.max_len, D), D),
+        "layers": {
+            "ln1_scale": jnp.ones((L, D), pd),
+            "ln1_bias": jnp.zeros((L, D), pd),
+            "ln2_scale": jnp.ones((L, D), pd),
+            "ln2_bias": jnp.zeros((L, D), pd),
+            "wqkv": trunc(keys[2], (L, D, 3, H, hd), D),
+            "wo": trunc(keys[3], (L, H, hd, D), D),
+            "w1": trunc(keys[4], (L, D, M), D),
+            "b1": jnp.zeros((L, M), pd),
+            "w2": trunc(keys[5], (L, M, D), M),
+            "b2": jnp.zeros((L, D), pd),
+        },
+        "ln_f_scale": jnp.ones((D,), pd),
+        "ln_f_bias": jnp.zeros((D,), pd),
+    }
+    return {
+        "vision": vit_lib.init_params(kv, cfg.vision),
+        "text": text,
+        "img_proj": trunc(kp1, (cfg.vision.dim, cfg.proj_dim),
+                          cfg.vision.dim),
+        "txt_proj": trunc(kp2, (tc.dim, cfg.proj_dim), tc.dim),
+        "logit_scale": jnp.asarray(cfg.logit_scale_init, pd),
+    }
+
+
+def _text_layer(tc: CLIPTextConfig, x: jax.Array, layer: Params) -> jax.Array:
+    ln = vit_lib.layer_norm
+    h = ln(x, layer["ln1_scale"], layer["ln1_bias"], tc.norm_eps)
+    qkv = jnp.einsum("bsd,dthk->tbshk", h.astype(tc.dtype),
+                     layer["wqkv"].astype(tc.dtype))
+    attn = dot_product_attention(qkv[0], qkv[1], qkv[2], causal=True)
+    attn = jnp.einsum("bshk,hkd->bsd", attn.astype(tc.dtype),
+                      layer["wo"].astype(tc.dtype))
+    x = x + attn.astype(x.dtype)
+    h = ln(x, layer["ln2_scale"], layer["ln2_bias"], tc.norm_eps)
+    h = jax.nn.gelu(jnp.einsum("bsd,dm->bsm", h.astype(tc.dtype),
+                               layer["w1"].astype(tc.dtype))
+                    + layer["b1"].astype(tc.dtype))
+    h = jnp.einsum("bsm,md->bsd", h, layer["w2"].astype(tc.dtype)) \
+        + layer["b2"].astype(tc.dtype)
+    return x + h.astype(x.dtype)
+
+
+def encode_text(params: Params, tokens: jax.Array,
+                cfg: CLIPConfig) -> jax.Array:
+    """(B, S) token ids → (B, D) features taken at each sequence's EOT
+    position (CLIP convention: the highest token id marks EOT)."""
+    tc = cfg.text
+    tp = params["text"]
+    x = tp["tok_embed"].astype(tc.dtype)[tokens]
+    x = x + tp["pos_embed"].astype(tc.dtype)[None, :tokens.shape[1]]
+
+    def body(carry, layer):
+        return _text_layer(tc, carry, layer), None
+
+    x, _ = lax.scan(body, x, tp["layers"])
+    x = vit_lib.layer_norm(x, tp["ln_f_scale"], tp["ln_f_bias"], tc.norm_eps)
+    eot = jnp.argmax(tokens, axis=-1)
+    return jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+
+
+def encode_image(params: Params, images: jax.Array,
+                 cfg: CLIPConfig) -> jax.Array:
+    return vit_lib.encode(params["vision"], images, cfg.vision)
+
+
+def forward(params: Params, images: jax.Array, tokens: jax.Array,
+            cfg: CLIPConfig) -> Tuple[jax.Array, jax.Array]:
+    """→ (img_emb, txt_emb), both L2-normalized (B, proj_dim) float32."""
+    img = encode_image(params, images, cfg).astype(jnp.float32)
+    txt = encode_text(params, tokens, cfg).astype(jnp.float32)
+    img = img @ params["img_proj"].astype(jnp.float32)
+    txt = txt @ params["txt_proj"].astype(jnp.float32)
+    img = img / (jnp.linalg.norm(img, axis=-1, keepdims=True) + 1e-8)
+    txt = txt / (jnp.linalg.norm(txt, axis=-1, keepdims=True) + 1e-8)
+    return img, txt
+
+
+def contrastive_loss(params: Params, images: jax.Array, tokens: jax.Array,
+                     cfg: CLIPConfig,
+                     axis_name: Optional[str] = None) -> jax.Array:
+    """Symmetric InfoNCE.  With ``axis_name`` (inside shard_map/pmap
+    over the dp axis) embeddings are all-gathered so negatives span the
+    global batch — the standard pod-scale CLIP recipe."""
+    img, txt = forward(params, images, tokens, cfg)
+    scale = jnp.exp(params["logit_scale"].astype(jnp.float32))
+    if axis_name is not None:
+        all_img = lax.all_gather(img, axis_name, tiled=True)
+        all_txt = lax.all_gather(txt, axis_name, tiled=True)
+        shard = lax.axis_index(axis_name)
+        offset = shard * img.shape[0]
+    else:
+        all_img, all_txt = img, txt
+        offset = 0
+    labels = offset + jnp.arange(img.shape[0])
+    # Local-queries × global-keys logits, both directions.
+    logits_i = scale * (img @ all_txt.T)
+    logits_t = scale * (txt @ all_img.T)
+
+    def nll(logits):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+    loss = 0.5 * (nll(logits_i) + nll(logits_t))
+    if axis_name is not None:
+        loss = lax.pmean(loss, axis_name)
+    return loss

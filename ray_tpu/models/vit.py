@@ -1,0 +1,215 @@
+"""Vision Transformer (ViT) — pure-JAX functional, sharding-aware.
+
+Required by BASELINE.json's config matrix (ViT-L / CLIP).  The
+reference ships no model code (models arrive as user torch modules,
+ray: python/ray/train/torch/train_loop_utils.py); here the model is
+TPU-first by construction, in the same style as models/llama.py:
+
+  * patch embedding as one reshape + matmul (MXU-shaped, no gather);
+  * stacked encoder blocks iterated with ``lax.scan``;
+  * bfloat16 matmuls, float32 layernorm/softmax;
+  * a logical-axis pytree so dp/fsdp/tp layouts are a rule-table
+    choice (ray_tpu.parallel.sharding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import dot_product_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    dim: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    mlp_dim: int = 4096
+    num_classes: int = 1000
+    pooling: str = "cls"  # "cls" | "gap"
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    @property
+    def seq_len(self) -> int:
+        return self.n_patches + (1 if self.pooling == "cls" else 0)
+
+    def num_params(self) -> int:
+        per_layer = 4 * self.dim * self.dim + 2 * self.dim * self.mlp_dim \
+            + 4 * self.dim + self.mlp_dim + self.dim
+        emb = self.patch_dim * self.dim + self.seq_len * self.dim + self.dim
+        head = self.dim * self.num_classes + self.num_classes
+        return self.n_layers * per_layer + emb + head + 2 * self.dim
+
+
+# Canonical configs (ViT-B/L per the original paper's table 1).
+VIT_B16 = ViTConfig(dim=768, n_layers=12, n_heads=12, mlp_dim=3072)
+VIT_L16 = ViTConfig()  # the BASELINE.json target
+VIT_TINY = ViTConfig(image_size=32, patch_size=8, dim=64, n_layers=2,
+                     n_heads=4, mlp_dim=128, num_classes=10, remat=False)
+
+CONFIGS = {"vit-b16": VIT_B16, "vit-l16": VIT_L16, "tiny": VIT_TINY}
+
+
+def logical_axes(cfg: ViTConfig) -> Params:
+    layer = {
+        "ln1_scale": ("layers", "embed"), "ln1_bias": ("layers", "embed"),
+        "ln2_scale": ("layers", "embed"), "ln2_bias": ("layers", "embed"),
+        "wqkv": ("layers", "embed", "qkv", "heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "w1": ("layers", "embed", "mlp"),
+        "b1": ("layers", "mlp"),
+        "w2": ("layers", "mlp", "embed"),
+        "b2": ("layers", "embed"),
+    }
+    out = {
+        "patch_embed": ("patch", "embed"),
+        "pos_embed": ("seq", "embed"),
+        "layers": layer,
+        "ln_f_scale": ("embed",), "ln_f_bias": ("embed",),
+        "head_w": ("embed", "classes"), "head_b": ("classes",),
+    }
+    if cfg.pooling == "cls":
+        out["cls_token"] = ("embed",)
+    return out
+
+
+def init_params(rng: jax.Array, cfg: ViTConfig) -> Params:
+    keys = jax.random.split(rng, 8)
+    pd = cfg.param_dtype
+
+    def trunc(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, pd)
+                * (fan_in ** -0.5))
+
+    L, D, H, hd, M = (cfg.n_layers, cfg.dim, cfg.n_heads, cfg.head_dim,
+                      cfg.mlp_dim)
+    params: Params = {
+        "patch_embed": trunc(keys[0], (cfg.patch_dim, D), cfg.patch_dim),
+        "pos_embed": trunc(keys[1], (cfg.seq_len, D), D),
+        "layers": {
+            "ln1_scale": jnp.ones((L, D), pd),
+            "ln1_bias": jnp.zeros((L, D), pd),
+            "ln2_scale": jnp.ones((L, D), pd),
+            "ln2_bias": jnp.zeros((L, D), pd),
+            "wqkv": trunc(keys[2], (L, D, 3, H, hd), D),
+            "wo": trunc(keys[3], (L, H, hd, D), D),
+            "w1": trunc(keys[4], (L, D, M), D),
+            "b1": jnp.zeros((L, M), pd),
+            "w2": trunc(keys[5], (L, M, D), M),
+            "b2": jnp.zeros((L, D), pd),
+        },
+        "ln_f_scale": jnp.ones((D,), pd),
+        "ln_f_bias": jnp.zeros((D,), pd),
+        "head_w": jnp.zeros((D, cfg.num_classes), pd),
+        "head_b": jnp.zeros((cfg.num_classes,), pd),
+    }
+    if cfg.pooling == "cls":
+        params["cls_token"] = trunc(keys[6], (D,), D)
+    return params
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    out = (x32 - mu) * lax.rsqrt(var + eps) * scale.astype(jnp.float32) \
+        + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """(B, H, W, C) → (B, N, patch_dim) with one reshape/transpose —
+    XLA lowers this to a layout change feeding the embed matmul."""
+    B, H, W, C = images.shape
+    p = cfg.patch_size
+    x = images.reshape(B, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, (H // p) * (W // p), p * p * C)
+
+
+def _layer_fn(cfg: ViTConfig, x: jax.Array, layer: Params) -> jax.Array:
+    B, S, D = x.shape
+    h = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], cfg.norm_eps)
+    qkv = jnp.einsum("bsd,dthk->tbshk", h.astype(cfg.dtype),
+                     layer["wqkv"].astype(cfg.dtype))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    attn = dot_product_attention(q, k, v, causal=False)
+    attn = jnp.einsum("bshk,hkd->bsd", attn.astype(cfg.dtype),
+                      layer["wo"].astype(cfg.dtype))
+    x = x + attn.astype(x.dtype)
+
+    h = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], cfg.norm_eps)
+    h = jnp.einsum("bsd,dm->bsm", h.astype(cfg.dtype),
+                   layer["w1"].astype(cfg.dtype)) + layer["b1"].astype(cfg.dtype)
+    h = jax.nn.gelu(h)
+    h = jnp.einsum("bsm,md->bsd", h,
+                   layer["w2"].astype(cfg.dtype)) + layer["b2"].astype(cfg.dtype)
+    return x + h.astype(x.dtype)
+
+
+def encode(params: Params, images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """(B, H, W, C) images → (B, D) pooled features (pre-head)."""
+    x = patchify(images.astype(cfg.dtype), cfg)
+    x = jnp.einsum("bnp,pd->bnd", x, params["patch_embed"].astype(cfg.dtype))
+    if cfg.pooling == "cls":
+        cls = jnp.broadcast_to(
+            params["cls_token"].astype(cfg.dtype),
+            (x.shape[0], 1, cfg.dim),
+        )
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)[None]
+
+    layer_fn = _layer_fn
+    if cfg.remat:
+        layer_fn = jax.checkpoint(_layer_fn, static_argnums=(0,))
+
+    def body(carry, layer):
+        return layer_fn(cfg, carry, layer), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                   cfg.norm_eps)
+    if cfg.pooling == "cls":
+        return x[:, 0]
+    return x.mean(axis=1)
+
+
+def forward(params: Params, images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """Images → class logits (float32)."""
+    feats = encode(params, images, cfg)
+    logits = feats.astype(jnp.float32) @ params["head_w"].astype(jnp.float32)
+    return logits + params["head_b"].astype(jnp.float32)
+
+
+def loss_fn(params: Params, images: jax.Array, labels: jax.Array,
+            cfg: ViTConfig) -> jax.Array:
+    logits = forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+    return nll.mean()

@@ -110,7 +110,10 @@ class Algorithm(Trainable):
         if kwargs:  # tune passes a flat dict config
             config = config.copy().update_from_dict(kwargs)
         self.config = config
-        self.env = make_env(config.env, **config.env_config)
+        # env=None: algorithms that don't interact with a simulator
+        # (LLM RLHF like GRPO — the "env" is the reward function).
+        self.env = (make_env(config.env, **config.env_config)
+                    if config.env is not None else None)
         self.iteration = 0
         self._timesteps_total = 0
         self._last_episode_return = float("nan")

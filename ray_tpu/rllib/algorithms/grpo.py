@@ -1,0 +1,248 @@
+"""GRPO — Group Relative Policy Optimization for LLM RLHF.
+
+Required by BASELINE.json's config matrix (PPO/GRPO RLHF).  The
+reference has no GRPO (its RLHF story is external libraries on Ray
+actors); this is a TPU-first design in the house one-jit-per-iteration
+style (see algorithms/ppo.py): sampling G completions per prompt
+(lax.scan over decode steps), reward scoring, group-relative advantage
+normalization, and all SGD epochs compile into ONE XLA program per
+iteration.
+
+GRPO (Shao et al., DeepSeekMath) replaces PPO's learned value baseline
+with the *group mean reward* of G samples from the same prompt:
+
+    A_i = (r_i - mean_group) / (std_group + eps)
+
+objective per token: clipped importance ratio × A_i, minus a
+k3-estimator KL penalty against the frozen reference policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models import llama
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class GRPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env = None  # no simulator: the reward function is the env
+        # model
+        self.model = llama.LLAMA_TINY
+        # sampling
+        self.num_prompts = 4       # distinct prompts per iteration
+        self.group_size = 8        # G samples per prompt
+        self.prompt_len = 8
+        self.max_new_tokens = 16
+        self.temperature = 1.0
+        # optimization
+        self.lr = 3e-4
+        self.num_epochs = 2
+        self.clip_param = 0.2
+        self.kl_coef = 0.02
+        self.grad_clip = 1.0
+        # reward_fn(prompt_tokens (B,P) i32, completion (B,N) i32) -> (B,)
+        # float32; must be jax-traceable (compiled into the iteration).
+        self.reward_fn: Optional[Callable] = None
+        # prompt_source(key) -> (num_prompts, prompt_len) i32; defaults
+        # to uniform random tokens (tests / synthetic RLHF).
+        self.prompt_source: Optional[Callable] = None
+
+    @property
+    def algo_class(self):
+        return GRPO
+
+
+@dataclasses.dataclass(frozen=True)
+class _Static:
+    prompt_len: int
+    max_new: int
+    group: int
+    num_prompts: int
+    temperature: float
+    clip: float
+    kl_coef: float
+    num_epochs: int
+
+
+def _completion_logps(params, buf, mcfg, P, N, temperature=1.0):
+    """Per-token log-probs of the completion region under ``params``,
+    at the same temperature the sampler used — the importance ratio
+    must compare identically-scaled measures.  buf: (B, P+N) tokens;
+    returns (B, N) float32."""
+    logits = llama.forward(params, buf, mcfg).astype(jnp.float32)
+    pred = logits[:, P - 1:P + N - 1] / temperature
+    tgt = buf[:, P:P + N]
+    logp = jax.nn.log_softmax(pred, axis=-1)
+    return jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+
+
+def _sample(params, prompts, key, mcfg, st: _Static):
+    """Autoregressive sampling: (B,P) prompts → ((B,P+N) buffer,
+    (B,N) sampling-time logps).  Full-buffer forward per step — the
+    causal mask makes unwritten future positions irrelevant; for the
+    RLHF loop the whole scan compiles once."""
+    B = prompts.shape[0]
+    P, N = st.prompt_len, st.max_new
+    buf = jnp.concatenate(
+        [prompts, jnp.zeros((B, N), prompts.dtype)], axis=1
+    )
+
+    def step(carry, t):
+        buf, key = carry
+        logits = llama.forward(params, buf, mcfg).astype(jnp.float32)
+        step_logits = jax.lax.dynamic_index_in_dim(
+            logits, P - 1 + t, axis=1, keepdims=False
+        ) / st.temperature
+        key, k = jax.random.split(key)
+        tok = jax.random.categorical(k, step_logits)
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(step_logits, axis=-1), tok[:, None], axis=-1
+        )[:, 0]
+        buf = jax.lax.dynamic_update_index_in_dim(
+            buf, tok.astype(buf.dtype), P + t, axis=1
+        )
+        return (buf, key), logp
+
+    (buf, _), logps = jax.lax.scan(step, (buf, key), jnp.arange(N))
+    return buf, logps.T  # (B, N)
+
+
+def _grpo_loss(params, buf, old_logps, ref_logps, adv, mcfg, st: _Static):
+    cur = _completion_logps(params, buf, mcfg, st.prompt_len, st.max_new,
+                            st.temperature)
+    ratio = jnp.exp(cur - old_logps)                       # (B, N)
+    adv_t = adv[:, None]                                   # broadcast
+    surrogate = jnp.minimum(
+        ratio * adv_t,
+        jnp.clip(ratio, 1 - st.clip, 1 + st.clip) * adv_t,
+    ).mean()
+    # k3 KL estimator vs the frozen reference (unbiased, low-variance).
+    log_r = ref_logps - cur
+    kl = (jnp.exp(log_r) - log_r - 1.0).mean()
+    return -(surrogate - st.kl_coef * kl), {
+        "kl": kl, "ratio_mean": ratio.mean(),
+    }
+
+
+def _grpo_iteration(mcfg, tx, reward_fn, prompt_source, st: _Static,
+                    params, ref_params, opt_state, key):
+    kp, ks = jax.random.split(key)
+    prompts = prompt_source(kp)                            # (n, P)
+    prompts = jnp.repeat(prompts, st.group, axis=0)        # (n*G, P)
+    buf, old_logps = _sample(params, prompts, ks, mcfg, st)
+    completions = buf[:, st.prompt_len:]
+    rewards = reward_fn(prompts, completions).astype(jnp.float32)
+
+    # Group-relative advantages: normalize within each prompt's group.
+    grp = rewards.reshape(st.num_prompts, st.group)
+    adv = ((grp - grp.mean(axis=1, keepdims=True))
+           / (grp.std(axis=1, keepdims=True) + 1e-6)).reshape(-1)
+
+    ref_logps = _completion_logps(ref_params, buf, mcfg,
+                                  st.prompt_len, st.max_new,
+                                  st.temperature)
+    old_logps = jax.lax.stop_gradient(old_logps)
+
+    def epoch(carry, _):
+        params, opt_state = carry
+        (loss, aux), grads = jax.value_and_grad(_grpo_loss, has_aux=True)(
+            params, buf, old_logps, ref_logps, adv, mcfg, st
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), (loss, aux["kl"])
+
+    (params, opt_state), (losses, kls) = jax.lax.scan(
+        epoch, (params, opt_state), None, length=st.num_epochs
+    )
+    metrics = {
+        "reward_mean": rewards.mean(),
+        "reward_max": rewards.max(),
+        "loss": losses[-1],
+        "kl": kls[-1],
+    }
+    return params, opt_state, metrics
+
+
+class GRPO(Algorithm):
+    config_class = GRPOConfig
+
+    def _setup(self) -> None:
+        cfg = self.config
+        if cfg.reward_fn is None:
+            raise ValueError("GRPOConfig.reward_fn is required (the "
+                             "reward model IS the environment in RLHF)")
+        mcfg = cfg.model
+        key = jax.random.key(cfg.seed)
+        key, k_init = jax.random.split(key)
+        self.params = llama.init_params(k_init, mcfg)
+        # Frozen reference policy for the KL penalty (parity with RLHF
+        # practice: ref = the SFT/init checkpoint).
+        self.ref_params = jax.tree.map(lambda x: x, self.params)
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr),
+        )
+        self.opt_state = self.tx.init(self.params)
+        self.key = key
+        st = _Static(
+            prompt_len=cfg.prompt_len, max_new=cfg.max_new_tokens,
+            group=cfg.group_size, num_prompts=cfg.num_prompts,
+            temperature=cfg.temperature, clip=cfg.clip_param,
+            kl_coef=cfg.kl_coef, num_epochs=cfg.num_epochs,
+        )
+        prompt_source = cfg.prompt_source or (
+            lambda k: jax.random.randint(
+                k, (cfg.num_prompts, cfg.prompt_len), 0, mcfg.vocab_size
+            ).astype(jnp.int32)
+        )
+        self._iteration_fn = jax.jit(partial(
+            _grpo_iteration, mcfg, self.tx, cfg.reward_fn,
+            prompt_source, st,
+        ))
+
+    def _train_once(self) -> Dict[str, Any]:
+        self.key, k = jax.random.split(self.key)
+        self.params, self.opt_state, metrics = self._iteration_fn(
+            self.params, self.ref_params, self.opt_state, k
+        )
+        out = {k_: float(v) for k_, v in metrics.items()}
+        out["_timesteps"] = (self.config.num_prompts
+                             * self.config.group_size
+                             * self.config.max_new_tokens)
+        return out
+
+    def sample(self, prompts: jnp.ndarray, key=None) -> jnp.ndarray:
+        """Greedy-temperature sampling with the current policy."""
+        cfg = self.config
+        st = _Static(cfg.prompt_len, cfg.max_new_tokens, cfg.group_size,
+                     cfg.num_prompts, cfg.temperature, cfg.clip_param,
+                     cfg.kl_coef, cfg.num_epochs)
+        key = key if key is not None else jax.random.key(0)
+        buf, _ = _sample(self.params, jnp.asarray(prompts), key,
+                         cfg.model, st)
+        return buf[:, cfg.prompt_len:]
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "params": jax.device_get(self.params),
+            "ref_params": jax.device_get(self.ref_params),
+            "opt_state": jax.device_get(self.opt_state),
+            "iteration": self.iteration,
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.ref_params = jax.device_put(state["ref_params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.iteration = state.get("iteration", 0)

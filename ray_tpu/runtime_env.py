@@ -198,22 +198,34 @@ class RuntimeEnvContext:
 
     @contextlib.contextmanager
     def applied(self):
-        """Apply env vars + sys.path for the duration of one task."""
+        """Apply env vars + sys.path for the duration of one task.
+
+        The lock guards only the mutate/restore critical sections, NOT
+        the task body — holding it across execution would deadlock any
+        env'd task that blocks on another env'd task.  Concurrent tasks
+        with different envs may therefore observe each other's vars
+        (best-effort under threads; the reference's per-process workers
+        have true isolation)."""
         with _apply_lock:
             saved_env = {k: os.environ.get(k) for k in self.env_vars}
             os.environ.update(self.env_vars)
-            saved_path = list(sys.path)
-            for p in reversed(self.sys_paths):
+            saved_paths = list(self.sys_paths)
+            for p in reversed(saved_paths):
                 sys.path.insert(0, p)
-            try:
-                yield self
-            finally:
+        try:
+            yield self
+        finally:
+            with _apply_lock:
                 for k, old in saved_env.items():
                     if old is None:
                         os.environ.pop(k, None)
                     else:
                         os.environ[k] = old
-                sys.path[:] = saved_path
+                for p in saved_paths:
+                    try:
+                        sys.path.remove(p)
+                    except ValueError:
+                        pass
 
 
 def materialize(spec) -> Optional[RuntimeEnvContext]:

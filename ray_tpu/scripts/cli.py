@@ -113,7 +113,9 @@ def cmd_job(args, out) -> int:
     if args.job_cmd == "submit":
         import shlex
 
-        words = [w for w in args.entrypoint if w != "--"]
+        words = list(args.entrypoint)
+        if words and words[0] == "--":  # strip only the CLI separator
+            words = words[1:]
         sid = client.submit_job(
             entrypoint=" ".join(shlex.quote(w) for w in words),
             submission_id=args.submission_id or None,

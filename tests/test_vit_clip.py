@@ -1,0 +1,155 @@
+"""ViT + CLIP model families (BASELINE.json config matrix: ViT-L/CLIP).
+
+Runs on the virtual CPU mesh (tests/conftest.py forces cpu platform)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import clip as clip_lib
+from ray_tpu.models import vit as vit_lib
+
+
+@pytest.fixture(scope="module")
+def tiny_vit():
+    cfg = vit_lib.VIT_TINY
+    params = vit_lib.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _images(cfg, n=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(
+        size=(n, cfg.image_size, cfg.image_size, cfg.channels)
+    ).astype(np.float32))
+
+
+def test_vit_forward_shapes(tiny_vit):
+    cfg, params = tiny_vit
+    logits = vit_lib.forward(params, _images(cfg), cfg)
+    assert logits.shape == (2, cfg.num_classes)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_vit_patchify_roundtrip():
+    cfg = vit_lib.VIT_TINY
+    imgs = _images(cfg, n=1)
+    patches = vit_lib.patchify(imgs, cfg)
+    assert patches.shape == (1, cfg.n_patches, cfg.patch_dim)
+    # First patch == top-left block, row-major.
+    p = cfg.patch_size
+    np.testing.assert_allclose(
+        np.asarray(patches)[0, 0].reshape(p, p, cfg.channels),
+        np.asarray(imgs)[0, :p, :p, :], rtol=1e-6,
+    )
+
+
+def test_vit_gap_pooling():
+    cfg = dataclasses.replace(vit_lib.VIT_TINY, pooling="gap")
+    params = vit_lib.init_params(jax.random.key(1), cfg)
+    assert "cls_token" not in params
+    assert vit_lib.forward(params, _images(cfg), cfg).shape == (2, 10)
+
+
+def test_vit_trains():
+    cfg = vit_lib.VIT_TINY
+    params = vit_lib.init_params(jax.random.key(0), cfg)
+    images = _images(cfg, n=4)
+    labels = jnp.array([0, 1, 2, 3])
+
+    @jax.jit
+    def step(p):
+        loss, grads = jax.value_and_grad(vit_lib.loss_fn)(
+            p, images, labels, cfg
+        )
+        return loss, jax.tree.map(lambda a, g: a - 0.05 * g, p, grads)
+
+    loss0, params = step(params)
+    for _ in range(5):
+        loss, params = step(params)
+    assert float(loss) < float(loss0)
+
+
+def test_vit_logical_axes_match_params(tiny_vit):
+    cfg, params = tiny_vit
+    axes = vit_lib.logical_axes(cfg)
+    flat_p = jax.tree.leaves(params)
+    flat_a = jax.tree.leaves(axes, is_leaf=lambda x: isinstance(x, tuple))
+    assert len(flat_p) == len(flat_a)
+    for p, a in zip(jax.tree.flatten(params)[0],
+                    jax.tree.flatten(axes,
+                                     is_leaf=lambda x: isinstance(x, tuple))[0]):
+        assert p.ndim == len(a), (p.shape, a)
+
+
+def test_clip_forward_and_loss():
+    cfg = clip_lib.CLIP_TINY
+    params = clip_lib.init_params(jax.random.key(0), cfg)
+    images = _images(cfg.vision, n=3)
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(
+        1, cfg.text.vocab_size, (3, cfg.text.max_len)
+    ).astype(np.int32))
+    img, txt = clip_lib.forward(params, images, tokens, cfg)
+    assert img.shape == (3, cfg.proj_dim) and txt.shape == (3, cfg.proj_dim)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(img), axis=-1),
+                               1.0, rtol=1e-4)
+    loss = clip_lib.contrastive_loss(params, images, tokens, cfg)
+    assert float(loss) > 0
+
+
+def test_clip_trains():
+    cfg = clip_lib.CLIP_TINY
+    params = clip_lib.init_params(jax.random.key(0), cfg)
+    images = _images(cfg.vision, n=4)
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(
+        1, cfg.text.vocab_size, (4, cfg.text.max_len)
+    ).astype(np.int32))
+
+    @jax.jit
+    def step(p):
+        loss, grads = jax.value_and_grad(clip_lib.contrastive_loss)(
+            p, images, tokens, cfg
+        )
+        return loss, jax.tree.map(lambda a, g: a - 0.1 * g, p, grads)
+
+    loss0, params = step(params)
+    for _ in range(8):
+        loss, params = step(params)
+    assert float(loss) < float(loss0)
+
+
+def test_clip_distributed_negatives():
+    """Global-batch InfoNCE over a dp mesh axis equals the single-device
+    loss on the concatenated batch."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import shard_map_unchecked
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    cfg = clip_lib.CLIP_TINY
+    params = clip_lib.init_params(jax.random.key(0), cfg)
+    images = _images(cfg.vision, n=4)
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.integers(
+        1, cfg.text.vocab_size, (4, cfg.text.max_len)
+    ).astype(np.int32))
+
+    mesh = Mesh(np.array(devs[:2]), ("dp",))
+    sharded = shard_map_unchecked(
+        lambda p, i, t: clip_lib.contrastive_loss(p, i, t, cfg,
+                                                  axis_name="dp"),
+        mesh=mesh,
+        in_specs=(P(), P("dp"), P("dp")),
+        out_specs=P(),
+    )
+    dist = float(sharded(params, images, tokens))
+    local = float(clip_lib.contrastive_loss(params, images, tokens, cfg))
+    assert abs(dist - local) < 1e-3
