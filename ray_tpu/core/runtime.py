@@ -20,7 +20,9 @@ so they run unchanged when workers move behind a process/RPC boundary.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import inspect as _inspect
 import itertools
 import threading
 import queue as _queue
@@ -49,6 +51,20 @@ from ray_tpu.utils.ids import (
     PlacementGroupID,
     TaskID,
 )
+
+_tracing_mod = None
+
+
+def _tracing():
+    """Cycle-safe cached import of ray_tpu.util.tracing (ray_tpu.util's
+    __init__ imports back into core, so a top-level import here would
+    be circular)."""
+    global _tracing_mod
+    if _tracing_mod is None:
+        from ray_tpu.util import tracing
+
+        _tracing_mod = tracing
+    return _tracing_mod
 
 
 @dataclasses.dataclass
@@ -205,6 +221,7 @@ class _PendingTask:
     function_name: str
     streaming: bool = False
     on_done: Optional[Callable[[], None]] = None
+    trace_ctx: Optional[Dict[str, str]] = None
 
 
 class _ActorShell:
@@ -326,6 +343,7 @@ class _ActorShell:
                 return
             method_name, args, kwargs, return_ids, num_returns = item[:5]
             task_id = item[5] if len(item) > 5 else None
+            trace_ctx = item[6] if len(item) > 6 else None
             task_hex = task_id.hex() if task_id is not None else None
             ev = self.runtime.events
             qname = f"{self.cls.__name__}.{method_name}"
@@ -339,17 +357,16 @@ class _ActorShell:
                 resolved_args, resolved_kwargs = self.runtime.resolve_args(
                     args, kwargs
                 )
-                import contextlib
-                import inspect
-
                 method = getattr(self.instance, method_name)
                 ctx = getattr(self, "_env_ctx", None)
                 # Env covers the whole body, including a streaming
                 # method's lazy generator execution.
                 with (ctx.applied() if ctx is not None
-                      else contextlib.nullcontext()):
+                      else contextlib.nullcontext()), \
+                        _tracing().task_span(qname, trace_ctx,
+                                           {"task_id": task_hex or ""}):
                     result = method(*resolved_args, **resolved_kwargs)
-                    if inspect.iscoroutine(result):
+                    if _inspect.iscoroutine(result):
                         import asyncio
 
                         result = asyncio.run(result)
@@ -399,7 +416,7 @@ class _ActorShell:
                                            error_message=repr(err))
 
     def submit(self, method_name: str, args, kwargs, return_ids, num_returns,
-               task_id: Optional[TaskID] = None):
+               task_id: Optional[TaskID] = None, trace_ctx=None):
         if self.dead:
             err = ActorDiedError(repr(self.cls), self.death_reason or "dead")
             for oid in return_ids:
@@ -413,7 +430,7 @@ class _ActorShell:
                                            error_message=repr(err))
             return
         self.queue.put((method_name, args, kwargs, return_ids, num_returns,
-                        task_id))
+                        task_id, trace_ctx))
 
     def kill(self, no_restart: bool = True):
         self.dead = True
@@ -833,6 +850,7 @@ class LocalRuntime:
             retries_left=0 if streaming else options.max_retries,
             task_id=task_id, function_name=getattr(fn, "__name__", repr(fn)),
             streaming=streaming,
+            trace_ctx=_tracing().capture_context(),
         )
         self.events.record(
             task_id.hex(), _ev.PENDING_NODE_ASSIGNMENT,
@@ -915,8 +933,6 @@ class LocalRuntime:
                 required_resources=pt.options.resource_demand(),
             )
             try:
-                import contextlib
-
                 args, kwargs = self.resolve_args(pt.args, pt.kwargs)
                 if pt.options.runtime_env:
                     from ray_tpu.runtime_env import materialize
@@ -926,7 +942,10 @@ class LocalRuntime:
                     env_cm = contextlib.nullcontext()
                 # The env must cover the whole body — for a streaming
                 # task the generator body runs inside _stream_results.
-                with env_cm:
+                with env_cm, _tracing().task_span(
+                    pt.function_name, pt.trace_ctx,
+                    {"task_id": pt.task_id.hex(), "attempt": attempt},
+                ):
                     result = pt.fn(*args, **kwargs)
                     if pt.streaming:
                         self._stream_results(result, pt.task_id,
@@ -1061,7 +1080,7 @@ class LocalRuntime:
                 actor_id=actor_id.hex(),
             )
             shell.submit(method_name, args, kwargs, return_ids, num_returns,
-                         task_id)
+                         task_id, _tracing().capture_context())
         if streaming:
             from ray_tpu.core.generator import ObjectRefGenerator
 

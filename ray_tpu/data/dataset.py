@@ -29,6 +29,7 @@ from ray_tpu.data.executor import (
     Op,
     ReadOp,
     StreamingExecutor,
+    make_groupby,
     make_random_shuffle,
     make_repartition,
     make_sort,
@@ -151,6 +152,12 @@ class Dataset:
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         return self._append(make_sort(key, descending))
+
+    def groupby(self, key: str) -> "GroupedData":
+        """Group rows by a key column (parity: dataset.groupby →
+        grouped_data.py GroupedData; hash-exchange + per-partition
+        aggregation)."""
+        return GroupedData(self, key)
 
     def limit(self, n: int) -> "Dataset":
         return self._append(LimitOp(n))
@@ -338,6 +345,74 @@ class Dataset:
         for op in self._ops:
             names.append(getattr(op, "name", type(op).__name__))
         return f"Dataset({' -> '.join(names)})"
+
+
+class GroupedData:
+    """Aggregations over groups (parity: data/grouped_data.py
+    GroupedData — count/sum/min/max/mean/std/aggregate/map_groups)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, name: str, agg_fn) -> Dataset:
+        return self._ds._append(
+            make_groupby(self._key, agg_fn, name=f"GroupBy({self._key}).{name}")
+        )
+
+    def count(self) -> Dataset:
+        key = self._key
+
+        def agg(value, group: Block) -> Dict[str, Any]:
+            return {key: value,
+                    "count()": BlockAccessor(group).num_rows()}
+
+        return self._agg("count", agg)
+
+    def _column_agg(self, name: str, col: str, np_fn) -> Dataset:
+        key = self._key
+
+        def agg(value, group: Block) -> Dict[str, Any]:
+            return {key: value, f"{name}({col})": np_fn(group[col])}
+
+        return self._agg(name, agg)
+
+    def sum(self, col: str) -> Dataset:
+        return self._column_agg("sum", col, np.sum)
+
+    def min(self, col: str) -> Dataset:
+        return self._column_agg("min", col, np.min)
+
+    def max(self, col: str) -> Dataset:
+        return self._column_agg("max", col, np.max)
+
+    def mean(self, col: str) -> Dataset:
+        return self._column_agg("mean", col, np.mean)
+
+    def std(self, col: str) -> Dataset:
+        return self._column_agg(
+            "std", col, lambda a: float(np.std(a, ddof=1))
+        )
+
+    def map_groups(self, fn: Callable[[Block], Block]) -> Dataset:
+        """Apply fn to each group's block; outputs are concatenated
+        (parity: GroupedData.map_groups)."""
+        key = self._key
+
+        def agg(value, group: Block) -> Dict[str, Any]:
+            out = fn(group)
+            if not isinstance(out, dict):
+                raise TypeError("map_groups fn must return a block dict")
+            return {"__block__": out}
+
+        ds = self._agg("map_groups", agg)
+
+        def explode(block: Block) -> Block:
+            if "__block__" not in block:
+                return block
+            return concat_blocks([b for b in block["__block__"] if b])
+
+        return ds._append(MapOp(explode, name="ExplodeGroups"))
 
 
 def _name(fn) -> str:

@@ -378,6 +378,67 @@ def make_random_shuffle(seed: Optional[int]) -> AllToAllOp:
     return AllToAllOp(run, name="RandomShuffle")
 
 
+def make_groupby(key: str, agg_fn, name: str) -> AllToAllOp:
+    """Hash exchange + per-partition aggregation (parity: the sort/hash
+    shuffle under data groupby, _internal/planner/exchange/
+    aggregate_task_spec.py): map-stage hash-partitions every block by
+    the group key, reduce-stage merges partition j of every block and
+    applies ``agg_fn`` per distinct key.
+
+    agg_fn(key_value, group_block) -> row dict.
+    """
+
+    def run(refs: List[Any], ex: "StreamingExecutor") -> List[Any]:
+        if not refs:
+            return []
+        k = len(refs)
+
+        def split_hash(block: Block, k: int) -> List[Block]:
+            acc = BlockAccessor(block)
+            if acc.num_rows() == 0 or key not in block:
+                # Rows without the group key are dropped explicitly
+                # (parity: the reference groups null keys separately;
+                # an entire keyless block has nothing to group on).
+                return [{} for _ in range(k)]
+            # Stable hash per group value → same key lands in the same
+            # partition across blocks.
+            codes = np.asarray(
+                [hash(str(v)) % k for v in block[key]], dtype=np.int64
+            )
+            return [acc.take_rows(np.nonzero(codes == j)[0])
+                    for j in range(k)]
+
+        split_fn = ray_tpu.remote(num_cpus=1)(split_hash)
+        parts_refs = [split_fn.remote(r, k) for r in refs]
+
+        def agg_j(j: int, *all_parts: List[Block]) -> Block:
+            merged = concat_blocks([parts[j] for parts in all_parts])
+            acc = BlockAccessor(merged)
+            if acc.num_rows() == 0 or key not in merged:
+                return {}
+            values = merged[key]
+            order = np.argsort(values.astype(str), kind="stable")
+            sorted_block = acc.take_rows(order)
+            sv = sorted_block[key]
+            boundaries = np.nonzero(
+                np.asarray(sv[1:]).astype(str)
+                != np.asarray(sv[:-1]).astype(str)
+            )[0] + 1
+            starts = np.concatenate([[0], boundaries])
+            ends = np.concatenate([boundaries, [len(sv)]])
+            sacc = BlockAccessor(sorted_block)
+            rows = []
+            for s, e in zip(starts, ends):
+                group = sacc.take_rows(np.arange(s, e))
+                rows.append(agg_fn(sv[s], group))
+            return BlockAccessor.from_rows(rows)
+
+        agg = ray_tpu.remote(num_cpus=1)(agg_j)
+        return [agg.remote(j, *parts_refs) for j in range(k)]
+
+    return AllToAllOp(run, name=name)
+
+
 def make_sort(key: str, descending: bool) -> AllToAllOp:
     """Global sort: sample-free simple implementation — concatenate,
     argsort, re-split (fine up to driver memory; the reference's range

@@ -80,6 +80,9 @@ class JobSupervisor:
         self._submission_id = submission_id
         self._proc: Optional[subprocess.Popen] = None
         self._stopped = False
+        # Serializes the stopped-check/spawn against stop(): without it
+        # stop() could report success while run() spawns anyway.
+        self._state_lock = threading.Lock()
 
     def run(self) -> None:
         info = _kv_read(self._submission_id)
@@ -87,23 +90,33 @@ class JobSupervisor:
         env.update(info.runtime_env.get("env_vars", {}))
         env["RAYTPU_JOB_ID"] = self._submission_id
         cwd = info.runtime_env.get("working_dir") or None
-        if self._stopped:
-            # stop() won the race before the subprocess existed.
-            info.status = JobStatus.STOPPED
-            info.message = "stopped before start"
-            info.end_time = time.time()
+        with self._state_lock:
+            if self._stopped:
+                # stop() won the race before the subprocess existed.
+                info.status = JobStatus.STOPPED
+                info.message = "stopped before start"
+                info.end_time = time.time()
+                _kv_write(info)
+                return
+            info.status = JobStatus.RUNNING
+            info.start_time = time.time()
             _kv_write(info)
-            return
-        info.status = JobStatus.RUNNING
-        info.start_time = time.time()
-        _kv_write(info)
-        log = open(info.log_path, "wb")
+            log = open(info.log_path, "wb")
+            try:
+                self._proc = subprocess.Popen(
+                    info.entrypoint, shell=True, stdout=log,
+                    stderr=subprocess.STDOUT, env=env, cwd=cwd,
+                    start_new_session=True,  # own process group for stop()
+                )
+            except Exception as e:
+                log.close()
+                info = _kv_read(self._submission_id)
+                info.status = JobStatus.FAILED
+                info.message = f"spawn error: {e!r}"
+                info.end_time = time.time()
+                _kv_write(info)
+                return
         try:
-            self._proc = subprocess.Popen(
-                info.entrypoint, shell=True, stdout=log,
-                stderr=subprocess.STDOUT, env=env, cwd=cwd,
-                start_new_session=True,  # own process group for stop()
-            )
             code = self._proc.wait()
         except Exception as e:
             info = _kv_read(self._submission_id)
@@ -128,11 +141,12 @@ class JobSupervisor:
         _kv_write(info)
 
     def stop(self) -> bool:
-        self._stopped = True
-        if self._proc is None:
-            # run() hasn't spawned the subprocess yet; the flag makes it
-            # bail out before Popen — stopping succeeded.
-            return True
+        with self._state_lock:
+            self._stopped = True
+            if self._proc is None:
+                # run() hasn't reached Popen; under the lock, the flag
+                # guarantees it bails out before spawning.
+                return True
         if self._proc.poll() is None:
             try:
                 os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)

@@ -61,7 +61,8 @@ class ViTConfig:
     def num_params(self) -> int:
         per_layer = 4 * self.dim * self.dim + 2 * self.dim * self.mlp_dim \
             + 4 * self.dim + self.mlp_dim + self.dim
-        emb = self.patch_dim * self.dim + self.seq_len * self.dim + self.dim
+        cls = self.dim if self.pooling == "cls" else 0
+        emb = self.patch_dim * self.dim + self.seq_len * self.dim + cls
         head = self.dim * self.num_classes + self.num_classes
         return self.n_layers * per_layer + emb + head + 2 * self.dim
 

@@ -133,9 +133,19 @@ class Algorithm(Trainable):
     def save_checkpoint(self) -> Any:
         # Always bundle the config so from_checkpoint can rebuild the
         # same env/net shapes regardless of what a subclass's
-        # get_state() includes.
+        # get_state() includes.  Non-picklable values (reward_fn
+        # lambdas etc.) are dropped — the caller passes those back via
+        # from_checkpoint(config=...).
         state = dict(self.get_state())
-        state.setdefault("config", self.config.to_dict())
+        if "config" not in state:
+            cfg = {}
+            for k, v in self.config.to_dict().items():
+                try:
+                    pickle.dumps(v)
+                except Exception:
+                    continue
+                cfg[k] = v
+            state["config"] = cfg
         return pickle.dumps(state)
 
     def load_checkpoint(self, checkpoint: Any) -> None:

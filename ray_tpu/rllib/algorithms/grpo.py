@@ -225,6 +225,12 @@ class GRPO(Algorithm):
     def sample(self, prompts: jnp.ndarray, key=None) -> jnp.ndarray:
         """Greedy-temperature sampling with the current policy."""
         cfg = self.config
+        prompts = jnp.asarray(prompts)
+        if prompts.shape[1] != cfg.prompt_len:
+            raise ValueError(
+                f"prompts width {prompts.shape[1]} != config.prompt_len "
+                f"{cfg.prompt_len} — _sample indexes by prompt_len"
+            )
         st = _Static(cfg.prompt_len, cfg.max_new_tokens, cfg.group_size,
                      cfg.num_prompts, cfg.temperature, cfg.clip_param,
                      cfg.kl_coef, cfg.num_epochs)

@@ -1,0 +1,83 @@
+"""Structured export events: JSONL event files per source.
+
+Parity: ray: src/ray/util/event.h (RayEvent / EventManager — structured
+events with severity/label/source appended to per-source files under
+the session's ``logs/events`` dir, consumed by the dashboard event
+module) and python/ray/_private/event/event_logger.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
+
+
+class EventLogger:
+    def __init__(self, event_dir: str, source: str):
+        self.source = source
+        os.makedirs(event_dir, exist_ok=True)
+        self._path = os.path.join(
+            event_dir, f"event_{source}.log"
+        )
+        self._lock = threading.Lock()
+
+    def emit(self, severity: str, label: str, message: str,
+             **custom_fields: Any) -> Dict[str, Any]:
+        if severity not in SEVERITIES:
+            raise ValueError(f"severity {severity!r} not in {SEVERITIES}")
+        event = {
+            "event_id": uuid.uuid4().hex,
+            "source_type": self.source,
+            "severity": severity,
+            "label": label,
+            "message": message,
+            "timestamp": time.time(),
+            "pid": os.getpid(),
+            "custom_fields": custom_fields,
+        }
+        with self._lock:
+            with open(self._path, "a") as f:
+                f.write(json.dumps(event) + "\n")
+        return event
+
+    def debug(self, label, message, **kw):
+        return self.emit("DEBUG", label, message, **kw)
+
+    def info(self, label, message, **kw):
+        return self.emit("INFO", label, message, **kw)
+
+    def warning(self, label, message, **kw):
+        return self.emit("WARNING", label, message, **kw)
+
+    def error(self, label, message, **kw):
+        return self.emit("ERROR", label, message, **kw)
+
+
+def read_events(event_dir: str,
+                source: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All events from a dir, oldest first (parity: the dashboard event
+    module's file scan)."""
+    out: List[Dict[str, Any]] = []
+    if not os.path.isdir(event_dir):
+        return out
+    for name in sorted(os.listdir(event_dir)):
+        if not name.startswith("event_"):
+            continue
+        if source is not None and name != f"event_{source}.log":
+            continue
+        with open(os.path.join(event_dir, name)) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+    out.sort(key=lambda e: e["timestamp"])
+    return out

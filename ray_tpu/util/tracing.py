@@ -1,0 +1,118 @@
+"""Distributed tracing: spans propagated through remote calls.
+
+Parity: the reference's OpenTelemetry integration (ray:
+python/ray/util/tracing/tracing_helper.py —
+_inject_tracing_into_function:326 wraps every remote function so the
+caller's span context rides inside task metadata and the worker opens
+a child span; opt-in via RAY_TRACING_ENABLED / ray.init tracing hook).
+
+Self-contained tracer (no opentelemetry dependency): spans carry
+(trace_id, span_id, parent_id, name, start/end, attributes), finished
+spans land in a bounded in-memory buffer and optionally a JSONL file.
+The runtime calls ``capture_context()`` at submit time and
+``activate(ctx)`` around execution — the exact two hook points the
+reference's propagator uses.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_enabled = False
+_lock = threading.Lock()
+_finished: "collections.deque" = collections.deque(maxlen=10000)
+_export_path: Optional[str] = None
+_tls = threading.local()
+
+
+def enable_tracing(export_file: Optional[str] = None) -> None:
+    """Turn tracing on (parity: RAY_TRACING_ENABLED +
+    _tracing_startup_hook)."""
+    global _enabled, _export_path
+    _enabled = True
+    _export_path = export_file
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def finished_spans() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_finished)
+
+
+def clear() -> None:
+    with _lock:
+        _finished.clear()
+
+
+def _current() -> Optional[Dict[str, str]]:
+    return getattr(_tls, "ctx", None)
+
+
+def capture_context() -> Optional[Dict[str, str]]:
+    """Snapshot the caller's span context for injection into a task
+    (parity: the serialized span context in task metadata)."""
+    if not _enabled:
+        return None
+    cur = _current()
+    if cur is None:
+        # Root: start a fresh trace at the call boundary.
+        return {"trace_id": uuid.uuid4().hex, "span_id": ""}
+    return {"trace_id": cur["trace_id"], "span_id": cur["span_id"]}
+
+
+@contextlib.contextmanager
+def span(name: str, ctx: Optional[Dict[str, str]] = None,
+         attributes: Optional[Dict[str, Any]] = None):
+    """Open a span; ``ctx`` (from capture_context) makes it a child of
+    the remote caller's span."""
+    if not _enabled:
+        yield None
+        return
+    parent = ctx if ctx is not None else _current()
+    rec = {
+        "trace_id": (parent or {}).get("trace_id") or uuid.uuid4().hex,
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_id": (parent or {}).get("span_id") or "",
+        "name": name,
+        "start": time.time(),
+        "attributes": dict(attributes or {}),
+    }
+    prev = _current()
+    _tls.ctx = {"trace_id": rec["trace_id"], "span_id": rec["span_id"]}
+    try:
+        yield rec
+    except BaseException as e:
+        rec["attributes"]["error"] = repr(e)
+        raise
+    finally:
+        rec["end"] = time.time()
+        _tls.ctx = prev
+        with _lock:
+            _finished.append(rec)
+            if _export_path:
+                try:
+                    with open(_export_path, "a") as f:
+                        f.write(json.dumps(rec) + "\n")
+                except OSError:
+                    pass
+
+
+def task_span(name: str, ctx: Optional[Dict[str, str]],
+              attributes: Optional[Dict[str, Any]] = None):
+    """Span for one task execution on a worker thread (parity: the
+    server-side wrapper in tracing_helper)."""
+    return span(name, ctx=ctx, attributes=attributes)

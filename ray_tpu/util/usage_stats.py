@@ -1,0 +1,84 @@
+"""Usage stats: opt-out local usage reporting.
+
+Parity: ray: python/ray/_private/usage/usage_lib.py — feature-tag
+recording (record_extra_usage_tag:190), a periodic ``UsageReportClient``
+(:806) that assembles a cluster usage payload.  This build has zero
+egress, so the "report" is written to a local JSON file instead of
+posted; the opt-out knob matches the reference's
+RAY_USAGE_STATS_ENABLED semantics (RAYTPU_USAGE_STATS_ENABLED=0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict
+
+import ray_tpu
+
+_lock = threading.Lock()
+_tags: Dict[str, str] = {}
+_counters: Dict[str, int] = {}
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAYTPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    """Feature-usage breadcrumb (parity: record_extra_usage_tag —
+    libraries call this to mark feature use)."""
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _tags[str(key)] = str(value)
+
+
+def record_library_usage(library: str) -> None:
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _counters[library] = _counters.get(library, 0) + 1
+
+
+def generate_report() -> Dict[str, Any]:
+    """Assemble the usage payload (parity: the UsageStats proto fields
+    that make sense without a cloud endpoint)."""
+    report: Dict[str, Any] = {
+        "schema_version": "0.1",
+        "source": "ray_tpu",
+        "collect_timestamp_ms": int(time.time() * 1000),
+        "version": ray_tpu.__version__,
+        "usage_stats_enabled": usage_stats_enabled(),
+    }
+    with _lock:
+        report["extra_usage_tags"] = dict(_tags)
+        report["library_usages"] = dict(_counters)
+    try:
+        from ray_tpu.core import api
+
+        if api.is_initialized():
+            rt = api.runtime()
+            report["total_num_nodes"] = sum(
+                1 for n in rt.nodes() if n["Alive"]
+            )
+            report["cluster_resources"] = rt.cluster_resources()
+    except Exception:
+        pass
+    return report
+
+
+def write_report(path: str) -> Dict[str, Any]:
+    report = generate_report()
+    if usage_stats_enabled():
+        with open(path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+def reset() -> None:
+    with _lock:
+        _tags.clear()
+        _counters.clear()

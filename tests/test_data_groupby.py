@@ -1,0 +1,62 @@
+"""Dataset.groupby (parity: data/grouped_data.py over the hash-exchange
+aggregate shuffle)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _table(rt):
+    rows = [{"cat": ["a", "b", "a", "c", "b", "a"][i], "x": float(i)}
+            for i in range(6)]
+    return rdata.from_items(rows)
+
+
+def test_groupby_count(rt):
+    out = _table(rt).groupby("cat").count().take_all()
+    counts = {r["cat"]: r["count()"] for r in out}
+    assert counts == {"a": 3, "b": 2, "c": 1}
+
+
+def test_groupby_sum_mean_max(rt):
+    ds = _table(rt)
+    sums = {r["cat"]: r["sum(x)"]
+            for r in ds.groupby("cat").sum("x").take_all()}
+    assert sums == {"a": 0 + 2 + 5, "b": 1 + 4, "c": 3}
+    means = {r["cat"]: r["mean(x)"]
+             for r in ds.groupby("cat").mean("x").take_all()}
+    assert means["b"] == pytest.approx(2.5)
+    maxes = {r["cat"]: r["max(x)"]
+             for r in ds.groupby("cat").max("x").take_all()}
+    assert maxes == {"a": 5.0, "b": 4.0, "c": 3.0}
+
+
+def test_groupby_map_groups(rt):
+    def normalize(group):
+        x = group["x"]
+        return {"cat": group["cat"], "x_centered": x - x.mean()}
+
+    out = _table(rt).groupby("cat").map_groups(normalize).take_all()
+    a_rows = sorted(r["x_centered"] for r in out if r["cat"] == "a")
+    np.testing.assert_allclose(a_rows, sorted(
+        np.array([0, 2, 5]) - np.mean([0, 2, 5])
+    ))
+    assert len(out) == 6  # one output row per input row
+
+
+def test_groupby_survives_shuffle_and_many_blocks(rt):
+    rows = [{"k": str(i % 7), "v": 1} for i in range(100)]
+    ds = rdata.from_items(rows, parallelism=8).random_shuffle(seed=0)
+    out = ds.groupby("k").sum("v").take_all()
+    total = {r["k"]: r["sum(v)"] for r in out}
+    for i in range(7):
+        assert total[str(i)] == len([r for r in rows if r["k"] == str(i)])

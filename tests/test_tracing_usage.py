@@ -1,0 +1,119 @@
+"""Tracing, usage stats, structured export events (parity:
+util/tracing/tracing_helper.py, _private/usage/usage_lib.py,
+src/ray/util/event.h)."""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import export_events, tracing, usage_stats
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    tracing.clear()
+    yield
+    tracing.disable_tracing()
+    ray_tpu.shutdown()
+
+
+def test_tracing_disabled_is_noop(rt):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    assert tracing.finished_spans() == []
+
+
+def test_task_spans_parented_to_caller(rt):
+    tracing.enable_tracing()
+
+    @ray_tpu.remote
+    def child():
+        return 1
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get(child.remote())
+
+    with tracing.span("driver"):
+        assert ray_tpu.get(parent.remote()) == 1
+
+    spans = {s["name"]: s for s in tracing.finished_spans()}
+    assert {"driver", "parent", "child"} <= set(spans)
+    # One trace end-to-end; child hangs off parent's span.
+    assert spans["parent"]["trace_id"] == spans["driver"]["trace_id"]
+    assert spans["child"]["trace_id"] == spans["driver"]["trace_id"]
+    assert spans["child"]["parent_id"] == spans["parent"]["span_id"]
+    assert spans["parent"]["parent_id"] == spans["driver"]["span_id"]
+    assert spans["child"]["end"] >= spans["child"]["start"]
+
+
+def test_actor_method_spans(rt):
+    tracing.enable_tracing()
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return "ok"
+
+    a = A.remote()
+    with tracing.span("root"):
+        assert ray_tpu.get(a.m.remote()) == "ok"
+    spans = {s["name"]: s for s in tracing.finished_spans()}
+    assert spans["A.m"]["trace_id"] == spans["root"]["trace_id"]
+
+
+def test_span_error_recorded(rt):
+    tracing.enable_tracing()
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("nope")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+    spans = [s for s in tracing.finished_spans() if s["name"] == "boom"]
+    assert spans and "nope" in spans[0]["attributes"]["error"]
+
+
+def test_tracing_export_file(rt, tmp_path):
+    out = tmp_path / "spans.jsonl"
+    tracing.enable_tracing(str(out))
+    with tracing.span("exported"):
+        pass
+    lines = [json.loads(x) for x in out.read_text().splitlines()]
+    assert lines[0]["name"] == "exported"
+
+
+def test_usage_stats(rt, tmp_path, monkeypatch):
+    usage_stats.reset()
+    usage_stats.record_extra_usage_tag("train_backend", "jax")
+    usage_stats.record_library_usage("data")
+    usage_stats.record_library_usage("data")
+    report = usage_stats.write_report(str(tmp_path / "usage.json"))
+    assert report["extra_usage_tags"]["train_backend"] == "jax"
+    assert report["library_usages"]["data"] == 2
+    assert report["total_num_nodes"] == 1
+    assert (tmp_path / "usage.json").exists()
+
+    monkeypatch.setenv("RAYTPU_USAGE_STATS_ENABLED", "0")
+    usage_stats.reset()
+    usage_stats.record_extra_usage_tag("k", "v")
+    assert usage_stats.generate_report()["extra_usage_tags"] == {}
+
+
+def test_export_events(tmp_path):
+    log = export_events.EventLogger(str(tmp_path), "raylet")
+    log.info("NODE_ADDED", "node joined", node_id="abc")
+    log.error("NODE_DIED", "node lost")
+    with pytest.raises(ValueError):
+        log.emit("LOUD", "X", "bad severity")
+    events = export_events.read_events(str(tmp_path))
+    assert [e["label"] for e in events] == ["NODE_ADDED", "NODE_DIED"]
+    assert events[0]["custom_fields"]["node_id"] == "abc"
+    assert export_events.read_events(str(tmp_path), source="raylet")
+    assert export_events.read_events(str(tmp_path), source="other") == []
