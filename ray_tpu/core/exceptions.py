@@ -31,6 +31,14 @@ class TaskError(RayTpuError):
             f"--- remote traceback ---\n{self.remote_tb}"
         )
 
+    def __reduce__(self):
+        # Exception pickling replays __init__ with self.args (the
+        # formatted message) — rebuild from the real fields instead so
+        # TaskError survives the client-mode wire (parity: RayTaskError
+        # is serializable).
+        return (type(self),
+                (self.function_name, self.cause, self.remote_tb))
+
 
 class ActorError(RayTpuError):
     pass

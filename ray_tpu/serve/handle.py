@@ -76,22 +76,27 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, app_name: str,
                  method_name: str = "__call__",
-                 assign_timeout_s: Optional[float] = None):
+                 assign_timeout_s: Optional[float] = None,
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
         # None = wait for a free replica slot indefinitely (backpressure,
         # the reference's behavior); a number bounds the wait.
         self._assign_timeout_s = assign_timeout_s
+        self._multiplexed_model_id = multiplexed_model_id
 
     def options(self, *, method_name: Optional[str] = None,
-                assign_timeout_s: Optional[float] = None
+                assign_timeout_s: Optional[float] = None,
+                multiplexed_model_id: Optional[str] = None
                 ) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self.app_name,
             method_name or self._method_name,
             (assign_timeout_s if assign_timeout_s is not None
              else self._assign_timeout_s),
+            (multiplexed_model_id if multiplexed_model_id is not None
+             else self._multiplexed_model_id),
         )
 
     def __getattr__(self, name: str):
@@ -99,7 +104,8 @@ class DeploymentHandle:
             raise AttributeError(name)
         # handle.method.remote(...) sugar (parity: handle method access)
         return DeploymentHandle(self.deployment_name, self.app_name, name,
-                                self._assign_timeout_s)
+                                self._assign_timeout_s,
+                                self._multiplexed_model_id)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         args = tuple(self._unwrap(a) for a in args)
@@ -107,6 +113,7 @@ class DeploymentHandle:
         router = _router_for(self.app_name, self.deployment_name)
         method = self._method_name
         timeout = self._assign_timeout_s
+        model_id = self._multiplexed_model_id
         dead: set = set()
         last = [None]
 
@@ -114,7 +121,8 @@ class DeploymentHandle:
             if last[0] is not None:
                 dead.add(last[0])
             ref, replica_id = router.assign(
-                method, args, kwargs, timeout=timeout, exclude=dead
+                method, args, kwargs, timeout=timeout, exclude=dead,
+                model_id=model_id,
             )
             last[0] = replica_id
             return ref
@@ -137,5 +145,5 @@ class DeploymentHandle:
         return (
             DeploymentHandle,
             (self.deployment_name, self.app_name, self._method_name,
-             self._assign_timeout_s),
+             self._assign_timeout_s, self._multiplexed_model_id),
         )

@@ -1,0 +1,116 @@
+"""Model multiplexing: many models per deployment, LRU per replica.
+
+Parity: ray: python/ray/serve/multiplex.py (``@serve.multiplexed`` with
+``max_num_models_per_replica``, ``serve.get_multiplexed_model_id``,
+model-aware routing in _private/replica_scheduler).  A deployment
+hosts a loader method decorated ``@multiplexed``; requests carry a
+model id (``handle.options(multiplexed_model_id=...)``); the router
+keeps model→replica affinity so repeat requests land where the model
+is already resident, and each replica LRU-evicts beyond the cap.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import functools
+import inspect
+import threading
+from typing import Any, Callable, Optional
+
+_ATTR = "_serve_multiplexed_models"
+
+_current_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default=""
+)
+
+
+def get_multiplexed_model_id() -> str:
+    """Model id of the in-flight request (parity:
+    serve.get_multiplexed_model_id)."""
+    return _current_model_id.get()
+
+
+def _set_model_id(model_id: str):
+    return _current_model_id.set(model_id)
+
+
+def _reset_model_id(token) -> None:
+    _current_model_id.reset(token)
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorate a model-loader method ``def get_model(self, model_id)``
+    (sync or async).  Calls are LRU-cached per replica instance up to
+    ``max_num_models_per_replica``; eviction drops the oldest model
+    (its __del__, if any, releases resources — parity with the
+    reference's eviction calling the model's destructor)."""
+
+    if max_num_models_per_replica < 1:
+        raise ValueError("max_num_models_per_replica must be >= 1")
+
+    def decorate(loader: Callable) -> Callable:
+        lock = threading.Lock()
+
+        def _lookup(self, model_id: str):
+            with lock:
+                cache = getattr(self, _ATTR, None)
+                if cache is None:
+                    cache = collections.OrderedDict()
+                    setattr(self, _ATTR, cache)
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache, cache[model_id], True
+                return cache, None, False
+
+        def _admit(cache, model_id: str, model):
+            with lock:
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                while len(cache) > max_num_models_per_replica:
+                    cache.popitem(last=False)  # LRU eviction
+
+        if inspect.iscoroutinefunction(loader):
+            # Async loader → async wrapper, awaitable from async
+            # deployments (parity: the reference's multiplexed wrapper
+            # is async-native).
+            @functools.wraps(loader)
+            async def awrapper(self, model_id: str):
+                cache, model, hit = _lookup(self, model_id)
+                if hit:
+                    return model
+                model = await loader(self, model_id)
+                _admit(cache, model_id, model)
+                return model
+
+            awrapper.__serve_multiplexed__ = True
+            return awrapper
+
+        @functools.wraps(loader)
+        def wrapper(self, model_id: str):
+            cache, model, hit = _lookup(self, model_id)
+            if hit:
+                return model
+            model = loader(self, model_id)
+            if inspect.iscoroutine(model):
+                raise TypeError(
+                    "loader returned a coroutine from a sync wrapper — "
+                    "declare it `async def` so @multiplexed builds the "
+                    "async wrapper"
+                )
+            _admit(cache, model_id, model)
+            return model
+
+        wrapper.__serve_multiplexed__ = True
+        return wrapper
+
+    if func is not None:
+        return decorate(func)
+    return decorate
+
+
+def loaded_model_ids(instance: Any) -> list:
+    """Model ids currently resident on a replica's user instance."""
+    cache = getattr(instance, _ATTR, None)
+    return list(cache) if cache else []

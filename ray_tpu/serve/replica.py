@@ -61,9 +61,11 @@ class ReplicaActor:
 
     # -- data plane --------------------------------------------------------
 
-    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict,
+                       metadata: dict = None):
         from ray_tpu.core import api
         from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.serve import multiplex as _mux
 
         # Upstream DeploymentResponses arrive as refs nested inside the
         # args tuple — resolve them here (parity: the reference resolves
@@ -78,6 +80,9 @@ class ReplicaActor:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        mux_token = _mux._set_model_id(
+            (metadata or {}).get("multiplexed_model_id", "")
+        )
         try:
             if method_name == "__call__":
                 if not callable(self._callable):
@@ -96,6 +101,7 @@ class ReplicaActor:
                 result = asyncio.run(result)
             return result
         finally:
+            _mux._reset_model_id(mux_token)
             with self._lock:
                 self._ongoing -= 1
 

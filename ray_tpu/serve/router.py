@@ -38,6 +38,10 @@ class Router:
         self._cv = threading.Condition(self._lock)
         self._replicas: Dict[str, _ReplicaInfo] = {}
         self._outstanding: Dict[ObjectRef, str] = {}
+        # Multiplexing affinity: model_id → replica_id of the replica
+        # that last served it (parity: the reference's model-aware
+        # replica scheduler preferring replicas with the model resident).
+        self._model_affinity: Dict[str, str] = {}
         self._stopped = threading.Event()
         self._client = None
         self._subscribe()
@@ -80,7 +84,8 @@ class Router:
 
     def assign(self, method_name: str, args: tuple, kwargs: dict,
                timeout: Optional[float] = None,
-               exclude: Optional[set] = None) -> Tuple[ObjectRef, str]:
+               exclude: Optional[set] = None,
+               model_id: str = "") -> Tuple[ObjectRef, str]:
         """Pick a replica (power of two choices on in-flight counts,
         respecting max_ongoing_requests backpressure) and submit.
         ``exclude``: replica ids observed dead by the caller — never
@@ -96,9 +101,19 @@ class Router:
                     and (not exclude or r.replica_id not in exclude)
                 ]
                 if candidates:
-                    if len(candidates) > 2:
-                        candidates = random.sample(candidates, 2)
-                    chosen = min(candidates, key=lambda r: r.inflight)
+                    chosen = None
+                    if model_id:
+                        # Sticky multiplexed routing: prefer the replica
+                        # that already holds this model, if it has slack.
+                        sticky = self._model_affinity.get(model_id)
+                        chosen = next((r for r in candidates
+                                       if r.replica_id == sticky), None)
+                    if chosen is None:
+                        if len(candidates) > 2:
+                            candidates = random.sample(candidates, 2)
+                        chosen = min(candidates, key=lambda r: r.inflight)
+                    if model_id:
+                        self._model_affinity[model_id] = chosen.replica_id
                     chosen.inflight += 1
                     break
                 remaining = (
@@ -110,7 +125,9 @@ class Router:
                         f"available within {timeout}s"
                     )
                 self._cv.wait(0.05 if remaining is None else min(remaining, 0.05))
-        ref = chosen.handle.handle_request.remote(method_name, args, kwargs)
+        metadata = {"multiplexed_model_id": model_id} if model_id else None
+        ref = chosen.handle.handle_request.remote(method_name, args, kwargs,
+                                                  metadata)
         with self._cv:
             self._outstanding[ref] = chosen.replica_id
         return ref, chosen.replica_id

@@ -1,0 +1,24 @@
+"""Remote-driver client mode ("ray client").
+
+Parity: the reference's Ray Client (ray: python/ray/util/client/ —
+client worker.py, server/proxier.py multiplexing many drivers onto one
+cluster over gRPC, protocol protobuf/ray_client.proto, design doc
+util/client/ARCHITECTURE.md): a thin driver in one process drives a
+cluster living in another process.  Here the transport is a
+length-prefixed cloudpickle protocol over TCP (no gRPC dependency);
+the server hosts the real runtime, the client holds proxy refs.
+
+    # server process
+    python -m ray_tpu.util.client.server --port 10001
+
+    # driver process
+    from ray_tpu.util.client import connect
+    ctx = connect("127.0.0.1:10001")
+    ref = ctx.remote(fn).remote(3)
+    ctx.get(ref)
+"""
+
+from ray_tpu.util.client.client import ClientContext, connect
+from ray_tpu.util.client.server import ClientServer
+
+__all__ = ["ClientContext", "ClientServer", "connect"]

@@ -1,0 +1,207 @@
+"""Client-mode driver: thin proxy of the core API over the wire.
+
+Parity: ray: python/ray/util/client/worker.py (the client-side Worker
+translating ray.get/put/remote into protocol calls) + api.py's
+ClientAPI surface.  ``connect(address)`` returns a ``ClientContext``
+exposing remote/get/put/wait/kill/cluster_resources; refs are
+``ClientObjectRef`` proxies naming server-side objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import threading
+from typing import Any, List, Optional, Sequence, Union
+
+from ray_tpu.util.client.common import recv_msg, send_msg
+
+
+@dataclasses.dataclass(frozen=True)
+class _RefPlaceholder:
+    """Wire form of a ref inside task args (parity: the client arg
+    encoding in ray_client.proto Arg)."""
+
+    id: bytes
+
+
+class ClientObjectRef:
+    def __init__(self, ctx: "ClientContext", binary_id: bytes):
+        self._ctx = ctx
+        self._id = binary_id
+
+    @property
+    def binary_id(self) -> bytes:
+        return self._id
+
+    def __repr__(self):
+        return f"ClientObjectRef({self._id.hex()[:16]})"
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return (isinstance(other, ClientObjectRef)
+                and other._id == self._id)
+
+
+class ClientRemoteFunction:
+    def __init__(self, ctx: "ClientContext", fn, options: dict):
+        self._ctx = ctx
+        self._fn = fn
+        self._options = options
+
+    def options(self, **overrides) -> "ClientRemoteFunction":
+        return ClientRemoteFunction(self._ctx, self._fn,
+                                    {**self._options, **overrides})
+
+    def remote(self, *args, **kwargs):
+        ids = self._ctx._call("task", fn=self._fn, options=self._options,
+                              args=self._ctx._encode_args(args),
+                              kwargs=self._ctx._encode_args(kwargs))
+        refs = [ClientObjectRef(self._ctx, b) for b in ids]
+        return refs[0] if len(refs) == 1 else refs
+
+
+class ClientActorHandle:
+    def __init__(self, ctx: "ClientContext", actor_id: bytes):
+        self._ctx = ctx
+        self._actor_id = actor_id
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClientActorMethod(self._ctx, self._actor_id, name)
+
+
+class _ClientActorMethod:
+    def __init__(self, ctx: "ClientContext", actor_id: bytes, name: str):
+        self._ctx = ctx
+        self._actor_id = actor_id
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        ids = self._ctx._call(
+            "actor_method", actor_id=self._actor_id, method=self._name,
+            args=self._ctx._encode_args(args),
+            kwargs=self._ctx._encode_args(kwargs),
+        )
+        refs = [ClientObjectRef(self._ctx, b) for b in ids]
+        return refs[0] if len(refs) == 1 else refs
+
+
+class ClientActorClass:
+    def __init__(self, ctx: "ClientContext", cls: type, options: dict):
+        self._ctx = ctx
+        self._cls = cls
+        self._options = options
+
+    def options(self, **overrides) -> "ClientActorClass":
+        return ClientActorClass(self._ctx, self._cls,
+                                {**self._options, **overrides})
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        aid = self._ctx._call(
+            "create_actor", cls=self._cls, options=self._options,
+            args=self._ctx._encode_args(args),
+            kwargs=self._ctx._encode_args(kwargs),
+        )
+        return ClientActorHandle(self._ctx, aid)
+
+
+class ClientContext:
+    """One connection to a client server (parity: the global client
+    worker after ray.init(address='ray://...'))."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()  # one in-flight request at a time
+        info = self._call("ping")
+        self.server_version = info["version"]
+
+    # -- transport ---------------------------------------------------------
+
+    def _call(self, op: str, **payload) -> Any:
+        with self._lock:
+            send_msg(self._sock, {"op": op, **payload})
+            reply = recv_msg(self._sock)
+        if not reply["ok"]:
+            raise reply["error"]
+        return reply["value"]
+
+    def _encode_args(self, tree):
+        def walk(v):
+            if isinstance(v, ClientObjectRef):
+                return _RefPlaceholder(v.binary_id)
+            if isinstance(v, (list, tuple)):
+                return type(v)(walk(x) for x in v)
+            if isinstance(v, dict):
+                return {k: walk(x) for k, x in v.items()}
+            return v
+
+        if isinstance(tree, dict):
+            return {k: walk(v) for k, v in tree.items()}
+        return tuple(walk(v) for v in tree)
+
+    # -- API ---------------------------------------------------------------
+
+    def remote(self, target=None, **options):
+        import inspect
+
+        def make(t):
+            if inspect.isclass(t):
+                return ClientActorClass(self, t, options)
+            return ClientRemoteFunction(self, t, options)
+
+        if target is not None:
+            return make(target)
+        return make
+
+    def put(self, value: Any) -> ClientObjectRef:
+        return ClientObjectRef(self, self._call("put", value=value))
+
+    def get(self, refs: Union[ClientObjectRef, Sequence[ClientObjectRef]],
+            *, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        ref_list = [refs] if single else list(refs)
+        values = self._call("get", ids=[r.binary_id for r in ref_list],
+                            timeout=timeout)
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ClientObjectRef], *, num_returns: int = 1,
+             timeout: Optional[float] = None):
+        ready_ids, pending_ids = self._call(
+            "wait", ids=[r.binary_id for r in refs],
+            num_returns=num_returns, timeout=timeout,
+        )
+        by_id = {r.binary_id: r for r in refs}
+        return ([by_id[b] for b in ready_ids],
+                [by_id[b] for b in pending_ids])
+
+    def kill(self, actor: ClientActorHandle, *, no_restart: bool = True):
+        self._call("kill_actor", actor_id=actor._actor_id,
+                   no_restart=no_restart)
+
+    def cluster_resources(self):
+        return self._call("cluster_resources")
+
+    def available_resources(self):
+        return self._call("available_resources")
+
+    def release(self, ref: ClientObjectRef) -> None:
+        self._call("release", id=ref.binary_id)
+
+    def disconnect(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def connect(address: str, **kwargs) -> ClientContext:
+    """Connect to a running client server (parity:
+    ray.init(address="ray://host:port"))."""
+    return ClientContext(address, **kwargs)

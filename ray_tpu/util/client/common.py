@@ -1,0 +1,43 @@
+"""Wire protocol for client mode: length-prefixed cloudpickle frames.
+
+Parity: the message surface of ray_client.proto (DataRequest/Response —
+put/get/wait/task/actor/terminate ops), collapsed to a minimal framed
+dict protocol (this build avoids a gRPC dependency; see
+util/client/__init__.py).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any
+
+import cloudpickle
+
+_LEN = struct.Struct(">Q")
+MAX_FRAME = 1 << 31
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = cloudpickle.dumps(obj)
+    if len(payload) > MAX_FRAME:
+        raise ValueError(f"frame too large: {len(payload)}")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _LEN.size)
+    (size,) = _LEN.unpack(header)
+    if size > MAX_FRAME:
+        raise ValueError(f"frame too large: {size}")
+    return cloudpickle.loads(_recv_exact(sock, size))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed the connection")
+        buf.extend(chunk)
+    return bytes(buf)

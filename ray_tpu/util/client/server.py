@@ -1,0 +1,220 @@
+"""Client-mode server: hosts the runtime for remote drivers.
+
+Parity: ray: python/ray/util/client/server/ — the proxier/server
+accepting many client connections (proxier.py:410), translating client
+ops onto the real cluster, and releasing a client's references when it
+disconnects (client GC).  One thread per connection; ObjectRefs and
+actor handles cross the wire as ids and are re-hydrated server-side.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.util.client.common import recv_msg, send_msg
+
+
+class _ClientSession:
+    """Server-side state for one connected driver (parity: per-client
+    state in the proxier)."""
+
+    def __init__(self):
+        self.refs: Dict[bytes, Any] = {}        # object_id → ObjectRef
+        self.actors: Dict[bytes, Any] = {}      # actor_id → ActorHandle
+
+
+class ClientServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 num_cpus: Optional[float] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(num_cpus=num_cpus, ignore_reinit_error=True)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._sock.getsockname()[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "ClientServer":
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="client-server", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def serve_forever(self) -> None:
+        self.start()
+        self._stopped.wait()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_client, args=(conn,), daemon=True,
+                name="client-conn",
+            ).start()
+
+    def _serve_client(self, conn: socket.socket) -> None:
+        session = _ClientSession()
+        try:
+            while True:
+                try:
+                    msg = recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    reply = {"ok": True,
+                             "value": self._handle(session, msg)}
+                except BaseException as e:
+                    reply = {"ok": False, "error": e}
+                try:
+                    send_msg(conn, reply)
+                except (ConnectionError, OSError):
+                    return
+                except Exception as e:
+                    # Unpicklable value/exception: degrade to an error
+                    # reply instead of killing the whole session.
+                    try:
+                        send_msg(conn, {
+                            "ok": False,
+                            "error": RuntimeError(
+                                f"reply not serializable: {e!r}"
+                            ),
+                        })
+                    except (ConnectionError, OSError):
+                        return
+        finally:
+            conn.close()
+
+    # -- op dispatch -------------------------------------------------------
+
+    def _handle(self, session: _ClientSession, msg: Dict[str, Any]) -> Any:
+        import ray_tpu
+        from ray_tpu.core.object_ref import ObjectRef
+
+        op = msg["op"]
+        if op == "ping":
+            return {"version": ray_tpu.__version__}
+        if op == "put":
+            ref = ray_tpu.put(msg["value"])
+            session.refs[ref.id.binary()] = ref
+            return ref.id.binary()
+        if op == "get":
+            refs = [session.refs.get(b) or self._rehydrate(b)
+                    for b in msg["ids"]]
+            return ray_tpu.get(refs, timeout=msg.get("timeout"))
+        if op == "wait":
+            refs = [session.refs.get(b) or self._rehydrate(b)
+                    for b in msg["ids"]]
+            ready, pending = ray_tpu.wait(
+                refs, num_returns=msg["num_returns"],
+                timeout=msg.get("timeout"),
+            )
+            return ([r.id.binary() for r in ready],
+                    [r.id.binary() for r in pending])
+        if op == "task":
+            fn = msg["fn"]
+            options = msg.get("options") or {}
+            args = self._resolve_args(session, msg["args"])
+            kwargs = self._resolve_args(session, msg["kwargs"])
+            remote_fn = ray_tpu.remote(**options)(fn) if options \
+                else ray_tpu.remote(fn)
+            out = remote_fn.remote(*args, **kwargs)
+            out_list = out if isinstance(out, list) else [out]
+            for r in out_list:
+                session.refs[r.id.binary()] = r
+            return [r.id.binary() for r in out_list]
+        if op == "create_actor":
+            cls = msg["cls"]
+            options = msg.get("options") or {}
+            args = self._resolve_args(session, msg["args"])
+            kwargs = self._resolve_args(session, msg["kwargs"])
+            actor_cls = ray_tpu.remote(**options)(cls) if options \
+                else ray_tpu.remote(cls)
+            handle = actor_cls.remote(*args, **kwargs)
+            aid = handle._actor_id.binary()
+            session.actors[aid] = handle
+            return aid
+        if op == "actor_method":
+            handle = session.actors[msg["actor_id"]]
+            args = self._resolve_args(session, msg["args"])
+            kwargs = self._resolve_args(session, msg["kwargs"])
+            out = getattr(handle, msg["method"]).remote(*args, **kwargs)
+            out_list = out if isinstance(out, list) else [out]
+            for r in out_list:
+                session.refs[r.id.binary()] = r
+            return [r.id.binary() for r in out_list]
+        if op == "kill_actor":
+            handle = session.actors.pop(msg["actor_id"], None)
+            if handle is not None:
+                ray_tpu.kill(handle,
+                             no_restart=msg.get("no_restart", True))
+            return None
+        if op == "cluster_resources":
+            return ray_tpu.cluster_resources()
+        if op == "available_resources":
+            return ray_tpu.available_resources()
+        if op == "release":
+            session.refs.pop(msg["id"], None)
+            return None
+        raise ValueError(f"unknown client op {op!r}")
+
+    @staticmethod
+    def _rehydrate(binary_id: bytes):
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.utils.ids import ObjectID
+
+        return ObjectRef(ObjectID(binary_id))
+
+    def _resolve_args(self, session: _ClientSession, tree):
+        """Client-side ref placeholders → server-side ObjectRefs."""
+        from ray_tpu.util.client.client import _RefPlaceholder
+
+        def walk(v):
+            if isinstance(v, _RefPlaceholder):
+                return session.refs.get(v.id) or self._rehydrate(v.id)
+            if isinstance(v, (list, tuple)):
+                return type(v)(walk(x) for x in v)
+            if isinstance(v, dict):
+                return {k: walk(x) for k, x in v.items()}
+            return v
+
+        if isinstance(tree, dict):
+            return {k: walk(v) for k, v in tree.items()}
+        return tuple(walk(v) for v in tree)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="ray_tpu client server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=10001)
+    parser.add_argument("--num-cpus", type=float, default=None)
+    args = parser.parse_args()
+    server = ClientServer(args.host, args.port, num_cpus=args.num_cpus)
+    print(f"ray_tpu client server listening on {server.address}",
+          flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

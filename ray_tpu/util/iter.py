@@ -1,0 +1,213 @@
+"""Parallel iterators over actor shards.
+
+Parity: ray: python/ray/util/iter.py — ``from_items``/``from_range``/
+``from_iterators`` build a ``ParallelIterator`` of N shards hosted by
+actors; ``for_each``/``filter``/``batch``/``flatten`` compose lazily
+per shard; ``gather_sync``/``gather_async`` fetch results to the
+driver as a ``LocalIterator``; ``shuffle_local`` and ``union``
+combine streams.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+import ray_tpu
+
+
+class _ShardActor:
+    """Hosts one shard's item stream + its lazy transform chain."""
+
+    def __init__(self, make_iterable):
+        self._make = make_iterable
+
+    def run(self, transforms) -> List[Any]:
+        out: Iterable = self._make()
+        for t in transforms:
+            out = t(out)
+        return list(out)
+
+
+def _apply_for_each(fn):
+    def t(it):
+        return (fn(x) for x in it)
+
+    return t
+
+
+def _apply_filter(fn):
+    def t(it):
+        return (x for x in it if fn(x))
+
+    return t
+
+
+def _apply_flatten():
+    def t(it):
+        return (y for x in it for y in x)
+
+    return t
+
+
+def _apply_batch(n):
+    def t(it):
+        batch: List[Any] = []
+        for x in it:
+            batch.append(x)
+            if len(batch) == n:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+    return t
+
+
+class LocalIterator:
+    """Driver-side iterator over gathered results (parity:
+    util/iter.py LocalIterator)."""
+
+    def __init__(self, gen_fn: Callable[[], Iterator[Any]]):
+        self._gen_fn = gen_fn
+
+    def __iter__(self):
+        return self._gen_fn()
+
+    def take(self, n: int) -> List[Any]:
+        out = []
+        for x in self:
+            out.append(x)
+            if len(out) >= n:
+                break
+        return out
+
+    def for_each(self, fn) -> "LocalIterator":
+        src = self._gen_fn
+        return LocalIterator(lambda: (fn(x) for x in src()))
+
+
+class ParallelIterator:
+    def __init__(self, actors: List[Any], transforms: List[Any],
+                 owns_actors: bool = False, keepalive: Any = None):
+        self._actors = actors
+        self._transforms = transforms
+        # Only the iterator returned by from_* owns the shard actors;
+        # derived iterators keep a reference to the owner (keepalive) so
+        # the owner's GC-time stop() can't fire while they're usable.
+        self._owns_actors = owns_actors
+        self._keepalive = keepalive
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._actors)
+
+    def stop(self) -> None:
+        """Kill the shard actors, releasing their resources (the
+        reference's iterators die with their actors' owner; an explicit
+        stop avoids leaking 0.5 CPU per shard)."""
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []
+
+    def __del__(self):
+        if getattr(self, "_owns_actors", False) and self._actors:
+            try:
+                self.stop()
+            except Exception:
+                pass
+
+    def _with(self, transform) -> "ParallelIterator":
+        return ParallelIterator(
+            self._actors, self._transforms + [transform],
+            keepalive=(self._keepalive or self),
+        )
+
+    def for_each(self, fn: Callable[[Any], Any]) -> "ParallelIterator":
+        return self._with(_apply_for_each(fn))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "ParallelIterator":
+        return self._with(_apply_filter(fn))
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return self._with(_apply_batch(n))
+
+    def flatten(self) -> "ParallelIterator":
+        return self._with(_apply_flatten())
+
+    def shuffle_local(self, seed: Optional[int] = None
+                      ) -> "ParallelIterator":
+        def t(it):
+            items = list(it)
+            random.Random(seed).shuffle(items)
+            return iter(items)
+
+        return self._with(t)
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        if self._transforms or other._transforms:
+            raise ValueError(
+                "union requires untransformed iterators — apply "
+                "for_each/filter after union (parity restriction)"
+            )
+        return ParallelIterator(
+            self._actors + other._actors, [],
+            keepalive=(self._keepalive or self,
+                       other._keepalive or other),
+        )
+
+    def _shard_refs(self) -> List[Any]:
+        return [a.run.remote(self._transforms) for a in self._actors]
+
+    def gather_sync(self) -> LocalIterator:
+        """Shard-order gather (parity: gather_sync)."""
+        refs = self._shard_refs()
+        keep = self._keepalive or self
+
+        def gen():
+            _ = keep  # pin the actor owner for the stream's lifetime
+            for ref in refs:
+                yield from ray_tpu.get(ref)
+
+        return LocalIterator(gen)
+
+    def gather_async(self) -> LocalIterator:
+        """Completion-order gather (parity: gather_async)."""
+        refs = self._shard_refs()
+        keep = self._keepalive or self
+
+        def gen():
+            _ = keep  # pin the actor owner for the stream's lifetime
+            pending = list(refs)
+            while pending:
+                ready, pending = ray_tpu.wait(pending, num_returns=1)
+                yield from ray_tpu.get(ready[0])
+
+        return LocalIterator(gen)
+
+    def __iter__(self):
+        return iter(self.gather_sync())
+
+
+def _make_shards(iterables: List[Callable[[], Iterable]]
+                 ) -> ParallelIterator:
+    cls = ray_tpu.remote(num_cpus=0.5)(_ShardActor)
+    return ParallelIterator([cls.remote(m) for m in iterables], [],
+                            owns_actors=True)
+
+
+def from_iterators(makers: List[Callable[[], Iterable]]
+                   ) -> ParallelIterator:
+    return _make_shards(list(makers))
+
+
+def from_items(items: List[Any], num_shards: int = 2) -> ParallelIterator:
+    shards = [items[i::num_shards] for i in range(num_shards)]
+    return _make_shards([lambda s=s: s for s in shards])
+
+
+def from_range(n: int, num_shards: int = 2) -> ParallelIterator:
+    return from_items(list(range(n)), num_shards)

@@ -1,0 +1,208 @@
+"""multiprocessing.Pool API over tasks/actors.
+
+Parity: ray: python/ray/util/multiprocessing/pool.py — a drop-in
+``Pool`` whose workers are actors, supporting apply/apply_async/map/
+map_async/imap/imap_unordered/starmap with chunking, so existing
+multiprocessing code scales onto the cluster unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import ray_tpu
+
+
+class AsyncResult:
+    """Handle for apply_async/map_async (parity: mp.pool.AsyncResult).
+    ``transform`` reshapes the raw chunk results locally (no extra
+    cluster round-trip)."""
+
+    def __init__(self, refs: List[Any],
+                 transform: Optional[Callable[[List[Any]], Any]] = None,
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None):
+        self._refs = refs
+        self._transform = transform
+        self._callback = callback
+        self._error_callback = error_callback
+        self._done = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        threading.Thread(target=self._wait_thread, daemon=True).start()
+
+    def _wait_thread(self):
+        try:
+            values = ray_tpu.get(self._refs)
+            self._value = (self._transform(values)
+                           if self._transform is not None else values)
+            if self._callback is not None:
+                self._callback(self._value)
+        except BaseException as e:
+            self._error = e
+            if self._error_callback is not None:
+                self._error_callback(e)
+        finally:
+            self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._done.wait(timeout)
+
+    def ready(self) -> bool:
+        return self._done.is_set()
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        return self._error is None
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        if not self._done.wait(timeout):
+            raise TimeoutError("result not ready in time")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _PoolActor:
+    """One pool worker (parity: the PoolActor in util/multiprocessing)."""
+
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_chunk(self, fn, chunk: List[tuple]) -> List[Any]:
+        return [fn(*args) for args in chunk]
+
+
+class Pool:
+    """Actor-backed process pool (parity: ray.util.multiprocessing.Pool)."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: Sequence = ()):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        if processes is None:
+            processes = max(1, int(ray_tpu.cluster_resources()
+                                   .get("CPU", 1)))
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._size = processes
+        cls = ray_tpu.remote(num_cpus=1)(_PoolActor)
+        self._actors = [cls.remote(initializer, tuple(initargs))
+                        for _ in range(processes)]
+        self._rr = itertools.cycle(self._actors)
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+        for a in self._actors:
+            ray_tpu.kill(a)
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("join() before close()")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    # -- apply -------------------------------------------------------------
+
+    def apply(self, fn: Callable, args: Sequence = (), kwds=None) -> Any:
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: Sequence = (), kwds=None,
+                    callback=None, error_callback=None) -> AsyncResult:
+        self._check_open()
+        kwds = kwds or {}
+        actor = next(self._rr)
+        ref = actor.run_chunk.remote(
+            lambda *a: fn(*a, **kwds), [tuple(args)]
+        )
+        return AsyncResult([ref], transform=lambda vals: vals[0][0],
+                           callback=callback,
+                           error_callback=error_callback)
+
+    # -- map ---------------------------------------------------------------
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int],
+                star: bool = False) -> List[List[tuple]]:
+        # map semantics pass each item as ONE argument (stdlib parity:
+        # map(len, [(1,2)]) calls len((1,2))); only starmap unpacks.
+        items = ([tuple(t) for t in iterable] if star
+                 else [(x,) for x in iterable])
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._size * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self._map_async(fn, iterable, chunksize, star=True).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None,
+                  callback=None, error_callback=None) -> AsyncResult:
+        return self._map_async(fn, iterable, chunksize, star=False,
+                               callback=callback,
+                               error_callback=error_callback)
+
+    def _map_async(self, fn, iterable, chunksize, *, star: bool,
+                   callback=None, error_callback=None) -> AsyncResult:
+        self._check_open()
+        chunks = self._chunks(iterable, chunksize, star=star)
+        refs = [next(self._rr).run_chunk.remote(fn, c) for c in chunks]
+        return AsyncResult(
+            refs, transform=lambda vals: [x for v in vals for x in v],
+            callback=callback, error_callback=error_callback,
+        )
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        """Ordered lazy iterator; work is submitted eagerly at call time
+        (parity: Pool.imap dispatches up front, yields as ready)."""
+        self._check_open()
+        chunks = self._chunks(iterable, chunksize)
+        refs = [next(self._rr).run_chunk.remote(fn, c) for c in chunks]
+
+        def gen():
+            for ref in refs:
+                for value in ray_tpu.get(ref):
+                    yield value
+
+        return gen()
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        """Completion-ordered iterator; submits eagerly like imap."""
+        self._check_open()
+        chunks = self._chunks(iterable, chunksize)
+        refs = [next(self._rr).run_chunk.remote(fn, c) for c in chunks]
+
+        def gen():
+            pending = list(refs)
+            while pending:
+                ready, pending = ray_tpu.wait(pending, num_returns=1)
+                for value in ray_tpu.get(ready[0]):
+                    yield value
+
+        return gen()

@@ -1,0 +1,113 @@
+"""Remote-driver client mode (parity: ray.util.client — thin driver in
+one process, cluster in another).
+
+The cross-process test spawns the server via ``python -m
+ray_tpu.util.client.server`` so the wire protocol is exercised over a
+real process boundary, like the reference's client tests."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.client import ClientServer, connect
+
+
+@pytest.fixture
+def ctx():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    server = ClientServer().start()
+    c = connect(server.address)
+    yield c
+    c.disconnect()
+    server.stop()
+    ray_tpu.shutdown()
+
+
+def test_put_get_wait(ctx):
+    ref = ctx.put({"k": [1, 2, 3]})
+    assert ctx.get(ref) == {"k": [1, 2, 3]}
+    ready, pending = ctx.wait([ref], num_returns=1, timeout=5)
+    assert ready == [ref] and pending == []
+
+
+def test_remote_function_with_refs(ctx):
+    def add(a, b):
+        return a + b
+
+    radd = ctx.remote(add)
+    x = ctx.put(10)
+    ref = radd.remote(x, 5)
+    assert ctx.get(ref) == 15
+    # chain client-side refs through tasks
+    assert ctx.get(radd.remote(ref, ref)) == 30
+
+
+def test_remote_function_options(ctx):
+    def two():
+        return "a", "b"
+
+    refs = ctx.remote(two, num_returns=2).remote()
+    assert ctx.get(refs) == ["a", "b"]
+
+
+def test_actor_roundtrip(ctx):
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+    CounterActor = ctx.remote(Counter)
+    c = CounterActor.remote(100)
+    assert ctx.get(c.incr.remote()) == 101
+    assert ctx.get(c.incr.remote(by=9)) == 110
+    ctx.kill(c)
+
+
+def test_task_error_propagates(ctx):
+    def boom():
+        raise ValueError("remote kaboom")
+
+    ref = ctx.remote(boom).remote()
+    with pytest.raises(Exception, match="kaboom"):
+        ctx.get(ref)
+
+
+def test_cluster_resources(ctx):
+    assert ctx.cluster_resources().get("CPU") == 4.0
+    assert "CPU" in ctx.available_resources()
+
+
+def test_cross_process_server(tmp_path):
+    """Full separation: server in a subprocess, driver here."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.util.client.server",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd="/root/repo",
+    )
+    try:
+        line = ""
+        for _ in range(20):  # skip interpreter warnings on stderr
+            line = proc.stdout.readline()
+            if "listening on" in line:
+                break
+        assert "listening on" in line, line
+        address = line.strip().rsplit(" ", 1)[-1]
+        c = connect(address, timeout=30)
+
+        def mul(a, b):
+            return a * b
+
+        assert c.get(c.remote(mul).remote(6, 7)) == 42
+        ref = c.put("over the wire")
+        assert c.get(ref) == "over the wire"
+        c.disconnect()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

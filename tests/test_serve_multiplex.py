@@ -1,0 +1,75 @@
+"""Serve model multiplexing (parity: serve/multiplex.py +
+model-aware routing)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_multiplexed_lru_and_model_id(rt):
+    loads = []
+
+    @serve.deployment(num_replicas=1)
+    class ModelServer:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            loads.append(model_id)
+            return f"model-{model_id}"
+
+        def __call__(self):
+            model_id = serve.get_multiplexed_model_id()
+            return self.get_model(model_id), model_id
+
+    handle = serve.run(ModelServer.bind(), name="mux")
+    h1 = handle.options(multiplexed_model_id="a")
+    model, seen_id = h1.remote().result(timeout_s=20)
+    assert (model, seen_id) == ("model-a", "a")
+
+    # Cache hit: same model not reloaded.
+    h1.remote().result(timeout_s=20)
+    assert loads == ["a"]
+
+    # Two more models → LRU evicts "a" (cap 2).
+    handle.options(multiplexed_model_id="b").remote().result(timeout_s=20)
+    handle.options(multiplexed_model_id="c").remote().result(timeout_s=20)
+    assert loads == ["a", "b", "c"]
+    h1.remote().result(timeout_s=20)  # "a" evicted → reloaded
+    assert loads == ["a", "b", "c", "a"]
+
+
+def test_multiplexed_sticky_routing(rt):
+    @serve.deployment(num_replicas=2, max_ongoing_requests=8)
+    class Server:
+        def __init__(self):
+            import uuid
+
+            self.replica_tag = uuid.uuid4().hex[:6]
+
+        @serve.multiplexed(max_num_models_per_replica=4)
+        def get_model(self, model_id: str):
+            return model_id
+
+        def __call__(self):
+            self.get_model(serve.get_multiplexed_model_id())
+            return self.replica_tag
+
+    handle = serve.run(Server.bind(), name="sticky")
+    h = handle.options(multiplexed_model_id="m1")
+    tags = {h.remote().result(timeout_s=20) for _ in range(6)}
+    # All requests for one model land on one replica.
+    assert len(tags) == 1
+
+
+def test_multiplexed_validation():
+    with pytest.raises(ValueError):
+        serve.multiplexed(max_num_models_per_replica=0)(lambda s, m: m)
+    assert serve.get_multiplexed_model_id() == ""

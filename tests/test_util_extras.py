@@ -1,0 +1,99 @@
+"""util.multiprocessing Pool + util.iter (parity:
+ray/util/multiprocessing/pool.py, ray/util/iter.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import iter as riter
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def test_pool_map_and_apply(rt):
+    with Pool(processes=3) as pool:
+        assert pool.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert pool.apply(_sq, (7,)) == 49
+        r = pool.apply_async(_sq, (9,))
+        assert r.get(timeout=10) == 81
+        assert r.successful()
+
+
+def test_pool_starmap_and_imap(rt):
+    with Pool(processes=2) as pool:
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        assert list(pool.imap(_sq, range(6), chunksize=2)) \
+            == [0, 1, 4, 9, 16, 25]
+        assert sorted(pool.imap_unordered(_sq, range(6), chunksize=2)) \
+            == [0, 1, 4, 9, 16, 25]
+
+
+def test_pool_async_error_and_callbacks(rt):
+    def boom(x):
+        raise RuntimeError("pool boom")
+
+    hits = []
+    with Pool(processes=1) as pool:
+        r = pool.apply_async(boom, (1,), error_callback=hits.append)
+        with pytest.raises(Exception):
+            r.get(timeout=10)
+        assert not r.successful()
+        assert hits
+
+        r2 = pool.map_async(_sq, [1, 2], callback=hits.append)
+        assert r2.get(timeout=10) == [1, 4]
+
+
+def test_pool_initializer_and_close(rt):
+    import os
+
+    with Pool(processes=2, initializer=lambda v: os.environ.update(POOLV=v),
+              initargs=("z",)) as pool:
+        vals = pool.map(lambda _: __import__("os").environ.get("POOLV"),
+                        range(2))
+        assert vals == ["z", "z"]
+        pool.close()
+        with pytest.raises(ValueError):
+            pool.map(_sq, [1])
+        pool.join()
+
+
+def test_iter_basics(rt):
+    it = riter.from_range(10, num_shards=2)
+    assert it.num_shards == 2
+    out = sorted(it.for_each(_sq).gather_sync())
+    assert out == sorted(x * x for x in range(10))
+
+    out = list(riter.from_items([1, 2, 3, 4], num_shards=2)
+               .filter(lambda x: x % 2 == 0).gather_sync())
+    assert sorted(out) == [2, 4]
+
+
+def test_iter_batch_flatten_union(rt):
+    batched = list(riter.from_range(6, num_shards=2).batch(2).gather_sync())
+    assert all(isinstance(b, list) and len(b) <= 2 for b in batched)
+    flat = sorted(riter.from_range(6, num_shards=2).batch(2).flatten()
+                  .gather_sync())
+    assert flat == list(range(6))
+
+    u = riter.from_range(3, num_shards=1).union(
+        riter.from_items([10, 11], num_shards=1))
+    assert sorted(u.gather_async()) == [0, 1, 2, 10, 11]
+    with pytest.raises(ValueError):
+        riter.from_range(2).for_each(_sq).union(riter.from_range(2))
+
+
+def test_iter_local_iterator(rt):
+    loc = riter.from_range(100, num_shards=4).gather_async()
+    assert len(loc.take(5)) == 5
+    doubled = loc.for_each(lambda x: x * 2)
+    assert all(v % 2 == 0 for v in doubled.take(10))
