@@ -1,0 +1,278 @@
+// Native cluster-resource scheduler: fixed-point ledgers + policy picks.
+//
+// Parity: the reference's raylet scheduling core in C++ —
+//   * FixedPoint resource arithmetic (ray: src/ray/common/scheduling/
+//     fixed_point.h — int64 at 1e-4 granularity, no float drift),
+//   * per-node available/total vectors (resource_instance_set.cc),
+//   * the hybrid scheduling policy (raylet/scheduling/policy/
+//     hybrid_scheduling_policy.h:28-46 — pack onto nodes below the
+//     utilization threshold in stable order, else least-utilized),
+//     plus SPREAD (spread_scheduling_policy.cc),
+//   * atomic pick+acquire under one lock (the raylet's single-threaded
+//     io_context discipline, here a mutex since callers are threads).
+//
+// Resource kinds are interned to dense ints by the Python side
+// (parity: scheduling_ids.h string→int interning lives above the
+// policy in the reference too).
+//
+// C ABI for ctypes (see ray_tpu/core/native_scheduler.py).
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kGranularity = 10000;  // 1e-4 units, fixed_point.h parity
+
+struct Node {
+  std::vector<int64_t> total;      // indexed by interned resource kind
+  std::vector<int64_t> available;
+  bool alive = true;
+
+  void ensure(size_t kinds) {
+    if (total.size() < kinds) {
+      total.resize(kinds, 0);
+      available.resize(kinds, 0);
+    }
+  }
+
+  // Max over kinds of used/total, in millionths (utilization * 1e6).
+  int64_t utilization_ppm() const {
+    int64_t worst = 0;
+    for (size_t i = 0; i < total.size(); ++i) {
+      if (total[i] > 0) {
+        int64_t used = total[i] - available[i];
+        int64_t ppm = used * 1000000 / total[i];
+        if (ppm > worst) worst = ppm;
+      }
+    }
+    return worst;
+  }
+
+  bool fits(const int64_t* demand, const int32_t* kinds, int n) const {
+    for (int i = 0; i < n; ++i) {
+      size_t k = static_cast<size_t>(kinds[i]);
+      int64_t have = k < available.size() ? available[k] : 0;
+      if (have < demand[i]) return false;
+    }
+    return true;
+  }
+
+  bool can_ever_fit(const int64_t* demand, const int32_t* kinds,
+                    int n) const {
+    for (int i = 0; i < n; ++i) {
+      size_t k = static_cast<size_t>(kinds[i]);
+      int64_t cap = k < total.size() ? total[k] : 0;
+      if (cap < demand[i]) return false;
+    }
+    return true;
+  }
+
+  void acquire(const int64_t* demand, const int32_t* kinds, int n) {
+    for (int i = 0; i < n; ++i) {
+      size_t k = static_cast<size_t>(kinds[i]);
+      // A kind this node never registered can pass fits() with a zero
+      // demand — grow the vectors rather than writing out of bounds.
+      ensure(k + 1);
+      available[k] -= demand[i];
+    }
+  }
+
+  void release(const int64_t* demand, const int32_t* kinds, int n) {
+    for (int i = 0; i < n; ++i) {
+      size_t k = static_cast<size_t>(kinds[i]);
+      ensure(k + 1);
+      available[k] += demand[i];
+    }
+  }
+};
+
+struct Scheduler {
+  std::mutex mu;
+  std::unordered_map<int64_t, Node> nodes;
+  std::vector<int64_t> order;  // stable insertion order for hybrid pack
+  int64_t threshold_ppm = 500000;  // hybrid spread threshold (0.5)
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rtsched_create(int64_t threshold_ppm) {
+  auto* s = new Scheduler();
+  // 0 is a legal threshold ("never pack"); only negatives mean default.
+  if (threshold_ppm >= 0) s->threshold_ppm = threshold_ppm;
+  return s;
+}
+
+void rtsched_destroy(void* h) { delete static_cast<Scheduler*>(h); }
+
+// Register / replace a node's capacity. kinds[i] is the interned id of
+// caps[i]; caps are in fixed-point units (value * 1e4).
+void rtsched_add_node(void* h, int64_t node, const int32_t* kinds,
+                      const int64_t* caps, int n) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->nodes.find(node);
+  if (it == s->nodes.end()) {
+    s->order.push_back(node);
+  }
+  Node& nd = s->nodes[node];
+  nd.alive = true;
+  int32_t max_kind = -1;
+  for (int i = 0; i < n; ++i) {
+    if (kinds[i] > max_kind) max_kind = kinds[i];
+  }
+  nd.ensure(static_cast<size_t>(max_kind + 1));
+  for (int i = 0; i < n; ++i) {
+    size_t k = static_cast<size_t>(kinds[i]);
+    nd.total[k] = caps[i];
+    nd.available[k] = caps[i];
+  }
+}
+
+void rtsched_kill_node(void* h, int64_t node) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->nodes.find(node);
+  if (it != s->nodes.end()) it->second.alive = false;
+}
+
+// Strategy codes.
+enum { STRAT_HYBRID = 0, STRAT_SPREAD = 1 };
+
+// Atomically pick a node per the policy and acquire the demand on it.
+// candidates: optional allow-list of node ids (affinity/label filtering
+// done in Python); n_candidates < 0 means "all alive nodes".
+// Returns the chosen node id, or -1 if nothing fits right now.
+int64_t rtsched_pick_and_acquire(void* h, const int32_t* kinds,
+                                 const int64_t* demand, int n,
+                                 int strategy, const int64_t* candidates,
+                                 int n_candidates) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+
+  auto allowed = [&](int64_t id) {
+    if (n_candidates < 0) return true;
+    for (int i = 0; i < n_candidates; ++i) {
+      if (candidates[i] == id) return true;
+    }
+    return false;
+  };
+
+  auto try_take = [&](int64_t id) -> bool {
+    Node& nd = s->nodes[id];
+    if (!nd.alive || !allowed(id) || !nd.fits(demand, kinds, n)) {
+      return false;
+    }
+    nd.acquire(demand, kinds, n);
+    return true;
+  };
+
+  if (strategy == STRAT_SPREAD) {
+    // Least-utilized first (spread_scheduling_policy parity).
+    int64_t best = -1;
+    int64_t best_ppm = -1;
+    for (int64_t id : s->order) {
+      Node& nd = s->nodes[id];
+      if (!nd.alive || !allowed(id) || !nd.fits(demand, kinds, n)) continue;
+      int64_t ppm = nd.utilization_ppm();
+      if (best == -1 || ppm < best_ppm) {
+        best = id;
+        best_ppm = ppm;
+      }
+    }
+    if (best != -1) s->nodes[best].acquire(demand, kinds, n);
+    return best;
+  }
+
+  // HYBRID: pack onto the first stable-order node below the threshold…
+  for (int64_t id : s->order) {
+    Node& nd = s->nodes[id];
+    if (!nd.alive || !allowed(id)) continue;
+    if (nd.utilization_ppm() < s->threshold_ppm && try_take(id)) return id;
+  }
+  // …else fall back to least-utilized that fits.
+  int64_t best = -1;
+  int64_t best_ppm = -1;
+  for (int64_t id : s->order) {
+    Node& nd = s->nodes[id];
+    if (!nd.alive || !allowed(id) || !nd.fits(demand, kinds, n)) continue;
+    int64_t ppm = nd.utilization_ppm();
+    if (best == -1 || ppm < best_ppm) {
+      best = id;
+      best_ppm = ppm;
+    }
+  }
+  if (best != -1) s->nodes[best].acquire(demand, kinds, n);
+  return best;
+}
+
+// Direct acquire on a specific node (PG-bundle reservation path).
+int rtsched_try_acquire(void* h, int64_t node, const int32_t* kinds,
+                        const int64_t* demand, int n) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->nodes.find(node);
+  if (it == s->nodes.end() || !it->second.alive ||
+      !it->second.fits(demand, kinds, n)) {
+    return 0;
+  }
+  it->second.acquire(demand, kinds, n);
+  return 1;
+}
+
+void rtsched_release(void* h, int64_t node, const int32_t* kinds,
+                     const int64_t* demand, int n) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->nodes.find(node);
+  if (it != s->nodes.end()) it->second.release(demand, kinds, n);
+}
+
+// Feasibility anywhere (infeasible-task detection parity).
+int rtsched_cluster_can_fit(void* h, const int32_t* kinds,
+                            const int64_t* demand, int n,
+                            const int64_t* candidates, int n_candidates) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  for (auto& [id, nd] : s->nodes) {
+    if (!nd.alive) continue;
+    if (n_candidates >= 0) {
+      bool ok = false;
+      for (int i = 0; i < n_candidates; ++i) {
+        if (candidates[i] == id) { ok = true; break; }
+      }
+      if (!ok) continue;
+    }
+    if (nd.can_ever_fit(demand, kinds, n)) return 1;
+  }
+  return 0;
+}
+
+// Snapshot one node's (total, available) for a kind; returns -1 if the
+// node is unknown.  Used for introspection/tests.
+int64_t rtsched_available(void* h, int64_t node, int32_t kind) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->nodes.find(node);
+  if (it == s->nodes.end()) return -1;
+  auto& av = it->second.available;
+  size_t k = static_cast<size_t>(kind);
+  return k < av.size() ? av[k] : 0;
+}
+
+int64_t rtsched_utilization_ppm(void* h, int64_t node) {
+  auto* s = static_cast<Scheduler*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->nodes.find(node);
+  if (it == s->nodes.end()) return -1;
+  return it->second.utilization_ppm();
+}
+
+int64_t rtsched_granularity() { return kGranularity; }
+
+}  // extern "C"

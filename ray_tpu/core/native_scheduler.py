@@ -1,0 +1,168 @@
+"""ctypes binding for the native (C++) cluster scheduler.
+
+Parity: the binding role of _raylet.pyx for raylet scheduling state —
+Python owns string resource names and scheduling strategies, the C++
+core owns fixed-point ledgers and the pick-and-acquire hot path
+(see ray_tpu/_native/scheduler.cc).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._native import build_library
+
+GRANULARITY = 10000  # fixed-point units per 1.0 (fixed_point.h parity)
+
+HYBRID = 0
+SPREAD = 1
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            path = build_library("scheduler.cc", "rtsched")
+            lib = ctypes.CDLL(path)
+            lib.rtsched_create.restype = ctypes.c_void_p
+            lib.rtsched_create.argtypes = [ctypes.c_int64]
+            lib.rtsched_destroy.argtypes = [ctypes.c_void_p]
+            lib.rtsched_add_node.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ]
+            lib.rtsched_kill_node.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int64]
+            lib.rtsched_pick_and_acquire.restype = ctypes.c_int64
+            lib.rtsched_pick_and_acquire.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ]
+            lib.rtsched_try_acquire.restype = ctypes.c_int
+            lib.rtsched_try_acquire.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ]
+            lib.rtsched_release.argtypes = lib.rtsched_try_acquire.argtypes
+            lib.rtsched_cluster_can_fit.restype = ctypes.c_int
+            lib.rtsched_cluster_can_fit.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ]
+            lib.rtsched_available.restype = ctypes.c_int64
+            lib.rtsched_available.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ]
+            lib.rtsched_utilization_ppm.restype = ctypes.c_int64
+            lib.rtsched_utilization_ppm.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+            ]
+            _lib = lib
+        return _lib
+
+
+def _fp(value: float) -> int:
+    return int(round(value * GRANULARITY))
+
+
+class NativeClusterScheduler:
+    """Interns resource names, keeps node-id handles, and forwards the
+    ledger/policy hot path to C++ (parity: ClusterResourceScheduler +
+    scheduling_ids interning)."""
+
+    def __init__(self, spread_threshold: float = 0.5):
+        lib = _load()
+        self._lib = lib
+        self._h = lib.rtsched_create(int(spread_threshold * 1e6))
+        self._kind_ids: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def close(self):
+        if self._h is not None:
+            self._lib.rtsched_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _intern(self, name: str) -> int:
+        with self._lock:
+            if name not in self._kind_ids:
+                self._kind_ids[name] = len(self._kind_ids)
+            return self._kind_ids[name]
+
+    def _encode(self, resources: Dict[str, float]
+                ) -> Tuple[Any, Any, int]:
+        n = len(resources)
+        kinds = (ctypes.c_int32 * n)(
+            *[self._intern(k) for k in resources]
+        )
+        vals = (ctypes.c_int64 * n)(
+            *[_fp(v) for v in resources.values()]
+        )
+        return kinds, vals, n
+
+    @staticmethod
+    def _cands(candidates: Optional[Sequence[int]]):
+        if candidates is None:
+            return None, -1
+        arr = (ctypes.c_int64 * len(candidates))(*candidates)
+        return arr, len(candidates)
+
+    def add_node(self, node_id: int, resources: Dict[str, float]) -> None:
+        kinds, vals, n = self._encode(resources)
+        self._lib.rtsched_add_node(self._h, node_id, kinds, vals, n)
+
+    def kill_node(self, node_id: int) -> None:
+        self._lib.rtsched_kill_node(self._h, node_id)
+
+    def pick_and_acquire(self, demand: Dict[str, float],
+                         strategy: int = HYBRID,
+                         candidates: Optional[Sequence[int]] = None
+                         ) -> Optional[int]:
+        kinds, vals, n = self._encode(demand)
+        cands, nc = self._cands(candidates)
+        chosen = self._lib.rtsched_pick_and_acquire(
+            self._h, kinds, vals, n, strategy, cands, nc
+        )
+        return None if chosen < 0 else chosen
+
+    def try_acquire(self, node_id: int, demand: Dict[str, float]) -> bool:
+        kinds, vals, n = self._encode(demand)
+        return bool(self._lib.rtsched_try_acquire(
+            self._h, node_id, kinds, vals, n
+        ))
+
+    def release(self, node_id: int, demand: Dict[str, float]) -> None:
+        kinds, vals, n = self._encode(demand)
+        self._lib.rtsched_release(self._h, node_id, kinds, vals, n)
+
+    def cluster_can_fit(self, demand: Dict[str, float],
+                        candidates: Optional[Sequence[int]] = None) -> bool:
+        kinds, vals, n = self._encode(demand)
+        cands, nc = self._cands(candidates)
+        return bool(self._lib.rtsched_cluster_can_fit(
+            self._h, kinds, vals, n, cands, nc
+        ))
+
+    def available(self, node_id: int, resource: str) -> float:
+        raw = self._lib.rtsched_available(
+            self._h, node_id, self._intern(resource)
+        )
+        return raw / GRANULARITY
+
+    def utilization(self, node_id: int) -> float:
+        ppm = self._lib.rtsched_utilization_ppm(self._h, node_id)
+        return max(ppm, 0) / 1e6

@@ -128,36 +128,60 @@ class ActorOptions:
 
 
 class ResourcePool:
-    """Logical resource ledger (parity: NodeResourceInstanceSet)."""
+    """Logical resource ledger (parity: NodeResourceInstanceSet).
 
-    def __init__(self, total: Dict[str, float]):
+    When the native scheduler built (ray_tpu/_native/scheduler.cc), the
+    ledger lives in C++ fixed-point arithmetic — acquire/release/
+    utilization forward there (parity: the raylet's C++ resource core).
+    Pure-Python fallback when no C++ toolchain is available."""
+
+    def __init__(self, total: Dict[str, float], native=None):
         self._lock = threading.Lock()
         self.total = dict(total)
-        self.available = dict(total)
+        self._avail = dict(total)
+        # native = (NativeClusterScheduler, node_int_id) or None
+        self._native = native
+
+    @property
+    def available(self) -> Dict[str, float]:
+        if self._native is not None:
+            sched, nid = self._native
+            return {k: sched.available(nid, k) for k in self.total}
+        return self._avail
 
     def can_fit(self, demand: Dict[str, float]) -> bool:
         return all(self.total.get(k, 0) >= v for k, v in demand.items())
 
     def try_acquire(self, demand: Dict[str, float]) -> bool:
+        if self._native is not None:
+            sched, nid = self._native
+            return sched.try_acquire(nid, demand)
         with self._lock:
-            if all(self.available.get(k, 0) >= v - 1e-9 for k, v in demand.items()):
+            if all(self._avail.get(k, 0) >= v - 1e-9 for k, v in demand.items()):
                 for k, v in demand.items():
-                    self.available[k] = self.available.get(k, 0) - v
+                    self._avail[k] = self._avail.get(k, 0) - v
                 return True
             return False
 
     def release(self, demand: Dict[str, float]) -> None:
+        if self._native is not None:
+            sched, nid = self._native
+            sched.release(nid, demand)
+            return
         with self._lock:
             for k, v in demand.items():
-                self.available[k] = self.available.get(k, 0) + v
+                self._avail[k] = self._avail.get(k, 0) + v
 
     def utilization(self) -> float:
         """Max over resource kinds of used/total (0 = idle, 1 = full)."""
+        if self._native is not None:
+            sched, nid = self._native
+            return sched.utilization(nid)
         with self._lock:
             worst = 0.0
             for k, tot in self.total.items():
                 if tot > 0:
-                    worst = max(worst, (tot - self.available.get(k, 0)) / tot)
+                    worst = max(worst, (tot - self._avail.get(k, 0)) / tot)
             return worst
 
 
@@ -166,9 +190,11 @@ class NodeState:
     (parity: GcsNodeManager's node table entry + raylet resource view)."""
 
     def __init__(self, node_id: NodeID, resources: Dict[str, float],
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 native=None, int_id: int = -1):
         self.node_id = node_id
-        self.pool = ResourcePool(resources)
+        self.int_id = int_id  # dense id for the native scheduler
+        self.pool = ResourcePool(resources, native=native)
         self.labels = dict(labels or {})
         self.alive = True
         self.actor_ids: set = set()
@@ -478,6 +504,18 @@ class LocalRuntime:
         self._named_actors: Dict[str, ActorID] = {}
         self._nodes: Dict[NodeID, NodeState] = {}
         self._node_order: List[NodeID] = []  # stable order for hybrid packing
+        # Native C++ scheduler core (parity: the raylet's C++
+        # ClusterResourceScheduler); None → pure-Python ledgers.
+        try:
+            from ray_tpu.core.native_scheduler import NativeClusterScheduler
+
+            self._native_sched = NativeClusterScheduler(
+                spread_threshold=cfg.scheduler_spread_threshold
+            )
+        except Exception:
+            self._native_sched = None
+        self._node_int_ids = itertools.count(1)
+        self._nodes_by_int: Dict[int, NodeState] = {}
         self._pgs: Dict[PlacementGroupID, _PGState] = {}
         self._named_pgs: Dict[str, PlacementGroupID] = {}
         # Tombstones for the actor state table, bounded (parity: GCS keeps
@@ -518,10 +556,19 @@ class LocalRuntime:
     def add_node(self, resources: Dict[str, float],
                  labels: Optional[Dict[str, str]] = None) -> NodeID:
         node_id = NodeID.from_random()
-        node = NodeState(node_id, dict(resources), labels)
+        int_id = next(self._node_int_ids)
+        native = ((self._native_sched, int_id)
+                  if self._native_sched is not None else None)
+        node = NodeState(node_id, dict(resources), labels,
+                         native=native, int_id=int_id)
         with self._lock:
             self._nodes[node_id] = node
             self._node_order.append(node_id)
+            self._nodes_by_int[int_id] = node
+        # Register with the native scheduler LAST: the node must not be
+        # natively pickable before the Python tables can map it back.
+        if self._native_sched is not None:
+            self._native_sched.add_node(int_id, dict(resources))
             pending_pgs = [st for st in self._pgs.values()
                            if not st.removed
                            and any(b.node_id is None for b in st.bundles)]
@@ -543,6 +590,8 @@ class LocalRuntime:
             if node is None or not node.alive:
                 return
             node.alive = False
+            if self._native_sched is not None:
+                self._native_sched.kill_node(node.int_id)
             doomed = [self._actors[a] for a in list(node.actor_ids)
                       if a in self._actors]
         for shell in doomed:
@@ -804,6 +853,31 @@ class LocalRuntime:
                 if n.pool.try_acquire(demand):
                     return _Allocation(n, None, demand)
             return None
+
+        if self._native_sched is not None and strategy in ("SPREAD",
+                                                           "DEFAULT"):
+            # Atomic pick+acquire in the C++ core (one lock, no Python
+            # loop races; parity: ClusterResourceScheduler picking under
+            # the raylet's single-threaded executor).
+            from ray_tpu.core import native_scheduler as _ns
+
+            with self._lock:
+                all_alive = len(nodes) == sum(
+                    1 for nd in self._nodes.values() if nd.alive
+                )
+            cands = None if all_alive else [n.int_id for n in nodes]
+            chosen = self._native_sched.pick_and_acquire(
+                demand,
+                _ns.SPREAD if strategy == "SPREAD" else _ns.HYBRID,
+                candidates=cands,
+            )
+            if chosen is None:
+                return None
+            node = self._nodes_by_int.get(chosen)
+            if node is None:  # can't happen post-registration ordering
+                self._native_sched.release(chosen, demand)
+                return None
+            return _Allocation(node, None, demand)
 
         if strategy == "SPREAD":
             for n in sorted(nodes, key=lambda n: n.pool.utilization()):

@@ -52,24 +52,44 @@ def multiplexed(func: Optional[Callable] = None, *,
 
     def decorate(loader: Callable) -> Callable:
         lock = threading.Lock()
+        # (instance id, model id) → Event while a load is in flight:
+        # concurrent requests for the same unloaded model wait for ONE
+        # load instead of duplicating it (parity: the reference
+        # serializes loads per model id).
+        inflight: dict = {}
 
-        def _lookup(self, model_id: str):
+        def _acquire_load_slot(self, model_id: str):
+            """Returns (cache, model, True) on hit, or (cache, None,
+            False) with this caller elected to load — after waiting out
+            any in-flight load of the same model."""
+            key = (id(self), model_id)
+            while True:
+                with lock:
+                    cache = getattr(self, _ATTR, None)
+                    if cache is None:
+                        cache = collections.OrderedDict()
+                        setattr(self, _ATTR, cache)
+                    if model_id in cache:
+                        cache.move_to_end(model_id)
+                        return cache, cache[model_id], True
+                    ev = inflight.get(key)
+                    if ev is None:
+                        inflight[key] = threading.Event()
+                        return cache, None, False
+                ev.wait()
+
+        def _finish_load(self, cache, model_id: str, model,
+                         success: bool):
+            key = (id(self), model_id)
             with lock:
-                cache = getattr(self, _ATTR, None)
-                if cache is None:
-                    cache = collections.OrderedDict()
-                    setattr(self, _ATTR, cache)
-                if model_id in cache:
+                if success:
+                    cache[model_id] = model
                     cache.move_to_end(model_id)
-                    return cache, cache[model_id], True
-                return cache, None, False
-
-        def _admit(cache, model_id: str, model):
-            with lock:
-                cache[model_id] = model
-                cache.move_to_end(model_id)
-                while len(cache) > max_num_models_per_replica:
-                    cache.popitem(last=False)  # LRU eviction
+                    while len(cache) > max_num_models_per_replica:
+                        cache.popitem(last=False)  # LRU eviction
+                ev = inflight.pop(key, None)
+            if ev is not None:
+                ev.set()
 
         if inspect.iscoroutinefunction(loader):
             # Async loader → async wrapper, awaitable from async
@@ -77,11 +97,15 @@ def multiplexed(func: Optional[Callable] = None, *,
             # is async-native).
             @functools.wraps(loader)
             async def awrapper(self, model_id: str):
-                cache, model, hit = _lookup(self, model_id)
+                cache, model, hit = _acquire_load_slot(self, model_id)
                 if hit:
                     return model
-                model = await loader(self, model_id)
-                _admit(cache, model_id, model)
+                try:
+                    model = await loader(self, model_id)
+                except BaseException:
+                    _finish_load(self, cache, model_id, None, False)
+                    raise
+                _finish_load(self, cache, model_id, model, True)
                 return model
 
             awrapper.__serve_multiplexed__ = True
@@ -89,17 +113,21 @@ def multiplexed(func: Optional[Callable] = None, *,
 
         @functools.wraps(loader)
         def wrapper(self, model_id: str):
-            cache, model, hit = _lookup(self, model_id)
+            cache, model, hit = _acquire_load_slot(self, model_id)
             if hit:
                 return model
-            model = loader(self, model_id)
-            if inspect.iscoroutine(model):
-                raise TypeError(
-                    "loader returned a coroutine from a sync wrapper — "
-                    "declare it `async def` so @multiplexed builds the "
-                    "async wrapper"
-                )
-            _admit(cache, model_id, model)
+            try:
+                model = loader(self, model_id)
+                if inspect.iscoroutine(model):
+                    raise TypeError(
+                        "loader returned a coroutine from a sync wrapper "
+                        "— declare it `async def` so @multiplexed builds "
+                        "the async wrapper"
+                    )
+            except BaseException:
+                _finish_load(self, cache, model_id, None, False)
+                raise
+            _finish_load(self, cache, model_id, model, True)
             return model
 
         wrapper.__serve_multiplexed__ = True

@@ -78,6 +78,12 @@ class Router:
                         replica_id, handle, max_ongoing
                     )
             self._replicas = fresh
+            # Drop affinity entries pointing at replicas that left the
+            # routing table (they'd pin models to ghosts forever).
+            self._model_affinity = {
+                m: rid for m, rid in self._model_affinity.items()
+                if rid in fresh
+            }
             self._cv.notify_all()
 
     # -- assignment --------------------------------------------------------
@@ -114,6 +120,12 @@ class Router:
                         chosen = min(candidates, key=lambda r: r.inflight)
                     if model_id:
                         self._model_affinity[model_id] = chosen.replica_id
+                        if len(self._model_affinity) > 4096:
+                            # Bounded map under model churn: drop the
+                            # oldest entry (insertion order ≈ LRU here).
+                            self._model_affinity.pop(
+                                next(iter(self._model_affinity))
+                            )
                     chosen.inflight += 1
                     break
                 remaining = (

@@ -34,6 +34,16 @@ class ClientObjectRef:
     def binary_id(self) -> bytes:
         return self._id
 
+    def __del__(self):
+        # Client-side GC queues the release; it rides along with the
+        # next request (parity: the reference client releases refs when
+        # proxies are collected, batched — no RPC from __del__, which
+        # could deadlock the in-flight call's lock).
+        try:
+            self._ctx._queue_release(self._id)
+        except Exception:
+            pass
+
     def __repr__(self):
         return f"ClientObjectRef({self._id.hex()[:16]})"
 
@@ -119,12 +129,22 @@ class ClientContext:
                                               timeout=timeout)
         self._sock.settimeout(None)
         self._lock = threading.Lock()  # one in-flight request at a time
+        self._release_lock = threading.Lock()
+        self._pending_releases: List[bytes] = []
         info = self._call("ping")
         self.server_version = info["version"]
 
     # -- transport ---------------------------------------------------------
 
+    def _queue_release(self, binary_id: bytes) -> None:
+        with self._release_lock:
+            self._pending_releases.append(binary_id)
+
     def _call(self, op: str, **payload) -> Any:
+        with self._release_lock:
+            releases, self._pending_releases = self._pending_releases, []
+        if releases:
+            payload["releases"] = releases
         with self._lock:
             send_msg(self._sock, {"op": op, **payload})
             reply = recv_msg(self._sock)

@@ -112,6 +112,11 @@ class ClientServer:
         import ray_tpu
         from ray_tpu.core.object_ref import ObjectRef
 
+        # Piggybacked ref releases from client-side GC (avoids one RPC
+        # per collected proxy; parity: the client's batched ReleaseObject).
+        for b in msg.get("releases", ()):
+            session.refs.pop(b, None)
+
         op = msg["op"]
         if op == "ping":
             return {"version": ray_tpu.__version__}
@@ -120,12 +125,10 @@ class ClientServer:
             session.refs[ref.id.binary()] = ref
             return ref.id.binary()
         if op == "get":
-            refs = [session.refs.get(b) or self._rehydrate(b)
-                    for b in msg["ids"]]
+            refs = [self._lookup(session, b) for b in msg["ids"]]
             return ray_tpu.get(refs, timeout=msg.get("timeout"))
         if op == "wait":
-            refs = [session.refs.get(b) or self._rehydrate(b)
-                    for b in msg["ids"]]
+            refs = [self._lookup(session, b) for b in msg["ids"]]
             ready, pending = ray_tpu.wait(
                 refs, num_returns=msg["num_returns"],
                 timeout=msg.get("timeout"),
@@ -180,11 +183,16 @@ class ClientServer:
         raise ValueError(f"unknown client op {op!r}")
 
     @staticmethod
-    def _rehydrate(binary_id: bytes):
-        from ray_tpu.core.object_ref import ObjectRef
-        from ray_tpu.utils.ids import ObjectID
-
-        return ObjectRef(ObjectID(binary_id))
+    def _lookup(session: _ClientSession, binary_id: bytes):
+        """Only ids this session created are valid — a fabricated ref
+        for an unknown id would block forever in get (released or
+        stale ids error instead)."""
+        ref = session.refs.get(binary_id)
+        if ref is None:
+            raise KeyError(
+                f"unknown or released object id {binary_id.hex()[:16]}"
+            )
+        return ref
 
     def _resolve_args(self, session: _ClientSession, tree):
         """Client-side ref placeholders → server-side ObjectRefs."""
@@ -192,7 +200,7 @@ class ClientServer:
 
         def walk(v):
             if isinstance(v, _RefPlaceholder):
-                return session.refs.get(v.id) or self._rehydrate(v.id)
+                return self._lookup(session, v.id)
             if isinstance(v, (list, tuple)):
                 return type(v)(walk(x) for x in v)
             if isinstance(v, dict):
