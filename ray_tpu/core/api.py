@@ -59,29 +59,24 @@ def init(
         if system_config:
             get_config().update(system_config)
         total = dict(resources or {})
+        labels = None
         if num_cpus is not None:
             total["CPU"] = float(num_cpus)
         if num_tpus is not None:
             total["TPU"] = float(num_tpus)
         elif "TPU" not in total:
-            n = _detect_tpu_chips()
-            if n:
-                total["TPU"] = float(n)
-        _runtime = LocalRuntime(resources=total)
+            # Full detection path (parity: _private/accelerator.py):
+            # chip count, version resource, slice-head resource, ICI
+            # topology labels.
+            from ray_tpu.utils.accelerator import node_resources_and_labels
+
+            extra, labels = node_resources_and_labels()
+            for k, v in extra.items():
+                total.setdefault(k, v)
+            labels = labels or None
+        _runtime = LocalRuntime(resources=total, labels=labels)
         atexit.register(shutdown)
         return _runtime
-
-
-def _detect_tpu_chips() -> int:
-    try:
-        import jax
-
-        devs = jax.devices()
-        if devs and devs[0].platform != "cpu":
-            return len(devs)
-    except Exception:
-        pass
-    return 0
 
 
 def shutdown() -> None:

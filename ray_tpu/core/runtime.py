@@ -565,13 +565,13 @@ class LocalRuntime:
             self._nodes[node_id] = node
             self._node_order.append(node_id)
             self._nodes_by_int[int_id] = node
+            pending_pgs = [st for st in self._pgs.values()
+                           if not st.removed
+                           and any(b.node_id is None for b in st.bundles)]
         # Register with the native scheduler LAST: the node must not be
         # natively pickable before the Python tables can map it back.
         if self._native_sched is not None:
             self._native_sched.add_node(int_id, dict(resources))
-            pending_pgs = [st for st in self._pgs.values()
-                           if not st.removed
-                           and any(b.node_id is None for b in st.bundles)]
         # New capacity may satisfy pending placement groups
         # (parity: GcsPlacementGroupManager::OnNodeAdd retry).
         for st in pending_pgs:

@@ -10,6 +10,7 @@ requests are in the replica simultaneously.
 from __future__ import annotations
 
 import functools
+import inspect
 import queue
 import threading
 from concurrent.futures import Future
@@ -31,6 +32,7 @@ class _BatchQueue:
         self._max = max_batch_size
         self._wait = batch_wait_timeout_s
         self._q: "queue.Queue" = queue.Queue()
+        self._loop_obj = None  # lazy per-thread loop for async handlers
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"batch-{getattr(fn, '__name__', 'fn')}",
@@ -49,6 +51,13 @@ class _BatchQueue:
         if owner is None:
             return None
         return functools.partial(self._fn, owner)
+
+    def _event_loop(self):
+        if self._loop_obj is None:
+            import asyncio
+
+            self._loop_obj = asyncio.new_event_loop()
+        return self._loop_obj
 
     def _loop(self):
         while True:
@@ -79,6 +88,14 @@ class _BatchQueue:
                 if bound is None:
                     raise RuntimeError("batch owner was garbage-collected")
                 results = bound(items)
+                if inspect.iscoroutine(results):
+                    # async batched fns are supported (parity: the
+                    # reference's @serve.batch wraps async handlers).
+                    # One persistent loop per batch thread: handlers may
+                    # cache loop-bound state across batches.
+                    results = self._event_loop().run_until_complete(
+                        results
+                    )
                 if len(results) != len(items):
                     raise ValueError(
                         f"batched function returned {len(results)} results "

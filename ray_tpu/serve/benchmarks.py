@@ -1,0 +1,109 @@
+"""Serve microbenchmarks: qps + latency percentiles.
+
+Parity: ray: python/ray/serve/benchmarks/microbenchmark.py (no-op
+deployment qps via handle and HTTP, batched throughput) and the
+release workloads under release/serve_tests/workloads/ — the numbers
+land in BASELINE.md.
+
+Run: ``python -m ray_tpu.serve.benchmarks``
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List
+
+
+def _percentiles(latencies_ms: List[float]) -> Dict[str, float]:
+    xs = sorted(latencies_ms)
+
+    def pct(p: float) -> float:
+        idx = min(len(xs) - 1, int(p / 100 * len(xs)))
+        return xs[idx]
+
+    return {"p50_ms": round(pct(50), 3), "p90_ms": round(pct(90), 3),
+            "p99_ms": round(pct(99), 3)}
+
+
+def bench_handle_noop(num_requests: int = 2000, num_replicas: int = 1,
+                      concurrency: int = 32) -> Dict[str, float]:
+    """qps + latency of a no-op deployment through DeploymentHandle
+    (parity: microbenchmark.py's handle path)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=num_replicas,
+                      max_ongoing_requests=concurrency)
+    class Noop:
+        def __call__(self):
+            return b"ok"
+
+    handle = serve.run(Noop.bind(), name=f"bench-noop-{num_replicas}")
+    # Warmup.
+    for _ in range(50):
+        handle.remote().result(timeout_s=30)
+
+    latencies: List[float] = []
+    t0 = time.perf_counter()
+    inflight = []
+    done = 0
+    while done < num_requests:
+        while len(inflight) < concurrency and \
+                done + len(inflight) < num_requests:
+            inflight.append((time.perf_counter(), handle.remote()))
+        started, resp = inflight.pop(0)
+        resp.result(timeout_s=30)
+        latencies.append((time.perf_counter() - started) * 1000)
+        done += 1
+    dt = time.perf_counter() - t0
+    out = {"qps": round(num_requests / dt, 1),
+           "num_replicas": num_replicas, **_percentiles(latencies)}
+    return out
+
+
+def bench_batching(num_requests: int = 2000,
+                   max_batch_size: int = 64) -> Dict[str, float]:
+    """Throughput with @serve.batch dynamic batching (parity:
+    microbenchmark.py batched path)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=256)
+    class Batched:
+        @serve.batch(max_batch_size=max_batch_size,
+                     batch_wait_timeout_s=0.002)
+        def handle_batch(self, items):
+            return [x * 2 for x in items]
+
+        def __call__(self, x: int = 1):
+            return self.handle_batch(x)
+
+    handle = serve.run(Batched.bind(), name="bench-batched")
+    for _ in range(20):
+        handle.remote(1).result(timeout_s=30)
+    t0 = time.perf_counter()
+    resps = [handle.remote(i) for i in range(num_requests)]
+    for r in resps:
+        r.result(timeout_s=60)
+    dt = time.perf_counter() - t0
+    return {"qps": round(num_requests / dt, 1),
+            "max_batch_size": max_batch_size}
+
+
+def main() -> None:
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    results = {
+        "handle_noop_1_replica": bench_handle_noop(num_replicas=1),
+        "handle_noop_4_replicas": bench_handle_noop(num_replicas=4),
+        "dynamic_batching": bench_batching(),
+    }
+    serve.shutdown()
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -58,25 +58,34 @@ def multiplexed(func: Optional[Callable] = None, *,
         # serializes loads per model id).
         inflight: dict = {}
 
-        def _acquire_load_slot(self, model_id: str):
-            """Returns (cache, model, True) on hit, or (cache, None,
-            False) with this caller elected to load — after waiting out
-            any in-flight load of the same model."""
+        def _try_acquire_load_slot(self, model_id: str):
+            """One non-blocking step: (cache, model, 'hit') on cache
+            hit, (cache, None, 'load') if this caller is elected to
+            load, (cache, event, 'wait') if another load is in flight."""
             key = (id(self), model_id)
+            with lock:
+                cache = getattr(self, _ATTR, None)
+                if cache is None:
+                    cache = collections.OrderedDict()
+                    setattr(self, _ATTR, cache)
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache, cache[model_id], "hit"
+                ev = inflight.get(key)
+                if ev is None:
+                    inflight[key] = threading.Event()
+                    return cache, None, "load"
+                return cache, ev, "wait"
+
+        def _acquire_load_slot(self, model_id: str):
+            """Blocking (thread) variant for the sync wrapper."""
             while True:
-                with lock:
-                    cache = getattr(self, _ATTR, None)
-                    if cache is None:
-                        cache = collections.OrderedDict()
-                        setattr(self, _ATTR, cache)
-                    if model_id in cache:
-                        cache.move_to_end(model_id)
-                        return cache, cache[model_id], True
-                    ev = inflight.get(key)
-                    if ev is None:
-                        inflight[key] = threading.Event()
-                        return cache, None, False
-                ev.wait()
+                cache, out, state = _try_acquire_load_slot(self, model_id)
+                if state == "hit":
+                    return cache, out, True
+                if state == "load":
+                    return cache, None, False
+                out.wait()
 
         def _finish_load(self, cache, model_id: str, model,
                          success: bool):
@@ -97,9 +106,21 @@ def multiplexed(func: Optional[Callable] = None, *,
             # is async-native).
             @functools.wraps(loader)
             async def awrapper(self, model_id: str):
-                cache, model, hit = _acquire_load_slot(self, model_id)
-                if hit:
-                    return model
+                import asyncio
+
+                while True:
+                    cache, out, state = _try_acquire_load_slot(
+                        self, model_id
+                    )
+                    if state == "hit":
+                        return out
+                    if state == "load":
+                        break
+                    # Another coroutine/thread is loading: yield the
+                    # loop while waiting (a blocking Event.wait here
+                    # would deadlock a single-loop pair of requests).
+                    while not out.is_set():
+                        await asyncio.sleep(0.005)
                 try:
                     model = await loader(self, model_id)
                 except BaseException:

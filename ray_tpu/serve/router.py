@@ -114,6 +114,10 @@ class Router:
                         sticky = self._model_affinity.get(model_id)
                         chosen = next((r for r in candidates
                                        if r.replica_id == sticky), None)
+                        if chosen is not None:
+                            # Refresh recency so bounded eviction drops
+                            # cold models, not hot ones.
+                            self._model_affinity.pop(model_id, None)
                     if chosen is None:
                         if len(candidates) > 2:
                             candidates = random.sample(candidates, 2)

@@ -5,19 +5,41 @@ from ray_tpu.train.state import (
     default_optimizer,
     state_shardings,
 )
+from ray_tpu.train.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    report,
+)
 from ray_tpu.train.step import compile_train_step, make_train_step
 from ray_tpu.train.trainer import JaxTrainer, Result, RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import (
+    BackendExecutor,
+    DataParallelTrainer,
+    FailureConfig,
+    TrainOutput,
+    WorkerGroup,
+)
 
 __all__ = [
+    "BackendExecutor",
     "CheckpointManager",
+    "DataParallelTrainer",
+    "FailureConfig",
     "JaxTrainer",
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "TrainContext",
+    "TrainOutput",
     "TrainState",
+    "WorkerGroup",
     "compile_train_step",
     "create_train_state",
     "default_optimizer",
+    "get_checkpoint",
+    "get_context",
     "make_train_step",
+    "report",
     "state_shardings",
 ]

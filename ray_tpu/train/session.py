@@ -1,0 +1,86 @@
+"""Per-worker training session: report() + get_context().
+
+Parity: ray: python/ray/train/_internal/session.py — ``_TrainSession``
+(:132) bound per worker, ``report(metrics, checkpoint)`` (:612,844)
+streaming results to the driver, and the public context surface
+(train.get_context(): rank / world size / local rank).  The session is
+thread-local: each worker actor's execution thread binds one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, Optional
+
+_tls = threading.local()
+
+
+@dataclasses.dataclass
+class TrainContext:
+    world_rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    experiment_name: str = ""
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+
+class _Session:
+    def __init__(self, context: TrainContext, report_fn):
+        self.context = context
+        self.report_fn = report_fn
+        self.latest_checkpoint: Optional[Any] = None
+
+
+def init_session(context: TrainContext, report_fn,
+                 latest_checkpoint: Optional[Any] = None) -> None:
+    s = _Session(context, report_fn)
+    s.latest_checkpoint = latest_checkpoint
+    _tls.session = s
+
+
+def shutdown_session() -> None:
+    _tls.session = None
+
+
+def _get_session() -> _Session:
+    s = getattr(_tls, "session", None)
+    if s is None:
+        raise RuntimeError(
+            "no train session on this thread — report()/get_context() "
+            "are only valid inside a train_loop_per_worker"
+        )
+    return s
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Any] = None) -> None:
+    """Stream metrics (and optionally a checkpoint payload) to the
+    driver (parity: ray.train.report)."""
+    _get_session().report_fn(dict(metrics), checkpoint)
+
+
+def get_context() -> TrainContext:
+    return _get_session().context
+
+
+def get_checkpoint() -> Optional[Any]:
+    """The checkpoint to resume from, if the trainer was restored
+    (parity: train.get_checkpoint)."""
+    return _get_session().latest_checkpoint

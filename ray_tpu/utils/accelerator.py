@@ -1,0 +1,129 @@
+"""TPU accelerator detection + topology labels.
+
+Parity: ray: python/ray/_private/accelerator.py:20-191 — TPU chip
+count (/dev/accel* or env), version (GCE metadata), per-pod head
+resources (``TPU-{version}-{pod}-head``), visibility isolation via
+``TPU_VISIBLE_CHIPS``; constants in
+python/ray/util/accelerators/accelerators.py (GOOGLE_TPU_V2/V3/V4).
+
+Here detection prefers the live jax backend (authoritative on TPU VMs);
+the env/metadata paths mirror the reference for worker processes that
+must not initialize jax.  Topology labels feed ICI-aware placement
+(SURVEY.md §7 phase 3: nodes carry slice/ICI coordinates; bundle
+policies pack along them — see runtime._reserve_bundles 'ici_index').
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional
+
+GOOGLE_TPU_V4 = "TPU-v4"
+GOOGLE_TPU_V5E = "TPU-v5e"
+GOOGLE_TPU_V5P = "TPU-v5p"
+GOOGLE_TPU_V6E = "TPU-v6e"
+
+_JAX_PLATFORM_VERSIONS = {
+    "tpu v4": GOOGLE_TPU_V4,
+    "tpu v5e": GOOGLE_TPU_V5E,
+    "tpu v5 lite": GOOGLE_TPU_V5E,
+    "tpu v5p": GOOGLE_TPU_V5P,
+    "tpu v5": GOOGLE_TPU_V5P,
+    "tpu v6e": GOOGLE_TPU_V6E,
+}
+
+
+def num_tpu_chips() -> int:
+    """Chips visible to this host (parity: accelerator.py chip count —
+    TPU_VISIBLE_CHIPS > /dev/accel* > jax)."""
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if visible is not None:
+        # An empty value means "no chips visible" — isolation, not
+        # unset; falling through would leak the host's full chip count.
+        return len([c for c in visible.split(",") if c.strip()])
+    accels = glob.glob("/dev/accel*")
+    if accels:
+        return len(accels)
+    try:
+        import jax
+
+        devs = jax.devices()
+        if devs and devs[0].platform not in ("cpu", "gpu"):
+            return len(devs)
+    except Exception:
+        pass
+    return 0
+
+
+def tpu_version() -> Optional[str]:
+    """Resource-string TPU version (parity: GCE metadata
+    accelerator-type; jax device_kind preferred when live)."""
+    env = os.environ.get("RAYTPU_TPU_VERSION")
+    if env:
+        return env
+    try:
+        import jax
+
+        devs = jax.devices()
+        if devs and devs[0].platform not in ("cpu", "gpu"):
+            kind = getattr(devs[0], "device_kind", "").lower()
+            for prefix, version in _JAX_PLATFORM_VERSIONS.items():
+                if kind.startswith(prefix):
+                    return version
+            return f"TPU-{kind.replace(' ', '-')}" if kind else None
+    except Exception:
+        pass
+    return None
+
+
+def tpu_pod_name() -> Optional[str]:
+    """Pod/slice identity from the TPU VM env (parity: TPU_NAME /
+    the metadata instance attributes)."""
+    return os.environ.get("TPU_NAME") or os.environ.get(
+        "TPU_WORKER_HOSTNAMES"
+    )
+
+
+def tpu_worker_id() -> int:
+    """This host's index inside the pod (parity: TPU_WORKER_ID)."""
+    try:
+        return int(os.environ.get("TPU_WORKER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def node_resources_and_labels() -> (Dict[str, float], Dict[str, str]):
+    """(extra resources, labels) a TPU host contributes at node start
+    (parity: resource_spec.py merging accelerator resources; the
+    ``TPU-{version}-{pod}-head`` resource on worker 0 is how the
+    reference gang-schedules onto a slice head)."""
+    resources: Dict[str, float] = {}
+    labels: Dict[str, str] = {}
+    chips = num_tpu_chips()
+    if chips <= 0:
+        return resources, labels
+    resources["TPU"] = float(chips)
+    version = tpu_version()
+    if version:
+        resources[version] = float(chips)
+        labels["raytpu.io/tpu-version"] = version
+    pod = tpu_pod_name()
+    worker_id = tpu_worker_id()
+    labels["ici_index"] = str(worker_id)
+    if pod:
+        labels["raytpu.io/tpu-pod"] = pod
+        if worker_id == 0 and version:
+            # Slice-head resource: exactly one per pod (parity:
+            # accelerator.py:176-191 TPU-{version}-{pod}-head).
+            resources[f"{version}-{pod}-head"] = 1.0
+    return resources, labels
+
+
+def visible_chip_env(chip_ids: List[int]) -> Dict[str, str]:
+    """Env pinning a worker to specific chips (parity: the reference
+    sets TPU_VISIBLE_CHIPS the way it sets CUDA_VISIBLE_DEVICES)."""
+    return {
+        "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in chip_ids),
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,1,1",
+    }

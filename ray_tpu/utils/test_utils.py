@@ -1,0 +1,70 @@
+"""Fault-injection helpers for tests.
+
+Parity: ray: python/ray/_private/test_utils.py —
+``get_and_run_node_killer`` (:1391-1401) randomly SIGKILLs raylets
+during chaos tests (python/ray/tests/test_chaos.py, release
+nightly_tests/chaos_test/).  Here the killer targets logical nodes of
+the in-process cluster; the failure semantics exercised (actor restart
+elsewhere, task retry, object reconstruction, bundle rescheduling) are
+the same paths real node death takes.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import List, Optional
+
+
+class NodeKiller:
+    """Kills a random non-head alive node every ``interval_s`` until
+    stopped (parity: NodeKillerActor's kill loop)."""
+
+    def __init__(self, runtime, *, interval_s: float = 0.2,
+                 max_kills: Optional[int] = None, seed: int = 0,
+                 spare_labels: Optional[dict] = None):
+        self.runtime = runtime
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.spare_labels = spare_labels or {}
+        self.killed: List[str] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _victims(self):
+        rt = self.runtime
+        with rt._lock:
+            out = []
+            for node in rt._nodes.values():
+                if not node.alive or node.node_id == rt.head_node_id:
+                    continue
+                if any(node.labels.get(k) == v
+                       for k, v in self.spare_labels.items()):
+                    continue
+                out.append(node.node_id)
+            return out
+
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(
+            target=self._loop, name="node-killer", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self.max_kills is not None \
+                    and len(self.killed) >= self.max_kills:
+                return
+            victims = self._victims()
+            if not victims:
+                continue
+            victim = self._rng.choice(victims)
+            self.runtime.kill_node(victim)
+            self.killed.append(victim.hex())

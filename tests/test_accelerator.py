@@ -1,0 +1,46 @@
+"""Accelerator detection (parity: _private/accelerator.py TPU paths)."""
+
+import pytest
+
+from ray_tpu.utils import accelerator as acc
+
+
+def test_visible_chips_env_precedence(monkeypatch):
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2")
+    assert acc.num_tpu_chips() == 3
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "")
+    # falls through to /dev/accel* or jax (>=0 either way)
+    assert acc.num_tpu_chips() >= 0
+
+
+def test_node_resources_and_labels(monkeypatch):
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2,3")
+    monkeypatch.setenv("RAYTPU_TPU_VERSION", "TPU-v5p")
+    monkeypatch.setenv("TPU_NAME", "my-pod")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    resources, labels = acc.node_resources_and_labels()
+    assert resources["TPU"] == 4.0
+    assert resources["TPU-v5p"] == 4.0
+    assert resources["TPU-v5p-my-pod-head"] == 1.0  # slice-head resource
+    assert labels["ici_index"] == "0"
+    assert labels["raytpu.io/tpu-pod"] == "my-pod"
+
+    # Non-zero worker: no head resource, ici_index reflects position.
+    monkeypatch.setenv("TPU_WORKER_ID", "3")
+    resources, labels = acc.node_resources_and_labels()
+    assert "TPU-v5p-my-pod-head" not in resources
+    assert labels["ici_index"] == "3"
+
+
+def test_no_tpu_is_empty(monkeypatch):
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "")
+    monkeypatch.delenv("TPU_NAME", raising=False)
+    # Force the no-chip path regardless of host hardware.
+    monkeypatch.setattr(acc, "num_tpu_chips", lambda: 0)
+    resources, labels = acc.node_resources_and_labels()
+    assert resources == {} and labels == {}
+
+
+def test_visible_chip_env():
+    env = acc.visible_chip_env([1, 3])
+    assert env["TPU_VISIBLE_CHIPS"] == "1,3"

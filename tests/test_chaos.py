@@ -1,0 +1,86 @@
+"""Chaos: random node kills under load (parity:
+python/ray/tests/test_chaos.py + the NodeKiller of
+_private/test_utils.py:1391 — retriable work must survive)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster import Cluster
+from ray_tpu.utils.test_utils import NodeKiller
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(head_node_args={"num_cpus": 4})
+    yield c
+    c.shutdown()
+
+
+def test_retriable_tasks_survive_node_churn(cluster):
+    rt = cluster._runtime
+    for _ in range(4):
+        cluster.add_node(num_cpus=4)
+
+    @ray_tpu.remote(max_retries=8, num_cpus=1)
+    def work(i):
+        time.sleep(0.15)
+        return i * i
+
+    killer = NodeKiller(rt, interval_s=0.05, max_kills=3).start()
+    # Keep adding capacity so kills never make work infeasible.
+    refs = [work.remote(i) for i in range(40)]
+    for _ in range(3):
+        cluster.add_node(num_cpus=4)
+    try:
+        results = ray_tpu.get(refs, timeout=60)
+    finally:
+        killer.stop()
+    assert results == [i * i for i in range(40)]
+    assert killer.killed  # chaos actually happened
+
+
+def test_restartable_actors_survive_node_churn(cluster):
+    rt = cluster._runtime
+    for _ in range(3):
+        cluster.add_node(num_cpus=4)
+
+    @ray_tpu.remote(max_restarts=10, num_cpus=1)
+    class Worker:
+        def compute(self, x):
+            time.sleep(0.02)
+            return x + 1
+
+    actors = [Worker.remote() for _ in range(6)]
+    killer = NodeKiller(rt, interval_s=0.2, max_kills=2).start()
+    cluster.add_node(num_cpus=8)
+    failures = 0
+    results = []
+    try:
+        for round_ in range(5):
+            for a in actors:
+                try:
+                    results.append(
+                        ray_tpu.get(a.compute.remote(round_), timeout=20)
+                    )
+                except Exception:
+                    failures += 1  # in-flight call lost at kill time
+            time.sleep(0.05)
+    finally:
+        killer.stop()
+    # The vast majority of calls succeed; restarted actors keep serving.
+    assert len(results) >= 20
+    assert killer.killed
+    # After the chaos window every actor answers again.
+    deadline = time.time() + 30
+    ok = 0
+    for a in actors:
+        while time.time() < deadline:
+            try:
+                assert ray_tpu.get(a.compute.remote(99), timeout=10) == 100
+                ok += 1
+                break
+            except Exception:
+                time.sleep(0.1)
+    assert ok == len(actors)

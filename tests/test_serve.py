@@ -314,3 +314,32 @@ def test_status_shape(serve_instance):
     assert dep["target_replicas"] == 2
     assert dep["running_replicas"] == 2
     assert dep["status"] == "HEALTHY"
+
+
+def test_async_batched_handler(serve_instance):
+    """@serve.batch over an async handler: one persistent loop per
+    batch thread (loop-bound state must survive across batches)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=16)
+    class AsyncBatched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.02)
+        async def handle(self, items):
+            import asyncio
+
+            if not hasattr(self, "_loop_mark"):
+                self._loop_mark = asyncio.get_event_loop()
+            # Same loop every batch.
+            assert asyncio.get_event_loop() is self._loop_mark
+            await asyncio.sleep(0)
+            return [x + 100 for x in items]
+
+        def __call__(self, x):
+            return self.handle(x)
+
+    handle = serve.run(AsyncBatched.bind(), name="async-batched")
+    # Two waves → at least two separate batches.
+    out1 = [handle.remote(i).result(timeout_s=20) for i in range(4)]
+    out2 = [handle.remote(i).result(timeout_s=20) for i in range(4)]
+    assert out1 == out2 == [100, 101, 102, 103]
