@@ -75,6 +75,11 @@ _LIST_ROUTES = {
 
 
 def cmd_list(args, out) -> int:
+    if args.entity == "jobs":
+        rows = _get_json(_address(args), "/api/jobs/")["jobs"]
+        _print_table(rows[:args.limit],
+                     ["submission_id", "status", "entrypoint"], out)
+        return 0
     route, columns = _LIST_ROUTES[args.entity]
     rows = _get_json(_address(args),
                      f"{route}?limit={args.limit}")["result"]
@@ -170,7 +175,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("status", help="cluster resources + nodes")
 
     lp = sub.add_parser("list", help="list cluster entities")
-    lp.add_argument("entity", choices=sorted(_LIST_ROUTES))
+    lp.add_argument("entity", choices=sorted(_LIST_ROUTES) + ["jobs"])
     lp.add_argument("--limit", type=int, default=100)
 
     sub.add_parser("summary", help="task summary by function and state")

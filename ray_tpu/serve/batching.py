@@ -65,6 +65,8 @@ class _BatchQueue:
                 item, fut = self._q.get(timeout=5.0)
             except queue.Empty:
                 if self._owner_ref is not None and self._owner_ref() is None:
+                    if self._loop_obj is not None:
+                        self._loop_obj.close()  # release epoll/pipe fds
                     return  # owner collected — exit
                 continue
             batch = [(item, fut)]

@@ -64,11 +64,56 @@ class HTTPProxy:
                     payload: Any = json.loads(raw) if raw else None
                 except json.JSONDecodeError:
                     payload = raw.decode()
+                wants_stream = "text/event-stream" in \
+                    (self.headers.get("Accept") or "")
                 try:
                     result = handle.remote(payload).result(timeout_s=60.0)
-                    self._reply(200, json.dumps(result).encode())
                 except Exception as e:
                     self._reply(500, json.dumps({"error": repr(e)}).encode())
+                    return
+                if wants_stream:
+                    self._reply_sse(result)
+                else:
+                    try:
+                        body = json.dumps(result).encode()
+                    except (TypeError, ValueError) as e:
+                        self._reply(500, json.dumps(
+                            {"error": f"unserializable result: {e!r}"}
+                        ).encode())
+                        return
+                    self._reply(200, body)
+
+            def _reply_sse(self, result: Any):
+                """Server-sent events: one `data:` frame per element of
+                an iterable result, then [DONE] (parity: the
+                reference's StreamingResponse support over ASGI —
+                serve's streaming HTTP responses).  Once headers go out
+                this owns the connection: mid-stream failures become an
+                error frame, never a second HTTP response."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                items = result if hasattr(result, "__iter__") \
+                    and not isinstance(result, (str, bytes, dict)) \
+                    else [result]
+                try:
+                    for item in items:
+                        try:
+                            frame = b"data: " + json.dumps(item).encode() \
+                                + b"\n\n"
+                        except (TypeError, ValueError) as e:
+                            self.wfile.write(
+                                b"data: " + json.dumps(
+                                    {"error": f"unserializable: {e!r}"}
+                                ).encode() + b"\n\n"
+                            )
+                            break
+                        self.wfile.write(frame)
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
 
             def _reply(self, code: int, body: bytes):
                 self.send_response(code)

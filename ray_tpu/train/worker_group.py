@@ -118,11 +118,10 @@ class WorkerGroup:
         so node-local facts can't be assumed from the world rank)."""
         from ray_tpu.core import api
 
-        rt = api.runtime()
-        node_of: List[Any] = []
-        for w in self.workers:
-            shell = rt._actors.get(w._actor_id)
-            node_of.append(shell.node_id if shell is not None else None)
+        rows = {row["actor_id"]: row.get("node_id")
+                for row in api.runtime().actor_table()}
+        node_of: List[Any] = [rows.get(w._actor_id.hex())
+                              for w in self.workers]
         node_order: List[Any] = []
         members: Dict[Any, List[int]] = {}
         for rank, node in enumerate(node_of):
@@ -254,29 +253,28 @@ class DataParallelTrainer:
             refs = executor.start_training(
                 self._fn, report_queue, latest_checkpoint, self._config
             )
+            def absorb_reports():
+                # Resume keys off rank 0's checkpoints only (parity:
+                # the reference persists the rank-0 report; a slow rank
+                # must not roll back a newer rank-0 checkpoint).
+                nonlocal latest_checkpoint
+                for item in _drain(report_queue):
+                    history.append(item)
+                    if item.get("checkpoint") is not None \
+                            and item["rank"] == 0:
+                        latest_checkpoint = item["checkpoint"]
+
             try:
                 pending = list(refs)
                 while pending:
-                    for item in _drain(report_queue):
-                        history.append(item)
-                        if item.get("checkpoint") is not None \
-                                and item["rank"] == 0:
-                            # Resume keys off rank 0's checkpoints only
-                            # (parity: the reference persists the rank-0
-                            # report; a slow rank must not roll back a
-                            # newer rank-0 checkpoint).
-                            latest_checkpoint = item["checkpoint"]
+                    absorb_reports()
                     done, pending = ray_tpu.wait(
                         pending, num_returns=len(pending), timeout=0.05
                     )
                     if done:
                         ray_tpu.get(done)  # surface worker errors
                 # Drain any reports that landed after the last wait.
-                for item in _drain(report_queue):
-                    history.append(item)
-                    if item.get("checkpoint") is not None \
-                            and item["rank"] == 0:
-                        latest_checkpoint = item["checkpoint"]
+                absorb_reports()
                 returns = ray_tpu.get(refs)
                 report_queue.shutdown()
                 executor.shutdown()
@@ -292,11 +290,7 @@ class DataParallelTrainer:
                 # dying queue), then capture reports — including the
                 # newest rank-0 checkpoint — then drop the queue actor.
                 executor.shutdown()
-                for item in _drain(report_queue):
-                    history.append(item)
-                    if item.get("checkpoint") is not None \
-                            and item["rank"] == 0:
-                        latest_checkpoint = item["checkpoint"]
+                absorb_reports()
                 report_queue.shutdown()
                 if not isinstance(e, Exception):
                     raise  # KeyboardInterrupt etc: cleaned up, propagate

@@ -343,3 +343,31 @@ def test_async_batched_handler(serve_instance):
     out1 = [handle.remote(i).result(timeout_s=20) for i in range(4)]
     out2 = [handle.remote(i).result(timeout_s=20) for i in range(4)]
     assert out1 == out2 == [100, 101, 102, 103]
+
+
+def test_http_sse_streaming(serve_instance):
+    """Accept: text/event-stream → per-element SSE frames (parity:
+    serve streaming HTTP responses)."""
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Chunky:
+        def __call__(self, payload):
+            return [f"chunk-{i}" for i in range(3)]
+
+    proxy = serve.start(http_port=0)
+    serve.run(Chunky.bind(), name="chunky", route_prefix="/chunky")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{proxy.port}/chunky",
+        data=b"{}", headers={"Accept": "text/event-stream",
+                             "Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=15) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        body = r.read().decode()
+    frames = [line[6:] for line in body.splitlines()
+              if line.startswith("data: ")]
+    assert frames == ['"chunk-0"', '"chunk-1"', '"chunk-2"', "[DONE]"]
