@@ -238,6 +238,10 @@ class LocalObjectStore:
     def get(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
         st = self._state(oid)
         deadline = None if timeout is None else time.monotonic() + timeout
+        return self._get_loop(st, oid, timeout, deadline)
+
+    def _get_loop(self, st, oid: ObjectID, timeout: Optional[float],
+                  deadline: Optional[float]) -> Any:
         while True:
             if st.lost and self.lost_object_callback is not None:
                 # Lazy reconstruction on fetch (parity:
@@ -261,47 +265,58 @@ class LocalObjectStore:
                 vb = st.value_bytes
                 spilled = st.spilled_uri
                 in_band = st.in_band
-            break
-        if err is not None:
-            raise err
-        if shm_flag:
-            shm = self._shm_store()
-            if shm is None:  # store closed under a racing reader
-                raise ObjectLostError(
-                    f"object {oid.hex()}: shared-memory store is closed"
-                )
-            try:
-                pinned = shm.get(oid.binary(), timeout=0.0)
-            except OSError:
-                raise ObjectLostError(
-                    f"object {oid.hex()} was evicted from the shared-memory "
-                    f"store (size {st.shm_size}) — increase capacity or "
-                    f"release refs sooner"
-                ) from None
-            # Zero-copy: deserialized arrays alias the arena through the
-            # pinned exporter; the native refcount drops automatically
-            # when the last view is garbage-collected (parity: plasma
-            # buffers unpin on Python-object GC).
-            return deserialize_object(pinned.view)
-        if vb is not None:
-            st.last_access = time.monotonic()
-            return deserialize_object(vb)
-        if spilled is not None:
-            # Restore from disk (parity: LocalObjectManager restore via
-            # IO workers; here a direct read).  The restored bytes are
-            # not re-admitted — a hot object will be re-put by its
-            # producer pattern, and not re-admitting avoids spill↔restore
-            # thrash under sustained pressure.
-            try:
-                data = self._external_storage().restore(spilled)
-            except OSError:
-                raise ObjectLostError(
-                    f"object {oid.hex()}: spilled copy unreadable"
-                ) from None
-            self.spill_stats["restored_objects"] += 1
-            self.spill_stats["restored_bytes"] += len(data)
-            return deserialize_object(data)
-        return in_band
+            if err is not None:
+                raise err
+            if shm_flag:
+                shm = self._shm_store()
+                if shm is None:  # store closed under a racing reader
+                    raise ObjectLostError(
+                        f"object {oid.hex()}: shared-memory store is closed"
+                    )
+                try:
+                    pinned = shm.get(oid.binary(), timeout=0.0)
+                except OSError:
+                    raise ObjectLostError(
+                        f"object {oid.hex()} was evicted from the "
+                        f"shared-memory store (size {st.shm_size}) — "
+                        f"increase capacity or release refs sooner"
+                    ) from None
+                # Zero-copy: deserialized arrays alias the arena through
+                # the pinned exporter; the native refcount drops
+                # automatically when the last view is garbage-collected
+                # (parity: plasma buffers unpin on Python-object GC).
+                return deserialize_object(pinned.view)
+            if vb is not None:
+                st.last_access = time.monotonic()
+                return deserialize_object(vb)
+            if spilled is not None:
+                # Restore from disk (parity: LocalObjectManager restore
+                # via IO workers; here a direct read).  The restored
+                # bytes are not re-admitted — a hot object will be
+                # re-put by its producer pattern, and not re-admitting
+                # avoids spill↔restore thrash under sustained pressure.
+                try:
+                    data = self._external_storage().restore(spilled)
+                except OSError:
+                    # The spilled_uri snapshot raced a concurrent
+                    # invalidate() (node death deletes spill files).  If
+                    # the representation changed in that window — the
+                    # object was marked lost, or reconstruction already
+                    # re-sealed it — loop back to the wait/reconstruct
+                    # path instead of surfacing a spurious
+                    # ObjectLostError.
+                    with self._lock:
+                        changed = (st.lost or not st.event.is_set()
+                                   or st.spilled_uri != spilled)
+                    if changed:
+                        continue
+                    raise ObjectLostError(
+                        f"object {oid.hex()}: spilled copy unreadable"
+                    ) from None
+                self.spill_stats["restored_objects"] += 1
+                self.spill_stats["restored_bytes"] += len(data)
+                return deserialize_object(data)
+            return in_band
 
     def wait(
         self,

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
@@ -400,10 +401,14 @@ def make_groupby(key: str, agg_fn, name: str) -> AllToAllOp:
                 # (parity: the reference groups null keys separately;
                 # an entire keyless block has nothing to group on).
                 return [{} for _ in range(k)]
-            # Stable hash per group value → same key lands in the same
-            # partition across blocks.
+            # Deterministic hash per group value → same key lands in the
+            # same partition across blocks AND across worker processes
+            # (Python's hash() is randomized per process via
+            # PYTHONHASHSEED; the reference uses stable key hashing for
+            # its shuffle).
             codes = np.asarray(
-                [hash(str(v)) % k for v in block[key]], dtype=np.int64
+                [zlib.crc32(str(v).encode()) % k for v in block[key]],
+                dtype=np.int64,
             )
             return [acc.take_rows(np.nonzero(codes == j)[0])
                     for j in range(k)]

@@ -133,11 +133,30 @@ def ensure_local(uri: str) -> str:
     zip_path = os.path.join(_cache_dir(), name)
     out_dir = os.path.join(_cache_dir(), name[:-len(".zip")])
     if not os.path.isdir(out_dir):
-        tmp = out_dir + ".tmp"
-        shutil.rmtree(tmp, ignore_errors=True)
-        with zipfile.ZipFile(zip_path) as z:
-            z.extractall(tmp)
-        os.replace(tmp, out_dir)
+        # Extract into a UNIQUE temp dir, then atomically install: two
+        # concurrent extractors (threads or worker processes) each get
+        # their own staging dir, so neither can rmtree the other
+        # mid-extract; the loser of os.replace just discards its copy.
+        tmp = tempfile.mkdtemp(prefix=name + ".", dir=_cache_dir())
+        try:
+            with zipfile.ZipFile(zip_path) as z:
+                for member in z.namelist():
+                    # Zip-slip guard: refuse absolute paths and ".."
+                    # escapes from cache-resident archives.
+                    p = os.path.normpath(member)
+                    if p == ".." or p.startswith("../") or os.path.isabs(p):
+                        raise ValueError(
+                            f"unsafe path in package {name!r}: {member!r}")
+                z.extractall(tmp)
+            try:
+                os.replace(tmp, out_dir)
+            except OSError:
+                if not os.path.isdir(out_dir):  # lost a benign race?
+                    raise
+                shutil.rmtree(tmp, ignore_errors=True)
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
     return out_dir
 
 

@@ -127,6 +127,9 @@ class ClientContext:
         host, port = address.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
+        from ray_tpu.util.client.common import client_handshake
+
+        client_handshake(self._sock)
         self._sock.settimeout(None)
         self._lock = threading.Lock()  # one in-flight request at a time
         self._release_lock = threading.Lock()

@@ -4,18 +4,76 @@ Parity: the message surface of ray_client.proto (DataRequest/Response —
 put/get/wait/task/actor/terminate ops), collapsed to a minimal framed
 dict protocol (this build avoids a gRPC dependency; see
 util/client/__init__.py).
+
+TRUST BOUNDARY: frames are cloudpickle — deserializing one executes
+arbitrary code, exactly like the reference's ``ray://`` trust model
+(anyone who can speak the protocol owns the server).  The server binds
+to 127.0.0.1 by default, and when ``RAYTPU_CLIENT_TOKEN`` is set both
+ends must prove knowledge of the shared secret via an HMAC
+challenge/response BEFORE the first pickle frame is parsed.  Set a
+token whenever the server binds a non-loopback interface.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
 import socket
 import struct
-from typing import Any
+from typing import Any, Optional
 
 import cloudpickle
 
 _LEN = struct.Struct(">Q")
 MAX_FRAME = 1 << 31
+_NONCE_LEN = 32
+TOKEN_ENV = "RAYTPU_CLIENT_TOKEN"
+
+
+def _digest(token: str, nonce: bytes) -> bytes:
+    return hmac.new(token.encode(), nonce, hashlib.sha256).digest()
+
+
+def server_handshake(sock: socket.socket,
+                     token: Optional[str] = None) -> bool:
+    """Challenge the peer before any pickle crosses the wire.
+
+    No token configured → no-op (loopback trust, documented above).
+    Returns False (caller should drop the connection) on a bad proof.
+    """
+    token = token if token is not None else os.environ.get(TOKEN_ENV)
+    if not token:
+        return True
+    nonce = os.urandom(_NONCE_LEN)
+    sock.sendall(b"RTPU" + nonce)
+    try:
+        proof = _recv_exact(sock, 32)
+    except (ConnectionError, OSError):
+        return False
+    return hmac.compare_digest(proof, _digest(token, nonce))
+
+
+def client_handshake(sock: socket.socket,
+                     token: Optional[str] = None) -> None:
+    """Answer the server's challenge (symmetric to server_handshake)."""
+    token = token if token is not None else os.environ.get(TOKEN_ENV)
+    if not token:
+        return
+    try:
+        head = _recv_exact(sock, 4 + _NONCE_LEN)
+    except (TimeoutError, socket.timeout) as e:
+        # A tokenless server sends no challenge at all — convert the
+        # silent mutual wait into an actionable error.
+        raise ConnectionError(
+            "timed out waiting for the server's token challenge — the "
+            "server likely has no RAYTPU_CLIENT_TOKEN configured while "
+            "this client does"
+        ) from e
+    if head[:4] != b"RTPU":
+        raise ConnectionError("server did not offer a token handshake "
+                              "(is RAYTPU_CLIENT_TOKEN set on both ends?)")
+    sock.sendall(_digest(token, head[4:]))
 
 
 def send_msg(sock: socket.socket, obj: Any) -> None:

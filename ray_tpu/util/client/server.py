@@ -28,11 +28,18 @@ class _ClientSession:
 
 class ClientServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
-                 num_cpus: Optional[float] = None):
+                 num_cpus: Optional[float] = None,
+                 token: Optional[str] = None):
+        import os
+
         import ray_tpu
 
         if not ray_tpu.is_initialized():
             ray_tpu.init(num_cpus=num_cpus, ignore_reinit_error=True)
+        # Frozen at construction so a later env change (or a client
+        # sharing this process in tests) can't alter the server's secret.
+        self._token = token if token is not None \
+            else os.environ.get("RAYTPU_CLIENT_TOKEN", "")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -75,6 +82,11 @@ class ClientServer:
             ).start()
 
     def _serve_client(self, conn: socket.socket) -> None:
+        from ray_tpu.util.client.common import server_handshake
+
+        if not server_handshake(conn, self._token):
+            conn.close()
+            return
         session = _ClientSession()
         try:
             while True:

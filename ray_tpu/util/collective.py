@@ -53,6 +53,18 @@ class _RendezvousStore:
         self._cv = threading.Condition(self._lock)
         self._rounds: Dict[str, Dict[int, Any]] = {}
         self._consumed: Dict[str, int] = {}
+        self._abandoned: Dict[str, int] = {}
+
+    def _retire(self, key: str) -> None:
+        """Drop a round once every rank has either consumed it or timed
+        out waiting on it — bounds memory without wedging latecomers
+        (a timed-out rank's value stays deposited so stragglers can
+        still complete the round)."""
+        if self._consumed.get(key, 0) + self._abandoned.get(key, 0) \
+                >= self._world:
+            self._rounds.pop(key, None)
+            self._consumed.pop(key, None)
+            self._abandoned.pop(key, None)
 
     def exchange(self, key: str, rank: int, value, timeout: float = 60.0):
         """Deposit this rank's value; returns {rank: value} once all
@@ -70,9 +82,17 @@ class _RendezvousStore:
                 timeout=timeout,
             )
             if not ok:
+                arrived = len(rnd)
+                # Leave this rank's value in place (stragglers may still
+                # complete the round) but count the abandonment so a
+                # round every rank has given up on is garbage-collected
+                # instead of leaking forever.
+                if key in self._rounds:
+                    self._abandoned[key] = self._abandoned.get(key, 0) + 1
+                    self._retire(key)
                 raise TimeoutError(
                     f"collective round {key!r}: only "
-                    f"{len(rnd)}/{self._world} ranks arrived in {timeout}s"
+                    f"{arrived}/{self._world} ranks arrived in {timeout}s"
                 )
             # Read from the captured round dict: the world-th reader
             # deletes the registry entry, and a descheduled straggler
@@ -80,9 +100,7 @@ class _RendezvousStore:
             out = dict(rnd)
             if key in self._rounds:
                 self._consumed[key] = self._consumed.get(key, 0) + 1
-                if self._consumed[key] >= self._world:
-                    del self._rounds[key]
-                    del self._consumed[key]
+                self._retire(key)
             return out
 
     def put_p2p(self, key: str, value) -> None:

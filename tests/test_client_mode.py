@@ -111,3 +111,22 @@ def test_cross_process_server(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_token_handshake(monkeypatch):
+    """RAYTPU_CLIENT_TOKEN gates the connection: matching secret works,
+    a wrong secret is dropped before any pickle frame is parsed."""
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    server = ClientServer(token="s3cret").start()
+    monkeypatch.setenv("RAYTPU_CLIENT_TOKEN", "s3cret")
+    try:
+        c = connect(server.address)
+        assert c.get(c.put(41)) == 41
+        c.disconnect()
+
+        monkeypatch.setenv("RAYTPU_CLIENT_TOKEN", "wrong")
+        with pytest.raises((ConnectionError, OSError)):
+            connect(server.address, timeout=5)
+    finally:
+        server.stop()
+        ray_tpu.shutdown()
