@@ -140,13 +140,7 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 
 def get_actor(name: str) -> ActorHandle:
-    from ray_tpu.core.actor import collect_method_num_returns
-
-    rt = runtime()
-    actor_id = rt.get_named_actor(name)
-    shell = rt._actors.get(actor_id)
-    cls_name = shell.cls.__name__ if shell else "unknown"
-    table = collect_method_num_returns(shell.cls) if shell else {}
+    actor_id, cls_name, table = runtime().named_actor_handle(name)
     return ActorHandle(actor_id, cls_name, table)
 
 

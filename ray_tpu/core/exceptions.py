@@ -64,6 +64,18 @@ class ObjectLostError(RayTpuError):
                          f"reconstructed")
 
 
+class WorkerDiedError(RayTpuError):
+    """The OS worker process executing a task died (crash, kill -9, OOM
+    kill).  Retriable: the task is resubmitted per max_retries (parity:
+    WorkerCrashedError, python/ray/exceptions.py)."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(
+            f"the worker process executing the task died unexpectedly"
+            f"{': ' + detail if detail else ''}"
+        )
+
+
 class RuntimeNotInitializedError(RayTpuError):
     def __init__(self):
         super().__init__(

@@ -334,10 +334,11 @@ class _ActorShell:
             if ctid is not None:
                 ev.record(ctid.hex(), _ev.FAILED, attempt=attempt,
                           error_message=repr(e))
-            self.runtime.store.put_error(
-                self._creation_oid,
-                ActorDiedError(repr(self.cls), self.death_reason),
-            )
+            err = ActorDiedError(repr(self.cls), self.death_reason)
+            self.runtime.store.put_error(self._creation_oid, err)
+            # Methods queued while __init__ was still running must fail,
+            # not hang (submissions after death are rejected in submit()).
+            self._drain(err)
             self.runtime._on_actor_death(self)
             return
         # max_concurrency > 1: a pool of threads drains the same queue, so
@@ -378,49 +379,67 @@ class _ActorShell:
                           type=_ev.ACTOR_TASK, actor_id=self.actor_id.hex(),
                           node_id=(self.node_id.hex() if self.node_id
                                    else None),
-                          worker=threading.current_thread().name)
+                          worker=self._worker_label())
             try:
-                resolved_args, resolved_kwargs = self.runtime.resolve_args(
-                    args, kwargs
-                )
-                method = getattr(self.instance, method_name)
-                ctx = getattr(self, "_env_ctx", None)
-                # Env covers the whole body, including a streaming
-                # method's lazy generator execution.
-                with (ctx.applied() if ctx is not None
-                      else contextlib.nullcontext()), \
-                        _tracing().task_span(qname, trace_ctx,
-                                           {"task_id": task_hex or ""}):
-                    result = method(*resolved_args, **resolved_kwargs)
-                    if _inspect.iscoroutine(result):
-                        import asyncio
-
-                        result = asyncio.run(result)
-                    if num_returns == "streaming":
-                        self.runtime._stream_results(result, task_id,
-                                                     qname)
-                if num_returns != "streaming":
-                    self.runtime._store_results(result, return_ids,
-                                                num_returns)
+                self._execute_item(qname, method_name, args, kwargs,
+                                   return_ids, num_returns, task_id,
+                                   trace_ctx, task_hex)
                 if task_hex:
                     ev.record(task_hex, _ev.FINISHED)
             except BaseException as e:
                 if task_hex:
                     ev.record(task_hex, _ev.FAILED, error_message=repr(e))
-                err = TaskError(f"{self.cls.__name__}.{method_name}", e)
+                err = self._item_error(qname, e)
                 for oid in return_ids:
                     self.runtime.store.put_error(oid, err)
                 if num_returns == "streaming" and task_id is not None:
-                    # See the streaming failure note in _start_task.
-                    self.runtime.store.put_error_if_pending(
-                        ObjectID.for_task_return(task_id, 0), err
-                    )
-                if not isinstance(e, Exception):
-                    # actor dies on SystemExit et al
-                    self.dead = True
-                    self.death_reason = repr(e)
-                    self.queue.put(None)
+                    # Seal at the first unsealed index (a worker may
+                    # already have produced a prefix of the stream) so
+                    # the consumer's next() unblocks with the error.
+                    self.runtime._seal_stream_failure(task_id, err)
+                if self._after_item_error(e):
                     return
+
+    def _worker_label(self) -> str:
+        return threading.current_thread().name
+
+    def _execute_item(self, qname, method_name, args, kwargs, return_ids,
+                      num_returns, task_id, trace_ctx, task_hex):
+        """Run one dequeued method call; overridden by the process
+        shell to push it to the actor's worker process."""
+        resolved_args, resolved_kwargs = self.runtime.resolve_args(
+            args, kwargs
+        )
+        method = getattr(self.instance, method_name)
+        ctx = getattr(self, "_env_ctx", None)
+        # Env covers the whole body, including a streaming method's
+        # lazy generator execution.
+        with (ctx.applied() if ctx is not None
+              else contextlib.nullcontext()), \
+                _tracing().task_span(qname, trace_ctx,
+                                     {"task_id": task_hex or ""}):
+            result = method(*resolved_args, **resolved_kwargs)
+            if _inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)
+            if num_returns == "streaming":
+                self.runtime._stream_results(result, task_id, qname)
+        if num_returns != "streaming":
+            self.runtime._store_results(result, return_ids, num_returns)
+
+    def _item_error(self, qname: str, e: BaseException) -> BaseException:
+        return TaskError(qname, e)
+
+    def _after_item_error(self, e: BaseException) -> bool:
+        """True → stop serving (the loop returns)."""
+        if not isinstance(e, Exception):
+            # actor dies on SystemExit et al
+            self.dead = True
+            self.death_reason = repr(e)
+            self.queue.put(None)
+            return True
+        return False
 
     def _drain(self, err: BaseException):
         while True:
@@ -463,6 +482,123 @@ class _ActorShell:
         self.no_restart = no_restart
         self.death_reason = "killed via ray_tpu.kill"
         self.queue.put(None)
+
+
+class _RemoteInstance:
+    """Truthy sentinel: the actor's real instance lives in a worker
+    process; drivers only know it was constructed."""
+
+    def __repr__(self):
+        return "<instance in worker process>"
+
+
+_REMOTE_INSTANCE = _RemoteInstance()
+
+
+class _ProcessActorShell(_ActorShell):
+    """Actor hosted in a dedicated OS worker process (parity: each actor
+    is its own worker process, gcs_actor_scheduler.cc LeaseWorkerFromNode
+    → the actor owns that worker for life).  The driver side keeps the
+    same queue/ordering/restart machinery as the in-process shell; only
+    construction and method execution cross the process boundary.
+
+    Crash semantics the thread shell cannot give: kill -9 of the worker
+    → in-flight calls fail with ActorDiedError and the restart FSM
+    re-leases a fresh process; ray_tpu.kill() preemptively terminates
+    the process, interrupting even a stuck method."""
+
+    def _construct(self):
+        import cloudpickle as _cp
+
+        pool = self.runtime.worker_pool
+        wh = pool.lease(dedicated=True)
+        try:
+            # Init args ship raw — ObjectRefs stay refs, matching the
+            # thread shell (the instance resolves them itself if/when
+            # it wants the values).
+            wh.call(
+                "actor_create",
+                spec=_cp.dumps((self.cls, self.init_args,
+                                self.init_kwargs)),
+                env=self.options.runtime_env,
+                env_plugins=self.runtime._ship_env(
+                    self.options.runtime_env),
+                max_concurrency=self.options.max_concurrency,
+            )
+        except BaseException:
+            # A half-constructed worker may hold broken state — never
+            # return it to the pool.
+            wh.terminate(graceful=False)
+            raise
+        self._worker = wh
+        wh.on_death = self._worker_died
+        self._env_ctx = None  # env is applied worker-side
+        self.instance = _REMOTE_INSTANCE
+
+    def _worker_died(self):
+        if self.dead:
+            return
+        self.dead = True
+        self.death_reason = "worker process died"
+        self.queue.put(None)
+
+    def _worker_label(self) -> str:
+        return f"pid-{getattr(self._worker, 'pid', '?')}"
+
+    def _execute_item(self, qname, method_name, args, kwargs, return_ids,
+                      num_returns, task_id, trace_ctx, task_hex):
+        import cloudpickle as _cp
+
+        wire_args, wire_kwargs = self.runtime._wire_args(args, kwargs)
+        with _tracing().task_span(qname, trace_ctx,
+                                  {"task_id": task_hex or ""}):
+            rep = self._worker.call(
+                "actor_task", method=method_name,
+                spec=_cp.dumps((wire_args, wire_kwargs)),
+                num_returns=num_returns,
+                returns=[oid.binary() for oid in return_ids],
+                task=(task_id.binary() if task_id is not None else b""),
+                trace_ctx=_tracing().capture_context(),
+            )
+        if num_returns != "streaming":
+            for oid, (kind, payload) in zip(return_ids, rep["results"]):
+                if kind == "shm":
+                    self.runtime.store.mark_shm_sealed(oid, payload)
+                else:
+                    self.runtime.store.put_serialized(oid, payload)
+
+    def _item_error(self, qname: str, e: BaseException) -> BaseException:
+        from ray_tpu.core.exceptions import WorkerDiedError
+
+        if isinstance(e, WorkerDiedError):
+            return ActorDiedError(repr(self.cls), "worker process died")
+        return TaskError(qname, e)
+
+    def _after_item_error(self, e: BaseException) -> bool:
+        from ray_tpu.core.exceptions import WorkerDiedError
+
+        if isinstance(e, WorkerDiedError):
+            self._worker_died()
+            return False  # drain remaining items fast via dead calls
+        # SystemExit et al raised worker-side and transported here —
+        # mirror the thread shell.
+        return super()._after_item_error(e)
+
+    def _drain(self, err: BaseException):
+        wh = getattr(self, "_worker", None)
+        if wh is not None:
+            wh.on_death = None
+            wh.terminate(graceful=not wh.dead)
+            self._worker = None
+        super()._drain(err)
+
+    def kill(self, no_restart: bool = True):
+        super().kill(no_restart)
+        # Preemptive: a stuck or long-running method dies with the
+        # process (the thread shell can only ask nicely).
+        wh = getattr(self, "_worker", None)
+        if wh is not None:
+            wh.terminate(graceful=False)
 
 
 @dataclasses.dataclass
@@ -545,6 +681,16 @@ class LocalRuntime:
         # Readers hitting a lost object trigger lazy lineage
         # reconstruction (parity: recovery on fetch failure).
         self.store.lost_object_callback = self._reconstruct_object
+        # Execution backend: thread (in-process) or pooled OS worker
+        # processes over the shared-memory object plane (parity: the
+        # raylet's WorkerPool of forked language workers,
+        # raylet/worker_pool.h:156).  RAYTPU_WORKERS=process.
+        self.worker_mode = cfg.workers
+        self.worker_pool = None
+        if self.worker_mode == "process":
+            from ray_tpu.core.worker_pool import WorkerPool
+
+            self.worker_pool = WorkerPool(self)
         self.head_node_id = self.add_node(total, labels)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="dispatcher", daemon=True
@@ -701,9 +847,34 @@ class LocalRuntime:
     # -- objects -----------------------------------------------------------
 
     def put(self, value: Any) -> ObjectRef:
-        oid = ObjectID.from_put(self.driver_task_id, next(self._put_counter))
+        oid = self.alloc_put_oid()
         self.store.put_value(oid, value)
         return ObjectRef(oid)
+
+    def alloc_put_oid(self) -> ObjectID:
+        """Fresh put-object id (also used for worker-side puts that
+        write the bytes directly into the shared arena)."""
+        return ObjectID.from_put(self.driver_task_id,
+                                 next(self._put_counter))
+
+    def _wire_args(self, args: tuple, kwargs: dict):
+        """Replace top-level ObjectRef args with their WIRE
+        representation for shipping to a worker process — shared-arena
+        pointers for large objects, framed bytes otherwise.  Never
+        deserializes here (the worker does the one decode); sealed
+        errors re-raise, matching resolve_args semantics."""
+        from ray_tpu.core.wire import WireRef
+
+        def enc(v):
+            if not isinstance(v, ObjectRef):
+                return v
+            kind, payload = self.store.get_wire(v.id)
+            if kind == "err":
+                raise payload
+            return WireRef(kind, payload, v.id.binary())
+
+        return (tuple(enc(a) for a in args),
+                {k: enc(v) for k, v in kwargs.items()})
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -784,6 +955,23 @@ class LocalRuntime:
         self.store.put_error(
             ObjectID.for_task_return(task_id, i), EndOfStream()
         )
+
+    def _seal_stream_failure(self, task_id: TaskID,
+                             err: BaseException) -> None:
+        """Seal ``err`` at the first UNSEALED stream index.  A worker
+        process that dies mid-stream leaves a sealed prefix [0, k);
+        sealing only index 0 would leave a consumer already past it
+        blocked forever on index k."""
+        i = 0
+        while True:
+            oid = ObjectID.for_task_return(task_id, i)
+            if self.store.put_error_if_pending(oid, err):
+                return
+            if self.store.peek_error(oid) is not None:
+                # Already ended (error or EndOfStream sentinel) — the
+                # consumer can't hang; don't clobber.
+                return
+            i += 1
 
     # -- scheduling --------------------------------------------------------
 
@@ -899,8 +1087,37 @@ class LocalRuntime:
 
     # -- tasks -------------------------------------------------------------
 
+    def _ship_env(self, renv):
+        """Worker-bound runtime-env payload: plugins named by the env
+        ship by value so the worker can materialize them (parity: the
+        reference distributes plugin setup through the per-node
+        runtime-env agent).  The pickled blob is memoized per
+        (plugin set, registry version) — NOT re-pickled per dispatch."""
+        if not renv:
+            return None
+        try:
+            names = frozenset(renv.keys())
+        except AttributeError:
+            return None
+        from ray_tpu import runtime_env as _re
+
+        used = frozenset(k for k in _re._plugins if k in names)
+        if not used:
+            return None
+        key = (used, _re._plugins_version)
+        cache = getattr(self, "_env_plugin_cache", None)
+        if cache is None or cache[0] != key:
+            import cloudpickle
+
+            cache = (key, cloudpickle.dumps(
+                {k: _re._plugins[k] for k in used}))
+            self._env_plugin_cache = cache
+        return cache[1]
+
     def submit_task(self, fn: Callable, args: tuple, kwargs: dict,
-                    options: TaskOptions) -> List[ObjectRef]:
+                    options: TaskOptions,
+                    trace_ctx: Optional[Dict[str, str]] = None
+                    ) -> List[ObjectRef]:
         demand = options.resource_demand()
         strategy = options.effective_strategy()
         if (not isinstance(strategy, PlacementGroupSchedulingStrategy)
@@ -924,7 +1141,8 @@ class LocalRuntime:
             retries_left=0 if streaming else options.max_retries,
             task_id=task_id, function_name=getattr(fn, "__name__", repr(fn)),
             streaming=streaming,
-            trace_ctx=_tracing().capture_context(),
+            trace_ctx=(trace_ctx if trace_ctx is not None
+                       else _tracing().capture_context()),
         )
         self.events.record(
             task_id.hex(), _ev.PENDING_NODE_ASSIGNMENT,
@@ -1007,26 +1225,36 @@ class LocalRuntime:
                 required_resources=pt.options.resource_demand(),
             )
             try:
-                args, kwargs = self.resolve_args(pt.args, pt.kwargs)
-                if pt.options.runtime_env:
-                    from ray_tpu.runtime_env import materialize
-
-                    env_cm = materialize(pt.options.runtime_env).applied()
+                if self.worker_pool is not None:
+                    with _tracing().task_span(
+                        pt.function_name, pt.trace_ctx,
+                        {"task_id": pt.task_id.hex(), "attempt": attempt},
+                    ):
+                        self._execute_task_remote(pt)
                 else:
-                    env_cm = contextlib.nullcontext()
-                # The env must cover the whole body — for a streaming
-                # task the generator body runs inside _stream_results.
-                with env_cm, _tracing().task_span(
-                    pt.function_name, pt.trace_ctx,
-                    {"task_id": pt.task_id.hex(), "attempt": attempt},
-                ):
-                    result = pt.fn(*args, **kwargs)
-                    if pt.streaming:
-                        self._stream_results(result, pt.task_id,
-                                             pt.function_name)
+                    args, kwargs = self.resolve_args(pt.args, pt.kwargs)
+                    if pt.options.runtime_env:
+                        from ray_tpu.runtime_env import materialize
+
+                        env_cm = materialize(
+                            pt.options.runtime_env).applied()
+                    else:
+                        env_cm = contextlib.nullcontext()
+                    # The env must cover the whole body — for a
+                    # streaming task the generator body runs inside
+                    # _stream_results.
+                    with env_cm, _tracing().task_span(
+                        pt.function_name, pt.trace_ctx,
+                        {"task_id": pt.task_id.hex(), "attempt": attempt},
+                    ):
+                        result = pt.fn(*args, **kwargs)
+                        if pt.streaming:
+                            self._stream_results(result, pt.task_id,
+                                                 pt.function_name)
+                    if not pt.streaming:
+                        self._store_results(result, pt.return_ids,
+                                            pt.options.num_returns)
                 if not pt.streaming:
-                    self._store_results(result, pt.return_ids,
-                                        pt.options.num_returns)
                     if alloc.node is not None:
                         with self._lock:
                             for oid in pt.return_ids:
@@ -1038,12 +1266,13 @@ class LocalRuntime:
                 self.events.record(pt.task_id.hex(), _ev.FAILED,
                                    attempt=attempt, error_message=repr(e))
                 if pt.streaming:
-                    # Failures before _stream_results sealed anything
-                    # (arg resolution, calling the function) must still
-                    # unblock the consumer; mid-stream failures already
-                    # sealed the failing index.
-                    self.store.put_error_if_pending(
-                        ObjectID.for_task_return(pt.task_id, 0),
+                    # Failures before/inside the stream must unblock the
+                    # consumer at the first unsealed index (a worker
+                    # process may have died after producing a prefix;
+                    # in-process failures already sealed the failing
+                    # index, making this a no-op there).
+                    self._seal_stream_failure(
+                        pt.task_id,
                         e if isinstance(e, TaskError)
                         else TaskError(pt.function_name, e),
                     )
@@ -1071,6 +1300,39 @@ class LocalRuntime:
         threading.Thread(
             target=run, name=f"task-{pt.function_name}", daemon=True
         ).start()
+
+    def _execute_task_remote(self, pt: _PendingTask) -> None:
+        """Run one task on a leased worker process (parity: OnWorkerIdle
+        pushing onto a leased worker, direct_task_transport.cc:191 →
+        HandlePushTask, core_worker.cc:3072).  Raises the worker-side
+        exception (or WorkerDiedError on a crash) so the caller's retry
+        path treats remote failures exactly like local ones."""
+        import cloudpickle
+
+        wire_args, wire_kwargs = self._wire_args(pt.args, pt.kwargs)
+        spec = cloudpickle.dumps((pt.fn, wire_args, wire_kwargs))
+        wh = self.worker_pool.lease()
+        try:
+            rep = wh.call(
+                "task", spec=spec, name=pt.function_name,
+                streaming=pt.streaming, task=pt.task_id.binary(),
+                num_returns=pt.options.num_returns,
+                returns=[oid.binary() for oid in pt.return_ids],
+                env=pt.options.runtime_env,
+                env_plugins=self._ship_env(pt.options.runtime_env),
+                # Capture INSIDE the driver-side task span so nested
+                # submissions from the worker parent to this task.
+                trace_ctx=_tracing().capture_context(),
+            )
+        finally:
+            self.worker_pool.release(wh)
+        if pt.streaming:
+            return  # the worker sealed every index + the sentinel
+        for oid, (kind, payload) in zip(pt.return_ids, rep["results"]):
+            if kind == "shm":
+                self.store.mark_shm_sealed(oid, payload)
+            else:
+                self.store.put_serialized(oid, payload)
 
     def _notify(self):
         with self._dispatch_cv:
@@ -1107,8 +1369,10 @@ class LocalRuntime:
         actor_id = ActorID.of(self.job_id)
         creation_task_id = TaskID.of(actor_id)
         creation_oid = ObjectID.for_task_return(creation_task_id, 0)
-        shell = _ActorShell(self, actor_id, cls, args, kwargs, options,
-                            creation_oid, alloc)
+        shell_cls = (_ProcessActorShell if self.worker_pool is not None
+                     else _ActorShell)
+        shell = shell_cls(self, actor_id, cls, args, kwargs, options,
+                          creation_oid, alloc)
         shell.creation_task_id = creation_task_id
         self.events.record(
             creation_task_id.hex(), _ev.PENDING_NODE_ASSIGNMENT,
@@ -1130,7 +1394,8 @@ class LocalRuntime:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict,
-                          num_returns: Any = 1):
+                          num_returns: Any = 1,
+                          trace_ctx: Optional[Dict[str, str]] = None):
         with self._lock:
             shell = self._actors.get(actor_id)
         task_id = TaskID.of(actor_id)
@@ -1154,7 +1419,9 @@ class LocalRuntime:
                 actor_id=actor_id.hex(),
             )
             shell.submit(method_name, args, kwargs, return_ids, num_returns,
-                         task_id, _tracing().capture_context())
+                         task_id,
+                         trace_ctx if trace_ctx is not None
+                         else _tracing().capture_context())
         if streaming:
             from ray_tpu.core.generator import ObjectRefGenerator
 
@@ -1175,6 +1442,20 @@ class LocalRuntime:
         if actor_id is None:
             raise ValueError(f"no actor named {name!r}")
         return actor_id
+
+    def named_actor_handle(self, name: str):
+        """(actor_id, class name, @method num_returns table) for handle
+        re-hydration — the same lookup worker processes do over RPC."""
+        from ray_tpu.core.actor import collect_method_num_returns
+
+        actor_id = self.get_named_actor(name)
+        with self._lock:
+            shell = self._actors.get(actor_id)
+        return (
+            actor_id,
+            shell.cls.__name__ if shell else "unknown",
+            collect_method_num_returns(shell.cls) if shell else {},
+        )
 
     def _on_actor_death(self, shell: _ActorShell):
         # Restart (parity: GCS actor FSM RESTARTING→ALIVE, gcs.proto actor
@@ -1517,4 +1798,6 @@ class LocalRuntime:
         for shell in actors:
             shell.restarts_left = 0
             shell.kill()
+        if self.worker_pool is not None:
+            self.worker_pool.shutdown()
         self.store.close()

@@ -142,21 +142,26 @@ class LocalObjectStore:
             if shm is None:
                 out = bytearray(size)
                 write_framed(memoryview(out), meta, buffers)
-                st.last_access = time.monotonic()
-                with self._lock:
-                    # Re-puts (actor restart re-sealing its creation
-                    # oid, reconstruction) replace the old bytes — the
-                    # ledger must not count both copies.
-                    if st.value_bytes is not None:
-                        self._inproc_bytes -= len(st.value_bytes)
-                    st.value_bytes = bytes(out)
-                    self._inproc_bytes += size
+                self._store_inline(st, bytes(out))
         else:
             st.in_band = value
         st.lost = False
         st.event.set()
         if self._inproc_bytes > self._inproc_cap:
             self._spill_cold_objects()
+
+    def _store_inline(self, st, data: bytes) -> None:
+        """Account framed bytes into the in-process tier (shared by
+        put_value's fallback and put_serialized)."""
+        st.last_access = time.monotonic()
+        with self._lock:
+            # Re-puts (actor restart re-sealing its creation oid,
+            # reconstruction) replace the old bytes — the ledger must
+            # not count both copies.
+            if st.value_bytes is not None:
+                self._inproc_bytes -= len(st.value_bytes)
+            st.value_bytes = data
+            self._inproc_bytes += len(data)
 
     def _spill_cold_objects(self) -> None:
         """Spill least-recently-used sealed in-process objects until the
@@ -207,6 +212,91 @@ class LocalObjectStore:
         st.error = error
         st.lost = False
         st.event.set()
+
+    # -- wire plane (multi-process workers) --------------------------------
+
+    def shm_name(self) -> Optional[str]:
+        """Force-build the native store and return its segment name so
+        worker processes can attach (parity: plasma socket name handed
+        to workers at registration)."""
+        shm = self._shm_store()
+        return shm.name if shm is not None else None
+
+    @property
+    def shm_threshold(self) -> int:
+        return self._shm_threshold
+
+    def put_serialized(self, oid: ObjectID, data) -> None:
+        """Seal already-serialized (framed) bytes — the path for values
+        produced in a worker process and shipped over the socket."""
+        st = self._state(oid)
+        data = bytes(data)
+        size = len(data)
+        shm = self._shm_store() if size >= self._shm_threshold else None
+        if shm is not None:
+            try:
+                shm.put_bytes(oid.binary(), data)
+                st.in_shm = True
+                st.shm_size = size
+                st.last_access = time.monotonic()
+            except Exception:
+                shm = None
+        if shm is None:
+            self._store_inline(st, data)
+        st.lost = False
+        st.event.set()
+        if self._inproc_bytes > self._inproc_cap:
+            self._spill_cold_objects()
+
+    def mark_shm_sealed(self, oid: ObjectID, size: int) -> None:
+        """A worker wrote+sealed this object directly into the shared
+        arena; record the location and wake waiters."""
+        st = self._state(oid)
+        st.in_shm = True
+        st.shm_size = size
+        st.lost = False
+        st.event.set()
+
+    def get_wire(self, oid: ObjectID, timeout: Optional[float] = None):
+        """Blocking fetch of an object's WIRE representation for a
+        worker: ("shm", size) — read it from the shared arena;
+        ("b", bytes) — framed serialized payload; ("err", exc) — sealed
+        error to re-raise.  Never deserializes the value (the worker
+        does the one decode)."""
+        st = self._state(oid)
+        while True:
+            ready, _ = self.wait([oid], 1, timeout)
+            if not ready:
+                raise GetTimeoutError(
+                    f"get timed out after {timeout}s for {oid.hex()}"
+                )
+            with self._lock:
+                if not st.event.is_set():
+                    # invalidate() raced between wait and snapshot —
+                    # loop back to the wait/reconstruction path (same
+                    # defense as get()).
+                    continue
+                err = st.error
+                if err is not None:
+                    return ("err", err)
+                if st.in_shm:
+                    return ("shm", st.shm_size)
+                vb = st.value_bytes
+                spilled = st.spilled_uri
+                in_band = st.in_band
+            break
+        if vb is not None:
+            st.last_access = time.monotonic()
+            return ("b", vb)
+        if spilled is not None:
+            data = self._external_storage().restore(spilled)
+            self.spill_stats["restored_objects"] += 1
+            self.spill_stats["restored_bytes"] += len(data)
+            return ("b", data)
+        # in-band (serialize_always=False configurations): one pickle hop.
+        from ray_tpu.utils.serialization import serialize_object
+
+        return ("b", serialize_object(in_band))
 
     def put_error_if_pending(self, oid: ObjectID,
                              error: BaseException) -> bool:

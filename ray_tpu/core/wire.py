@@ -1,0 +1,184 @@
+"""Bidirectional framed-message channel between driver and workers.
+
+Parity: the reference's driver↔worker plane is gRPC (ray:
+src/ray/rpc/grpc_server.h, core_worker.proto:417 PushTask etc.) plus a
+unix-socket raylet handshake.  Here both directions run over one
+AF_UNIX socket per worker with length-prefixed cloudpickle frames
+(ray_tpu/util/client/common.py) and message-id correlation, because the
+driver pushes work to workers AND workers call back into the driver's
+control plane (nested tasks, object gets) concurrently.
+
+Each request carries ``mid`` (unique per sender); the peer answers with
+a ``rep`` frame echoing the mid.  Incoming requests are dispatched on
+fresh threads so a blocking handler (e.g. a worker-side ``ray.get``
+waiting on an unsealed object) never stalls the reader loop.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.util.client.common import recv_msg, send_msg
+
+
+class ChannelClosedError(ConnectionError):
+    """The peer hung up (worker crash / driver shutdown)."""
+
+
+class WireRef:
+    """Marker for a resolved top-level ObjectRef argument in a shipped
+    task spec: ``kind`` is "shm" (read ``oid`` from the shared arena —
+    ``data`` is the size) or "b" (``data`` is the framed payload)."""
+
+    __slots__ = ("kind", "data", "oid")
+
+    def __init__(self, kind: str, data, oid: bytes):
+        self.kind = kind
+        self.data = data
+        self.oid = oid
+
+    def __reduce__(self):
+        return (WireRef, (self.kind, self.data, self.oid))
+
+
+class _Reply:
+    __slots__ = ("event", "ok", "value")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.ok = False
+        self.value: Any = None
+
+
+class MsgChannel:
+    """One socket, two directions, mid-correlated request/reply."""
+
+    def __init__(self, sock, handler: Callable[["MsgChannel", Dict], Any],
+                 name: str = "chan",
+                 on_close: Optional[Callable[[], None]] = None):
+        self._sock = sock
+        self._handler = handler
+        self._name = name
+        self.on_close = on_close
+        self._send_lock = threading.Lock()
+        self._mids = itertools.count(1)
+        self._pending: Dict[int, _Reply] = {}
+        self._pending_lock = threading.Lock()
+        self.closed = False
+        self._reader: Optional[threading.Thread] = None
+
+    def start(self) -> "MsgChannel":
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"{self._name}-reader", daemon=True
+        )
+        self._reader.start()
+        return self
+
+    # -- sending -----------------------------------------------------------
+
+    def _send(self, msg: Dict) -> None:
+        with self._send_lock:
+            if self.closed:
+                raise ChannelClosedError(f"{self._name}: channel closed")
+            send_msg(self._sock, msg)
+
+    def call(self, op: str, rpc_timeout: Optional[float] = None,
+             **payload) -> Any:
+        """Send a request and block for the reply.  Raises the peer's
+        exception on error replies, ChannelClosedError if the peer dies
+        first (the caller maps that to worker-death semantics).
+
+        ``rpc_timeout`` bounds THIS rpc (deliberately not named
+        ``timeout``: application-level timeouts like a store wait's
+        travel inside ``payload`` to be enforced by the peer)."""
+        mid = next(self._mids)
+        rep = _Reply()
+        with self._pending_lock:
+            if self.closed:
+                raise ChannelClosedError(f"{self._name}: channel closed")
+            self._pending[mid] = rep
+        try:
+            self._send({"mid": mid, "kind": "req", "op": op, **payload})
+        except (OSError, ChannelClosedError):
+            with self._pending_lock:
+                self._pending.pop(mid, None)
+            raise ChannelClosedError(f"{self._name}: send failed")
+        if not rep.event.wait(rpc_timeout):
+            with self._pending_lock:
+                self._pending.pop(mid, None)
+            raise TimeoutError(f"{self._name}: {op} timed out after "
+                               f"{rpc_timeout}s")
+        if rep.ok:
+            return rep.value
+        if isinstance(rep.value, BaseException):
+            raise rep.value
+        raise ChannelClosedError(f"{self._name}: {rep.value}")
+
+    # -- receiving ---------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                msg = recv_msg(self._sock)
+            except BaseException:
+                self._shutdown()
+                return
+            kind = msg.get("kind")
+            if kind == "rep":
+                with self._pending_lock:
+                    rep = self._pending.pop(msg.get("mid"), None)
+                if rep is not None:
+                    rep.ok = bool(msg.get("ok"))
+                    rep.value = msg.get("value") if rep.ok \
+                        else msg.get("error")
+                    rep.event.set()
+            elif kind == "req":
+                threading.Thread(
+                    target=self._run_handler, args=(msg,),
+                    name=f"{self._name}-{msg.get('op', '?')}", daemon=True,
+                ).start()
+
+    def _run_handler(self, msg: Dict) -> None:
+        mid = msg.get("mid")
+        try:
+            value = self._handler(self, msg)
+            rep = {"mid": mid, "kind": "rep", "ok": True, "value": value}
+        except BaseException as e:
+            rep = {"mid": mid, "kind": "rep", "ok": False, "error": e}
+        try:
+            self._send(rep)
+        except (OSError, ChannelClosedError):
+            pass
+        except Exception as e:  # unpicklable reply value
+            try:
+                self._send({"mid": mid, "kind": "rep", "ok": False,
+                            "error": RuntimeError(
+                                f"reply not serializable: {e!r}")})
+            except (OSError, ChannelClosedError):
+                pass
+
+    def _shutdown(self) -> None:
+        with self._pending_lock:
+            if self.closed:
+                return
+            self.closed = True
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for rep in pending:
+            rep.ok = False
+            rep.value = ChannelClosedError(f"{self._name}: peer hung up")
+            rep.event.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self.on_close is not None:
+            try:
+                self.on_close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._shutdown()

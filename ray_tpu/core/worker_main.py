@@ -1,0 +1,581 @@
+"""Worker process entry point + the worker-side runtime proxy.
+
+Parity: the per-process core worker (ray:
+src/ray/core_worker/core_worker.cc — ExecuteTask:2565, HandlePushTask:
+3072) and its Python task-execution callback (python/ray/_raylet.pyx:
+1448 execute_task).  A worker process:
+
+1. connects back to the driver's AF_UNIX socket using the one-time
+   spawn token (parity: worker registration with the raylet,
+   node_manager.cc:1292),
+2. receives the welcome payload (config snapshot, shared-memory arena
+   name, job id),
+3. installs a ``WorkerRuntime`` as the process-global runtime so that
+   any ``ray_tpu`` API call made by user code inside a task — nested
+   tasks, ``get``/``put``, actor creation — proxies to the driver's
+   control plane (parity: CoreWorker SubmitTask from within a worker),
+4. serves pushed work: plain tasks, actor construction, actor method
+   calls, until told to exit or its driver hangs up.
+
+Large values move through the C++ shared-memory store that the worker
+attaches by name — reads are zero-copy (pinned views over the mapped
+arena), writes land directly under the destination ObjectID so the
+driver only learns ("shm", size), never the bytes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import socket
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.wire import ChannelClosedError, MsgChannel, WireRef
+from ray_tpu.utils.ids import ActorID, ObjectID, TaskID
+from ray_tpu.utils.serialization import (
+    deserialize_object,
+    framed_size,
+    serialize_parts,
+    write_framed,
+)
+
+
+class _StoreProxy:
+    """The subset of LocalObjectStore the generator/consumer paths use,
+    proxied to the driver."""
+
+    def __init__(self, wr: "WorkerRuntime"):
+        self._wr = wr
+
+    def wait(self, oids: List[ObjectID], num_returns: int,
+             timeout: Optional[float]):
+        ready, pending = self._wr._chan.call(
+            "wait", oids=[o.binary() for o in oids],
+            num_returns=num_returns, timeout=timeout,
+        )
+        return [ObjectID(b) for b in ready], [ObjectID(b) for b in pending]
+
+    def peek_error(self, oid: ObjectID):
+        return self._wr._chan.call("peek_error", oid=oid.binary())
+
+    def contains(self, oid: ObjectID) -> bool:
+        return self._wr._chan.call("contains", oid=oid.binary())
+
+    def get(self, oid: ObjectID, timeout: Optional[float] = None):
+        return self._wr._fetch([oid.binary()], timeout)[0]
+
+
+class _KvProxy:
+    def __init__(self, wr: "WorkerRuntime"):
+        self._wr = wr
+
+    def put(self, key, value, *, overwrite: bool = True, namespace=None):
+        return self._wr._chan.call("kv_put", key=key, value=value,
+                                   overwrite=overwrite, namespace=namespace)
+
+    def get(self, key, *, namespace=None):
+        return self._wr._chan.call("kv_get", key=key, namespace=namespace)
+
+    def delete(self, key, *, namespace=None):
+        return self._wr._chan.call("kv_del", key=key, namespace=namespace)
+
+    def exists(self, key, *, namespace=None):
+        return self._wr._chan.call("kv_exists", key=key,
+                                   namespace=namespace)
+
+    def keys(self, prefix=b"", *, namespace=None):
+        return self._wr._chan.call("kv_keys", prefix=prefix,
+                                   namespace=namespace)
+
+
+class WorkerRuntime:
+    """Driver-API facade inside a worker process (parity: the worker's
+    CoreWorker — same surface as LocalRuntime for everything user code
+    can reach, implemented as RPCs to the owner/driver)."""
+
+    def __init__(self, chan: MsgChannel, shm, shm_threshold: int):
+        self._chan = chan
+        self._shm = shm
+        self._shm_threshold = shm_threshold
+        self.store = _StoreProxy(self)
+        self.kv = _KvProxy(self)
+
+    # -- objects -----------------------------------------------------------
+
+    def _read_shm(self, oid_bin: bytes):
+        """Deserialize one shared-arena object — zero-copy when this
+        worker attached the arena (views stay pinned until GC'd);
+        otherwise fall back to asking the driver for the bytes so a
+        worker whose attach failed degrades instead of crashing."""
+        if self._shm is not None:
+            pb = self._shm.get(oid_bin, timeout=5.0)
+            return deserialize_object(pb.view)
+        (kind, payload), = self._chan.call("get_raw", oids=[oid_bin],
+                                           no_shm=True)
+        if kind == "err":
+            raise payload
+        return deserialize_object(payload)
+
+    def _fetch(self, oid_bins: List[bytes],
+               timeout: Optional[float] = None) -> List[Any]:
+        entries = self._chan.call("get_raw", oids=oid_bins,
+                                  timeout=timeout,
+                                  no_shm=self._shm is None)
+        out = []
+        for b, (kind, payload) in zip(oid_bins, entries):
+            if kind == "err":
+                raise payload
+            if kind == "shm":
+                out.append(self._read_shm(b))
+            else:
+                out.append(deserialize_object(payload))
+        return out
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        out = self._fetch([r.id.binary() for r in ref_list], timeout)
+        return out[0] if single else out
+
+    def put(self, value: Any) -> ObjectRef:
+        meta, buffers = serialize_parts(value)
+        size = framed_size(meta, buffers)
+        if self._shm is not None and size >= self._shm_threshold:
+            oid_bin = self._chan.call("alloc_put_oid")
+            try:
+                buf = self._shm.create(oid_bin, size)
+                write_framed(buf, meta, buffers)
+                self._shm.seal(oid_bin)
+                self._chan.call("mark_shm", oid=oid_bin, size=size)
+                return ObjectRef(ObjectID(oid_bin))
+            except OSError:
+                pass  # arena full → inline fallback
+            out = bytearray(size)
+            write_framed(memoryview(out), meta, buffers)
+            self._chan.call("seal_value", oid=oid_bin,
+                            entry=("b", bytes(out)))
+            return ObjectRef(ObjectID(oid_bin))
+        out = bytearray(size)
+        write_framed(memoryview(out), meta, buffers)
+        oid_bin = self._chan.call("put_val", data=bytes(out))
+        return ObjectRef(ObjectID(oid_bin))
+
+    def wait(self, refs, num_returns: int, timeout: Optional[float],
+             fetch_local: bool = True):
+        ids = [r.id for r in refs]
+        ready_ids, pending_ids = self.store.wait(ids, num_returns, timeout)
+        by_id = {r.id: r for r in refs}
+        return ([by_id[i] for i in ready_ids],
+                [by_id[i] for i in pending_ids])
+
+    # -- tasks / actors ----------------------------------------------------
+
+    def submit_task(self, fn, args, kwargs, options):
+        from ray_tpu.util import tracing
+
+        rep = self._chan.call(
+            "submit_task", spec=cloudpickle.dumps((fn, args, kwargs)),
+            options=options, trace_ctx=tracing.capture_context(),
+        )
+        if "stream" in rep:
+            from ray_tpu.core.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(TaskID(rep["stream"]))
+        return [ObjectRef(ObjectID(b)) for b in rep["oids"]]
+
+    def create_actor(self, cls, args, kwargs, options):
+        rep = self._chan.call(
+            "create_actor", spec=cloudpickle.dumps((cls, args, kwargs)),
+            options=options,
+        )
+        import types
+
+        shell = types.SimpleNamespace(
+            actor_id=ActorID(rep["actor_id"]),
+            _creation_oid=ObjectID(rep["creation_oid"]),
+        )
+        return shell, ObjectRef(shell._creation_oid)
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args, kwargs, num_returns: Any = 1):
+        from ray_tpu.util import tracing
+
+        rep = self._chan.call(
+            "submit_actor_task", actor_id=actor_id.binary(),
+            method=method_name, spec=cloudpickle.dumps((args, kwargs)),
+            num_returns=num_returns, trace_ctx=tracing.capture_context(),
+        )
+        if "stream" in rep:
+            from ray_tpu.core.generator import ObjectRefGenerator
+
+            return ObjectRefGenerator(TaskID(rep["stream"]))
+        return [ObjectRef(ObjectID(b)) for b in rep["oids"]]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._chan.call("kill_actor", actor_id=actor_id.binary(),
+                        no_restart=no_restart)
+
+    def get_named_actor(self, name: str) -> ActorID:
+        return ActorID(self._chan.call("named_actor", name=name)
+                       ["actor_id"])
+
+    def named_actor_handle(self, name: str):
+        rep = self._chan.call("named_actor", name=name)
+        return ActorID(rep["actor_id"]), rep["cls_name"], rep["table"]
+
+    # -- placement groups --------------------------------------------------
+
+    def create_placement_group(self, bundles, strategy, name, lifetime):
+        from ray_tpu.core.placement_group import PlacementGroup
+        from ray_tpu.utils.ids import PlacementGroupID
+
+        pg_id = self._chan.call(
+            "create_pg", bundles=bundles, strategy=strategy, name=name,
+            lifetime=lifetime,
+        )
+        return PlacementGroup(PlacementGroupID(pg_id), bundles, strategy,
+                              name)
+
+    def remove_placement_group(self, pg_id):
+        self._chan.call("remove_pg", pg_id=pg_id.binary())
+
+    def pg_ready_ref(self, pg_id):
+        return ObjectRef(ObjectID(
+            self._chan.call("pg_ready", pg_id=pg_id.binary())
+        ))
+
+    def get_named_placement_group(self, name: str):
+        from ray_tpu.core.placement_group import PlacementGroup
+        from ray_tpu.utils.ids import PlacementGroupID
+
+        rep = self._chan.call("named_pg", name=name)
+        return PlacementGroup(PlacementGroupID(rep["pg_id"]),
+                              rep["bundles"], rep["strategy"],
+                              rep["name"])
+
+    def placement_group_table(self):
+        return self._chan.call("pg_table")
+
+    # -- cluster info ------------------------------------------------------
+
+    def cluster_resources(self):
+        return self._chan.call("cluster_resources")
+
+    def available_resources(self):
+        return self._chan.call("available_resources")
+
+    def nodes(self):
+        return self._chan.call("nodes")
+
+
+# -- execution --------------------------------------------------------------
+
+
+class _ActorExecutor:
+    """Fixed thread pool that runs all of an actor's work, so a method
+    sees the SAME thread across calls when max_concurrency == 1 —
+    matching reference actor semantics (one scheduling-queue thread per
+    actor; thread-locals like collective group contexts survive between
+    method invocations)."""
+
+    def __init__(self, n: int):
+        import queue as _q
+
+        self._q: "_q.Queue" = _q.Queue()
+        for i in range(max(1, n)):
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"actor-exec-{i}").start()
+
+    def _loop(self) -> None:
+        while True:
+            fn, box, ev = self._q.get()
+            try:
+                box.append(("ok", fn()))
+            except BaseException as e:
+                box.append(("err", e))
+            ev.set()
+
+    def run(self, fn):
+        box: list = []
+        ev = threading.Event()
+        self._q.put((fn, box, ev))
+        ev.wait()
+        kind, val = box[0]
+        if kind == "err":
+            raise val
+        return val
+
+
+class _WorkerServer:
+    def __init__(self):
+        self._chan: Optional[MsgChannel] = None
+        self._wr: Optional[WorkerRuntime] = None
+        self._shm = None
+        self._shm_threshold = 1 << 30
+        self._actor_instance: Any = None
+        self._actor_env = None
+        self._actor_env_plugins = None
+        self._actor_exec: Optional[_ActorExecutor] = None
+        # ALL plain tasks run on one persistent executor thread — the
+        # reference's model (a worker's main loop executes tasks one at
+        # a time), and load-bearing here: native extensions imported in
+        # a transient thread can corrupt their TLS when that thread
+        # exits (observed: pyarrow 25 segfaults on second use when first
+        # imported in a short-lived thread).  A thread that never exits
+        # sidesteps the entire class of bug.
+        self._task_exec = _ActorExecutor(1)
+        self._exit = threading.Event()
+
+    # -- value encoding ----------------------------------------------------
+
+    def _encode_result(self, value: Any, dest_oid: Optional[bytes]):
+        """Wire entry for one produced value: written straight into the
+        shared arena under its destination ObjectID when large, inline
+        bytes otherwise."""
+        meta, buffers = serialize_parts(value)
+        size = framed_size(meta, buffers)
+        if (self._shm is not None and dest_oid is not None
+                and size >= self._shm_threshold):
+            try:
+                buf = self._shm.create(dest_oid, size)
+                write_framed(buf, meta, buffers)
+                self._shm.seal(dest_oid)
+                return ("shm", size)
+            except OSError:
+                pass
+        out = bytearray(size)
+        write_framed(memoryview(out), meta, buffers)
+        return ("b", bytes(out))
+
+    def _decode_args(self, args, kwargs) -> Tuple[tuple, dict]:
+        def dec(v):
+            if isinstance(v, WireRef):
+                if v.kind == "shm":
+                    return self._wr._read_shm(v.oid)
+                return deserialize_object(v.data)
+            return v
+
+        return (tuple(dec(a) for a in args),
+                {k: dec(v) for k, v in kwargs.items()})
+
+    def _env_context(self, env, plugins_blob=None):
+        if plugins_blob:
+            from ray_tpu.runtime_env import register_plugin
+
+            for plugin in cloudpickle.loads(plugins_blob).values():
+                register_plugin(plugin)
+        if env:
+            from ray_tpu.runtime_env import materialize
+
+            return materialize(env).applied()
+        return contextlib.nullcontext()
+
+    @staticmethod
+    def _trace(ctx):
+        from ray_tpu.util import tracing
+
+        return tracing.activate(ctx)
+
+    # -- request handling --------------------------------------------------
+
+    def handle(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:
+        op = msg["op"]
+        if op == "task":
+            return self._task_exec.run(lambda: self._run_task(msg))
+        if op == "actor_create":
+            return self._actor_create(msg)
+        if op == "actor_task":
+            return self._actor_task(msg)
+        if op == "ping":
+            return "pong"
+        if op == "exit":
+            self._exit.set()
+            return None
+        raise ValueError(f"unknown driver op {op!r}")
+
+    def _run_task(self, msg: Dict[str, Any]) -> Any:
+        fn, args, kwargs = cloudpickle.loads(msg["spec"])
+        args, kwargs = self._decode_args(args, kwargs)
+        with self._env_context(msg.get("env"), msg.get("env_plugins")), \
+                self._trace(msg.get("trace_ctx")):
+            result = fn(*args, **kwargs)
+            if msg.get("streaming"):
+                self._stream(result, TaskID(msg["task"]), msg["name"])
+                return {"streamed": True}
+        num_returns = msg.get("num_returns", 1)
+        returns = msg.get("returns", [])
+        if num_returns == 1:
+            return {"results": [self._encode_result(
+                result, returns[0] if returns else None)]}
+        values = list(result)
+        if len(values) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned "
+                f"{len(values)} values"
+            )
+        return {"results": [
+            self._encode_result(v, returns[i] if i < len(returns) else None)
+            for i, v in enumerate(values)
+        ]}
+
+    def _stream(self, result, task_id: TaskID, name: str) -> None:
+        """Seal yielded items into the driver's store one by one
+        (parity: the streaming-generator executor, _raylet.pyx:918)."""
+        from ray_tpu.core.exceptions import TaskError
+        from ray_tpu.core.generator import EndOfStream
+
+        i = 0
+        try:
+            if not hasattr(result, "__iter__"):
+                raise TypeError(
+                    f"streaming task {name!r} must return an iterable, "
+                    f"got {type(result).__name__}"
+                )
+            for item in result:
+                oid = ObjectID.for_task_return(task_id, i)
+                entry = self._encode_result(item, oid.binary())
+                self._chan.call("seal_value", oid=oid.binary(), entry=entry)
+                i += 1
+        except BaseException as e:
+            err = e if isinstance(e, TaskError) else TaskError(name, e)
+            self._chan.call(
+                "seal_error",
+                oid=ObjectID.for_task_return(task_id, i).binary(),
+                error=err, if_pending=False,
+            )
+            raise
+        self._chan.call(
+            "seal_error", oid=ObjectID.for_task_return(task_id, i).binary(),
+            error=EndOfStream(), if_pending=False,
+        )
+
+    def _actor_create(self, msg: Dict[str, Any]) -> None:
+        cls, args, kwargs = cloudpickle.loads(msg["spec"])
+        args, kwargs = self._decode_args(args, kwargs)
+        self._actor_env = msg.get("env")
+        self._actor_env_plugins = msg.get("env_plugins")
+        self._actor_exec = _ActorExecutor(msg.get("max_concurrency", 1))
+
+        def construct():
+            with self._env_context(self._actor_env,
+                                   self._actor_env_plugins):
+                self._actor_instance = cls(*args, **kwargs)
+
+        # __init__ runs on the executor thread too, so instance state
+        # bound to the thread (thread-locals, event loops) carries over
+        # into method calls.
+        self._actor_exec.run(construct)
+        return None
+
+    def _actor_task(self, msg: Dict[str, Any]) -> Any:
+        if self._actor_instance is None:
+            raise RuntimeError("no actor constructed in this worker")
+        return self._actor_exec.run(lambda: self._actor_task_body(msg))
+
+    def _actor_task_body(self, msg: Dict[str, Any]) -> Any:
+        args, kwargs = cloudpickle.loads(msg["spec"])
+        args, kwargs = self._decode_args(args, kwargs)
+        method = getattr(self._actor_instance, msg["method"])
+        with self._env_context(self._actor_env, self._actor_env_plugins), \
+                self._trace(msg.get("trace_ctx")):
+            result = method(*args, **kwargs)
+            import inspect as _inspect
+
+            if _inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)
+            if msg.get("num_returns") == "streaming":
+                self._stream(result, TaskID(msg["task"]), msg["method"])
+                return {"streamed": True}
+        num_returns = msg.get("num_returns", 1)
+        returns = msg.get("returns", [])
+        if num_returns == 1:
+            return {"results": [self._encode_result(
+                result, returns[0] if returns else None)]}
+        values = list(result)
+        if len(values) != num_returns:
+            raise ValueError(
+                f"method declared num_returns={num_returns} but returned "
+                f"{len(values)} values"
+            )
+        return {"results": [
+            self._encode_result(v, returns[i] if i < len(returns) else None)
+            for i, v in enumerate(values)
+        ]}
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def main(self) -> int:
+        import faulthandler
+
+        faulthandler.enable()  # crashing workers leave a stack trace
+        sock_path = os.environ.get("RAYTPU_WORKER_SOCKET")
+        token = os.environ.get("RAYTPU_WORKER_TOKEN", "")
+        if not sock_path:
+            print("RAYTPU_WORKER_SOCKET not set", file=sys.stderr)
+            return 2
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(sock_path)
+        from ray_tpu.util.client.common import recv_msg, send_msg
+
+        send_msg(sock, {"kind": "req", "mid": 0, "op": "hello",
+                        "token": token, "pid": os.getpid()})
+        welcome = recv_msg(sock)
+        if not welcome.get("ok"):
+            return 3
+        info = welcome["value"]
+        from ray_tpu.utils.config import get_config
+
+        try:
+            get_config().update(info.get("config") or {})
+        except Exception:
+            pass
+        for p in info.get("sys_path") or []:
+            if p not in sys.path:
+                sys.path.append(p)
+        try:
+            if info.get("cwd"):
+                os.chdir(info["cwd"])
+        except OSError:
+            pass
+        self._shm_threshold = info.get("shm_threshold", 1 << 30)
+        if info.get("shm_name"):
+            try:
+                from ray_tpu.core.shm_store import SharedMemoryStore
+
+                self._shm = SharedMemoryStore.connect(info["shm_name"])
+            except Exception as e:
+                # Degraded but functional: large values travel as bytes
+                # through the driver (see _read_shm / get_raw no_shm).
+                print(f"[ray_tpu worker {os.getpid()}] shared-memory "
+                      f"attach failed ({e!r}); falling back to inline "
+                      f"transfers", file=sys.stderr)
+                self._shm = None
+        self._chan = MsgChannel(sock, self.handle, name="driver",
+                                on_close=lambda: self._exit.set())
+        self._wr = WorkerRuntime(self._chan, self._shm,
+                                 self._shm_threshold)
+        # Install the proxy as THE runtime for this process: any
+        # ray_tpu API call in user code now routes to the driver.
+        from ray_tpu.core import api
+
+        api._runtime = self._wr
+        self._chan.start()
+        self._exit.wait()
+        # Let in-flight replies flush before dying.
+        self._chan.close()
+        return 0
+
+
+def main() -> int:
+    return _WorkerServer().main()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

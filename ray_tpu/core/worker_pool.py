@@ -1,0 +1,429 @@
+"""Pooled OS worker processes — the driver side.
+
+Parity: the raylet's WorkerPool (ray: src/ray/raylet/worker_pool.h:156
+— fork/pool/reuse language workers, startup tokens, registration
+handshake) plus the driver half of the CoreWorkerService push-task plane
+(src/ray/protobuf/core_worker.proto:417).  Workers are real OS
+processes spawned with ``python -m ray_tpu.core.worker_main``; each
+registers back over an AF_UNIX socket identified by a one-time spawn
+token, then tasks/actor methods are pushed over that channel
+(ray_tpu/core/wire.py) and large values ride the C++ shared-memory
+arena (ray_tpu/_native/shm_store.cc) that every worker attaches to —
+the plasma-equivalent shared object plane.
+
+Nested API calls (a task submitting sub-tasks, a worker-side
+``ray.get``) arrive as reverse-direction requests and are served
+against the driver's runtime by ``WorkerPool.handle_request`` — the
+GCS/owner role in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, TYPE_CHECKING
+
+import cloudpickle
+
+from ray_tpu.core.wire import ChannelClosedError, MsgChannel
+from ray_tpu.utils.ids import ObjectID
+
+if TYPE_CHECKING:
+    from ray_tpu.core.runtime import LocalRuntime
+
+
+class WorkerHandle:
+    """One registered worker process."""
+
+    def __init__(self, pool: "WorkerPool", proc: subprocess.Popen,
+                 chan: MsgChannel, wid: str):
+        self.pool = pool
+        self.proc = proc
+        self.chan = chan
+        self.wid = wid
+        self.pid = proc.pid
+        self.dead = False
+        self.dedicated = False  # actor hosts never return to the idle set
+        # Actor shells hook this to learn about crashes while idle.
+        self.on_death = None
+        chan.on_close = self._on_close
+
+    def _on_close(self) -> None:
+        self.dead = True
+        self.pool._discard(self)
+        cb = self.on_death
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def call(self, op: str, rpc_timeout: Optional[float] = None,
+             **payload):
+        try:
+            return self.chan.call(op, rpc_timeout=rpc_timeout, **payload)
+        except ChannelClosedError as e:
+            from ray_tpu.core.exceptions import WorkerDiedError
+
+            # Mark dead NOW: the caller's finally-release must not race
+            # the reader thread's on_close and re-pool a dead worker.
+            self.dead = True
+            raise WorkerDiedError(f"pid {self.pid}: {e}") from None
+
+    def terminate(self, graceful: bool = True) -> None:
+        self.dead = True
+        if graceful and not self.chan.closed:
+            try:
+                self.chan._send({"mid": 0, "kind": "req", "op": "exit"})
+            except Exception:
+                pass
+        self.chan.close()
+        try:
+            self.proc.terminate()
+        except Exception:
+            pass
+
+
+class WorkerPool:
+    def __init__(self, runtime: "LocalRuntime"):
+        self._rt = runtime
+        self._lock = threading.Lock()
+        self._idle: List[WorkerHandle] = []
+        self._all: Dict[str, WorkerHandle] = {}
+        self._spawn_waiters: Dict[str, Any] = {}  # token → [Event, handle]
+        self._closed = False
+        self._sock_dir = tempfile.mkdtemp(prefix="raytpu-ipc-")
+        self._sock_path = os.path.join(self._sock_dir, "driver.sock")
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self._sock_path)
+        self._listener.listen(128)
+        threading.Thread(target=self._accept_loop, name="worker-accept",
+                         daemon=True).start()
+        # Welcome payload pieces, computed once.
+        self._shm_name = runtime.store.shm_name()
+        self._shm_threshold = runtime.store.shm_threshold
+        from ray_tpu.utils.config import get_config
+
+        for _ in range(get_config().worker_prestart):
+            threading.Thread(target=self._prestart_one, daemon=True,
+                             name="worker-prestart").start()
+
+    def _prestart_one(self) -> None:
+        try:
+            self.release(self.spawn())
+        except Exception:
+            pass
+
+    # -- registration ------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._register, args=(conn,),
+                             daemon=True, name="worker-register").start()
+
+    def _register(self, conn: socket.socket) -> None:
+        from ray_tpu.util.client.common import recv_msg, send_msg
+
+        try:
+            hello = recv_msg(conn)
+            token = hello.get("token", "")
+        except Exception:
+            conn.close()
+            return
+        with self._lock:
+            waiter = self._spawn_waiters.get(token)
+        if waiter is None:  # unknown peer — not one of our spawns
+            conn.close()
+            return
+        try:
+            from ray_tpu.utils.config import get_config
+
+            send_msg(conn, {
+                "kind": "rep", "mid": hello.get("mid"), "ok": True,
+                "value": {
+                    "config": get_config().snapshot(),
+                    "shm_name": self._shm_name,
+                    "shm_threshold": self._shm_threshold,
+                    "job_id": self._rt.job_id.hex(),
+                    # Functions pickled by reference (driver-side
+                    # modules) must be importable in the worker (parity:
+                    # same-node workers share the driver's module
+                    # environment; cross-node shipping is runtime_env's
+                    # job).
+                    "sys_path": list(sys.path),
+                    "cwd": os.getcwd(),
+                },
+            })
+        except Exception:
+            conn.close()
+            return
+        chan = MsgChannel(conn, self._handle, name=f"worker-{token[:8]}")
+        with self._lock:
+            if self._spawn_waiters.get(token) is not waiter:
+                # spawn() already timed out and withdrew the token.
+                chan.close()
+                return
+            waiter[1] = chan
+            waiter[0].set()
+
+    def spawn(self) -> WorkerHandle:
+        from ray_tpu.utils.config import get_config
+
+        token = uuid.uuid4().hex
+        env = dict(os.environ)
+        env["RAYTPU_WORKER_SOCKET"] = self._sock_path
+        env["RAYTPU_WORKER_TOKEN"] = token
+        # The worker hosts no runtime of its own — never recurse.
+        env.pop("RAYTPU_WORKERS", None)
+        if not get_config().worker_tpu_access:
+            # Skip the TPU-runtime sitecustomize preload (~2 s per
+            # worker, and the single chip belongs to the driver).  jax
+            # stays importable on the CPU backend.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            if env.get("JAX_PLATFORMS") == "axon":
+                env["JAX_PLATFORMS"] = "cpu"
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        ev = threading.Event()
+        waiter = [ev, None]
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("worker pool is shut down")
+            self._spawn_waiters[token] = waiter
+        registered = False
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env,
+            )
+            timeout = get_config().worker_register_timeout_s
+            if not ev.wait(timeout):
+                proc.terminate()
+                raise TimeoutError(
+                    f"worker pid {proc.pid} failed to register within "
+                    f"{timeout}s"
+                )
+            registered = True
+        finally:
+            with self._lock:
+                self._spawn_waiters.pop(token, None)
+            if not registered and waiter[1] is not None:
+                # _register raced our timeout and produced a channel
+                # nobody will ever read — close the orphaned socket.
+                waiter[1].close()
+        chan = waiter[1]
+        wh = WorkerHandle(self, proc, chan, token)
+        chan.start()
+        with self._lock:
+            self._all[token] = wh
+        return wh
+
+    # -- leasing -----------------------------------------------------------
+
+    def lease(self, dedicated: bool = False) -> WorkerHandle:
+        """Pop an idle worker or spawn one (parity: PopWorker with
+        on-demand StartWorkerProcess)."""
+        with self._lock:
+            while self._idle:
+                wh = self._idle.pop()
+                if not wh.dead:
+                    wh.dedicated = dedicated
+                    return wh
+        wh = self.spawn()
+        wh.dedicated = dedicated
+        return wh
+
+    def release(self, wh: WorkerHandle) -> None:
+        if wh.dead or wh.dedicated:
+            return
+        with self._lock:
+            if not self._closed:
+                self._idle.append(wh)
+
+    def _discard(self, wh: WorkerHandle) -> None:
+        with self._lock:
+            self._all.pop(wh.wid, None)
+            if wh in self._idle:
+                self._idle.remove(wh)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            workers = list(self._all.values())
+            self._all.clear()
+            self._idle.clear()
+        for wh in workers:
+            wh.terminate()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self._sock_path)
+            os.rmdir(self._sock_dir)
+        except OSError:
+            pass
+        for wh in workers:
+            try:
+                wh.proc.wait(timeout=2)
+            except Exception:
+                try:
+                    wh.proc.kill()
+                except Exception:
+                    pass
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"workers": len(self._all), "idle": len(self._idle)}
+
+    # -- nested-API dispatch (worker → driver) -----------------------------
+
+    def _handle(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:
+        """Serve a worker's control-plane request against the runtime
+        (parity: the owner/GCS RPC surface a core worker talks to)."""
+        rt = self._rt
+        op = msg["op"]
+        if op == "get_raw":
+            entries = [rt.store.get_wire(ObjectID(b), msg.get("timeout"))
+                       for b in msg["oids"]]
+            if msg.get("no_shm"):
+                # Shm-less worker (arena attach failed): materialize the
+                # bytes driver-side instead of handing out arena refs.
+                shm = rt.store._shm_store()
+                entries = [
+                    ("b", shm.get_bytes(ObjectID(b).binary()))
+                    if kind == "shm" else (kind, payload)
+                    for b, (kind, payload) in zip(msg["oids"], entries)
+                ]
+            return entries
+        if op == "put_val":
+            oid = rt.alloc_put_oid()
+            rt.store.put_serialized(oid, msg["data"])
+            return oid.binary()
+        if op == "alloc_put_oid":
+            return rt.alloc_put_oid().binary()
+        if op == "mark_shm":
+            rt.store.mark_shm_sealed(ObjectID(msg["oid"]), msg["size"])
+            return None
+        if op == "seal_value":
+            kind, payload = msg["entry"]
+            oid = ObjectID(msg["oid"])
+            if kind == "shm":
+                rt.store.mark_shm_sealed(oid, payload)
+            else:
+                rt.store.put_serialized(oid, payload)
+            return None
+        if op == "seal_error":
+            oid = ObjectID(msg["oid"])
+            if msg.get("if_pending"):
+                rt.store.put_error_if_pending(oid, msg["error"])
+            else:
+                rt.store.put_error(oid, msg["error"])
+            return None
+        if op == "wait":
+            ready, pending = rt.store.wait(
+                [ObjectID(b) for b in msg["oids"]], msg["num_returns"],
+                msg.get("timeout"),
+            )
+            return ([o.binary() for o in ready],
+                    [o.binary() for o in pending])
+        if op == "peek_error":
+            return rt.store.peek_error(ObjectID(msg["oid"]))
+        if op == "contains":
+            return rt.store.contains(ObjectID(msg["oid"]))
+        if op == "submit_task":
+            fn, args, kwargs = cloudpickle.loads(msg["spec"])
+            options = msg["options"]
+            out = rt.submit_task(fn, args, kwargs, options,
+                                 trace_ctx=msg.get("trace_ctx"))
+            if options.num_returns == "streaming":
+                return {"stream": out.task_id.binary()}
+            return {"oids": [r.id.binary() for r in out]}
+        if op == "create_actor":
+            cls, args, kwargs = cloudpickle.loads(msg["spec"])
+            shell, ref = rt.create_actor(cls, args, kwargs, msg["options"])
+            from ray_tpu.core.actor import collect_method_num_returns
+
+            return {"actor_id": shell.actor_id.binary(),
+                    "cls_name": cls.__name__,
+                    "table": collect_method_num_returns(cls),
+                    "creation_oid": ref.id.binary()}
+        if op == "submit_actor_task":
+            from ray_tpu.utils.ids import ActorID
+
+            args, kwargs = cloudpickle.loads(msg["spec"])
+            out = rt.submit_actor_task(
+                ActorID(msg["actor_id"]), msg["method"], args, kwargs,
+                num_returns=msg["num_returns"],
+                trace_ctx=msg.get("trace_ctx"),
+            )
+            if msg["num_returns"] == "streaming":
+                return {"stream": out.task_id.binary()}
+            return {"oids": [r.id.binary() for r in out]}
+        if op == "kill_actor":
+            from ray_tpu.utils.ids import ActorID
+
+            rt.kill_actor(ActorID(msg["actor_id"]),
+                          msg.get("no_restart", True))
+            return None
+        if op == "named_actor":
+            aid, cls_name, table = rt.named_actor_handle(msg["name"])
+            return {"actor_id": aid.binary(), "cls_name": cls_name,
+                    "table": table}
+        if op == "create_pg":
+            pg = rt.create_placement_group(
+                msg["bundles"], msg["strategy"], msg["name"],
+                msg.get("lifetime"),
+            )
+            return pg.id.binary()
+        if op == "remove_pg":
+            from ray_tpu.utils.ids import PlacementGroupID
+
+            rt.remove_placement_group(PlacementGroupID(msg["pg_id"]))
+            return None
+        if op == "pg_ready":
+            from ray_tpu.utils.ids import PlacementGroupID
+
+            return rt.pg_ready_ref(
+                PlacementGroupID(msg["pg_id"])).id.binary()
+        if op == "named_pg":
+            pg = rt.get_named_placement_group(msg["name"])
+            return {"pg_id": pg.id.binary(), "bundles": pg.bundle_specs,
+                    "strategy": pg.strategy, "name": pg.name}
+        if op == "pg_table":
+            return rt.placement_group_table()
+        if op == "cluster_resources":
+            return rt.cluster_resources()
+        if op == "available_resources":
+            return rt.available_resources()
+        if op == "nodes":
+            return rt.nodes()
+        if op == "kv_put":
+            return rt.kv.put(msg["key"], msg["value"],
+                             overwrite=msg.get("overwrite", True),
+                             namespace=msg.get("namespace"))
+        if op == "kv_get":
+            return rt.kv.get(msg["key"], namespace=msg.get("namespace"))
+        if op == "kv_del":
+            return rt.kv.delete(msg["key"], namespace=msg.get("namespace"))
+        if op == "kv_keys":
+            return rt.kv.keys(msg.get("prefix", b""),
+                              namespace=msg.get("namespace"))
+        if op == "kv_exists":
+            return rt.kv.exists(msg["key"], namespace=msg.get("namespace"))
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown worker op {op!r}")

@@ -173,13 +173,16 @@ class RuntimeEnvPlugin:
 
 
 _plugins: Dict[str, RuntimeEnvPlugin] = {}
+_plugins_version = 0  # bumped on registration; invalidates ship caches
 
 
 def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    global _plugins_version
     if not plugin.name:
         raise ValueError("plugin needs a name")
     _plugins[plugin.name] = plugin
     _KNOWN_FIELDS.add(plugin.name)
+    _plugins_version += 1
 
 
 # -- materialization -------------------------------------------------------

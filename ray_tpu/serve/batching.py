@@ -110,61 +110,88 @@ class _BatchQueue:
                     f.set_exception(e)
 
 
+class _BatchedCallable:
+    """The @serve.batch wrapper as a picklable descriptor: runtime state
+    (lock, queues, flusher threads) is rebuilt fresh on unpickle, so a
+    deployment class carrying a batched method ships cleanly to replica
+    worker processes (closures capturing a threading.Lock cannot)."""
+
+    _is_serve_batch = True
+
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait = batch_wait_timeout_s
+        functools.update_wrapper(self, fn)
+        self._init_runtime_state()
+
+    def _init_runtime_state(self) -> None:
+        self._lock = threading.Lock()
+        self._shared: List[Optional[_BatchQueue]] = [None]  # unbound case
+        self._attr = f"__batch_queue_{self._fn.__name__}"
+        # Fallback for owners that reject setattr/weakref (__slots__,
+        # frozen dataclasses): strong id-keyed map, the pre-weakref
+        # behavior (leaks across owner churn, but only for such classes).
+        self._rigid_queues: dict = {}
+
+    def __reduce__(self):
+        return (_rebuild_batched, (self._fn, self._max, self._wait))
+
+    def __get__(self, obj, objtype=None):
+        # Descriptor protocol: instance.method binds the owner like a
+        # normal function attribute would.
+        if obj is None:
+            return self
+        return functools.partial(self.__call__, obj)
+
+    def __call__(self, *call_args):
+        # Support bound methods: (self, item) or plain (item,).
+        if len(call_args) == 2:
+            owner, item = call_args
+            with self._lock:
+                bq = getattr(owner, self._attr, None) \
+                    or self._rigid_queues.get(id(owner))
+                if bq is None:
+                    # Probe attribute assignment BEFORE starting a
+                    # queue (its flusher thread would leak if setattr
+                    # failed afterwards).
+                    try:
+                        setattr(owner, self._attr, None)
+                        bq = _BatchQueue(
+                            self._fn, self._max, self._wait, owner=owner,
+                        )
+                        setattr(owner, self._attr, bq)
+                    except (AttributeError, TypeError):
+                        bq = _BatchQueue(
+                            functools.partial(self._fn, owner),
+                            self._max, self._wait,
+                        )
+                        self._rigid_queues[id(owner)] = bq
+        elif len(call_args) == 1:
+            item = call_args[0]
+            with self._lock:
+                if self._shared[0] is None:
+                    self._shared[0] = _BatchQueue(
+                        self._fn, self._max, self._wait
+                    )
+                bq = self._shared[0]
+        else:
+            raise TypeError("@serve.batch functions take a single item")
+        return bq.submit(item).result()
+
+
+def _rebuild_batched(fn, max_batch_size, batch_wait_timeout_s):
+    return _BatchedCallable(fn, max_batch_size, batch_wait_timeout_s)
+
+
 def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
           batch_wait_timeout_s: float = 0.01):
     """Decorator: the wrapped fn must take a list of items; callers pass
     one item and block for their element of the result."""
 
     def wrap(fn: Callable):
-        lock = threading.Lock()
-        shared: List[Optional[_BatchQueue]] = [None]  # unbound-case queue
-        attr = f"__batch_queue_{fn.__name__}"
-
-        # Fallback for owners that reject setattr/weakref (__slots__,
-        # frozen dataclasses): strong id-keyed map, the pre-weakref
-        # behavior (leaks across owner churn, but only for such classes).
-        rigid_queues: dict = {}
-
-        @functools.wraps(fn)
-        def wrapper(*call_args):
-            # Support bound methods: (self, item) or plain (item,).
-            if len(call_args) == 2:
-                owner, item = call_args
-                with lock:
-                    bq = getattr(owner, attr, None) or rigid_queues.get(
-                        id(owner)
-                    )
-                    if bq is None:
-                        # Probe attribute assignment BEFORE starting a
-                        # queue (its flusher thread would leak if setattr
-                        # failed afterwards).
-                        try:
-                            setattr(owner, attr, None)
-                            bq = _BatchQueue(
-                                fn, max_batch_size, batch_wait_timeout_s,
-                                owner=owner,
-                            )
-                            setattr(owner, attr, bq)
-                        except (AttributeError, TypeError):
-                            bq = _BatchQueue(
-                                functools.partial(fn, owner),
-                                max_batch_size, batch_wait_timeout_s,
-                            )
-                            rigid_queues[id(owner)] = bq
-            elif len(call_args) == 1:
-                item = call_args[0]
-                with lock:
-                    if shared[0] is None:
-                        shared[0] = _BatchQueue(
-                            fn, max_batch_size, batch_wait_timeout_s
-                        )
-                    bq = shared[0]
-            else:
-                raise TypeError("@serve.batch functions take a single item")
-            return bq.submit(item).result()
-
-        wrapper._is_serve_batch = True  # type: ignore[attr-defined]
-        return wrapper
+        return _BatchedCallable(fn, max_batch_size, batch_wait_timeout_s)
 
     if _fn is not None:
         return wrap(_fn)

@@ -39,6 +39,122 @@ def _reset_model_id(token) -> None:
     _current_model_id.reset(token)
 
 
+class _MultiplexedCallable:
+    """The @multiplexed wrapper as a picklable descriptor: the lock and
+    in-flight table are rebuilt fresh on unpickle so deployment classes
+    carrying a multiplexed loader ship to replica worker processes
+    (a closure capturing a threading.Lock cannot cross the boundary)."""
+
+    __serve_multiplexed__ = True
+
+    def __init__(self, loader: Callable, max_num_models_per_replica: int):
+        self._loader = loader
+        self._max = max_num_models_per_replica
+        self._is_async = inspect.iscoroutinefunction(loader)
+        functools.update_wrapper(self, loader)
+        self._init_runtime_state()
+
+    def _init_runtime_state(self) -> None:
+        self._lock = threading.Lock()
+        # (instance id, model id) → Event while a load is in flight:
+        # concurrent requests for the same unloaded model wait for ONE
+        # load instead of duplicating it (parity: the reference
+        # serializes loads per model id).
+        self._inflight: dict = {}
+
+    def __reduce__(self):
+        return (_MultiplexedCallable, (self._loader, self._max))
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return functools.partial(self.__call__, obj)
+
+    def _try_acquire_load_slot(self, owner, model_id: str):
+        """One non-blocking step: (cache, model, 'hit') on cache hit,
+        (cache, None, 'load') if this caller is elected to load,
+        (cache, event, 'wait') if another load is in flight."""
+        key = (id(owner), model_id)
+        with self._lock:
+            cache = getattr(owner, _ATTR, None)
+            if cache is None:
+                cache = collections.OrderedDict()
+                setattr(owner, _ATTR, cache)
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache, cache[model_id], "hit"
+            ev = self._inflight.get(key)
+            if ev is None:
+                self._inflight[key] = threading.Event()
+                return cache, None, "load"
+            return cache, ev, "wait"
+
+    def _finish_load(self, owner, cache, model_id: str, model,
+                     success: bool) -> None:
+        key = (id(owner), model_id)
+        with self._lock:
+            if success:
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                while len(cache) > self._max:
+                    cache.popitem(last=False)  # LRU eviction
+            ev = self._inflight.pop(key, None)
+        if ev is not None:
+            ev.set()
+
+    async def _acall(self, owner, model_id: str):
+        """Async path — awaitable from async deployments (parity: the
+        reference's multiplexed wrapper is async-native)."""
+        import asyncio
+
+        while True:
+            cache, out, state = self._try_acquire_load_slot(
+                owner, model_id
+            )
+            if state == "hit":
+                return out
+            if state == "load":
+                break
+            # Another coroutine/thread is loading: yield the loop while
+            # waiting (a blocking Event.wait here would deadlock a
+            # single-loop pair of requests).
+            while not out.is_set():
+                await asyncio.sleep(0.005)
+        try:
+            model = await self._loader(owner, model_id)
+        except BaseException:
+            self._finish_load(owner, cache, model_id, None, False)
+            raise
+        self._finish_load(owner, cache, model_id, model, True)
+        return model
+
+    def __call__(self, owner, model_id: str):
+        if self._is_async:
+            return self._acall(owner, model_id)
+        while True:
+            cache, out, state = self._try_acquire_load_slot(
+                owner, model_id
+            )
+            if state == "hit":
+                return out
+            if state == "load":
+                break
+            out.wait()
+        try:
+            model = self._loader(owner, model_id)
+            if inspect.iscoroutine(model):
+                raise TypeError(
+                    "loader returned a coroutine from a sync wrapper "
+                    "— declare it `async def` so @multiplexed builds "
+                    "the async wrapper"
+                )
+        except BaseException:
+            self._finish_load(owner, cache, model_id, None, False)
+            raise
+        self._finish_load(owner, cache, model_id, model, True)
+        return model
+
+
 def multiplexed(func: Optional[Callable] = None, *,
                 max_num_models_per_replica: int = 3):
     """Decorate a model-loader method ``def get_model(self, model_id)``
@@ -51,108 +167,7 @@ def multiplexed(func: Optional[Callable] = None, *,
         raise ValueError("max_num_models_per_replica must be >= 1")
 
     def decorate(loader: Callable) -> Callable:
-        lock = threading.Lock()
-        # (instance id, model id) → Event while a load is in flight:
-        # concurrent requests for the same unloaded model wait for ONE
-        # load instead of duplicating it (parity: the reference
-        # serializes loads per model id).
-        inflight: dict = {}
-
-        def _try_acquire_load_slot(self, model_id: str):
-            """One non-blocking step: (cache, model, 'hit') on cache
-            hit, (cache, None, 'load') if this caller is elected to
-            load, (cache, event, 'wait') if another load is in flight."""
-            key = (id(self), model_id)
-            with lock:
-                cache = getattr(self, _ATTR, None)
-                if cache is None:
-                    cache = collections.OrderedDict()
-                    setattr(self, _ATTR, cache)
-                if model_id in cache:
-                    cache.move_to_end(model_id)
-                    return cache, cache[model_id], "hit"
-                ev = inflight.get(key)
-                if ev is None:
-                    inflight[key] = threading.Event()
-                    return cache, None, "load"
-                return cache, ev, "wait"
-
-        def _acquire_load_slot(self, model_id: str):
-            """Blocking (thread) variant for the sync wrapper."""
-            while True:
-                cache, out, state = _try_acquire_load_slot(self, model_id)
-                if state == "hit":
-                    return cache, out, True
-                if state == "load":
-                    return cache, None, False
-                out.wait()
-
-        def _finish_load(self, cache, model_id: str, model,
-                         success: bool):
-            key = (id(self), model_id)
-            with lock:
-                if success:
-                    cache[model_id] = model
-                    cache.move_to_end(model_id)
-                    while len(cache) > max_num_models_per_replica:
-                        cache.popitem(last=False)  # LRU eviction
-                ev = inflight.pop(key, None)
-            if ev is not None:
-                ev.set()
-
-        if inspect.iscoroutinefunction(loader):
-            # Async loader → async wrapper, awaitable from async
-            # deployments (parity: the reference's multiplexed wrapper
-            # is async-native).
-            @functools.wraps(loader)
-            async def awrapper(self, model_id: str):
-                import asyncio
-
-                while True:
-                    cache, out, state = _try_acquire_load_slot(
-                        self, model_id
-                    )
-                    if state == "hit":
-                        return out
-                    if state == "load":
-                        break
-                    # Another coroutine/thread is loading: yield the
-                    # loop while waiting (a blocking Event.wait here
-                    # would deadlock a single-loop pair of requests).
-                    while not out.is_set():
-                        await asyncio.sleep(0.005)
-                try:
-                    model = await loader(self, model_id)
-                except BaseException:
-                    _finish_load(self, cache, model_id, None, False)
-                    raise
-                _finish_load(self, cache, model_id, model, True)
-                return model
-
-            awrapper.__serve_multiplexed__ = True
-            return awrapper
-
-        @functools.wraps(loader)
-        def wrapper(self, model_id: str):
-            cache, model, hit = _acquire_load_slot(self, model_id)
-            if hit:
-                return model
-            try:
-                model = loader(self, model_id)
-                if inspect.iscoroutine(model):
-                    raise TypeError(
-                        "loader returned a coroutine from a sync wrapper "
-                        "— declare it `async def` so @multiplexed builds "
-                        "the async wrapper"
-                    )
-            except BaseException:
-                _finish_load(self, cache, model_id, None, False)
-                raise
-            _finish_load(self, cache, model_id, model, True)
-            return model
-
-        wrapper.__serve_multiplexed__ = True
-        return wrapper
+        return _MultiplexedCallable(loader, max_num_models_per_replica)
 
     if func is not None:
         return decorate(func)

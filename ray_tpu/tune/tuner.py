@@ -126,15 +126,34 @@ def with_resources(trainable, resources: Dict[str, float]):
 
 class _FnTrialRunner:
     """Actor wrapping a function trainable: runs it to completion on the
-    actor thread; reports stream through the session channel."""
+    actor's execution thread; reports buffer in the ACTOR-LOCAL session
+    channel and the controller drains them via actor calls — so the
+    same flow works whether the actor is a thread or its own OS worker
+    process (parity: the controller fetching results from trainable
+    actors rather than sharing memory with them)."""
 
-    def run(self, trial_id: str, fn: Callable, config: Dict[str, Any]):
+    def run(self, trial_id: str, fn: Callable, config: Dict[str, Any],
+            restore_checkpoint: Any = None,
+            stop_criteria: Optional[Dict[str, float]] = None):
+        SESSION.register(trial_id, restore_checkpoint, stop_criteria)
         SESSION.bind(trial_id)
         try:
             fn(config)
             return "DONE"
         except StopTrial:
             return "STOPPED"
+
+    def drain(self, trial_id: str):
+        return SESSION.drain(trial_id)
+
+    def request_stop(self, trial_id: str):
+        SESSION.request_stop(trial_id)
+
+    def finish(self, trial_id: str):
+        """Drop session state — load-bearing in thread mode, where the
+        SESSION is the driver-global channel and would otherwise keep
+        per-trial queues/checkpoints alive for the process lifetime."""
+        SESSION.unregister(trial_id)
 
 
 class _ClassTrialRunner:
@@ -199,7 +218,11 @@ class TuneController:
     # -- function trainables ----------------------------------------------
 
     def _run_fn_trials(self):
-        Runner = ray_tpu.remote(**_actor_opts(self.resources))(_FnTrialRunner)
+        # max_concurrency=2: drain()/request_stop() must interleave with
+        # the long-running run() on the same actor.
+        Runner = ray_tpu.remote(
+            max_concurrency=2, **_actor_opts(self.resources)
+        )(_FnTrialRunner)
         active: List[Trial] = []
         pending = list(self.trials)
         fn = self.trainable
@@ -224,12 +247,12 @@ class TuneController:
                         active.remove(trial)
 
     def _start_fn_trial(self, trial: Trial, Runner, fn):
-        SESSION.register(trial.trial_id, trial.restore_from,
-                         self.run_cfg.stop)
         trial.actor = Runner.remote()
         trial.status = RUNNING
-        trial.run_ref = trial.actor.run.remote(trial.trial_id, fn,
-                                               trial.config)
+        trial.run_ref = trial.actor.run.remote(
+            trial.trial_id, fn, trial.config, trial.restore_from,
+            self.run_cfg.stop,
+        )
 
     def _finish_fn_trial(self, trial: Trial):
         try:
@@ -239,12 +262,24 @@ class TuneController:
             trial.status = ERROR
             trial.error = str(e)
         finally:
-            SESSION.unregister(trial.trial_id)
+            try:
+                ray_tpu.get(trial.actor.finish.remote(trial.trial_id),
+                            timeout=10)
+            except Exception:
+                pass  # dead actor: its session state died with it
             ray_tpu.kill(trial.actor)
             trial.actor = None
 
     def _pump_results(self, trial: Trial):
-        for item in SESSION.drain(trial.trial_id):
+        if trial.actor is None:
+            return
+        try:
+            items = ray_tpu.get(
+                trial.actor.drain.remote(trial.trial_id), timeout=30
+            )
+        except Exception:
+            return  # actor died mid-drain; _finish_fn_trial reports it
+        for item in items:
             metrics = item["metrics"]
             metrics.setdefault("training_iteration", len(trial.results) + 1)
             trial.results.append(metrics)
@@ -254,14 +289,14 @@ class TuneController:
             if self._hit_stop_criteria(metrics):
                 decision = STOP
             if decision == STOP:
-                SESSION.request_stop(trial.trial_id)
+                trial.actor.request_stop.remote(trial.trial_id)
             elif decision == "EXPLOIT":
                 target = self.scheduler.exploit_target(trial, self.trials)
                 if target is not None:
                     source, new_config = target
                     self._exploits[trial.trial_id] = (
                         source.checkpoint, new_config)
-                    SESSION.request_stop(trial.trial_id)
+                    trial.actor.request_stop.remote(trial.trial_id)
 
     # -- class trainables --------------------------------------------------
 

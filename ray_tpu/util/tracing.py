@@ -65,13 +65,33 @@ def _current() -> Optional[Dict[str, str]]:
 def capture_context() -> Optional[Dict[str, str]]:
     """Snapshot the caller's span context for injection into a task
     (parity: the serialized span context in task metadata)."""
+    cur = _current()
+    if cur is not None:
+        # An activated context counts even when this process never
+        # called enable_tracing itself — worker processes carry the
+        # driver's context this way.
+        return {"trace_id": cur["trace_id"], "span_id": cur["span_id"]}
     if not _enabled:
         return None
-    cur = _current()
-    if cur is None:
-        # Root: start a fresh trace at the call boundary.
-        return {"trace_id": uuid.uuid4().hex, "span_id": ""}
-    return {"trace_id": cur["trace_id"], "span_id": cur["span_id"]}
+    # Root: start a fresh trace at the call boundary.
+    return {"trace_id": uuid.uuid4().hex, "span_id": ""}
+
+
+@contextlib.contextmanager
+def activate(ctx: Optional[Dict[str, str]]):
+    """Install a remote caller's span context as current WITHOUT
+    opening a span (the caller's side records the span; this side only
+    needs nested submissions to parent correctly — parity: context
+    attach on the worker before user code runs)."""
+    if ctx is None:
+        yield
+        return
+    prev = _current()
+    _tls.ctx = dict(ctx)
+    try:
+        yield
+    finally:
+        _tls.ctx = prev
 
 
 @contextlib.contextmanager

@@ -113,6 +113,16 @@ D("max_pending_lease_requests_per_scheduling_class", int, 10,
   "Pipelined lease requests per distinct (fn, resources) class.")
 
 # --- Workers --------------------------------------------------------------
+D("workers", str, "thread",
+  "Execution backend: 'thread' (in-process, fast start, GIL-bound) or "
+  "'process' (pooled OS worker processes over the shared-memory object "
+  "plane — real parallelism and crash isolation).  Env: RAYTPU_WORKERS.")
+D("worker_tpu_access", bool, False,
+  "Give spawned worker processes the TPU runtime preload (slower start; "
+  "only one process can hold a chip — leave off for pure-CPU workers and "
+  "run device work from the driver or a dedicated TPU actor).")
+D("worker_prestart", int, 0,
+  "Spawn this many workers in the background at init (hides cold-start).")
 D("num_workers_soft_limit", int, 0, "0 = num_cpus workers per node.")
 D("worker_register_timeout_s", float, 30.0, "Startup handshake deadline.")
 D("worker_idle_timeout_s", float, 300.0, "Idle worker reap time.")

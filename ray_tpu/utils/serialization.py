@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import pickle
 import struct
+import sys
 from typing import Any, List, Tuple
 
 import cloudpickle
@@ -32,15 +33,14 @@ _U32 = struct.Struct("<I")
 
 
 def _to_picklable(value: Any) -> Any:
-    try:
-        import jax
+    # Only consult jax if this process already imported it: a value
+    # cannot be a jax.Array otherwise, and importing jax here would cost
+    # ~2 s in every freshly spawned worker that never touches it.
+    jax = sys.modules.get("jax")
+    if jax is not None and isinstance(value, jax.Array):
+        import numpy as np
 
-        if isinstance(value, jax.Array):
-            import numpy as np
-
-            return np.asarray(value)
-    except ImportError:  # pragma: no cover
-        pass
+        return np.asarray(value)
     return value
 
 

@@ -62,16 +62,18 @@ def test_task_error_propagates(rt):
     assert "inner message" in str(ei.value)
 
 
-def test_task_retries(rt):
-    state = {"n": 0}
+def test_task_retries(rt, tmp_path):
+    # File-based attempt counter: visible to thread-mode AND
+    # process-mode workers (closure state would reset per process).
+    cnt = tmp_path / "attempts"
 
     @ray_tpu.remote
     def counter_path():
-        # runs in-process (threads) so shared state is visible
-        state["n"] += 1
-        if state["n"] < 3:
+        n = int(cnt.read_text()) + 1 if cnt.exists() else 1
+        cnt.write_text(str(n))
+        if n < 3:
             raise RuntimeError("flaky")
-        return state["n"]
+        return n
 
     ref = counter_path.options(max_retries=5).remote()
     assert ray_tpu.get(ref) == 3
@@ -84,8 +86,11 @@ def test_wait(rt):
         return t
 
     fast = slow.remote(0.01)
-    slower = slow.remote(0.8)
-    ready, pending = ray_tpu.wait([fast, slower], num_returns=1, timeout=0.5)
+    slower = slow.remote(3.0)
+    # Generous window: process-mode workers pay a cold spawn (~0.2 s)
+    # before the fast task can finish.
+    ready, pending = ray_tpu.wait([fast, slower], num_returns=1,
+                                  timeout=2.0)
     assert ready == [fast] and pending == [slower]
     ready2, pending2 = ray_tpu.wait([fast, slower], num_returns=2, timeout=5)
     assert len(ready2) == 2 and not pending2

@@ -1,0 +1,315 @@
+"""Multi-process worker runtime (RAYTPU_WORKERS=process).
+
+Parity targets: the raylet WorkerPool of real OS worker processes (ray:
+src/ray/raylet/worker_pool.h:156), task push onto leased workers
+(core_worker.proto PushTask), worker-crash retry semantics
+(task_manager.h max_retries), actor restart after process death (gcs
+actor FSM), and the plasma arena as the cross-process object plane.
+
+These tests run the REAL thing: OS processes, kill -9, shared memory.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api as _api
+from ray_tpu.core.exceptions import ActorDiedError, TaskError
+
+
+@pytest.fixture
+def proc_runtime(monkeypatch):
+    monkeypatch.setenv("RAYTPU_WORKERS", "process")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield _api.runtime()
+    ray_tpu.shutdown()
+
+
+def test_task_runs_in_other_process(proc_runtime):
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    pid = ray_tpu.get(whoami.remote())
+    assert pid != os.getpid()
+
+
+def test_worker_reuse(proc_runtime):
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    pids = {ray_tpu.get(whoami.remote()) for _ in range(5)}
+    # Sequential tasks reuse the pooled worker instead of forking anew.
+    assert len(pids) == 1
+    assert proc_runtime.worker_pool.stats()["workers"] >= 1
+
+
+def test_large_object_rides_shared_memory(proc_runtime):
+    @ray_tpu.remote
+    def make():
+        return np.arange(500_000, dtype=np.float64)
+
+    ref = make.remote()
+    arr = ray_tpu.get(ref)
+    assert arr.shape == (500_000,) and arr[-1] == 499_999
+    # The value must have landed in the shared arena, not the socket.
+    st = proc_runtime.store._state(ref.id)
+    assert st.in_shm, "large task result should be sealed via shm"
+
+
+def test_ref_args_cross_process(proc_runtime):
+    big = ray_tpu.put(np.ones(300_000))
+
+    @ray_tpu.remote
+    def total(x, scale):
+        return float(x.sum()) * scale
+
+    assert ray_tpu.get(total.remote(big, 2.0)) == 600_000.0
+
+
+def test_exceptions_propagate(proc_runtime):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("from the worker")
+
+    with pytest.raises(TaskError, match="from the worker"):
+        ray_tpu.get(boom.remote())
+
+
+def test_kill9_triggers_retry(proc_runtime, tmp_path):
+    marker = tmp_path / "attempted"
+
+    @ray_tpu.remote(max_retries=2)
+    def die_once():
+        if not marker.exists():
+            marker.write_text("x")
+            os.kill(os.getpid(), signal.SIGKILL)
+        return "survived"
+
+    assert ray_tpu.get(die_once.remote()) == "survived"
+
+
+def test_kill9_without_retries_fails(proc_runtime):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(die.remote(), timeout=30)
+    assert "died" in str(ei.value).lower() or "worker" in str(ei.value)
+
+
+def test_actor_lives_in_own_process_and_keeps_state(proc_runtime):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def inc(self, n=1):
+            self.v += n
+            return self.v
+
+        def pid(self):
+            return os.getpid()
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.pid.remote()) != os.getpid()
+    assert ray_tpu.get([c.inc.remote(), c.inc.remote(5)]) == [11, 16]
+
+
+def test_actor_restart_after_kill9(proc_runtime):
+    @ray_tpu.remote(max_restarts=1)
+    class A:
+        def __init__(self):
+            self.n = 0
+
+        def pid(self):
+            return os.getpid()
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    a = A.remote()
+    pid1 = ray_tpu.get(a.pid.remote())
+    os.kill(pid1, signal.SIGKILL)
+    deadline = time.monotonic() + 30
+    pid2 = None
+    while time.monotonic() < deadline:
+        try:
+            pid2 = ray_tpu.get(a.pid.remote(), timeout=5)
+            break
+        except Exception:
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+    assert ray_tpu.get(a.inc.remote()) == 1  # fresh state after restart
+
+
+def test_actor_dead_after_exhausted_restarts(proc_runtime):
+    @ray_tpu.remote(max_restarts=0)
+    class A:
+        def pid(self):
+            return os.getpid()
+
+    a = A.remote()
+    pid = ray_tpu.get(a.pid.remote())
+    os.kill(pid, signal.SIGKILL)
+    time.sleep(0.5)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.pid.remote(), timeout=10)
+
+
+def test_nested_task_submission_from_worker(proc_runtime):
+    @ray_tpu.remote
+    def outer(n):
+        @ray_tpu.remote
+        def inner(x):
+            return x * x
+
+        return sum(ray_tpu.get([inner.remote(i) for i in range(n)]))
+
+    assert ray_tpu.get(outer.remote(4)) == 0 + 1 + 4 + 9
+
+
+def test_worker_side_put_and_nested_actor(proc_runtime):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, ref):
+            self.ref = ref
+
+        def fetch(self):
+            return float(ray_tpu.get(self.ref).sum())
+
+    @ray_tpu.remote
+    def build():
+        ref = ray_tpu.put(np.full(400_000, 2.0))  # large → worker-side shm
+        h = Holder.remote(ref)
+        return ray_tpu.get(h.fetch.remote())
+
+    assert ray_tpu.get(build.remote()) == 800_000.0
+
+
+def test_named_actor_from_worker(proc_runtime):
+    @ray_tpu.remote
+    class Registry:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return len(self.items)
+
+    Registry.options(name="reg").remote()
+
+    @ray_tpu.remote
+    def client():
+        reg = ray_tpu.get_actor("reg")
+        return ray_tpu.get(reg.add.remote("from-worker"))
+
+    assert ray_tpu.get(client.remote()) == 1
+
+
+def test_streaming_generator_across_process(proc_runtime):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    got = [ray_tpu.get(r) for r in gen.remote(4)]
+    assert got == [0, 10, 20, 30]
+
+
+def test_streaming_from_actor_across_process(proc_runtime):
+    @ray_tpu.remote
+    class G:
+        @ray_tpu.method(num_returns="streaming")
+        def gen(self, n):
+            for i in range(n):
+                yield i + 100
+
+    g = G.remote()
+    got = [ray_tpu.get(r) for r in g.gen.options(
+        num_returns="streaming").remote(3)]
+    assert got == [100, 101, 102]
+
+
+def test_runtime_env_env_vars_in_worker(proc_runtime):
+    @ray_tpu.remote(runtime_env={"env_vars": {"PROC_TEST_VAR": "yes"}})
+    def read():
+        return os.environ.get("PROC_TEST_VAR")
+
+    assert ray_tpu.get(read.remote()) == "yes"
+
+
+def test_cluster_info_from_worker(proc_runtime):
+    @ray_tpu.remote
+    def info():
+        return ray_tpu.cluster_resources().get("CPU")
+
+    assert ray_tpu.get(info.remote()) == 8.0
+
+
+def test_kill_actor_preempts_stuck_method(proc_runtime):
+    @ray_tpu.remote
+    class Stuck:
+        def ready(self):
+            return True
+
+        def spin(self):
+            while True:
+                time.sleep(0.1)
+
+    s = Stuck.remote()
+    assert ray_tpu.get(s.ready.remote())
+    ref = s.spin.remote()
+    time.sleep(0.3)
+    ray_tpu.kill(s)  # hard-terminates the worker process
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(ref, timeout=15)
+
+
+def test_parallel_wall_clock(proc_runtime):
+    """N sleeping tasks overlap across processes (true concurrency even
+    on one core; on multi-core boxes this also proves GIL escape)."""
+
+    @ray_tpu.remote
+    def nap(sec):
+        time.sleep(sec)
+        return os.getpid()
+
+    t0 = time.monotonic()
+    pids = ray_tpu.get([nap.remote(1.0) for _ in range(4)])
+    elapsed = time.monotonic() - t0
+    assert elapsed < 3.0, f"4x1s naps took {elapsed:.1f}s — not parallel"
+    assert len(set(pids)) == 4  # four distinct worker processes
+
+
+def test_placement_group_from_worker(proc_runtime):
+    """A worker-side actor can create/use/remove a placement group —
+    the path a tune trial takes when it builds a Train WorkerGroup."""
+
+    @ray_tpu.remote
+    def build_and_use():
+        from ray_tpu.core.placement_group import (
+            placement_group,
+            remove_placement_group,
+        )
+
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+        assert pg.wait(timeout=10)
+
+        @ray_tpu.remote(num_cpus=1, placement_group=pg)
+        def inside():
+            return "placed"
+
+        out = ray_tpu.get(inside.remote())
+        remove_placement_group(pg)
+        return out
+
+    assert ray_tpu.get(build_and_use.remote()) == "placed"

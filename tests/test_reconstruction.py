@@ -27,25 +27,26 @@ def _run_on(cluster, node_id, fn_remote, *args):
     ).remote(*args)
 
 
-def test_retriable_task_output_reconstructed(cluster):
+def test_retriable_task_output_reconstructed(cluster, tmp_path):
     node = cluster.add_node(num_cpus=2)
-    runs = []
+    runs = tmp_path / "runs"  # file-based: visible across worker processes
 
     @ray_tpu.remote(max_retries=2)
     def produce():
-        runs.append(1)
+        with open(runs, "a") as fh:
+            fh.write("x")
         return np.arange(1000)
 
     ref = _run_on(cluster, node, produce)
     np.testing.assert_array_equal(ray_tpu.get(ref), np.arange(1000))
-    assert len(runs) == 1
+    assert runs.read_text() == "x"
 
     cluster.kill_node(node)
     # The object is rebuilt by re-executing the task on a live node.
     np.testing.assert_array_equal(
         ray_tpu.get(ref, timeout=10), np.arange(1000)
     )
-    assert len(runs) == 2
+    assert runs.read_text() == "xx"
 
 
 def test_non_retriable_output_lost(cluster):
@@ -69,18 +70,20 @@ def test_put_objects_survive_node_death(cluster):
     assert ray_tpu.get(ref) == {"driver": "owned"}
 
 
-def test_chained_reconstruction(cluster):
+def test_chained_reconstruction(cluster, tmp_path):
     node = cluster.add_node(num_cpus=4)
-    runs = {"f": 0, "g": 0}
+    runs_f, runs_g = tmp_path / "f", tmp_path / "g"
 
     @ray_tpu.remote(max_retries=1)
     def f():
-        runs["f"] += 1
+        with open(runs_f, "a") as fh:
+            fh.write("x")
         return 10
 
     @ray_tpu.remote(max_retries=1)
     def g(x):
-        runs["g"] += 1
+        with open(runs_g, "a") as fh:
+            fh.write("x")
         return x + 1
 
     f_ref = _run_on(cluster, node, f)
@@ -90,7 +93,7 @@ def test_chained_reconstruction(cluster):
     # Both outputs lived on the dead node; both chains re-execute.
     assert ray_tpu.get(g_ref, timeout=10) == 11
     assert ray_tpu.get(f_ref, timeout=10) == 10
-    assert runs["f"] == 2 and runs["g"] == 2
+    assert runs_f.read_text() == "xx" and runs_g.read_text() == "xx"
 
 
 def test_multi_return_reconstruction(cluster):

@@ -99,14 +99,14 @@ def test_py_modules(rt, tmp_path):
     assert ray_tpu.get(use.remote()) == "extra"
 
 
-def test_plugin(rt):
-    applied = {}
+def test_plugin(rt, tmp_path):
+    marker = tmp_path / "plugin_value"  # visible from worker processes
 
     class MyPlugin(renv.RuntimeEnvPlugin):
         name = "my_plugin"
 
         def create(self, value, ctx):
-            applied["value"] = value
+            marker.write_text(str(value))
             ctx.env_vars["FROM_PLUGIN"] = str(value)
 
     renv.register_plugin(MyPlugin())
@@ -116,7 +116,7 @@ def test_plugin(rt):
             return os.environ.get("FROM_PLUGIN")
 
         assert ray_tpu.get(read.remote()) == "7"
-        assert applied["value"] == 7
+        assert marker.read_text() == "7"
     finally:
         renv._plugins.pop("my_plugin", None)
         renv._KNOWN_FIELDS.discard("my_plugin")

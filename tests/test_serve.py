@@ -152,14 +152,15 @@ def test_user_config_reconfigure(serve_instance):
     assert handle.remote(None).result() == 9
 
 
-def test_batching(serve_instance):
-    batch_sizes = []
+def test_batching(serve_instance, tmp_path):
+    sizes = tmp_path / "batch_sizes"  # visible from replica processes
 
     @serve.deployment(max_ongoing_requests=16)
     class Batched:
         @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
         def handle(self, items):
-            batch_sizes.append(len(items))
+            with open(sizes, "a") as fh:
+                fh.write(f"{len(items)}\n")
             return [i * 2 for i in items]
 
         def __call__(self, x):
@@ -168,6 +169,7 @@ def test_batching(serve_instance):
     handle = serve.run(Batched.bind(), name="batched", route_prefix=None)
     responses = [handle.remote(i) for i in range(8)]
     assert [r.result() for r in responses] == [0, 2, 4, 6, 8, 10, 12, 14]
+    batch_sizes = [int(x) for x in sizes.read_text().split()]
     assert max(batch_sizes) > 1  # at least some requests were batched
 
 

@@ -15,14 +15,19 @@ def rt():
     ray_tpu.shutdown()
 
 
-def test_multiplexed_lru_and_model_id(rt):
-    loads = []
+def test_multiplexed_lru_and_model_id(rt, tmp_path):
+    loads_file = tmp_path / "loads"  # visible from replica processes
+    loads_file.write_text("")
+
+    def loads():
+        return loads_file.read_text().split()
 
     @serve.deployment(num_replicas=1)
     class ModelServer:
         @serve.multiplexed(max_num_models_per_replica=2)
         def get_model(self, model_id: str):
-            loads.append(model_id)
+            with open(loads_file, "a") as fh:
+                fh.write(model_id + "\n")
             return f"model-{model_id}"
 
         def __call__(self):
@@ -36,14 +41,14 @@ def test_multiplexed_lru_and_model_id(rt):
 
     # Cache hit: same model not reloaded.
     h1.remote().result(timeout_s=20)
-    assert loads == ["a"]
+    assert loads() == ["a"]
 
     # Two more models → LRU evicts "a" (cap 2).
     handle.options(multiplexed_model_id="b").remote().result(timeout_s=20)
     handle.options(multiplexed_model_id="c").remote().result(timeout_s=20)
-    assert loads == ["a", "b", "c"]
+    assert loads() == ["a", "b", "c"]
     h1.remote().result(timeout_s=20)  # "a" evicted → reloaded
-    assert loads == ["a", "b", "c", "a"]
+    assert loads() == ["a", "b", "c", "a"]
 
 
 def test_multiplexed_sticky_routing(rt):

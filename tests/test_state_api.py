@@ -53,13 +53,14 @@ def test_task_filters_and_limit(rt):
         state.list_tasks(filters=[("state", ">", "FINISHED")])
 
 
-def test_retry_attempts_recorded(rt):
-    calls = {"n": 0}
+def test_retry_attempts_recorded(rt, tmp_path):
+    cnt = tmp_path / "attempts"  # works across worker processes too
 
     @ray_tpu.remote(max_retries=2)
     def flaky():
-        calls["n"] += 1
-        if calls["n"] < 3:
+        n = int(cnt.read_text()) + 1 if cnt.exists() else 1
+        cnt.write_text(str(n))
+        if n < 3:
             raise RuntimeError("transient")
         return "ok"
 

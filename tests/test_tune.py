@@ -145,17 +145,17 @@ def test_pbt_exploits_checkpoints():
     assert scores[0] > 0.1 * 9  # better than it could do alone
 
 
-def test_resume_checkpoint_in_function_trainable():
-    seen = {}
+def test_resume_checkpoint_in_function_trainable(tmp_path):
+    seen = tmp_path / "start"  # visible across worker processes
 
     def trainable(config):
         ckpt = tune.get_checkpoint()
         start = ckpt["step"] + 1 if ckpt else 1
-        seen["start"] = start
+        seen.write_text(str(start))
         for step in range(start, 4):
             tune.report({"training_iteration": step},
                         checkpoint={"step": step})
 
     grid = tune.run(trainable, param_space={}, metric="training_iteration")
-    assert seen["start"] == 1
+    assert seen.read_text() == "1"
     assert grid[0].checkpoint == {"step": 3}

@@ -139,12 +139,13 @@ def test_function_dag():
     assert ray_tpu.get(graph.execute(3)) == 50
 
 
-def test_diamond_dag_executes_shared_node_once():
-    calls = []
+def test_diamond_dag_executes_shared_node_once(tmp_path):
+    calls = tmp_path / "calls"  # file-based: visible across worker processes
 
     @ray_tpu.remote
     def base(x):
-        calls.append(1)
+        with open(calls, "a") as fh:
+            fh.write("x")
         return x + 1
 
     @ray_tpu.remote
@@ -163,7 +164,7 @@ def test_diamond_dag_executes_shared_node_once():
         b = base.bind(inp)
         graph = join.bind(left.bind(b), right.bind(b))
     assert ray_tpu.get(graph.execute(1)) == 2 * 2 + 2 * 3
-    assert len(calls) == 1  # diamond: base ran once
+    assert calls.read_text() == "x"  # diamond: base ran once
 
 
 def test_actor_dag():

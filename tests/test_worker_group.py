@@ -2,6 +2,8 @@
 (parity: train/_internal/worker_group.py:101, backend_executor.py:46,
 session.py:132 report/get_context, air FailureConfig)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -25,7 +27,13 @@ def test_worker_group_execute(rt):
         assert wg.execute_single(2, lambda: 42) == 42
     finally:
         wg.shutdown()
-    # Resources return after shutdown.
+    # Resources return after shutdown (asynchronously: the actor death
+    # path releases them once each shell drains).
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ray_tpu.available_resources().get("CPU") == 8.0:
+            break
+        time.sleep(0.05)
     assert ray_tpu.available_resources()["CPU"] == 8.0
 
 
