@@ -64,6 +64,18 @@ class ObjectLostError(RayTpuError):
                          f"reconstructed")
 
 
+class ObjectFreedError(RayTpuError):
+    """Fetch of an object the owner already freed — every reference went
+    out of scope, so the value was garbage-collected (parity:
+    ReferenceCountingAssertionError on get-after-free)."""
+
+    def __init__(self, object_id_hex: str):
+        super().__init__(
+            f"object {object_id_hex} was freed: all references to it went "
+            f"out of scope and its value was garbage-collected"
+        )
+
+
 class WorkerDiedError(RayTpuError):
     """The OS worker process executing a task died (crash, kill -9, OOM
     kill).  Retriable: the task is resubmitted per max_retries (parity:

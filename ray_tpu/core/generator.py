@@ -83,6 +83,25 @@ class ObjectRefGenerator:
         self._index += 1
         return ObjectRef(oid)
 
+    def __del__(self):
+        # GC of the consumer handle releases sealed-but-unconsumed
+        # stream items (and the end-of-stream sentinel) — consumed items
+        # have their own counted ObjectRef handles (parity: the
+        # streaming generator's out-of-scope cleanup in task_manager.cc).
+        try:
+            from ray_tpu.core import api
+
+            if api.is_initialized():
+                rt = api.runtime()
+                # Async: __del__ may run inside a GC pause on a thread
+                # holding store/wire locks — never do lock-taking (or
+                # RPC) work here.
+                release = getattr(rt, "release_stream_async", None)
+                if release is not None:
+                    release(self._task_id, self._index)
+        except Exception:
+            pass
+
     def __repr__(self) -> str:
         return (f"ObjectRefGenerator(task={self._task_id.hex()[:12]}, "
                 f"next_index={self._index})")

@@ -8,18 +8,71 @@ it through the runtime's object store.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Callable, List, Optional
 
 from ray_tpu.utils.ids import ObjectID
 
+# Ownership hooks (parity: the Cython ObjectRef's ctor/dealloc calling
+# into ReferenceCounter AddLocalReference/RemoveLocalReference,
+# ray: python/ray/_raylet.pyx ObjectRef.__dealloc__).  A runtime (or a
+# worker-side runtime proxy) installs (on_create, on_delete); every live
+# ObjectRef instance then counts one local reference.  Each ref captures
+# the on_delete it was born under so refs outliving a runtime decrement
+# the right (possibly closed, then no-op) counter.
+_ref_hooks: Optional[tuple] = None
+
+# Thread-local sink collecting oids of refs serialized inside a value —
+# the "nested refs" detection (parity: serialization counting contained
+# ObjectRefs, ray: _private/serialization.py ownership registration).
+_nested_tl = threading.local()
+
+
+def install_ref_hooks(on_create: Callable[[ObjectID], None],
+                      on_delete: Callable[[ObjectID], None]) -> None:
+    global _ref_hooks
+    _ref_hooks = (on_create, on_delete)
+
+
+def clear_ref_hooks() -> None:
+    global _ref_hooks
+    _ref_hooks = None
+
+
+@contextlib.contextmanager
+def collect_nested_refs():
+    """Within this context (current thread), every ObjectRef that gets
+    pickled reports its oid into the yielded list."""
+    prev = getattr(_nested_tl, "sink", None)
+    sink: List[ObjectID] = []
+    _nested_tl.sink = sink
+    try:
+        yield sink
+    finally:
+        _nested_tl.sink = prev
+
 
 class ObjectRef:
-    __slots__ = ("id", "_owner", "owner_hint")
+    __slots__ = ("id", "_owner", "owner_hint", "_on_del", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner_hint: str = ""):
         self.id = object_id
         self.owner_hint = owner_hint  # node/worker that owns the value
+        hooks = _ref_hooks
+        if hooks is not None:
+            self._on_del = hooks[1]
+            hooks[0](object_id)
+        else:
+            self._on_del = None
+
+    def __del__(self):
+        on_del = getattr(self, "_on_del", None)
+        if on_del is not None:
+            try:
+                on_del(self.id)
+            except Exception:
+                pass
 
     def binary(self) -> bytes:
         return self.id.binary()
@@ -40,8 +93,14 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __reduce__(self):
-        # Refs serialize by id — ownership bookkeeping happens in the
-        # serialization hooks of the runtime (borrower registration).
+        # Refs serialize by id; deserialization re-enters __init__ so a
+        # reconstructed handle (driver or borrower process) re-registers
+        # with whatever counter is installed there.  When a nested-ref
+        # collector is active (store seal / result encode), report this
+        # oid so the outer object pins it.
+        sink = getattr(_nested_tl, "sink", None)
+        if sink is not None:
+            sink.append(self.id)
         return (ObjectRef, (self.id, self.owner_hint))
 
     # Allow `await ref` inside async actors.

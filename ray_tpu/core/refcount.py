@@ -1,0 +1,275 @@
+"""Ownership / distributed reference counting — the owner-side GC.
+
+Parity with the reference's ReferenceCounter
+(ray: src/ray/core_worker/reference_count.h:61, 1,630 LoC protocol):
+every object has exactly one owner (here: the driver runtime), which
+tracks all reasons the value must stay alive and frees the store copy
+the moment the last one disappears.
+
+Reference kinds tracked, mirroring the reference's protocol:
+
+- **local handles** — live ``ObjectRef`` Python instances in the owner
+  process (ray: "local references" from the language frontend).  Hooked
+  via ``ObjectRef.__init__``/``__del__`` (object_ref.install_ref_hooks).
+- **seal pins** — a task return oid is pinned from submission until its
+  value (or error) is sealed, so dropping the future before the task
+  finishes doesn't free the slot out from under the executor (ray:
+  "submitted task return references" in reference_count.h).
+- **borrows** — handles held by other processes (workers that
+  deserialized a ref in task args, or got one back from a nested
+  submission).  Workers batch add/del updates over the wire; a worker's
+  borrows all drop when it dies (ray: the borrower protocol,
+  AddBorrowedObject / WaitForRefRemoved).
+- **nested pins** — a sealed object whose serialized bytes contain
+  other refs pins those inner objects until the outer is freed (ray:
+  "contained in owned" nested refs).
+
+Freeing cascades through lineage: the runtime drops the freed object's
+lineage entry, which releases the task spec's argument handles, which
+may drop further counts (ray: lineage pinning bounded by the ref count,
+reference_count.h ``lineage_ref_count_``).
+
+Frees are deferred to a dedicated thread: ``__del__`` runs at arbitrary
+GC points (possibly while the caller holds runtime/store locks), so the
+zero-transition only enqueues the oid.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+from ray_tpu.utils.ids import ObjectID
+
+
+class TombstoneSet:
+    """Bounded membership set with FIFO eviction — the set and ring are
+    kept in sync so memory stays bounded.  NOT thread-safe: callers
+    bring their own lock (bare ``in`` checks are GIL-atomic and may be
+    done unlocked)."""
+
+    __slots__ = ("_ring", "_set")
+
+    def __init__(self, maxlen: int):
+        self._ring: "collections.deque" = collections.deque(maxlen=maxlen)
+        self._set: Set = set()
+
+    def add(self, item) -> None:
+        if item in self._set:
+            return
+        if len(self._ring) == self._ring.maxlen:
+            self._set.discard(self._ring[0])
+        self._ring.append(item)
+        self._set.add(item)
+
+    def __contains__(self, item) -> bool:
+        return item in self._set
+
+    def __bool__(self) -> bool:
+        return bool(self._set)
+
+    def discard(self, item) -> None:
+        # Lazy: drop set membership now; the ring entry ages out.
+        self._set.discard(item)
+
+
+class ReferenceCounter:
+    """Owner-side per-object reference ledger.
+
+    ``on_zero(oid)`` runs on the free thread (never inline with the
+    decrement) once an oid's total count — local handles + seal pins +
+    borrows + nested pins — transitions to zero.  Only oids that were
+    ever tracked are freed; a never-referenced sealed object (e.g. a
+    stream item the consumer never asked for) is the producer-side
+    structures' responsibility.
+    """
+
+    def __init__(self, on_zero: Callable[[ObjectID], None]):
+        # RLock: add_local/remove_local run from ObjectRef.__init__/
+        # __del__; an allocation inside the critical section can trigger
+        # cyclic GC, whose collected ObjectRefs re-enter these methods
+        # on the SAME thread — a plain Lock would self-deadlock.
+        self._lock = threading.RLock()
+        self._on_zero = on_zero
+        self._local: Dict[ObjectID, int] = {}
+        self._pins: Dict[ObjectID, int] = {}
+        # oid -> {worker_key -> count}
+        self._borrows: Dict[ObjectID, Dict[str, int]] = {}
+        # outer oid -> inner oids pinned by it (each inner got +1 pin)
+        self._nested: Dict[ObjectID, List[ObjectID]] = {}
+        self._closed = False
+        self._freeq: "collections.deque[ObjectID]" = collections.deque()
+        self._free_cv = threading.Condition()
+        self._free_thread = threading.Thread(
+            target=self._free_loop, name="refcount-gc", daemon=True
+        )
+        self._free_thread.start()
+
+    # -- count mutation ----------------------------------------------------
+
+    def add_local(self, oid: ObjectID) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._local[oid] = self._local.get(oid, 0) + 1
+
+    def remove_local(self, oid: ObjectID) -> None:
+        self._dec(self._local, oid)
+
+    def add_seal_pin(self, oid: ObjectID) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._pins[oid] = self._pins.get(oid, 0) + 1
+
+    def remove_seal_pin(self, oid: ObjectID) -> None:
+        self._dec(self._pins, oid)
+
+    def add_borrow(self, worker_key: str, oid: ObjectID) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            per = self._borrows.setdefault(oid, {})
+            per[worker_key] = per.get(worker_key, 0) + 1
+
+    def remove_borrow(self, worker_key: str, oid: ObjectID) -> None:
+        free = False
+        with self._lock:
+            per = self._borrows.get(oid)
+            if per is None or worker_key not in per:
+                return
+            per[worker_key] -= 1
+            if per[worker_key] <= 0:
+                del per[worker_key]
+            if not per:
+                del self._borrows[oid]
+                free = self._is_zero_locked(oid)
+        if free:
+            self._enqueue_free(oid)
+
+    def drop_worker(self, worker_key: str) -> None:
+        """A worker process died: all of its borrows evaporate (ray: the
+        owner clears borrower entries when the borrower disconnects)."""
+        freed = []
+        with self._lock:
+            for oid in list(self._borrows):
+                per = self._borrows[oid]
+                if per.pop(worker_key, None) is not None and not per:
+                    del self._borrows[oid]
+                    if self._is_zero_locked(oid):
+                        freed.append(oid)
+        for oid in freed:
+            self._enqueue_free(oid)
+
+    def add_nested(self, outer: ObjectID, inners: List[ObjectID]) -> None:
+        """``outer``'s sealed bytes contain refs to ``inners`` — pin
+        them until outer is freed."""
+        if not inners:
+            return
+        with self._lock:
+            if self._closed:
+                return
+            self._nested.setdefault(outer, []).extend(inners)
+            for oid in inners:
+                self._pins[oid] = self._pins.get(oid, 0) + 1
+
+    # -- queries -----------------------------------------------------------
+
+    def count(self, oid: ObjectID) -> int:
+        with self._lock:
+            return (self._local.get(oid, 0) + self._pins.get(oid, 0)
+                    + sum(self._borrows.get(oid, {}).values()))
+
+    def tracked(self) -> Set[ObjectID]:
+        with self._lock:
+            out: Set[ObjectID] = set(self._local)
+            out.update(self._pins)
+            out.update(self._borrows)
+            return out
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "local_refs": sum(self._local.values()),
+                "seal_pins": sum(self._pins.values()),
+                "borrowed": len(self._borrows),
+                "nested_outers": len(self._nested),
+            }
+
+    # -- internals ---------------------------------------------------------
+
+    def _dec(self, table: Dict[ObjectID, int], oid: ObjectID) -> None:
+        free = False
+        with self._lock:
+            n = table.get(oid)
+            if n is None:
+                return
+            if n <= 1:
+                del table[oid]
+                free = self._is_zero_locked(oid)
+            else:
+                table[oid] = n - 1
+        if free:
+            self._enqueue_free(oid)
+
+    def _is_zero_locked(self, oid: ObjectID) -> bool:
+        return (not self._closed
+                and self._local.get(oid, 0) == 0
+                and self._pins.get(oid, 0) == 0
+                and not self._borrows.get(oid))
+
+    def _enqueue_free(self, oid: ObjectID) -> None:
+        with self._free_cv:
+            self._freeq.append(oid)
+            self._free_cv.notify()
+
+    def defer(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` on the free thread.  For cleanup reachable from
+        ``__del__`` (e.g. generator stream release) that must not take
+        runtime/store locks inside a GC pause."""
+        with self._free_cv:
+            if self._closed:
+                return
+            self._freeq.append(fn)
+            self._free_cv.notify()
+
+    def _free_loop(self) -> None:
+        while True:
+            with self._free_cv:
+                while not self._freeq and not self._closed:
+                    self._free_cv.wait()
+                if self._closed and not self._freeq:
+                    return
+                item = self._freeq.popleft()
+            if callable(item):
+                try:
+                    item()
+                except Exception:
+                    pass
+                continue
+            oid = item
+            # Re-check under lock: a new handle may have appeared between
+            # the zero transition and now (e.g. a borrower registered).
+            with self._lock:
+                if not self._is_zero_locked(oid):
+                    continue
+                inners = self._nested.pop(oid, None)
+            try:
+                self._on_zero(oid)
+            except Exception:
+                pass
+            if inners:
+                for inner in inners:
+                    self.remove_seal_pin(inner)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._local.clear()
+            self._pins.clear()
+            self._borrows.clear()
+            self._nested.clear()
+        with self._free_cv:
+            self._freeq.clear()
+            self._free_cv.notify_all()

@@ -516,7 +516,7 @@ class _ProcessActorShell(_ActorShell):
             # Init args ship raw — ObjectRefs stay refs, matching the
             # thread shell (the instance resolves them itself if/when
             # it wants the values).
-            wh.call(
+            rep = wh.call(
                 "actor_create",
                 spec=_cp.dumps((self.cls, self.init_args,
                                 self.init_kwargs)),
@@ -525,6 +525,9 @@ class _ProcessActorShell(_ActorShell):
                     self.options.runtime_env),
                 max_concurrency=self.options.max_concurrency,
             )
+            if isinstance(rep, dict):
+                self.runtime.apply_ref_batches(
+                    rep, self.runtime._worker_ref_key(wh))
         except BaseException:
             # A half-constructed worker may hold broken state — never
             # return it to the pool.
@@ -560,12 +563,11 @@ class _ProcessActorShell(_ActorShell):
                 task=(task_id.binary() if task_id is not None else b""),
                 trace_ctx=_tracing().capture_context(),
             )
+        wkey = self.runtime._worker_ref_key(self._worker)
         if num_returns != "streaming":
-            for oid, (kind, payload) in zip(return_ids, rep["results"]):
-                if kind == "shm":
-                    self.runtime.store.mark_shm_sealed(oid, payload)
-                else:
-                    self.runtime.store.put_serialized(oid, payload)
+            self.runtime.seal_remote_results(return_ids, rep, wkey)
+        else:
+            self.runtime.apply_ref_batches(rep, wkey)
 
     def _item_error(self, qname: str, e: BaseException) -> BaseException:
         from ray_tpu.core.exceptions import WorkerDiedError
@@ -681,6 +683,32 @@ class LocalRuntime:
         # Readers hitting a lost object trigger lazy lineage
         # reconstruction (parity: recovery on fetch failure).
         self.store.lost_object_callback = self._reconstruct_object
+        # Ownership / reference counting (parity: ReferenceCounter,
+        # reference_count.h:61): local handles via ObjectRef hooks,
+        # seal pins for in-flight task returns, borrows from worker
+        # processes, nested pins from sealed values.  Zero → the free
+        # thread releases the store copy and this object's lineage
+        # entry (which in turn drops the task spec's argument handles —
+        # lineage bounded by the ref count).
+        from ray_tpu.core import object_ref as _object_ref
+        from ray_tpu.core.refcount import ReferenceCounter
+
+        self.refs = ReferenceCounter(self._on_refs_zero)
+        # RLock: release_stream (reachable from generator __del__ via
+        # the defer path, and directly in tests) takes it while seal
+        # callbacks may be on the same stack.
+        self._seal_pin_lock = threading.RLock()
+        self._seal_pinned: set = set()
+        # Streams whose consumer generator was dropped: items the
+        # producer seals afterwards are released on arrival instead of
+        # leaking (bounded tombstone ring).
+        from ray_tpu.core.refcount import TombstoneSet
+
+        self._dropped_streams = TombstoneSet(4096)
+        self.store.on_sealed = self._on_object_sealed
+        self.store.on_nested = self.refs.add_nested
+        self._ref_hooks = (self.refs.add_local, self.refs.remove_local)
+        _object_ref.install_ref_hooks(*self._ref_hooks)
         # Execution backend: thread (in-process) or pooled OS worker
         # processes over the shared-memory object plane (parity: the
         # raylet's WorkerPool of forked language workers,
@@ -857,6 +885,63 @@ class LocalRuntime:
         return ObjectID.from_put(self.driver_task_id,
                                  next(self._put_counter))
 
+    # -- ownership / GC ----------------------------------------------------
+
+    def _pin_returns(self, return_ids: Sequence[ObjectID]) -> None:
+        """Pin task-return oids from submission until seal, so dropping
+        the future before the task finishes can't free the slot under
+        the executor (parity: submitted-task return refs)."""
+        with self._seal_pin_lock:
+            for oid in return_ids:
+                self.refs.add_seal_pin(oid)
+                self._seal_pinned.add(oid)
+
+    def _on_object_sealed(self, oid: ObjectID) -> None:
+        with self._seal_pin_lock:
+            pinned = oid in self._seal_pinned
+            if pinned:
+                self._seal_pinned.discard(oid)
+            dropped_stream = (self._dropped_streams
+                              and oid.task_id() in self._dropped_streams)
+        if pinned:
+            self.refs.remove_seal_pin(oid)
+        if dropped_stream:
+            # Item sealed into an abandoned stream — nobody can ever
+            # consume it (the generator is gone); release on arrival.
+            self.store.release(oid)
+
+    def _on_refs_zero(self, oid: ObjectID) -> None:
+        """Free thread: last reference to ``oid`` dropped.  Release the
+        store copy and this object's lineage/location entries; dropping
+        the lineage task spec releases its argument handles, cascading
+        the collection upstream (parity: lineage_ref_count_)."""
+        with self._lock:
+            self._lineage.pop(oid, None)
+            self._object_locations.pop(oid, None)
+        self.store.release(oid, tombstone=True)
+
+    def release_stream_async(self, task_id: TaskID, from_index: int) -> None:
+        """GC-safe entry for generator __del__: defers the release to
+        the free thread (release_stream takes store/runtime locks that
+        may already be held by the thread a GC pause interrupted)."""
+        self.refs.defer(lambda: self.release_stream(task_id, from_index))
+
+    def release_stream(self, task_id: TaskID, from_index: int) -> None:
+        """A dropped ObjectRefGenerator releases sealed-but-unconsumed
+        stream items (consumed items have their own counted handles).
+        The stream is also marked dropped FIRST, so items a still-running
+        producer seals after this scan are released on arrival
+        (_on_object_sealed) instead of leaking."""
+        with self._seal_pin_lock:
+            self._dropped_streams.add(task_id)
+        i = from_index
+        while True:
+            oid = ObjectID.for_task_return(task_id, i)
+            if not self.store.contains(oid):
+                return
+            self.store.release(oid)
+            i += 1
+
     def _wire_args(self, args: tuple, kwargs: dict):
         """Replace top-level ObjectRef args with their WIRE
         representation for shipping to a worker process — shared-arena
@@ -965,6 +1050,12 @@ class LocalRuntime:
         i = 0
         while True:
             oid = ObjectID.for_task_return(task_id, i)
+            if self.store.is_freed(oid):
+                # Consumed-and-dropped index (refcount freed it): not
+                # the first unsealed — keep scanning, or the consumer
+                # hangs at the real one.
+                i += 1
+                continue
             if self.store.put_error_if_pending(oid, err):
                 return
             if self.store.peek_error(oid) is not None:
@@ -1133,6 +1224,7 @@ class LocalRuntime:
             ObjectID.for_task_return(task_id, i)
             for i in range(options.num_returns)
         ]
+        self._pin_returns(return_ids)
         pt = _PendingTask(
             fn=fn, args=args, kwargs=kwargs, options=options,
             return_ids=return_ids,
@@ -1326,13 +1418,49 @@ class LocalRuntime:
             )
         finally:
             self.worker_pool.release(wh)
+        wkey = self._worker_ref_key(wh)
         if pt.streaming:
-            return  # the worker sealed every index + the sentinel
-        for oid, (kind, payload) in zip(pt.return_ids, rep["results"]):
+            # The worker sealed every index + the sentinel.
+            self.apply_ref_batches(rep, wkey)
+            return
+        self.seal_remote_results(pt.return_ids, rep, wkey)
+
+    @staticmethod
+    def _worker_ref_key(wh) -> str:
+        from ray_tpu.core.worker_pool import _wkey
+
+        return _wkey(wh.chan)
+
+    def apply_ref_batches(self, rep: Dict[str, Any], worker_key: str,
+                          which: str = "both") -> None:
+        """Apply borrow add/del batches piggybacked on a worker reply."""
+        if which in ("both", "add"):
+            for b in rep.get("ref_add") or ():
+                self.refs.add_borrow(worker_key, ObjectID(b))
+        if which in ("both", "rem"):
+            for b in rep.get("ref_rem") or ():
+                self.refs.remove_borrow(worker_key, ObjectID(b))
+
+    def seal_remote_results(self, return_ids: Sequence[ObjectID],
+                            rep: Dict[str, Any],
+                            worker_key: Optional[str] = None) -> None:
+        """Seal a worker task reply's results.  Order matters: borrow
+        ADDS first (they may cover refs inside the returned values),
+        then nested pins, then the seal, then borrow DELS — so a del of
+        a ref riding in the reply can never free it before its pin."""
+        if worker_key is not None:
+            self.apply_ref_batches(rep, worker_key, which="add")
+        nested = rep.get("nested") or [()] * len(return_ids)
+        for oid, (kind, payload), inner in zip(return_ids,
+                                               rep["results"], nested):
+            if inner:
+                self.refs.add_nested(oid, [ObjectID(b) for b in inner])
             if kind == "shm":
                 self.store.mark_shm_sealed(oid, payload)
             else:
                 self.store.put_serialized(oid, payload)
+        if worker_key is not None:
+            self.apply_ref_batches(rep, worker_key, which="rem")
 
     def _notify(self):
         with self._dispatch_cv:
@@ -1369,6 +1497,10 @@ class LocalRuntime:
         actor_id = ActorID.of(self.job_id)
         creation_task_id = TaskID.of(actor_id)
         creation_oid = ObjectID.for_task_return(creation_task_id, 0)
+        # Permanent pin (not seal-cleared): restarts RE-seal this oid,
+        # so it must never be freed/tombstoned while the actor lives;
+        # _finish_actor_removal drops the pin and the store entry.
+        self.refs.add_seal_pin(creation_oid)
         shell_cls = (_ProcessActorShell if self.worker_pool is not None
                      else _ActorShell)
         shell = shell_cls(self, actor_id, cls, args, kwargs, options,
@@ -1403,6 +1535,7 @@ class LocalRuntime:
         return_ids = [] if streaming else [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
+        self._pin_returns(return_ids)
         if shell is None:
             err = ActorDiedError(actor_id.hex(), "no such actor")
             for oid in return_ids:
@@ -1553,6 +1686,10 @@ class LocalRuntime:
         }
 
     def _finish_actor_removal(self, shell: _ActorShell):
+        # Drop the creation oid's permanent pin (its error/None value
+        # stays readable through any still-held handles; the pin removal
+        # lets it free once those drop).
+        self.refs.remove_seal_pin(shell._creation_oid)
         with self._lock:
             self._dead_actors.append(self._actor_row(shell, "DEAD"))
             self._actors.pop(shell.actor_id, None)
@@ -1578,6 +1715,9 @@ class LocalRuntime:
             ready_oid=ready_oid,
             lifetime=lifetime,
         )
+        # Permanent pin: ready() can be called repeatedly for the PG's
+        # lifetime; remove_placement_group drops pin + store entry.
+        self.refs.add_seal_pin(ready_oid)
         with self._lock:
             self._pgs[pg_id] = st
             if name:
@@ -1722,6 +1862,11 @@ class LocalRuntime:
         for shell in doomed:
             shell.restarts_left = 0
             shell.kill(no_restart=True)
+        # Drop the ready marker's permanent pin + store entry.  The
+        # tombstone turns a get on a still-held pg.ready() ref into
+        # ObjectFreedError instead of an unseal-forever hang.
+        self.refs.remove_seal_pin(st.ready_oid)
+        self.store.release(st.ready_oid, tombstone=True)
         self._notify()
 
     def get_named_placement_group(self, name: str) -> PlacementGroup:
@@ -1790,6 +1935,13 @@ class LocalRuntime:
             } for nid in self._node_order]
 
     def shutdown(self):
+        from ray_tpu.core import object_ref as _object_ref
+
+        # Stop counting first: mass ref destruction during teardown must
+        # not trigger frees against a closing store.
+        self.refs.close()
+        if _object_ref._ref_hooks == self._ref_hooks:
+            _object_ref.clear_ref_hooks()
         with self._dispatch_cv:
             self._shutdown = True
             self._dispatch_cv.notify_all()

@@ -17,8 +17,12 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
-from ray_tpu.core.object_ref import ObjectState
+from ray_tpu.core.exceptions import (
+    GetTimeoutError,
+    ObjectFreedError,
+    ObjectLostError,
+)
+from ray_tpu.core.object_ref import ObjectState, collect_nested_refs
 from ray_tpu.utils.ids import ObjectID
 import itertools as _itertools
 
@@ -75,6 +79,19 @@ class LocalObjectStore:
         # the runtime hooks lineage reconstruction here (parity: the
         # plasma fetch failure that triggers ObjectRecoveryManager).
         self.lost_object_callback = None
+        # Ownership hooks (parity: the plasma/owner interplay in
+        # reference_count.cc).  on_sealed(oid) fires once a value/error
+        # is sealed — the runtime drops the task-return seal pin there.
+        # on_nested(oid, [inner]) reports refs found inside a sealed
+        # value so the counter can pin them.
+        self.on_sealed = None
+        self.on_nested = None
+        # Tombstones of freed oids — a late get raises ObjectFreedError
+        # instead of blocking forever.  Bounded (parity: the owner
+        # keeps OUT_OF_SCOPE entries briefly).
+        from ray_tpu.core.refcount import TombstoneSet
+
+        self._freed = TombstoneSet(16384)
         # RLock: _spill_cold_objects holds it while lazily building the
         # storage via _external_storage (same lock).
         self._spill_lock = threading.RLock()
@@ -124,8 +141,10 @@ class LocalObjectStore:
 
     def put_value(self, oid: ObjectID, value: Any) -> None:
         st = self._state(oid)
+        nested = []
         if self._serialize_always:
-            meta, buffers = serialize_parts(value)
+            with collect_nested_refs() as nested:
+                meta, buffers = serialize_parts(value)
             size = framed_size(meta, buffers)
             shm = (self._shm_store()
                    if size >= self._shm_threshold else None)
@@ -146,9 +165,19 @@ class LocalObjectStore:
         else:
             st.in_band = value
         st.lost = False
+        if nested and self.on_nested is not None:
+            # Register nested pins BEFORE waking readers: a reader must
+            # never deserialize inner refs the counter doesn't yet pin.
+            self.on_nested(oid, nested)
         st.event.set()
+        self._sealed(oid)
         if self._inproc_bytes > self._inproc_cap:
             self._spill_cold_objects()
+
+    def _sealed(self, oid: ObjectID) -> None:
+        cb = self.on_sealed
+        if cb is not None:
+            cb(oid)
 
     def _store_inline(self, st, data: bytes) -> None:
         """Account framed bytes into the in-process tier (shared by
@@ -212,6 +241,7 @@ class LocalObjectStore:
         st.error = error
         st.lost = False
         st.event.set()
+        self._sealed(oid)
 
     # -- wire plane (multi-process workers) --------------------------------
 
@@ -245,6 +275,7 @@ class LocalObjectStore:
             self._store_inline(st, data)
         st.lost = False
         st.event.set()
+        self._sealed(oid)
         if self._inproc_bytes > self._inproc_cap:
             self._spill_cold_objects()
 
@@ -256,6 +287,7 @@ class LocalObjectStore:
         st.shm_size = size
         st.lost = False
         st.event.set()
+        self._sealed(oid)
 
     def get_wire(self, oid: ObjectID, timeout: Optional[float] = None):
         """Blocking fetch of an object's WIRE representation for a
@@ -263,6 +295,8 @@ class LocalObjectStore:
         ("b", bytes) — framed serialized payload; ("err", exc) — sealed
         error to re-raise.  Never deserializes the value (the worker
         does the one decode)."""
+        if oid in self._freed:
+            raise ObjectFreedError(oid.hex())
         st = self._state(oid)
         while True:
             ready, _ = self.wait([oid], 1, timeout)
@@ -298,11 +332,17 @@ class LocalObjectStore:
 
         return ("b", serialize_object(in_band))
 
+    def is_freed(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._freed
+
     def put_error_if_pending(self, oid: ObjectID,
                              error: BaseException) -> bool:
         """Seal an error only if the object is still unsealed — used by
         failure paths that must not clobber already-produced stream
-        items."""
+        items.  Freed (tombstoned) oids are never resurrected."""
+        if oid in self._freed:
+            return False
         st = self._state(oid)
         with self._lock:
             if st.event.is_set():
@@ -310,7 +350,8 @@ class LocalObjectStore:
             st.error = error
             st.lost = False
             st.event.set()
-            return True
+        self._sealed(oid)
+        return True
 
     # -- consumer side -----------------------------------------------------
 
@@ -326,6 +367,8 @@ class LocalObjectStore:
         return st.error if st is not None and st.event.is_set() else None
 
     def get(self, oid: ObjectID, timeout: Optional[float] = None) -> Any:
+        if oid in self._freed:
+            raise ObjectFreedError(oid.hex())
         st = self._state(oid)
         deadline = None if timeout is None else time.monotonic() + timeout
         return self._get_loop(st, oid, timeout, deadline)
@@ -420,6 +463,15 @@ class LocalObjectStore:
         while len(ready) < num_returns:
             progressed = False
             for oid in list(pending):
+                if oid in self._freed:
+                    # Freed objects count as ready: the follow-up get
+                    # raises ObjectFreedError immediately (no hang).
+                    ready.append(oid)
+                    pending.remove(oid)
+                    progressed = True
+                    if len(ready) >= num_returns:
+                        break
+                    continue
                 st = self._state(oid)
                 if st.event.is_set():
                     ready.append(oid)
@@ -473,9 +525,11 @@ class LocalObjectStore:
                 pass
         return True
 
-    def release(self, oid: ObjectID) -> None:
+    def release(self, oid: ObjectID, tombstone: bool = False) -> None:
         with self._lock:
             st = self._objects.pop(oid, None)
+            if tombstone:
+                self._freed.add(oid)
             if st is not None and st.value_bytes is not None:
                 self._inproc_bytes -= len(st.value_bytes)
                 # Null the bytes so an in-flight spill of this object

@@ -25,6 +25,7 @@ driver only learns ("shm", size), never the bytes.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import socket
@@ -43,6 +44,98 @@ from ray_tpu.utils.serialization import (
     serialize_parts,
     write_framed,
 )
+
+
+class _RefClient:
+    """Borrower-side reference reporting (parity: the borrower half of
+    the ownership protocol, reference_count.h AddBorrowedObject /
+    removing borrows on WaitForRefRemoved).  Every live ObjectRef in
+    this worker counts one local ref; transitions 0→1 / 1→0 are batched
+    and flushed to the owner as a single ``ref`` message.  Flush points:
+    end of every task / actor method (synchronous — the add must land
+    before the driver releases the task's argument pins) and a periodic
+    background sweep for handles dropped by long-lived actor state."""
+
+    def __init__(self, chan: MsgChannel):
+        self._chan = chan
+        # RLock: on_create/on_delete run from ObjectRef __init__/__del__;
+        # cyclic GC triggered inside the critical section can re-enter
+        # on the same thread (see ReferenceCounter._lock).
+        self._lock = threading.RLock()
+        # Serializes whole flushes (snapshot + send): without it the 1s
+        # sweep and a task-end flush can deliver batches out of snapshot
+        # order — an add overtaken by its del leaks the borrow forever.
+        self._flush_lock = threading.Lock()
+        self._local: Dict[bytes, int] = {}
+        self._adds: set = set()
+        self._dels: set = set()
+        self._adopted: set = set()
+        # (task_id_bin, from_index) stream releases deferred from
+        # generator __del__ — sent by flush, never from GC context.
+        self._stream_releases: "collections.deque" = collections.deque()
+
+    def adopt(self, oid_bin: bytes) -> None:
+        """The owner already registered our borrow (e.g. in the
+        submit-task reply) — the first handle must not re-report it."""
+        with self._lock:
+            self._adopted.add(oid_bin)
+
+    def on_create(self, oid) -> None:
+        b = oid.binary()
+        with self._lock:
+            n = self._local.get(b, 0)
+            self._local[b] = n + 1
+            if n == 0:
+                if b in self._adopted:
+                    self._adopted.discard(b)  # owner-side count exists
+                elif b in self._dels:
+                    self._dels.discard(b)  # cancel the unsent del
+                else:
+                    self._adds.add(b)
+
+    def on_delete(self, oid) -> None:
+        b = oid.binary()
+        with self._lock:
+            n = self._local.get(b, 0)
+            if n <= 1:
+                self._local.pop(b, None)
+                if b in self._adds:
+                    self._adds.discard(b)  # never told the owner
+                else:
+                    self._dels.add(b)
+            else:
+                self._local[b] = n - 1
+
+    def defer_stream_release(self, task_bin: bytes, index: int) -> None:
+        self._stream_releases.append((task_bin, index))
+
+    def drain_batches(self):
+        """Snapshot pending add/del batches for piggybacking on a task
+        reply — the owner applies adds BEFORE sealing/pinning the
+        reply's results and dels AFTER, so a del of a ref that rides in
+        the returned value can never beat its nested pin."""
+        with self._flush_lock:
+            with self._lock:
+                adds, self._adds = self._adds, set()
+                dels, self._dels = self._dels, set()
+        return list(adds), list(dels)
+
+    def flush(self) -> None:
+        with self._flush_lock:
+            with self._lock:
+                adds, self._adds = self._adds, set()
+                dels, self._dels = self._dels, set()
+            streams = []
+            while self._stream_releases:
+                streams.append(self._stream_releases.popleft())
+            try:
+                if adds or dels:
+                    self._chan.call("ref", add=list(adds), rem=list(dels))
+                for task_bin, index in streams:
+                    self._chan.call("release_stream", task=task_bin,
+                                    index=index)
+            except Exception:
+                pass  # channel down → owner drops this worker's borrows
 
 
 class _StoreProxy:
@@ -104,6 +197,14 @@ class WorkerRuntime:
         self._shm_threshold = shm_threshold
         self.store = _StoreProxy(self)
         self.kv = _KvProxy(self)
+        # Borrower-side ref reporting: every ObjectRef built in this
+        # process registers with the owner so borrowed values stay
+        # alive while we hold them.
+        from ray_tpu.core import object_ref as _object_ref
+
+        self.refs = _RefClient(chan)
+        _object_ref.install_ref_hooks(self.refs.on_create,
+                                      self.refs.on_delete)
 
     # -- objects -----------------------------------------------------------
 
@@ -143,26 +244,39 @@ class WorkerRuntime:
         return out[0] if single else out
 
     def put(self, value: Any) -> ObjectRef:
-        meta, buffers = serialize_parts(value)
+        from ray_tpu.core.object_ref import collect_nested_refs
+
+        with collect_nested_refs() as nested:
+            meta, buffers = serialize_parts(value)
+        nested_bins = [o.binary() for o in nested]
         size = framed_size(meta, buffers)
         if self._shm is not None and size >= self._shm_threshold:
             oid_bin = self._chan.call("alloc_put_oid")
+            self.refs.adopt(oid_bin)  # owner pre-registered our borrow
+            sealed = False
             try:
                 buf = self._shm.create(oid_bin, size)
                 write_framed(buf, meta, buffers)
                 self._shm.seal(oid_bin)
-                self._chan.call("mark_shm", oid=oid_bin, size=size)
-                return ObjectRef(ObjectID(oid_bin))
+                sealed = True
             except OSError:
                 pass  # arena full → inline fallback
+            if sealed:
+                # Outside the try: a ChannelClosedError here is a real
+                # failure (the value IS in the arena), not arena-full.
+                self._chan.call("mark_shm", oid=oid_bin, size=size,
+                                nested=nested_bins)
+                return ObjectRef(ObjectID(oid_bin))
             out = bytearray(size)
             write_framed(memoryview(out), meta, buffers)
             self._chan.call("seal_value", oid=oid_bin,
-                            entry=("b", bytes(out)))
+                            entry=("b", bytes(out)), nested=nested_bins)
             return ObjectRef(ObjectID(oid_bin))
         out = bytearray(size)
         write_framed(memoryview(out), meta, buffers)
-        oid_bin = self._chan.call("put_val", data=bytes(out))
+        oid_bin = self._chan.call("put_val", data=bytes(out),
+                                  nested=nested_bins)
+        self.refs.adopt(oid_bin)
         return ObjectRef(ObjectID(oid_bin))
 
     def wait(self, refs, num_returns: int, timeout: Optional[float],
@@ -172,6 +286,11 @@ class WorkerRuntime:
         by_id = {r.id: r for r in refs}
         return ([by_id[i] for i in ready_ids],
                 [by_id[i] for i in pending_ids])
+
+    def release_stream_async(self, task_id: TaskID, from_index: int) -> None:
+        # Called from generator __del__ (possibly inside a GC pause) —
+        # never RPC here; the next flush (task end or 1 s sweep) sends it.
+        self.refs.defer_stream_release(task_id.binary(), from_index)
 
     # -- tasks / actors ----------------------------------------------------
 
@@ -186,6 +305,8 @@ class WorkerRuntime:
             from ray_tpu.core.generator import ObjectRefGenerator
 
             return ObjectRefGenerator(TaskID(rep["stream"]))
+        for b in rep["oids"]:
+            self.refs.adopt(b)  # owner pre-registered our borrow
         return [ObjectRef(ObjectID(b)) for b in rep["oids"]]
 
     def create_actor(self, cls, args, kwargs, options):
@@ -214,6 +335,8 @@ class WorkerRuntime:
             from ray_tpu.core.generator import ObjectRefGenerator
 
             return ObjectRefGenerator(TaskID(rep["stream"]))
+        for b in rep["oids"]:
+            self.refs.adopt(b)
         return [ObjectRef(ObjectID(b)) for b in rep["oids"]]
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
@@ -330,14 +453,24 @@ class _WorkerServer:
         # sidesteps the entire class of bug.
         self._task_exec = _ActorExecutor(1)
         self._exit = threading.Event()
+        # In-flight pushed work: the 1s ref sweep only flushes when
+        # idle, so a sweep-sent del can't overtake a reply-attached add.
+        self._busy = 0
+        self._busy_lock = threading.Lock()
 
     # -- value encoding ----------------------------------------------------
 
     def _encode_result(self, value: Any, dest_oid: Optional[bytes]):
         """Wire entry for one produced value: written straight into the
         shared arena under its destination ObjectID when large, inline
-        bytes otherwise."""
-        meta, buffers = serialize_parts(value)
+        bytes otherwise.  Returns (entry, nested_oid_bins) — refs
+        serialized inside the value, which the owner pins under the
+        result oid (nested ownership)."""
+        from ray_tpu.core.object_ref import collect_nested_refs
+
+        with collect_nested_refs() as nested:
+            meta, buffers = serialize_parts(value)
+        nested_bins = [o.binary() for o in nested]
         size = framed_size(meta, buffers)
         if (self._shm is not None and dest_oid is not None
                 and size >= self._shm_threshold):
@@ -345,12 +478,12 @@ class _WorkerServer:
                 buf = self._shm.create(dest_oid, size)
                 write_framed(buf, meta, buffers)
                 self._shm.seal(dest_oid)
-                return ("shm", size)
+                return ("shm", size), nested_bins
             except OSError:
                 pass
         out = bytearray(size)
         write_framed(memoryview(out), meta, buffers)
-        return ("b", bytes(out))
+        return ("b", bytes(out)), nested_bins
 
     def _decode_args(self, args, kwargs) -> Tuple[tuple, dict]:
         def dec(v):
@@ -386,17 +519,49 @@ class _WorkerServer:
     def handle(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:
         op = msg["op"]
         if op == "task":
-            return self._task_exec.run(lambda: self._run_task(msg))
+            return self._run_op(
+                lambda: self._task_exec.run(lambda: self._run_task(msg)))
         if op == "actor_create":
-            return self._actor_create(msg)
+            return self._run_op(lambda: self._actor_create(msg))
         if op == "actor_task":
-            return self._actor_task(msg)
+            return self._run_op(lambda: self._actor_task(msg))
         if op == "ping":
             return "pong"
         if op == "exit":
             self._exit.set()
             return None
         raise ValueError(f"unknown driver op {op!r}")
+
+    def _run_op(self, body) -> Dict[str, Any]:
+        """Run one pushed work item.  On success the pending borrow
+        add/del batches ride IN the reply (the driver applies adds
+        before pinning/sealing results and dels after); on failure they
+        flush as a plain ref message — an error reply carries no values
+        to pin, so ordering doesn't matter there."""
+        with self._busy_lock:
+            self._busy += 1
+        try:
+            try:
+                rep = body()
+            except BaseException:
+                self._flush_refs()
+                raise
+            # Drain while still "busy" so the sweep can't grab (and
+            # send out-of-band) a del that belongs after this reply.
+            rep = rep if rep is not None else {}
+            adds, dels = self._wr.refs.drain_batches()
+            if adds:
+                rep["ref_add"] = adds
+            if dels:
+                rep["ref_rem"] = dels
+            return rep
+        finally:
+            with self._busy_lock:
+                self._busy -= 1
+
+    def _flush_refs(self) -> None:
+        if self._wr is not None:
+            self._wr.refs.flush()
 
     def _run_task(self, msg: Dict[str, Any]) -> Any:
         fn, args, kwargs = cloudpickle.loads(msg["spec"])
@@ -407,21 +572,28 @@ class _WorkerServer:
             if msg.get("streaming"):
                 self._stream(result, TaskID(msg["task"]), msg["name"])
                 return {"streamed": True}
+        return self._encode_reply(result, msg)
+
+    def _encode_reply(self, result, msg: Dict[str, Any]) -> Dict[str, Any]:
         num_returns = msg.get("num_returns", 1)
         returns = msg.get("returns", [])
         if num_returns == 1:
-            return {"results": [self._encode_result(
-                result, returns[0] if returns else None)]}
+            entry, nested = self._encode_result(
+                result, returns[0] if returns else None)
+            return {"results": [entry], "nested": [nested]}
         values = list(result)
         if len(values) != num_returns:
             raise ValueError(
                 f"task declared num_returns={num_returns} but returned "
                 f"{len(values)} values"
             )
-        return {"results": [
-            self._encode_result(v, returns[i] if i < len(returns) else None)
-            for i, v in enumerate(values)
-        ]}
+        entries, nesteds = [], []
+        for i, v in enumerate(values):
+            entry, nested = self._encode_result(
+                v, returns[i] if i < len(returns) else None)
+            entries.append(entry)
+            nesteds.append(nested)
+        return {"results": entries, "nested": nesteds}
 
     def _stream(self, result, task_id: TaskID, name: str) -> None:
         """Seal yielded items into the driver's store one by one
@@ -438,8 +610,9 @@ class _WorkerServer:
                 )
             for item in result:
                 oid = ObjectID.for_task_return(task_id, i)
-                entry = self._encode_result(item, oid.binary())
-                self._chan.call("seal_value", oid=oid.binary(), entry=entry)
+                entry, nested = self._encode_result(item, oid.binary())
+                self._chan.call("seal_value", oid=oid.binary(), entry=entry,
+                                nested=nested)
                 i += 1
         except BaseException as e:
             err = e if isinstance(e, TaskError) else TaskError(name, e)
@@ -493,21 +666,7 @@ class _WorkerServer:
             if msg.get("num_returns") == "streaming":
                 self._stream(result, TaskID(msg["task"]), msg["method"])
                 return {"streamed": True}
-        num_returns = msg.get("num_returns", 1)
-        returns = msg.get("returns", [])
-        if num_returns == 1:
-            return {"results": [self._encode_result(
-                result, returns[0] if returns else None)]}
-        values = list(result)
-        if len(values) != num_returns:
-            raise ValueError(
-                f"method declared num_returns={num_returns} but returned "
-                f"{len(values)} values"
-            )
-        return {"results": [
-            self._encode_result(v, returns[i] if i < len(returns) else None)
-            for i, v in enumerate(values)
-        ]}
+        return self._encode_reply(result, msg)
 
     # -- bootstrap ---------------------------------------------------------
 
@@ -566,6 +725,24 @@ class _WorkerServer:
         from ray_tpu.core import api
 
         api._runtime = self._wr
+
+        def ref_sweep():
+            # Handles dropped by long-lived actor state between tasks
+            # (reply-attached batches cover everything else).  Only
+            # when idle: a sweep del racing an in-flight reply's adds
+            # would leak the borrow.
+            while not self._exit.wait(1.0):
+                with self._busy_lock:
+                    busy = self._busy
+                if busy:
+                    continue
+                try:
+                    self._wr.refs.flush()
+                except Exception:
+                    pass
+
+        threading.Thread(target=ref_sweep, name="ref-sweep",
+                         daemon=True).start()
         self._chan.start()
         self._exit.wait()
         # Let in-flight replies flush before dying.

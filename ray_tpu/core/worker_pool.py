@@ -38,6 +38,12 @@ if TYPE_CHECKING:
     from ray_tpu.core.runtime import LocalRuntime
 
 
+def _wkey(chan) -> str:
+    """Borrower identity of a worker = its channel object (stable for
+    the worker's lifetime; all borrows drop together on close)."""
+    return f"w{id(chan):x}"
+
+
 class WorkerHandle:
     """One registered worker process."""
 
@@ -57,6 +63,12 @@ class WorkerHandle:
     def _on_close(self) -> None:
         self.dead = True
         self.pool._discard(self)
+        # A dead borrower's references evaporate (parity: the owner
+        # clears borrows when the borrower disconnects).
+        try:
+            self.pool._rt.refs.drop_worker(_wkey(self.chan))
+        except Exception:
+            pass
         cb = self.on_death
         if cb is not None:
             try:
@@ -291,6 +303,11 @@ class WorkerPool:
 
     # -- nested-API dispatch (worker → driver) -----------------------------
 
+    def _register_nested(self, oid: ObjectID, msg: Dict[str, Any]) -> None:
+        nested = msg.get("nested")
+        if nested:
+            self._rt.refs.add_nested(oid, [ObjectID(b) for b in nested])
+
     def _handle(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:
         """Serve a worker's control-plane request against the runtime
         (parity: the owner/GCS RPC surface a core worker talks to)."""
@@ -311,20 +328,42 @@ class WorkerPool:
             return entries
         if op == "put_val":
             oid = rt.alloc_put_oid()
+            # Pre-register the putting worker's borrow (the worker
+            # adopts): a put whose handle dies before the batched flush
+            # must still be freeable, not leaked untracked.
+            rt.refs.add_borrow(_wkey(chan), oid)
+            self._register_nested(oid, msg)
             rt.store.put_serialized(oid, msg["data"])
             return oid.binary()
         if op == "alloc_put_oid":
-            return rt.alloc_put_oid().binary()
+            oid = rt.alloc_put_oid()
+            rt.refs.add_borrow(_wkey(chan), oid)
+            return oid.binary()
         if op == "mark_shm":
-            rt.store.mark_shm_sealed(ObjectID(msg["oid"]), msg["size"])
+            oid = ObjectID(msg["oid"])
+            self._register_nested(oid, msg)
+            rt.store.mark_shm_sealed(oid, msg["size"])
             return None
         if op == "seal_value":
             kind, payload = msg["entry"]
             oid = ObjectID(msg["oid"])
+            self._register_nested(oid, msg)
             if kind == "shm":
                 rt.store.mark_shm_sealed(oid, payload)
             else:
                 rt.store.put_serialized(oid, payload)
+            return None
+        if op == "ref":
+            key = _wkey(chan)
+            for b in msg.get("add") or []:
+                rt.refs.add_borrow(key, ObjectID(b))
+            for b in msg.get("rem") or []:
+                rt.refs.remove_borrow(key, ObjectID(b))
+            return None
+        if op == "release_stream":
+            from ray_tpu.utils.ids import TaskID
+
+            rt.release_stream(TaskID(msg["task"]), msg["index"])
             return None
         if op == "seal_error":
             oid = ObjectID(msg["oid"])
@@ -351,6 +390,13 @@ class WorkerPool:
                                  trace_ctx=msg.get("trace_ctx"))
             if options.num_returns == "streaming":
                 return {"stream": out.task_id.binary()}
+            # Pre-register the caller's borrows: the worker constructs
+            # handles from these bins (and adopts them without
+            # re-reporting), so a fast-finishing task can't be freed
+            # between seal and the worker's batched add.
+            key = _wkey(chan)
+            for r in out:
+                rt.refs.add_borrow(key, r.id)
             return {"oids": [r.id.binary() for r in out]}
         if op == "create_actor":
             cls, args, kwargs = cloudpickle.loads(msg["spec"])
@@ -372,6 +418,9 @@ class WorkerPool:
             )
             if msg["num_returns"] == "streaming":
                 return {"stream": out.task_id.binary()}
+            key = _wkey(chan)
+            for r in out:
+                rt.refs.add_borrow(key, r.id)
             return {"oids": [r.id.binary() for r in out]}
         if op == "kill_actor":
             from ray_tpu.utils.ids import ActorID

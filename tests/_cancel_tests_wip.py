@@ -1,0 +1,294 @@
+"""Task cancellation + async actors.
+
+Parity targets: ray.cancel semantics (ray: python/ray/_raylet.pyx:1806
+cancellation wrapper around execute_task; core_worker.cc
+HandleCancelTask) — cancelling a PENDING task prevents it from running,
+cancelling a RUNNING task interrupts it cooperatively, force=True
+hard-kills the executor; get() of a cancelled ref raises
+TaskCancelledError.  Async actors (ray: core_worker/transport/fiber.h:55
+boost::fibers event loop) — N awaits interleave on one event loop.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api as _api
+from ray_tpu.core.exceptions import TaskCancelledError, WorkerDiedError
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield _api.runtime()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def proc_rt(monkeypatch):
+    monkeypatch.setenv("RAYTPU_WORKERS", "process")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield _api.runtime()
+    ray_tpu.shutdown()
+
+
+# -- pending tasks -----------------------------------------------------------
+
+
+def test_cancel_pending_task(rt):
+    # Fill all 4 CPUs with blockers so the victim never starts.
+    gate = threading.Event()
+
+    @ray_tpu.remote
+    def blocker():
+        gate.wait(10)
+        return "blocked"
+
+    @ray_tpu.remote
+    def victim():
+        return "ran"
+
+    blockers = [blocker.remote() for _ in range(4)]
+    v = victim.remote()
+    time.sleep(0.2)
+    ray_tpu.cancel(v)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(v, timeout=5)
+    gate.set()
+    assert ray_tpu.get(blockers) == ["blocked"] * 4
+
+
+def test_cancel_completed_task_is_noop(rt):
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref) == 7
+    ray_tpu.cancel(ref)  # no error; result stays
+    assert ray_tpu.get(ref) == 7
+
+
+def test_cancelled_task_never_retries(rt):
+    runs = []
+    gate = threading.Event()
+
+    @ray_tpu.remote(max_retries=3)
+    def flaky():
+        runs.append(1)
+        gate.wait(10)
+        raise RuntimeError("boom")
+
+    ref = flaky.remote()
+    time.sleep(0.2)
+    ray_tpu.cancel(ref)
+    gate.set()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=5)
+    time.sleep(0.3)
+    assert sum(runs) <= 1  # a cancelled task must not be retried
+
+
+# -- running tasks (thread mode: cooperative async-exception) ---------------
+
+
+def test_cancel_running_task_thread_mode(rt):
+    started = threading.Event()
+
+    @ray_tpu.remote
+    def spin():
+        started.set()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10:
+            sum(range(1000))  # bytecode loop — interruptible
+        return "finished"
+
+    ref = spin.remote()
+    assert started.wait(5)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=5)
+
+
+# -- running tasks (process mode) -------------------------------------------
+
+
+def test_cancel_running_task_process_mode(proc_rt):
+    @ray_tpu.remote
+    def spin():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30:
+            sum(range(1000))
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # let it reach the worker
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_force_cancel_process_mode(proc_rt):
+    @ray_tpu.remote
+    def stuck():
+        time.sleep(60)  # blocking C call — only force can stop it
+        return "finished"
+
+    ref = stuck.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises((TaskCancelledError, WorkerDiedError)):
+        ray_tpu.get(ref, timeout=10)
+
+
+# -- actor task cancellation -------------------------------------------------
+
+
+def test_cancel_queued_actor_task(rt):
+    @ray_tpu.remote
+    class Slow:
+        def work(self, sec):
+            time.sleep(sec)
+            return sec
+
+    a = Slow.remote()
+    first = a.work.remote(1.0)
+    queued = a.work.remote(0.1)
+    time.sleep(0.1)
+    ray_tpu.cancel(queued)  # still waiting behind `first` in the queue
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=5)
+    assert ray_tpu.get(first) == 1.0  # the running call is untouched
+
+
+# -- async actors ------------------------------------------------------------
+
+
+def test_async_actor_interleaves_awaits(rt):
+    @ray_tpu.remote
+    class AsyncActor:
+        def __init__(self):
+            self.inflight = 0
+            self.max_inflight = 0
+
+        async def slow_echo(self, v):
+            self.inflight += 1
+            self.max_inflight = max(self.max_inflight, self.inflight)
+            await asyncio.sleep(0.3)
+            self.inflight -= 1
+            return v
+
+        async def peak(self):
+            return self.max_inflight
+
+    a = AsyncActor.remote()
+    t0 = time.monotonic()
+    refs = [a.slow_echo.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs) == list(range(20))
+    elapsed = time.monotonic() - t0
+    # 20 × 0.3 s awaits interleaved on one loop — serial would be 6 s.
+    assert elapsed < 4.0, f"awaits serialized: {elapsed:.1f}s"
+    assert ray_tpu.get(a.peak.remote()) > 1
+
+
+def test_async_actor_100_concurrent(rt):
+    # The VERDICT acceptance bar: one replica holds 100 concurrent
+    # in-flight async requests.
+    @ray_tpu.remote
+    class Replica:
+        def __init__(self):
+            self.live = 0
+            self.peak = 0
+
+        async def handle(self):
+            self.live += 1
+            self.peak = max(self.peak, self.live)
+            await asyncio.sleep(0.5)
+            self.live -= 1
+            return True
+
+        async def peak_live(self):
+            return self.peak
+
+    r = Replica.remote()
+    refs = [r.handle.remote() for _ in range(100)]
+    assert all(ray_tpu.get(refs, timeout=30))
+    assert ray_tpu.get(r.peak_live.remote()) >= 100
+
+
+def test_async_actor_state_single_threaded(rt):
+    # All coroutines run on ONE loop thread: unguarded state mutation
+    # between awaits is safe (the asyncio actor contract).
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+            self.threads = set()
+
+        async def bump(self):
+            self.threads.add(threading.get_ident())
+            before = self.n
+            await asyncio.sleep(0.01)
+            self.n = before + 1  # lost-update unless awaits interleave safely
+            return self.n
+
+        async def threads_seen(self):
+            return len(self.threads)
+
+    c = Counter.remote()
+    ray_tpu.get([c.bump.remote() for _ in range(10)])
+    assert ray_tpu.get(c.threads_seen.remote()) == 1
+
+
+def test_cancel_async_actor_task(rt):
+    @ray_tpu.remote
+    class A:
+        async def forever(self):
+            await asyncio.sleep(60)
+            return "done"
+
+        async def ping(self):
+            return "pong"
+
+    a = A.remote()
+    ref = a.forever.remote()
+    time.sleep(0.3)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=5)
+    assert ray_tpu.get(a.ping.remote()) == "pong"  # actor alive
+
+
+def test_async_actor_sync_method_mix(rt):
+    @ray_tpu.remote
+    class Mixed:
+        def sync_add(self, a, b):
+            return a + b
+
+        async def async_add(self, a, b):
+            await asyncio.sleep(0.01)
+            return a + b
+
+    m = Mixed.remote()
+    assert ray_tpu.get(m.sync_add.remote(1, 2)) == 3
+    assert ray_tpu.get(m.async_add.remote(3, 4)) == 7
+
+
+def test_await_object_ref_inside_async_actor(rt):
+    @ray_tpu.remote
+    def producer():
+        return 21
+
+    @ray_tpu.remote
+    class Awaiter:
+        async def consume(self, boxed):
+            v = await boxed[0]
+            return v * 2
+
+    a = Awaiter.remote()
+    assert ray_tpu.get(a.consume.remote([producer.remote()]), timeout=10) == 42

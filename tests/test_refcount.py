@@ -1,0 +1,309 @@
+"""Ownership / reference-counting GC.
+
+Parity targets: the owner-side ReferenceCounter protocol (ray:
+src/ray/core_worker/reference_count.h:61) — local refs from language
+handles, pins for in-flight task returns, borrower registration from
+worker processes, nested (contained) refs, and lineage bounded by the
+ref count.  Semantics checked against the reference's documented
+behavior: values free when the last reference drops; a borrower
+provably keeps a value alive; get-after-free raises instead of hanging.
+"""
+
+import gc
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api as _api
+from ray_tpu.core.exceptions import ObjectFreedError
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield _api.runtime()
+    ray_tpu.shutdown()
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        gc.collect()
+        time.sleep(0.02)
+    return False
+
+
+def test_put_and_drop_frees_store(rt):
+    ref = ray_tpu.put(list(range(100)))
+    oid = ref.id
+    assert rt.store.contains(oid)
+    del ref
+    assert _wait_for(lambda: not rt.store.contains(oid))
+
+
+def test_bounded_memory_many_puts(rt):
+    # The VERDICT acceptance bar: a loop creating + dropping objects
+    # runs in bounded memory (round 1 leaked every object to shutdown).
+    for i in range(5000):
+        ray_tpu.put(i)  # dropped immediately
+    assert _wait_for(lambda: rt.store.stats()["num_objects"] < 500)
+
+
+def test_get_after_free_raises(rt):
+    ref = ray_tpu.put("payload")
+    oid = ref.id
+    del ref
+    assert _wait_for(lambda: not rt.store.contains(oid))
+    with pytest.raises(ObjectFreedError):
+        rt.store.get(oid, timeout=1.0)
+
+
+def test_live_handle_keeps_value(rt):
+    ref = ray_tpu.put("alive")
+    gc.collect()
+    time.sleep(0.1)
+    assert ray_tpu.get(ref) == "alive"
+
+
+def test_task_result_freed_after_drop(rt):
+    @ray_tpu.remote
+    def f():
+        return 41
+
+    ref = f.remote()
+    assert ray_tpu.get(ref) == 41
+    oid = ref.id
+    assert oid in rt._lineage
+    del ref
+    assert _wait_for(lambda: not rt.store.contains(oid))
+    # Lineage entry dropped with the last handle (lineage bounded by
+    # the ref count, reference_count.h lineage pinning).
+    assert oid not in rt._lineage
+
+
+def test_drop_future_before_completion(rt):
+    # Dropping the future must not free the return slot under the
+    # running task (the seal pin holds it), and the object frees right
+    # after seal.
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.4)
+        return "done"
+
+    ref = slow.remote()
+    oid = ref.id
+    del ref
+    gc.collect()
+    time.sleep(0.1)  # task still running; pin holds bookkeeping
+    assert _wait_for(lambda: not rt.store.contains(oid), timeout=8.0)
+
+
+def test_task_args_pinned_by_lineage(rt):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    a = ray_tpu.put(21)
+    a_oid = a.id
+    r = double.remote(a)
+    del a  # the task spec (pending, then lineage) still holds the arg
+    assert ray_tpu.get(r) == 42
+    # While r is in scope its lineage pins the arg object.
+    gc.collect()
+    time.sleep(0.1)
+    assert rt.store.contains(a_oid)
+    r_oid = r.id
+    del r
+    # Dropping the result releases its lineage → the arg handle → both free.
+    assert _wait_for(lambda: not rt.store.contains(r_oid))
+    assert _wait_for(lambda: not rt.store.contains(a_oid))
+
+
+def test_nested_refs_keep_inner_alive(rt):
+    inner = ray_tpu.put("inner-value")
+    inner_oid = inner.id
+    outer = ray_tpu.put({"k": [inner]})
+    del inner
+    gc.collect()
+    time.sleep(0.1)
+    # The outer sealed bytes contain the ref → inner stays alive.
+    assert rt.store.contains(inner_oid)
+    got = ray_tpu.get(outer)
+    assert ray_tpu.get(got["k"][0]) == "inner-value"
+    outer_oid = outer.id
+    del got, outer
+    assert _wait_for(lambda: not rt.store.contains(outer_oid))
+    assert _wait_for(lambda: not rt.store.contains(inner_oid))
+
+
+def test_wait_on_freed_object_is_ready(rt):
+    ref = ray_tpu.put(1)
+    oid = ref.id
+    del ref
+    assert _wait_for(lambda: not rt.store.contains(oid))
+    ready, pending = rt.store.wait([oid], 1, timeout=1.0)
+    assert ready == [oid]
+
+
+def test_pg_ready_survives_repeated_ready_calls(rt):
+    from ray_tpu.core.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1}])
+    ray_tpu.get(pg.ready())
+    gc.collect()
+    time.sleep(0.05)
+    ray_tpu.get(pg.ready())  # second ready() must not see a freed oid
+    remove_placement_group(pg)
+
+
+def test_actor_state_ref_thread_mode(rt):
+    # In thread mode the actor's stashed handle is a local ref — the
+    # value must survive the driver dropping its own handle.
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def stash(self, ref):
+            self.ref = ref
+            return True
+
+        def read(self):
+            return ray_tpu.get(self.ref)
+
+    h = Holder.remote()
+    v = ray_tpu.put("stashed")
+    oid = v.id
+    assert ray_tpu.get(h.stash.remote([v]))  # nested in a list arg
+    del v
+    gc.collect()
+    time.sleep(0.2)
+    assert ray_tpu.get(h.read.remote()) == ["stashed"]
+    assert rt.store.contains(oid)
+
+
+def test_stream_items_released_on_generator_drop(rt):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(10):
+            yield i
+
+    g = gen.remote()
+    first = next(g)
+    assert ray_tpu.get(first) == 0
+    time.sleep(0.5)  # let the producer finish sealing all items
+    tid = g.task_id
+    del g
+    gc.collect()
+    from ray_tpu.utils.ids import ObjectID
+
+    def all_released():
+        return not any(
+            rt.store.contains(ObjectID.for_task_return(tid, i))
+            for i in range(1, 11)
+        )
+
+    assert _wait_for(all_released)
+
+
+def test_refcounter_stats_exposed(rt):
+    ref = ray_tpu.put(7)
+    stats = rt.refs.stats()
+    assert stats["local_refs"] >= 1
+    del ref
+
+
+# -- borrower protocol across a real process boundary -----------------------
+
+
+@pytest.fixture
+def proc_rt(monkeypatch):
+    monkeypatch.setenv("RAYTPU_WORKERS", "process")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield _api.runtime()
+    ray_tpu.shutdown()
+
+
+def test_borrower_keeps_value_alive(proc_rt):
+    # The VERDICT acceptance bar: a borrower (ref passed into an actor
+    # in ANOTHER PROCESS, stashed in its state) provably keeps the
+    # value alive after the owner drops its handle.
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def stash(self, boxed):
+            self.ref = boxed[0]
+            return True
+
+        def read(self):
+            return ray_tpu.get(self.ref)
+
+    h = Holder.remote()
+    v = ray_tpu.put("borrowed-value")
+    oid = v.id
+    assert ray_tpu.get(h.stash.remote([v]))
+    del v
+    gc.collect()
+    time.sleep(0.3)  # GC sweep window: a bug would free it here
+    assert proc_rt.store.contains(oid)
+    assert ray_tpu.get(h.read.remote()) == "borrowed-value"
+
+
+def test_borrows_drop_when_worker_dies(proc_rt):
+    @ray_tpu.remote
+    class Holder:
+        def stash(self, boxed):
+            self.ref = boxed[0]
+            return True
+
+    h = Holder.remote()
+    v = ray_tpu.put("doomed")
+    oid = v.id
+    assert ray_tpu.get(h.stash.remote([v]))
+    del v
+    gc.collect()
+    time.sleep(0.2)
+    assert proc_rt.store.contains(oid)
+    ray_tpu.kill(h)
+    # The dead borrower's references evaporate → value frees.
+    assert _wait_for(lambda: not proc_rt.store.contains(oid), timeout=8.0)
+
+
+def test_worker_results_freed_after_drop(proc_rt):
+    @ray_tpu.remote
+    def make():
+        return list(range(50))
+
+    refs = [make.remote() for _ in range(8)]
+    assert all(len(v) == 50 for v in ray_tpu.get(refs))
+    oids = [r.id for r in refs]
+    del refs
+    assert _wait_for(
+        lambda: not any(proc_rt.store.contains(o) for o in oids), timeout=8.0
+    )
+
+
+def test_nested_submission_result_survives(proc_rt):
+    # A worker submits a nested task and returns the REF; the driver
+    # must be able to get it (the worker's borrow + nested pin bridge
+    # the gap until the driver holds its own handle).
+    @ray_tpu.remote
+    def inner():
+        return "deep"
+
+    @ray_tpu.remote
+    def outer():
+        return inner.remote()
+
+    ref = ray_tpu.get(outer.remote())
+    assert ray_tpu.get(ref) == "deep"
