@@ -145,8 +145,14 @@ def get_actor(name: str) -> ActorHandle:
 
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    # Local runtime: cooperative cancellation not yet wired; parity stub.
-    raise NotImplementedError("cancel is not yet supported")
+    """Cancel the task producing ``ref`` (parity: ray.cancel).  Pending
+    tasks never run; running tasks are interrupted cooperatively, or
+    hard-killed with force=True in process mode.  get() of a cancelled
+    ref raises TaskCancelledError; cancelled tasks never retry."""
+    if not isinstance(ref, ObjectRef):
+        raise TypeError(f"cancel expects an ObjectRef, got "
+                        f"{type(ref).__name__}")
+    runtime().cancel(ref.id, force=force)
 
 
 def nodes() -> List[Dict[str, Any]]:

@@ -64,6 +64,21 @@ class ObjectLostError(RayTpuError):
                          f"reconstructed")
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel (parity:
+    ray.exceptions.TaskCancelledError) — raised at every get of the
+    cancelled ref.  Cancelled tasks never retry."""
+
+    def __init__(self, task_id_hex: str = ""):
+        self.task_id_hex = task_id_hex
+        super().__init__(
+            f"task {task_id_hex or '<unknown>'} was cancelled"
+        )
+
+    def __reduce__(self):
+        return (type(self), (self.task_id_hex,))
+
+
 class ObjectFreedError(RayTpuError):
     """Fetch of an object the owner already freed — every reference went
     out of scope, so the value was garbage-collected (parity:

@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ray_tpu.core import events as _ev
 from ray_tpu.core.exceptions import (
     ActorDiedError,
+    TaskCancelledError,
     TaskError,
 )
 from ray_tpu.core.object_ref import ObjectRef
@@ -248,6 +249,20 @@ class _PendingTask:
     streaming: bool = False
     on_done: Optional[Callable[[], None]] = None
     trace_ctx: Optional[Dict[str, str]] = None
+    # Set by ray_tpu.cancel: never (re)dispatch, never retry (parity:
+    # TaskSpec cancellation flag checked in _raylet.pyx:1806).
+    cancelled: bool = False
+
+
+# Returned by _execute_item when completion happens later on the actor's
+# event loop (async method): the serve loop must not record FINISHED.
+_ASYNC_DEFERRED = object()
+
+
+from ray_tpu.utils.interrupt import (
+    async_raise as _async_raise,
+    clear_async_exc as _clear_async_exc,
+)
 
 
 class _ActorShell:
@@ -280,6 +295,20 @@ class _ActorShell:
         # Restart counter for per-attempt task events (parity: each
         # restart is a distinct attempt of the creation task).
         self.creation_attempt = -1
+        # Cancellation bookkeeping (parity: actor task cancel via the
+        # scheduling queue / asyncio task cancel).
+        from ray_tpu.core.refcount import TombstoneSet
+
+        self._cancel_lock = threading.Lock()
+        self._cancelled = TombstoneSet(1024)  # cancelled-before-run ids
+        self._running_sync: Dict[TaskID, Any] = {}  # id → thread ident
+        self._inflight_async: Dict[TaskID, Any] = {}  # id → (fut, oids)
+        # Async actors: one event loop thread per actor; N method calls
+        # interleave as coroutines on it (parity: boost::fibers async
+        # actors, core_worker/transport/fiber.h:55).
+        self._loop = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._async_sem = None
 
     @property
     def node_id(self) -> Optional[NodeID]:
@@ -374,6 +403,18 @@ class _ActorShell:
             task_hex = task_id.hex() if task_id is not None else None
             ev = self.runtime.events
             qname = f"{self.cls.__name__}.{method_name}"
+            if task_id is not None:
+                with self._cancel_lock:
+                    was_cancelled = task_id in self._cancelled
+                if was_cancelled:
+                    # Cancelled while queued: never runs (parity: the
+                    # scheduling queue drops cancelled actor tasks).
+                    self.runtime._seal_cancelled(
+                        task_id, return_ids, num_returns == "streaming")
+                    if task_hex:
+                        ev.record(task_hex, _ev.FAILED,
+                                  error_message="cancelled")
+                    continue
             if task_hex:
                 ev.record(task_hex, _ev.RUNNING, name=qname,
                           type=_ev.ACTOR_TASK, actor_id=self.actor_id.hex(),
@@ -381,15 +422,16 @@ class _ActorShell:
                                    else None),
                           worker=self._worker_label())
             try:
-                self._execute_item(qname, method_name, args, kwargs,
-                                   return_ids, num_returns, task_id,
-                                   trace_ctx, task_hex)
-                if task_hex:
+                outcome = self._execute_item(qname, method_name, args, kwargs,
+                                             return_ids, num_returns, task_id,
+                                             trace_ctx, task_hex)
+                if task_hex and outcome is not _ASYNC_DEFERRED:
                     ev.record(task_hex, _ev.FINISHED)
             except BaseException as e:
                 if task_hex:
                     ev.record(task_hex, _ev.FAILED, error_message=repr(e))
-                err = self._item_error(qname, e)
+                err = (e if isinstance(e, TaskCancelledError)
+                       else self._item_error(qname, e))
                 for oid in return_ids:
                     self.runtime.store.put_error(oid, err)
                 if num_returns == "streaming" and task_id is not None:
@@ -411,22 +453,132 @@ class _ActorShell:
             args, kwargs
         )
         method = getattr(self.instance, method_name)
+        if _inspect.iscoroutinefunction(method) and num_returns != "streaming":
+            # Async actor path: schedule on the actor's event loop and
+            # return immediately — the serve loop moves to the next
+            # item, so N awaits interleave (parity: fiber.h async
+            # actors).  Completion seals results from the callback.
+            return self._execute_async(qname, method, resolved_args,
+                                       resolved_kwargs, return_ids,
+                                       num_returns, task_id, task_hex)
         ctx = getattr(self, "_env_ctx", None)
-        # Env covers the whole body, including a streaming method's
-        # lazy generator execution.
-        with (ctx.applied() if ctx is not None
-              else contextlib.nullcontext()), \
-                _tracing().task_span(qname, trace_ctx,
-                                     {"task_id": task_hex or ""}):
-            result = method(*resolved_args, **resolved_kwargs)
-            if _inspect.iscoroutine(result):
-                import asyncio
+        if task_id is not None:
+            with self._cancel_lock:
+                self._running_sync[task_id] = threading.get_ident()
+        try:
+            # Env covers the whole body, including a streaming method's
+            # lazy generator execution.
+            with (ctx.applied() if ctx is not None
+                  else contextlib.nullcontext()), \
+                    _tracing().task_span(qname, trace_ctx,
+                                         {"task_id": task_hex or ""}):
+                result = method(*resolved_args, **resolved_kwargs)
+                if _inspect.iscoroutine(result):
+                    import asyncio
 
-                result = asyncio.run(result)
-            if num_returns == "streaming":
-                self.runtime._stream_results(result, task_id, qname)
+                    result = asyncio.run(result)
+                if num_returns == "streaming":
+                    self.runtime._stream_results(result, task_id, qname)
+        finally:
+            if task_id is not None:
+                with self._cancel_lock:
+                    self._running_sync.pop(task_id, None)
+                    # Withdraw a cancel that arrived too late, so it
+                    # cannot hit the next item on this thread.
+                    _clear_async_exc(threading.get_ident())
         if num_returns != "streaming":
             self.runtime._store_results(result, return_ids, num_returns)
+
+    def _ensure_loop(self):
+        if self._loop is not None:
+            return
+        import asyncio
+
+        self._loop = asyncio.new_event_loop()
+        # Async actors default to high concurrency when the user left
+        # max_concurrency at 1 (parity: ray's async actors default to
+        # 1000 concurrent coroutines).
+        limit = int(self.options.max_concurrency)
+        if limit <= 1:
+            limit = 1000
+        self._async_sem = asyncio.Semaphore(limit)
+        self._loop_thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True,
+            name=f"actor-{self.actor_id.hex()[:8]}-loop",
+        )
+        self._loop_thread.start()
+
+    def _execute_async(self, qname, method, args, kwargs, return_ids,
+                       num_returns, task_id, task_hex):
+        import asyncio
+        import concurrent.futures as _cf
+
+        self._ensure_loop()
+        sem = self._async_sem
+
+        async def body():
+            async with sem:
+                return await method(*args, **kwargs)
+
+        fut = asyncio.run_coroutine_threadsafe(body(), self._loop)
+        if task_id is not None:
+            with self._cancel_lock:
+                self._inflight_async[task_id] = (fut, return_ids)
+        ev = self.runtime.events
+
+        def done(f):
+            if task_id is not None:
+                with self._cancel_lock:
+                    self._inflight_async.pop(task_id, None)
+            try:
+                result = f.result()
+            except BaseException as e:
+                if isinstance(e, (asyncio.CancelledError, _cf.CancelledError)):
+                    err: BaseException = TaskCancelledError(task_hex or "")
+                elif isinstance(e, TaskCancelledError):
+                    err = e
+                else:
+                    err = self._item_error(qname, e)
+                for oid in return_ids:
+                    self.runtime.store.put_error_if_pending(oid, err)
+                if task_hex:
+                    ev.record(task_hex, _ev.FAILED, error_message=repr(err))
+                return
+            try:
+                self.runtime._store_results(result, return_ids, num_returns)
+                if task_hex:
+                    ev.record(task_hex, _ev.FINISHED)
+            except BaseException as e:
+                err = self._item_error(qname, e)
+                for oid in return_ids:
+                    self.runtime.store.put_error_if_pending(oid, err)
+                if task_hex:
+                    ev.record(task_hex, _ev.FAILED, error_message=repr(err))
+
+        fut.add_done_callback(done)
+        return _ASYNC_DEFERRED
+
+    def cancel_task(self, task_id: TaskID, force: bool = False) -> None:
+        """Cancel one submitted actor task: drop it if queued, cancel
+        the coroutine if in-flight async, async-raise into the thread
+        if running sync (parity: CancelActorTask semantics — force has
+        no stronger meaning for actor tasks)."""
+        with self._cancel_lock:
+            entry = self._inflight_async.get(task_id)
+            tid = self._running_sync.get(task_id)
+            if entry is None and tid is None:
+                self._cancelled.add(task_id)
+                return
+            if entry is None:
+                # Deliver UNDER the lock: _execute_item's finally
+                # unregisters + withdraws pending exceptions under the
+                # same lock, so this can never poison a later item on
+                # the thread.
+                _async_raise(tid, TaskCancelledError)
+                return
+        # Future.cancel outside the lock: a not-yet-started coroutine
+        # cancels synchronously, invoking done() which takes the lock.
+        entry[0].cancel()
 
     def _item_error(self, qname: str, e: BaseException) -> BaseException:
         return TaskError(qname, e)
@@ -442,6 +594,17 @@ class _ActorShell:
         return False
 
     def _drain(self, err: BaseException):
+        # In-flight async calls: seal the death error (so consumers
+        # can't hang on a stopped loop) and cancel the coroutines.
+        with self._cancel_lock:
+            inflight = list(self._inflight_async.values())
+            self._inflight_async.clear()
+        for fut, oids in inflight:
+            for oid in oids:
+                self.runtime.store.put_error_if_pending(oid, err)
+            fut.cancel()
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(lambda: None)  # wake the loop
         while True:
             try:
                 item = self.queue.get_nowait()
@@ -553,16 +716,24 @@ class _ProcessActorShell(_ActorShell):
         import cloudpickle as _cp
 
         wire_args, wire_kwargs = self.runtime._wire_args(args, kwargs)
-        with _tracing().task_span(qname, trace_ctx,
-                                  {"task_id": task_hex or ""}):
-            rep = self._worker.call(
-                "actor_task", method=method_name,
-                spec=_cp.dumps((wire_args, wire_kwargs)),
-                num_returns=num_returns,
-                returns=[oid.binary() for oid in return_ids],
-                task=(task_id.binary() if task_id is not None else b""),
-                trace_ctx=_tracing().capture_context(),
-            )
+        if task_id is not None:
+            with self._cancel_lock:
+                self._running_sync[task_id] = True  # in-flight marker
+        try:
+            with _tracing().task_span(qname, trace_ctx,
+                                      {"task_id": task_hex or ""}):
+                rep = self._worker.call(
+                    "actor_task", method=method_name,
+                    spec=_cp.dumps((wire_args, wire_kwargs)),
+                    num_returns=num_returns,
+                    returns=[oid.binary() for oid in return_ids],
+                    task=(task_id.binary() if task_id is not None else b""),
+                    trace_ctx=_tracing().capture_context(),
+                )
+        finally:
+            if task_id is not None:
+                with self._cancel_lock:
+                    self._running_sync.pop(task_id, None)
         wkey = self.runtime._worker_ref_key(self._worker)
         if num_returns != "streaming":
             self.runtime.seal_remote_results(return_ids, rep, wkey)
@@ -593,6 +764,19 @@ class _ProcessActorShell(_ActorShell):
             wh.terminate(graceful=not wh.dead)
             self._worker = None
         super()._drain(err)
+
+    def cancel_task(self, task_id: TaskID, force: bool = False) -> None:
+        with self._cancel_lock:
+            running = task_id in self._running_sync
+            if not running:
+                self._cancelled.add(task_id)
+                return
+        wh = getattr(self, "_worker", None)
+        if wh is not None:
+            try:
+                wh.call("cancel", task=task_id.binary())
+            except Exception:
+                pass  # worker gone — death semantics already apply
 
     def kill(self, no_restart: bool = True):
         super().kill(no_restart)
@@ -677,6 +861,10 @@ class LocalRuntime:
         # reference counts reconstruction against the retry budget).
         self._reconstructing: set = set()
         self._recon_attempts: Dict[int, int] = {}
+        # Running normal tasks, for cancellation: task_id → {"pt", and
+        # "thread" (thread mode) or "worker" (process mode)} (parity:
+        # the executing-tasks map HandleCancelTask consults).
+        self._running_tasks: Dict[TaskID, Dict[str, Any]] = {}
         # Serializes all bundle (re-)reservation: concurrent node events
         # must not double-place the same pending bundle.
         self._pg_reserve_lock = threading.Lock()
@@ -1309,6 +1497,19 @@ class LocalRuntime:
 
         def run():
             requeued = False
+            if pt.cancelled:
+                # Cancelled between scheduling and start: never run.
+                self._seal_cancelled(pt.task_id, pt.return_ids,
+                                     pt.streaming)
+                if pt.on_done is not None:
+                    pt.on_done()
+                alloc.release()
+                self._notify()
+                return
+            with self._lock:
+                self._running_tasks[pt.task_id] = {
+                    "pt": pt, "thread": threading.get_ident(),
+                }
             self.events.record(
                 pt.task_id.hex(), _ev.RUNNING, name=pt.function_name,
                 attempt=attempt, job_id=self.job_id.hex(),
@@ -1357,7 +1558,15 @@ class LocalRuntime:
             except Exception as e:
                 self.events.record(pt.task_id.hex(), _ev.FAILED,
                                    attempt=attempt, error_message=repr(e))
-                if pt.streaming:
+                cancelled = pt.cancelled or isinstance(e, TaskCancelledError)
+                if cancelled:
+                    # Cancelled tasks seal TaskCancelledError and NEVER
+                    # retry (parity: cancellation beats max_retries).
+                    self._seal_cancelled(
+                        pt.task_id, pt.return_ids, pt.streaming,
+                        err=e if isinstance(e, TaskCancelledError) else None,
+                    )
+                elif pt.streaming:
                     # Failures before/inside the stream must unblock the
                     # consumer at the first unsealed index (a worker
                     # process may have died after producing a prefix;
@@ -1368,19 +1577,25 @@ class LocalRuntime:
                         e if isinstance(e, TaskError)
                         else TaskError(pt.function_name, e),
                     )
-                if pt.retries_left > 0:
+                if not cancelled and pt.retries_left > 0:
                     pt.retries_left -= 1
                     requeued = True
                     with self._dispatch_cv:
                         self._pending.append(pt)
                         self._dispatch_cv.notify_all()
-                else:
+                elif not cancelled and not pt.streaming:
                     err = e if isinstance(e, TaskError) else TaskError(
                         pt.function_name, e
                     )
                     for oid in pt.return_ids:
                         self.store.put_error(oid, err)
             finally:
+                with self._lock:
+                    self._running_tasks.pop(pt.task_id, None)
+                    # Withdraw a too-late cancel UNDER the lock (cancel
+                    # delivers under it too), so it can't hit an
+                    # unrelated future task on this thread.
+                    _clear_async_exc(threading.get_ident())
                 # on_done (the reconstruction in-flight guard) must NOT
                 # fire when the task was re-queued for retry — the work
                 # is still in flight.
@@ -1404,6 +1619,10 @@ class LocalRuntime:
         wire_args, wire_kwargs = self._wire_args(pt.args, pt.kwargs)
         spec = cloudpickle.dumps((pt.fn, wire_args, wire_kwargs))
         wh = self.worker_pool.lease()
+        with self._lock:
+            entry = self._running_tasks.get(pt.task_id)
+            if entry is not None:
+                entry["worker"] = wh  # cancellation targets the process
         try:
             rep = wh.call(
                 "task", spec=spec, name=pt.function_name,
@@ -1465,6 +1684,79 @@ class LocalRuntime:
     def _notify(self):
         with self._dispatch_cv:
             self._dispatch_cv.notify_all()
+
+    # -- cancellation ------------------------------------------------------
+
+    def _seal_cancelled(self, task_id: TaskID,
+                        return_ids: Sequence[ObjectID], streaming: bool,
+                        err: Optional[BaseException] = None
+                        ) -> BaseException:
+        """Seal TaskCancelledError on a task's outputs — the single
+        sealing path for every cancellation site (queued, pre-start,
+        failed-running, queued-actor)."""
+        err = err or TaskCancelledError(task_id.hex())
+        for roid in return_ids:
+            self.store.put_error_if_pending(roid, err)
+        if streaming:
+            self._seal_stream_failure(task_id, err)
+        return err
+
+    def cancel(self, oid: ObjectID, force: bool = False) -> None:
+        """Cancel the task that produces ``oid`` (parity: ray.cancel —
+        core_worker.cc HandleCancelTask + _raylet.pyx:1806).  Pending
+        tasks are dropped; running tasks get a cooperative async
+        exception (thread mode) or a cancel RPC / process kill
+        (process mode, force=True).  A finished task is a no-op."""
+        task_id = oid.task_id()
+        # 1. Queued (not yet dispatched) normal task.
+        target = None
+        with self._dispatch_cv:
+            for pt in self._pending:
+                if pt.task_id == task_id:
+                    target = pt
+                    pt.cancelled = True
+                    self._pending.remove(pt)
+                    break
+        if target is not None:
+            self._seal_cancelled(task_id, target.return_ids,
+                                 target.streaming)
+            if target.on_done is not None:
+                target.on_done()
+            self.events.record(task_id.hex(), _ev.FAILED,
+                               error_message="cancelled")
+            return
+        # 2. Running normal task.
+        wh = None
+        with self._lock:
+            info = self._running_tasks.get(task_id)
+            if info is not None:
+                info["pt"].cancelled = True
+                wh = info.get("worker")
+                if wh is None:
+                    # Deliver UNDER the lock — run()'s finally withdraws
+                    # pending exceptions under the same lock, so a
+                    # too-late cancel can't poison the thread's next task.
+                    _async_raise(info["thread"], TaskCancelledError)
+        if info is not None:
+            if wh is not None:
+                if force:
+                    # Hard kill: the lease-holder sees WorkerDiedError,
+                    # which the cancelled flag converts to
+                    # TaskCancelledError with no retry.
+                    wh.terminate(graceful=False)
+                else:
+                    try:
+                        wh.call("cancel", task=task_id.binary())
+                    except Exception:
+                        pass  # worker died — death semantics apply
+            return
+        # 3. Actor task (the task id embeds its actor).
+        with self._lock:
+            shell = self._actors.get(task_id.actor_id())
+        if shell is not None:
+            shell.cancel_task(task_id, force)
+        # 4. Already finished or unknown: no-op (parity: cancelling a
+        # completed task has no effect).
 
     # -- actors ------------------------------------------------------------
 
@@ -1690,6 +1982,14 @@ class LocalRuntime:
         # stays readable through any still-held handles; the pin removal
         # lets it free once those drop).
         self.refs.remove_seal_pin(shell._creation_oid)
+        # Stop a dead async actor's event loop thread (queued callbacks
+        # — including cancellation dones — run before the stop lands).
+        loop = getattr(shell, "_loop", None)
+        if loop is not None:
+            try:
+                loop.call_soon_threadsafe(loop.stop)
+            except RuntimeError:
+                pass  # already stopped/closed
         with self._lock:
             self._dead_actors.append(self._actor_row(shell, "DEAD"))
             self._actors.pop(shell.actor_id, None)

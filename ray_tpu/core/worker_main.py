@@ -339,6 +339,9 @@ class WorkerRuntime:
             self.refs.adopt(b)
         return [ObjectRef(ObjectID(b)) for b in rep["oids"]]
 
+    def cancel(self, oid: ObjectID, force: bool = False) -> None:
+        self._chan.call("cancel_task", oid=oid.binary(), force=force)
+
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self._chan.call("kill_actor", actor_id=actor_id.binary(),
                         no_restart=no_restart)
@@ -457,6 +460,15 @@ class _WorkerServer:
         # idle, so a sweep-sent del can't overtake a reply-attached add.
         self._busy = 0
         self._busy_lock = threading.Lock()
+        # Cancellation registry: task_bin → ("thread", ident) while a
+        # sync body runs, ("async", fut) while a coroutine is in flight
+        # (parity: the executing-tasks map HandleCancelTask consults).
+        self._running: Dict[bytes, Any] = {}
+        self._running_lock = threading.Lock()
+        # Shared event loop for async actor methods: concurrent calls
+        # interleave their awaits on it instead of each getting a
+        # private asyncio.run (parity: fiber.h async actors).
+        self._loop = None
 
     # -- value encoding ----------------------------------------------------
 
@@ -525,12 +537,50 @@ class _WorkerServer:
             return self._run_op(lambda: self._actor_create(msg))
         if op == "actor_task":
             return self._run_op(lambda: self._actor_task(msg))
+        if op == "cancel":
+            return self._cancel(msg["task"])
         if op == "ping":
             return "pong"
         if op == "exit":
             self._exit.set()
             return None
         raise ValueError(f"unknown driver op {op!r}")
+
+    def _cancel(self, task_bin: bytes) -> None:
+        from ray_tpu.core.exceptions import TaskCancelledError
+        from ray_tpu.utils.interrupt import async_raise
+
+        with self._running_lock:
+            entry = self._running.get(task_bin)
+            if entry is None:
+                return None  # already finished — no-op
+            kind, target = entry
+            if kind == "thread":
+                # Under the lock: the executor thread unregisters (and
+                # withdraws pending exceptions) under the same lock, so
+                # this cannot hit a later task.
+                async_raise(target, TaskCancelledError)
+                return None
+        target.cancel()  # asyncio future — thread-safe
+        return None
+
+    @contextlib.contextmanager
+    def _cancellable(self, task_bin: bytes):
+        """Register the calling thread as the executor of task_bin for
+        the duration of the body."""
+        from ray_tpu.utils.interrupt import clear_async_exc
+
+        ident = threading.get_ident()
+        if task_bin:
+            with self._running_lock:
+                self._running[task_bin] = ("thread", ident)
+        try:
+            yield
+        finally:
+            if task_bin:
+                with self._running_lock:
+                    self._running.pop(task_bin, None)
+                    clear_async_exc(ident)
 
     def _run_op(self, body) -> Dict[str, Any]:
         """Run one pushed work item.  On success the pending borrow
@@ -567,12 +617,48 @@ class _WorkerServer:
         fn, args, kwargs = cloudpickle.loads(msg["spec"])
         args, kwargs = self._decode_args(args, kwargs)
         with self._env_context(msg.get("env"), msg.get("env_plugins")), \
-                self._trace(msg.get("trace_ctx")):
+                self._trace(msg.get("trace_ctx")), \
+                self._cancellable(msg.get("task") or b""):
             result = fn(*args, **kwargs)
             if msg.get("streaming"):
                 self._stream(result, TaskID(msg["task"]), msg["name"])
                 return {"streamed": True}
         return self._encode_reply(result, msg)
+
+    def _ensure_loop(self):
+        with self._running_lock:
+            if self._loop is None:
+                import asyncio
+
+                self._loop = asyncio.new_event_loop()
+                threading.Thread(
+                    target=self._loop.run_forever, daemon=True,
+                    name="async-actor-loop",
+                ).start()
+            return self._loop
+
+    def _run_coroutine(self, coro, task_bin: bytes):
+        """Run an async actor method on the shared loop so concurrent
+        calls interleave their awaits; cancellable via the registry."""
+        import asyncio
+        import concurrent.futures as _cf
+
+        from ray_tpu.core.exceptions import TaskCancelledError
+
+        loop = self._ensure_loop()
+        fut = asyncio.run_coroutine_threadsafe(coro, loop)
+        if task_bin:
+            with self._running_lock:
+                self._running[task_bin] = ("async", fut)
+        try:
+            return fut.result()
+        except (_cf.CancelledError, asyncio.CancelledError):
+            raise TaskCancelledError(
+                TaskID(task_bin).hex() if task_bin else "")
+        finally:
+            if task_bin:
+                with self._running_lock:
+                    self._running.pop(task_bin, None)
 
     def _encode_reply(self, result, msg: Dict[str, Any]) -> Dict[str, Any]:
         num_returns = msg.get("num_returns", 1)
@@ -654,15 +740,21 @@ class _WorkerServer:
         args, kwargs = cloudpickle.loads(msg["spec"])
         args, kwargs = self._decode_args(args, kwargs)
         method = getattr(self._actor_instance, msg["method"])
+        task_bin = msg.get("task") or b""
         with self._env_context(self._actor_env, self._actor_env_plugins), \
                 self._trace(msg.get("trace_ctx")):
-            result = method(*args, **kwargs)
             import inspect as _inspect
 
-            if _inspect.iscoroutine(result):
-                import asyncio
-
-                result = asyncio.run(result)
+            if _inspect.iscoroutinefunction(method):
+                # Shared loop: concurrent calls interleave their awaits
+                # (each handler thread blocks, the coroutines don't).
+                result = self._run_coroutine(method(*args, **kwargs),
+                                             task_bin)
+            else:
+                with self._cancellable(task_bin):
+                    result = method(*args, **kwargs)
+                if _inspect.iscoroutine(result):
+                    result = self._run_coroutine(result, task_bin)
             if msg.get("num_returns") == "streaming":
                 self._stream(result, TaskID(msg["task"]), msg["method"])
                 return {"streamed": True}

@@ -422,6 +422,9 @@ class WorkerPool:
             for r in out:
                 rt.refs.add_borrow(key, r.id)
             return {"oids": [r.id.binary() for r in out]}
+        if op == "cancel_task":
+            rt.cancel(ObjectID(msg["oid"]), force=msg.get("force", False))
+            return None
         if op == "kill_actor":
             from ray_tpu.utils.ids import ActorID
 

@@ -366,12 +366,23 @@ class ServeController:
         st.metrics.pop(r.replica_id, None)
 
     def _broadcast(self, st: _DeploymentState):
+        import inspect as _inspect
+
+        # Async deployments route to handle_request_async (loop
+        # interleaving on the replica); sync ones to handle_request
+        # (thread pool) — see replica.py.
+        target = st.info.func_or_class
+        call = (getattr(target, "__call__", None)
+                if _inspect.isclass(target) else target)
+        is_async = (_inspect.iscoroutinefunction(call)
+                    or _inspect.isasyncgenfunction(call))
         table = []
         for r in st.replicas.values():
             if r.state == "RUNNING":
                 r._announced = True
                 table.append(
-                    (r.replica_id, r.handle, st.config.max_ongoing_requests)
+                    (r.replica_id, r.handle, st.config.max_ongoing_requests,
+                     is_async)
                 )
         self._host.notify_changed(
             replica_set_key(st.app_name, st.info.name), table

@@ -61,6 +61,17 @@ class ReplicaActor:
 
     # -- data plane --------------------------------------------------------
 
+    def _target(self, method_name: str):
+        if method_name == "__call__":
+            if not callable(self._callable):
+                raise TypeError(
+                    f"deployment {self.deployment_name!r} is not "
+                    f"callable — define __call__ or route to a named "
+                    f"method"
+                )
+            return self._callable
+        return getattr(self._callable, method_name)
+
     def handle_request(self, method_name: str, args: tuple, kwargs: dict,
                        metadata: dict = None):
         from ray_tpu.core import api
@@ -84,21 +95,45 @@ class ReplicaActor:
             (metadata or {}).get("multiplexed_model_id", "")
         )
         try:
-            if method_name == "__call__":
-                if not callable(self._callable):
-                    raise TypeError(
-                        f"deployment {self.deployment_name!r} is not "
-                        f"callable — define __call__ or route to a named "
-                        f"method"
-                    )
-                target = self._callable
-            else:
-                target = getattr(self._callable, method_name)
-            result = target(*args, **kwargs)
+            result = self._target(method_name)(*args, **kwargs)
             if inspect.iscoroutine(result):
                 import asyncio
 
                 result = asyncio.run(result)
+            return result
+        finally:
+            _mux._reset_model_id(mux_token)
+            with self._lock:
+                self._ongoing -= 1
+
+    async def handle_request_async(self, method_name: str, args: tuple,
+                                   kwargs: dict, metadata: dict = None):
+        """Async data plane: runs as a coroutine on the replica actor's
+        event loop, so max_ongoing_requests requests interleave their
+        awaits on ONE loop instead of one thread each (parity: the
+        reference's replica is natively asyncio, replica.py:494)."""
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.serve import multiplex as _mux
+
+        # List comp, not genexp: a generator expression containing
+        # ``await`` is an async generator, which tuple() rejects.
+        args = tuple(
+            [(await a) if isinstance(a, ObjectRef) else a for a in args]
+        )
+        kwargs = {
+            k: (await v) if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        mux_token = _mux._set_model_id(
+            (metadata or {}).get("multiplexed_model_id", "")
+        )
+        try:
+            result = self._target(method_name)(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
             return result
         finally:
             _mux._reset_model_id(mux_token)

@@ -20,10 +20,12 @@ from ray_tpu.core.object_ref import ObjectRef
 
 
 class _ReplicaInfo:
-    def __init__(self, replica_id: str, handle, max_ongoing: int):
+    def __init__(self, replica_id: str, handle, max_ongoing: int,
+                 is_async: bool = False):
         self.replica_id = replica_id
         self.handle = handle
         self.max_ongoing = max_ongoing
+        self.is_async = is_async
         self.inflight = 0
 
 
@@ -65,17 +67,21 @@ class Router:
         self._client = LongPollClient(listen, {key: self._update_replicas})
 
     def _update_replicas(self, table: List[Tuple[str, Any, int]]) -> None:
-        """table: [(replica_id, actor_handle, max_ongoing_requests)]"""
+        """table: [(replica_id, actor_handle, max_ongoing_requests,
+        is_async)]"""
         with self._cv:
             fresh: Dict[str, _ReplicaInfo] = {}
-            for replica_id, handle, max_ongoing in table:
+            for row in table:
+                replica_id, handle, max_ongoing = row[:3]
+                is_async = bool(row[3]) if len(row) > 3 else False
                 old = self._replicas.get(replica_id)
                 if old is not None:
                     old.max_ongoing = max_ongoing
+                    old.is_async = is_async
                     fresh[replica_id] = old
                 else:
                     fresh[replica_id] = _ReplicaInfo(
-                        replica_id, handle, max_ongoing
+                        replica_id, handle, max_ongoing, is_async
                     )
             self._replicas = fresh
             # Drop affinity entries pointing at replicas that left the
@@ -142,8 +148,9 @@ class Router:
                     )
                 self._cv.wait(0.05 if remaining is None else min(remaining, 0.05))
         metadata = {"multiplexed_model_id": model_id} if model_id else None
-        ref = chosen.handle.handle_request.remote(method_name, args, kwargs,
-                                                  metadata)
+        entry = (chosen.handle.handle_request_async if chosen.is_async
+                 else chosen.handle.handle_request)
+        ref = entry.remote(method_name, args, kwargs, metadata)
         with self._cv:
             self._outstanding[ref] = chosen.replica_id
         return ref, chosen.replica_id

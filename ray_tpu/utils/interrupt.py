@@ -1,0 +1,29 @@
+"""Thread async-exception delivery (CPython only).
+
+Parity: the reference interrupts a worker's running task by raising
+KeyboardInterrupt in it for non-force ray.cancel (ray:
+python/ray/_raylet.pyx:1806 task cancellation wrapper); here the same
+mechanism targets an executor THREAD via PyThreadState_SetAsyncExc.
+The exception lands at the next bytecode boundary — blocking C calls
+are not interrupted (that's what force=True / process kill is for).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+
+def async_raise(thread_ident: int, exc_cls) -> None:
+    """Deliver ``exc_cls`` into the thread at its next bytecode boundary."""
+    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_ident), ctypes.py_object(exc_cls)
+    )
+
+
+def clear_async_exc(thread_ident: int) -> None:
+    """Withdraw a not-yet-delivered async exception (call when the task
+    it targeted already finished, so it can't hit the next task that
+    runs on the same thread)."""
+    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_ident), None
+    )
