@@ -63,7 +63,15 @@ class RemoteFunction:
     def _submit(self, args, kwargs, overrides):
         from ray_tpu.core import api
 
-        opts = _make_task_options(self._default_options, overrides)
+        if overrides:
+            opts = _make_task_options(self._default_options, overrides)
+        else:
+            # Hot path: the default options never change — build once
+            # (submit_task treats TaskOptions as read-only).
+            opts = self.__dict__.get("_cached_opts")
+            if opts is None:
+                opts = _make_task_options(self._default_options, {})
+                self.__dict__["_cached_opts"] = opts
         refs = api.runtime().submit_task(self._fn, args, kwargs, opts)
         if opts.num_returns == "streaming":
             return refs  # an ObjectRefGenerator

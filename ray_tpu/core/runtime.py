@@ -20,6 +20,7 @@ so they run unchanged when workers move behind a process/RPC boundary.
 
 from __future__ import annotations
 
+import collections as _collections
 import contextlib
 import dataclasses
 import inspect as _inspect
@@ -252,6 +253,72 @@ class _PendingTask:
     # Set by ray_tpu.cancel: never (re)dispatch, never retry (parity:
     # TaskSpec cancellation flag checked in _raylet.pyx:1806).
     cancelled: bool = False
+    # Unsatisfied dependency oids while parked in the waiting index
+    # (parity: DependencyManager's per-task unfulfilled set).
+    waiting_on: Optional[set] = None
+    # Resource demand, computed once at submission (hot path).
+    demand: Optional[Dict[str, float]] = None
+
+
+class _CachedThreadPool:
+    """Task-execution threads, pooled and reused (parity: the raylet's
+    WorkerPool keeping warm workers instead of forking per task,
+    worker_pool.h:156 — here for thread mode).  Unbounded on purpose:
+    tasks may block arbitrarily long (nested ray.get), so a bounded
+    pool would deadlock; idle threads expire instead."""
+
+    def __init__(self, idle_timeout: float = 2.0, name: str = "task-exec"):
+        import collections as _c
+
+        self._cv = threading.Condition()
+        self._work: "_c.deque" = _c.deque()
+        self._idle = 0
+        self._timeout = idle_timeout
+        self._name = name
+        self._seq = itertools.count()
+        self._closed = False
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        spawn = False
+        with self._cv:
+            if self._closed:
+                return
+            self._work.append(fn)
+            if self._idle > 0:
+                self._cv.notify()
+            if len(self._work) > self._idle:
+                spawn = True
+        if spawn:
+            threading.Thread(
+                target=self._worker, daemon=True,
+                name=f"{self._name}-{next(self._seq)}",
+            ).start()
+
+    def _worker(self) -> None:
+        import time as _time
+
+        while True:
+            with self._cv:
+                deadline = _time.monotonic() + self._timeout
+                self._idle += 1
+                while not self._work:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0 or self._closed:
+                        self._idle -= 1
+                        return
+                    self._cv.wait(remaining)
+                self._idle -= 1
+                fn = self._work.popleft()
+            try:
+                fn()
+            except BaseException:
+                pass  # task bodies seal their own errors
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._work.clear()
+            self._cv.notify_all()
 
 
 # Returned by _execute_item when completion happens later on the actor's
@@ -819,8 +886,24 @@ class LocalRuntime:
         self.driver_task_id = TaskID.for_driver(self.job_id)
         self._put_counter = itertools.count(1)
         self._lock = threading.Lock()
-        self._pending: List[_PendingTask] = []
+        # Ready queue (deps satisfied, awaiting resources) + the
+        # dependency-wakeup index: missing oid → tasks parked on it
+        # (parity: DependencyManager, raylet/dependency_manager.h:51 —
+        # tasks wake when their deps become local, no polling).  Deque:
+        # the dispatcher pops the head O(1) — a list's pop(0) would be
+        # O(n) per dispatch with 100k tasks queued.
+        self._pending: "_collections.deque[_PendingTask]" = \
+            _collections.deque()
+        self._waiting_deps: Dict[ObjectID, List[_PendingTask]] = {}
         self._dispatch_cv = threading.Condition()
+        # Pooled executor threads for thread-mode task bodies.
+        self._exec_pool = _CachedThreadPool()
+        # Feasibility memo for (demand, string-strategy) pairs —
+        # submit-path hot cache, cleared on any topology change.  The
+        # epoch guards against caching a verdict computed against
+        # pre-change topology (compute is not under the topology lock).
+        self._feasible_cache: Dict[Any, bool] = {}
+        self._topology_epoch = 0
         self._shutdown = False
         self._actors: Dict[ActorID, _ActorShell] = {}
         self._named_actors: Dict[str, ActorID] = {}
@@ -843,8 +926,6 @@ class LocalRuntime:
         # Tombstones for the actor state table, bounded (parity: GCS keeps
         # DEAD actors queryable up to
         # RAY_maximum_gcs_destroyed_actor_cached_count).
-        import collections as _collections
-
         self._dead_actors: Any = _collections.deque(maxlen=1024)
         # Lineage for object reconstruction (parity: TaskManager keeps
         # specs of finished tasks while their outputs are referenced,
@@ -930,6 +1011,8 @@ class LocalRuntime:
             pending_pgs = [st for st in self._pgs.values()
                            if not st.removed
                            and any(b.node_id is None for b in st.bundles)]
+        self._topology_epoch += 1
+        self._feasible_cache.clear()  # new capacity changes feasibility
         # Register with the native scheduler LAST: the node must not be
         # natively pickable before the Python tables can map it back.
         if self._native_sched is not None:
@@ -952,6 +1035,8 @@ class LocalRuntime:
             if node is None or not node.alive:
                 return
             node.alive = False
+            self._topology_epoch += 1
+            self._feasible_cache.clear()
             if self._native_sched is not None:
                 self._native_sched.kill_node(node.int_id)
             doomed = [self._actors[a] for a in list(node.actor_ids)
@@ -1001,6 +1086,13 @@ class LocalRuntime:
             invalidated = self.store.invalidate(oid)
             if invalidated and oid in unrecoverable:
                 self.store.put_error(oid, ObjectLostError(oid.hex()))
+        # Tasks parked on a just-lost dep would otherwise wait for a
+        # reconstruction nobody triggers (recovery is fetch-lazy, and a
+        # parked task never fetches) — kick it for them now.
+        with self._dispatch_cv:
+            parked_lost = [oid for oid in lost if oid in self._waiting_deps]
+        for oid in parked_lost:
+            self._reconstruct_object(oid)
 
     def _reconstruct_object(self, oid: ObjectID) -> None:
         """Resubmit the creating task of a lost object (parity:
@@ -1052,9 +1144,7 @@ class LocalRuntime:
                     roid, ObjectLostError(roid.hex())
                 )
             return
-        with self._dispatch_cv:
-            self._pending.append(fresh)
-            self._dispatch_cv.notify_all()
+        self._enqueue_task(fresh)
 
     def _alive_nodes(self) -> List[NodeState]:
         return [self._nodes[i] for i in self._node_order
@@ -1097,6 +1187,23 @@ class LocalRuntime:
             # Item sealed into an abandoned stream — nobody can ever
             # consume it (the generator is gone); release on arrival.
             self.store.release(oid)
+        # Dependency wakeup (parity: DependencyManager::HandleObjectLocal
+        # moving tasks to ready) — tasks parked on this oid whose last
+        # missing dep just sealed go to the ready queue.
+        if self._waiting_deps:
+            with self._dispatch_cv:
+                waiters = self._waiting_deps.pop(oid, None)
+                if waiters:
+                    woke = False
+                    for pt in waiters:
+                        if pt.waiting_on is not None:
+                            pt.waiting_on.discard(oid)
+                        if not pt.waiting_on:
+                            pt.waiting_on = None
+                            self._pending.append(pt)
+                            woke = True
+                    if woke:
+                        self._dispatch_cv.notify_all()
 
     def _on_refs_zero(self, oid: ObjectID) -> None:
         """Free thread: last reference to ``oid`` dropped.  Release the
@@ -1171,15 +1278,73 @@ class LocalRuntime:
 
         return tuple(res(a) for a in args), {k: res(v) for k, v in kwargs.items()}
 
-    def _deps_ready(self, args: tuple, kwargs: dict) -> bool:
-        for v in list(args) + list(kwargs.values()):
-            if isinstance(v, ObjectRef) and not self.store.contains(v.id):
-                # A lost dependency triggers its own reconstruction
-                # (parity: the dependency resolver's recovery path).
-                if self.store._state(v.id).lost:
-                    self._reconstruct_object(v.id)
-                return False
-        return True
+    def _task_arg_oids(self, pt: _PendingTask) -> List[ObjectID]:
+        return [v.id for v in list(pt.args) + list(pt.kwargs.values())
+                if isinstance(v, ObjectRef)]
+
+    def _enqueue_task(self, pt: _PendingTask) -> None:
+        """Queue for dispatch: straight to the ready queue when every
+        ObjectRef arg is local, else parked in the dependency index to
+        be woken by the seal callback (parity: DependencyManager
+        subscribe → wake, no polling).  The registration and the seal
+        callback's resolution both run under _dispatch_cv, so a seal
+        racing this enqueue either makes contains() true here or finds
+        the parked entry there — never neither."""
+        with self._dispatch_cv:
+            missing = []
+            for oid in self._task_arg_oids(pt):
+                if not self.store.contains(oid):
+                    missing.append(oid)
+                    if self.store._state(oid).lost:
+                        # Parked fetcher triggers recovery (parity: the
+                        # dependency resolver's recovery path).
+                        self._reconstruct_object(oid)
+            if missing:
+                self._park_locked(pt, missing)
+                return
+            pt.waiting_on = None
+            self._pending.append(pt)
+            self._dispatch_cv.notify_all()
+
+    def _park_locked(self, pt: _PendingTask,
+                     missing: List[ObjectID]) -> None:
+        """Park in the dependency index; caller holds _dispatch_cv.
+        After registering, re-check each dep: the seal callback's
+        UNLOCKED emptiness fast-path may have skipped a wakeup while we
+        were parking — the locked contains() re-check closes that race."""
+        pt.waiting_on = set(missing)
+        for oid in pt.waiting_on:
+            self._waiting_deps.setdefault(oid, []).append(pt)
+        for oid in list(pt.waiting_on):
+            if self.store.contains(oid):
+                pt.waiting_on.discard(oid)
+                lst = self._waiting_deps.get(oid)
+                if lst is not None:
+                    try:
+                        lst.remove(pt)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        del self._waiting_deps[oid]
+        if not pt.waiting_on:
+            pt.waiting_on = None
+            self._pending.append(pt)
+            self._dispatch_cv.notify_all()
+
+    def _deps_still_ready_locked(self, pt: _PendingTask) -> bool:
+        """Cheap pre-dispatch re-check: a dep sealed at enqueue time may
+        have been invalidated since (node death).  Re-parks the task and
+        kicks reconstruction if so.  Caller holds _dispatch_cv."""
+        missing = []
+        for oid in self._task_arg_oids(pt):
+            if not self.store.contains(oid):
+                missing.append(oid)
+                if self.store._state(oid).lost:
+                    self._reconstruct_object(oid)
+        if not missing:
+            return True
+        self._park_locked(pt, missing)
+        return False
 
     def _store_results(self, result: Any, return_ids: List[ObjectID],
                        num_returns: int):
@@ -1253,6 +1418,21 @@ class LocalRuntime:
             i += 1
 
     # -- scheduling --------------------------------------------------------
+
+    def _feasible(self, demand: Dict[str, float], strategy: Any) -> bool:
+        """Memoized _cluster_can_fit for hashable (string) strategies;
+        the cache clears whenever cluster topology changes."""
+        if not isinstance(strategy, str):
+            return self._cluster_can_fit(demand, strategy)
+        key = (tuple(sorted(demand.items())), strategy)
+        cached = self._feasible_cache.get(key)
+        if cached is not None:
+            return cached
+        epoch = self._topology_epoch
+        ok = self._cluster_can_fit(demand, strategy)
+        if epoch == self._topology_epoch and len(self._feasible_cache) < 1024:
+            self._feasible_cache[key] = ok
+        return ok
 
     def _cluster_can_fit(self, demand: Dict[str, float],
                          strategy: Any = "DEFAULT") -> bool:
@@ -1400,7 +1580,7 @@ class LocalRuntime:
         demand = options.resource_demand()
         strategy = options.effective_strategy()
         if (not isinstance(strategy, PlacementGroupSchedulingStrategy)
-                and not self._cluster_can_fit(demand, strategy)):
+                and not self._feasible(demand, strategy)):
             raise ValueError(
                 f"task {getattr(fn, '__name__', fn)!r} demands {demand} "
                 f"under {strategy!r}, which no node can ever satisfy — "
@@ -1424,6 +1604,7 @@ class LocalRuntime:
             trace_ctx=(trace_ctx if trace_ctx is not None
                        else _tracing().capture_context()),
         )
+        pt.demand = demand  # computed once; dispatch + events reuse it
         self.events.record(
             task_id.hex(), _ev.PENDING_NODE_ASSIGNMENT,
             name=pt.function_name, type=_ev.NORMAL_TASK,
@@ -1440,9 +1621,7 @@ class LocalRuntime:
                     old_oid, old_pt = self._lineage.popitem(last=False)
                     self._object_locations.pop(old_oid, None)
                     self._recon_attempts.pop(id(old_pt), None)
-        with self._dispatch_cv:
-            self._pending.append(pt)
-            self._dispatch_cv.notify_all()
+        self._enqueue_task(pt)
         if streaming:
             from ray_tpu.core.generator import ObjectRefGenerator
 
@@ -1450,44 +1629,67 @@ class LocalRuntime:
         return [ObjectRef(oid) for oid in return_ids]
 
     def _dispatch_loop(self):
+        """Event-driven dispatcher: sleeps until woken by a new ready
+        task, a dependency seal, or a resource release (parity: the
+        raylet scheduling on events, not a poll — the 1 s timeout is
+        only a lost-wakeup safety net; round 1 polled every 20 ms)."""
         while True:
             with self._dispatch_cv:
                 while not self._shutdown:
                     runnable = self._next_runnable_locked()
                     if runnable is not None:
                         break
-                    self._dispatch_cv.wait(0.02)
+                    self._dispatch_cv.wait(1.0)
                 if self._shutdown:
                     return
             self._start_task(*runnable)
 
     def _next_runnable_locked(self):
-        for pt in self._pending:
-            if not self._deps_ready(pt.args, pt.kwargs):
-                continue
-            try:
-                alloc = self._try_allocate(
-                    pt.options.resource_demand(), pt.options.effective_strategy()
-                )
-            except ValueError as e:
-                self._pending.remove(pt)
-                err = TaskError(pt.function_name, e)
-                for oid in pt.return_ids:
-                    self.store.put_error(oid, err)
-                if pt.streaming:
-                    self.store.put_error(
-                        ObjectID.for_task_return(pt.task_id, 0), err
+        """Pop the first dispatchable ready task.  Head-pop is O(1) on
+        the hot path (homogeneous tasks: the head either fits or
+        nothing does); skipped tasks are restored in order."""
+        skipped: List[_PendingTask] = []
+        runnable = None
+        try:
+            while self._pending:
+                pt = self._pending.popleft()
+                if pt.cancelled:
+                    continue  # cancel() already sealed its outputs
+                # Dep liveness re-check: sealed-at-enqueue deps may have
+                # been invalidated by a node death since.
+                if not self._deps_still_ready_locked(pt):
+                    continue  # re-parked (or re-appended, if it resolved)
+                try:
+                    alloc = self._try_allocate(
+                        pt.demand if pt.demand is not None
+                        else pt.options.resource_demand(),
+                        pt.options.effective_strategy(),
                     )
-                self.events.record(
-                    pt.task_id.hex(), _ev.FAILED, name=pt.function_name,
-                    attempt=pt.options.max_retries - pt.retries_left,
-                    error_message=str(e),
-                )
-                return None
-            if alloc is not None:
-                self._pending.remove(pt)
-                return pt, alloc
-        return None
+                except ValueError as e:
+                    err = TaskError(pt.function_name, e)
+                    for oid in pt.return_ids:
+                        self.store.put_error(oid, err)
+                    if pt.streaming:
+                        self.store.put_error(
+                            ObjectID.for_task_return(pt.task_id, 0), err
+                        )
+                    self.events.record(
+                        pt.task_id.hex(), _ev.FAILED, name=pt.function_name,
+                        attempt=pt.options.max_retries - pt.retries_left,
+                        error_message=str(e),
+                    )
+                    # Keep scanning: with no poll, returning here would
+                    # stall runnable tasks behind a poisoned head for a
+                    # full safety-net wait.
+                    continue
+                if alloc is not None:
+                    runnable = (pt, alloc)
+                    return runnable
+                skipped.append(pt)
+            return None
+        finally:
+            # Restore skipped tasks at the front, original order first.
+            self._pending.extendleft(reversed(skipped))
 
     def _start_task(self, pt: _PendingTask, alloc: _Allocation):
         # Streaming tasks force retries_left=0, so derive their attempt
@@ -1515,7 +1717,8 @@ class LocalRuntime:
                 attempt=attempt, job_id=self.job_id.hex(),
                 node_id=(alloc.node.node_id.hex() if alloc.node else None),
                 worker=threading.current_thread().name,
-                required_resources=pt.options.resource_demand(),
+                required_resources=(pt.demand if pt.demand is not None
+                                    else pt.options.resource_demand()),
             )
             try:
                 if self.worker_pool is not None:
@@ -1580,9 +1783,7 @@ class LocalRuntime:
                 if not cancelled and pt.retries_left > 0:
                     pt.retries_left -= 1
                     requeued = True
-                    with self._dispatch_cv:
-                        self._pending.append(pt)
-                        self._dispatch_cv.notify_all()
+                    self._enqueue_task(pt)
                 elif not cancelled and not pt.streaming:
                     err = e if isinstance(e, TaskError) else TaskError(
                         pt.function_name, e
@@ -1604,9 +1805,7 @@ class LocalRuntime:
                 alloc.release()
                 self._notify()
 
-        threading.Thread(
-            target=run, name=f"task-{pt.function_name}", daemon=True
-        ).start()
+        self._exec_pool.submit(run)
 
     def _execute_task_remote(self, pt: _PendingTask) -> None:
         """Run one task on a leased worker process (parity: OnWorkerIdle
@@ -1708,7 +1907,8 @@ class LocalRuntime:
         exception (thread mode) or a cancel RPC / process kill
         (process mode, force=True).  A finished task is a no-op."""
         task_id = oid.task_id()
-        # 1. Queued (not yet dispatched) normal task.
+        # 1. Queued (not yet dispatched) normal task — ready queue or
+        # parked in the dependency index.
         target = None
         with self._dispatch_cv:
             for pt in self._pending:
@@ -1717,6 +1917,27 @@ class LocalRuntime:
                     pt.cancelled = True
                     self._pending.remove(pt)
                     break
+            if target is None:
+                for lst in self._waiting_deps.values():
+                    for pt in lst:
+                        if pt.task_id == task_id:
+                            target = pt
+                            pt.cancelled = True
+                            break
+                    if target is not None:
+                        break
+                if target is not None:
+                    # Unpark from every dep list it sits in.
+                    for dep in list(target.waiting_on or ()):
+                        lst = self._waiting_deps.get(dep)
+                        if lst is not None:
+                            try:
+                                lst.remove(target)
+                            except ValueError:
+                                pass
+                            if not lst:
+                                del self._waiting_deps[dep]
+                    target.waiting_on = None
         if target is not None:
             self._seal_cancelled(task_id, target.return_ids,
                                  target.streaming)
@@ -2252,4 +2473,5 @@ class LocalRuntime:
             shell.kill()
         if self.worker_pool is not None:
             self.worker_pool.shutdown()
+        self._exec_pool.close()
         self.store.close()

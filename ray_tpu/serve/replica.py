@@ -131,7 +131,22 @@ class ReplicaActor:
             (metadata or {}).get("multiplexed_model_id", "")
         )
         try:
-            result = self._target(method_name)(*args, **kwargs)
+            target = self._target(method_name)
+            # Per-METHOD dispatch: the deployment is announced async off
+            # its __call__, but a sync named method must not run inline
+            # on the shared event loop (it would freeze every
+            # interleaved request, or deadlock if it blocks on another
+            # coroutine's output) — push it to a thread.
+            fn = (target if inspect.isroutine(target)
+                  else getattr(target, "__call__", target))
+            if inspect.iscoroutinefunction(fn):
+                return await target(*args, **kwargs)
+            import asyncio
+            import functools
+
+            loop = asyncio.get_running_loop()
+            result = await loop.run_in_executor(
+                None, functools.partial(target, *args, **kwargs))
             if inspect.iscoroutine(result):
                 result = await result
             return result

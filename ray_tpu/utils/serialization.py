@@ -62,7 +62,23 @@ def serialize_parts(value: Any) -> Tuple[bytes, List[memoryview]]:
     """(meta, out-of-band buffers) — used when writing straight into the store."""
     value = _flatten(value)
     buffers: List[pickle.PickleBuffer] = []
-    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    try:
+        # Fast path: the C pickler handles everything importable —
+        # ~10× cheaper than constructing a CloudPickler per value
+        # (parity: the reference registers cloudpickle only as the
+        # fallback reducer over pickle5).  Types living in __main__
+        # pickle by REFERENCE here but wouldn't resolve in a worker
+        # process — the byte scan routes those to cloudpickle, which
+        # serializes them by value.
+        meta = pickle.dumps(value, protocol=5,
+                            buffer_callback=buffers.append)
+        if b"__main__" in meta:
+            raise pickle.PicklingError("__main__ type: by-value needed")
+    except Exception:
+        # Closures, lambdas, locally-defined classes, __main__ types.
+        buffers.clear()
+        meta = cloudpickle.dumps(value, protocol=5,
+                                 buffer_callback=buffers.append)
     views = []
     for b in buffers:
         raw = b.raw()

@@ -1,0 +1,138 @@
+"""Core-runtime microbenchmarks.
+
+Parity: the reference's microbenchmark suite (ray:
+python/ray/_private/ray_perf.py:93-153, run nightly via
+release/microbenchmark/run_microbenchmark.py:14-31) — task/actor-call/
+put throughput on one node.  Prints one JSON line per metric:
+
+    {"metric": "tasks_per_second", "value": N, "unit": "1/s"}
+
+Run: python release/ray_perf.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _rate(n: int, seconds: float) -> float:
+    return round(n / seconds, 1) if seconds > 0 else float("inf")
+
+
+def emit(metric: str, value: float, unit: str) -> None:
+    print(json.dumps({"metric": metric, "value": value, "unit": unit}),
+          flush=True)
+
+
+def bench_submit_and_drain(ray_tpu, n: int) -> None:
+    """Queue n no-op tasks as fast as possible, then drain — measures
+    submission rate and end-to-end dispatch throughput (the reference's
+    envelope: 1M queued on a node; ≥10k/s dispatch)."""
+
+    @ray_tpu.remote(num_cpus=0.001)
+    def noop():
+        return None
+
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    t_submit = time.perf_counter() - t0
+    ray_tpu.get(refs)
+    t_total = time.perf_counter() - t0
+    emit("task_submissions_per_second", _rate(n, t_submit), "1/s")
+    emit("tasks_per_second", _rate(n, t_total), "1/s")
+
+
+def bench_single_client_tasks_sync(ray_tpu, n: int) -> None:
+    """One-at-a-time round trips (submit + get) — latency-bound."""
+
+    @ray_tpu.remote(num_cpus=0.001)
+    def noop():
+        return None
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(noop.remote())
+    emit("tasks_sync_per_second", _rate(n, time.perf_counter() - t0), "1/s")
+
+
+def bench_actor_calls(ray_tpu, n: int) -> None:
+    @ray_tpu.remote(num_cpus=0.001)
+    class A:
+        def noop(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.noop.remote())  # warm
+    t0 = time.perf_counter()
+    refs = [a.noop.remote() for _ in range(n)]
+    ray_tpu.get(refs)
+    emit("actor_calls_per_second", _rate(n, time.perf_counter() - t0), "1/s")
+
+
+def bench_async_actor_calls(ray_tpu, n: int) -> None:
+    @ray_tpu.remote(num_cpus=0.001)
+    class A:
+        async def noop(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.noop.remote())
+    t0 = time.perf_counter()
+    refs = [a.noop.remote() for _ in range(n)]
+    ray_tpu.get(refs)
+    emit("async_actor_calls_per_second",
+         _rate(n, time.perf_counter() - t0), "1/s")
+
+
+def bench_put_small(ray_tpu, n: int) -> None:
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(i) for i in range(n)]
+    emit("puts_per_second", _rate(n, time.perf_counter() - t0), "1/s")
+    del refs
+
+
+def bench_put_gbps(ray_tpu, mb: int) -> None:
+    import numpy as np
+
+    data = np.random.randint(0, 255, size=(mb, 1 << 20), dtype=np.uint8)
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(data)
+    dt = time.perf_counter() - t0
+    emit("put_gigabytes_per_second",
+         round(data.nbytes / dt / (1 << 30), 3), "GB/s")
+    t0 = time.perf_counter()
+    out = ray_tpu.get(ref)
+    dt = time.perf_counter() - t0
+    assert out.shape == data.shape
+    emit("get_gigabytes_per_second",
+         round(data.nbytes / dt / (1 << 30), 3), "GB/s")
+    del out, ref
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv
+    n_tasks = 2_000 if quick else 20_000
+    n_queue = 5_000 if quick else 100_000
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    try:
+        bench_submit_and_drain(ray_tpu, n_queue)
+        bench_single_client_tasks_sync(ray_tpu, 200 if quick else 1_000)
+        bench_actor_calls(ray_tpu, n_tasks)
+        bench_async_actor_calls(ray_tpu, n_tasks)
+        bench_put_small(ray_tpu, n_tasks)
+        bench_put_gbps(ray_tpu, 64 if quick else 256)
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
