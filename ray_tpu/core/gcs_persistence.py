@@ -1,0 +1,105 @@
+"""Control-plane persistence — the Redis-backed GCS storage equivalent.
+
+Parity with the reference's pluggable GCS store (ray:
+src/ray/gcs/store_client/redis_store_client.h:33 behind GcsTableStorage,
+selection at gcs_server.cc:517-518): the control plane's durable tables
+(KV, detached-actor creation specs, placement-group specs) snapshot to a
+file; a driver restart pointed at the same path rebuilds them
+(gcs_init_data.cc replays tables the same way).  Snapshots are atomic
+(tmp + rename); a crash loses at most one flush period of writes —
+Redis "appendfsync everysec" semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_FORMAT_VERSION = 1
+
+
+class GcsPersistence:
+    """Atomic snapshot file + dirty-flag flusher thread."""
+
+    def __init__(self, path: str, flush_period_s: float = 0.2):
+        self.path = path
+        self._period = flush_period_s
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        self._collect: Optional[Callable[[], Dict[str, Any]]] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- load --------------------------------------------------------------
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        """The last snapshot, or None (missing/corrupt file — a torn
+        write can't happen thanks to rename, but a foreign file can)."""
+        try:
+            with open(self.path, "rb") as f:
+                blob = pickle.load(f)
+        except Exception:
+            # OSError, UnpicklingError, but also AttributeError/
+            # ImportError/ValueError from foreign or corrupt pickles —
+            # any unreadable snapshot means "start fresh", never "fail
+            # init" (recovery is the whole point of this file).
+            return None
+        if (not isinstance(blob, dict)
+                or blob.get("version") != _FORMAT_VERSION):
+            return None
+        return blob.get("tables")
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, tables: Dict[str, Any]) -> None:
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".gcs-snap-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump({"version": _FORMAT_VERSION, "tables": tables}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    # -- flusher -----------------------------------------------------------
+
+    def start_flusher(self, collect: Callable[[], Dict[str, Any]]) -> None:
+        self._collect = collect
+        self._thread = threading.Thread(
+            target=self._flush_loop, name="gcs-flush", daemon=True
+        )
+        self._thread.start()
+
+    def mark_dirty(self) -> None:
+        self._dirty.set()
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(self._period):
+            if self._dirty.is_set():
+                self._dirty.clear()
+                self._try_flush()
+
+    def _try_flush(self) -> None:
+        try:
+            self.save(self._collect())
+        except Exception:
+            pass  # persistence is best-effort; next tick retries
+
+    def close(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            # Join BEFORE the final flush: an in-flight periodic save
+            # could otherwise rename its stale snapshot over the final
+            # one and silently lose the last writes.
+            self._thread.join(timeout=5.0)
+        if final_flush and self._collect is not None:
+            self._try_flush()

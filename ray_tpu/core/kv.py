@@ -22,6 +22,22 @@ class KvStore:
     def __init__(self):
         self._lock = threading.Lock()
         self._data: Dict[Tuple[str, bytes], bytes] = {}
+        # Persistence hook: called (outside the lock) after any mutation
+        # (parity: the GCS table storage write-through).
+        self.on_mutate = None
+
+    def _mutated(self) -> None:
+        cb = self.on_mutate
+        if cb is not None:
+            cb()
+
+    def dump(self) -> Dict[Tuple[str, bytes], bytes]:
+        with self._lock:
+            return dict(self._data)
+
+    def restore(self, data: Dict[Tuple[str, bytes], bytes]) -> None:
+        with self._lock:
+            self._data = dict(data)
 
     @staticmethod
     def _key(namespace: Optional[str], key: bytes) -> Tuple[str, bytes]:
@@ -38,7 +54,8 @@ class KvStore:
             if not overwrite and k in self._data:
                 return False
             self._data[k] = bytes(value)
-            return True
+        self._mutated()
+        return True
 
     def get(self, key, *, namespace: Optional[str] = None
             ) -> Optional[bytes]:
@@ -51,7 +68,11 @@ class KvStore:
 
     def delete(self, key, *, namespace: Optional[str] = None) -> bool:
         with self._lock:
-            return self._data.pop(self._key(namespace, key), None) is not None
+            existed = self._data.pop(self._key(namespace, key),
+                                     None) is not None
+        if existed:
+            self._mutated()
+        return existed
 
     def keys(self, prefix=b"", *, namespace: Optional[str] = None
              ) -> List[bytes]:

@@ -26,6 +26,7 @@ import dataclasses
 import inspect as _inspect
 import itertools
 import threading
+import time
 import queue as _queue
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -988,11 +989,36 @@ class LocalRuntime:
             from ray_tpu.core.worker_pool import WorkerPool
 
             self.worker_pool = WorkerPool(self)
+        # Control-plane persistence (parity: Redis-backed GCS storage —
+        # KV + detached-actor specs + detached PG specs survive a
+        # driver restart, gcs/store_client/redis_store_client.h:33).
+        self._detached_specs: Dict[str, bytes] = {}
+        self._persist = None
+        self._restored_tables = None
+        if cfg.gcs_persist_path:
+            from ray_tpu.core.gcs_persistence import GcsPersistence
+
+            self._persist = GcsPersistence(
+                cfg.gcs_persist_path, cfg.gcs_flush_period_s
+            )
+            self._restored_tables = self._persist.load()
+            if self._restored_tables:
+                self.kv.restore(self._restored_tables.get("kv") or {})
+            self.kv.on_mutate = self._persist.mark_dirty
         self.head_node_id = self.add_node(total, labels)
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="dispatcher", daemon=True
         )
         self._dispatcher.start()
+        # Detached actors re-create AFTER the dispatcher is live (their
+        # constructors may submit work).  Parity: GCS restart replays
+        # the actor table and reschedules detached actors
+        # (gcs_init_data.cc + GcsActorManager::Initialize).
+        if self._restored_tables:
+            self._restore_detached(self._restored_tables)
+        self._restored_tables = None  # only needed during init
+        if self._persist is not None:
+            self._persist.start_flusher(self._gcs_tables)
 
     # -- cluster membership ------------------------------------------------
 
@@ -1149,6 +1175,52 @@ class LocalRuntime:
     def _alive_nodes(self) -> List[NodeState]:
         return [self._nodes[i] for i in self._node_order
                 if self._nodes[i].alive]
+
+    # -- control-plane persistence -----------------------------------------
+
+    def _gcs_tables(self) -> Dict[str, Any]:
+        """Durable control-plane snapshot (parity: the GCS tables Redis
+        holds: KV, actor specs for detached actors, PG specs)."""
+        with self._lock:
+            detached = dict(self._detached_specs)
+            pgs = [
+                {"bundles": [dict(b.resources) for b in st.bundles],
+                 "strategy": st.pg.strategy, "name": st.pg.name}
+                for st in self._pgs.values()
+                if st.lifetime == "detached" and st.pg.name
+                and not st.removed
+            ]
+        return {"kv": self.kv.dump(), "detached_actors": detached,
+                "detached_pgs": pgs}
+
+    def _mark_gcs_dirty(self) -> None:
+        if self._persist is not None:
+            self._persist.mark_dirty()
+
+    def _restore_detached(self, tables: Dict[str, Any]) -> None:
+        """Re-create persisted detached actors/PGs.  Actor memory state
+        is NOT recovered — same contract as the reference restarting a
+        detached actor after its process died (checkpoint in the actor
+        if its state matters)."""
+        import cloudpickle as _cp
+
+        for spec in tables.get("detached_pgs") or ():
+            try:
+                self.create_placement_group(
+                    spec["bundles"], spec["strategy"], spec["name"],
+                    "detached",
+                )
+            except Exception:
+                pass  # e.g. name re-taken; best-effort replay
+        for name, blob in (tables.get("detached_actors") or {}).items():
+            try:
+                cls, args, kwargs, options = _cp.loads(blob)
+                # Bounded wait: a cluster that shrank since the snapshot
+                # must skip unplaceable actors, not hang init forever.
+                self.create_actor(cls, args, kwargs, options,
+                                  alloc_timeout=5.0)
+            except Exception:
+                pass
 
     # -- objects -----------------------------------------------------------
 
@@ -1982,7 +2054,8 @@ class LocalRuntime:
     # -- actors ------------------------------------------------------------
 
     def create_actor(self, cls: type, args: tuple, kwargs: dict,
-                     options: ActorOptions):
+                     options: ActorOptions,
+                     alloc_timeout: Optional[float] = None):
         if options.name:
             with self._lock:
                 existing = self._named_actors.get(options.name)
@@ -2001,10 +2074,19 @@ class LocalRuntime:
             )
         # Actors hold their resources for their lifetime; block until
         # capacity frees up (woken by _notify on every release).
+        # alloc_timeout bounds the wait (used by detached-actor replay,
+        # where a shrunken cluster must not hang init forever).
+        deadline = (None if alloc_timeout is None
+                    else time.monotonic() + alloc_timeout)
         while True:
             alloc = self._try_allocate(demand, strategy)
             if alloc is not None:
                 break
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ValueError(
+                    f"actor {cls.__name__!r}: no capacity for {demand} "
+                    f"within {alloc_timeout}s"
+                )
             with self._dispatch_cv:
                 self._dispatch_cv.wait(0.05)
         actor_id = ActorID.of(self.job_id)
@@ -2026,6 +2108,20 @@ class LocalRuntime:
             node_id=(alloc.node.node_id.hex() if alloc.node else None),
             required_resources=demand,
         )
+        # Persist the creation spec so a restarted driver can replay it
+        # (parity: detached actors in the GCS actor table).  Serialized
+        # BEFORE registration: an unpicklable constructor arg must not
+        # leave a ghost registration behind (thread-mode actors never
+        # pickle their args otherwise) — it just isn't persisted.
+        spec_blob = None
+        if (options.lifetime == "detached" and options.name
+                and self._persist is not None):
+            import cloudpickle as _cp
+
+            try:
+                spec_blob = _cp.dumps((cls, args, kwargs, options))
+            except Exception:
+                spec_blob = None
         # Register before starting: if __init__ fails instantly, the death
         # path must find (and unregister) the actor, or its name leaks.
         with self._lock:
@@ -2034,6 +2130,10 @@ class LocalRuntime:
                 self._named_actors[options.name] = actor_id
             if alloc.node is not None:
                 alloc.node.actor_ids.add(actor_id)
+            if spec_blob is not None:
+                self._detached_specs[options.name] = spec_blob
+        if spec_blob is not None:
+            self._mark_gcs_dirty()
         shell.start()
         return shell, ObjectRef(creation_oid)
 
@@ -2216,9 +2316,19 @@ class LocalRuntime:
             self._actors.pop(shell.actor_id, None)
             if shell.allocation.node is not None:
                 shell.allocation.node.actor_ids.discard(shell.actor_id)
+            dropped_spec = False
             for name, aid in list(self._named_actors.items()):
                 if aid == shell.actor_id:
                     del self._named_actors[name]
+                    # A detached actor that truly died (kill/crash out
+                    # of restarts) leaves the durable table too — but a
+                    # driver SHUTDOWN must keep the spec so the next
+                    # driver can replay it.
+                    if not self._shutdown and name in self._detached_specs:
+                        del self._detached_specs[name]
+                        dropped_spec = True
+        if dropped_spec:
+            self._mark_gcs_dirty()
         self._notify()
 
     # -- placement groups --------------------------------------------------
@@ -2246,6 +2356,8 @@ class LocalRuntime:
                     raise ValueError(f"placement group name {name!r} taken")
                 self._named_pgs[name] = pg_id
         self._reserve_bundles(st, st.bundles)
+        if lifetime == "detached" and name:
+            self._mark_gcs_dirty()
         return pg
 
     def _reserve_bundles(self, st: _PGState, bundles: List[Bundle]) -> bool:
@@ -2388,6 +2500,7 @@ class LocalRuntime:
         # ObjectFreedError instead of an unseal-forever hang.
         self.refs.remove_seal_pin(st.ready_oid)
         self.store.release(st.ready_oid, tombstone=True)
+        self._mark_gcs_dirty()
         self._notify()
 
     def get_named_placement_group(self, name: str) -> PlacementGroup:
@@ -2473,5 +2586,9 @@ class LocalRuntime:
             shell.kill()
         if self.worker_pool is not None:
             self.worker_pool.shutdown()
+        if self._persist is not None:
+            # Final snapshot AFTER actor teardown (specs were kept —
+            # _finish_actor_removal skips spec removal once _shutdown).
+            self._persist.close(final_flush=True)
         self._exec_pool.close()
         self.store.close()

@@ -97,7 +97,12 @@ class WorkerHandle:
                 pass
         self.chan.close()
         try:
-            self.proc.terminate()
+            if graceful:
+                self.proc.terminate()
+            else:
+                # SIGKILL: delivered even to a SIGSTOP'd process (a
+                # pending SIGTERM would wait for SIGCONT forever).
+                self.proc.kill()
         except Exception:
             pass
 
@@ -125,12 +130,57 @@ class WorkerPool:
         for _ in range(get_config().worker_prestart):
             threading.Thread(target=self._prestart_one, daemon=True,
                              name="worker-prestart").start()
+        # Active liveness probing (parity: GcsHealthCheckManager's
+        # periodic gRPC health probes per node,
+        # gcs/gcs_server/gcs_health_check_manager.h:55,87-106): a worker
+        # that stops answering pings — SIGSTOP'd, deadlocked socket,
+        # livelocked — is declared dead WITHOUT anyone calling kill.
+        if get_config().health_check_period_s > 0:
+            threading.Thread(target=self._health_loop, daemon=True,
+                             name="worker-health").start()
 
     def _prestart_one(self) -> None:
         try:
             self.release(self.spawn())
         except Exception:
             pass
+
+    # -- health checking ---------------------------------------------------
+
+    def _health_loop(self) -> None:
+        from ray_tpu.utils.config import get_config
+
+        cfg = get_config()
+        period = cfg.health_check_period_s
+        window = period * max(1, cfg.health_check_failure_threshold)
+        while not self._closed:
+            time.sleep(period)
+            with self._lock:
+                workers = list(self._all.values())
+            for wh in workers:
+                if wh.dead or getattr(wh, "_probe_inflight", False):
+                    continue
+                wh._probe_inflight = True
+                threading.Thread(
+                    target=self._probe, args=(wh, window), daemon=True,
+                    name=f"health-probe-{wh.pid}",
+                ).start()
+
+    def _probe(self, wh: WorkerHandle, window: float) -> None:
+        try:
+            try:
+                wh.chan.call("ping", rpc_timeout=window)
+            except TimeoutError:
+                # Unresponsive for the whole failure window → dead
+                # (parity: failure_threshold missed probes).  terminate
+                # closes the channel, which fires _on_close → actor
+                # death / in-flight call failure / borrow drop.
+                if not wh.dead:
+                    wh.terminate(graceful=False)
+            except Exception:
+                pass  # channel already closing — death path owns it
+        finally:
+            wh._probe_inflight = False
 
     # -- registration ------------------------------------------------------
 

@@ -128,10 +128,25 @@ D("worker_register_timeout_s", float, 30.0, "Startup handshake deadline.")
 D("worker_idle_timeout_s", float, 300.0, "Idle worker reap time.")
 
 # --- Control plane --------------------------------------------------------
-D("health_check_period_s", float, 1.0, "Controller→node liveness probe period.")
-D("health_check_failure_threshold", int, 5, "Missed probes before a node is dead.")
+D("health_check_period_s", float, 5.0,
+  "Worker liveness probe period (0 disables).  The probe shares the "
+  "worker's GIL, so the failure window (period x threshold) must exceed "
+  "any single GIL-holding C call a healthy task might make.")
+D("health_check_failure_threshold", int, 6,
+  "Unresponsive for period x threshold (default 30 s) = dead.")
 D("task_event_buffer_size", int, 10000, "Ring buffer of task state events.")
 D("pubsub_poll_timeout_s", float, 30.0, "Long-poll timeout for subscribers.")
+
+# --- Control-plane persistence (GCS fault tolerance) ----------------------
+D("gcs_persist_path", str, "",
+  "File the control plane snapshots to (KV, detached-actor specs, "
+  "placement-group specs).  '' disables persistence; a driver restart "
+  "pointed at the same path recovers the state (parity: the Redis-backed "
+  "GCS storage, gcs/store_client/redis_store_client.h:33).  "
+  "Env: RAYTPU_GCS_PERSIST_PATH.")
+D("gcs_flush_period_s", float, 0.2,
+  "Dirty-snapshot flush period (crash loses at most this window, like "
+  "Redis AOF everysec).")
 
 # --- Fault tolerance ------------------------------------------------------
 D("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")
