@@ -29,6 +29,9 @@ class GcsPersistence:
         self._period = flush_period_s
         self._dirty = threading.Event()
         self._stop = threading.Event()
+        # Serializes saves: the final flush must never lose to a stale
+        # in-flight periodic save's os.replace.
+        self._save_lock = threading.Lock()
         self._collect: Optional[Callable[[], Dict[str, Any]]] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -90,7 +93,8 @@ class GcsPersistence:
 
     def _try_flush(self) -> None:
         try:
-            self.save(self._collect())
+            with self._save_lock:
+                self.save(self._collect())
         except Exception:
             pass  # persistence is best-effort; next tick retries
 
@@ -99,7 +103,15 @@ class GcsPersistence:
         if self._thread is not None:
             # Join BEFORE the final flush: an in-flight periodic save
             # could otherwise rename its stale snapshot over the final
-            # one and silently lose the last writes.
+            # one and silently lose the last writes.  If it is stuck
+            # (hung filesystem), the save lock still orders us after it
+            # — bounded, so a truly hung fsync can't wedge shutdown.
             self._thread.join(timeout=5.0)
         if final_flush and self._collect is not None:
-            self._try_flush()
+            if self._save_lock.acquire(timeout=10.0):
+                try:
+                    self.save(self._collect())
+                except Exception:
+                    pass
+                finally:
+                    self._save_lock.release()

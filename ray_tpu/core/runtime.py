@@ -1220,7 +1220,12 @@ class LocalRuntime:
                 self.create_actor(cls, args, kwargs, options,
                                   alloc_timeout=5.0)
             except Exception:
-                pass
+                # Unplaceable/unreplayable NOW ≠ gone: keep the spec in
+                # the durable table so a later restart with capacity can
+                # still recover it (parity: an unplaceable detached
+                # actor stays pending in the GCS actor table).
+                with self._lock:
+                    self._detached_specs.setdefault(name, blob)
 
     # -- objects -----------------------------------------------------------
 

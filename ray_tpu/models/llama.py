@@ -46,6 +46,10 @@ class LlamaConfig:
     # "dots": save matmul outputs, recompute the rest (best tokens/sec when
     # HBM allows); "full": save nothing (max memory headroom, ~12% slower)
     remat_policy: str = "dots"
+    # Head-projection chunk along S for the training loss (0 = off):
+    # never materializes [B, S, V] logits — the dominant activation for
+    # small-dim/big-vocab models (see chunked_next_token_loss).
+    loss_chunk: int = 0
     logits_soft_cap: Optional[float] = None
     tie_embeddings: bool = False
     # Shard the sequence over the mesh "sp" axis: attention becomes ring
@@ -242,15 +246,18 @@ def _layer_fn(cfg: LlamaConfig, x, layer, sin, cos, segment_ids):
 
 # --- forward --------------------------------------------------------------
 
-def forward(
+def forward_hidden(
     params: Params,
     tokens: jax.Array,
     cfg: LlamaConfig,
     *,
     positions: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
-) -> jax.Array:
-    """Training/prefill forward: tokens [B, S] → logits [B, S, V] (float32)."""
+) -> Tuple[jax.Array, jax.Array]:
+    """Backbone only: tokens [B, S] → (hidden [B, S, D], head [D, V]).
+    The head projection is left to the caller so the loss can run it
+    CHUNKED — materializing full [B, S, V] float32 logits is the single
+    biggest activation on small models (B8·S2048·V32k f32 = 2.1 GB)."""
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
     sin, cos = rope_table(cfg, positions)
@@ -274,6 +281,20 @@ def forward(
     x, _ = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    return x, head
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Training/prefill forward: tokens [B, S] → logits [B, S, V] (float32)."""
+    x, head = forward_hidden(params, tokens, cfg, positions=positions,
+                             segment_ids=segment_ids)
     return jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype)).astype(jnp.float32)
 
 
@@ -301,6 +322,55 @@ def next_token_loss(
     return total, jnp.sum(mask)
 
 
+def chunked_next_token_loss(
+    x: jax.Array,
+    head: jax.Array,
+    tokens: jax.Array,
+    loss_mask: Optional[jax.Array] = None,
+    *,
+    chunk: int = 512,
+    z_loss: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Cross-entropy with the head projection chunked over the sequence
+    axis: at no point do full [B, S, V] logits exist — each scan step
+    materializes only [B, chunk, V] and the backward rematerializes it
+    (jax.checkpoint).  Chunking along S (not a flatten over B·S) keeps
+    the dp/fsdp batch sharding intact under pjit."""
+    x = x[:, :-1]
+    targets = tokens[:, 1:]
+    B, S1, D = x.shape
+    mask = (jnp.ones((B, S1), jnp.float32) if loss_mask is None
+            else loss_mask[:, 1:].astype(jnp.float32))
+    pad = (-S1) % chunk
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n_chunks = (S1 + pad) // chunk
+    # [C, B, chunk, ...] so scan walks sequence chunks.
+    xs = x.reshape(B, n_chunks, chunk, D).swapaxes(0, 1)
+    ts = targets.reshape(B, n_chunks, chunk).swapaxes(0, 1)
+    ms = mask.reshape(B, n_chunks, chunk).swapaxes(0, 1)
+    hd = head.astype(x.dtype)
+
+    def body(carry, inp):
+        xi, ti, mi = inp
+        logits = jnp.einsum("bkd,dv->bkv", xi, hd).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, ti[..., None], axis=-1)[..., 0]
+        nll = logz - tgt
+        if z_loss:
+            nll = nll + z_loss * logz**2
+        tot, cnt = carry
+        return (tot + jnp.sum(nll * mi), cnt + jnp.sum(mi)), None
+
+    (tot, cnt), _ = lax.scan(
+        jax.checkpoint(body), (jnp.float32(0.0), jnp.float32(0.0)),
+        (xs, ts, ms),
+    )
+    return tot / jnp.maximum(cnt, 1.0), cnt
+
+
 def loss_fn(
     params: Params,
     batch: Dict[str, jax.Array],
@@ -312,10 +382,19 @@ def loss_fn(
     tokens = batch["tokens"]
     # Run the full sequence length (keeps S block-divisible for the flash
     # kernel) and shift logits instead of inputs.
-    logits = forward(params, tokens, cfg, segment_ids=batch.get("segment_ids"))
-    total, ntokens = next_token_loss(
-        logits, tokens, batch.get("loss_mask"), z_loss=z_loss
-    )
+    if cfg.loss_chunk:
+        x, head = forward_hidden(params, tokens, cfg,
+                                 segment_ids=batch.get("segment_ids"))
+        total, ntokens = chunked_next_token_loss(
+            x, head, tokens, batch.get("loss_mask"),
+            chunk=cfg.loss_chunk, z_loss=z_loss,
+        )
+    else:
+        logits = forward(params, tokens, cfg,
+                         segment_ids=batch.get("segment_ids"))
+        total, ntokens = next_token_loss(
+            logits, tokens, batch.get("loss_mask"), z_loss=z_loss
+        )
     return total, {"loss": total, "ntokens": ntokens}
 
 

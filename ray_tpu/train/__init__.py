@@ -13,6 +13,7 @@ from ray_tpu.train.session import (
 )
 from ray_tpu.train.step import compile_train_step, make_train_step
 from ray_tpu.train.trainer import JaxTrainer, Result, RunConfig, ScalingConfig
+from ray_tpu.train.backend import JaxBackendConfig, JaxDistributedBackend
 from ray_tpu.train.worker_group import (
     BackendExecutor,
     DataParallelTrainer,
@@ -26,6 +27,8 @@ __all__ = [
     "CheckpointManager",
     "DataParallelTrainer",
     "FailureConfig",
+    "JaxBackendConfig",
+    "JaxDistributedBackend",
     "JaxTrainer",
     "Result",
     "RunConfig",

@@ -1,0 +1,139 @@
+"""Training backends: wiring a real multi-process jax world.
+
+Parity: the reference's Backend/BackendConfig abstraction (ray:
+python/ray/train/backend.py:15,27) whose torch instance builds the NCCL
+process group from worker-0's rendezvous address
+(train/torch/config.py:63 _setup_torch_process_group).  The TPU-native
+instance instead calls ``jax.distributed.initialize`` in EVERY worker
+process — after which ``jax.devices()`` is the global device set and
+pjit/shard_map programs emit cross-process collectives (XLA over
+ICI/DCN on TPU pods; gloo on the CPU backend used in tests).
+
+SPMD-vs-actor impedance (SURVEY.md §7 hard part 5): one worker actor is
+pinned per host, all enter the same program, and a worker restart means
+the whole world re-forms — DataParallelTrainer's retry tears the group
+down (killing every worker PROCESS, which dissolves the old world) and
+the next attempt builds a fresh one on a fresh coordinator, resuming
+from the latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class JaxBackendConfig:
+    """Parity: BackendConfig (train/backend.py:15)."""
+
+    # Force a platform in the workers ("cpu" for multi-process CPU
+    # worlds in tests; None = let jax pick, i.e. TPU when present).
+    platform: Optional[str] = None
+    # 0 = pick a free port on worker 0's host.
+    coordinator_port: int = 0
+    # Pass through to jax.distributed.initialize (e.g. 4 chips/host).
+    local_device_ids: Optional[List[int]] = None
+
+
+# Module-level worker functions: shipped by reference, run inside the
+# worker processes.
+
+def _pick_coordinator(port: int) -> str:
+    import socket
+
+    host = socket.gethostbyname(socket.gethostname())
+    if port == 0:
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+    return f"{host}:{port}"
+
+
+def _init_jax_distributed(addr: str, num_processes: int, process_id: int,
+                          platform: Optional[str],
+                          local_device_ids: Optional[List[int]]) -> int:
+    """Runs in the worker process BEFORE any other jax backend use —
+    fresh worker processes import jax lazily, so the train fn sees the
+    initialized world (parity: process-group init before the loop)."""
+    import os
+    import re
+
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+    if platform == "cpu":
+        # One LOCAL device per process: a test driver's inherited
+        # --xla_force_host_platform_device_count=8 would otherwise give
+        # every process 8 virtual devices and a world of 8N.
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       flags)
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=1"
+        ).strip()
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    kwargs: Dict[str, Any] = {}
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    return len(jax.devices())
+
+
+def _shutdown_jax_distributed() -> None:
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+class JaxDistributedBackend:
+    """Forms the jax world across a WorkerGroup (parity: Backend —
+    on_start builds the process group, on_shutdown destroys it)."""
+
+    def __init__(self, config: Optional[JaxBackendConfig] = None):
+        self.config = config or JaxBackendConfig()
+        self.coordinator_address: Optional[str] = None
+
+    def on_start(self, worker_group) -> List[int]:
+        """Initialize every worker's jax.distributed; returns each
+        worker's global device count (all equal once formed)."""
+        import ray_tpu
+
+        cfg = self.config
+        self.coordinator_address = worker_group.execute_single(
+            0, _pick_coordinator, cfg.coordinator_port
+        )
+        n = worker_group.num_workers
+        # All initialize calls must be in flight together — each blocks
+        # until the full world connects to the coordinator.
+        refs = [
+            w.execute.remote(
+                _init_jax_distributed, self.coordinator_address, n, rank,
+                cfg.platform, cfg.local_device_ids,
+            )
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        return ray_tpu.get(refs, timeout=120)
+
+    def on_shutdown(self, worker_group) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.get(
+                [w.execute.remote(_shutdown_jax_distributed)
+                 for w in worker_group.workers],
+                timeout=10,
+            )
+        except Exception:
+            pass  # dying workers take their world down with them

@@ -95,8 +95,11 @@ def default_optimizer(
     grad_clip: float = 1.0,
     warmup_steps: int = 100,
     total_steps: Optional[int] = None,
+    mu_dtype: Any = None,
 ) -> optax.GradientTransformation:
-    """AdamW with cosine schedule + global-norm clipping (LLM defaults)."""
+    """AdamW with cosine schedule + global-norm clipping (LLM defaults).
+    ``mu_dtype=jnp.bfloat16`` halves the first-moment buffer (HBM
+    headroom for bigger batches; the variance stays float32)."""
     if callable(learning_rate):
         schedule = learning_rate
     elif total_steps:
@@ -107,5 +110,6 @@ def default_optimizer(
         schedule = optax.linear_schedule(0.0, learning_rate, max(warmup_steps, 1))
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay,
+                    mu_dtype=mu_dtype),
     )

@@ -183,10 +183,12 @@ class BackendExecutor:
 
     def __init__(self, num_workers: int, *,
                  resources_per_worker: Optional[Dict[str, float]] = None,
-                 placement_strategy: str = "PACK"):
+                 placement_strategy: str = "PACK",
+                 backend: Optional[Any] = None):
         self.num_workers = num_workers
         self.resources_per_worker = resources_per_worker
         self.placement_strategy = placement_strategy
+        self.backend = backend
         self.worker_group: Optional[WorkerGroup] = None
 
     def start(self) -> None:
@@ -195,6 +197,11 @@ class BackendExecutor:
             resources_per_worker=self.resources_per_worker,
             placement_strategy=self.placement_strategy,
         )
+        if self.backend is not None:
+            # Form the jax.distributed world across the fresh worker
+            # processes (parity: Backend.on_start building the NCCL
+            # group, train/torch/config.py:63).
+            self.backend.on_start(self.worker_group)
 
     def start_training(self, train_fn: Callable, report_queue,
                        latest_checkpoint: Optional[Any] = None,
@@ -209,6 +216,8 @@ class BackendExecutor:
 
     def shutdown(self) -> None:
         if self.worker_group is not None:
+            if self.backend is not None:
+                self.backend.on_shutdown(self.worker_group)
             self.worker_group.shutdown()
             self.worker_group = None
 
@@ -225,13 +234,15 @@ class DataParallelTrainer:
                  num_workers: int = 1,
                  resources_per_worker: Optional[Dict[str, float]] = None,
                  placement_strategy: str = "PACK",
-                 failure_config: Optional[FailureConfig] = None):
+                 failure_config: Optional[FailureConfig] = None,
+                 backend: Optional[Any] = None):
         self._fn = train_loop_per_worker
         self._config = train_loop_config
         self._num_workers = num_workers
         self._resources = resources_per_worker
         self._strategy = placement_strategy
         self._failure_config = failure_config or FailureConfig()
+        self._backend = backend
 
     def fit(self) -> "TrainOutput":
         from ray_tpu.util.queue import Queue
@@ -247,6 +258,7 @@ class DataParallelTrainer:
                 self._num_workers,
                 resources_per_worker=self._resources,
                 placement_strategy=self._strategy,
+                backend=self._backend,
             )
             executor.start()
             report_queue = Queue()

@@ -1,0 +1,161 @@
+"""Real multi-process jax.distributed training (no virtual-device mesh).
+
+Parity targets: the torch backend's process-group formation from the
+worker group's rendezvous (ray: train/torch/config.py:63
+_setup_torch_process_group) and whole-run restart from checkpoint on
+worker failure (air FailureConfig).  Unlike the rest of the suite,
+these tests build an N-PROCESS jax world: each worker actor is its own
+OS process, jax.distributed.initialize rendezvouses them, and the train
+step's reduction is a REAL cross-process collective (gloo on CPU; XLA
+over ICI on TPU pods).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api as _api
+from ray_tpu.train import (
+    DataParallelTrainer,
+    FailureConfig,
+    JaxBackendConfig,
+    JaxDistributedBackend,
+    WorkerGroup,
+    BackendExecutor,
+)
+from ray_tpu.train import session
+
+
+@pytest.fixture
+def proc_rt(monkeypatch):
+    monkeypatch.setenv("RAYTPU_WORKERS", "process")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield _api.runtime()
+    ray_tpu.shutdown()
+
+
+def _world_probe():
+    import jax
+
+    return {
+        "pid": os.getpid(),
+        "process_index": jax.process_index(),
+        "global_devices": len(jax.devices()),
+        "local_devices": jax.local_device_count(),
+    }
+
+
+def test_world_forms_across_processes(proc_rt):
+    executor = BackendExecutor(
+        2, resources_per_worker={"CPU": 1},
+        backend=JaxDistributedBackend(JaxBackendConfig(platform="cpu")),
+    )
+    executor.start()
+    try:
+        rows = executor.worker_group.execute(_world_probe)
+        # Two DISTINCT OS processes, one global 2-device world.
+        assert len({r["pid"] for r in rows}) == 2
+        assert all(r["global_devices"] == 2 for r in rows)
+        assert all(r["local_devices"] == 1 for r in rows)
+        assert sorted(r["process_index"] for r in rows) == [0, 1]
+    finally:
+        executor.shutdown()
+
+
+def _dp_train_fn(config):
+    """A data-parallel step whose gradient reduction is a real
+    cross-process collective: each process feeds its own shard."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    assert len(devs) == config["world"], "world did not form"
+    mesh = Mesh(np.array(devs), ("dp",))
+    batch_sh = NamedSharding(mesh, P("dp", None))
+    repl = NamedSharding(mesh, P())
+
+    rank = jax.process_index()
+    ckpt = session.get_checkpoint()
+    start = 0 if ckpt is None else int(ckpt["step"]) + 1
+    w = (jnp.zeros((4,), jnp.float32) if ckpt is None
+         else jnp.asarray(ckpt["w"]))
+
+    def loss(w, x, y):
+        pred = x @ w
+        return jnp.mean((pred - y) ** 2)
+
+    step_fn = jax.jit(
+        lambda w, x, y: (loss(w, x, y),
+                         w - 0.1 * jax.grad(loss)(w, x, y)),
+        in_shardings=(repl, batch_sh, NamedSharding(mesh, P("dp"))),
+        out_shardings=(repl, repl),
+    )
+    rng = np.random.default_rng(7)  # same stream everywhere
+    # FIXED dataset: full-batch gradient descent strictly decreases the
+    # loss, so the test's monotonicity assertion is deterministic.
+    x_all = rng.standard_normal((config["world"] * 2, 4)).astype(np.float32)
+    y_all = (x_all @ np.array([1.0, -2.0, 3.0, 0.5],
+                              np.float32)).astype(np.float32)
+    for i in range(start, config["steps"]):
+        if config.get("die_at") is not None and i == config["die_at"] \
+                and rank == 0 and ckpt is None:
+            os.kill(os.getpid(), 9)  # simulate a worker crash mid-run
+        lx = x_all[rank * 2:(rank + 1) * 2]
+        ly = y_all[rank * 2:(rank + 1) * 2]
+        x = jax.make_array_from_single_device_arrays(
+            x_all.shape, batch_sh,
+            [jax.device_put(lx, jax.local_devices()[0])])
+        y = jax.make_array_from_single_device_arrays(
+            y_all.shape, NamedSharding(mesh, P("dp")),
+            [jax.device_put(ly, jax.local_devices()[0])])
+        lv, w = step_fn(w, x, y)
+        session.report(
+            {"step": i, "loss": float(jax.device_get(lv))},
+            checkpoint={"step": i, "w": np.asarray(jax.device_get(w))},
+        )
+    return float(jax.device_get(lv))
+
+
+def test_two_process_training_step(proc_rt):
+    trainer = DataParallelTrainer(
+        _dp_train_fn,
+        train_loop_config={"world": 2, "steps": 3, "die_at": None},
+        num_workers=2,
+        resources_per_worker={"CPU": 1},
+        backend=JaxDistributedBackend(JaxBackendConfig(platform="cpu")),
+    )
+    out = trainer.fit()
+    assert out.error is None
+    losses = [h["metrics"]["loss"] for h in out.metrics_history
+              if h["rank"] == 0]
+    assert len(losses) == 3
+    assert losses[-1] < losses[0]  # the shared world actually trained
+
+
+def test_worker_kill_reforms_world_and_resumes(proc_rt):
+    """The VERDICT bar: kill -9 a worker mid-run; the group tears down,
+    a fresh world forms on a fresh coordinator, and training resumes
+    from the latest rank-0 checkpoint instead of step 0."""
+    trainer = DataParallelTrainer(
+        _dp_train_fn,
+        train_loop_config={"world": 2, "steps": 4, "die_at": 2},
+        num_workers=2,
+        resources_per_worker={"CPU": 1},
+        failure_config=FailureConfig(max_failures=1),
+        backend=JaxDistributedBackend(JaxBackendConfig(platform="cpu")),
+    )
+    t0 = time.monotonic()
+    out = trainer.fit()
+    assert out.error is None, f"did not recover: {out.error}"
+    rank0 = [h["metrics"]["step"] for h in out.metrics_history
+             if h["rank"] == 0]
+    # Attempt 1 reported steps 0..1 then died at 2; attempt 2 resumed
+    # FROM the checkpoint (step 2 onward, not step 0 again).
+    assert rank0[:2] == [0, 1]
+    assert rank0[2:] == [2, 3], f"no checkpoint resume: {rank0}"
+    assert time.monotonic() - t0 < 120
