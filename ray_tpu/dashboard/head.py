@@ -91,6 +91,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(_state.timeline())
             elif url.path.startswith("/api/jobs"):
                 self._jobs_get(url.path)
+            elif url.path == "/api/serve/applications":
+                # Parity: the serve REST surface (serve/schema.py →
+                # dashboard serve module GET /api/serve/applications/).
+                self._serve_status()
             else:
                 self._json({"error": f"no route {url.path}"}, 404)
         except BrokenPipeError:
@@ -100,6 +104,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json({"error": repr(e)}, 500)
             except Exception:
                 pass
+
+    def _serve_status(self) -> None:
+        from ray_tpu.core import api as _api
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        try:
+            controller = _api.get_actor(CONTROLLER_NAME)
+        except ValueError:
+            self._json({"applications": {}})
+            return
+        self._json(_api.get(controller.status.remote()))
 
     # -- job REST routes (parity: dashboard/modules/job/job_head.py) -------
 

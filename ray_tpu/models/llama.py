@@ -540,6 +540,125 @@ def decode_slots(
     return logits.astype(jnp.float32), cache
 
 
+# --- paged inference (block-table KV cache) --------------------------------
+
+def init_paged_cache(cfg: LlamaConfig, num_pages: int,
+                     page_size: int) -> Dict[str, jax.Array]:
+    """Page-pool cache: k/v [L, KVH, P, page, D] (kv-head-major per
+    layer — the paged kernel's layout, ops/paged_attention.py)."""
+    shape = (cfg.n_layers, cfg.n_kv_heads, num_pages, page_size,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def prefill_slot_paged(
+    params: Params,
+    tokens: jax.Array,
+    true_len: jax.Array,
+    pages: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Prefill ONE sequence, writing k/v into its assigned PAGES.
+
+    tokens [S] (S a multiple of page_size), pages [S // page_size]
+    physical page ids.  Returns (logits at true_len-1 [V], cache)."""
+    S = tokens.shape[0]
+    page = cache["k"].shape[3]
+    positions = jnp.arange(S)[None, :]
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"].astype(cfg.dtype)[tokens[None, :]]
+
+    def body(carry, layer):
+        x = carry
+        normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        out, (k, v) = _attn_block(normed, layer, cfg, sin, cos, None)
+        h = x + out
+        h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
+        return h, (k[0], v[0])
+
+    x, (k_all, v_all) = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = lax.dynamic_index_in_dim(x[0], true_len - 1, axis=0, keepdims=False)
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = last @ head.astype(cfg.dtype)
+
+    # k_all/v_all [L, S, KVH, D] → [L, KVH, S, D], then one
+    # dynamic_update_slice per page chunk.
+    k_all = k_all.swapaxes(1, 2)
+    v_all = v_all.swapaxes(1, 2)
+    ck, cv = cache["k"], cache["v"]
+    for j in range(S // page):
+        chunk_k = lax.dynamic_slice_in_dim(k_all, j * page, page, axis=2)
+        chunk_v = lax.dynamic_slice_in_dim(v_all, j * page, page, axis=2)
+        ck = lax.dynamic_update_slice(
+            ck, chunk_k[:, :, None], (0, 0, pages[j], 0, 0))
+        cv = lax.dynamic_update_slice(
+            cv, chunk_v[:, :, None], (0, 0, pages[j], 0, 0))
+    return logits.astype(jnp.float32), {"k": ck, "v": cv}
+
+
+def decode_slots_paged(
+    params: Params,
+    tokens: jax.Array,
+    active: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array], jax.Array]:
+    """One decode step over all slots against the page pool.
+
+    tokens [slots], active [slots] bool, block_tables [slots, maxp],
+    lengths [slots] → (logits [slots, V], cache, new_lengths).
+    The new token's k/v is scattered into page
+    block_tables[b, lengths[b] // page] at offset lengths[b] % page."""
+    from ray_tpu.ops.paged_attention import paged_decode_attention
+
+    page = cache["k"].shape[3]
+    new_len = jnp.where(active, lengths + 1, lengths)
+    positions = lengths[:, None]
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"].astype(cfg.dtype)[tokens[:, None]]
+    maxp = block_tables.shape[1]
+    num_pages = cache["k"].shape[2]
+    pids = jnp.take_along_axis(
+        block_tables, jnp.minimum(lengths // page, maxp - 1)[:, None],
+        axis=1)[:, 0]  # [B]
+    # Inactive slots must not write: their pages may already belong to
+    # another request — route them OOB so the scatter drops them.
+    pids = jnp.where(active, pids, jnp.int32(num_pages))
+    offs = lengths % page
+
+    def body(carry, inputs):
+        x = carry
+        layer, k_pages, v_pages = inputs
+        normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _qkv(normed, layer, cfg, sin, cos)
+        # k/v [B, 1, KVH, D] → write at [kvh, pids[b], offs[b]].
+        k_pages = k_pages.at[:, pids, offs].set(
+            k[:, 0].swapaxes(0, 1), mode="drop")
+        v_pages = v_pages.at[:, pids, offs].set(
+            v[:, 0].swapaxes(0, 1), mode="drop")
+        out = paged_decode_attention(
+            q[:, 0], k_pages, v_pages, block_tables, new_len,
+            soft_cap=cfg.logits_soft_cap,
+        )  # [B, H*D grouped] → [B, H, D]
+        out = jnp.einsum("bhk,hkd->bd", out,
+                         layer["attn"]["wo"].astype(cfg.dtype))[:, None]
+        h = x + out
+        h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
+        return h, (k_pages, v_pages)
+
+    x, (k_new, v_new) = lax.scan(body, x, (params["layers"], cache["k"],
+                                           cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))
+    return (logits.astype(jnp.float32), {"k": k_new, "v": v_new}, new_len)
+
+
 def decode_step(
     params: Params,
     tokens: jax.Array,

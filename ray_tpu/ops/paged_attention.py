@@ -1,0 +1,184 @@
+"""Paged decode attention — Pallas TPU kernel over a block-table KV cache.
+
+The serving-side attention primitive (no reference counterpart — the
+reference's serve layer runs user torch code; this is the TPU analogue
+of vLLM-style PagedAttention, cf. PAPERS.md ragged paged attention):
+the KV cache lives in fixed-size PAGES owned by a global pool, and each
+sequence maps logical positions to physical pages through a block
+table.  Decode attention then reads exactly the pages a sequence owns —
+memory grows with actual lengths, slots are recycled without copying,
+and long-context batches don't pay O(slots × max_len) bandwidth.
+
+Kernel layout (one q token per sequence, GQA):
+  q            [B, H, D]        → reshaped [B, KVH, qpg, D]
+  k/v pages    [KVH, P, page, D]  (kv-head major: the page block is then
+                                   [page, D], which satisfies the TPU
+                                   (8,128) tiling constraint)
+  block_table  [B, maxp] int32  (physical page per logical page; unused
+                                 entries MUST hold a valid id, e.g. 0)
+  lengths      [B] int32        (tokens already in cache, incl. current)
+
+Grid (B, maxp): the page axis is innermost-sequential with online
+softmax (m, l, acc) in VMEM scratch; every kv head is processed inside
+one program (static unroll) — a per-head grid axis would multiply the
+program count and the launch overhead dominates at decode sizes.
+Block tables + lengths ride the scalar-prefetch channel so the k/v
+BlockSpec index maps can chase the indirection
+(pltpu.PrefetchScalarGridSpec).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_MIN_QPG = 8  # sublane floor: pad the per-kv-head q group to 8 rows
+
+
+def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+            m_scr, l_scr, acc_scr, *, page: int, scale: float,
+            soft_cap: Optional[float], kvh: int, qpg_p: int):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+
+    @pl.when(p * page < length)
+    def _compute():
+        for h in range(kvh):  # static unroll: all kv heads, one program
+            lo, hi = h * qpg_p, (h + 1) * qpg_p
+            q = q_ref[0, h]      # [qpg_p, D]
+            k = k_ref[h, 0]      # [page, D]
+            s = lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale            # [qpg_p, page]
+            if soft_cap is not None:
+                s = soft_cap * jnp.tanh(s / soft_cap)
+            pos = p * page + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(pos < length, s, NEG_INF)
+            m_prev = m_scr[lo:hi]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            probs = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[lo:hi] = (corr * l_scr[lo:hi]
+                            + jnp.sum(probs, axis=-1, keepdims=True))
+            v = v_ref[h, 0]      # [page, D]
+            pv = lax.dot_general(
+                probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_scr[lo:hi] = acc_scr[lo:hi] * corr + pv
+            m_scr[lo:hi] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        for h in range(kvh):
+            lo, hi = h * qpg_p, (h + 1) * qpg_p
+            o_ref[0, h] = (acc_scr[lo:hi] / l_safe[lo:hi]).astype(
+                o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    soft_cap: Optional[float] = None,
+) -> jax.Array:
+    """q [B, H, D], k/v_pages [KVH, P, page, D], block_table [B, maxp],
+    lengths [B] → out [B, H, D]."""
+    B, H, D = q.shape
+    KVH, P, page, _ = k_pages.shape
+    maxp = block_table.shape[1]
+    qpg = H // KVH
+    qpg_p = max(qpg, _MIN_QPG)
+    scale = D ** -0.5
+
+    # [B, KVH, qpg_p, D] with sublane padding for tiny GQA groups.
+    qg = q.reshape(B, KVH, qpg, D)
+    if qpg_p != qpg:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, qpg_p - qpg), (0, 0)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_table, lengths
+        grid=(B, maxp),
+        in_specs=[
+            pl.BlockSpec((1, KVH, qpg_p, D),
+                         lambda b, p, bt, ln: (b, 0, 0, 0)),
+            pl.BlockSpec((KVH, 1, page, D),
+                         lambda b, p, bt, ln: (0, bt[b, p], 0, 0)),
+            pl.BlockSpec((KVH, 1, page, D),
+                         lambda b, p, bt, ln: (0, bt[b, p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, KVH, qpg_p, D),
+                               lambda b, p, bt, ln: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KVH * qpg_p, 1), jnp.float32),
+            pltpu.VMEM((KVH * qpg_p, 1), jnp.float32),
+            pltpu.VMEM((KVH * qpg_p, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, page=page, scale=scale,
+                          soft_cap=soft_cap, kvh=KVH, qpg_p=qpg_p),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, qpg_p, D), q.dtype),
+        interpret=_interpret_mode(),
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out[:, :, :qpg, :].reshape(B, H, D)
+
+
+def paged_decode_attention_reference(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    soft_cap: Optional[float] = None,
+) -> jax.Array:
+    """Dense einsum reference: gather pages into [B, maxp*page, KVH, D]
+    then masked attention — for tests and as the CPU fallback."""
+    B, H, D = q.shape
+    KVH, P, page, _ = k_pages.shape
+    maxp = block_table.shape[1]
+    k = k_pages[:, block_table]  # [KVH, B, maxp, page, D]
+    v = v_pages[:, block_table]
+    k = k.transpose(1, 2, 3, 0, 4).reshape(B, maxp * page, KVH, D)
+    v = v.transpose(1, 2, 3, 0, 4).reshape(B, maxp * page, KVH, D)
+    group = H // KVH
+    kx = jnp.repeat(k, group, axis=2)
+    vx = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                   kx.astype(jnp.float32)) * (D ** -0.5)
+    if soft_cap is not None:
+        s = soft_cap * jnp.tanh(s / soft_cap)
+    ki = jnp.arange(maxp * page)[None, None, :]
+    s = jnp.where(ki < lengths[:, None, None], s, NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", probs, vx.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _interpret_mode() -> bool:
+    return jax.devices()[0].platform == "cpu"

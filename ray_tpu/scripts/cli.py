@@ -161,6 +161,39 @@ def cmd_start(args, out) -> int:
     return 0
 
 
+def cmd_serve(args, out) -> int:
+    """`serve deploy config.yaml` runs the declarative config IN THIS
+    process (starting a runtime if needed) and blocks; `serve status`
+    queries a running head over HTTP (parity: ray serve CLI,
+    serve/scripts.py — deploy/status/shutdown)."""
+    if args.serve_cmd == "deploy":
+        import ray_tpu
+        from ray_tpu.serve import schema as serve_schema
+
+        ray_tpu.init(ignore_reinit_error=True)
+        names = serve_schema.deploy(args.config)
+        print(f"deployed applications: {', '.join(names)}", file=out)
+        if args.block:
+            import signal
+
+            try:
+                signal.pause()
+            except KeyboardInterrupt:
+                pass
+        return 0
+    if args.serve_cmd == "status":
+        data = _get_json(_address(args), "/api/serve/applications")
+        print(json.dumps(data, indent=2), file=out)
+        return 0
+    if args.serve_cmd == "shutdown":
+        from ray_tpu import serve
+
+        serve.shutdown()
+        print("serve shut down", file=out)
+        return 0
+    return 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ray_tpu",
@@ -197,6 +230,15 @@ def build_parser() -> argparse.ArgumentParser:
         jx.add_argument("id")
     jsub.add_parser("list")
 
+    svp = sub.add_parser("serve", help="declarative serve deploy/status")
+    ssub = svp.add_subparsers(dest="serve_cmd", required=True)
+    sd = ssub.add_parser("deploy", help="deploy a YAML/JSON config")
+    sd.add_argument("config")
+    sd.add_argument("--block", action="store_true", default=True)
+    sd.add_argument("--no-block", dest="block", action="store_false")
+    ssub.add_parser("status")
+    ssub.add_parser("shutdown")
+
     spp = sub.add_parser("start", help="start a head in this process")
     spp.add_argument("--head", action="store_true", default=True)
     spp.add_argument("--num-cpus", type=float, default=None)
@@ -213,6 +255,7 @@ _DISPATCH = {
     "timeline": cmd_timeline,
     "memory": cmd_memory,
     "job": cmd_job,
+    "serve": cmd_serve,
     "start": cmd_start,
 }
 

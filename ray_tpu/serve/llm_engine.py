@@ -40,10 +40,16 @@ class EngineConfig:
     min_prefill_bucket: int = 32
     max_new_tokens_default: int = 128
     eos_id: Optional[int] = None
+    # Paged KV cache (block tables over a page pool — TPU PagedAttention,
+    # ops/paged_attention.py).  num_pages=0 sizes the pool for full
+    # occupancy (slots × max_seq_len); smaller pools oversubscribe and
+    # requests queue when no pages are free.
+    page_size: int = 64
+    num_pages: int = 0
     # Decode this many steps per host round-trip (lax.scan on device).
     # Amortizes host↔device latency; tokens past an EOS inside a chunk
     # are discarded host-side.  Chunk sizes used: {1, 4, decode_chunk}.
-    decode_chunk: int = 8
+    decode_chunk: int = 16
 
     def buckets(self) -> List[int]:
         out, b = [], self.min_prefill_bucket
@@ -79,6 +85,39 @@ def llama_adapter(cfg) -> EngineAdapter:
             llama.prefill_slot(params, tokens, true_len, slot, cfg, cache),
         decode_slots=lambda params, tokens, active, cache:
             llama.decode_slots(params, tokens, active, cfg, cache),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedEngineAdapter:
+    """Model plug for the paged (block-table) cache:
+
+    init_cache(num_pages, page_size) -> pytree (no length field; the
+        engine tracks lengths host-side)
+    prefill_slot(params, tokens[S], true_len, pages[S/page], cache)
+        -> (logits[V], cache)
+    decode_slots(params, tokens[slots], active, block_tables, lengths,
+        cache) -> (logits[slots, V], cache, new_lengths)
+    """
+
+    init_cache: Callable[[int, int], Any]
+    prefill_slot: Callable[..., Tuple[jax.Array, Any]]
+    decode_slots: Callable[..., Tuple[jax.Array, Any, jax.Array]]
+
+
+def llama_paged_adapter(cfg) -> PagedEngineAdapter:
+    from ray_tpu.models import llama
+
+    return PagedEngineAdapter(
+        init_cache=lambda num_pages, page: llama.init_paged_cache(
+            cfg, num_pages, page
+        ),
+        prefill_slot=lambda params, tokens, true_len, pages, cache:
+            llama.prefill_slot_paged(params, tokens, true_len, pages,
+                                     cfg, cache),
+        decode_slots=lambda params, tokens, active, bt, lens, cache:
+            llama.decode_slots_paged(params, tokens, active, bt, lens,
+                                     cfg, cache),
     )
 
 
@@ -200,7 +239,21 @@ class LLMEngine:
         self.config = config
         self.adapter = adapter
         self._params = params
-        self._cache = adapter.init_cache(config.max_slots, config.max_seq_len)
+        self._paged = isinstance(adapter, PagedEngineAdapter)
+        if self._paged:
+            page = config.page_size
+            self._maxp = -(-config.max_seq_len // page)
+            self._num_pages = (config.num_pages
+                               or config.max_slots * self._maxp)
+            self._cache = adapter.init_cache(self._num_pages, page)
+            self._free_pages = list(range(self._num_pages))
+            self._slot_pages: Dict[int, List[int]] = {}
+            self._bt = np.zeros((config.max_slots, self._maxp), np.int32)
+            self._lens = np.zeros((config.max_slots,), np.int32)
+            self._backlog: List[Request] = []  # admitted-but-no-pages
+        else:
+            self._cache = adapter.init_cache(config.max_slots,
+                                             config.max_seq_len)
         self._key = jax.random.key(seed)
         self._waiting: "queue.Queue[Request]" = queue.Queue()
         self._slot_req: Dict[int, Request] = {}
@@ -215,13 +268,30 @@ class LLMEngine:
 
         slots = config.max_slots
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def prefill_fn(params, cache, tokens, true_len, slot, temp, key):
-            logits, cache = adapter.prefill_slot(
-                params, tokens, true_len, slot, cache
+        @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+        def prefill_batch_fn(k, params, cache, tokens, true_lens,
+                             slot_or_pages, temps, key):
+            """Prefill k slots in ONE dispatch (k static: {1,2,4,8}).
+            A driver↔device round trip costs ~100 ms on tunneled dev
+            setups, so admission batches prefills instead of paying one
+            RPC per request.  Rows are sequential inside the program
+            (each writes its own slot); padding rows are copies of the
+            last real row — an idempotent rewrite of the same slot with
+            the same values, whose sample is discarded."""
+            keys = jax.random.split(key, k)
+
+            def body(i, carry):
+                cache, toks = carry
+                logits, cache = adapter.prefill_slot(
+                    params, tokens[i], true_lens[i], slot_or_pages[i], cache
+                )
+                tok = _sample(logits[None, :], temps[i][None], keys[i])[0]
+                return cache, toks.at[i].set(tok)
+
+            cache, toks = jax.lax.fori_loop(
+                0, k, body, (cache, jnp.zeros((k,), jnp.int32))
             )
-            tok = _sample(logits[None, :], temp[None], key)[0]
-            return cache, tok
+            return cache, toks
 
         @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
         def decode_fn(n_steps, params, cache, cur, active, temps, key):
@@ -236,8 +306,28 @@ class LLMEngine:
             (cache, _), toks = jax.lax.scan(step, (cache, cur), keys)
             return cache, toks  # [n_steps, slots]
 
-        self._prefill_fn = prefill_fn
-        self._decode_fn = decode_fn
+        @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+        def decode_paged_fn(n_steps, params, cache, cur, active, temps,
+                            key, bt, lens):
+            def step(carry, k):
+                cache, cur, lens = carry
+                logits, cache, lens = adapter.decode_slots(
+                    params, cur, active, bt, lens, cache
+                )
+                toks = _sample(logits, temps, k)
+                toks = jnp.where(active, toks, cur)
+                return (cache, toks, lens), toks
+
+            keys = jax.random.split(key, n_steps)
+            (cache, _, _), toks = jax.lax.scan(
+                step, (cache, cur, lens), keys
+            )
+            return cache, toks
+
+        # One prefill program serves both modes: the adapter closure is
+        # what interprets the third per-row arg (slot id vs page list).
+        self._prefill_batch_fn = prefill_batch_fn
+        self._decode_fn = decode_paged_fn if self._paged else decode_fn
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="llm-engine"
         )
@@ -261,6 +351,17 @@ class LLMEngine:
             stream=queue.Queue(),
             req_id=next(self._req_counter),
         )
+        if self._paged:
+            # Reject requests the page pool can NEVER satisfy — they
+            # would otherwise wedge admission head-of-line forever.
+            need = self._pages_needed(req)
+            if need > self._num_pages:
+                raise ValueError(
+                    f"request needs {need} pages "
+                    f"({len(prompt)}+{req.max_new_tokens} tokens, page "
+                    f"{self.config.page_size}) but the pool has only "
+                    f"{self._num_pages}"
+                )
         self._waiting.put(req)
         self._work.set()
         return CompletionStream(req)
@@ -293,26 +394,134 @@ class LLMEngine:
         raise ValueError(f"prompt length {n} exceeds max bucket")
 
     def _admit(self):
+        if self._paged:
+            return self._admit_paged()
         while self._free_slots:
-            try:
-                req = self._waiting.get_nowait()
-            except queue.Empty:
+            # Pull as many waiting requests as there are free slots and
+            # prefill them in one dispatch (padded to a {1,2,4,8} batch
+            # and to the largest prompt bucket of the group).
+            batch: List[Tuple[Request, int]] = []
+            while self._free_slots and len(batch) < 8:
+                try:
+                    req = self._waiting.get_nowait()
+                except queue.Empty:
+                    break
+                batch.append((req, self._free_slots.pop()))
+            if not batch:
                 return
-            slot = self._free_slots.pop()
-            bucket = self._bucket_for(len(req.prompt))
-            padded = np.zeros((bucket,), np.int32)
-            padded[: len(req.prompt)] = req.prompt
-            self._cache, tok = self._prefill_fn(
-                self._params, self._cache, jnp.asarray(padded),
-                jnp.int32(len(req.prompt)), jnp.int32(slot),
-                jnp.float32(req.temperature), self._next_key(),
+            bucket = max(self._bucket_for(len(r.prompt))
+                         for r, _ in batch)
+            k = 1
+            while k < len(batch):
+                k *= 2
+            tokens = np.zeros((k, bucket), np.int32)
+            true_lens = np.zeros((k,), np.int32)
+            slot_ids = np.zeros((k,), np.int32)
+            temps = np.zeros((k,), np.float32)
+            for i in range(k):
+                req, slot = batch[min(i, len(batch) - 1)]  # pad = row copy
+                tokens[i, : len(req.prompt)] = req.prompt
+                true_lens[i] = len(req.prompt)
+                slot_ids[i] = slot
+                temps[i] = req.temperature
+            self._cache, toks = self._prefill_batch_fn(
+                k, self._params, self._cache, jnp.asarray(tokens),
+                jnp.asarray(true_lens), jnp.asarray(slot_ids),
+                jnp.asarray(temps), self._next_key(),
             )
-            tok = int(jax.device_get(tok))
-            req.first_token_at = time.monotonic()
-            self._emit(req, slot, tok)
-            if slot in self._slot_req:  # not finished after first token
-                self._cur[slot] = tok
-                self._temps[slot] = req.temperature
+            toks = np.asarray(jax.device_get(toks))
+            now = time.monotonic()
+            for i, (req, slot) in enumerate(batch):
+                tok = int(toks[i])
+                req.first_token_at = now
+                self._emit(req, slot, tok)
+                if slot in self._slot_req:  # not finished at first token
+                    self._cur[slot] = tok
+                    self._temps[slot] = req.temperature
+
+    def _pages_needed(self, req: Request) -> int:
+        """Pages covering max(prefill bucket, prompt+max_new)."""
+        page = self.config.page_size
+        bucket = self._paged_bucket_for(len(req.prompt))
+        return min(max(bucket // page,
+                       -(-(len(req.prompt) + req.max_new_tokens) // page)),
+                   self._maxp)
+
+    def _paged_bucket_for(self, n: int) -> int:
+        """Prefill bucket rounded UP to a page multiple: the paged
+        prefill writes whole pages, so a bucket smaller than a page
+        would write NO prompt k/v at all."""
+        page = self.config.page_size
+        b = self._bucket_for(n)
+        return -(-b // page) * page
+
+    def _admit_paged(self):
+        """Admission with page allocation: a request needs pages for
+        max(prefill bucket, prompt+max_new) tokens; when the pool can't
+        cover it the request waits in the backlog (continuous batching
+        under page pressure, the PagedAttention admission rule)."""
+        page = self.config.page_size
+        while self._free_slots:
+            batch: List[Tuple[Request, int]] = []
+            group_bucket = None
+            while self._free_slots and len(batch) < 8:
+                if self._backlog:
+                    req = self._backlog.pop(0)
+                else:
+                    try:
+                        req = self._waiting.get_nowait()
+                    except queue.Empty:
+                        break
+                bucket = self._paged_bucket_for(len(req.prompt))
+                if group_bucket is None:
+                    group_bucket = bucket
+                elif bucket != group_bucket:
+                    # One bucket per compiled prefill group; mismatches
+                    # lead the next group.
+                    self._backlog.append(req)
+                    break
+                need = self._pages_needed(req)
+                if len(self._free_pages) < need:
+                    self._backlog.append(req)  # wait for page frees
+                    break
+                slot = self._free_slots.pop()
+                pages = [self._free_pages.pop() for _ in range(need)]
+                self._slot_pages[slot] = pages
+                row = np.zeros((self._maxp,), np.int32)
+                row[: len(pages)] = pages
+                self._bt[slot] = row
+                batch.append((req, slot))
+            if not batch:
+                return
+            bucket = group_bucket
+            k = 1
+            while k < len(batch):
+                k *= 2
+            tokens = np.zeros((k, bucket), np.int32)
+            true_lens = np.zeros((k,), np.int32)
+            pages_rows = np.zeros((k, bucket // page), np.int32)
+            temps = np.zeros((k,), np.float32)
+            for i in range(k):
+                req, slot = batch[min(i, len(batch) - 1)]  # pad = copy
+                tokens[i, : len(req.prompt)] = req.prompt
+                true_lens[i] = len(req.prompt)
+                pages_rows[i] = self._bt[slot][: bucket // page]
+                temps[i] = req.temperature
+            self._cache, toks = self._prefill_batch_fn(
+                k, self._params, self._cache, jnp.asarray(tokens),
+                jnp.asarray(true_lens), jnp.asarray(pages_rows),
+                jnp.asarray(temps), self._next_key(),
+            )
+            toks = np.asarray(jax.device_get(toks))
+            now = time.monotonic()
+            for i, (req, slot) in enumerate(batch):
+                tok = int(toks[i])
+                req.first_token_at = now
+                self._lens[slot] = len(req.prompt)
+                self._emit(req, slot, tok)
+                if slot in self._slot_req:
+                    self._cur[slot] = tok
+                    self._temps[slot] = req.temperature
 
     def _emit(self, req: Request, slot: int, tok: int):
         """Record one generated token; finish/free the slot if done."""
@@ -330,6 +539,10 @@ class LLMEngine:
             req.stream.put(_DONE)
             del self._slot_req[slot]
             self._free_slots.append(slot)
+            if self._paged:
+                self._free_pages.extend(self._slot_pages.pop(slot, []))
+                self._bt[slot] = 0
+                self._lens[slot] = 0
 
     def _chunk_size(self) -> int:
         """Largest compiled chunk that no active request can out-finish
@@ -349,7 +562,8 @@ class LLMEngine:
 
     def _loop(self):
         while not self._stopped.is_set():
-            if not self._slot_req and self._waiting.empty():
+            backlog = self._paged and self._backlog
+            if not self._slot_req and self._waiting.empty() and not backlog:
                 self._work.wait(timeout=0.05)
                 self._work.clear()
                 continue
@@ -360,11 +574,20 @@ class LLMEngine:
             for slot in self._slot_req:
                 active[slot] = True
             chunk = self._chunk_size()
-            self._cache, toks = self._decode_fn(
-                chunk, self._params, self._cache, jnp.asarray(self._cur),
-                jnp.asarray(active), jnp.asarray(self._temps),
-                self._next_key(),
-            )
+            if self._paged:
+                self._cache, toks = self._decode_fn(
+                    chunk, self._params, self._cache,
+                    jnp.asarray(self._cur), jnp.asarray(active),
+                    jnp.asarray(self._temps), self._next_key(),
+                    jnp.asarray(self._bt), jnp.asarray(self._lens),
+                )
+                self._lens[active] += chunk
+            else:
+                self._cache, toks = self._decode_fn(
+                    chunk, self._params, self._cache,
+                    jnp.asarray(self._cur), jnp.asarray(active),
+                    jnp.asarray(self._temps), self._next_key(),
+                )
             self._steps += chunk
             toks = np.asarray(jax.device_get(toks))  # [chunk, slots]
             for slot, req in list(self._slot_req.items()):
