@@ -22,7 +22,12 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ray_tpu.utils.ids import PlacementGroupID
 
-VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD",
+                    # TPU gang placement: bundles land on a contiguous
+                    # axis-aligned rectangle of one slice's ICI grid
+                    # (nodes labeled ici_coord="x,y"), or stay pending —
+                    # fragmented placements are rejected.
+                    "ICI_CONTIGUOUS")
 
 
 @dataclasses.dataclass

@@ -2334,6 +2334,7 @@ class LocalRuntime:
                         dropped_spec = True
         if dropped_spec:
             self._mark_gcs_dirty()
+        self._retry_pending_pgs()
         self._notify()
 
     # -- placement groups --------------------------------------------------
@@ -2364,6 +2365,19 @@ class LocalRuntime:
         if lifetime == "detached" and name:
             self._mark_gcs_dirty()
         return pg
+
+    def _retry_pending_pgs(self) -> None:
+        """Capacity freed (PG/actor removal): pending placement groups
+        get another shot (parity: GcsPlacementGroupManager retrying on
+        resource updates, not just node adds)."""
+        with self._lock:
+            pending = [s for s in self._pgs.values()
+                       if not s.removed
+                       and any(b.node_id is None for b in s.bundles)]
+        for s in pending:
+            self._reserve_bundles(
+                s, [b for b in s.bundles if b.node_id is None]
+            )
 
     def _reserve_bundles(self, st: _PGState, bundles: List[Bundle]) -> bool:
         """Reserve bundles on nodes per the PG strategy.  All-or-nothing
@@ -2402,6 +2416,7 @@ class LocalRuntime:
                 with b.lock:
                     b.node_id = None
                     b.available = {}
+            reserved.clear()
 
         def place_on(b: Bundle, n: NodeState) -> bool:
             if n.pool.try_acquire(b.resources):
@@ -2411,6 +2426,33 @@ class LocalRuntime:
                 reserved.append((b, n))
                 return True
             return False
+
+        if strategy == "ICI_CONTIGUOUS":
+            # Gang placement on a contiguous axis-aligned sub-grid of
+            # ONE slice's ICI torus (SURVEY.md §7 hard part 4; extends
+            # the reference's bundle policies
+            # raylet/scheduling/policy/bundle_scheduling_policy.h:31-98
+            # with slice topology — the reference only sketches TPU
+            # head resources in _private/accelerator.py:176-191).
+            # Fragmented placements are REJECTED: the group stays
+            # pending until a whole rectangle frees up.  Node death
+            # voids the whole gang (re-reservation re-places every
+            # bundle so adjacency is preserved).
+            requested = {id(b) for b in bundles}
+            voided = [b for b in st.bundles
+                      if b.node_id is not None and id(b) not in requested]
+            if voided:
+                for b in voided:
+                    node = self._nodes.get(b.node_id)
+                    with b.lock:
+                        avail = dict(b.available)
+                        b.available = {}
+                        b.node_id = None
+                    if node is not None and node.alive:
+                        node.pool.release(avail)
+                bundles = list(st.bundles)
+            return self._reserve_ici_contiguous(st, bundles, nodes,
+                                                place_on, rollback)
 
         if strategy in ("PACK", "STRICT_PACK"):
             # Try to land everything on a single node first.
@@ -2456,6 +2498,62 @@ class LocalRuntime:
                 return False
         self._pg_maybe_ready(st)
         return True
+
+    def _reserve_ici_contiguous(self, st: _PGState, bundles: List[Bundle],
+                                nodes: List[NodeState], place_on,
+                                rollback) -> bool:
+        """Place n bundles on an h×w rectangle of ici_coord-labeled
+        nodes within one slice, row-major bundle order (bundle index →
+        mesh position is deterministic, so callers can map coordinates
+        to mesh axes).  All-or-nothing."""
+        n = len(bundles)
+        # Slice name → {(x, y): node}
+        slices: Dict[str, Dict[Tuple[int, int], NodeState]] = {}
+        for node in nodes:
+            coord = node.labels.get("ici_coord")
+            if not coord:
+                continue
+            try:
+                x, y = (int(c) for c in coord.split(","))
+            except ValueError:
+                continue
+            key = node.labels.get("raytpu.io/tpu-slice",
+                                  node.labels.get("raytpu.io/tpu-pod", ""))
+            slices.setdefault(key, {})[(x, y)] = node
+
+        def shapes():
+            # Prefer squares, then squat rectangles (less ICI hop
+            # diameter); 1×n last.
+            out = []
+            for h in range(int(n ** 0.5), 0, -1):
+                if n % h == 0:
+                    out.append((h, n // h))
+                    if h != n // h:
+                        out.append((n // h, h))
+            return out
+
+        for grid in slices.values():
+            if len(grid) < n:
+                continue
+            xs = [c[0] for c in grid]
+            ys = [c[1] for c in grid]
+            for h, w in shapes():
+                for x0 in range(min(xs), max(xs) - h + 2):
+                    for y0 in range(min(ys), max(ys) - w + 2):
+                        cells = [(x0 + i, y0 + j)
+                                 for i in range(h) for j in range(w)]
+                        if any(c not in grid for c in cells):
+                            continue
+                        ok = True
+                        for b, c in zip(bundles, cells):
+                            if not place_on(b, grid[c]):
+                                ok = False
+                                break
+                        if ok:
+                            self._pg_maybe_ready(st)
+                            return True
+                        rollback()
+        return False  # no contiguous window — stays pending
 
     def _pg_maybe_ready(self, st: _PGState):
         if all(b.node_id is not None for b in st.bundles):
@@ -2506,6 +2604,7 @@ class LocalRuntime:
         self.refs.remove_seal_pin(st.ready_oid)
         self.store.release(st.ready_oid, tombstone=True)
         self._mark_gcs_dirty()
+        self._retry_pending_pgs()
         self._notify()
 
     def get_named_placement_group(self, name: str) -> PlacementGroup:

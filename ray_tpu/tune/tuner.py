@@ -49,8 +49,15 @@ class TuneConfig:
 
 @dataclasses.dataclass
 class RunConfig:
+    # Experiment persistence (parity: tune/execution/experiment_state.py
+    # periodic driver snapshots + Tuner.restore).  storage_path=None
+    # disables; else <storage_path>/<name>/experiment_state.pkl is
+    # written atomically on a throttle and a killed-mid-sweep run can
+    # be resumed with Tuner.restore(path, trainable).
     name: str = "experiment"
     stop: Optional[Dict[str, float]] = None  # e.g. {"training_iteration": 10}
+    storage_path: Optional[str] = None
+    snapshot_period_s: float = 1.0
 
 
 @dataclasses.dataclass
@@ -177,7 +184,8 @@ class TuneController:
     """The experiment event loop (parity: tune_controller.py:81)."""
 
     def __init__(self, trainable, param_space: Dict[str, Any],
-                 tune_config: TuneConfig, run_config: RunConfig):
+                 tune_config: TuneConfig, run_config: RunConfig,
+                 restored_trials: Optional[List[Trial]] = None):
         self.trainable = trainable
         self.param_space = param_space
         self.cfg = tune_config
@@ -191,6 +199,56 @@ class TuneController:
         self.trials: List[Trial] = []
         # trial_id -> pending exploit (source_checkpoint, new_config)
         self._exploits: Dict[str, Any] = {}
+        self._restored = restored_trials
+        self._exp_file: Optional[str] = None
+        self._last_snapshot = 0.0
+        if run_config.storage_path:
+            import os
+
+            d = os.path.join(run_config.storage_path, run_config.name)
+            os.makedirs(d, exist_ok=True)
+            self._exp_file = os.path.join(d, "experiment_state.pkl")
+
+    # -- experiment persistence (parity: experiment_state.py) --------------
+
+    def _maybe_snapshot(self, force: bool = False) -> None:
+        if self._exp_file is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_snapshot < \
+                self.run_cfg.snapshot_period_s:
+            return
+        self._last_snapshot = now
+        import os
+        import tempfile
+
+        import cloudpickle as _cp
+
+        rows = [
+            {"trial_id": t.trial_id, "config": t.config,
+             "status": t.status, "results": list(t.results),
+             "error": t.error, "checkpoint": t.checkpoint}
+            for t in self.trials
+        ]
+        blob = _cp.dumps({
+            "version": 1,
+            "trials": rows,
+            "tune_config": self.cfg,
+            "run_config": self.run_cfg,
+            "param_space": self.param_space,
+        })
+        d = os.path.dirname(self._exp_file)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".exp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self._exp_file)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     # -- shared ------------------------------------------------------------
 
@@ -208,11 +266,25 @@ class TuneController:
         return False
 
     def run(self) -> List[Trial]:
-        self._make_trials()
+        if self._restored is not None:
+            self.trials = self._restored
+            # Warm the scheduler's rungs with the finished trials'
+            # history (decisions from the replay are meaningless and
+            # ignored — those trials won't run again).
+            for t in self.trials:
+                if t.status in (TERMINATED, ERROR):
+                    for r in t.results:
+                        try:
+                            self.scheduler.on_result(t, r, self.trials)
+                        except Exception:
+                            pass
+        else:
+            self._make_trials()
         if self.is_class:
             self._run_class_trials()
         else:
             self._run_fn_trials()
+        self._maybe_snapshot(force=True)
         return self.trials
 
     # -- function trainables ----------------------------------------------

@@ -111,6 +111,19 @@ def node_resources_and_labels() -> (Dict[str, float], Dict[str, str]):
     pod = tpu_pod_name()
     worker_id = tpu_worker_id()
     labels["ici_index"] = str(worker_id)
+    # 2-D host coordinate inside the slice, for ICI_CONTIGUOUS gang
+    # placement.  TPU_TOPOLOGY (e.g. "4x4" chips) gives the host grid:
+    # v4/v5p hosts own a 2x2x1 chip block, v5e/v6e hosts a 2x2; a
+    # row-major host index maps onto (hosts_x, hosts_y).  Best-effort —
+    # without topology info, a 1-D coordinate still gives contiguity
+    # along one axis.
+    topo = os.environ.get("TPU_TOPOLOGY", "")
+    try:
+        dims = [int(d) for d in topo.lower().split("x")]
+        hosts_y = max(1, dims[1] // 2) if len(dims) >= 2 else 1
+    except (ValueError, IndexError):
+        hosts_y = 1
+    labels["ici_coord"] = f"{worker_id // hosts_y},{worker_id % hosts_y}"
     if pod:
         labels["raytpu.io/tpu-pod"] = pod
         if worker_id == 0 and version:

@@ -1,0 +1,160 @@
+"""ICI_CONTIGUOUS gang placement over a fake slice topology.
+
+Parity targets: bundle scheduling policies (ray:
+src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h:31-98)
+extended with slice topology — the reference only sketches TPU pod-head
+resources (python/ray/_private/accelerator.py:176-191); contiguity is a
+TPU-first addition (SURVEY.md §7 hard part 4).  A gang either lands on
+a contiguous axis-aligned rectangle of one slice's ICI grid or stays
+pending; fragmented placements are rejected.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api as _api
+from ray_tpu.core.placement_group import placement_group
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1)
+    yield _api.runtime()
+    ray_tpu.shutdown()
+
+
+def _add_grid(rt, w=4, h=4, tpus=4, slice_name="s0"):
+    """Fake w×h host grid (the multi-node trick, cluster_utils style)."""
+    nodes = {}
+    for x in range(w):
+        for y in range(h):
+            nodes[(x, y)] = rt.add_node(
+                {"TPU": float(tpus), "CPU": 1},
+                labels={"ici_coord": f"{x},{y}",
+                        "raytpu.io/tpu-slice": slice_name},
+            )
+    return nodes
+
+
+def _coords_of(rt, pg):
+    st = rt._pgs[pg.id]
+    out = []
+    for b in st.bundles:
+        node = rt._nodes[b.node_id]
+        x, y = (int(c) for c in node.labels["ici_coord"].split(","))
+        out.append((x, y))
+    return out
+
+
+def _is_rect(coords):
+    xs = sorted({c[0] for c in coords})
+    ys = sorted({c[1] for c in coords})
+    grid = {(x, y) for x in xs for y in ys}
+    return (set(coords) == grid
+            and xs == list(range(xs[0], xs[-1] + 1))
+            and ys == list(range(ys[0], ys[-1] + 1))
+            and len(coords) == len(set(coords)))
+
+
+def test_2x2_gang_lands_contiguously(rt):
+    _add_grid(rt)
+    pg = placement_group([{"TPU": 4}] * 4, strategy="ICI_CONTIGUOUS")
+    ray_tpu.get(pg.ready(), timeout=10)
+    coords = _coords_of(rt, pg)
+    assert _is_rect(coords), coords
+    assert len(coords) == 4
+
+
+def test_row_major_bundle_order(rt):
+    """Bundle index → grid position is deterministic (row-major), so
+    callers can map bundle ranks onto mesh coordinates."""
+    _add_grid(rt, w=2, h=2)
+    pg = placement_group([{"TPU": 4}] * 4, strategy="ICI_CONTIGUOUS")
+    ray_tpu.get(pg.ready(), timeout=10)
+    assert _coords_of(rt, pg) == [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+
+def test_fragmented_topology_stays_pending(rt):
+    """Free capacity exists (8 whole nodes!) but no contiguous window:
+    the gang must NOT take a fragmented placement."""
+    nodes = _add_grid(rt)
+    # Checkerboard occupancy: every 2x2 window contains a full node.
+    for (x, y), nid in nodes.items():
+        if (x + y) % 2 == 0:
+            assert rt._nodes[nid].pool.try_acquire({"TPU": 4.0})
+    pg = placement_group([{"TPU": 4}] * 4, strategy="ICI_CONTIGUOUS")
+    time.sleep(0.3)
+    st = rt._pgs[pg.id]
+    assert any(b.node_id is None for b in st.bundles), \
+        "fragmented placement was accepted"
+    assert not rt.store.contains(st.ready_oid)
+
+
+def test_pending_gang_places_after_defrag(rt):
+    """Freeing a window lets the retry (node/capacity event) place the
+    whole gang."""
+    nodes = _add_grid(rt)
+    # Occupy everything.
+    for nid in nodes.values():
+        assert rt._nodes[nid].pool.try_acquire({"TPU": 4.0})
+    pg = placement_group([{"TPU": 4}] * 4, strategy="ICI_CONTIGUOUS")
+    time.sleep(0.2)
+    assert not rt.store.contains(rt._pgs[pg.id].ready_oid)
+    # Free a 2x2 window.
+    for c in [(1, 1), (1, 2), (2, 1), (2, 2)]:
+        rt._nodes[nodes[c]].pool.release({"TPU": 4.0})
+    # PG retry rides node/capacity events; poke via add_node of a dud.
+    rt.add_node({"CPU": 0.001})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if rt.store.contains(rt._pgs[pg.id].ready_oid):
+            break
+        time.sleep(0.1)
+    assert rt.store.contains(rt._pgs[pg.id].ready_oid)
+    coords = _coords_of(rt, pg)
+    assert sorted(coords) == [(1, 1), (1, 2), (2, 1), (2, 2)]
+
+
+def test_single_slice_constraint(rt):
+    """A gang never straddles slices even when a cross-slice rectangle
+    would exist geometrically."""
+    _add_grid(rt, w=1, h=2, slice_name="s0")
+    _add_grid(rt, w=1, h=2, slice_name="s1")
+    pg = placement_group([{"TPU": 4}] * 4, strategy="ICI_CONTIGUOUS")
+    time.sleep(0.3)
+    st = rt._pgs[pg.id]
+    assert any(b.node_id is None for b in st.bundles), \
+        "gang straddled two slices"
+
+
+def test_node_death_revokes_whole_gang(rt):
+    """Losing one member voids the gang; re-reservation re-places ALL
+    bundles contiguously (adjacency can't be patched per-bundle)."""
+    nodes = _add_grid(rt)
+    pg = placement_group([{"TPU": 4}] * 4, strategy="ICI_CONTIGUOUS")
+    ray_tpu.get(pg.ready(), timeout=10)
+    victim_coord = _coords_of(rt, pg)[0]
+    rt.kill_node(nodes[victim_coord])
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        st = rt._pgs[pg.id]
+        if all(b.node_id is not None for b in st.bundles):
+            coords = _coords_of(rt, pg)
+            if victim_coord not in coords:
+                break
+        time.sleep(0.1)
+    coords = _coords_of(rt, pg)
+    assert victim_coord not in coords
+    assert _is_rect(coords), coords
+
+
+def test_1d_shapes_allowed(rt):
+    _add_grid(rt, w=4, h=1)
+    pg = placement_group([{"TPU": 4}] * 3, strategy="ICI_CONTIGUOUS")
+    ray_tpu.get(pg.ready(), timeout=10)
+    coords = _coords_of(rt, pg)
+    xs = sorted(c[0] for c in coords)
+    assert xs == list(range(xs[0], xs[0] + 3))
