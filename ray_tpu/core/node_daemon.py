@@ -1,0 +1,813 @@
+"""Per-node daemon + head-side node server: the multi-host runtime.
+
+Parity: the reference's raylet/GCS split — a head process hosts the
+control plane (here the existing ``LocalRuntime``) and every other
+machine runs a node daemon that registers over TCP and then owns a
+local worker pool, shared-memory arena, and spill directory (ray:
+src/ray/raylet/main.cc:81 raylet startup, gcs/gcs_server/gcs_server.h:79
+node registration, protobuf/node_manager.proto:363 the raylet RPC
+surface).  Scheduling stays centralized at the head (one cluster view);
+dispatch to a remote node rides the daemon's channel, and the object
+plane does chunked node-to-node pulls with owner-recorded locations
+(src/ray/object_manager/object_manager.h:117, pull_manager.h:52,
+push_manager.h:30, ownership_based_object_directory.cc).
+
+Wire security matches client mode: set ``RAYTPU_CLUSTER_TOKEN`` and
+every join/peer connection must pass the HMAC challenge before the
+first pickle frame is parsed (frames are cloudpickle — the trust model
+is the reference's: anyone who can speak the protocol owns the
+cluster).
+
+Start a head:     ``ray_tpu start --head --port 6380``
+Join a machine:   ``ray_tpu start --address HOST:6380``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.wire import ChannelClosedError, MsgChannel
+from ray_tpu.utils.ids import JobID, NodeID, ObjectID
+
+PULL_CHUNK = 8 << 20  # 8 MiB per pull RPC (chunked object transfer)
+
+
+def _cluster_token(token: Optional[str]) -> Optional[str]:
+    return (token if token is not None
+            else os.environ.get("RAYTPU_CLUSTER_TOKEN"))
+
+
+def _pull_bytes(call, oid_bin: bytes, size: int) -> bytes:
+    """Client side of the chunked pull protocol: fetch ``size`` framed
+    bytes of one object through ``call`` (a channel-call closure)."""
+    if size <= PULL_CHUNK:
+        data = call("pull", oid=oid_bin, off=0, len=size)
+        if len(data) != size:
+            raise OSError(f"truncated pull: {len(data)}/{size}")
+        return data
+    parts = []
+    off = 0
+    while off < size:
+        chunk = call("pull", oid=oid_bin, off=off,
+                     len=min(PULL_CHUNK, size - off))
+        if not chunk:
+            raise OSError(f"truncated pull at {off}/{size}")
+        parts.append(chunk)
+        off += len(chunk)
+    return b"".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Head side
+# ---------------------------------------------------------------------------
+
+
+class RemoteWorkerHandle:
+    """Head-side handle for one worker process living on a remote node
+    daemon — the same lease/call/terminate surface as
+    ``worker_pool.WorkerHandle`` so tasks and actor shells dispatch
+    identically to local and remote workers."""
+
+    def __init__(self, agent: "RemoteNodeAgent", wid: str, key: str,
+                 pid: int):
+        self.agent = agent
+        self.wid = wid
+        self.ref_key = key      # borrower identity at the head
+        self.pid = pid
+        self.node_hex = agent.node_hex
+        self.dead = False
+        self.dedicated = False
+        self.on_death = None
+        # chan attr parity with WorkerHandle (some callers key on it).
+        self.chan = agent.chan
+
+    def call(self, op: str, rpc_timeout: Optional[float] = None,
+             **payload):
+        from ray_tpu.core.exceptions import WorkerDiedError
+
+        try:
+            return self.agent.chan.call(
+                "wcall", rpc_timeout=rpc_timeout,
+                wid=self.wid, wop=op, pl=payload,
+            )
+        except ChannelClosedError as e:
+            # The daemon itself died: every worker it hosted is gone.
+            self.dead = True
+            raise WorkerDiedError(
+                f"node {self.node_hex[:12]} daemon died: {e}") from None
+        except WorkerDiedError:
+            self.dead = True
+            raise
+
+    def terminate(self, graceful: bool = True) -> None:
+        self.dead = True
+        self.agent.chan.cast("kill_worker", wid=self.wid,
+                             graceful=graceful)
+        self.agent._forget(self.wid)
+
+
+class RemoteNodeAgent:
+    """Head-side handle for one joined node daemon: leases workers,
+    pulls objects, frees remote copies (parity: the raylet client the
+    GCS/owner holds per node)."""
+
+    def __init__(self, chan: MsgChannel, node_hex: str):
+        self.chan = chan
+        self.node_hex = node_hex
+        self._rt = None
+        self._node = None
+        self._lock = threading.Lock()
+        self._leased: Dict[str, RemoteWorkerHandle] = {}
+        self._closed = False
+
+    def bind(self, rt, node) -> None:
+        self._rt = rt
+        self._node = node
+
+    # -- worker leasing (same surface as WorkerPool) -----------------------
+
+    def lease(self, dedicated: bool = False) -> RemoteWorkerHandle:
+        rep = self.chan.call("lease", dedicated=dedicated)
+        wh = RemoteWorkerHandle(self, rep["wid"], rep["key"], rep["pid"])
+        wh.dedicated = dedicated
+        with self._lock:
+            self._leased[wh.wid] = wh
+        return wh
+
+    def release(self, wh: RemoteWorkerHandle) -> None:
+        self._forget(wh.wid)
+        if not wh.dead and not wh.dedicated:
+            self.chan.cast("release_worker", wid=wh.wid)
+
+    def _forget(self, wid: str) -> None:
+        with self._lock:
+            self._leased.pop(wid, None)
+
+    def worker_gone(self, wid: str) -> None:
+        """Daemon reported one of its workers died."""
+        with self._lock:
+            wh = self._leased.pop(wid, None)
+        if wh is not None:
+            wh.dead = True
+            cb = wh.on_death
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    pass
+
+    # -- object plane ------------------------------------------------------
+
+    def pull(self, oid: ObjectID, size: int) -> bytes:
+        return _pull_bytes(self.chan.call, oid.binary(), size)
+
+    def free(self, oid_bins: List[bytes]) -> None:
+        self.chan.cast("free", oids=oid_bins)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return self.chan.call("stats")
+
+    def shutdown_daemon(self) -> None:
+        self._closed = True
+        self.chan.cast("shutdown")
+        self.chan.close()
+
+    def close(self) -> None:
+        self._closed = True
+        self.chan.close()
+        # Every leased worker died with the daemon.
+        with self._lock:
+            leased = list(self._leased.values())
+            self._leased.clear()
+        for wh in leased:
+            wh.dead = True
+            cb = wh.on_death
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    pass
+
+
+class NodeServer:
+    """The head's TCP join endpoint: node daemons register here and
+    stay connected for their lifetime (parity: GcsServer's node
+    registration + the per-node raylet channel)."""
+
+    def __init__(self, runtime, host: Optional[str] = None, port: int = 0,
+                 token: Optional[str] = None):
+        self._rt = runtime
+        self._token = token
+        if host is None:
+            # Non-loopback binds require the HMAC token — frames are
+            # cloudpickle, so an open port is arbitrary code execution
+            # (same rule as client mode's TRUST BOUNDARY note).
+            host = ("0.0.0.0" if _cluster_token(token) else "127.0.0.1")
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._closed = False
+        threading.Thread(target=self._accept_loop, name="node-accept",
+                         daemon=True).start()
+        from ray_tpu.utils.config import get_config
+
+        if get_config().health_check_period_s > 0:
+            threading.Thread(target=self._health_loop, daemon=True,
+                             name="node-health").start()
+
+    @property
+    def address(self) -> str:
+        return f"{socket.gethostname()}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, peer = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._register, args=(conn, peer),
+                             daemon=True, name="node-register").start()
+
+    def _register(self, conn: socket.socket, peer) -> None:
+        from ray_tpu.util.client.common import (
+            recv_msg,
+            send_msg,
+            server_handshake,
+        )
+
+        token = (self._token if self._token is not None
+                 else os.environ.get("RAYTPU_CLUSTER_TOKEN"))
+        conn.settimeout(10.0)
+        try:
+            if not server_handshake(conn, token or None):
+                conn.close()
+                return
+            hello = recv_msg(conn)
+            if hello.get("op") != "register":
+                conn.close()
+                return
+            conn.settimeout(None)
+        except Exception:
+            conn.close()
+            return
+        rt = self._rt
+
+        def handler(chan, msg):
+            return self._handle(agent, chan, msg)
+
+        chan = MsgChannel(conn, handler, name=f"node-{peer[0]}")
+        agent = RemoteNodeAgent(chan, "")
+        # Register BEFORE welcome: the daemon's first forwarded op must
+        # find the node present.
+        addr = hello.get("addr") or (peer[0], 0)
+        # The daemon advertises a port; trust the observed source host
+        # over a default advertise host (NAT-less clusters).
+        if addr[0] in ("", "0.0.0.0"):
+            addr = (peer[0], addr[1])
+        node_id = rt.register_remote_node(
+            agent, hello["resources"], hello.get("labels"), addr
+        )
+        agent.node_hex = node_id.hex()
+        chan.on_close = lambda: self._node_lost(node_id)
+        from ray_tpu.utils.config import get_config
+
+        try:
+            send_msg(conn, {
+                "ok": True,
+                "node_id": node_id.binary(),
+                "job_id": rt.job_id.hex(),
+                "config": get_config().snapshot(),
+                "sys_path": list(sys.path),
+                "cwd": os.getcwd(),
+            })
+        except Exception:
+            chan.close()
+            rt.kill_node(node_id)
+            return
+        chan.start()
+
+    def _node_lost(self, node_id: NodeID) -> None:
+        if not self._closed:
+            self._rt.kill_node(node_id)
+
+    def _handle(self, agent: RemoteNodeAgent, chan: MsgChannel,
+                msg: Dict[str, Any]) -> Any:
+        """Daemon → head ops: forwarded worker control ops (with the
+        worker's borrower key) plus daemon-specific notifications."""
+        from ray_tpu.core.worker_pool import handle_control_op
+
+        op = msg["op"]
+        if op == "worker_gone":
+            self._rt.refs.drop_worker(msg["wkey"])
+            agent.worker_gone(msg.get("wid", ""))
+            return None
+        if op == "heartbeat":
+            return time.time()
+        key = msg.get("wkey") or f"{agent.node_hex[:12]}/daemon"
+        return handle_control_op(self._rt, key, msg,
+                                 node_hex=agent.node_hex)
+
+    def _health_loop(self) -> None:
+        from ray_tpu.utils.config import get_config
+
+        cfg = get_config()
+        period = cfg.health_check_period_s
+        window = period * max(1, cfg.health_check_failure_threshold)
+        while not self._closed:
+            time.sleep(period)
+            with self._rt._lock:
+                agents = [n.agent for n in self._rt._nodes.values()
+                          if n.alive and n.agent is not None]
+            for agent in agents:
+                threading.Thread(target=self._probe, args=(agent, window),
+                                 daemon=True, name="node-probe").start()
+
+    def _probe(self, agent: RemoteNodeAgent, window: float) -> None:
+        try:
+            agent.chan.call("ping", rpc_timeout=window)
+        except TimeoutError:
+            # Unresponsive for the whole window → declare the node dead
+            # (parity: GcsHealthCheckManager failure_threshold).
+            agent.chan.close()  # on_close → kill_node
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Daemon side
+# ---------------------------------------------------------------------------
+
+
+class _ForwardRefs:
+    """Daemon-side stand-in for the runtime's ReferenceCounter: worker
+    death forwards the borrower-drop to the head (which owns all
+    refcounts).  Keys arrive unprefixed from WorkerHandle._on_close;
+    the node prefix is added here so they match what this daemon
+    attached to forwarded ops."""
+
+    def __init__(self, daemon: "NodeDaemon"):
+        self._daemon = daemon
+
+    def drop_worker(self, wkey: str) -> None:
+        self._daemon.head.cast(
+            "worker_gone", wkey=self._daemon._key_prefix + wkey, wid="")
+
+
+class _DaemonRT:
+    """The minimal runtime surface DaemonWorkerPool needs."""
+
+    def __init__(self, daemon: "NodeDaemon", store, job_id: JobID):
+        self._daemon = daemon
+        self.store = store
+        self.job_id = job_id
+        self.refs = _ForwardRefs(daemon)
+
+
+def make_daemon_pool(daemon: "NodeDaemon", rt_shim: "_DaemonRT"):
+    """A WorkerPool (same spawn/registration/health machinery) whose
+    worker ops route to the daemon: control-plane ops forward to the
+    head with the worker's borrower key; object-plane ops serve from
+    the daemon's local store, pulling remote copies on miss."""
+    from ray_tpu.core.worker_pool import WorkerPool
+
+    class _Pool(WorkerPool):
+        def _handle(self, chan, msg):
+            return daemon.handle_worker_op(chan, msg)
+
+    return _Pool(rt_shim)
+
+
+class NodeDaemon:
+    """One machine's membership in the cluster: local worker pool +
+    local object plane, a channel to the head, and a peer server for
+    node-to-node object pulls."""
+
+    def __init__(self, head_addr: Tuple[str, int], *,
+                 resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None,
+                 peer_port: int = 0,
+                 advertise_host: str = "",
+                 token: Optional[str] = None):
+        self._token = _cluster_token(token)
+        self._exit = threading.Event()
+        # Peer listener FIRST (its port goes into the register frame).
+        # Loopback unless the cluster token authenticates peers (same
+        # trust rule as the head's join port).
+        self._peer_listener = socket.socket(socket.AF_INET,
+                                            socket.SOCK_STREAM)
+        self._peer_listener.setsockopt(socket.SOL_SOCKET,
+                                       socket.SO_REUSEADDR, 1)
+        self._peer_listener.bind(
+            ("0.0.0.0" if self._token else "127.0.0.1", peer_port))
+        self._peer_listener.listen(64)
+        self.peer_port = self._peer_listener.getsockname()[1]
+
+        # Join the head.
+        from ray_tpu.util.client.common import (
+            client_handshake,
+            recv_msg,
+            send_msg,
+        )
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(15.0)
+        sock.connect(head_addr)
+        client_handshake(sock, self._token or None)
+        send_msg(sock, {
+            "op": "register",
+            "resources": resources,
+            "labels": labels or {},
+            "addr": (advertise_host, self.peer_port),
+            "pid": os.getpid(),
+        })
+        welcome = recv_msg(sock)
+        if not welcome.get("ok"):
+            raise ConnectionError(f"head rejected registration: {welcome}")
+        sock.settimeout(None)
+        self.node_id = NodeID(welcome["node_id"])
+        self.node_hex = self.node_id.hex()
+        self._key_prefix = self.node_hex[:12] + "/"
+        self.job_id = JobID(bytes.fromhex(welcome["job_id"]))
+        # Head config first, so store caps / thresholds match the
+        # cluster; local env overrides still win (utils/config.py
+        # priority: env > snapshot).
+        from ray_tpu.utils.config import get_config
+
+        try:
+            get_config().update(welcome.get("config") or {})
+        except Exception:
+            pass
+        for p in welcome.get("sys_path") or []:
+            if p not in sys.path:
+                sys.path.append(p)
+        try:
+            if welcome.get("cwd"):
+                os.chdir(welcome["cwd"])
+        except OSError:
+            pass
+
+        # Local object plane: own arena + spill dir (parity: per-node
+        # plasma + LocalObjectManager).
+        from ray_tpu.core.store import LocalObjectStore
+
+        self.store = LocalObjectStore()
+        self._pulls: Dict[bytes, threading.Event] = {}
+        self._pull_lock = threading.Lock()
+        self._peer_chans: Dict[Tuple[str, int], MsgChannel] = {}
+        self._peer_lock = threading.Lock()
+
+        # Head channel (wrapped AFTER registration).
+        self.head = MsgChannel(sock, self._handle_head_op, name="head",
+                               on_close=self._on_head_lost)
+        # Local worker pool (spawns ray_tpu.core.worker_main processes
+        # that attach THIS daemon's arena).
+        self._rt_shim = _DaemonRT(self, self.store, self.job_id)
+        self.pool = make_daemon_pool(self, self._rt_shim)
+        self.head.start()
+        threading.Thread(target=self._peer_accept_loop, daemon=True,
+                         name="peer-accept").start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _on_head_lost(self) -> None:
+        # Head gone → this node has no cluster; terminate everything.
+        self._exit.set()
+
+    def wait(self) -> None:
+        self._exit.wait()
+
+    def shutdown(self) -> None:
+        self._exit.set()
+        try:
+            self.pool.shutdown()
+        except Exception:
+            pass
+        try:
+            self._peer_listener.close()
+        except OSError:
+            pass
+        with self._peer_lock:
+            chans = list(self._peer_chans.values())
+            self._peer_chans.clear()
+        for ch in chans:
+            ch.close()
+        self.head.close()
+        self.store.close()
+
+    # -- head → daemon ops -------------------------------------------------
+
+    def _handle_head_op(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:
+        op = msg["op"]
+        if op == "lease":
+            wh = self.pool.lease(dedicated=msg.get("dedicated", False))
+            self._hook_death(wh)
+            return {"wid": wh.wid, "key": self._worker_key(wh),
+                    "pid": wh.pid}
+        if op == "release_worker":
+            wh = self.pool._all.get(msg["wid"])
+            if wh is not None:
+                wh.dedicated = False
+                self.pool.release(wh)
+            return None
+        if op == "wcall":
+            wh = self.pool._all.get(msg["wid"])
+            if wh is None or wh.dead:
+                from ray_tpu.core.exceptions import WorkerDiedError
+
+                raise WorkerDiedError(f"worker {msg['wid'][:8]} is gone")
+            pl = msg.get("pl") or {}
+            rep = wh.call(msg["wop"], **pl)
+            # Result values the worker wrote into THIS node's arena must
+            # enter the local store index (the authority for serving
+            # peer pulls / local get_raw) before the head records their
+            # location here.
+            if isinstance(rep, dict) and rep.get("results"):
+                for oid_bin, (kind, payload) in zip(pl.get("returns") or (),
+                                                    rep["results"]):
+                    if kind == "shm":
+                        self.store.mark_shm_sealed(ObjectID(oid_bin),
+                                                   payload)
+            return rep
+        if op == "kill_worker":
+            wh = self.pool._all.get(msg["wid"])
+            if wh is not None:
+                wh.terminate(graceful=msg.get("graceful", True))
+            return None
+        if op == "free":
+            for b in msg["oids"]:
+                self.store.release(ObjectID(b))
+            return None
+        if op == "pull":
+            return self.store.read_range(ObjectID(msg["oid"]), msg["off"],
+                                         msg["len"])
+        if op == "stats":
+            st = self.pool.stats()
+            st["store"] = self.store.stats()
+            return st
+        if op == "ping":
+            return "pong"
+        if op == "shutdown":
+            self._exit.set()
+            return None
+        raise ValueError(f"unknown head op {op!r}")
+
+    def _worker_key(self, wh) -> str:
+        from ray_tpu.core.worker_pool import _wkey
+
+        return self._key_prefix + _wkey(wh.chan)
+
+    def _hook_death(self, wh) -> None:
+        if wh.on_death is None:
+            key = self._worker_key(wh)
+
+            def died():
+                self.head.cast("worker_gone", wkey=key, wid=wh.wid)
+
+            wh.on_death = died
+
+    # -- worker → daemon ops -----------------------------------------------
+
+    _LOCAL_STORE_OPS = frozenset({"get_raw"})
+
+    def handle_worker_op(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:
+        op = msg["op"]
+        if op == "ping":
+            return "pong"
+        if op == "get_raw":
+            return self._get_raw(msg)
+        if op == "mark_shm":
+            # Worker sealed bytes into THIS node's arena: track them in
+            # the local store, then tell the head where they live.
+            oid = ObjectID(msg["oid"])
+            self.store.mark_shm_sealed(oid, msg["size"])
+            return self._forward(chan, msg)
+        if op == "seal_value":
+            kind, payload = msg["entry"]
+            if kind == "shm":
+                self.store.mark_shm_sealed(ObjectID(msg["oid"]), payload)
+            return self._forward(chan, msg)
+        # Everything else is control-plane: forward to the head with
+        # this worker's borrower key attached.
+        return self._forward(chan, msg)
+
+    def _forward(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:
+        payload = {k: v for k, v in msg.items()
+                   if k not in ("mid", "kind", "op")}
+        from ray_tpu.core.worker_pool import _wkey
+
+        payload["wkey"] = self._key_prefix + _wkey(chan)
+        return self.head.call(msg["op"], **payload)
+
+    def _get_raw(self, msg: Dict[str, Any]) -> List[Tuple[str, Any]]:
+        no_shm = bool(msg.get("no_shm"))
+        entries = []
+        for b in msg["oids"]:
+            entries.append(self._fetch_entry(b, msg.get("timeout"), no_shm))
+        return entries
+
+    def _fetch_entry(self, oid_bin: bytes, timeout: Optional[float],
+                     no_shm: bool) -> Tuple[str, Any]:
+        """One object's wire entry for a local worker: local store hit,
+        else resolve the location at the head and pull the bytes into
+        the local arena (dedup'd across concurrent pulls — parity:
+        pull_manager.h in-flight dedup)."""
+        oid = ObjectID(oid_bin)
+        for attempt in range(5):
+            if self.store.contains(oid):
+                try:
+                    entry = self.store.get_wire(oid, timeout)
+                except Exception:
+                    break  # fall through to head resolution
+                return self._maybe_inline(oid_bin, entry, no_shm)
+            # In-flight pull?  Wait for it instead of double-pulling.
+            with self._pull_lock:
+                ev = self._pulls.get(oid_bin)
+            if ev is not None:
+                ev.wait(300.0)
+                continue
+            (entry,) = self.head.call("get_wire", oids=[oid_bin],
+                                      timeout=timeout)
+            kind = entry[0]
+            if kind in ("b", "err"):
+                return entry
+            if kind == "shm":
+                # Head materialized it locally after all (race with a
+                # concurrent local reader at the head) — re-ask as
+                # bytes via a pull from the head.
+                entry = ("at", ("", None, entry[1]))
+            node_hex, addr, size = entry[1]
+            if node_hex == self.node_hex:
+                # Head thinks it's here but the local copy is gone
+                # (arena eviction): report and retry — the head
+                # invalidates + reconstructs.
+                self.head.call("report_lost", oid=oid_bin)
+                time.sleep(0.2 * (attempt + 1))
+                continue
+            try:
+                self._pull_into_store(oid_bin, node_hex, addr, size)
+            except Exception:
+                # Source vanished mid-pull (node death): tell the head
+                # and retry; reconstruction reseals elsewhere.
+                time.sleep(0.2 * (attempt + 1))
+                continue
+        # Give the head one final authoritative try (it may have an
+        # error sealed by now, which is the right thing to raise).
+        (entry,) = self.head.call("get_wire", oids=[oid_bin],
+                                  timeout=timeout)
+        if entry[0] in ("b", "err"):
+            return entry
+        raise OSError(f"object {oid.hex()}: unfetchable after retries")
+
+    def _maybe_inline(self, oid_bin: bytes, entry, no_shm: bool):
+        if no_shm and entry[0] == "shm":
+            shm = self.store._shm_store()
+            return ("b", shm.get_bytes(oid_bin))
+        return entry
+
+    def _pull_into_store(self, oid_bin: bytes, node_hex: str,
+                         addr, size: int) -> None:
+        with self._pull_lock:
+            if self._pulls.get(oid_bin) is not None:
+                return  # racer started it; caller loops and waits
+            ev = self._pulls[oid_bin] = threading.Event()
+        try:
+            if node_hex == "" or addr is None:
+                data = _pull_bytes(self.head.call, oid_bin, size)
+            else:
+                peer = self._peer_channel(tuple(addr))
+                data = _pull_bytes(peer.call, oid_bin, size)
+            self.store.put_serialized(ObjectID(oid_bin), data)
+        finally:
+            with self._pull_lock:
+                self._pulls.pop(oid_bin, None)
+            ev.set()
+
+    # -- peer plane --------------------------------------------------------
+
+    def _peer_channel(self, addr: Tuple[str, int]) -> MsgChannel:
+        from ray_tpu.util.client.common import client_handshake
+
+        with self._peer_lock:
+            ch = self._peer_chans.get(addr)
+            if ch is not None and not ch.closed:
+                return ch
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(10.0)
+        sock.connect(addr)
+        client_handshake(sock, self._token or None)
+        sock.settimeout(None)
+        ch = MsgChannel(sock, self._handle_peer_op,
+                        name=f"peer-{addr[0]}:{addr[1]}").start()
+        with self._peer_lock:
+            old = self._peer_chans.get(addr)
+            if old is not None and not old.closed:
+                ch.close()
+                return old
+            self._peer_chans[addr] = ch
+        return ch
+
+    def _peer_accept_loop(self) -> None:
+        from ray_tpu.util.client.common import server_handshake
+
+        while not self._exit.is_set():
+            try:
+                conn, peer = self._peer_listener.accept()
+            except OSError:
+                return
+
+            def serve(conn=conn, peer=peer):
+                conn.settimeout(10.0)
+                if not server_handshake(conn, self._token or None):
+                    conn.close()
+                    return
+                conn.settimeout(None)
+                MsgChannel(conn, self._handle_peer_op,
+                           name=f"peer-in-{peer[0]}").start()
+
+            threading.Thread(target=serve, daemon=True,
+                             name="peer-serve").start()
+
+    def _handle_peer_op(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:
+        op = msg["op"]
+        if op == "pull":
+            return self.store.read_range(ObjectID(msg["oid"]), msg["off"],
+                                         msg["len"])
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown peer op {op!r}")
+
+
+# ---------------------------------------------------------------------------
+# Daemon process entry point
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ray_tpu.core.node_daemon",
+        description="join a ray_tpu cluster as a worker node",
+    )
+    ap.add_argument("--address", required=True,
+                    help="head node address HOST:PORT")
+    ap.add_argument("--num-cpus", type=float, default=None)
+    ap.add_argument("--num-tpus", type=float, default=None)
+    ap.add_argument("--resources", default="{}",
+                    help="extra resources as JSON")
+    ap.add_argument("--labels", default="{}", help="node labels as JSON")
+    ap.add_argument("--port", type=int, default=0,
+                    help="peer object-transfer port (0 = ephemeral)")
+    ap.add_argument("--advertise-host", default="",
+                    help="address other nodes reach this machine at")
+    args = ap.parse_args(argv)
+
+    host, _, port = args.address.rpartition(":")
+    resources = dict(json.loads(args.resources))
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    elif "CPU" not in resources:
+        resources["CPU"] = float(os.cpu_count() or 8)
+    labels = dict(json.loads(args.labels))
+    if args.num_tpus is not None and args.num_tpus > 0:
+        resources["TPU"] = float(args.num_tpus)
+    elif "TPU" not in resources:
+        # Chip detection is opt-in for daemons: on a shared test
+        # machine the chip belongs to the head process.
+        pass
+    resources.setdefault("memory", 16 * 1024**3)
+
+    daemon = NodeDaemon(
+        (host or "127.0.0.1", int(port)),
+        resources=resources, labels=labels,
+        peer_port=args.port, advertise_host=args.advertise_host,
+    )
+    print(f"[ray_tpu node {daemon.node_hex[:12]}] joined "
+          f"{args.address}; peer port {daemon.peer_port}",
+          flush=True)
+    try:
+        daemon.wait()
+    except KeyboardInterrupt:
+        pass
+    daemon.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

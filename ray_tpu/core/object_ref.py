@@ -120,13 +120,19 @@ class ObjectState:
     """Store-side bookkeeping for one object (local runtime)."""
 
     __slots__ = ("event", "value_bytes", "error", "in_band", "in_shm",
-                 "shm_size", "spilled_uri", "last_access", "lost")
+                 "shm_size", "spilled_uri", "last_access", "lost",
+                 "remote_node")
 
     def __init__(self):
         self.event = threading.Event()
         self.value_bytes: Optional[bytes] = None
         self.error: Optional[BaseException] = None
         self.in_band: Any = None
+        # Primary copy lives in a remote node daemon's arena (hex node
+        # id); the bytes are fetched over the wire on first local read
+        # (parity: the object directory's remote-location entries,
+        # ownership_based_object_directory.cc).
+        self.remote_node: Optional[str] = None
         # True after invalidate(): the primary copy was lost and a
         # reader should trigger lineage reconstruction (lazy, parity:
         # ObjectRecoveryManager recovers on fetch, not on node death).

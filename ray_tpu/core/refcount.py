@@ -162,6 +162,23 @@ class ReferenceCounter:
         for oid in freed:
             self._enqueue_free(oid)
 
+    def drop_worker_prefix(self, prefix: str) -> None:
+        """All borrower keys starting with ``prefix`` evaporate — used
+        when a node daemon dies and takes every worker it hosted with
+        it (their keys are namespaced under the node id)."""
+        freed = []
+        with self._lock:
+            for oid in list(self._borrows):
+                per = self._borrows[oid]
+                for k in [k for k in per if k.startswith(prefix)]:
+                    per.pop(k, None)
+                if not per:
+                    del self._borrows[oid]
+                    if self._is_zero_locked(oid):
+                        freed.append(oid)
+        for oid in freed:
+            self._enqueue_free(oid)
+
     def add_nested(self, outer: ObjectID, inners: List[ObjectID]) -> None:
         """``outer``'s sealed bytes contain refs to ``inners`` — pin
         them until outer is freed."""

@@ -201,6 +201,13 @@ class NodeState:
         self.labels = dict(labels or {})
         self.alive = True
         self.actor_ids: set = set()
+        # Remote node daemon handle (ray_tpu.core.node_daemon
+        # RemoteNodeAgent) — None for the head's local node and for
+        # logical test nodes.  When set, tasks/actors allocated here
+        # dispatch over the daemon's channel to ITS worker pool, and
+        # the daemon's object-plane address is ``addr``.
+        self.agent = None
+        self.addr: Optional[Tuple[str, int]] = None
 
     def matches_labels(self, required: Dict[str, str]) -> bool:
         return all(self.labels.get(k) == v for k, v in required.items())
@@ -741,7 +748,7 @@ class _ProcessActorShell(_ActorShell):
     def _construct(self):
         import cloudpickle as _cp
 
-        pool = self.runtime.worker_pool
+        pool = self.runtime._pool_for(self.allocation)
         wh = pool.lease(dedicated=True)
         try:
             # Init args ship raw — ObjectRefs stay refs, matching the
@@ -804,7 +811,9 @@ class _ProcessActorShell(_ActorShell):
                     self._running_sync.pop(task_id, None)
         wkey = self.runtime._worker_ref_key(self._worker)
         if num_returns != "streaming":
-            self.runtime.seal_remote_results(return_ids, rep, wkey)
+            self.runtime.seal_remote_results(
+                return_ids, rep, wkey,
+                node_hex=getattr(self._worker, "node_hex", None))
         else:
             self.runtime.apply_ref_batches(rep, wkey)
 
@@ -977,6 +986,10 @@ class LocalRuntime:
         self._dropped_streams = TombstoneSet(4096)
         self.store.on_sealed = self._on_object_sealed
         self.store.on_nested = self.refs.add_nested
+        # Cross-node object plane: pull remote primary copies through
+        # the owning daemon's channel; free them when refs hit zero.
+        self.store.fetch_remote = self._fetch_remote_bytes
+        self.store.release_remote = self._release_remote
         self._ref_hooks = (self.refs.add_local, self.refs.remove_local)
         _object_ref.install_ref_hooks(*self._ref_hooks)
         # Execution backend: thread (in-process) or pooled OS worker
@@ -1052,6 +1065,40 @@ class LocalRuntime:
         self._notify()
         return node_id
 
+    def register_remote_node(self, agent, resources: Dict[str, float],
+                             labels: Optional[Dict[str, str]],
+                             addr: Tuple[str, int]) -> NodeID:
+        """Register a node daemon that joined over TCP (parity: raylet
+        registration with the GCS, gcs_node_manager.cc RegisterNode).
+        The daemon owns its local worker pool + shm arena; the head
+        schedules onto it like any node and dispatches over ``agent``."""
+        node_id = self.add_node(resources, labels)
+        with self._lock:
+            node = self._nodes[node_id]
+            node.agent = agent
+            node.addr = tuple(addr)
+        agent.bind(self, node)
+        return node_id
+
+    def seal_remote_at(self, oid: ObjectID, node_hex: str,
+                       size: int) -> None:
+        """Record a seal whose bytes live in a remote daemon's arena:
+        store marks the location; the location table feeds node-death
+        recovery (parity: object directory location update)."""
+        self.store.mark_remote_sealed(oid, node_hex, size)
+        with self._lock:
+            node = next((n for n in self._nodes.values()
+                         if n.node_id.hex() == node_hex), None)
+            if node is not None:
+                self._object_locations[oid] = node.node_id
+
+    def node_by_hex(self, node_hex: str) -> Optional[NodeState]:
+        with self._lock:
+            for n in self._nodes.values():
+                if n.node_id.hex() == node_hex:
+                    return n
+        return None
+
     def kill_node(self, node_id: NodeID) -> None:
         """Mark a node dead; its actors die (restartable ones restart
         elsewhere), its PG bundles are re-reserved on surviving nodes
@@ -1067,6 +1114,12 @@ class LocalRuntime:
                 self._native_sched.kill_node(node.int_id)
             doomed = [self._actors[a] for a in list(node.actor_ids)
                       if a in self._actors]
+        if node.agent is not None:
+            # Borrows held by the dead node's workers evaporate (their
+            # keys are namespaced under the node id), and the channel
+            # closes (idempotent if the close is what killed the node).
+            self.refs.drop_worker_prefix(node_id.hex()[:12] + "/")
+            node.agent.close()
         for shell in doomed:
             shell.death_reason = "node died"
             shell.dead = True
@@ -1175,6 +1228,31 @@ class LocalRuntime:
     def _alive_nodes(self) -> List[NodeState]:
         return [self._nodes[i] for i in self._node_order
                 if self._nodes[i].alive]
+
+    # -- cross-node object plane -------------------------------------------
+
+    def _fetch_remote_bytes(self, node_hex: str, oid: ObjectID,
+                            size: int) -> bytes:
+        """Pull one object's framed bytes from the node daemon that
+        holds its primary copy (parity: PullManager → remote object
+        manager chunk transfer)."""
+        node = self.node_by_hex(node_hex)
+        if node is None or not node.alive or node.agent is None:
+            raise OSError(f"object {oid.hex()}: node {node_hex} is gone")
+        return node.agent.pull(oid, size)
+
+    def _release_remote(self, node_hex: Optional[str],
+                        oid: ObjectID) -> None:
+        """Free node-side copies of a released object.  Broadcast to
+        every joined daemon: replicas pulled by consumer nodes are not
+        location-tracked at the head (parity trade-off vs the
+        reference's per-copy object directory), and the cast is a
+        fire-and-forget socket write — cheap at this scale."""
+        with self._lock:
+            agents = [n.agent for n in self._nodes.values()
+                      if n.agent is not None and n.alive]
+        for agent in agents:
+            agent.free([oid.binary()])
 
     # -- control-plane persistence -----------------------------------------
 
@@ -1325,9 +1403,15 @@ class LocalRuntime:
         def enc(v):
             if not isinstance(v, ObjectRef):
                 return v
-            kind, payload = self.store.get_wire(v.id)
+            kind, payload = self.store.get_wire_loc(v.id)
             if kind == "err":
                 raise payload
+            if kind == "at":
+                # Remote primary copy: ship the location marker; the
+                # executing worker fetches through its node daemon
+                # (local-arena hit when the task landed on the owning
+                # node — the common consumer-follows-producer case).
+                return WireRef("fetch", payload[1], v.id.binary())
             return WireRef(kind, payload, v.id.binary())
 
         return (tuple(enc(a) for a in args),
@@ -1798,12 +1882,13 @@ class LocalRuntime:
                                     else pt.options.resource_demand()),
             )
             try:
-                if self.worker_pool is not None:
+                pool = self._pool_for(alloc)
+                if pool is not None:
                     with _tracing().task_span(
                         pt.function_name, pt.trace_ctx,
                         {"task_id": pt.task_id.hex(), "attempt": attempt},
                     ):
-                        self._execute_task_remote(pt)
+                        self._execute_task_remote(pt, pool)
                 else:
                     args, kwargs = self.resolve_args(pt.args, pt.kwargs)
                     if pt.options.runtime_env:
@@ -1884,17 +1969,29 @@ class LocalRuntime:
 
         self._exec_pool.submit(run)
 
-    def _execute_task_remote(self, pt: _PendingTask) -> None:
+    def _pool_for(self, alloc: _Allocation):
+        """Execution backend for an allocation: the remote node's daemon
+        agent when the task landed on a joined node, else the head's
+        local worker pool (None → thread-mode in-process execution)."""
+        if alloc.node is not None and alloc.node.agent is not None:
+            return alloc.node.agent
+        return self.worker_pool
+
+    def _execute_task_remote(self, pt: _PendingTask, pool=None) -> None:
         """Run one task on a leased worker process (parity: OnWorkerIdle
         pushing onto a leased worker, direct_task_transport.cc:191 →
-        HandlePushTask, core_worker.cc:3072).  Raises the worker-side
-        exception (or WorkerDiedError on a crash) so the caller's retry
-        path treats remote failures exactly like local ones."""
+        HandlePushTask, core_worker.cc:3072).  ``pool`` is the head's
+        WorkerPool or a remote node's agent (same lease/release
+        surface).  Raises the worker-side exception (or WorkerDiedError
+        on a crash) so the caller's retry path treats remote failures
+        exactly like local ones."""
         import cloudpickle
 
+        if pool is None:
+            pool = self.worker_pool
         wire_args, wire_kwargs = self._wire_args(pt.args, pt.kwargs)
         spec = cloudpickle.dumps((pt.fn, wire_args, wire_kwargs))
-        wh = self.worker_pool.lease()
+        wh = pool.lease()
         with self._lock:
             entry = self._running_tasks.get(pt.task_id)
             if entry is not None:
@@ -1912,16 +2009,20 @@ class LocalRuntime:
                 trace_ctx=_tracing().capture_context(),
             )
         finally:
-            self.worker_pool.release(wh)
+            pool.release(wh)
         wkey = self._worker_ref_key(wh)
         if pt.streaming:
             # The worker sealed every index + the sentinel.
             self.apply_ref_batches(rep, wkey)
             return
-        self.seal_remote_results(pt.return_ids, rep, wkey)
+        self.seal_remote_results(pt.return_ids, rep, wkey,
+                                 node_hex=getattr(wh, "node_hex", None))
 
     @staticmethod
     def _worker_ref_key(wh) -> str:
+        rk = getattr(wh, "ref_key", None)
+        if rk is not None:
+            return rk
         from ray_tpu.core.worker_pool import _wkey
 
         return _wkey(wh.chan)
@@ -1938,11 +2039,15 @@ class LocalRuntime:
 
     def seal_remote_results(self, return_ids: Sequence[ObjectID],
                             rep: Dict[str, Any],
-                            worker_key: Optional[str] = None) -> None:
+                            worker_key: Optional[str] = None,
+                            node_hex: Optional[str] = None) -> None:
         """Seal a worker task reply's results.  Order matters: borrow
         ADDS first (they may cover refs inside the returned values),
         then nested pins, then the seal, then borrow DELS — so a del of
-        a ref riding in the reply can never free it before its pin."""
+        a ref riding in the reply can never free it before its pin.
+        ``node_hex`` set → the executing worker lives on a remote node
+        daemon; "shm" entries stayed in THAT node's arena and seal as
+        remote locations."""
         if worker_key is not None:
             self.apply_ref_batches(rep, worker_key, which="add")
         nested = rep.get("nested") or [()] * len(return_ids)
@@ -1951,7 +2056,10 @@ class LocalRuntime:
             if inner:
                 self.refs.add_nested(oid, [ObjectID(b) for b in inner])
             if kind == "shm":
-                self.store.mark_shm_sealed(oid, payload)
+                if node_hex:
+                    self.seal_remote_at(oid, node_hex, payload)
+                else:
+                    self.store.mark_shm_sealed(oid, payload)
             else:
                 self.store.put_serialized(oid, payload)
         if worker_key is not None:
@@ -2101,7 +2209,10 @@ class LocalRuntime:
         # so it must never be freed/tombstoned while the actor lives;
         # _finish_actor_removal drops the pin and the store entry.
         self.refs.add_seal_pin(creation_oid)
-        shell_cls = (_ProcessActorShell if self.worker_pool is not None
+        shell_cls = (_ProcessActorShell
+                     if (self.worker_pool is not None
+                         or (alloc.node is not None
+                             and alloc.node.agent is not None))
                      else _ActorShell)
         shell = shell_cls(self, actor_id, cls, args, kwargs, options,
                           creation_oid, alloc)
@@ -2688,6 +2799,13 @@ class LocalRuntime:
         for shell in actors:
             shell.restarts_left = 0
             shell.kill()
+        # Ask joined node daemons to exit (best-effort cast), then drop
+        # their channels.
+        with self._lock:
+            agents = [n.agent for n in self._nodes.values()
+                      if n.agent is not None]
+        for agent in agents:
+            agent.shutdown_daemon()
         if self.worker_pool is not None:
             self.worker_pool.shutdown()
         if self._persist is not None:

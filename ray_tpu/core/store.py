@@ -47,12 +47,14 @@ class LocalObjectStore:
 
     def __init__(self, *, serialize_always: bool = True,
                  shm_threshold: int = 256 * 1024,
-                 shm_capacity: int = 4 << 30,
+                 shm_capacity: Optional[int] = None,
                  inproc_cap_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None):
         from ray_tpu.utils.config import get_config
 
         cfg = get_config()
+        if shm_capacity is None:
+            shm_capacity = cfg.object_store_memory_bytes
         self._lock = threading.Lock()
         self._objects: Dict[ObjectID, ObjectState] = {}
         # Serializing everything (even in local mode) keeps semantics
@@ -79,6 +81,18 @@ class LocalObjectStore:
         # the runtime hooks lineage reconstruction here (parity: the
         # plasma fetch failure that triggers ObjectRecoveryManager).
         self.lost_object_callback = None
+        # Cross-node object plane hooks (multi-host runtime).
+        # fetch_remote(node_hex, oid, size) -> framed bytes — pull the
+        # primary copy from the owning node daemon's arena (parity:
+        # PullManager fetching chunks from a remote object manager).
+        # Raises on failure; the reader path then marks the object lost.
+        self.fetch_remote = None
+        # release_remote(node_hex, oid) — best-effort free of the
+        # primary copy on its node when the owner's refcount hits zero.
+        self.release_remote = None
+        # In-flight remote fetch dedup: oid → Event (first reader pulls,
+        # the rest wait; parity: pull_manager.h in-flight dedup).
+        self._fetching: Dict[ObjectID, threading.Event] = {}
         # Ownership hooks (parity: the plasma/owner interplay in
         # reference_count.cc).  on_sealed(oid) fires once a value/error
         # is sealed — the runtime drops the task-return seal pin there.
@@ -278,6 +292,8 @@ class LocalObjectStore:
         self._sealed(oid)
         if self._inproc_bytes > self._inproc_cap:
             self._spill_cold_objects()
+        if shm is not None:
+            self._maybe_spill_arena()
 
     def mark_shm_sealed(self, oid: ObjectID, size: int) -> None:
         """A worker wrote+sealed this object directly into the shared
@@ -285,9 +301,154 @@ class LocalObjectStore:
         st = self._state(oid)
         st.in_shm = True
         st.shm_size = size
+        st.last_access = time.monotonic()
         st.lost = False
         st.event.set()
         self._sealed(oid)
+        self._maybe_spill_arena()
+
+    def _maybe_spill_arena(self) -> None:
+        """Spill cold sealed ARENA objects to disk when the arena runs
+        hot, instead of losing them to LRU eviction (parity: the
+        reference spills FROM plasma — LocalObjectManager::
+        SpillObjectsOfSize over plasma entries).  Objects currently
+        pinned by readers are skipped (delete would EBUSY)."""
+        shm = self._shm
+        if shm is None:
+            return
+        try:
+            stats = shm.stats()
+        except OSError:
+            return
+        cap = stats["capacity"] or 1
+        if stats["bytes_used"] / cap < self._spill_low_frac:
+            return
+        low_water = int(cap * max(0.0, self._spill_low_frac - 0.2))
+        with self._spill_lock:
+            with self._lock:
+                victims = sorted(
+                    ((oid, st) for oid, st in self._objects.items()
+                     if st.in_shm and st.event.is_set()
+                     and st.error is None and st.remote_node is None
+                     and st.spilled_uri is None),
+                    key=lambda kv: kv[1].last_access,
+                )
+            used = stats["bytes_used"]
+            batch = []
+            for oid, st in victims:
+                if used <= low_water:
+                    break
+                try:
+                    payload = shm.get_bytes(oid.binary(), timeout=0.0)
+                except OSError:
+                    continue
+                batch.append((oid, st, payload))
+                used -= len(payload)
+            if not batch:
+                return
+            storage = self._external_storage()
+            uris = storage.spill_objects(
+                [(oid.binary(), payload) for oid, _, payload in batch]
+            )
+            orphaned: List[str] = []
+            for (oid, st, payload), uri in zip(batch, uris):
+                with self._lock:
+                    if not st.in_shm or not st.event.is_set():
+                        orphaned.append(uri)  # raced release/invalidate
+                        continue
+                    st.spilled_uri = uri
+                    st.in_shm = False
+                    self.spill_stats["spilled_objects"] += 1
+                    self.spill_stats["spilled_bytes"] += len(payload)
+                try:
+                    shm.delete(oid.binary())
+                except OSError:
+                    # Pinned by a live reader: keep both copies; the
+                    # arena copy goes with the pin, the spill file
+                    # remains authoritative in our index.
+                    pass
+            if orphaned:
+                storage.delete(orphaned)
+
+    # -- cross-node object plane -------------------------------------------
+
+    def mark_remote_sealed(self, oid: ObjectID, node_hex: str,
+                           size: int) -> None:
+        """The primary copy was sealed into a remote node daemon's arena
+        (parity: the owner recording an object location from a remote
+        plasma seal).  Local readers fetch lazily via ``fetch_remote``."""
+        st = self._state(oid)
+        st.remote_node = node_hex
+        st.shm_size = size
+        st.lost = False
+        st.event.set()
+        self._sealed(oid)
+
+    def remote_location(self, oid: ObjectID) -> Optional[str]:
+        with self._lock:
+            st = self._objects.get(oid)
+            return st.remote_node if st is not None else None
+
+    def _materialize_remote(self, oid: ObjectID, st) -> None:
+        """Pull a remote primary copy into the local tiers.  Dedups
+        concurrent readers; on pull failure marks the object lost so
+        the reader loop triggers lineage reconstruction."""
+        with self._lock:
+            node_hex = st.remote_node
+            if node_hex is None or not st.event.is_set():
+                return  # raced: someone else materialized or invalidated
+            ev = self._fetching.get(oid)
+            if ev is not None:
+                waiter = True
+            else:
+                waiter = False
+                ev = self._fetching[oid] = threading.Event()
+            size = st.shm_size
+        if waiter:
+            ev.wait(300.0)
+            return
+        try:
+            fetch = self.fetch_remote
+            if fetch is None:
+                raise OSError(f"no remote-fetch path for {oid.hex()}")
+            data = fetch(node_hex, oid, size)
+            # Admit into the local tiers WITHOUT re-firing seal hooks
+            # (the object was already sealed once).
+            shm = (self._shm_store()
+                   if len(data) >= self._shm_threshold else None)
+            admitted_shm = False
+            if shm is not None:
+                try:
+                    shm.put_bytes(oid.binary(), bytes(data))
+                    admitted_shm = True
+                except Exception:
+                    admitted_shm = False
+            with self._lock:
+                if st.remote_node != node_hex:
+                    return  # invalidated mid-pull; drop our copy
+                if admitted_shm:
+                    st.in_shm = True
+                    st.shm_size = len(data)
+                else:
+                    if st.value_bytes is not None:
+                        self._inproc_bytes -= len(st.value_bytes)
+                    st.value_bytes = bytes(data)
+                    self._inproc_bytes += len(data)
+                # remote_node stays set: the producing node still holds
+                # the primary copy, and release() must free it there
+                # when the refcount hits zero.  Read paths prefer the
+                # local tiers once they exist.
+                st.last_access = time.monotonic()
+        except Exception:
+            # Primary copy unreachable (node died mid-pull): invalidate
+            # so readers trigger reconstruction instead of spinning.
+            self.invalidate(oid)
+        finally:
+            with self._lock:
+                self._fetching.pop(oid, None)
+            ev.set()
+            if self._inproc_bytes > self._inproc_cap:
+                self._spill_cold_objects()
 
     def get_wire(self, oid: ObjectID, timeout: Optional[float] = None):
         """Blocking fetch of an object's WIRE representation for a
@@ -318,6 +479,12 @@ class LocalObjectStore:
                 vb = st.value_bytes
                 spilled = st.spilled_uri
                 in_band = st.in_band
+                remote_only = (st.remote_node is not None and vb is None
+                               and spilled is None and in_band is None)
+            if remote_only:
+                # Pull the primary copy local, then re-snapshot.
+                self._materialize_remote(oid, st)
+                continue
             break
         if vb is not None:
             st.last_access = time.monotonic()
@@ -331,6 +498,85 @@ class LocalObjectStore:
         from ray_tpu.utils.serialization import serialize_object
 
         return ("b", serialize_object(in_band))
+
+    def get_wire_loc(self, oid: ObjectID, timeout: Optional[float] = None):
+        """Like get_wire but NEVER pulls a remote primary copy local:
+        returns ("at", (node_hex, size)) instead, so dispatch paths can
+        ship a location and let the consuming node pull directly
+        (A → B instead of A → head → B)."""
+        if oid in self._freed:
+            raise ObjectFreedError(oid.hex())
+        st = self._state(oid)
+        ready, _ = self.wait([oid], 1, timeout)
+        if not ready:
+            raise GetTimeoutError(
+                f"get timed out after {timeout}s for {oid.hex()}"
+            )
+        with self._lock:
+            if st.event.is_set() and st.error is None \
+                    and st.remote_node is not None:
+                return ("at", (st.remote_node, st.shm_size))
+        return self.get_wire(oid, timeout)
+
+    def read_range(self, oid: ObjectID, off: int, length: int) -> bytes:
+        """Serve ``length`` framed bytes at ``off`` of a LOCAL copy —
+        the serving side of the cross-node pull protocol (parity: the
+        object manager answering Pull with ObjectChunk pushes,
+        object_manager.h:117).  Zero-copy out of the arena; spilled
+        objects restore through a short-lived cache so a chunked pull
+        doesn't re-read the spill file per chunk."""
+        st = self._state(oid)
+        if not st.event.is_set():
+            raise OSError(f"object {oid.hex()} not sealed here")
+        with self._lock:
+            in_shm = st.in_shm
+            vb = st.value_bytes
+            spilled = st.spilled_uri
+        if in_shm:
+            shm = self._shm_store()
+            if shm is not None:
+                pb = shm.get(oid.binary(), timeout=0.0)
+                return bytes(pb.view[off:off + length])
+        if vb is None and spilled is not None:
+            vb = self._restored_for_pull(oid, spilled)
+        if vb is not None:
+            return bytes(vb[off:off + length])
+        raise OSError(f"object {oid.hex()}: no local bytes to serve")
+
+    _PULL_CACHE_CAP = 256 << 20  # restored-payload cache, across pulls
+
+    def _restored_for_pull(self, oid: ObjectID, spilled: str) -> bytes:
+        """Restore a spilled payload for chunked serving, through a
+        small lock-protected cache so one pull's chunks share one disk
+        read.  Size-capped: oversized payloads serve uncached, and the
+        oldest entries evict to admit new ones."""
+        lock = getattr(self, "_pull_cache_lock", None)
+        if lock is None:
+            lock = self._pull_cache_lock = threading.Lock()
+        with lock:
+            cache = getattr(self, "_pull_cache", None)
+            if cache is None:
+                # oid → (bytes, expiry); insertion-ordered for eviction.
+                cache = self._pull_cache = {}
+            hit = cache.get(oid)
+            now = time.monotonic()
+            if hit is not None and hit[1] >= now:
+                return hit[0]
+        data = self._external_storage().restore(spilled)
+        self.spill_stats["restored_objects"] += 1
+        self.spill_stats["restored_bytes"] += len(data)
+        if len(data) > self._PULL_CACHE_CAP:
+            return data  # too big to cache; each chunk re-reads
+        with lock:
+            now = time.monotonic()
+            for k in [k for k, (_, exp) in cache.items() if exp < now]:
+                del cache[k]
+            total = sum(len(v) for v, _ in cache.values())
+            while cache and total + len(data) > self._PULL_CACHE_CAP:
+                _, (old, _exp) = cache.popitem()
+                total -= len(old)
+            cache[oid] = (data, now + 30.0)
+        return data
 
     def is_freed(self, oid: ObjectID) -> bool:
         with self._lock:
@@ -398,8 +644,16 @@ class LocalObjectStore:
                 vb = st.value_bytes
                 spilled = st.spilled_uri
                 in_band = st.in_band
+                remote_only = (st.remote_node is not None and not shm_flag
+                               and vb is None and spilled is None
+                               and in_band is None)
             if err is not None:
                 raise err
+            if remote_only:
+                # Primary copy is on a remote node: pull it local first
+                # (dedup'd across concurrent readers), then re-snapshot.
+                self._materialize_remote(oid, st)
+                continue
             if shm_flag:
                 shm = self._shm_store()
                 if shm is None:  # store closed under a racing reader
@@ -514,6 +768,7 @@ class LocalObjectStore:
             st.value_bytes = None
             st.in_band = None
             st.error = None
+            st.remote_node = None
             st.lost = True
             st.event.clear()
         if spilled is not None and self._storage is not None:
@@ -547,6 +802,14 @@ class LocalObjectStore:
                 self._shm.delete(oid.binary())
             except OSError:
                 pass
+        if st is not None and self.release_remote is not None \
+                and (st.remote_node is not None
+                     or st.in_shm or st.shm_size > 0):
+            # Free every node-side copy: the primary AND any replicas
+            # consumer daemons pulled (the hook broadcasts — only
+            # arena-class objects ever enter daemon stores, so small
+            # in-band releases cost nothing).
+            self.release_remote(st.remote_node, oid)
 
     def close(self) -> None:
         if self._shm is not None:
@@ -566,6 +829,8 @@ class LocalObjectStore:
         for oid, st in items:
             if st.error is not None:
                 tier, size = "ERROR", 0
+            elif st.remote_node is not None:
+                tier, size = "REMOTE", st.shm_size
             elif st.in_shm:
                 tier, size = "SHARED_MEMORY", st.shm_size
             elif st.value_bytes is not None:

@@ -116,6 +116,16 @@ class MsgChannel:
             raise rep.value
         raise ChannelClosedError(f"{self._name}: {rep.value}")
 
+    def cast(self, op: str, **payload) -> None:
+        """One-way notification: mid 0 means the peer must not reply
+        (parity: fire-and-forget RPCs like the reference's pubsub
+        publishes).  Errors are swallowed — casts are best-effort by
+        contract (the channel-close path owns failure semantics)."""
+        try:
+            self._send({"mid": 0, "kind": "req", "op": op, **payload})
+        except (OSError, ChannelClosedError):
+            pass
+
     # -- receiving ---------------------------------------------------------
 
     def _read_loop(self) -> None:
@@ -142,6 +152,12 @@ class MsgChannel:
 
     def _run_handler(self, msg: Dict) -> None:
         mid = msg.get("mid")
+        if not mid:  # cast: run the handler, never reply
+            try:
+                self._handler(self, msg)
+            except BaseException:
+                pass
+            return
         try:
             value = self._handler(self, msg)
             rep = {"mid": mid, "kind": "rep", "ok": True, "value": value}

@@ -210,16 +210,25 @@ class WorkerRuntime:
 
     def _read_shm(self, oid_bin: bytes):
         """Deserialize one shared-arena object — zero-copy when this
-        worker attached the arena (views stay pinned until GC'd);
-        otherwise fall back to asking the driver for the bytes so a
-        worker whose attach failed degrades instead of crashing."""
+        worker attached the arena (views stay pinned until GC'd).  An
+        arena miss (object lives on another node, or was evicted) falls
+        back to a get_raw through the host, which pulls/materializes it
+        into the local arena; attach-failed workers always go through
+        the host with inline bytes."""
         if self._shm is not None:
-            pb = self._shm.get(oid_bin, timeout=5.0)
-            return deserialize_object(pb.view)
+            try:
+                pb = self._shm.get(oid_bin, timeout=0.05)
+                return deserialize_object(pb.view)
+            except OSError:
+                pass  # not local (yet) — ask the host to make it so
+        no_shm = self._shm is None
         (kind, payload), = self._chan.call("get_raw", oids=[oid_bin],
-                                           no_shm=True)
+                                           no_shm=no_shm)
         if kind == "err":
             raise payload
+        if kind == "shm":
+            pb = self._shm.get(oid_bin, timeout=5.0)
+            return deserialize_object(pb.view)
         return deserialize_object(payload)
 
     def _fetch(self, oid_bins: List[bytes],
@@ -500,7 +509,10 @@ class _WorkerServer:
     def _decode_args(self, args, kwargs) -> Tuple[tuple, dict]:
         def dec(v):
             if isinstance(v, WireRef):
-                if v.kind == "shm":
+                if v.kind in ("shm", "fetch"):
+                    # "fetch": the bytes live on another node — the
+                    # host daemon pulls them into the local arena on
+                    # the get_raw fallback inside _read_shm.
                     return self._wr._read_shm(v.oid)
                 return deserialize_object(v.data)
             return v

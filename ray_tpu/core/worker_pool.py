@@ -353,179 +353,231 @@ class WorkerPool:
 
     # -- nested-API dispatch (worker → driver) -----------------------------
 
-    def _register_nested(self, oid: ObjectID, msg: Dict[str, Any]) -> None:
-        nested = msg.get("nested")
-        if nested:
-            self._rt.refs.add_nested(oid, [ObjectID(b) for b in nested])
-
     def _handle(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:
         """Serve a worker's control-plane request against the runtime
         (parity: the owner/GCS RPC surface a core worker talks to)."""
-        rt = self._rt
-        op = msg["op"]
-        if op == "get_raw":
-            entries = [rt.store.get_wire(ObjectID(b), msg.get("timeout"))
-                       for b in msg["oids"]]
-            if msg.get("no_shm"):
-                # Shm-less worker (arena attach failed): materialize the
-                # bytes driver-side instead of handing out arena refs.
-                shm = rt.store._shm_store()
-                entries = [
-                    ("b", shm.get_bytes(ObjectID(b).binary()))
-                    if kind == "shm" else (kind, payload)
-                    for b, (kind, payload) in zip(msg["oids"], entries)
-                ]
-            return entries
-        if op == "put_val":
-            oid = rt.alloc_put_oid()
-            # Pre-register the putting worker's borrow (the worker
-            # adopts): a put whose handle dies before the batched flush
-            # must still be freeable, not leaked untracked.
-            rt.refs.add_borrow(_wkey(chan), oid)
-            self._register_nested(oid, msg)
-            rt.store.put_serialized(oid, msg["data"])
-            return oid.binary()
-        if op == "alloc_put_oid":
-            oid = rt.alloc_put_oid()
-            rt.refs.add_borrow(_wkey(chan), oid)
-            return oid.binary()
-        if op == "mark_shm":
-            oid = ObjectID(msg["oid"])
-            self._register_nested(oid, msg)
-            rt.store.mark_shm_sealed(oid, msg["size"])
-            return None
-        if op == "seal_value":
-            kind, payload = msg["entry"]
-            oid = ObjectID(msg["oid"])
-            self._register_nested(oid, msg)
+        return handle_control_op(self._rt, _wkey(chan), msg)
+
+
+def _register_nested(rt, oid: ObjectID, msg: Dict[str, Any]) -> None:
+    nested = msg.get("nested")
+    if nested:
+        rt.refs.add_nested(oid, [ObjectID(b) for b in nested])
+
+
+def handle_control_op(rt, key: str, msg: Dict[str, Any],
+                      node_hex: Optional[str] = None) -> Any:
+    """The owner/GCS op surface serving workers AND node daemons.
+
+    ``key`` is the borrower identity for reference counting (one per
+    worker process; daemons forward their workers' keys prefixed with
+    the node id).  ``node_hex`` is set when the caller is a remote node
+    daemon: seals of arena-resident values then record a remote
+    location instead of a local arena entry (the bytes stayed in the
+    daemon's arena — parity: a remote plasma seal updating the owner's
+    object directory)."""
+    op = msg["op"]
+    if op == "get_raw":
+        entries = [rt.store.get_wire(ObjectID(b), msg.get("timeout"))
+                   for b in msg["oids"]]
+        if msg.get("no_shm"):
+            # Shm-less worker (arena attach failed): materialize the
+            # bytes driver-side instead of handing out arena refs.
+            shm = rt.store._shm_store()
+            entries = [
+                ("b", shm.get_bytes(ObjectID(b).binary()))
+                if kind == "shm" else (kind, payload)
+                for b, (kind, payload) in zip(msg["oids"], entries)
+            ]
+        return entries
+    if op == "get_wire":
+        # Daemon-side fetch: never materializes remote copies at the
+        # head — returns ("at", (node_hex, addr, size)) locations so
+        # the consuming node pulls directly from the owning node.
+        # Head arena copies are ("at", ("", None, size)): pull over
+        # the head channel.
+        out = []
+        for b in msg["oids"]:
+            kind, payload = rt.store.get_wire_loc(
+                ObjectID(b), msg.get("timeout"))
             if kind == "shm":
+                out.append(("at", ("", None, payload)))
+            elif kind == "at":
+                nh, size = payload
+                node = rt.node_by_hex(nh)
+                out.append(("at", (nh, node.addr if node else None, size)))
+            else:
+                out.append((kind, payload))
+        return out
+    if op == "pull":
+        return rt.store.read_range(ObjectID(msg["oid"]), msg["off"],
+                                   msg["len"])
+    if op == "report_lost":
+        # A node daemon discovered its supposed-local copy is gone
+        # (arena eviction): invalidate so readers reconstruct.
+        oid = ObjectID(msg["oid"])
+        if rt.store.remote_location(oid) == node_hex:
+            rt.store.invalidate(oid)
+            rt._reconstruct_object(oid)
+        return None
+    if op == "put_val":
+        oid = rt.alloc_put_oid()
+        # Pre-register the putting worker's borrow (the worker
+        # adopts): a put whose handle dies before the batched flush
+        # must still be freeable, not leaked untracked.
+        rt.refs.add_borrow(key, oid)
+        _register_nested(rt, oid, msg)
+        rt.store.put_serialized(oid, msg["data"])
+        return oid.binary()
+    if op == "alloc_put_oid":
+        oid = rt.alloc_put_oid()
+        rt.refs.add_borrow(key, oid)
+        return oid.binary()
+    if op == "mark_shm":
+        oid = ObjectID(msg["oid"])
+        _register_nested(rt, oid, msg)
+        if node_hex:
+            rt.seal_remote_at(oid, node_hex, msg["size"])
+        else:
+            rt.store.mark_shm_sealed(oid, msg["size"])
+        return None
+    if op == "seal_value":
+        kind, payload = msg["entry"]
+        oid = ObjectID(msg["oid"])
+        _register_nested(rt, oid, msg)
+        if kind == "shm":
+            if node_hex:
+                rt.seal_remote_at(oid, node_hex, payload)
+            else:
                 rt.store.mark_shm_sealed(oid, payload)
-            else:
-                rt.store.put_serialized(oid, payload)
-            return None
-        if op == "ref":
-            key = _wkey(chan)
-            for b in msg.get("add") or []:
-                rt.refs.add_borrow(key, ObjectID(b))
-            for b in msg.get("rem") or []:
-                rt.refs.remove_borrow(key, ObjectID(b))
-            return None
-        if op == "release_stream":
-            from ray_tpu.utils.ids import TaskID
+        else:
+            rt.store.put_serialized(oid, payload)
+        return None
+    if op == "ref":
+        for b in msg.get("add") or []:
+            rt.refs.add_borrow(key, ObjectID(b))
+        for b in msg.get("rem") or []:
+            rt.refs.remove_borrow(key, ObjectID(b))
+        return None
+    if op == "worker_gone":
+        # A daemon-side worker process died: its borrows evaporate
+        # (the daemon forwards the dead worker's borrower key).
+        rt.refs.drop_worker(msg["wkey"])
+        return None
+    if op == "release_stream":
+        from ray_tpu.utils.ids import TaskID
 
-            rt.release_stream(TaskID(msg["task"]), msg["index"])
-            return None
-        if op == "seal_error":
-            oid = ObjectID(msg["oid"])
-            if msg.get("if_pending"):
-                rt.store.put_error_if_pending(oid, msg["error"])
-            else:
-                rt.store.put_error(oid, msg["error"])
-            return None
-        if op == "wait":
-            ready, pending = rt.store.wait(
-                [ObjectID(b) for b in msg["oids"]], msg["num_returns"],
-                msg.get("timeout"),
-            )
-            return ([o.binary() for o in ready],
-                    [o.binary() for o in pending])
-        if op == "peek_error":
-            return rt.store.peek_error(ObjectID(msg["oid"]))
-        if op == "contains":
-            return rt.store.contains(ObjectID(msg["oid"]))
-        if op == "submit_task":
-            fn, args, kwargs = cloudpickle.loads(msg["spec"])
-            options = msg["options"]
-            out = rt.submit_task(fn, args, kwargs, options,
-                                 trace_ctx=msg.get("trace_ctx"))
-            if options.num_returns == "streaming":
-                return {"stream": out.task_id.binary()}
-            # Pre-register the caller's borrows: the worker constructs
-            # handles from these bins (and adopts them without
-            # re-reporting), so a fast-finishing task can't be freed
-            # between seal and the worker's batched add.
-            key = _wkey(chan)
-            for r in out:
-                rt.refs.add_borrow(key, r.id)
-            return {"oids": [r.id.binary() for r in out]}
-        if op == "create_actor":
-            cls, args, kwargs = cloudpickle.loads(msg["spec"])
-            shell, ref = rt.create_actor(cls, args, kwargs, msg["options"])
-            from ray_tpu.core.actor import collect_method_num_returns
+        rt.release_stream(TaskID(msg["task"]), msg["index"])
+        return None
+    if op == "seal_error":
+        oid = ObjectID(msg["oid"])
+        if msg.get("if_pending"):
+            rt.store.put_error_if_pending(oid, msg["error"])
+        else:
+            rt.store.put_error(oid, msg["error"])
+        return None
+    if op == "wait":
+        ready, pending = rt.store.wait(
+            [ObjectID(b) for b in msg["oids"]], msg["num_returns"],
+            msg.get("timeout"),
+        )
+        return ([o.binary() for o in ready],
+                [o.binary() for o in pending])
+    if op == "peek_error":
+        return rt.store.peek_error(ObjectID(msg["oid"]))
+    if op == "contains":
+        return rt.store.contains(ObjectID(msg["oid"]))
+    if op == "submit_task":
+        fn, args, kwargs = cloudpickle.loads(msg["spec"])
+        options = msg["options"]
+        out = rt.submit_task(fn, args, kwargs, options,
+                             trace_ctx=msg.get("trace_ctx"))
+        if options.num_returns == "streaming":
+            return {"stream": out.task_id.binary()}
+        # Pre-register the caller's borrows: the worker constructs
+        # handles from these bins (and adopts them without
+        # re-reporting), so a fast-finishing task can't be freed
+        # between seal and the worker's batched add.
+        for r in out:
+            rt.refs.add_borrow(key, r.id)
+        return {"oids": [r.id.binary() for r in out]}
+    if op == "create_actor":
+        cls, args, kwargs = cloudpickle.loads(msg["spec"])
+        shell, ref = rt.create_actor(cls, args, kwargs, msg["options"])
+        from ray_tpu.core.actor import collect_method_num_returns
 
-            return {"actor_id": shell.actor_id.binary(),
-                    "cls_name": cls.__name__,
-                    "table": collect_method_num_returns(cls),
-                    "creation_oid": ref.id.binary()}
-        if op == "submit_actor_task":
-            from ray_tpu.utils.ids import ActorID
+        return {"actor_id": shell.actor_id.binary(),
+                "cls_name": cls.__name__,
+                "table": collect_method_num_returns(cls),
+                "creation_oid": ref.id.binary()}
+    if op == "submit_actor_task":
+        from ray_tpu.utils.ids import ActorID
 
-            args, kwargs = cloudpickle.loads(msg["spec"])
-            out = rt.submit_actor_task(
-                ActorID(msg["actor_id"]), msg["method"], args, kwargs,
-                num_returns=msg["num_returns"],
-                trace_ctx=msg.get("trace_ctx"),
-            )
-            if msg["num_returns"] == "streaming":
-                return {"stream": out.task_id.binary()}
-            key = _wkey(chan)
-            for r in out:
-                rt.refs.add_borrow(key, r.id)
-            return {"oids": [r.id.binary() for r in out]}
-        if op == "cancel_task":
-            rt.cancel(ObjectID(msg["oid"]), force=msg.get("force", False))
-            return None
-        if op == "kill_actor":
-            from ray_tpu.utils.ids import ActorID
+        args, kwargs = cloudpickle.loads(msg["spec"])
+        out = rt.submit_actor_task(
+            ActorID(msg["actor_id"]), msg["method"], args, kwargs,
+            num_returns=msg["num_returns"],
+            trace_ctx=msg.get("trace_ctx"),
+        )
+        if msg["num_returns"] == "streaming":
+            return {"stream": out.task_id.binary()}
+        for r in out:
+            rt.refs.add_borrow(key, r.id)
+        return {"oids": [r.id.binary() for r in out]}
+    if op == "cancel_task":
+        rt.cancel(ObjectID(msg["oid"]), force=msg.get("force", False))
+        return None
+    if op == "kill_actor":
+        from ray_tpu.utils.ids import ActorID
 
-            rt.kill_actor(ActorID(msg["actor_id"]),
-                          msg.get("no_restart", True))
-            return None
-        if op == "named_actor":
-            aid, cls_name, table = rt.named_actor_handle(msg["name"])
-            return {"actor_id": aid.binary(), "cls_name": cls_name,
-                    "table": table}
-        if op == "create_pg":
-            pg = rt.create_placement_group(
-                msg["bundles"], msg["strategy"], msg["name"],
-                msg.get("lifetime"),
-            )
-            return pg.id.binary()
-        if op == "remove_pg":
-            from ray_tpu.utils.ids import PlacementGroupID
+        rt.kill_actor(ActorID(msg["actor_id"]),
+                      msg.get("no_restart", True))
+        return None
+    if op == "named_actor":
+        aid, cls_name, table = rt.named_actor_handle(msg["name"])
+        return {"actor_id": aid.binary(), "cls_name": cls_name,
+                "table": table}
+    if op == "create_pg":
+        pg = rt.create_placement_group(
+            msg["bundles"], msg["strategy"], msg["name"],
+            msg.get("lifetime"),
+        )
+        return pg.id.binary()
+    if op == "remove_pg":
+        from ray_tpu.utils.ids import PlacementGroupID
 
-            rt.remove_placement_group(PlacementGroupID(msg["pg_id"]))
-            return None
-        if op == "pg_ready":
-            from ray_tpu.utils.ids import PlacementGroupID
+        rt.remove_placement_group(PlacementGroupID(msg["pg_id"]))
+        return None
+    if op == "pg_ready":
+        from ray_tpu.utils.ids import PlacementGroupID
 
-            return rt.pg_ready_ref(
-                PlacementGroupID(msg["pg_id"])).id.binary()
-        if op == "named_pg":
-            pg = rt.get_named_placement_group(msg["name"])
-            return {"pg_id": pg.id.binary(), "bundles": pg.bundle_specs,
-                    "strategy": pg.strategy, "name": pg.name}
-        if op == "pg_table":
-            return rt.placement_group_table()
-        if op == "cluster_resources":
-            return rt.cluster_resources()
-        if op == "available_resources":
-            return rt.available_resources()
-        if op == "nodes":
-            return rt.nodes()
-        if op == "kv_put":
-            return rt.kv.put(msg["key"], msg["value"],
-                             overwrite=msg.get("overwrite", True),
-                             namespace=msg.get("namespace"))
-        if op == "kv_get":
-            return rt.kv.get(msg["key"], namespace=msg.get("namespace"))
-        if op == "kv_del":
-            return rt.kv.delete(msg["key"], namespace=msg.get("namespace"))
-        if op == "kv_keys":
-            return rt.kv.keys(msg.get("prefix", b""),
-                              namespace=msg.get("namespace"))
-        if op == "kv_exists":
-            return rt.kv.exists(msg["key"], namespace=msg.get("namespace"))
-        if op == "ping":
-            return "pong"
-        raise ValueError(f"unknown worker op {op!r}")
+        return rt.pg_ready_ref(
+            PlacementGroupID(msg["pg_id"])).id.binary()
+    if op == "named_pg":
+        pg = rt.get_named_placement_group(msg["name"])
+        return {"pg_id": pg.id.binary(), "bundles": pg.bundle_specs,
+                "strategy": pg.strategy, "name": pg.name}
+    if op == "pg_table":
+        return rt.placement_group_table()
+    if op == "cluster_resources":
+        return rt.cluster_resources()
+    if op == "available_resources":
+        return rt.available_resources()
+    if op == "nodes":
+        return rt.nodes()
+    if op == "kv_put":
+        return rt.kv.put(msg["key"], msg["value"],
+                         overwrite=msg.get("overwrite", True),
+                         namespace=msg.get("namespace"))
+    if op == "kv_get":
+        return rt.kv.get(msg["key"], namespace=msg.get("namespace"))
+    if op == "kv_del":
+        return rt.kv.delete(msg["key"], namespace=msg.get("namespace"))
+    if op == "kv_keys":
+        return rt.kv.keys(msg.get("prefix", b""),
+                          namespace=msg.get("namespace"))
+    if op == "kv_exists":
+        return rt.kv.exists(msg["key"], namespace=msg.get("namespace"))
+    if op == "ping":
+        return "pong"
+    raise ValueError(f"unknown worker op {op!r}")

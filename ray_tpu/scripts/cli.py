@@ -142,12 +142,32 @@ def cmd_job(args, out) -> int:
 
 
 def cmd_start(args, out) -> int:
+    if args.address and not args.head:
+        # Worker-node mode: run a node daemon joined to the head
+        # (parity: `ray start --address=...` starting a raylet).
+        from ray_tpu.core import node_daemon
+
+        argv = ["--address", args.address, "--port", str(args.node_port)]
+        if args.num_cpus is not None:
+            argv += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            argv += ["--num-tpus", str(args.num_tpus)]
+        argv += ["--resources", args.resources, "--labels", args.labels]
+        if args.advertise_host:
+            argv += ["--advertise-host", args.advertise_host]
+        return node_daemon.main(argv)
+
     import ray_tpu
+    from ray_tpu.core import api
+    from ray_tpu.core.node_daemon import NodeServer
     from ray_tpu.dashboard import DashboardHead
 
     ray_tpu.init(num_cpus=args.num_cpus, ignore_reinit_error=True)
+    server = NodeServer(api.runtime(), port=args.port)
     dash = DashboardHead(port=args.dashboard_port).start()
-    print(f"ray_tpu head started; dashboard at {dash.address}", file=out)
+    print(f"ray_tpu head started; join with "
+          f"`ray_tpu start --address <this-host>:{server.port}`; "
+          f"dashboard at {dash.address}", file=out)
     if args.block:
         import signal
 
@@ -156,6 +176,7 @@ def cmd_start(args, out) -> int:
         except KeyboardInterrupt:
             pass
         finally:
+            server.close()
             dash.stop()
             ray_tpu.shutdown()
     return 0
@@ -239,9 +260,24 @@ def build_parser() -> argparse.ArgumentParser:
     ssub.add_parser("status")
     ssub.add_parser("shutdown")
 
-    spp = sub.add_parser("start", help="start a head in this process")
-    spp.add_argument("--head", action="store_true", default=True)
+    spp = sub.add_parser(
+        "start",
+        help="start a head (--head) or join one (--address HOST:PORT)",
+    )
+    spp.add_argument("--head", action="store_true", default=False)
+    spp.add_argument("--address", default="",
+                     help="join an existing head at HOST:PORT")
+    spp.add_argument("--port", type=int, default=6380,
+                     help="head: node-join port (0 = ephemeral)")
+    spp.add_argument("--node-port", type=int, default=0,
+                     help="worker node: peer object-transfer port")
+    spp.add_argument("--advertise-host", default="",
+                     help="address other nodes reach this machine at")
     spp.add_argument("--num-cpus", type=float, default=None)
+    spp.add_argument("--num-tpus", type=float, default=None)
+    spp.add_argument("--resources", default="{}",
+                     help="extra resources as JSON")
+    spp.add_argument("--labels", default="{}", help="node labels as JSON")
     spp.add_argument("--dashboard-port", type=int, default=8265)
     spp.add_argument("--block", action="store_true", default=True)
     spp.add_argument("--no-block", dest="block", action="store_false")

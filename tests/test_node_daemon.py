@@ -1,0 +1,375 @@
+"""Multi-host cluster: real node-daemon processes joined to a head.
+
+Parity targets: the reference's single-machine multi-raylet test trick
+(ray: python/ray/cluster_utils.py:108 — N raylet processes, one GCS),
+node registration (gcs/gcs_server/gcs_server.h:79,
+protobuf/node_manager.proto:363), cross-node object transfer
+(object_manager/object_manager.h:117, pull_manager.h:52), and
+node-death fault tolerance (gcs_node_manager.cc death → actor restart
++ bundle reschedule + object recovery).
+
+These tests run the REAL thing: daemon OS processes with their own
+worker pools and shm arenas, kill -9, chunked TCP object pulls.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api as _api
+from ray_tpu.core.node_daemon import NodeServer
+from ray_tpu.core.placement_group import NodeAffinitySchedulingStrategy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_daemon(port, *, num_cpus=2, resources="{}", labels="{}",
+                  extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("RAYTPU_WORKERS", None)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_daemon",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", str(num_cpus),
+         "--resources", resources, "--labels", labels],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _wait_nodes(rt, n, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if sum(1 for x in rt.nodes() if x["Alive"]) >= n:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"cluster never reached {n} nodes: {rt.nodes()}")
+
+
+class _Cluster:
+    def __init__(self, rt, server, procs):
+        self.rt = rt
+        self.server = server
+        self.procs = procs
+
+    def daemon_node_ids(self):
+        return [n["NodeID"] for n in self.rt.nodes()
+                if n["Labels"].get("daemon") and n["Alive"]]
+
+    def affinity(self, node_id):
+        return NodeAffinitySchedulingStrategy(node_id, soft=False)
+
+
+@pytest.fixture
+def cluster():
+    """Head + 2 daemon processes (each with its own arena + workers)."""
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=2)
+    server = NodeServer(rt, host="127.0.0.1", port=0)
+    procs = [
+        _spawn_daemon(server.port,
+                      resources='{"slot": 1}',
+                      labels='{"daemon": "d%d"}' % i)
+        for i in range(2)
+    ]
+    _wait_nodes(rt, 3)
+    yield _Cluster(rt, server, procs)
+    for p in procs:
+        p.kill()
+    server.close()
+    ray_tpu.shutdown()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except Exception:
+            pass
+
+
+def test_tasks_span_daemon_processes(cluster):
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    pids = set()
+    for nid in cluster.daemon_node_ids():
+        pid = ray_tpu.get(
+            whoami.options(scheduling_strategy=cluster.affinity(nid))
+            .remote())
+        pids.add(pid)
+    assert len(pids) == 2
+    assert os.getpid() not in pids
+    daemon_pids = {p.pid for p in cluster.procs}
+    # Worker processes are children of the daemons, not of the driver.
+    assert pids.isdisjoint(daemon_pids)
+
+
+def test_cross_node_object_transfer(cluster):
+    """Task on node B gets a large array created on node A — the bytes
+    travel the daemon↔daemon pull plane into B's arena."""
+    a, b = cluster.daemon_node_ids()
+
+    @ray_tpu.remote
+    def make():
+        return np.arange(2_000_000, dtype=np.float64)  # 16 MB
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(arr.sum()), os.getpid()
+
+    ref = make.options(scheduling_strategy=cluster.affinity(a)).remote()
+    total, pid = ray_tpu.get(
+        consume.options(scheduling_strategy=cluster.affinity(b))
+        .remote(ref))
+    assert total == 1_999_999 * 2_000_000 / 2
+    # Driver-side get pulls the same primary copy over the head channel.
+    arr = ray_tpu.get(ref)
+    assert arr.shape == (2_000_000,) and arr[-1] == 1_999_999.0
+
+
+def test_consumer_follows_producer_no_transfer(cluster):
+    """B-produced object consumed on B: served straight from B's local
+    arena (the fetch entry resolves locally, no peer pull)."""
+    _, b = cluster.daemon_node_ids()
+    aff = cluster.affinity(b)
+
+    @ray_tpu.remote
+    def make():
+        return np.ones(1_000_000)
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = make.options(scheduling_strategy=aff).remote()
+    assert ray_tpu.get(
+        consume.options(scheduling_strategy=aff).remote(ref)) == 1_000_000.0
+
+
+def test_driver_put_consumed_on_daemon(cluster):
+    nid = cluster.daemon_node_ids()[0]
+    ref = ray_tpu.put(np.full(600_000, 2.0))
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    out = ray_tpu.get(
+        consume.options(scheduling_strategy=cluster.affinity(nid))
+        .remote(ref))
+    assert out == 1_200_000.0
+
+
+def test_broadcast_fans_out_to_all_nodes(cluster):
+    """One big driver-side object consumed by tasks on every node —
+    each daemon pulls once into its arena, concurrent consumers on the
+    same node dedup onto that single pull."""
+    ref = ray_tpu.put(np.ones(1_500_000))
+
+    @ray_tpu.remote
+    def consume(arr, tag):
+        return float(arr.sum()) + tag
+
+    refs = []
+    for i, nid in enumerate(cluster.daemon_node_ids()):
+        aff = cluster.affinity(nid)
+        refs += [consume.options(scheduling_strategy=aff).remote(ref, i)
+                 for _ in range(3)]
+    out = ray_tpu.get(refs)
+    assert sorted(out) == [1_500_000.0] * 3 + [1_500_001.0] * 3
+
+
+def test_actor_on_daemon_and_restart_elsewhere(cluster):
+    """kill -9 of a daemon → its actor restarts on the surviving node
+    (parity: gcs actor FSM restart after node death)."""
+
+    @ray_tpu.remote(max_restarts=1, resources={"slot": 1})
+    class Host:
+        def pid(self):
+            return os.getpid()
+
+    h = Host.remote()
+    pid0 = ray_tpu.get(h.pid.remote())
+    assert pid0 != os.getpid()
+    # Which daemon hosts it?  kill that one.
+    victim = None
+    for proc in cluster.procs:
+        out = subprocess.run(
+            ["ps", "-o", "pid=", "--ppid", str(proc.pid)],
+            capture_output=True, text=True).stdout
+        if str(pid0) in out.split():
+            victim = proc
+            break
+    assert victim is not None, "actor worker not found under any daemon"
+    victim.kill()
+    deadline = time.time() + 30
+    pid1 = None
+    while time.time() < deadline:
+        try:
+            pid1 = ray_tpu.get(h.pid.remote(), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert pid1 is not None and pid1 != pid0
+
+
+def test_daemon_death_reschedules_and_recovers_objects(cluster):
+    """Objects sealed on a killed node reconstruct via lineage when a
+    reader pulls them (parity: ObjectRecoveryManager on fetch)."""
+    a, b = cluster.daemon_node_ids()
+
+    @ray_tpu.remote(max_retries=2)
+    def make():
+        return np.arange(1_000_000, dtype=np.float64)
+
+    ref = make.options(scheduling_strategy=cluster.affinity(a)).remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=30)
+    # Kill the daemon holding the primary copy.
+    labels = {n["NodeID"]: n["Labels"].get("daemon")
+              for n in cluster.rt.nodes()}
+    idx = int(labels[a][1:])  # "d0" → 0
+    cluster.procs[idx].kill()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        alive = [n for n in cluster.rt.nodes()
+                 if n["Alive"] and n["NodeID"] == a]
+        if not alive:
+            break
+        time.sleep(0.2)
+    # Reader triggers lazy reconstruction; the rebuilt copy lands on a
+    # surviving node (affinity falls back when the pinned node died).
+    arr = ray_tpu.get(ref, timeout=60)
+    assert arr.shape == (1_000_000,) and arr[-1] == 999_999.0
+
+
+def test_placement_group_spans_daemons(cluster):
+    from ray_tpu.core.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+                         strategy="STRICT_SPREAD")
+    ray_tpu.get(pg.ready(), timeout=30)
+    st = _api.runtime()._pgs[pg.id]
+    node_ids = {b.node_id for b in st.bundles}
+    assert len(node_ids) == 3  # head + both daemons
+
+    # Tasks run inside the spanning bundles, one per node.
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    pids = ray_tpu.get([
+        whoami.options(placement_group=pg,
+                       placement_bundle_index=i).remote()
+        for i in range(3)
+    ])
+    assert len(set(pids)) == 3
+
+
+def test_spilled_on_node_restores_across_wire():
+    """Objects spilled from a daemon's arena to ITS disk restore over
+    the pull plane when a remote consumer asks (parity: spilled-object
+    restore through the object manager).  The producing daemon gets a
+    tiny arena so sustained production forces arena→disk spill."""
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=2)
+    server = NodeServer(rt, host="127.0.0.1", port=0)
+    procs = [
+        _spawn_daemon(server.port, labels='{"daemon": "small"}',
+                      extra_env={
+                          # 12 MB arena: four 3.2 MB objects overflow
+                          # the 0.8 spill watermark.
+                          "RAYTPU_OBJECT_STORE_MEMORY_BYTES": "12000000",
+                      }),
+        _spawn_daemon(server.port, labels='{"daemon": "big"}'),
+    ]
+    try:
+        _wait_nodes(rt, 3)
+        by_label = {n["Labels"].get("daemon"): n["NodeID"]
+                    for n in rt.nodes() if n["Labels"].get("daemon")}
+        aff_small = NodeAffinitySchedulingStrategy(by_label["small"],
+                                                   soft=False)
+        aff_big = NodeAffinitySchedulingStrategy(by_label["big"],
+                                                 soft=False)
+
+        @ray_tpu.remote
+        def make(i):
+            return np.full(400_000, float(i))  # ~3.2 MB each
+
+        @ray_tpu.remote
+        def consume(arr):
+            return float(arr[0])
+
+        refs = [make.options(scheduling_strategy=aff_small).remote(i)
+                for i in range(6)]
+        ray_tpu.wait(refs, num_returns=6, timeout=60)
+        node = rt.node_by_hex(by_label["small"])
+        stats = node.agent.stats()["store"]
+        assert stats["spilled_objects"] > 0, stats
+        out = ray_tpu.get([
+            consume.options(scheduling_strategy=aff_big).remote(r)
+            for r in refs
+        ], timeout=60)
+        assert out == [float(i) for i in range(6)]
+        # Restores actually happened on the small node.
+        stats = node.agent.stats()["store"]
+        assert stats["restored_objects"] > 0, stats
+    finally:
+        for p in procs:
+            p.kill()
+        server.close()
+        ray_tpu.shutdown()
+
+
+def test_nested_submission_from_daemon_worker(cluster):
+    """A task on a daemon submits sub-tasks through its daemon to the
+    head scheduler (the nested-API forwarding plane)."""
+    nid = cluster.daemon_node_ids()[0]
+
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer():
+        return ray_tpu.get([inner.remote(i) for i in range(4)])
+
+    out = ray_tpu.get(
+        outer.options(scheduling_strategy=cluster.affinity(nid)).remote())
+    assert out == [0, 2, 4, 6]
+
+
+def test_named_actor_visible_from_daemon_worker(cluster):
+    nid = cluster.daemon_node_ids()[0]
+
+    @ray_tpu.remote
+    class Registry:
+        def __init__(self):
+            self.v = {}
+
+        def put(self, k, v):
+            self.v[k] = v
+            return True
+
+        def get(self, k):
+            return self.v.get(k)
+
+    reg = Registry.options(name="reg").remote()
+    ray_tpu.get(reg.put.remote("x", 41))
+
+    @ray_tpu.remote
+    def use_named():
+        h = ray_tpu.get_actor("reg")
+        ray_tpu.get(h.put.remote("y", 1))
+        return ray_tpu.get(h.get.remote("x"))
+
+    assert ray_tpu.get(
+        use_named.options(scheduling_strategy=cluster.affinity(nid))
+        .remote()) == 41
+    assert ray_tpu.get(reg.get.remote("y")) == 1
