@@ -790,6 +790,17 @@ class _ProcessActorShell(_ActorShell):
                       num_returns, task_id, trace_ctx, task_hex):
         import cloudpickle as _cp
 
+        method = getattr(self.cls, method_name, None)
+        if (_inspect.iscoroutinefunction(method)
+                and num_returns != "streaming"):
+            # Async actor method: dispatch WITHOUT blocking the serve
+            # loop, so N calls are in flight to the worker together and
+            # interleave on its shared event loop (parity: fiber.h
+            # async actors — the thread shell's _execute_async
+            # equivalent across the process boundary).
+            return self._execute_async_remote(
+                qname, method_name, args, kwargs, return_ids,
+                num_returns, task_id, trace_ctx, task_hex)
         wire_args, wire_kwargs = self.runtime._wire_args(args, kwargs)
         if task_id is not None:
             with self._cancel_lock:
@@ -816,6 +827,70 @@ class _ProcessActorShell(_ActorShell):
                 node_hex=getattr(self._worker, "node_hex", None))
         else:
             self.runtime.apply_ref_batches(rep, wkey)
+
+    def _execute_async_remote(self, qname, method_name, args, kwargs,
+                              return_ids, num_returns, task_id, trace_ctx,
+                              task_hex):
+        import cloudpickle as _cp
+
+        from ray_tpu.core.exceptions import WorkerDiedError
+
+        if self._async_sem is None:
+            limit = int(self.options.max_concurrency)
+            self._async_sem = threading.Semaphore(
+                limit if limit > 1 else 1000)
+        wire_args, wire_kwargs = self.runtime._wire_args(args, kwargs)
+        spec = _cp.dumps((wire_args, wire_kwargs))
+        wh = self._worker
+        # At the concurrency cap the serve loop blocks here — the same
+        # bound the thread shell's asyncio.Semaphore enforces.
+        self._async_sem.acquire()
+        if task_id is not None:
+            with self._cancel_lock:
+                self._running_sync[task_id] = True
+        ev = self.runtime.events
+        ctx = _tracing().capture_context()
+
+        def run():
+            try:
+                try:
+                    rep = wh.call(
+                        "actor_task", method=method_name, spec=spec,
+                        num_returns=num_returns,
+                        returns=[oid.binary() for oid in return_ids],
+                        task=(task_id.binary() if task_id is not None
+                              else b""),
+                        trace_ctx=ctx,
+                    )
+                finally:
+                    if task_id is not None:
+                        with self._cancel_lock:
+                            self._running_sync.pop(task_id, None)
+                self.runtime.seal_remote_results(
+                    return_ids, rep,
+                    self.runtime._worker_ref_key(wh),
+                    node_hex=getattr(wh, "node_hex", None))
+                if task_hex:
+                    ev.record(task_hex, _ev.FINISHED)
+            except BaseException as e:
+                if isinstance(e, WorkerDiedError):
+                    err: BaseException = ActorDiedError(
+                        repr(self.cls), "worker process died")
+                    self._worker_died()
+                elif isinstance(e, TaskCancelledError):
+                    err = e
+                else:
+                    err = TaskError(qname, e)
+                for oid in return_ids:
+                    self.runtime.store.put_error_if_pending(oid, err)
+                if task_hex:
+                    ev.record(task_hex, _ev.FAILED, error_message=repr(err))
+            finally:
+                self._async_sem.release()
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"{qname}-async").start()
+        return _ASYNC_DEFERRED
 
     def _item_error(self, qname: str, e: BaseException) -> BaseException:
         from ray_tpu.core.exceptions import WorkerDiedError

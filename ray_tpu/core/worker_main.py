@@ -746,6 +746,16 @@ class _WorkerServer:
     def _actor_task(self, msg: Dict[str, Any]) -> Any:
         if self._actor_instance is None:
             raise RuntimeError("no actor constructed in this worker")
+        import inspect as _inspect
+
+        method = getattr(self._actor_instance, msg["method"], None)
+        if _inspect.iscoroutinefunction(method):
+            # Async methods bypass the executor: each request's handler
+            # thread parks on the coroutine's future while the SHARED
+            # loop interleaves all of them (parity: fiber.h async
+            # actors) — routing through the 1-thread executor would
+            # serialize exactly what async actors exist to overlap.
+            return self._actor_task_body(msg)
         return self._actor_exec.run(lambda: self._actor_task_body(msg))
 
     def _actor_task_body(self, msg: Dict[str, Any]) -> Any:

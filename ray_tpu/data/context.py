@@ -23,6 +23,13 @@ class DataContext:
     prefetch_batches: int = 2
     # CPUs requested per block task.
     cpus_per_task: float = 1.0
+    # Operator memory budget: pause task submission while the
+    # pipeline's live produced blocks exceed this many bytes (0 = no
+    # byte budget; parity: per-op object-store budgets in
+    # streaming_executor_state.py:376 — here one shared pipeline
+    # budget, which the linear plans this executor runs make
+    # equivalent).
+    op_memory_budget_bytes: int = 0
 
     _instance = None
     _lock = threading.Lock()

@@ -103,7 +103,8 @@ class Dataset:
             rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
             return BlockAccessor.from_rows(rows)
 
-        return self._append(MapOp(per_block, name=f"Map({_name(fn)})"))
+        return self._append(MapOp(per_block, name=f"Map({_name(fn)})",
+                                  preserves_cardinality=True))
 
     def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
         def per_block(block: Block) -> Block:
@@ -130,19 +131,22 @@ class Dataset:
             out[name] = np.asarray(fn(block))
             return out
 
-        return self._append(MapOp(per_block, name=f"AddColumn({name})"))
+        return self._append(MapOp(per_block, name=f"AddColumn({name})",
+                                  preserves_cardinality=True))
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
         def per_block(block: Block) -> Block:
             return {k: v for k, v in block.items() if k not in cols}
 
-        return self._append(MapOp(per_block, name="DropColumns"))
+        return self._append(MapOp(per_block, name="DropColumns",
+                                  preserves_cardinality=True))
 
     def select_columns(self, cols: List[str]) -> "Dataset":
         def per_block(block: Block) -> Block:
             return {k: block[k] for k in cols}
 
-        return self._append(MapOp(per_block, name="SelectColumns"))
+        return self._append(MapOp(per_block, name="SelectColumns",
+                                  preserves_cardinality=True))
 
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._append(make_repartition(num_blocks))

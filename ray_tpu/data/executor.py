@@ -52,13 +52,24 @@ class ReadOp:
 class MapOp:
     """Per-block transform: fn(Block) -> Block."""
 
-    fn: Callable[[Block], Block]
+    fn: Optional[Callable[[Block], Block]]
     name: str = "Map"
     # Actor-pool compute: run the transform inside a pool of stateful
     # actors instead of stateless tasks (parity: ActorPoolMapOperator).
     actor_pool_size: int = 0
     fn_constructor: Optional[Callable[[], Any]] = None
     batch_size: Optional[int] = None  # sub-batching inside pool workers
+    # Exactly one output row per input row — lets LimitPushdown hop a
+    # Limit over this op (parity: logical op cardinality metadata).
+    preserves_cardinality: bool = False
+    # Set by the MapFusion rule: the fused chain this op stands for.
+    fused_fns: Optional[List[Callable[[Block], Block]]] = None
+
+    @property
+    def fns(self) -> List[Callable[[Block], Block]]:
+        if self.fused_fns is not None:
+            return list(self.fused_fns)
+        return [self.fn] if self.fn is not None else []
 
 
 @dataclasses.dataclass
@@ -133,7 +144,10 @@ class StreamingExecutor:
     in-flight work."""
 
     def __init__(self, ops: List[Op], ctx: Optional[DataContext] = None):
-        self.ops = ops
+        from ray_tpu.data.logical_plan import LogicalPlan
+
+        self.plan = LogicalPlan(list(ops)).optimized()
+        self.ops = self.plan.ops
         self.ctx = ctx or DataContext.get_current()
         self.stats: List[StageStats] = []
         self._remote_chain_read = ray_tpu.remote(
@@ -142,6 +156,49 @@ class StreamingExecutor:
             num_cpus=self.ctx.cpus_per_task)(_chain_block)
         self.remote_num_rows = ray_tpu.remote(num_cpus=0.25)(_num_rows)
         self.remote_slice = ray_tpu.remote(num_cpus=0.25)(_slice_block)
+        # Live-block ledger for operator backpressure (parity: per-op
+        # object-store budgets, streaming_executor_state.py:376): refs
+        # this execution produced whose store entries are still live.
+        self._produced: List[Any] = []
+        self.peak_live_bytes = 0
+
+    # -- operator memory backpressure --------------------------------------
+
+    def _track(self, ref) -> None:
+        if self.ctx.op_memory_budget_bytes > 0:
+            self._produced.append(ref)
+
+    def _live_bytes(self) -> int:
+        """Bytes of produced blocks still alive in the object store —
+        the pipeline's working-set footprint.  Freed/pending entries
+        prune out; the ledger is the backpressure signal."""
+        from ray_tpu.core import api
+
+        try:
+            store = api.runtime().store
+            objects = store._objects
+        except Exception:
+            return 0
+        total = 0
+        live = []
+        for ref in self._produced:
+            st = objects.get(ref.id)
+            if st is None or not st.event.is_set():
+                if st is not None:
+                    live.append(ref)  # pending: still in flight
+                continue
+            live.append(ref)
+            if st.in_shm or st.remote_node is not None:
+                total += st.shm_size
+            elif st.value_bytes is not None:
+                total += len(st.value_bytes)
+        self._produced = live
+        self.peak_live_bytes = max(self.peak_live_bytes, total)
+        return total
+
+    def _under_budget(self) -> bool:
+        budget = self.ctx.op_memory_budget_bytes
+        return budget <= 0 or self._live_bytes() < budget
 
     # -- public -----------------------------------------------------------
 
@@ -171,7 +228,15 @@ class StreamingExecutor:
     # -- segmentation -----------------------------------------------------
 
     def _segment_ops(self):
-        """Group ops into [source+fused maps][all2all][fused maps]..."""
+        """Group ops into [source+fused maps][all2all][fused maps]...
+
+        Plans arriving here are already MapFusion-optimized (adjacent
+        stateless maps merged by the logical rule, logical_plan.py), so
+        the grouping loops below usually see single pre-fused ops; they
+        remain as a fallback for hand-built op lists that bypass the
+        optimizer.  Read-op fusion (folding the leading map chain into
+        the read tasks themselves) is genuinely segmentation's job —
+        the logical rule cannot merge into a ReadOp."""
         segments: List[Any] = []
         i = 0
         ops = self.ops
@@ -211,7 +276,7 @@ class StreamingExecutor:
         if parallelism in (-1, None):
             parallelism = self.ctx.max_in_flight_tasks * 2
         tasks = read.datasource.get_read_tasks(parallelism)
-        fns = [m.fn for m in fused]
+        fns = [f for m in fused for f in m.fns]
         name = "+".join([read.name] + [m.name for m in fused])
         t0 = time.perf_counter()
         stat = StageStats(name, len(tasks))
@@ -219,24 +284,33 @@ class StreamingExecutor:
         window = self.ctx.max_in_flight_tasks
         pending = deque()
         it = iter(tasks)
-        try:
-            for _ in range(window):
-                pending.append(self._remote_chain_read.remote(next(it), fns))
-        except StopIteration:
-            it = None
-        while pending:
-            ref = pending.popleft()
-            if it is not None:
+
+        def launch_more():
+            nonlocal it
+            # Budget guard: pause submission while the pipeline's live
+            # blocks exceed the operator memory budget — but always
+            # keep at least one task in flight (no deadlock).
+            while it is not None and len(pending) < window and (
+                not pending or self._under_budget()
+            ):
                 try:
-                    pending.append(self._remote_chain_read.remote(next(it), fns))
+                    ref = self._remote_chain_read.remote(next(it), fns)
                 except StopIteration:
                     it = None
+                    return
+                self._track(ref)
+                pending.append(ref)
+
+        launch_more()
+        while pending:
+            ref = pending.popleft()
+            launch_more()
             yield ref
         stat.wall_s = time.perf_counter() - t0
 
     def _run_map_segment(self, stream: Iterator[Any],
                          fused: List[MapOp]) -> Iterator[Any]:
-        fns = [m.fn for m in fused]
+        fns = [f for m in fused for f in m.fns]
         name = "+".join(m.name for m in fused)
         t0 = time.perf_counter()
         stat = StageStats(name)
@@ -245,13 +319,17 @@ class StreamingExecutor:
         pending = deque()
         exhausted = False
         while True:
-            while not exhausted and len(pending) < window:
+            while not exhausted and len(pending) < window and (
+                not pending or self._under_budget()
+            ):
                 try:
                     up = next(stream)
                 except StopIteration:
                     exhausted = True
                     break
-                pending.append(self._remote_chain_block.remote(up, fns))
+                ref = self._remote_chain_block.remote(up, fns)
+                self._track(ref)
+                pending.append(ref)
                 stat.tasks += 1
             if not pending:
                 break

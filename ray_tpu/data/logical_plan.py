@@ -1,0 +1,96 @@
+"""Logical plan + rule-based optimizer for Data pipelines.
+
+Parity: the reference's logical operator tree and rule registry
+(ray: python/ray/data/_internal/logical/interfaces/logical_plan.py,
+logical/optimizers.py — LogicalOptimizer applying rules like
+OperatorFusionRule and LimitPushdownRule before physical planning).
+Here the plan is the op list a Dataset accumulates; rules rewrite it
+before the StreamingExecutor segments it into task pipelines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence
+
+
+@dataclasses.dataclass
+class LogicalPlan:
+    """An ordered chain of logical ops (linear plans only — the
+    dataset API builds chains; joins/unions would widen this to a
+    DAG)."""
+
+    ops: List[Any]
+
+    def optimized(self, rules: Sequence["Rule"] = None) -> "LogicalPlan":
+        plan = self
+        for rule in (DEFAULT_RULES if rules is None else rules):
+            plan = rule.apply(plan)
+        return plan
+
+    def describe(self) -> str:
+        return " -> ".join(getattr(op, "name", type(op).__name__)
+                           for op in self.ops)
+
+
+class Rule:
+    """One rewrite pass (parity: logical/interfaces/optimizer.py Rule)."""
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:  # pragma: no cover
+        raise NotImplementedError
+
+
+class LimitPushdown(Rule):
+    """Move a Limit upstream past cardinality-preserving maps so fewer
+    rows pay the map (parity: logical/rules/limit_pushdown.py).  A
+    Limit can hop over a MapOp only when the map emits exactly one row
+    per input row (``preserves_cardinality``) — filters/flat-maps
+    change row counts and block the hop."""
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        from ray_tpu.data.executor import LimitOp, MapOp
+
+        ops = list(plan.ops)
+        changed = True
+        while changed:
+            changed = False
+            for i in range(1, len(ops)):
+                if (isinstance(ops[i], LimitOp)
+                        and isinstance(ops[i - 1], MapOp)
+                        and ops[i - 1].preserves_cardinality
+                        and not ops[i - 1].actor_pool_size):
+                    ops[i - 1], ops[i] = ops[i], ops[i - 1]
+                    changed = True
+        return LogicalPlan(ops)
+
+
+class MapFusion(Rule):
+    """Fuse chains of stateless per-block maps into one op, so a
+    read→map→filter chain costs one task per block (parity:
+    logical/rules/operator_fusion.py MapFusionRule).  Actor-pool maps
+    keep their own stage (their state lives in pool actors)."""
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        from ray_tpu.data.executor import MapOp, _chain_block
+
+        out: List[Any] = []
+        for op in plan.ops:
+            prev = out[-1] if out else None
+            if (isinstance(op, MapOp) and not op.actor_pool_size
+                    and isinstance(prev, MapOp)
+                    and not prev.actor_pool_size):
+                fns = list(prev.fused_fns or [prev.fn]) + \
+                    list(op.fused_fns or [op.fn])
+                out[-1] = MapOp(
+                    fn=None,
+                    name=f"{prev.name}+{op.name}",
+                    preserves_cardinality=(prev.preserves_cardinality
+                                           and op.preserves_cardinality),
+                    fused_fns=fns,
+                )
+            else:
+                out.append(op)
+        return LogicalPlan(out)
+
+
+DEFAULT_RULES = (LimitPushdown(), MapFusion())

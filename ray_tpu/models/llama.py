@@ -430,6 +430,7 @@ def prefill(
 
     def body(carry, layer):
         x = carry
+        layer = _deq_layer(layer, cfg.dtype)
         normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
         out, (k, v) = _attn_block(normed, layer, cfg, sin, cos, None)
         h = x + out
@@ -496,6 +497,101 @@ def prefill_slot(
     return logits.astype(jnp.float32), cache
 
 
+def prefill_batch(
+    params: Params,
+    tokens: jax.Array,
+    true_lens: jax.Array,
+    slots: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Prefill K sequences in ONE batched forward (the MXU-friendly
+    admission path: [K, S] beats K sequential [1, S] passes ~K-fold).
+
+    tokens [K, S], true_lens [K], slots [K] → (logits at each row's
+    true_len-1 [K, V], cache).  Rows attend only within themselves
+    (standard causal batch); duplicate slot ids (admission padding
+    rows) write identical values, so last-wins is benign."""
+    K, S = tokens.shape
+    positions = jnp.arange(S)[None, :]
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+
+    def body(carry, layer):
+        x = carry
+        layer = _deq_layer(layer, cfg.dtype)
+        normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        out, (k, v) = _attn_block(normed, layer, cfg, sin, cos, None)
+        h = x + out
+        h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
+        return h, (k, v)
+
+    x, (k_all, v_all) = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]  # [K, D]
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = last @ _deq_head(head, cfg.dtype)
+
+    # k_all/v_all [L, K, S, KVH, D] → scatter whole rows into slots.
+    cache = dict(cache)
+    cache["k"] = cache["k"].at[:, slots, :S].set(k_all)
+    cache["v"] = cache["v"].at[:, slots, :S].set(v_all)
+    cache["length"] = cache["length"].at[slots].set(true_lens)
+    return logits.astype(jnp.float32), cache
+
+
+def prefill_batch_paged(
+    params: Params,
+    tokens: jax.Array,
+    true_lens: jax.Array,
+    pages_rows: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Batched prefill into the PAGE POOL: one [K, S] forward, then one
+    scatter of all K rows' page chunks (pages_rows [K, S // page]).
+    Rows own disjoint pages (padding duplicates write identical data)."""
+    K, S = tokens.shape
+    page = cache["k"].shape[3]
+    positions = jnp.arange(S)[None, :]
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+
+    def body(carry, layer):
+        x = carry
+        layer = _deq_layer(layer, cfg.dtype)
+        normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        out, (k, v) = _attn_block(normed, layer, cfg, sin, cos, None)
+        h = x + out
+        h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
+        return h, (k, v)
+
+    x, (k_all, v_all) = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = last @ _deq_head(head, cfg.dtype)
+
+    # [L, K, S, KVH, D] → [L, KVH, K * S/page, page, D]; one scatter.
+    npg = S // page
+    def to_pages(a):
+        a = a.transpose(0, 3, 1, 2, 4)  # [L, KVH, K, S, D]
+        L, KVH = a.shape[0], a.shape[1]
+        return a.reshape(L, KVH, K * npg, page, a.shape[-1])
+
+    page_ids = pages_rows[:, :npg].reshape(K * npg)
+    cache = dict(cache)
+    cache["k"] = cache["k"].at[:, :, page_ids].set(to_pages(k_all))
+    cache["v"] = cache["v"].at[:, :, page_ids].set(to_pages(v_all))
+    return logits.astype(jnp.float32), cache
+
+
 def decode_slots(
     params: Params,
     tokens: jax.Array,
@@ -540,6 +636,33 @@ def decode_slots(
     return logits.astype(jnp.float32), cache
 
 
+# --- quantized-weight support (w8a16 serving, models/quant.py) -------------
+
+def _is_qdict(node) -> bool:
+    return isinstance(node, dict) and set(node.keys()) == {"q", "scale"}
+
+
+def _deq_layer(layer, dtype):
+    """Dequantize one layer's int8 leaves INSIDE the scan body — per
+    layer, so XLA cannot hoist a full-model bf16 materialization out of
+    the loop (which would defeat the int8 memory win: an 8B model's
+    dequantized tree is 16 GB).  Identity for unquantized layers."""
+    def walk(node):
+        if _is_qdict(node):
+            return node["q"].astype(dtype) * node["scale"].astype(dtype)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(layer)
+
+
+def _deq_head(node, dtype):
+    if _is_qdict(node):
+        return node["q"].astype(dtype) * node["scale"].astype(dtype)
+    return node.astype(dtype)
+
+
 # --- paged inference (block-table KV cache) --------------------------------
 
 def init_paged_cache(cfg: LlamaConfig, num_pages: int,
@@ -572,6 +695,7 @@ def prefill_slot_paged(
 
     def body(carry, layer):
         x = carry
+        layer = _deq_layer(layer, cfg.dtype)
         normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
         out, (k, v) = _attn_block(normed, layer, cfg, sin, cos, None)
         h = x + out
@@ -581,8 +705,9 @@ def prefill_slot_paged(
     x, (k_all, v_all) = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last = lax.dynamic_index_in_dim(x[0], true_len - 1, axis=0, keepdims=False)
-    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
-    logits = last @ head.astype(cfg.dtype)
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = last @ _deq_head(head, cfg.dtype)
 
     # k_all/v_all [L, S, KVH, D] → [L, KVH, S, D], then one
     # dynamic_update_slice per page chunk.
@@ -634,6 +759,7 @@ def decode_slots_paged(
     def body(carry, inputs):
         x = carry
         layer, k_pages, v_pages = inputs
+        layer = _deq_layer(layer, cfg.dtype)
         normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
         q, k, v = _qkv(normed, layer, cfg, sin, cos)
         # k/v [B, 1, KVH, D] → write at [kvh, pids[b], offs[b]].
@@ -654,8 +780,9 @@ def decode_slots_paged(
     x, (k_new, v_new) = lax.scan(body, x, (params["layers"], cache["k"],
                                            cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], _deq_head(head, cfg.dtype))
     return (logits.astype(jnp.float32), {"k": k_new, "v": v_new}, new_len)
 
 

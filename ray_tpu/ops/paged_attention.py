@@ -124,10 +124,16 @@ def paged_decode_attention(
         in_specs=[
             pl.BlockSpec((1, KVH, qpg_p, D),
                          lambda b, p, bt, ln: (b, 0, 0, 0)),
+            # Clamp the page index: unallocated block-table entries
+            # hold an OOB sentinel (== P); their grid cells are
+            # compute-masked (p*page >= length) but the BlockSpec DMA
+            # still runs, so the fetch must stay in bounds.
             pl.BlockSpec((KVH, 1, page, D),
-                         lambda b, p, bt, ln: (0, bt[b, p], 0, 0)),
+                         lambda b, p, bt, ln: (
+                             0, jnp.minimum(bt[b, p], P - 1), 0, 0)),
             pl.BlockSpec((KVH, 1, page, D),
-                         lambda b, p, bt, ln: (0, bt[b, p], 0, 0)),
+                         lambda b, p, bt, ln: (
+                             0, jnp.minimum(bt[b, p], P - 1), 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, KVH, qpg_p, D),
                                lambda b, p, bt, ln: (b, 0, 0, 0)),

@@ -13,17 +13,22 @@ Distributed sampling uses EnvRunner actors over ray_tpu.core.
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.algorithms import (DQN, IMPALA, PPO, DQNConfig,
-                                      IMPALAConfig, PPOConfig, vtrace)
+from ray_tpu.rllib.algorithms import (DQN, IMPALA, PPO, SAC, DQNConfig,
+                                      IMPALAConfig, PPOConfig, SACConfig,
+                                      vtrace)
 from ray_tpu.rllib.env import (CartPole, ExternalEnv, Pendulum, make_env,
                                register_env)
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
 from ray_tpu.rllib.models import ActorCritic
+from ray_tpu.rllib.multi_agent import (MultiAgentPPO, MultiAgentPPOConfig,
+                                       TwoAgentReach)
 from ray_tpu.rllib.replay_buffer import DeviceReplayBuffer, HostReplayBuffer
 
 __all__ = [
     "Algorithm", "AlgorithmConfig",
     "PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
+    "SAC", "SACConfig", "MultiAgentPPO", "MultiAgentPPOConfig",
+    "TwoAgentReach",
     "vtrace",
     "CartPole", "Pendulum", "ExternalEnv", "make_env", "register_env",
     "EnvRunnerGroup", "ActorCritic",

@@ -2,6 +2,7 @@ from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.grpo import GRPO, GRPOConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ = ["GRPO", "GRPOConfig", "PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA",
-           "IMPALAConfig", "vtrace"]
+           "IMPALAConfig", "vtrace", "SAC", "SACConfig"]

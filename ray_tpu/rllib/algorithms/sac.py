@@ -1,0 +1,293 @@
+"""SAC — soft actor-critic for continuous control.
+
+Parity target: the reference's SAC (ray: rllib/algorithms/sac/sac.py —
+twin Q critics with target networks, squashed-Gaussian actor, automatic
+entropy-temperature tuning).  TPU redesign like DQN here: the replay
+buffer is device-resident and one ``train()`` iteration — K env steps
+interleaved with SGD updates on actor, critics, and temperature — is a
+single ``lax.scan`` inside one jit; nothing touches the host between
+iterations.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import apply_mlp, init_mlp
+from ray_tpu.rllib.replay_buffer import DeviceReplayBuffer
+
+_LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env = "Pendulum-v1"
+        self.lr = 3e-4
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1_000
+        self.train_batch_size = 256
+        self.train_freq = 1          # env steps (per env) between updates
+        self.tau = 0.005             # target-network soft-update rate
+        self.init_alpha = 0.1
+        self.target_entropy: float = None  # default: -action_size
+        self.action_scale: float = None    # default: env.max_torque-ish 1.0
+        self.steps_per_iteration = 256
+        self.num_envs = 8
+        self.hidden = (128, 128)
+
+    @property
+    def algo_class(self):
+        return SAC
+
+
+def _actor_dist(params, obs):
+    out = apply_mlp(params, obs)
+    mu, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+    return mu, log_std
+
+
+def _sample_squashed(params, obs, key, scale):
+    """tanh-squashed Gaussian sample + log-prob (the SAC policy head)."""
+    mu, log_std = _actor_dist(params, obs)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mu.shape)
+    pre = mu + std * eps
+    a = jnp.tanh(pre)
+    # log π with the tanh change-of-variables correction.
+    logp = (-0.5 * (eps**2 + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+    logp = logp - jnp.log(1 - a**2 + 1e-6).sum(-1)
+    return a * scale, logp
+
+
+def _q(params, obs, act):
+    x = jnp.concatenate([obs, act], axis=-1)
+    return jnp.squeeze(apply_mlp(params, x), -1)
+
+
+class SAC(Algorithm):
+    config_class = SACConfig
+
+    def _setup(self) -> None:
+        cfg = self.config
+        env = self.env
+        if env.discrete:
+            raise ValueError("SAC here targets continuous action spaces "
+                             "(use DQN/PPO for discrete)")
+        obs_dim, act_dim = env.observation_size, env.action_size
+        if cfg.target_entropy is None:
+            cfg.target_entropy = -float(act_dim)
+        if cfg.action_scale is None:
+            cfg.action_scale = float(getattr(env, "max_torque", 1.0))
+        key = jax.random.key(cfg.seed)
+        key, ka, k1, k2, kr = jax.random.split(key, 5)
+        self.params = {
+            "actor": init_mlp(ka, obs_dim, cfg.hidden, 2 * act_dim,
+                              final_scale=0.01),
+            "q1": init_mlp(k1, obs_dim + act_dim, cfg.hidden, 1,
+                           final_scale=1.0),
+            "q2": init_mlp(k2, obs_dim + act_dim, cfg.hidden, 1,
+                           final_scale=1.0),
+            "log_alpha": jnp.log(jnp.float32(cfg.init_alpha)),
+        }
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = DeviceReplayBuffer(cfg.buffer_capacity, {
+            "obs": ((obs_dim,), jnp.float32),
+            "action": ((act_dim,), jnp.float32),
+            "reward": ((), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "done": ((), jnp.float32),
+        })
+        self.buf_state = self.buffer.init()
+        reset_keys = jax.random.split(kr, cfg.num_envs)
+        self.env_state, self.obs = jax.vmap(env.reset)(reset_keys)
+        self.ep_ret = jnp.zeros(cfg.num_envs)
+        self.total_env_steps = jnp.zeros((), jnp.int32)
+        self.key = key
+        self._iteration_fn = jax.jit(
+            partial(_sac_iteration, env, self.buffer, self.tx,
+                    _static_cfg(cfg))
+        )
+
+    def _train_once(self) -> Dict[str, Any]:
+        self.key, it_key = jax.random.split(self.key)
+        (self.params, self.target_q, self.opt_state, self.buf_state,
+         self.env_state, self.obs, self.ep_ret, self.total_env_steps,
+         metrics) = self._iteration_fn(
+            self.params, self.target_q, self.opt_state, self.buf_state,
+            self.env_state, self.obs, self.ep_ret, self.total_env_steps,
+            it_key,
+        )
+        out = {k: float(v) for k, v in metrics.items()}
+        out["_timesteps"] = (
+            self.config.steps_per_iteration * self.config.num_envs
+        )
+        return out
+
+    def compute_single_action(self, obs, explore: bool = False):
+        cfg = self.config
+        obs = jnp.asarray(obs)[None]
+        if explore:
+            self.key, k = jax.random.split(self.key)
+            a, _ = _sample_squashed(self.params["actor"], obs, k,
+                                    cfg.action_scale)
+            return np.asarray(a[0])
+        mu, _ = _actor_dist(self.params["actor"], obs)
+        return np.asarray(jnp.tanh(mu[0]) * cfg.action_scale)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "params": jax.device_get(self.params),
+            "target_q": jax.device_get(self.target_q),
+            "opt_state": jax.device_get(self.opt_state),
+            "iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "total_env_steps": int(self.total_env_steps),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.target_q = jax.device_put(state["target_q"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+        self.total_env_steps = jnp.asarray(
+            state["total_env_steps"], jnp.int32
+        )
+
+
+def _static_cfg(cfg: SACConfig):
+    return (cfg.steps_per_iteration, cfg.train_batch_size, cfg.train_freq,
+            cfg.gamma, cfg.tau, cfg.target_entropy, cfg.action_scale,
+            cfg.learning_starts)
+
+
+def _sac_iteration(env, buffer, tx, scfg, params, target_q, opt_state,
+                   buf_state, env_state, obs, ep_ret, total_steps, key):
+    (T, batch_size, train_freq, gamma, tau, target_entropy, scale,
+     learning_starts) = scfg
+    n_envs = obs.shape[0]
+    v_step = jax.vmap(env.step)
+    v_reset = jax.vmap(env.reset)
+
+    def losses(p, tq, mb, k):
+        k1, k2 = jax.random.split(k)
+        alpha = jnp.exp(p["log_alpha"])
+        # Critic target from the CURRENT policy at s'.
+        a_next, logp_next = _sample_squashed(p["actor"], mb["next_obs"],
+                                             k1, scale)
+        q_next = jnp.minimum(
+            _q(tq["q1"], mb["next_obs"], a_next),
+            _q(tq["q2"], mb["next_obs"], a_next),
+        ) - lax.stop_gradient(alpha) * logp_next
+        target = mb["reward"] + gamma * (1 - mb["done"]) * q_next
+        target = lax.stop_gradient(target)
+        q1 = _q(p["q1"], mb["obs"], mb["action"])
+        q2 = _q(p["q2"], mb["obs"], mb["action"])
+        critic_loss = jnp.mean((q1 - target) ** 2) \
+            + jnp.mean((q2 - target) ** 2)
+        # Actor: maximize min-Q minus entropy penalty (critics frozen).
+        a_pi, logp_pi = _sample_squashed(p["actor"], mb["obs"], k2, scale)
+        q_pi = jnp.minimum(
+            _q(lax.stop_gradient(p["q1"]), mb["obs"], a_pi),
+            _q(lax.stop_gradient(p["q2"]), mb["obs"], a_pi),
+        )
+        actor_loss = jnp.mean(lax.stop_gradient(alpha) * logp_pi - q_pi)
+        # Temperature: drive entropy to the target.
+        alpha_loss = -jnp.mean(
+            p["log_alpha"]
+            * lax.stop_gradient(logp_pi + target_entropy)
+        )
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {"critic_loss": critic_loss,
+                       "actor_loss": actor_loss,
+                       "alpha": alpha,
+                       "entropy": -jnp.mean(logp_pi)}
+
+    def one_step(carry, step_key):
+        (params, target_q, opt_state, buf_state, env_state, obs, ep_ret,
+         total_steps, ret_sum, ret_cnt) = carry
+        k_act, k_reset, k_sample, k_loss = jax.random.split(step_key, 4)
+        act_keys = jax.random.split(k_act, n_envs)
+        action, _ = jax.vmap(
+            lambda o, k: _sample_squashed(params["actor"], o[None], k,
+                                          scale)
+        )(obs, act_keys)
+        action = action[:, 0]
+        next_env_state, next_obs, reward, done = v_step(env_state, action)
+        buf_state = buffer.add_batch(buf_state, {
+            "obs": obs, "action": action, "reward": reward,
+            "next_obs": next_obs, "done": done.astype(jnp.float32),
+        })
+        ep_ret = ep_ret + reward
+        ret_sum = ret_sum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+        ret_cnt = ret_cnt + jnp.sum(done)
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        reset_keys = jax.random.split(k_reset, n_envs)
+        r_state, r_obs = v_reset(reset_keys)
+        next_env_state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(
+                jnp.reshape(done, done.shape + (1,) * (r.ndim - 1)), r, c
+            ),
+            r_state, next_env_state,
+        )
+        next_obs = jnp.where(done[:, None], r_obs, next_obs)
+        total_steps = total_steps + n_envs
+
+        def do_update(args):
+            params, target_q, opt_state = args
+            mb = buffer.sample(buf_state, k_sample, batch_size)
+            (l, aux), grads = jax.value_and_grad(losses, has_aux=True)(
+                params, target_q, mb, k_loss
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_q = jax.tree_util.tree_map(
+                lambda t, o: (1 - tau) * t + tau * o,
+                target_q, {"q1": params["q1"], "q2": params["q2"]},
+            )
+            return params, target_q, opt_state, aux["critic_loss"], \
+                aux["alpha"], aux["entropy"]
+
+        should_train = (
+            (buf_state.size >= learning_starts)
+            & ((total_steps // n_envs) % max(train_freq, 1) == 0)
+        )
+        params, target_q, opt_state, closs, alpha, ent = lax.cond(
+            should_train, do_update,
+            lambda args: (args[0], args[1], args[2], jnp.float32(0.0),
+                          jnp.exp(params["log_alpha"]), jnp.float32(0.0)),
+            (params, target_q, opt_state),
+        )
+        carry = (params, target_q, opt_state, buf_state, next_env_state,
+                 next_obs, ep_ret, total_steps, ret_sum, ret_cnt)
+        return carry, (closs, alpha, ent)
+
+    step_keys = jax.random.split(key, T)
+    init = (params, target_q, opt_state, buf_state, env_state, obs,
+            ep_ret, total_steps, jnp.float32(0.0), jnp.int32(0))
+    (params, target_q, opt_state, buf_state, env_state, obs, ep_ret,
+     total_steps, ret_sum, ret_cnt), (closses, alphas, ents) = lax.scan(
+        one_step, init, step_keys)
+    metrics = {
+        "episode_return_mean": jnp.where(
+            ret_cnt > 0, ret_sum / jnp.maximum(ret_cnt, 1), jnp.nan
+        ),
+        "critic_loss_mean": jnp.mean(closses),
+        "alpha": alphas[-1],
+        "entropy": jnp.mean(ents),
+        "buffer_size": buf_state.size,
+    }
+    return (params, target_q, opt_state, buf_state, env_state, obs,
+            ep_ret, total_steps, metrics)

@@ -15,8 +15,14 @@ Supported fields:
   py_modules   list of paths/URIs — packaged like working_dir, each
                extracted and importable
   config       {"setup_timeout_seconds": ...} accepted for parity
-  pip/conda    rejected: this build disallows package installation
-               (the reference shells out to pip/conda in the agent)
+  pip          list of requirements (or {"packages": [...]}) — built
+               ONCE per requirement-set hash with ``pip install
+               --target`` into the shared cache, then prepended to
+               sys.path (parity: _private/runtime_env/pip.py's
+               hash-keyed virtualenv builds).  Local wheel paths work
+               offline; index installs need egress.
+  conda        rejected: this build disallows conda environments
+               (the reference shells out to conda in the agent)
 
 Worker model note: the reference materializes envs per worker
 *process*; this runtime executes tasks on threads, so env_vars /
@@ -60,12 +66,20 @@ class RuntimeEnv(dict):
                 f"unknown runtime_env field(s) {sorted(unknown)}; "
                 f"known: {sorted(_KNOWN_FIELDS)}"
             )
-        if "pip" in kwargs or "conda" in kwargs:
+        if "conda" in kwargs:
             raise NotImplementedError(
-                "pip/conda runtime envs are disabled in this build "
-                "(no package installation); bake dependencies into the "
-                "image instead"
+                "conda runtime envs are disabled in this build; use "
+                "pip requirements or bake dependencies into the image"
             )
+        pip = kwargs.pop("pip", None)
+        if pip is not None:
+            if isinstance(pip, dict):
+                pip = pip.get("packages", [])
+            if not isinstance(pip, (list, tuple)) or not all(
+                isinstance(r, str) for r in pip
+            ):
+                raise TypeError("pip must be a list of requirement strings")
+            self["pip"] = list(pip)
         if env_vars:
             for k, v in env_vars.items():
                 if not isinstance(k, str) or not isinstance(v, str):
@@ -160,6 +174,109 @@ def ensure_local(uri: str) -> str:
     return out_dir
 
 
+# -- pip environments (parity: _private/runtime_env/pip.py) ----------------
+
+def ensure_pip(requirements: List[str], timeout_s: float = 600.0) -> str:
+    """Build (once) and return the ``pip install --target`` site dir
+    for a requirement set, keyed by the sorted-requirements hash
+    (parity: pip.py's hash-named virtualenv under the resources dir,
+    built by the per-node agent and reused across workers).  Concurrent
+    builders race on an O_EXCL lock file; losers wait for the winner's
+    .done marker."""
+    import subprocess
+    import time as _time
+
+    reqs = sorted(requirements)
+    key = hashlib.sha256("\n".join(reqs).encode()).hexdigest()[:32]
+    target = os.path.join(_cache_dir(), f"pip-{key}")
+    done = target + ".done"
+    lock = target + ".lock"
+    deadline = _time.monotonic() + timeout_s
+    while True:
+        if os.path.exists(done):
+            return target
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            # Another builder holds the lock.  A builder that DIED
+            # (SIGKILL mid-install) leaves a stale lock forever — treat
+            # a sufficiently old lock as abandoned and break it, then
+            # retry the claim; a live builder refreshes nothing, but
+            # its install finishing shows up as the .done marker.
+            try:
+                age = _time.time() - os.path.getmtime(lock)
+            except OSError:
+                continue  # lock vanished — retry claim immediately
+            if age > 60.0:
+                try:
+                    os.unlink(lock)
+                except OSError:
+                    pass
+                continue
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pip env {key} build did not finish in {timeout_s}s"
+                )
+            _time.sleep(0.2)
+            continue
+        os.close(fd)
+        break
+    try:
+        if os.path.exists(done):
+            return target
+        tmp = target + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        heartbeat = _Heartbeat(lock)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "pip", "install", "--target", tmp,
+                 "--no-input", "--disable-pip-version-check",
+                 "--no-warn-script-location", *reqs],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        finally:
+            heartbeat.stop()
+        if proc.returncode != 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"pip install failed for {reqs}: "
+                f"{proc.stderr.strip()[-800:]}"
+            )
+        os.replace(tmp, target)
+        with open(done, "w") as f:
+            f.write("\n".join(reqs))
+        return target
+    finally:
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
+
+
+class _Heartbeat:
+    """Touches a lock file periodically so waiters can tell a live
+    long-running build from an abandoned one (mtime-based staleness)."""
+
+    def __init__(self, path: str, period_s: float = 15.0):
+        import threading as _threading
+
+        self._path = path
+        self._stop = _threading.Event()
+
+        def beat():
+            while not self._stop.wait(period_s):
+                try:
+                    os.utime(self._path)
+                except OSError:
+                    return
+
+        self._thread = _threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
 # -- plugins (parity: _private/runtime_env/plugin.py) ----------------------
 
 class RuntimeEnvPlugin:
@@ -212,6 +329,13 @@ class RuntimeEnvContext:
             uri = (mod if mod.startswith(_PKG_SCHEME)
                    else package_directory(mod))
             self.sys_paths.append(ensure_local(uri))
+        pip_reqs = self.env.get("pip")
+        if pip_reqs:
+            cfg = self.env.get("config") or {}
+            self.sys_paths.append(ensure_pip(
+                pip_reqs,
+                timeout_s=float(cfg.get("setup_timeout_seconds", 600)),
+            ))
         for name, plugin in sorted(_plugins.items(),
                                    key=lambda kv: kv[1].priority):
             if name in self.env:

@@ -61,6 +61,17 @@ class DeploymentResponse:
                     raise
                 self._ref = self._resubmit()
 
+    def __await__(self):
+        """Awaitable inside async replicas (parity: serve
+        DeploymentResponse.__await__): the blocking get runs on the
+        loop's default executor, so concurrent requests on one async
+        replica interleave while awaiting downstream deployments."""
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        fut = loop.run_in_executor(None, self.result)
+        return fut.__await__()
+
     def _to_object_ref(self) -> ObjectRef:
         return self._ref
 

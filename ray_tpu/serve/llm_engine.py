@@ -72,6 +72,12 @@ class EngineAdapter:
     init_cache: Callable[[int, int], Any]
     prefill_slot: Callable[..., Tuple[jax.Array, Any]]
     decode_slots: Callable[..., Tuple[jax.Array, Any]]
+    # Optional batched admission: prefill_batch(params, tokens[K,S],
+    # true_lens[K], slots[K], cache) -> (logits[K,V], cache).  One
+    # [K, S] forward instead of K sequential rows — the MXU-friendly
+    # shape; the engine falls back to a fori_loop of prefill_slot when
+    # absent.
+    prefill_batch: Optional[Callable[..., Tuple[jax.Array, Any]]] = None
 
 
 def llama_adapter(cfg) -> EngineAdapter:
@@ -85,6 +91,9 @@ def llama_adapter(cfg) -> EngineAdapter:
             llama.prefill_slot(params, tokens, true_len, slot, cfg, cache),
         decode_slots=lambda params, tokens, active, cache:
             llama.decode_slots(params, tokens, active, cfg, cache),
+        prefill_batch=lambda params, tokens, true_lens, slots, cache:
+            llama.prefill_batch(params, tokens, true_lens, slots, cfg,
+                                cache),
     )
 
 
@@ -103,6 +112,8 @@ class PagedEngineAdapter:
     init_cache: Callable[[int, int], Any]
     prefill_slot: Callable[..., Tuple[jax.Array, Any]]
     decode_slots: Callable[..., Tuple[jax.Array, Any, jax.Array]]
+    # Batched admission over page rows (see EngineAdapter.prefill_batch).
+    prefill_batch: Optional[Callable[..., Tuple[jax.Array, Any]]] = None
 
 
 def llama_paged_adapter(cfg) -> PagedEngineAdapter:
@@ -118,6 +129,9 @@ def llama_paged_adapter(cfg) -> PagedEngineAdapter:
         decode_slots=lambda params, tokens, active, bt, lens, cache:
             llama.decode_slots_paged(params, tokens, active, bt, lens,
                                      cfg, cache),
+        prefill_batch=lambda params, tokens, true_lens, pages_rows, cache:
+            llama.prefill_batch_paged(params, tokens, true_lens,
+                                      pages_rows, cfg, cache),
     )
 
 
@@ -166,6 +180,9 @@ class CompletionStream:
             if item is _DONE:
                 self._done.set()
                 return
+            if isinstance(item, BaseException):
+                self._done.set()
+                raise item
             yield item
 
     def result(self, timeout_s: Optional[float] = None) -> List[int]:
@@ -183,6 +200,9 @@ class CompletionStream:
                 ) from None
             if item is _DONE:
                 self._done.set()
+            elif isinstance(item, BaseException):
+                self._done.set()
+                raise item
         return list(self._req.tokens)
 
     @property
@@ -248,18 +268,30 @@ class LLMEngine:
             self._cache = adapter.init_cache(self._num_pages, page)
             self._free_pages = list(range(self._num_pages))
             self._slot_pages: Dict[int, List[int]] = {}
-            self._bt = np.zeros((config.max_slots, self._maxp), np.int32)
+            # Unallocated block-table entries hold the OOB sentinel
+            # (num_pages): a stale slot decoded past its allocation by
+            # an overshooting in-flight chunk then scatters out of
+            # bounds (mode="drop") instead of corrupting page 0.
+            self._bt = np.full((config.max_slots, self._maxp),
+                               self._num_pages, np.int32)
             self._lens = np.zeros((config.max_slots,), np.int32)
             self._backlog: List[Request] = []  # admitted-but-no-pages
         else:
             self._cache = adapter.init_cache(config.max_slots,
                                              config.max_seq_len)
-        self._key = jax.random.key(seed)
         self._waiting: "queue.Queue[Request]" = queue.Queue()
         self._slot_req: Dict[int, Request] = {}
         self._free_slots = list(range(config.max_slots))
-        self._cur = np.zeros((config.max_slots,), np.int32)
+        # Last sampled token per slot lives ON DEVICE: the next decode
+        # chunk reads it without a host round trip, which is what lets
+        # chunk N+1 dispatch before chunk N's tokens reach the host
+        # (the depth-2 pipeline that hides the dispatch RTT).
+        self._cur_dev = jnp.zeros((config.max_slots,), jnp.int32)
         self._temps = np.zeros((config.max_slots,), np.float32)
+        # In-flight decode chunks: (toks_dev, chunk, [(slot, req)]) —
+        # dispatched, host processing deferred.
+        self._inflight: List[Tuple[Any, int, List[Tuple[int, Any]]]] = []
+        self._inflight_tokens: Dict[int, int] = {}  # slot → undelivered
         self._req_counter = itertools.count()
         self._stopped = threading.Event()
         self._work = threading.Event()
@@ -268,17 +300,22 @@ class LLMEngine:
 
         slots = config.max_slots
 
+        # NOTE on host↔device traffic: on tunneled/remote devices a
+        # sync round trip costs ~100 ms and even jax.random.split is a
+        # dispatched program — so every per-chunk side op here is folded
+        # INTO the jitted programs (keys derive from an int seed inside
+        # jit; the next-token vector and the updated cur come back as
+        # extra outputs), and token fetches are deferred + batched.
+
         @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
         def prefill_batch_fn(k, params, cache, tokens, true_lens,
-                             slot_or_pages, temps, key):
+                             slot_or_pages, temps, seed, cur, slot_ids):
             """Prefill k slots in ONE dispatch (k static: {1,2,4,8}).
-            A driver↔device round trip costs ~100 ms on tunneled dev
-            setups, so admission batches prefills instead of paying one
-            RPC per request.  Rows are sequential inside the program
-            (each writes its own slot); padding rows are copies of the
-            last real row — an idempotent rewrite of the same slot with
-            the same values, whose sample is discarded."""
-            keys = jax.random.split(key, k)
+            Rows are sequential inside the program (each writes its own
+            slot); padding rows are copies of the last real row — an
+            idempotent rewrite whose sample is discarded.  Also scatters
+            the sampled first tokens into the device-resident cur."""
+            keys = jax.random.split(jax.random.key(seed[0]), k)
 
             def body(i, carry):
                 cache, toks = carry
@@ -291,10 +328,14 @@ class LLMEngine:
             cache, toks = jax.lax.fori_loop(
                 0, k, body, (cache, jnp.zeros((k,), jnp.int32))
             )
-            return cache, toks
+            # Padding rows carry an OOB scatter id (mode="drop"): with
+            # temperature > 0 they sample a DIFFERENT token for the
+            # same slot, and the scatter must not let a padding row's
+            # sample beat the emitted real-row token.
+            return cache, toks, cur.at[slot_ids].set(toks, mode="drop")
 
         @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-        def decode_fn(n_steps, params, cache, cur, active, temps, key):
+        def decode_fn(n_steps, params, cache, cur, active, temps, seed):
             def step(carry, k):
                 cache, cur = carry
                 logits, cache = adapter.decode_slots(params, cur, active, cache)
@@ -302,13 +343,13 @@ class LLMEngine:
                 toks = jnp.where(active, toks, cur)
                 return (cache, toks), toks
 
-            keys = jax.random.split(key, n_steps)
-            (cache, _), toks = jax.lax.scan(step, (cache, cur), keys)
-            return cache, toks  # [n_steps, slots]
+            keys = jax.random.split(jax.random.key(seed[0]), n_steps)
+            (cache, cur), toks = jax.lax.scan(step, (cache, cur), keys)
+            return cache, toks, cur, None  # [n_steps, slots]
 
         @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
         def decode_paged_fn(n_steps, params, cache, cur, active, temps,
-                            key, bt, lens):
+                            seed, bt, lens):
             def step(carry, k):
                 cache, cur, lens = carry
                 logits, cache, lens = adapter.decode_slots(
@@ -318,16 +359,49 @@ class LLMEngine:
                 toks = jnp.where(active, toks, cur)
                 return (cache, toks, lens), toks
 
-            keys = jax.random.split(key, n_steps)
-            (cache, _, _), toks = jax.lax.scan(
+            keys = jax.random.split(jax.random.key(seed[0]), n_steps)
+            (cache, cur, lens), toks = jax.lax.scan(
                 step, (cache, cur, lens), keys
             )
-            return cache, toks
+            # cur + lens ride back as DEVICE arrays: the next dispatch
+            # feeds them straight in — no host round trip.
+            return cache, toks, cur, lens
 
+        if adapter.prefill_batch is not None:
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_batched_fn(params, cache, tokens, true_lens,
+                                   slot_or_pages, temps, seed, cur,
+                                   slot_ids):
+                logits, cache = adapter.prefill_batch(
+                    params, tokens, true_lens, slot_or_pages, cache
+                )
+                toks = _sample(logits, temps, jax.random.key(seed[0]))
+                # Padding rows' scatter ids are OOB — see prefill_batch_fn.
+                return cache, toks, cur.at[slot_ids].set(toks, mode="drop")
+
+            self._prefill_batched_fn = prefill_batched_fn
+        else:
+            self._prefill_batched_fn = None
         # One prefill program serves both modes: the adapter closure is
         # what interprets the third per-row arg (slot id vs page list).
         self._prefill_batch_fn = prefill_batch_fn
         self._decode_fn = decode_paged_fn if self._paged else decode_fn
+        self._seed_counter = itertools.count(seed * 1_000_003 + 1)
+        # Decode chunk ladder: descending powers of two (see
+        # _chunk_size).
+        ladder = []
+        k = max(1, config.decode_chunk)
+        while k >= 1:
+            ladder.append(k)
+            k //= 2
+        self._chunk_ladder = tuple(ladder)
+        # Per-slot control arrays riding dispatches as jit args,
+        # rebuilt only when admission/finish dirties them.
+        self._state_dirty = True
+        self._active_arg = None
+        self._temps_arg = None
+        self._bt_arg = None
+        self._lens_arg = None
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="llm-engine"
         )
@@ -337,6 +411,8 @@ class LLMEngine:
 
     def submit(self, prompt: List[int], *, max_new_tokens: Optional[int] = None,
                temperature: float = 0.0) -> CompletionStream:
+        if self._stopped.is_set():
+            raise RuntimeError("engine is stopped (shut down or crashed)")
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) >= self.config.max_seq_len:
@@ -383,9 +459,12 @@ class LLMEngine:
 
     # -- engine loop -------------------------------------------------------
 
-    def _next_key(self) -> jax.Array:
-        self._key, sub = jax.random.split(self._key)
-        return sub
+    def _next_seed(self) -> np.ndarray:
+        """Per-dispatch RNG seed as a tiny host array — the key derives
+        INSIDE the jitted program (jax.random.split on the host is a
+        ~75 ms dispatched program on tunneled devices)."""
+        return np.asarray([next(self._seed_counter) & 0x7FFFFFFF],
+                          np.uint32)
 
     def _bucket_for(self, n: int) -> int:
         for b in self.config.buckets():
@@ -424,20 +503,55 @@ class LLMEngine:
                 true_lens[i] = len(req.prompt)
                 slot_ids[i] = slot
                 temps[i] = req.temperature
-            self._cache, toks = self._prefill_batch_fn(
-                k, self._params, self._cache, jnp.asarray(tokens),
-                jnp.asarray(true_lens), jnp.asarray(slot_ids),
-                jnp.asarray(temps), self._next_key(),
+            toks_dev = self._run_prefill(k, tokens, true_lens, slot_ids,
+                                         temps,
+                                         self._scatter_ids(slot_ids,
+                                                           len(batch)))
+            self._finish_admit(batch, toks_dev, slot_ids)
+
+    def _scatter_ids(self, slot_ids: np.ndarray, n_real: int) -> np.ndarray:
+        """cur-scatter indices: real rows keep their slot, padding rows
+        go OOB so their (differently-sampled) token is dropped."""
+        out = np.array(slot_ids, np.int32)
+        out[n_real:] = self.config.max_slots
+        return out
+
+    def _run_prefill(self, k, tokens, true_lens, slot_or_pages, temps,
+                     slot_ids):
+        """One admission dispatch: batched [K, S] forward when the
+        adapter provides it, else the fori_loop-of-rows program.  The
+        sampled first tokens scatter into the device cur INSIDE the
+        program; host arrays ride the dispatch (no separate uploads)."""
+        if self._prefill_batched_fn is not None:
+            self._cache, toks_dev, self._cur_dev = \
+                self._prefill_batched_fn(
+                    self._params, self._cache, tokens, true_lens,
+                    slot_or_pages, temps, self._next_seed(),
+                    self._cur_dev, slot_ids,
+                )
+        else:
+            self._cache, toks_dev, self._cur_dev = self._prefill_batch_fn(
+                k, self._params, self._cache, tokens, true_lens,
+                slot_or_pages, temps, self._next_seed(),
+                self._cur_dev, slot_ids,
             )
-            toks = np.asarray(jax.device_get(toks))
-            now = time.monotonic()
-            for i, (req, slot) in enumerate(batch):
-                tok = int(toks[i])
-                req.first_token_at = now
-                self._emit(req, slot, tok)
-                if slot in self._slot_req:  # not finished at first token
-                    self._cur[slot] = tok
-                    self._temps[slot] = req.temperature
+        return toks_dev
+
+    def _finish_admit(self, batch, toks_dev, slot_ids) -> None:
+        """Post-prefill bookkeeping shared by both cache modes.  The
+        first-token FETCH is deferred into the pipeline (one batched
+        device_get covers several entries — each sync get costs a full
+        ~100 ms round trip on tunneled devices); slots register NOW so
+        decode chunks dispatch behind the prefill without waiting."""
+        for req, slot in batch:
+            self._slot_req[slot] = req
+            self._temps[slot] = req.temperature
+            # The pending first token counts against the budget until
+            # the prefill entry is processed.
+            self._inflight_tokens[slot] = \
+                self._inflight_tokens.get(slot, 0) + 1
+        self._state_dirty = True  # active/temps/bt/lens changed
+        self._inflight.append(("prefill", toks_dev, 0, list(batch)))
 
     def _pages_needed(self, req: Request) -> int:
         """Pages covering max(prefill bucket, prompt+max_new)."""
@@ -487,7 +601,7 @@ class LLMEngine:
                 slot = self._free_slots.pop()
                 pages = [self._free_pages.pop() for _ in range(need)]
                 self._slot_pages[slot] = pages
-                row = np.zeros((self._maxp,), np.int32)
+                row = np.full((self._maxp,), self._num_pages, np.int32)
                 row[: len(pages)] = pages
                 self._bt[slot] = row
                 batch.append((req, slot))
@@ -507,21 +621,16 @@ class LLMEngine:
                 true_lens[i] = len(req.prompt)
                 pages_rows[i] = self._bt[slot][: bucket // page]
                 temps[i] = req.temperature
-            self._cache, toks = self._prefill_batch_fn(
-                k, self._params, self._cache, jnp.asarray(tokens),
-                jnp.asarray(true_lens), jnp.asarray(pages_rows),
-                jnp.asarray(temps), self._next_key(),
-            )
-            toks = np.asarray(jax.device_get(toks))
-            now = time.monotonic()
-            for i, (req, slot) in enumerate(batch):
-                tok = int(toks[i])
-                req.first_token_at = now
+            slot_ids = np.asarray(
+                [batch[min(i, len(batch) - 1)][1] for i in range(k)],
+                np.int32)
+            for req, slot in batch:
                 self._lens[slot] = len(req.prompt)
-                self._emit(req, slot, tok)
-                if slot in self._slot_req:
-                    self._cur[slot] = tok
-                    self._temps[slot] = req.temperature
+            toks_dev = self._run_prefill(k, tokens, true_lens, pages_rows,
+                                         temps,
+                                         self._scatter_ids(slot_ids,
+                                                           len(batch)))
+            self._finish_admit(batch, toks_dev, slot_ids)
 
     def _emit(self, req: Request, slot: int, tok: int):
         """Record one generated token; finish/free the slot if done."""
@@ -539,61 +648,166 @@ class LLMEngine:
             req.stream.put(_DONE)
             del self._slot_req[slot]
             self._free_slots.append(slot)
+            self._state_dirty = True
             if self._paged:
                 self._free_pages.extend(self._slot_pages.pop(slot, []))
-                self._bt[slot] = 0
+                self._bt[slot] = self._num_pages
                 self._lens[slot] = 0
 
     def _chunk_size(self) -> int:
         """Largest compiled chunk that no active request can out-finish
-        (so only EOS, never the token budget, can end a request
-        mid-chunk)."""
-        remaining = min(
+        given tokens ALREADY IN FLIGHT (so only EOS, never the token
+        budget, can end a request mid-chunk); 0 = every budget is fully
+        covered by in-flight chunks — process those first.  The ladder
+        is descending powers of two, so a gen-31 tail costs
+        16+8+4+2+1 = 5 dispatches, not 16+4+4+4+1+1+1.
+
+        Sizing keys off the LONGEST-remaining active request: shorter
+        requests finish mid-chunk (their lanes decode garbage for the
+        chunk's tail — batched decode computes every lane anyway, and
+        overshoot writes are OOB-dropped via the block-table sentinel).
+        min-sizing would fragment chunks whenever staggered arrivals
+        mix progress levels — the open-loop serving pattern."""
+        remaining = max(
             min(
                 req.max_new_tokens - len(req.tokens),
                 self.config.max_seq_len - len(req.prompt) - len(req.tokens),
-            )
-            for req in self._slot_req.values()
+            ) - self._inflight_tokens.get(slot, 0)
+            for slot, req in self._slot_req.items()
         )
-        for k in (self.config.decode_chunk, 4, 1):
+        for k in self._chunk_ladder:
             if k <= remaining:
                 return k
-        return 1
+        if remaining > 0:
+            return self._chunk_ladder[-1]  # 1-step chunk covers any tail
+        return 0
+
+    def _refresh_state_args(self) -> None:
+        """Rebuild the per-slot control arrays only when admission or a
+        finish changed them; the arrays ride the next dispatch as jit
+        arguments (no separate upload ops).  Between changes, lens
+        feeds back device-side from the previous decode."""
+        if not self._state_dirty:
+            return
+        active = np.zeros((self.config.max_slots,), bool)
+        for slot in self._slot_req:
+            active[slot] = True
+        self._active_arg = active
+        self._temps_arg = np.array(self._temps)
+        if self._paged:
+            self._bt_arg = np.array(self._bt)
+            self._lens_arg = np.array(self._lens)
+        self._state_dirty = False
+
+    def _dispatch_decode(self, chunk: int) -> None:
+        """Enqueue one decode chunk WITHOUT a host sync: cur and lens
+        come back as device outputs of the previous chunk, so this runs
+        while earlier chunks' tokens are still on the wire (the
+        pipeline that hides the ~100 ms dispatch RTT of tunneled/remote
+        devices)."""
+        self._refresh_state_args()
+        if self._paged:
+            self._cache, toks_dev, self._cur_dev, self._lens_arg = \
+                self._decode_fn(
+                    chunk, self._params, self._cache, self._cur_dev,
+                    self._active_arg, self._temps_arg,
+                    self._next_seed(), self._bt_arg, self._lens_arg,
+                )
+            # Host mirror advances for slots active in THIS dispatch.
+            for slot in self._slot_req:
+                self._lens[slot] += chunk
+        else:
+            self._cache, toks_dev, self._cur_dev, _ = self._decode_fn(
+                chunk, self._params, self._cache, self._cur_dev,
+                self._active_arg, self._temps_arg, self._next_seed(),
+            )
+        self._steps += chunk
+        participants = list(self._slot_req.items())
+        for slot, _req in participants:
+            self._inflight_tokens[slot] = (
+                self._inflight_tokens.get(slot, 0) + chunk
+            )
+        self._inflight.append(("decode", toks_dev, chunk, participants))
+
+    def _process_ready(self, keep: int = 0) -> None:
+        """Host half of the pipeline: fetch every in-flight entry but
+        the newest ``keep`` in ONE batched device_get (each get costs a
+        full round trip on tunneled devices — batching N entries into
+        one call amortizes it), then emit in dispatch order."""
+        take = len(self._inflight) - keep
+        if take <= 0:
+            return
+        entries = self._inflight[:take]
+        del self._inflight[:take]
+        fetched = jax.device_get([e[1] for e in entries])
+        now = time.monotonic()
+        for (kind, _dev, chunk, participants), toks in zip(entries,
+                                                           fetched):
+            toks = np.asarray(toks)
+            if kind == "prefill":
+                for i, (req, slot) in enumerate(participants):
+                    left = self._inflight_tokens.get(slot, 0) - 1
+                    if left > 0:
+                        self._inflight_tokens[slot] = left
+                    else:
+                        self._inflight_tokens.pop(slot, None)
+                    req.first_token_at = now
+                    self._emit(req, slot, int(toks[i]))
+                continue
+            for slot, req in participants:
+                left = self._inflight_tokens.get(slot, 0) - chunk
+                if left > 0:
+                    self._inflight_tokens[slot] = left
+                else:
+                    self._inflight_tokens.pop(slot, None)
+                if self._slot_req.get(slot) is not req:
+                    # Finished in an earlier chunk (EOS): overshoot.
+                    continue
+                for k in range(chunk):
+                    self._emit(req, slot, int(toks[k, slot]))
+                    if self._slot_req.get(slot) is not req:
+                        break  # finished mid-chunk
+
+    _PIPELINE_DEPTH = 3
 
     def _loop(self):
+        try:
+            self._loop_body()
+        except BaseException as e:  # engine crash — fail every client
+            self._stopped.set()
+            err = RuntimeError(f"LLM engine loop crashed: {e!r}")
+            err.__cause__ = e
+            failing = list(self._slot_req.values())
+            if self._paged:
+                failing += list(self._backlog)
+            while True:
+                try:
+                    failing.append(self._waiting.get_nowait())
+                except queue.Empty:
+                    break
+            for req in failing:
+                req.stream.put(err)
+            raise
+
+    def _loop_body(self):
         while not self._stopped.is_set():
             backlog = self._paged and self._backlog
-            if not self._slot_req and self._waiting.empty() and not backlog:
+            if (not self._slot_req and self._waiting.empty()
+                    and not backlog and not self._inflight):
                 self._work.wait(timeout=0.05)
                 self._work.clear()
                 continue
             self._admit()
-            if not self._slot_req:
-                continue
-            active = np.zeros((self.config.max_slots,), bool)
-            for slot in self._slot_req:
-                active[slot] = True
-            chunk = self._chunk_size()
-            if self._paged:
-                self._cache, toks = self._decode_fn(
-                    chunk, self._params, self._cache,
-                    jnp.asarray(self._cur), jnp.asarray(active),
-                    jnp.asarray(self._temps), self._next_key(),
-                    jnp.asarray(self._bt), jnp.asarray(self._lens),
-                )
-                self._lens[active] += chunk
-            else:
-                self._cache, toks = self._decode_fn(
-                    chunk, self._params, self._cache,
-                    jnp.asarray(self._cur), jnp.asarray(active),
-                    jnp.asarray(self._temps), self._next_key(),
-                )
-            self._steps += chunk
-            toks = np.asarray(jax.device_get(toks))  # [chunk, slots]
-            for slot, req in list(self._slot_req.items()):
-                for k in range(chunk):
-                    tok = int(toks[k, slot])
-                    self._emit(req, slot, tok)
-                    self._cur[slot] = tok
-                    if slot not in self._slot_req:  # finished mid-chunk
-                        break
+            dispatched = False
+            if self._slot_req and len(self._inflight) < self._PIPELINE_DEPTH:
+                chunk = self._chunk_size()
+                if chunk > 0:
+                    self._dispatch_decode(chunk)
+                    dispatched = True
+            if len(self._inflight) >= self._PIPELINE_DEPTH:
+                # Pipeline full: drain all but one (it keeps the device
+                # busy while the host emits).
+                self._process_ready(keep=1)
+            elif self._inflight and not dispatched:
+                # Nothing else to do — drain everything.
+                self._process_ready(keep=0)

@@ -34,6 +34,10 @@ class JaxBackendConfig:
     coordinator_port: int = 0
     # Pass through to jax.distributed.initialize (e.g. 4 chips/host).
     local_device_ids: Optional[List[int]] = None
+    # Virtual CPU devices per process (cpu platform only): >1 models a
+    # multi-chip host, so a 2-process world exercises the same
+    # process-boundary SPMD as a 2-host × N-chip pod.
+    cpu_devices_per_process: int = 1
 
 
 # Module-level worker functions: shipped by reference, run inside the
@@ -53,7 +57,8 @@ def _pick_coordinator(port: int) -> str:
 
 def _init_jax_distributed(addr: str, num_processes: int, process_id: int,
                           platform: Optional[str],
-                          local_device_ids: Optional[List[int]]) -> int:
+                          local_device_ids: Optional[List[int]],
+                          cpu_devices_per_process: int = 1) -> int:
     """Runs in the worker process BEFORE any other jax backend use —
     fresh worker processes import jax lazily, so the train fn sees the
     initialized world (parity: process-group init before the loop)."""
@@ -63,14 +68,15 @@ def _init_jax_distributed(addr: str, num_processes: int, process_id: int,
     if platform:
         os.environ["JAX_PLATFORMS"] = platform
     if platform == "cpu":
-        # One LOCAL device per process: a test driver's inherited
+        # Pin LOCAL device count per process: a test driver's inherited
         # --xla_force_host_platform_device_count=8 would otherwise give
         # every process 8 virtual devices and a world of 8N.
         flags = os.environ.get("XLA_FLAGS", "")
         flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
                        flags)
         os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=1"
+            flags + " --xla_force_host_platform_device_count="
+            f"{max(1, cpu_devices_per_process)}"
         ).strip()
     import jax
 
@@ -121,6 +127,7 @@ class JaxDistributedBackend:
             w.execute.remote(
                 _init_jax_distributed, self.coordinator_address, n, rank,
                 cfg.platform, cfg.local_device_ids,
+                cfg.cpu_devices_per_process,
             )
             for rank, w in enumerate(worker_group.workers)
         ]

@@ -296,7 +296,9 @@ class TuneController:
             max_concurrency=2, **_actor_opts(self.resources)
         )(_FnTrialRunner)
         active: List[Trial] = []
-        pending = list(self.trials)
+        # Resume skips already-finished trials (driver-crash restore).
+        pending = [t for t in self.trials
+                   if t.status not in (TERMINATED, ERROR)]
         fn = self.trainable
         while pending or active:
             while pending and len(active) < self.cfg.max_concurrent_trials:
@@ -304,6 +306,7 @@ class TuneController:
                 self._start_fn_trial(trial, Runner, fn)
                 active.append(trial)
             time.sleep(0.01)
+            self._maybe_snapshot()
             for trial in list(active):
                 self._pump_results(trial)
                 done, _ = ray_tpu.wait([trial.run_ref], timeout=0)
@@ -376,16 +379,24 @@ class TuneController:
         Runner = ray_tpu.remote(**_actor_opts(self.resources))(
             _ClassTrialRunner)
         active: List[Trial] = []
-        pending = list(self.trials)
+        pending = [t for t in self.trials
+                   if t.status not in (TERMINATED, ERROR)]
         step_refs: Dict[str, Any] = {}
         while pending or active:
             while pending and len(active) < self.cfg.max_concurrent_trials:
                 trial = pending.pop(0)
                 trial.actor = Runner.remote(self.trainable, trial.config)
                 trial.status = RUNNING
+                if trial.restore_from is not None:
+                    # Driver-crash resume: rebuild the trainable from
+                    # the trial's last checkpoint.
+                    ray_tpu.get(trial.actor.restore.remote(
+                        trial.restore_from))
+                    trial.restore_from = None
                 step_refs[trial.trial_id] = trial.actor.step.remote()
                 active.append(trial)
             time.sleep(0.005)
+            self._maybe_snapshot()
             for trial in list(active):
                 ref = step_refs.get(trial.trial_id)
                 done, _ = ray_tpu.wait([ref], timeout=0)
@@ -454,9 +465,49 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
 
+    @classmethod
+    def restore(cls, path: str, trainable) -> "Tuner":
+        """Rebuild a Tuner from a periodic experiment snapshot so a
+        sweep survives a DRIVER crash (parity:
+        tune/execution/experiment_state.py + Tuner.restore): finished
+        trials keep their results; interrupted trials resume from their
+        last reported checkpoint; never-started trials run normally.
+        ``path`` is <storage_path>/<name> or the experiment_state.pkl
+        itself."""
+        import os
+
+        import cloudpickle as _cp
+
+        from ray_tpu.tune.trial import Trial as _Trial
+
+        f = (path if path.endswith(".pkl")
+             else os.path.join(path, "experiment_state.pkl"))
+        with open(f, "rb") as fh:
+            snap = _cp.loads(fh.read())
+        trials = []
+        for row in snap["trials"]:
+            t = _Trial(trial_id=row["trial_id"], config=row["config"],
+                       status=row["status"], results=row["results"],
+                       error=row["error"], checkpoint=row["checkpoint"])
+            if t.status not in (TERMINATED, ERROR):
+                # Interrupted mid-run: restart from the newest
+                # checkpoint (or from scratch if none reported yet).
+                t.status = PENDING
+                t.restore_from = t.checkpoint
+                t.results = list(t.results)
+            trials.append(t)
+        tuner = cls(trainable, param_space=snap["param_space"],
+                    tune_config=snap["tune_config"],
+                    run_config=snap["run_config"])
+        tuner._restored_trials = trials
+        return tuner
+
     def fit(self) -> ResultGrid:
-        controller = TuneController(self.trainable, self.param_space,
-                                    self.tune_config, self.run_config)
+        controller = TuneController(
+            self.trainable, self.param_space, self.tune_config,
+            self.run_config,
+            restored_trials=getattr(self, "_restored_trials", None),
+        )
         trials = controller.run()
         results = [
             Result(config=t.config, metrics=t.last_result(), error=t.error,

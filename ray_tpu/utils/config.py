@@ -113,10 +113,13 @@ D("max_pending_lease_requests_per_scheduling_class", int, 10,
   "Pipelined lease requests per distinct (fn, resources) class.")
 
 # --- Workers --------------------------------------------------------------
-D("workers", str, "thread",
-  "Execution backend: 'thread' (in-process, fast start, GIL-bound) or "
-  "'process' (pooled OS worker processes over the shared-memory object "
-  "plane — real parallelism and crash isolation).  Env: RAYTPU_WORKERS.")
+D("workers", str, "process",
+  "Execution backend: 'process' (default — pooled OS worker processes "
+  "over the shared-memory object plane: real parallelism and crash "
+  "isolation, like the reference, which never runs user code in the "
+  "driver: ray src/ray/raylet/worker_pool.h:156) or 'thread' "
+  "(in-process, fast start, GIL-bound — the annotated exception for "
+  "latency-critical embedded uses and tests).  Env: RAYTPU_WORKERS.")
 D("worker_tpu_access", bool, False,
   "Give spawned worker processes the TPU runtime preload (slower start; "
   "only one process can hold a chip — leave off for pure-CPU workers and "

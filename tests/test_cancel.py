@@ -21,7 +21,13 @@ from ray_tpu.core.exceptions import TaskCancelledError, WorkerDiedError
 
 
 @pytest.fixture
-def rt():
+def rt(monkeypatch):
+    # THREAD mode (the annotated exception; process is the default):
+    # these tests exercise thread-mode cancel semantics and share
+    # driver-process state (threading.Event gates, driver-side lists)
+    # that cannot cross a process boundary.  Process-mode cancel and
+    # async-actor coverage lives in tests/test_process_workers.py.
+    monkeypatch.setenv("RAYTPU_WORKERS", "thread")
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=4)
     yield _api.runtime()

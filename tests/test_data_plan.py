@@ -1,0 +1,129 @@
+"""Logical plan optimizer + operator memory backpressure.
+
+Parity targets: the rule-based logical optimizer (ray:
+python/ray/data/_internal/logical/optimizers.py — MapFusion,
+LimitPushdown) and per-operator object-store budgets
+(_internal/execution/streaming_executor_state.py:376).
+"""
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.core import api as _api
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.executor import LimitOp, MapOp, ReadOp, StreamingExecutor
+from ray_tpu.data.logical_plan import (
+    LimitPushdown,
+    LogicalPlan,
+    MapFusion,
+)
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield _api.runtime()
+    ray_tpu.shutdown()
+
+
+def _mk_map(name, preserves=False):
+    return MapOp(fn=lambda b: b, name=name,
+                 preserves_cardinality=preserves)
+
+
+def test_map_fusion_rule():
+    plan = LogicalPlan([
+        ReadOp(None), _mk_map("A"), _mk_map("B"), _mk_map("C"),
+        LimitOp(5), _mk_map("D"), _mk_map("E"),
+    ])
+    out = MapFusion().apply(plan)
+    names = [getattr(op, "name", type(op).__name__) for op in out.ops]
+    assert names == ["Read", "A+B+C", "Limit", "D+E"]
+    fused = out.ops[1]
+    assert len(fused.fns) == 3
+
+
+def test_fusion_keeps_actor_pool_stage_separate():
+    pool = MapOp(fn=lambda b: b, name="Pool", actor_pool_size=2,
+                 fn_constructor=lambda: (lambda b: b))
+    plan = MapFusion().apply(LogicalPlan(
+        [ReadOp(None), _mk_map("A"), pool, _mk_map("B"), _mk_map("C")]))
+    names = [getattr(op, "name", "?") for op in plan.ops]
+    assert names == ["Read", "A", "Pool", "B+C"]
+
+
+def test_limit_pushdown_rule():
+    plan = LogicalPlan([
+        ReadOp(None),
+        _mk_map("RowMap", preserves=True),
+        _mk_map("Filter", preserves=False),
+        _mk_map("AddCol", preserves=True),
+        LimitOp(7),
+    ])
+    out = LimitPushdown().apply(plan)
+    names = [getattr(op, "name", type(op).__name__) for op in out.ops]
+    # Limit hops over AddCol (cardinality-preserving) but stops at the
+    # Filter (which changes row counts).
+    assert names == ["Read", "RowMap", "Filter", "Limit", "AddCol"]
+
+
+def test_limit_pushdown_end_to_end(rt):
+    """Pushed-down limit transforms only the surviving rows."""
+    seen = []
+
+    ds = rd.range(1000).map(lambda r: {"id": r["id"] * 2}).limit(10)
+    out = ds.take_all()
+    assert len(out) == 10
+    assert [r["id"] for r in out] == [i * 2 for i in range(10)]
+    plan = StreamingExecutor(ds._ops).plan
+    names = plan.describe()
+    assert names.index("Limit") < names.index("Map")
+
+
+def test_backpressure_stays_under_budget(rt):
+    """A pipeline with a fat middle map keeps its live-block working
+    set under the configured byte budget while completing."""
+    ctx = DataContext.get_current()
+    old_budget = ctx.op_memory_budget_bytes
+    old_window = ctx.max_in_flight_tasks
+    ctx.op_memory_budget_bytes = 4 * 1024 * 1024  # 4 MB
+    ctx.max_in_flight_tasks = 8
+    try:
+        def fatten(block):
+            n = len(block["id"])
+            return {"id": block["id"],
+                    "payload": np.ones((n, 4096), np.float64)}  # 32KB/row
+
+        # 32 blocks × 32 rows × 32 KB = 32 MB total, 1 MB per block —
+        # unbudgeted, the window would hold ~8-16 MB live.
+        ds = rd.range(1024, parallelism=32).map_batches(fatten)
+        ex = StreamingExecutor(ds._ops)
+        peak = 0
+        n_rows = 0
+        for ref in ex.execute():
+            block = ray_tpu.get(ref)
+            n_rows += len(block["id"])
+            peak = max(peak, ex.peak_live_bytes)
+            del block, ref
+            time.sleep(0.01)  # slow consumer — forces backpressure
+        assert n_rows == 1024
+        # Budget plus one block of slack (the always-one-in-flight
+        # deadlock guard can overshoot by a single block).
+        assert peak <= ctx.op_memory_budget_bytes + 2 * 1024 * 1024, peak
+        assert ex.peak_live_bytes > 0
+    finally:
+        ctx.op_memory_budget_bytes = old_budget
+        ctx.max_in_flight_tasks = old_window
+
+
+def test_budget_zero_means_unbounded(rt):
+    ctx = DataContext.get_current()
+    assert ctx.op_memory_budget_bytes == 0
+    ds = rd.range(100, parallelism=4).map(lambda r: r)
+    assert len(ds.take_all()) == 100

@@ -45,7 +45,7 @@ def _spawn_daemon(port, *, num_cpus=2, resources="{}", labels="{}",
     )
 
 
-def _wait_nodes(rt, n, timeout=30):
+def _wait_nodes(rt, n, timeout=60):
     deadline = time.time() + timeout
     while time.time() < deadline:
         if sum(1 for x in rt.nodes() if x["Alive"]) >= n:
@@ -283,9 +283,13 @@ def test_spilled_on_node_restores_across_wire():
     procs = [
         _spawn_daemon(server.port, labels='{"daemon": "small"}',
                       extra_env={
-                          # 12 MB arena: four 3.2 MB objects overflow
-                          # the 0.8 spill watermark.
-                          "RAYTPU_OBJECT_STORE_MEMORY_BYTES": "12000000",
+                          # 16 MB arena with an aggressive 0.3 spill
+                          # watermark: the second 3.2 MB object already
+                          # crosses it, and spill stays well ahead of
+                          # the arena's LRU eviction (which would be
+                          # silent loss, not spill).
+                          "RAYTPU_OBJECT_STORE_MEMORY_BYTES": "16000000",
+                          "RAYTPU_OBJECT_SPILL_THRESHOLD": "0.3",
                       }),
         _spawn_daemon(server.port, labels='{"daemon": "big"}'),
     ]

@@ -313,3 +313,49 @@ def test_placement_group_from_worker(proc_runtime):
         return out
 
     assert ray_tpu.get(build_and_use.remote()) == "placed"
+
+
+def test_async_actor_interleaves_in_process_mode(proc_runtime):
+    """Async methods of a PROCESS-hosted actor overlap their awaits on
+    the worker's shared event loop; the driver-side shell pumps calls
+    without blocking its serve loop (parity: fiber.h async actors —
+    this is the process-boundary equivalent of the thread shell's
+    deferred async path)."""
+
+    @ray_tpu.remote
+    class Sleeper:
+        async def nap(self, s):
+            import asyncio
+
+            await asyncio.sleep(s)
+            return s
+
+    a = Sleeper.remote()
+    t0 = time.monotonic()
+    out = ray_tpu.get([a.nap.remote(0.4) for _ in range(12)], timeout=30)
+    dt = time.monotonic() - t0
+    assert out == [0.4] * 12
+    # Serial execution would take 4.8 s; interleaved ≈ 0.4 s + overhead.
+    assert dt < 3.0, f"async actor calls serialized: {dt:.2f}s"
+
+
+def test_async_actor_ordering_with_sync_methods(proc_runtime):
+    """Sync methods still serialize through the executor while async
+    ones interleave — state mutations from sync calls stay ordered."""
+
+    @ray_tpu.remote
+    class Mixed:
+        def __init__(self):
+            self.log = []
+
+        def record(self, v):
+            self.log.append(v)
+            return list(self.log)
+
+        async def peek(self):
+            return list(self.log)
+
+    m = Mixed.remote()
+    outs = ray_tpu.get([m.record.remote(i) for i in range(5)])
+    assert outs[-1] == [0, 1, 2, 3, 4]
+    assert ray_tpu.get(m.peek.remote()) == [0, 1, 2, 3, 4]

@@ -1,0 +1,88 @@
+"""Weight-only int8 quantization (w8a16 serving path).
+
+Parity note: no reference counterpart (serve runs user torch code
+there); this is the TPU-native big-model-fits-HBM play the 8B serving
+artifact rides (ray_tpu/models/quant.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama, quant
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=64, max_seq_len=64,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_quantize_roundtrip_error_small(tiny):
+    cfg, params = tiny
+    q = quant.quantize_params(params)
+    deq = quant.dequantize_params(q, jnp.float32)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(deq)):
+        if a.ndim >= 2:
+            rel = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                        - b.astype(jnp.float32)))
+                        / (jnp.max(jnp.abs(a)) + 1e-9))
+            assert rel < 0.02, rel
+
+
+def test_norms_and_embeddings_stay_full_precision(tiny):
+    cfg, params = tiny
+    q = quant.quantize_params(params)
+    assert q["tok_embed"].dtype == params["tok_embed"].dtype
+    assert q["final_norm"].dtype == params["final_norm"].dtype
+    attn = q["layers"]["attn"]
+    assert attn["wq"]["q"].dtype == jnp.int8
+    assert attn["wq"]["scale"].dtype == jnp.float32
+    assert q["layers"]["ln_attn"].dtype == params["layers"]["ln_attn"].dtype
+
+
+def test_quantized_forward_close(tiny):
+    cfg, params = tiny
+    deq = quant.dequantize_params(quant.quantize_params(params), cfg.dtype)
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 16), np.int64).astype(np.int32))
+    o1 = llama.forward(params, toks, cfg)
+    o2 = llama.forward(deq, toks, cfg)
+    rel = float(jnp.mean(jnp.abs(o1 - o2))
+                / (jnp.mean(jnp.abs(o1)) + 1e-9))
+    assert rel < 0.15, rel
+
+
+def test_quantized_engine_generates(tiny):
+    cfg, params = tiny
+    from ray_tpu.serve.llm_engine import EngineConfig, LLMEngine
+
+    q = quant.quantize_params(params)
+    eng = LLMEngine(
+        q, quant.llama_paged_adapter_quant(cfg),
+        EngineConfig(max_slots=2, max_seq_len=64, decode_chunk=4,
+                     max_new_tokens_default=4, min_prefill_bucket=16,
+                     page_size=16),
+    )
+    try:
+        out = eng.generate([1, 2, 3, 4, 5])
+        assert len(out) == 4
+        assert all(0 <= t < cfg.vocab_size for t in out)
+    finally:
+        eng.shutdown()
+
+
+def test_quantized_bytes_counts_int8(tiny):
+    cfg, params = tiny
+    q = quant.quantize_params(params)
+    qb = quant.quantized_bytes(q)
+    fb = sum(l.size * l.dtype.itemsize
+             for l in jax.tree_util.tree_leaves(params))
+    # Weight matrices dominate; int8 tree must be far below the f32 one.
+    assert qb < 0.45 * fb

@@ -1,0 +1,97 @@
+"""SAC (continuous control) + multi-agent independent PPO.
+
+Parity targets: rllib/algorithms/sac/sac.py (twin critics, squashed
+Gaussian, auto entropy temperature) and rllib/env/multi_agent_env.py +
+policy_map.py (per-agent policies trained on per-agent rewards).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import SAC, SACConfig
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    TwoAgentReach,
+)
+
+
+def test_sac_learns_pendulum():
+    """Pendulum swing-up: untrained ≈ -1100..-1600; < -900 within a
+    small CPU budget demonstrates learning."""
+    algo = (SACConfig()
+            .environment("Pendulum-v1")
+            .training(steps_per_iteration=256, train_batch_size=128,
+                      learning_starts=500)
+            .debugging(seed=0)
+            .build())
+    result = None
+    for _ in range(20):
+        result = algo.train()
+    assert result["episode_return_mean"] > -900, result
+    # Entropy temperature is being adapted, not stuck at init.
+    assert result["alpha"] > 0.0
+    # Deterministic action has the env's action shape and bound.
+    a = algo.compute_single_action(np.zeros(3, np.float32))
+    assert a.shape == (1,) and abs(float(a[0])) <= 2.0 + 1e-5
+
+
+def test_sac_checkpoint_roundtrip():
+    algo = (SACConfig()
+            .training(steps_per_iteration=64, learning_starts=64)
+            .debugging(seed=1).build())
+    algo.train()
+    state = algo.get_state()
+    algo2 = SACConfig().debugging(seed=2).build()
+    algo2.set_state(state)
+    o = np.zeros(3, np.float32)
+    np.testing.assert_allclose(
+        algo.compute_single_action(o), algo2.compute_single_action(o),
+        rtol=1e-5,
+    )
+
+
+def test_sac_rejects_discrete_env():
+    with pytest.raises(ValueError):
+        SACConfig().environment("CartPole-v1").build()
+
+
+def test_two_agent_env_mechanics():
+    env = TwoAgentReach()
+    state, obs = env.reset(jax.random.key(0))
+    assert obs.shape == (2, 8)
+    state, obs, rew, done = env.step(
+        state, jax.numpy.zeros((2, 2)))
+    assert rew.shape == (2,)
+    assert float(rew[0]) <= 0.0 and not bool(done)
+
+
+def test_multi_agent_ppo_learns_with_per_agent_policies():
+    algo = (MultiAgentPPOConfig()
+            .env_runners(num_envs=16, rollout_length=64)
+            .debugging(seed=0)
+            .build())
+    first = None
+    result = None
+    for _ in range(12):
+        result = algo.train()
+        m = result["episode_return_mean"]
+        if first is None and m == m:
+            first = m
+    assert result["episode_return_mean"] > first + 15, (first, result)
+    # BOTH agents improved — per-agent reward attribution works.
+    assert result["episode_return_mean/agent_0"] > first
+    assert result["episode_return_mean/agent_1"] > first
+    # The two policies are distinct parameter slices, not shared.
+    leaves = jax.tree_util.tree_leaves(algo.params)
+    assert all(l.shape[0] == 2 for l in leaves)
+    a0 = np.asarray(leaves[0][0])
+    a1 = np.asarray(leaves[0][1])
+    assert not np.allclose(a0, a1)
+
+
+def test_multi_agent_actions_per_agent():
+    algo = MultiAgentPPOConfig().debugging(seed=3).build()
+    acts = algo.compute_actions(np.zeros((2, 8), np.float32))
+    assert acts.shape == (2, 2)

@@ -25,7 +25,12 @@ def test_validation():
     with pytest.raises(TypeError):
         renv.RuntimeEnv(env_vars={"A": 1})
     with pytest.raises(NotImplementedError):
-        renv.RuntimeEnv(pip=["requests"])
+        renv.RuntimeEnv(conda={"dependencies": ["requests"]})
+    assert renv.RuntimeEnv(pip=["requests"])["pip"] == ["requests"]
+    assert renv.RuntimeEnv(
+        pip={"packages": ["a", "b"]})["pip"] == ["a", "b"]
+    with pytest.raises(TypeError):
+        renv.RuntimeEnv(pip=[1, 2])
 
 
 def test_task_env_vars(rt):
@@ -120,3 +125,77 @@ def test_plugin(rt, tmp_path):
     finally:
         renv._plugins.pop("my_plugin", None)
         renv._KNOWN_FIELDS.discard("my_plugin")
+
+
+def _make_wheel(tmp_path, name="rtpudemo", version="0.1", value=42):
+    """Hand-rolled minimal wheel — pip installs local wheels with no
+    network, which is how the pip-env path is exercised offline."""
+    import zipfile
+
+    dist = f"{name}-{version}"
+    whl = tmp_path / f"{dist}-py3-none-any.whl"
+    meta = (f"Metadata-Version: 2.1\nName: {name}\n"
+            f"Version: {version}\n")
+    wheel_meta = ("Wheel-Version: 1.0\nGenerator: test\n"
+                  "Root-Is-Purelib: true\nTag: py3-none-any\n")
+    with zipfile.ZipFile(whl, "w") as z:
+        z.writestr(f"{name}/__init__.py", f"VALUE = {value}\n")
+        z.writestr(f"{dist}.dist-info/METADATA", meta)
+        z.writestr(f"{dist}.dist-info/WHEEL", wheel_meta)
+        z.writestr(
+            f"{dist}.dist-info/RECORD",
+            f"{name}/__init__.py,,\n"
+            f"{dist}.dist-info/METADATA,,\n"
+            f"{dist}.dist-info/WHEEL,,\n"
+            f"{dist}.dist-info/RECORD,,\n",
+        )
+    return str(whl)
+
+
+def test_pip_env_local_wheel(rt, tmp_path):
+    """A task importing a wheel absent from the driver env runs under
+    runtime_env={"pip": [<wheel>]} (parity: pip.py URI-cached builds;
+    offline via a local wheel)."""
+    whl = _make_wheel(tmp_path)
+
+    @ray_tpu.remote
+    def use_pkg():
+        import rtpudemo
+
+        return rtpudemo.VALUE
+
+    with pytest.raises(Exception):
+        ray_tpu.get(use_pkg.remote())  # not installed in the driver env
+    out = ray_tpu.get(
+        use_pkg.options(runtime_env={"pip": [whl]}).remote())
+    assert out == 42
+    # Cached: second materialization reuses the built target dir.
+    site = renv.ensure_pip([whl])
+    assert renv.ensure_pip([whl]) == site
+    import os as _os
+
+    assert _os.path.isdir(site)
+
+
+def test_pip_env_in_process_worker(tmp_path, monkeypatch):
+    """Same wheel through a PROCESS worker: the env ships to the worker
+    and materializes there."""
+    monkeypatch.setenv("RAYTPU_WORKERS", "process")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        whl = _make_wheel(tmp_path, value=7)
+
+        @ray_tpu.remote
+        def use_pkg():
+            import os
+
+            import rtpudemo
+
+            return rtpudemo.VALUE, os.getpid()
+
+        val, pid = ray_tpu.get(
+            use_pkg.options(runtime_env={"pip": [whl]}).remote())
+        assert val == 7 and pid != __import__("os").getpid()
+    finally:
+        ray_tpu.shutdown()

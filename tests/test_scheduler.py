@@ -16,7 +16,14 @@ from ray_tpu.core import api as _api
 
 
 @pytest.fixture
-def rt():
+def rt(monkeypatch):
+    # THREAD mode (the annotated exception; process is the default):
+    # these tests introspect scheduler internals (_pending,
+    # _waiting_deps) and gate tasks on driver-process threading.Events,
+    # which cannot cross a process boundary.  The dispatch logic under
+    # test is backend-agnostic; process-mode dispatch is covered by
+    # tests/test_process_workers.py and tests/test_node_daemon.py.
+    monkeypatch.setenv("RAYTPU_WORKERS", "thread")
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=8)
     yield _api.runtime()

@@ -107,6 +107,39 @@ def test_composition(serve_instance):
     assert handle.remote(4).result() == 50
 
 
+def test_async_composition_interleaves(serve_instance):
+    """An ASYNC replica awaiting a downstream handle (parity: awaitable
+    DeploymentResponse, serve/handle.py DeploymentResponse.__await__):
+    N concurrent requests overlap their downstream awaits on one
+    replica's event loop instead of serializing."""
+    import time as _time
+
+    @serve.deployment
+    class Slow:
+        def __call__(self, x):
+            _time.sleep(0.4)
+            return x + 1
+
+    @serve.deployment
+    class Gateway:
+        def __init__(self, slow):
+            self.slow = slow
+
+        async def __call__(self, x):
+            y = await self.slow.remote(x)
+            return y * 10
+
+    handle = serve.run(Gateway.bind(Slow.bind()), name="async-comp",
+                       route_prefix=None)
+    t0 = _time.monotonic()
+    resps = [handle.remote(i) for i in range(6)]
+    out = sorted(r.result(timeout_s=30) for r in resps)
+    dt = _time.monotonic() - t0
+    assert out == [10, 20, 30, 40, 50, 60]
+    # Serial execution would take ≥ 2.4 s; interleaved ≈ 0.4 s + overhead.
+    assert dt < 2.0, f"async composition did not interleave: {dt:.2f}s"
+
+
 def test_response_passing(serve_instance):
     @serve.deployment
     class A:

@@ -159,3 +159,80 @@ def test_resume_checkpoint_in_function_trainable(tmp_path):
     grid = tune.run(trainable, param_space={}, metric="training_iteration")
     assert seen.read_text() == "1"
     assert grid[0].checkpoint == {"step": 3}
+
+
+def test_tuner_survives_driver_crash(tmp_path):
+    """kill -9 of the DRIVER mid-sweep → Tuner.restore resumes from the
+    periodic experiment snapshot: finished trials keep results,
+    interrupted ones restart from their last checkpoint (parity:
+    tune/execution/experiment_state.py + Tuner.restore)."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+    import time
+
+    from ray_tpu.tune import RunConfig, TuneConfig, Tuner
+
+    storage = str(tmp_path / "exp")
+    runs_dir = tmp_path / "runs"
+    runs_dir.mkdir()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {repo!r})
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault("RAYTPU_WORKERS", "thread")
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import ray_tpu
+        from ray_tpu import tune
+        from ray_tpu.tune import RunConfig, TuneConfig, Tuner
+
+        def slow_trial(config):
+            ckpt = tune.get_checkpoint()
+            start = 0 if ckpt is None else ckpt["step"] + 1
+            for step in range(start, 4):
+                with open(os.path.join({str(runs_dir)!r},
+                          f"t{{config['x']}}_s{{step}}"), "w") as f:
+                    f.write("1")
+                time.sleep(0.6)
+                tune.report({{"training_iteration": step,
+                             "score": config["x"]}},
+                            checkpoint={{"step": step}})
+
+        ray_tpu.init(num_cpus=2)
+        Tuner(slow_trial,
+              param_space={{"x": tune.grid_search([1, 2, 3, 4])}},
+              tune_config=TuneConfig(max_concurrent_trials=2),
+              run_config=RunConfig(storage_path={storage!r},
+                                   name="crashme",
+                                   snapshot_period_s=0.2)).fit()
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", script])
+    # Let it make progress (snapshots every 0.2 s), then hard-kill.
+    deadline = time.time() + 60
+    state = os.path.join(storage, "crashme", "experiment_state.pkl")
+    while time.time() < deadline:
+        if os.path.exists(state) and len(list(runs_dir.iterdir())) >= 3:
+            break
+        time.sleep(0.1)
+    proc.kill()
+    proc.wait()
+    assert os.path.exists(state), "no snapshot written before the crash"
+
+    def slow_trial(config):
+        ckpt = tune.get_checkpoint()
+        start = 0 if ckpt is None else ckpt["step"] + 1
+        for step in range(start, 4):
+            (runs_dir / f"t{config['x']}_s{step}").write_text("1")
+            tune.report({"training_iteration": step, "score": config["x"]},
+                        checkpoint={"step": step})
+
+    grid = Tuner.restore(os.path.join(storage, "crashme"),
+                         slow_trial).fit()
+    assert len(grid) == 4
+    scores = sorted(r.metrics["score"] for r in grid)
+    assert scores == [1, 2, 3, 4]
+    for r in grid:
+        assert r.error is None
+        assert r.checkpoint == {"step": 3}
