@@ -18,8 +18,10 @@ from ray_tpu.autoscaler.autoscaler import (
     StandardAutoscaler,
 )
 from ray_tpu.autoscaler.node_provider import FakeNodeProvider, NodeProvider
+from ray_tpu.autoscaler.tpu_provider import TPUPodConfig, TPUPodProvider
 
 __all__ = [
+    "TPUPodConfig", "TPUPodProvider",
     "AutoscalerMonitor",
     "FakeNodeProvider",
     "NodeProvider",

@@ -1,0 +1,134 @@
+"""Single-file dashboard frontend (no build step, no external deps).
+
+Parity: the reference ships a React client (ray: dashboard/client/) —
+here one self-contained page polls the same REST surface
+(dashboard/head.py routes) and renders cluster resources, nodes,
+actors, task summaries, placement groups and jobs, auto-refreshing.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font: 13px/1.45 system-ui, sans-serif; margin: 0;
+         background: Canvas; color: CanvasText; }
+  header { padding: 10px 18px; border-bottom: 1px solid color-mix(in srgb, CanvasText 18%, Canvas);
+           display: flex; gap: 16px; align-items: baseline; }
+  header h1 { font-size: 16px; margin: 0; }
+  header .muted, .muted { opacity: .62; }
+  main { padding: 12px 18px; display: grid; gap: 18px;
+         grid-template-columns: repeat(auto-fit, minmax(420px, 1fr)); }
+  section { min-width: 0; }
+  h2 { font-size: 13px; text-transform: uppercase; letter-spacing: .06em;
+       opacity: .72; margin: 0 0 6px; }
+  table { border-collapse: collapse; width: 100%; }
+  th, td { text-align: left; padding: 3px 10px 3px 0; white-space: nowrap;
+           overflow: hidden; text-overflow: ellipsis; max-width: 260px;
+           border-bottom: 1px solid color-mix(in srgb, CanvasText 10%, Canvas); }
+  th { font-weight: 600; opacity: .72; }
+  .bar { height: 6px; border-radius: 3px; width: 140px; display: inline-block;
+         background: color-mix(in srgb, CanvasText 12%, Canvas); vertical-align: middle; }
+  .bar i { display: block; height: 100%; border-radius: 3px;
+           background: #5b8def; }
+  .ok { color: #2e9e5b; } .bad { color: #d64545; } .warn { color: #c7861f; }
+  code { font-size: 12px; }
+  footer { padding: 8px 18px; }
+  a { color: inherit; }
+</style></head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span id="uptime" class="muted"></span>
+  <span style="flex:1"></span>
+  <span class="muted">auto-refresh 2s ·
+    <a href="/metrics">metrics</a> · <a href="/timeline">timeline</a> ·
+    <a href="/api/cluster_status">raw</a></span>
+</header>
+<main>
+  <section><h2>Resources</h2><div id="resources"></div></section>
+  <section><h2>Nodes</h2><div id="nodes"></div></section>
+  <section><h2>Task summary</h2><div id="tasks"></div></section>
+  <section><h2>Actors</h2><div id="actors"></div></section>
+  <section><h2>Placement groups</h2><div id="pgs"></div></section>
+  <section><h2>Jobs</h2><div id="jobs"></div></section>
+  <section><h2>Serve</h2><div id="serve"></div></section>
+</main>
+<footer class="muted" id="err"></footer>
+<script>
+const $ = id => document.getElementById(id);
+const esc = s => String(s).replace(/[&<>]/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;'}[c]));
+function table(rows, cols) {
+  if (!rows || !rows.length) return '<div class="muted">none</div>';
+  let h = '<table><tr>' + cols.map(c => `<th>${esc(c)}</th>`).join('')
+        + '</tr>';
+  for (const r of rows.slice(0, 50))
+    h += '<tr>' + cols.map(c => `<td>${esc(r[c] ?? '')}</td>`).join('')
+       + '</tr>';
+  if (rows.length > 50)
+    h += `<tr><td class="muted" colspan="${cols.length}">… ${rows.length - 50} more</td></tr>`;
+  return h + '</table>';
+}
+async function j(url) { const r = await fetch(url); return r.json(); }
+async function refresh() {
+  try {
+    const st = await j('/api/cluster_status');
+    const total = st.resources || {}, avail = st.available || {};
+    let rh = '<table>';
+    for (const k of Object.keys(total).sort()) {
+      const used = total[k] - (avail[k] ?? 0);
+      const pct = total[k] ? Math.round(100 * used / total[k]) : 0;
+      rh += `<tr><th>${esc(k)}</th><td><span class="bar"><i style="width:${pct}%"></i></span></td>
+             <td>${Number(used.toFixed(2))} / ${Number(total[k].toFixed(2))}</td></tr>`;
+    }
+    $('resources').innerHTML = rh + '</table>';
+    const nodes = (st.nodes || []).map(n => ({
+      id: (n.node_id || '').slice(0, 12),
+      state: n.state,
+      CPU: (n.resources || {}).CPU ?? '', TPU: (n.resources || {}).TPU ?? '',
+      labels: Object.entries(n.labels || {}).map(([k, v]) => `${k}=${v}`).join(' '),
+    }));
+    $('nodes').innerHTML = table(nodes, ['id', 'state', 'CPU', 'TPU', 'labels'])
+      .replaceAll('>ALIVE<', ' class="ok">ALIVE<')
+      .replaceAll('>DEAD<', ' class="bad">DEAD<');
+    const ts = (await j('/api/v0/tasks/summarize')).result || {};
+    const rows = Object.entries(ts).map(([name, states]) =>
+      Object.assign({name}, states));
+    const stateCols = [...new Set(rows.flatMap(r =>
+      Object.keys(r).filter(k => k !== 'name')))];
+    $('tasks').innerHTML = table(rows, ['name', ...stateCols]);
+    const actors = ((await j('/api/v0/actors')).result || []).map(a => ({
+      id: (a.actor_id || '').slice(0, 12), class: a.class_name,
+      state: a.state, name: a.name || '',
+      node: (a.node_id || '').slice(0, 8),
+    }));
+    $('actors').innerHTML = table(actors, ['id', 'class', 'state', 'name', 'node'])
+      .replaceAll('>ALIVE<', ' class="ok">ALIVE<')
+      .replaceAll('>DEAD<', ' class="bad">DEAD<');
+    const pgs = (await j('/api/v0/placement_groups')).result || [];
+    $('pgs').innerHTML = table(pgs.map(p => ({
+      id: (p.placement_group_id || '').slice(0, 12),
+      name: p.name || '', strategy: p.strategy, state: p.state,
+      bundles: Object.keys(p.bundles || {}).length,
+    })), ['id', 'name', 'strategy', 'state', 'bundles']);
+    let jobs = [];
+    try { jobs = (await j('/api/jobs/')).jobs || []; } catch (e) {}
+    $('jobs').innerHTML = table(jobs.map(x => ({
+      id: x.submission_id, status: x.status,
+      entrypoint: (x.entrypoint || '').slice(0, 60),
+    })), ['id', 'status', 'entrypoint']);
+    let serve = {};
+    try { serve = await j('/api/serve/applications'); } catch (e) {}
+    const apps = Object.entries(serve.applications || {}).map(([name, a]) => ({
+      app: name, status: a.status || '',
+      deployments: Object.keys(a.deployments || {}).length,
+    }));
+    $('serve').innerHTML = table(apps, ['app', 'status', 'deployments']);
+    $('err').textContent = '';
+    $('uptime').textContent = new Date().toLocaleTimeString();
+  } catch (e) { $('err').textContent = 'refresh failed: ' + e; }
+}
+refresh(); setInterval(refresh, 2000);
+</script>
+</body></html>
+"""

@@ -25,17 +25,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
-_INDEX = """<!doctype html><title>ray_tpu dashboard</title>
-<h1>ray_tpu dashboard</h1><ul>
-<li><a href=/api/cluster_status>cluster status</a>
-<li><a href=/api/v0/tasks>tasks</a> (<a href=/api/v0/tasks/summarize>summary</a>)
-<li><a href=/api/v0/actors>actors</a>
-<li><a href=/api/v0/objects>objects</a>
-<li><a href=/api/v0/nodes>nodes</a>
-<li><a href=/api/v0/placement_groups>placement groups</a>
-<li><a href=/timeline>timeline</a> (chrome://tracing)
-<li><a href=/metrics>metrics</a> (prometheus)
-</ul>"""
+from ray_tpu.dashboard.frontend import INDEX_HTML as _INDEX
 
 
 class _Handler(BaseHTTPRequestHandler):

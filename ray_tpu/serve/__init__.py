@@ -57,9 +57,9 @@ def start(http_port: Optional[int] = None, http_host: str = "127.0.0.1"):
     global _proxy
     _get_or_create_controller()
     if http_port is not None and _proxy is None:
-        from ray_tpu.serve.http import HTTPProxy
+        from ray_tpu.serve.http import AsyncHTTPProxy
 
-        _proxy = HTTPProxy(http_host, http_port)
+        _proxy = AsyncHTTPProxy(http_host, http_port)
     return _proxy
 
 

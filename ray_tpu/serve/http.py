@@ -2,14 +2,18 @@
 
 Parity with the reference (ray: python/ray/serve/_private/proxy.py —
 HTTPProxy:912 over uvicorn; route matching proxy_router.py).  The
-reference runs one proxy actor per node with an ASGI server; here a
-threaded stdlib HTTP server fronts the same router/handle path (the
-data plane past the socket is identical), keeping the image free of
-server dependencies.
+reference runs one proxy actor per node with an ASGI server; here the
+default data plane is an ASYNCIO HTTP/1.1 server (``AsyncHTTPProxy``:
+keep-alive connections, a bounded handler executor so idle sockets
+hold no threads, SSE streaming) fronting the same router/handle path —
+dependency-free uvicorn-equivalent semantics.  The stdlib threaded
+proxy remains as a fallback (``HTTPProxy``).
 """
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -20,18 +24,77 @@ from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.long_poll import LongPollClient
 
 
-class HTTPProxy:
-    """Routes ``POST <route_prefix>`` to the app's ingress deployment.
+def _sse_frames(result):
+    """SSE framing shared by both proxies: one ``data:`` frame per
+    element of an iterable result (scalars stream as one frame), an
+    error frame on unserializable items, then the [DONE] terminator."""
+    items = result if hasattr(result, "__iter__") \
+        and not isinstance(result, (str, bytes, dict)) else [result]
+    for item in items:
+        try:
+            yield b"data: " + json.dumps(item).encode() + b"\n\n"
+        except (TypeError, ValueError) as e:
+            yield b"data: " + json.dumps(
+                {"error": f"unserializable: {e!r}"}).encode() + b"\n\n"
+            break
+    yield b"data: [DONE]\n\n"
+
+
+class _ProxyBase:
+    """Route table + controller long-poll subscription shared by both
+    proxy implementations."""
+
+    def __init__(self):
+        self._routes: Dict[str, Tuple[str, str]] = {}
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._lock = threading.Lock()
+        self._subscribe()
+
+    def _subscribe(self):
+        from ray_tpu.serve.controller import CONTROLLER_NAME, ROUTES_KEY
+
+        controller = api.get_actor(CONTROLLER_NAME)
+
+        def listen(seen):
+            return api.get(controller.long_poll.remote(seen))
+
+        def update(routes: Dict[str, Tuple[str, str]]):
+            with self._lock:
+                self._routes = dict(routes)
+                self._handles = {
+                    # Bounded assign wait: the proxy must return 500,
+                    # never hang a client socket forever.
+                    prefix: DeploymentHandle(dep, app, assign_timeout_s=55.0)
+                    for prefix, (app, dep) in routes.items()
+                }
+
+        self._client = LongPollClient(listen, {ROUTES_KEY: update})
+        # Seed synchronously so requests right after startup route.
+        update(api.get(controller.get_routes.remote()))
+
+    def _match(self, path: str) -> Optional[DeploymentHandle]:
+        with self._lock:
+            best = None
+            for prefix in self._handles:
+                norm = prefix.rstrip("/") or "/"
+                if path == norm or path.startswith(
+                    norm if norm.endswith("/") else norm + "/"
+                ) or norm == "/":
+                    if best is None or len(norm) > len(best):
+                        best = prefix
+            return self._handles.get(best) if best is not None else None
+
+
+class HTTPProxy(_ProxyBase):
+    """Threaded-stdlib fallback proxy: routes ``POST <route_prefix>``
+    to the app's ingress deployment.
 
     Body: JSON → passed as a dict (or raw string if not JSON).
     Response: JSON-encoded result.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._routes: Dict[str, Tuple[str, str]] = {}
-        self._handles: Dict[str, DeploymentHandle] = {}
-        self._lock = threading.Lock()
-        self._subscribe()
+        super().__init__()
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -84,34 +147,18 @@ class HTTPProxy:
                     self._reply(200, body)
 
             def _reply_sse(self, result: Any):
-                """Server-sent events: one `data:` frame per element of
-                an iterable result, then [DONE] (parity: the
-                reference's StreamingResponse support over ASGI —
-                serve's streaming HTTP responses).  Once headers go out
-                this owns the connection: mid-stream failures become an
-                error frame, never a second HTTP response."""
+                """Server-sent events over the threaded proxy.  Once
+                headers go out this owns the connection: mid-stream
+                failures become an error frame, never a second HTTP
+                response."""
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.end_headers()
-                items = result if hasattr(result, "__iter__") \
-                    and not isinstance(result, (str, bytes, dict)) \
-                    else [result]
                 try:
-                    for item in items:
-                        try:
-                            frame = b"data: " + json.dumps(item).encode() \
-                                + b"\n\n"
-                        except (TypeError, ValueError) as e:
-                            self.wfile.write(
-                                b"data: " + json.dumps(
-                                    {"error": f"unserializable: {e!r}"}
-                                ).encode() + b"\n\n"
-                            )
-                            break
+                    for frame in _sse_frames(result):
                         self.wfile.write(frame)
                         self.wfile.flush()
-                    self.wfile.write(b"data: [DONE]\n\n")
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
 
@@ -129,41 +176,238 @@ class HTTPProxy:
         )
         self._thread.start()
 
-    def _subscribe(self):
-        from ray_tpu.serve.controller import CONTROLLER_NAME, ROUTES_KEY
-
-        controller = api.get_actor(CONTROLLER_NAME)
-
-        def listen(seen):
-            return api.get(controller.long_poll.remote(seen))
-
-        def update(routes: Dict[str, Tuple[str, str]]):
-            with self._lock:
-                self._routes = dict(routes)
-                self._handles = {
-                    # Bounded assign wait: the proxy must return 500,
-                    # never hang a client socket forever.
-                    prefix: DeploymentHandle(dep, app, assign_timeout_s=55.0)
-                    for prefix, (app, dep) in routes.items()
-                }
-
-        self._client = LongPollClient(listen, {ROUTES_KEY: update})
-        # Seed synchronously so requests right after startup route.
-        update(api.get(controller.get_routes.remote()))
-
-    def _match(self, path: str) -> Optional[DeploymentHandle]:
-        with self._lock:
-            best = None
-            for prefix in self._handles:
-                norm = prefix.rstrip("/") or "/"
-                if path == norm or path.startswith(
-                    norm if norm.endswith("/") else norm + "/"
-                ) or norm == "/":
-                    if best is None or len(norm) > len(best):
-                        best = prefix
-            return self._handles.get(best) if best is not None else None
-
     def shutdown(self):
         self._client.stop()
         self._server.shutdown()
         self._server.server_close()
+
+
+_MAX_HEADER_BYTES = 64 * 1024
+_MAX_BODY_BYTES = 256 << 20
+
+
+class AsyncHTTPProxy(_ProxyBase):
+    """Asyncio HTTP/1.1 data plane (the default; parity: serve's
+    uvicorn-based HTTPProxy, proxy.py:912):
+
+    * persistent (keep-alive) connections — thousands of idle clients
+      hold sockets, not threads;
+    * handler work (the blocking ``handle.remote().result()`` hop into
+      the replica plane) runs on a bounded executor, so the accept/IO
+      loop never blocks;
+    * SSE streaming for iterable results (``Accept: text/event-stream``).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 handler_threads: int = 64):
+        super().__init__()
+        self._loop = asyncio.new_event_loop()
+        self._exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=handler_threads, thread_name_prefix="http-handler"
+        )
+        started = threading.Event()
+        box: list = []
+
+        def run_loop():
+            asyncio.set_event_loop(self._loop)
+
+            async def boot():
+                try:
+                    server = await asyncio.start_server(
+                        self._serve_conn, host, port
+                    )
+                except BaseException as e:  # surface bind errors
+                    box.append(e)
+                    started.set()
+                    return
+                box.append(server)
+                started.set()
+                async with server:
+                    await server.serve_forever()
+
+            try:
+                self._loop.run_until_complete(boot())
+            except asyncio.CancelledError:
+                pass
+
+        self._thread = threading.Thread(target=run_loop, daemon=True,
+                                        name="http-proxy-loop")
+        self._thread.start()
+        if not started.wait(10):
+            raise RuntimeError("async HTTP proxy failed to start")
+        if isinstance(box[0], BaseException):
+            raise RuntimeError(
+                f"async HTTP proxy failed to bind {host}:{port}"
+            ) from box[0]
+        self._server = box[0]
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    # -- connection handling ----------------------------------------------
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    head = await reader.readuntil(b"\r\n\r\n")
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                except asyncio.LimitOverrunError:
+                    await self._send_simple(writer, 431, {
+                        "error": "headers too large"}, close=True)
+                    return
+                if len(head) > _MAX_HEADER_BYTES:
+                    await self._send_simple(writer, 431, {
+                        "error": "headers too large"}, close=True)
+                    return
+                lines = head.decode("latin-1").split("\r\n")
+                try:
+                    method, path, version = lines[0].split(" ", 2)
+                except ValueError:
+                    await self._send_simple(writer, 400, {
+                        "error": "bad request line"}, close=True)
+                    return
+                headers = {}
+                for ln in lines[1:]:
+                    if ":" in ln:
+                        k, v = ln.split(":", 1)
+                        headers[k.strip().lower()] = v.strip()
+                if "chunked" in headers.get("transfer-encoding", "").lower():
+                    await self._send_simple(writer, 501, {
+                        "error": "chunked transfer encoding not "
+                                 "supported; send Content-Length"},
+                        close=True)
+                    return
+                try:
+                    n = int(headers.get("content-length", 0) or 0)
+                except ValueError:
+                    await self._send_simple(writer, 400, {
+                        "error": "bad Content-Length"}, close=True)
+                    return
+                if n > _MAX_BODY_BYTES:
+                    await self._send_simple(writer, 413, {
+                        "error": "body too large"}, close=True)
+                    return
+                body = await reader.readexactly(n) if n else b""
+                keep = (version != "HTTP/1.0"
+                        and headers.get("connection", "") != "close")
+                done = await self._dispatch(writer, method, path, headers,
+                                            body, keep)
+                if not done or not keep:
+                    return
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, writer, method: str, path: str,
+                        headers: Dict[str, str], body: bytes,
+                        keep: bool) -> bool:
+        """Handle one request; returns False if the connection must
+        close (e.g. after an SSE stream)."""
+        if method == "GET" and path == "/-/healthz":
+            await self._send_simple(writer, 200, "ok", keep=keep)
+            return True
+        if method == "GET" and path == "/-/routes":
+            with self._lock:
+                routes = {p: f"{a}:{d}"
+                          for p, (a, d) in self._routes.items()}
+            await self._send_simple(writer, 200, routes, keep=keep)
+            return True
+        handle = self._match(path)
+        if handle is None:
+            await self._send_simple(writer, 404, {
+                "error": f"no route for {path}"}, keep=keep)
+            return True
+        try:
+            payload: Any = json.loads(body) if body else None
+        except json.JSONDecodeError:
+            payload = body.decode()
+        loop = asyncio.get_running_loop()
+        try:
+            # The replica hop is blocking — bounded executor, not the
+            # IO loop (parity: uvicorn workers awaiting the handle).
+            result = await loop.run_in_executor(
+                self._exec,
+                lambda: handle.remote(payload).result(timeout_s=60.0),
+            )
+        except Exception as e:
+            await self._send_simple(writer, 500, {"error": repr(e)},
+                                    keep=keep)
+            return True
+        if "text/event-stream" in headers.get("accept", ""):
+            await self._send_sse(writer, result)
+            return False  # SSE owns and ends the connection
+        try:
+            payload_out = json.dumps(result).encode()
+        except (TypeError, ValueError) as e:
+            await self._send_simple(writer, 500, {
+                "error": f"unserializable result: {e!r}"}, keep=keep)
+            return True
+        await self._send_raw(writer, 200, payload_out, keep=keep)
+        return True
+
+    async def _send_sse(self, writer, result: Any) -> None:
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Cache-Control: no-cache\r\n"
+                     b"Connection: close\r\n\r\n")
+        loop = asyncio.get_running_loop()
+        frames = _sse_frames(result)
+
+        def next_frame():
+            try:
+                return next(frames)
+            except StopIteration:
+                return None
+
+        try:
+            while True:
+                # Pull from the (possibly blocking) iterator off-loop.
+                frame = await loop.run_in_executor(self._exec, next_frame)
+                if frame is None:
+                    break
+                writer.write(frame)
+                await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+    async def _send_simple(self, writer, code: int, obj: Any,
+                           keep: bool = False, close: bool = False) -> None:
+        await self._send_raw(writer, code, json.dumps(obj).encode(),
+                             keep=keep and not close)
+
+    async def _send_raw(self, writer, code: int, body: bytes,
+                        keep: bool) -> None:
+        phrase = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  413: "Payload Too Large", 431: "Headers Too Large",
+                  500: "Internal Server Error"}.get(code, "Status")
+        conn = b"keep-alive" if keep else b"close"
+        writer.write(
+            f"HTTP/1.1 {code} {phrase}\r\n".encode()
+            + b"Content-Type: application/json\r\n"
+            + f"Content-Length: {len(body)}\r\n".encode()
+            + b"Connection: " + conn + b"\r\n\r\n" + body
+        )
+        try:
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+    def shutdown(self):
+        self._client.stop()
+
+        def stop():
+            self._server.close()
+            for task in asyncio.all_tasks(self._loop):
+                task.cancel()
+
+        try:
+            self._loop.call_soon_threadsafe(stop)
+        except RuntimeError:
+            pass
+        self._thread.join(timeout=5)
+        self._exec.shutdown(wait=False)

@@ -436,3 +436,55 @@ def test_async_deployment_loop_concurrency(serve_instance):
     # Serial execution would take 20 s; loop interleaving ≈ 0.4 s + overhead.
     assert elapsed < 8.0, f"async requests serialized: {elapsed:.1f}s"
     assert max(o["peak"] for o in outs) >= 40
+
+
+def test_async_proxy_keepalive_and_concurrency(serve_instance):
+    """The asyncio data plane (serve/http.py AsyncHTTPProxy — parity:
+    proxy.py:912 uvicorn HTTPProxy): one persistent connection serves
+    several requests, and N concurrent slow requests overlap instead of
+    serializing on connection threads."""
+    import http.client
+    import threading as _threading
+    import time as _time
+
+    @serve.deployment(max_ongoing_requests=32)
+    class Slow:
+        def __call__(self, payload=None):
+            _time.sleep(0.5)
+            return {"ok": True}
+
+    proxy = serve.start(http_port=0)
+    serve.run(Slow.bind(), name="slowhttp", route_prefix="/slowhttp")
+    port = proxy.port
+
+    # Keep-alive: three requests over ONE connection.
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    try:
+        for _ in range(3):
+            conn.request("GET", "/-/healthz")
+            r = conn.getresponse()
+            assert r.status == 200
+            r.read()
+            assert r.headers.get("Connection", "").lower() == "keep-alive"
+    finally:
+        conn.close()
+
+    # Concurrency: 8 half-second requests in ~1 RTT, not 4 s.
+    results = []
+
+    def one():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/slowhttp", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=20) as r:
+            results.append(json.loads(r.read()))
+
+    t0 = _time.monotonic()
+    threads = [_threading.Thread(target=one) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = _time.monotonic() - t0
+    assert results == [{"ok": True}] * 8
+    assert dt < 3.0, f"proxy serialized concurrent requests: {dt:.2f}s"
