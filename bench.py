@@ -129,9 +129,9 @@ def _measure(cfg, devices, *, steps: int, batch: int = None,
     return batch * SEQ * steps / dt
 
 
-def _measure_serving(cfg, *, n_requests: int = 48, prompt_len: int = 128,
-                     gen: int = 32, slots: int = 16,
-                     arrival_rate: float = 14.0,
+def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
+                     gen: int = 32, slots: int = 64,
+                     arrival_rate: float = 40.0,
                      params=None, adapter_factory=None) -> dict:
     """Continuous-batching engine (paged KV cache), measured two ways
     (harness shape: the reference's serve microbenchmark,
@@ -346,7 +346,7 @@ def main():
         try:
             extra["serving_1b"] = _measure_serving(
                 dataclasses.replace(BENCH_1B_CFG, max_seq_len=512),
-                n_requests=32, arrival_rate=6.0)
+                n_requests=64, slots=32, arrival_rate=12.0)
         except Exception as e:
             extra["serving_1b"] = {"error": repr(e)[:120]}
         # North star #3: the 8B artifact — int8 serving (measured) +

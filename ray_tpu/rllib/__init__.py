@@ -22,13 +22,16 @@ from ray_tpu.rllib.env_runner import EnvRunnerGroup
 from ray_tpu.rllib.models import ActorCritic
 from ray_tpu.rllib.multi_agent import (MultiAgentPPO, MultiAgentPPOConfig,
                                        TwoAgentReach)
+from ray_tpu.rllib.offline import (BC, BCConfig, CQL, CQLConfig,
+                                   OfflineDataset)
 from ray_tpu.rllib.replay_buffer import DeviceReplayBuffer, HostReplayBuffer
 
 __all__ = [
     "Algorithm", "AlgorithmConfig",
     "PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
     "SAC", "SACConfig", "MultiAgentPPO", "MultiAgentPPOConfig",
-    "TwoAgentReach",
+    "TwoAgentReach", "BC", "BCConfig", "CQL", "CQLConfig",
+    "OfflineDataset",
     "vtrace",
     "CartPole", "Pendulum", "ExternalEnv", "make_env", "register_env",
     "EnvRunnerGroup", "ActorCritic",

@@ -1,0 +1,387 @@
+"""Offline RL: datasets of logged transitions + BC and CQL learners.
+
+Parity target: the reference's offline stack (ray: rllib/offline/ —
+dataset readers feeding offline algorithms; rllib/algorithms/bc/bc.py
+behavior cloning; rllib/algorithms/cql/cql.py conservative Q-learning).
+TPU redesign consistent with the rest of this rllib: the dataset lives
+ON DEVICE as stacked arrays, an epoch of minibatch updates is one
+``lax.scan`` inside a single jit, and nothing touches the host between
+``train()`` calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.sac import (
+    _actor_dist,
+    _q,
+    _sample_squashed,
+)
+from ray_tpu.rllib.models import apply_mlp, init_mlp
+
+
+@dataclasses.dataclass
+class OfflineDataset:
+    """Logged transitions as stacked arrays (parity: the SampleBatch
+    columns offline readers produce — rllib/offline/json_reader.py)."""
+
+    obs: np.ndarray        # [N, obs_dim]
+    action: np.ndarray     # [N, act_dim] (continuous) or [N] (discrete)
+    reward: np.ndarray     # [N]
+    next_obs: np.ndarray   # [N, obs_dim]
+    done: np.ndarray       # [N]
+
+    def __len__(self) -> int:
+        return len(self.obs)
+
+    @classmethod
+    def collect(cls, env, policy: Callable[[np.ndarray, np.random.Generator],
+                                           np.ndarray],
+                *, num_steps: int, seed: int = 0) -> "OfflineDataset":
+        """Roll a host-side policy through a jax env to build a logged
+        dataset (parity: `rllib train ... --output` rollout logging)."""
+        rng = np.random.default_rng(seed)
+        key = jax.random.key(seed)
+        key, k = jax.random.split(key)
+        state, obs = env.reset(k)
+        rows: Dict[str, list] = {c: [] for c in
+                                 ("obs", "action", "reward", "next_obs",
+                                  "done")}
+        for _ in range(num_steps):
+            o = np.asarray(obs)
+            a = np.asarray(policy(o, rng), np.float32)
+            state, nobs, r, d = env.step(state, jnp.asarray(a))
+            rows["obs"].append(o)
+            rows["action"].append(a)
+            rows["reward"].append(float(r))
+            rows["next_obs"].append(np.asarray(nobs))
+            rows["done"].append(float(bool(d)))
+            if bool(d):
+                key, k = jax.random.split(key)
+                state, obs = env.reset(k)
+            else:
+                obs = nobs
+        return cls(**{k2: np.asarray(v, np.float32)
+                      for k2, v in rows.items()})
+
+    def save(self, path: str) -> None:
+        np.savez(path, obs=self.obs, action=self.action,
+                 reward=self.reward, next_obs=self.next_obs,
+                 done=self.done)
+
+    @classmethod
+    def load(cls, path: str) -> "OfflineDataset":
+        z = np.load(path)
+        return cls(obs=z["obs"], action=z["action"], reward=z["reward"],
+                   next_obs=z["next_obs"], done=z["done"])
+
+
+class BCConfig(AlgorithmConfig):
+    """Behavior cloning (parity: rllib/algorithms/bc/bc.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self.env = "Pendulum-v1"
+        self.dataset: Optional[OfflineDataset] = None
+        self.train_batch_size = 256
+        self.updates_per_iteration = 64
+        self.action_scale: float = None
+        self.lr = 1e-3
+        self.hidden = (128, 128)
+
+    @property
+    def algo_class(self):
+        return BC
+
+
+class BC(Algorithm):
+    """Max-likelihood regression onto the logged actions: for the
+    squashed-Gaussian head, minimize -log π(a_data | s)."""
+
+    config_class = BCConfig
+
+    def _setup(self) -> None:
+        cfg = self.config
+        env = self.env
+        if cfg.dataset is None:
+            raise ValueError("BCConfig.dataset is required (offline)")
+        if env.discrete:
+            raise ValueError("this BC targets continuous actions")
+        if cfg.action_scale is None:
+            cfg.action_scale = float(getattr(env, "max_torque", 1.0))
+        obs_dim, act_dim = env.observation_size, env.action_size
+        key = jax.random.key(cfg.seed)
+        key, ka = jax.random.split(key)
+        self.params = init_mlp(ka, obs_dim, cfg.hidden, 2 * act_dim,
+                               final_scale=0.01)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.data = jax.device_put({
+            "obs": jnp.asarray(cfg.dataset.obs),
+            "action": jnp.asarray(cfg.dataset.action),
+        })
+        self.key = key
+        self._iteration_fn = jax.jit(partial(
+            _bc_iteration, self.tx, _bc_static(cfg)))
+
+    def _train_once(self) -> Dict[str, Any]:
+        self.key, k = jax.random.split(self.key)
+        self.params, self.opt_state, metrics = self._iteration_fn(
+            self.params, self.opt_state, self.data, k)
+        out = {k2: float(v) for k2, v in metrics.items()}
+        out["_timesteps"] = (self.config.updates_per_iteration
+                             * self.config.train_batch_size)
+        return out
+
+    def compute_single_action(self, obs, explore: bool = False):
+        mu, _ = _actor_dist(self.params, jnp.asarray(obs)[None])
+        return np.asarray(jnp.tanh(mu[0]) * self.config.action_scale)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state),
+                "iteration": self.iteration,
+                "timesteps_total": self._timesteps_total}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+
+
+def _bc_static(cfg: BCConfig):
+    return (cfg.updates_per_iteration, cfg.train_batch_size,
+            cfg.action_scale)
+
+
+def _bc_iteration(tx, scfg, params, opt_state, data, key):
+    updates_n, batch, scale = scfg
+    n = data["obs"].shape[0]
+
+    def nll(p, obs, act):
+        # Deterministic cloning in ACTION space: MSE between the
+        # squashed policy mean and the logged action.  A Gaussian NLL
+        # on the pre-squash value blows up on saturated logged actions
+        # (clip at ±scale → arctanh → ±8 outliers dominate the fit);
+        # action-space regression is robust to them.
+        mu, _log_std = _actor_dist(p, obs)
+        pred = jnp.tanh(mu) * scale
+        return jnp.mean((pred - act) ** 2)
+
+    def step(carry, k):
+        params, opt_state = carry
+        idx = jax.random.randint(k, (batch,), 0, n)
+        loss, grads = jax.value_and_grad(nll)(
+            params, data["obs"][idx], data["action"][idx])
+        upd, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, upd), opt_state), loss
+
+    (params, opt_state), losses = lax.scan(
+        step, (params, opt_state), jax.random.split(key, updates_n))
+    return params, opt_state, {"bc_loss": jnp.mean(losses)}
+
+
+class CQLConfig(AlgorithmConfig):
+    """Conservative Q-learning (parity: rllib/algorithms/cql/cql.py —
+    SAC losses + the conservative penalty that pushes down Q on
+    out-of-distribution actions)."""
+
+    def __init__(self):
+        super().__init__()
+        self.env = "Pendulum-v1"
+        self.dataset: Optional[OfflineDataset] = None
+        self.train_batch_size = 256
+        self.updates_per_iteration = 64
+        self.cql_alpha = 1.0          # conservative penalty weight
+        self.cql_num_actions = 4      # sampled actions for the logsumexp
+        # TD3+BC-style regularizer: the actor objective is normalized
+        # by mean |Q| and anchored to the dataset actions — the
+        # standard stabilizer for offline actor extraction.
+        self.actor_bc_weight = 1.0
+        self.tau = 0.005
+        self.init_alpha = 0.1
+        self.target_entropy: float = None
+        self.action_scale: float = None
+        self.lr = 3e-4
+        self.hidden = (128, 128)
+
+    @property
+    def algo_class(self):
+        return CQL
+
+
+class CQL(Algorithm):
+    config_class = CQLConfig
+
+    def _setup(self) -> None:
+        cfg = self.config
+        env = self.env
+        if cfg.dataset is None:
+            raise ValueError("CQLConfig.dataset is required (offline)")
+        if env.discrete:
+            raise ValueError("this CQL targets continuous actions")
+        obs_dim, act_dim = env.observation_size, env.action_size
+        if cfg.target_entropy is None:
+            cfg.target_entropy = -float(act_dim)
+        if cfg.action_scale is None:
+            cfg.action_scale = float(getattr(env, "max_torque", 1.0))
+        key = jax.random.key(cfg.seed)
+        key, ka, k1, k2 = jax.random.split(key, 4)
+        self.params = {
+            "actor": init_mlp(ka, obs_dim, cfg.hidden, 2 * act_dim,
+                              final_scale=0.01),
+            "q1": init_mlp(k1, obs_dim + act_dim, cfg.hidden, 1,
+                           final_scale=1.0),
+            "q2": init_mlp(k2, obs_dim + act_dim, cfg.hidden, 1,
+                           final_scale=1.0),
+            "log_alpha": jnp.log(jnp.float32(cfg.init_alpha)),
+        }
+        self.target_q = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        d = cfg.dataset
+        self.data = jax.device_put({
+            "obs": jnp.asarray(d.obs), "action": jnp.asarray(d.action),
+            "reward": jnp.asarray(d.reward),
+            "next_obs": jnp.asarray(d.next_obs),
+            "done": jnp.asarray(d.done),
+        })
+        self.key = key
+        self._iteration_fn = jax.jit(partial(
+            _cql_iteration, self.tx, _cql_static(cfg)))
+
+    def _train_once(self) -> Dict[str, Any]:
+        self.key, k = jax.random.split(self.key)
+        (self.params, self.target_q, self.opt_state,
+         metrics) = self._iteration_fn(
+            self.params, self.target_q, self.opt_state, self.data, k)
+        out = {k2: float(v) for k2, v in metrics.items()}
+        out["_timesteps"] = (self.config.updates_per_iteration
+                             * self.config.train_batch_size)
+        return out
+
+    def compute_single_action(self, obs, explore: bool = False):
+        mu, _ = _actor_dist(self.params["actor"],
+                            jnp.asarray(obs)[None])
+        return np.asarray(jnp.tanh(mu[0]) * self.config.action_scale)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": jax.device_get(self.params),
+                "target_q": jax.device_get(self.target_q),
+                "opt_state": jax.device_get(self.opt_state),
+                "iteration": self.iteration,
+                "timesteps_total": self._timesteps_total}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.target_q = jax.device_put(state["target_q"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+
+
+def _cql_static(cfg: CQLConfig):
+    return (cfg.updates_per_iteration, cfg.train_batch_size, cfg.gamma,
+            cfg.tau, cfg.target_entropy, cfg.action_scale,
+            cfg.cql_alpha, cfg.cql_num_actions, cfg.actor_bc_weight)
+
+
+def _cql_iteration(tx, scfg, params, target_q, opt_state, data, key):
+    (updates_n, batch, gamma, tau, target_entropy, scale, cql_alpha,
+     n_cql, bc_w) = scfg
+    n = data["obs"].shape[0]
+
+    def losses(p, tq, mb, k):
+        k1, k2, k3, k4 = jax.random.split(k, 4)
+        alpha = jnp.exp(p["log_alpha"])
+        # SAC critic target.
+        a_next, logp_next = _sample_squashed(p["actor"], mb["next_obs"],
+                                             k1, scale)
+        q_next = jnp.minimum(
+            _q(tq["q1"], mb["next_obs"], a_next),
+            _q(tq["q2"], mb["next_obs"], a_next),
+        ) - lax.stop_gradient(alpha) * logp_next
+        target = lax.stop_gradient(
+            mb["reward"] + gamma * (1 - mb["done"]) * q_next)
+        q1 = _q(p["q1"], mb["obs"], mb["action"])
+        q2 = _q(p["q2"], mb["obs"], mb["action"])
+        bellman = jnp.mean((q1 - target) ** 2) \
+            + jnp.mean((q2 - target) ** 2)
+        # Conservative penalty: push Q down on sampled (OOD) actions,
+        # up on dataset actions — logsumexp over uniform + policy
+        # samples (CQL(H), the reference's default variant).
+        B = mb["obs"].shape[0]
+        act_dim = mb["action"].shape[-1]
+        rand_a = jax.random.uniform(k2, (n_cql, B, act_dim),
+                                    minval=-scale, maxval=scale)
+        pol_a, _ = _sample_squashed(
+            p["actor"],
+            jnp.broadcast_to(mb["obs"], (n_cql,) + mb["obs"].shape), k3,
+            scale)
+        # The conservative penalty trains CRITICS only: without this
+        # stop_gradient the reparameterized policy sample would hand
+        # the actor a gradient MINIMIZING logsumexp Q — i.e. steering
+        # the policy toward low-Q actions, the opposite of its
+        # objective (reference CQL keeps the penalty in the critic
+        # loss alone).
+        pol_a = lax.stop_gradient(pol_a)
+
+        def q_all(qp):
+            qs_r = jax.vmap(lambda a: _q(qp, mb["obs"], a))(rand_a)
+            qs_p = jax.vmap(lambda a: _q(qp, mb["obs"], a))(pol_a)
+            cat = jnp.concatenate([qs_r, qs_p], axis=0)  # [2K, B]
+            return jax.scipy.special.logsumexp(cat, axis=0) \
+                - jnp.log(2.0 * n_cql)
+
+        cql_pen = (jnp.mean(q_all(p["q1"]) - q1)
+                   + jnp.mean(q_all(p["q2"]) - q2))
+        # SAC actor + temperature on dataset states, normalized by
+        # mean |Q| and anchored to logged actions (TD3+BC's lambda
+        # trick) — pure critic-maximization drifts off-distribution on
+        # small offline datasets.
+        a_pi, logp_pi = _sample_squashed(p["actor"], mb["obs"], k4, scale)
+        q_pi = jnp.minimum(
+            _q(lax.stop_gradient(p["q1"]), mb["obs"], a_pi),
+            _q(lax.stop_gradient(p["q2"]), mb["obs"], a_pi),
+        )
+        q_norm = lax.stop_gradient(jnp.mean(jnp.abs(q_pi)) + 1e-6)
+        mu, _ls = _actor_dist(p["actor"], mb["obs"])
+        bc_mse = jnp.mean((jnp.tanh(mu) * scale - mb["action"]) ** 2)
+        actor_loss = (jnp.mean(lax.stop_gradient(alpha) * logp_pi - q_pi)
+                      / q_norm + bc_w * bc_mse)
+        alpha_loss = -jnp.mean(
+            p["log_alpha"] * lax.stop_gradient(logp_pi + target_entropy))
+        total = bellman + cql_alpha * cql_pen + actor_loss + alpha_loss
+        return total, {"bellman": bellman, "cql_penalty": cql_pen,
+                       "actor_loss": actor_loss, "alpha": alpha}
+
+    def step(carry, k):
+        params, target_q, opt_state = carry
+        ks, kl = jax.random.split(k)
+        idx = jax.random.randint(ks, (batch,), 0, n)
+        mb = {c: v[idx] for c, v in data.items()}
+        (l, aux), grads = jax.value_and_grad(losses, has_aux=True)(
+            params, target_q, mb, kl)
+        upd, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, upd)
+        target_q = jax.tree_util.tree_map(
+            lambda t, o: (1 - tau) * t + tau * o,
+            target_q, {"q1": params["q1"], "q2": params["q2"]})
+        return (params, target_q, opt_state), aux
+
+    (params, target_q, opt_state), auxes = lax.scan(
+        step, (params, target_q, opt_state),
+        jax.random.split(key, updates_n))
+    metrics = {k2: jnp.mean(v) for k2, v in auxes.items()}
+    return params, target_q, opt_state, metrics

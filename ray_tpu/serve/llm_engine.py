@@ -288,9 +288,15 @@ class LLMEngine:
         # (the depth-2 pipeline that hides the dispatch RTT).
         self._cur_dev = jnp.zeros((config.max_slots,), jnp.int32)
         self._temps = np.zeros((config.max_slots,), np.float32)
-        # In-flight decode chunks: (toks_dev, chunk, [(slot, req)]) —
-        # dispatched, host processing deferred.
-        self._inflight: List[Tuple[Any, int, List[Tuple[int, Any]]]] = []
+        # In-flight entries (prefill/decode) ride a dedicated FETCH
+        # thread: the engine loop dispatches device work and emits
+        # fetched tokens, while the fetcher turns queued entries into
+        # ONE batched device_get at a time (a get costs a full ~100 ms
+        # round trip on tunneled devices regardless of payload, so the
+        # batch size self-balances to the arrival rate).
+        self._fetchq: "queue.Queue" = queue.Queue()
+        self._fetched: "queue.Queue" = queue.Queue()
+        self._unprocessed = 0  # dispatched entries not yet emitted
         self._inflight_tokens: Dict[int, int] = {}  # slot → undelivered
         self._req_counter = itertools.count()
         self._stopped = threading.Event()
@@ -406,6 +412,10 @@ class LLMEngine:
             target=self._loop, daemon=True, name="llm-engine"
         )
         self._thread.start()
+        self._fetcher = threading.Thread(
+            target=self._fetch_loop, daemon=True, name="llm-fetch"
+        )
+        self._fetcher.start()
 
     # -- client API --------------------------------------------------------
 
@@ -456,6 +466,7 @@ class LLMEngine:
     def shutdown(self):
         self._stopped.set()
         self._work.set()
+        self._fetchq.put(None)  # release the fetcher
 
     # -- engine loop -------------------------------------------------------
 
@@ -551,7 +562,8 @@ class LLMEngine:
             self._inflight_tokens[slot] = \
                 self._inflight_tokens.get(slot, 0) + 1
         self._state_dirty = True  # active/temps/bt/lens changed
-        self._inflight.append(("prefill", toks_dev, 0, list(batch)))
+        self._unprocessed += 1
+        self._fetchq.put(("prefill", toks_dev, 0, list(batch)))
 
     def _pages_needed(self, req: Request) -> int:
         """Pages covering max(prefill bucket, prompt+max_new)."""
@@ -727,23 +739,51 @@ class LLMEngine:
             self._inflight_tokens[slot] = (
                 self._inflight_tokens.get(slot, 0) + chunk
             )
-        self._inflight.append(("decode", toks_dev, chunk, participants))
+        self._unprocessed += 1
+        self._fetchq.put(("decode", toks_dev, chunk, participants))
 
-    def _process_ready(self, keep: int = 0) -> None:
-        """Host half of the pipeline: fetch every in-flight entry but
-        the newest ``keep`` in ONE batched device_get (each get costs a
-        full round trip on tunneled devices — batching N entries into
-        one call amortizes it), then emit in dispatch order."""
-        take = len(self._inflight) - keep
-        if take <= 0:
-            return
-        entries = self._inflight[:take]
-        del self._inflight[:take]
-        fetched = jax.device_get([e[1] for e in entries])
-        now = time.monotonic()
-        for (kind, _dev, chunk, participants), toks in zip(entries,
-                                                           fetched):
-            toks = np.asarray(toks)
+    def _fetch_loop(self) -> None:
+        """Dedicated fetch thread: drain every queued entry, batch them
+        into ONE device_get, hand the host arrays back to the engine
+        loop in dispatch order.  Gets overlap dispatching AND each
+        other's processing; the batch size self-balances to load."""
+        while not self._stopped.is_set():
+            entries = [self._fetchq.get()]
+            if entries[0] is None:
+                return
+            while True:
+                try:
+                    nxt = self._fetchq.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    return
+                entries.append(nxt)
+            try:
+                fetched = jax.device_get([e[1] for e in entries])
+            except BaseException as e:
+                self._fetched.put(e)
+                return
+            for entry, toks in zip(entries, fetched):
+                self._fetched.put((entry, np.asarray(toks)))
+
+    def _process_fetched(self, block: bool) -> bool:
+        """Emit every fetched entry available; returns True if any was
+        processed.  ``block`` waits briefly for the next one (used when
+        the loop has nothing to dispatch)."""
+        processed = False
+        while True:
+            try:
+                item = self._fetched.get(timeout=0.02) if block \
+                    and not processed else self._fetched.get_nowait()
+            except queue.Empty:
+                return processed
+            if isinstance(item, BaseException):
+                raise item
+            processed = True
+            self._unprocessed -= 1
+            (kind, _dev, chunk, participants), toks = item
+            now = time.monotonic()
             if kind == "prefill":
                 for i, (req, slot) in enumerate(participants):
                     left = self._inflight_tokens.get(slot, 0) - 1
@@ -768,13 +808,16 @@ class LLMEngine:
                     if self._slot_req.get(slot) is not req:
                         break  # finished mid-chunk
 
-    _PIPELINE_DEPTH = 3
+    # Dispatched-but-unemitted entries: enough to keep the device and
+    # the fetch pipe full; budget gating bounds per-slot run-ahead.
+    _PIPELINE_DEPTH = 6
 
     def _loop(self):
         try:
             self._loop_body()
         except BaseException as e:  # engine crash — fail every client
             self._stopped.set()
+            self._fetchq.put(None)  # release the fetcher thread too
             err = RuntimeError(f"LLM engine loop crashed: {e!r}")
             err.__cause__ = e
             failing = list(self._slot_req.values())
@@ -793,21 +836,18 @@ class LLMEngine:
         while not self._stopped.is_set():
             backlog = self._paged and self._backlog
             if (not self._slot_req and self._waiting.empty()
-                    and not backlog and not self._inflight):
+                    and not backlog and self._unprocessed == 0):
                 self._work.wait(timeout=0.05)
                 self._work.clear()
                 continue
+            self._process_fetched(block=False)
             self._admit()
             dispatched = False
-            if self._slot_req and len(self._inflight) < self._PIPELINE_DEPTH:
+            if self._slot_req and self._unprocessed < self._PIPELINE_DEPTH:
                 chunk = self._chunk_size()
                 if chunk > 0:
                     self._dispatch_decode(chunk)
                     dispatched = True
-            if len(self._inflight) >= self._PIPELINE_DEPTH:
-                # Pipeline full: drain all but one (it keeps the device
-                # busy while the host emits).
-                self._process_ready(keep=1)
-            elif self._inflight and not dispatched:
-                # Nothing else to do — drain everything.
-                self._process_ready(keep=0)
+            if not dispatched and self._unprocessed > 0:
+                # Nothing to dispatch — wait for the fetcher.
+                self._process_fetched(block=True)
