@@ -1,0 +1,78 @@
+"""joblib parallel backend over the ray_tpu task runtime.
+
+Parity: ray: python/ray/util/joblib/__init__.py register_ray +
+ray_backend.py RayBackend — a joblib backend built on the
+multiprocessing.Pool shim, so scikit-learn-style code scales onto the
+cluster unchanged:
+
+    import joblib
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        results = joblib.Parallel()(joblib.delayed(f)(x) for x in xs)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def register_ray_tpu() -> None:
+    """Register the "ray_tpu" joblib backend (idempotent)."""
+    try:
+        from joblib.parallel import register_parallel_backend
+    except ImportError as e:  # pragma: no cover - joblib is baked in
+        raise ImportError(
+            "joblib is required for the ray_tpu joblib backend"
+        ) from e
+    register_parallel_backend("ray_tpu", _make_backend_class())
+
+
+_backend_cls = None
+
+
+def _make_backend_class():
+    global _backend_cls
+    if _backend_cls is not None:
+        return _backend_cls
+
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    class RayTpuBackend(MultiprocessingBackend):
+        """joblib backend whose pool is ray_tpu actors (parity:
+        ray_backend.py RayBackend subclassing MultiprocessingBackend
+        with the ray Pool)."""
+
+        supports_timeout = True
+
+        def effective_n_jobs(self, n_jobs: Optional[int]) -> int:
+            eff = super().effective_n_jobs(n_jobs)
+            if n_jobs in (-1, None):
+                # All cluster CPUs, not just this host's.
+                try:
+                    from ray_tpu.core import api
+
+                    eff = max(eff, int(api.cluster_resources()
+                                       .get("CPU", eff)))
+                except Exception:
+                    pass
+            return max(1, eff)
+
+        def configure(self, n_jobs: int = 1, parallel: Any = None,
+                      prefer: Any = None, require: Any = None,
+                      **memmapping_args) -> int:
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self.parallel = parallel
+            self._pool = Pool(processes=n_jobs)
+            return n_jobs
+
+        def terminate(self) -> None:
+            if getattr(self, "_pool", None) is not None:
+                self._pool.terminate()
+                self._pool = None
+
+    _backend_cls = RayTpuBackend
+    return _backend_cls

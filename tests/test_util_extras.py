@@ -97,3 +97,17 @@ def test_iter_local_iterator(rt):
     assert len(loc.take(5)) == 5
     doubled = loc.for_each(lambda x: x * 2)
     assert all(v % 2 == 0 for v in doubled.take(10))
+
+
+def test_joblib_backend(rt):
+    """scikit-learn-style joblib code runs over ray_tpu tasks (parity:
+    ray.util.joblib register_ray)."""
+    import joblib
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = joblib.Parallel()(
+            joblib.delayed(lambda x: x * x)(i) for i in range(12))
+    assert out == [i * i for i in range(12)]
