@@ -724,6 +724,94 @@ def prefill_slot_paged(
     return logits.astype(jnp.float32), {"k": ck, "v": cv}
 
 
+def prefill_chunk_paged(
+    params: Params,
+    tokens: jax.Array,
+    start: jax.Array,
+    chunk_lens: jax.Array,
+    pages_rows: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One CHUNK of an incremental prefill (chunked prefill: long
+    prompts process in segments interleaved with decode chunks, so
+    admission never stalls running streams).
+
+    tokens [K, C] — the next C prompt tokens of K sequences, occupying
+    absolute positions start[k] .. start[k]+C-1 (right-pad short
+    tails; ``chunk_lens`` [K] is each row's true count).  K/V write
+    into the rows' pages; attention runs against ALL cached positions
+    (prior chunks + this one, causal).  Returns (logits [K, V] at each
+    row's last true position — only meaningful on the final chunk —
+    and the cache)."""
+    K, C = tokens.shape
+    page = cache["k"].shape[3]
+    maxp = pages_rows.shape[1]
+    D = cfg.head_dim
+    KVH = cfg.n_kv_heads
+    positions = start[:, None] + jnp.arange(C)[None, :]
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    ctx = maxp * page
+    group = cfg.n_heads // KVH
+    key_idx = jnp.arange(ctx)[None, None, :]          # [1, 1, S_ctx]
+    q_pos = positions[:, :, None]                     # [K, C, 1]
+    mask = key_idx <= q_pos                           # causal over cache
+
+    # Scatter coordinates for this chunk's K/V (pad rows write OOB).
+    pid = jnp.take_along_axis(
+        pages_rows, jnp.minimum(positions // page, maxp - 1), axis=1)
+    in_chunk = jnp.arange(C)[None, :] < chunk_lens[:, None]
+    num_pages = cache["k"].shape[2]
+    pid = jnp.where(in_chunk, pid, num_pages)         # drop pad writes
+    off = positions % page
+
+    def body(carry, inputs):
+        x = carry
+        layer, k_pages, v_pages = inputs
+        layer = _deq_layer(layer, cfg.dtype)
+        normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _qkv(normed, layer, cfg, sin, cos)  # [K, C, H/KVH, D]
+        k_pages = k_pages.at[:, pid, off].set(
+            k.transpose(2, 0, 1, 3), mode="drop")
+        v_pages = v_pages.at[:, pid, off].set(
+            v.transpose(2, 0, 1, 3), mode="drop")
+        # Gather the rows' full contexts and attend (prefill chunks are
+        # compute-bound matmuls — the gather path is the right shape
+        # for the MXU here; the Pallas kernel covers decode).
+        kk = k_pages[:, pages_rows]                   # [KVH, K, maxp, pg, D]
+        vv = v_pages[:, pages_rows]
+        kk = kk.transpose(1, 2, 3, 0, 4).reshape(K, ctx, KVH, D)
+        vv = vv.transpose(1, 2, 3, 0, 4).reshape(K, ctx, KVH, D)
+        kk = jnp.repeat(kk, group, axis=2)
+        vv = jnp.repeat(vv, group, axis=2)
+        s = jnp.einsum("kchd,kshd->khcs", q.astype(jnp.float32),
+                       kk.astype(jnp.float32)) * (D ** -0.5)
+        if cfg.logits_soft_cap is not None:
+            s = cfg.logits_soft_cap * jnp.tanh(s / cfg.logits_soft_cap)
+        s = jnp.where(mask[:, None, :, :], s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("khcs,kshd->kchd", probs,
+                         vv.astype(jnp.float32)).astype(cfg.dtype)
+        out = jnp.einsum("kchd,hdE->kcE", out,
+                         layer["attn"]["wo"].astype(cfg.dtype))
+        h = x + out
+        h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps),
+                           layer, cfg)
+        return h, (k_pages, v_pages)
+
+    x, (k_new, v_new) = lax.scan(body, x, (params["layers"], cache["k"],
+                                           cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(chunk_lens - 1, 0)[:, None, None].astype(jnp.int32),
+        axis=1)[:, 0]
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = last @ _deq_head(head, cfg.dtype)
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
 def decode_slots_paged(
     params: Params,
     tokens: jax.Array,

@@ -48,8 +48,13 @@ class EngineConfig:
     num_pages: int = 0
     # Decode this many steps per host round-trip (lax.scan on device).
     # Amortizes host↔device latency; tokens past an EOS inside a chunk
-    # are discarded host-side.  Chunk sizes used: {1, 4, decode_chunk}.
+    # are discarded host-side.  Chunk sizes: powers of two ≤ this.
     decode_chunk: int = 16
+    # Chunked prefill (paged mode): prompts longer than this many
+    # tokens prefill in segments of this size, interleaved with decode
+    # chunks — a long prompt never stalls running streams for its full
+    # prefill (0 = always one-shot).
+    prefill_chunk: int = 0
 
     def buckets(self) -> List[int]:
         out, b = [], self.min_prefill_bucket
@@ -114,6 +119,10 @@ class PagedEngineAdapter:
     decode_slots: Callable[..., Tuple[jax.Array, Any, jax.Array]]
     # Batched admission over page rows (see EngineAdapter.prefill_batch).
     prefill_batch: Optional[Callable[..., Tuple[jax.Array, Any]]] = None
+    # Incremental prefill: prefill_chunk(params, tokens[K,C], start[K],
+    # chunk_lens[K], pages_rows[K,maxp], cache) -> (logits[K,V], cache)
+    # — enables EngineConfig.prefill_chunk.
+    prefill_chunk: Optional[Callable[..., Tuple[jax.Array, Any]]] = None
 
 
 def llama_paged_adapter(cfg) -> PagedEngineAdapter:
@@ -131,6 +140,10 @@ def llama_paged_adapter(cfg) -> PagedEngineAdapter:
                                      cfg, cache),
         prefill_batch=lambda params, tokens, true_lens, pages_rows, cache:
             llama.prefill_batch_paged(params, tokens, true_lens,
+                                      pages_rows, cfg, cache),
+        prefill_chunk=lambda params, tokens, start, chunk_lens, pages_rows,
+        cache:
+            llama.prefill_chunk_paged(params, tokens, start, chunk_lens,
                                       pages_rows, cfg, cache),
     )
 
@@ -373,6 +386,23 @@ class LLMEngine:
             # feeds them straight in — no host round trip.
             return cache, toks, cur, lens
 
+        if self._paged and adapter.prefill_chunk is not None:
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_chunk_fn(params, cache, tokens, start, chunk_lens,
+                                 pages_rows, temps, seed, cur, slot_ids):
+                logits, cache = adapter.prefill_chunk(
+                    params, tokens, start, chunk_lens, pages_rows, cache
+                )
+                toks = _sample(logits, temps, jax.random.key(seed[0]))
+                return cache, toks, cur.at[slot_ids].set(toks,
+                                                         mode="drop")
+
+            self._prefill_chunk_fn = prefill_chunk_fn
+        else:
+            self._prefill_chunk_fn = None
+        # Requests mid-incremental-prefill: [{req, slot, pos}].
+        self._prefilling: List[Dict[str, Any]] = []
+
         if adapter.prefill_batch is not None:
             @partial(jax.jit, donate_argnums=(1,))
             def prefill_batched_fn(params, cache, tokens, true_lens,
@@ -458,6 +488,7 @@ class LLMEngine:
     def stats(self) -> Dict[str, Any]:
         return {
             "active_slots": self.config.max_slots - len(self._free_slots),
+            "prefilling": len(getattr(self, "_prefilling", ())),
             "waiting": self._waiting.qsize(),
             "steps": self._steps,
             "tokens_out": self._tokens_out,
@@ -565,6 +596,23 @@ class LLMEngine:
         self._unprocessed += 1
         self._fetchq.put(("prefill", toks_dev, 0, list(batch)))
 
+    def _alloc_slot_pages(self, req: Request,
+                          need: Optional[int] = None) -> Optional[int]:
+        """Claim a slot + its pages for a request; the block-table row
+        gets real pages then the OOB sentinel (see _bt).  None when the
+        pool can't cover it."""
+        if need is None:
+            need = self._pages_needed(req)
+        if not self._free_slots or len(self._free_pages) < need:
+            return None
+        slot = self._free_slots.pop()
+        pages = [self._free_pages.pop() for _ in range(need)]
+        self._slot_pages[slot] = pages
+        row = np.full((self._maxp,), self._num_pages, np.int32)
+        row[: len(pages)] = pages
+        self._bt[slot] = row
+        return slot
+
     def _pages_needed(self, req: Request) -> int:
         """Pages covering max(prefill bucket, prompt+max_new)."""
         page = self.config.page_size
@@ -585,8 +633,33 @@ class LLMEngine:
         """Admission with page allocation: a request needs pages for
         max(prefill bucket, prompt+max_new) tokens; when the pool can't
         cover it the request waits in the backlog (continuous batching
-        under page pressure, the PagedAttention admission rule)."""
+        under page pressure, the PagedAttention admission rule).  Long
+        prompts (> prefill_chunk) go to the incremental-prefill track
+        instead of a one-shot bucket."""
         page = self.config.page_size
+        pc = self.config.prefill_chunk
+        if pc and self._prefill_chunk_fn is not None:
+            while self._free_slots:
+                # Peek for a long-prompt request; admit it incrementally.
+                if self._backlog and len(self._backlog[0].prompt) > pc:
+                    req = self._backlog.pop(0)
+                elif not self._backlog:
+                    try:
+                        req = self._waiting.get_nowait()
+                    except queue.Empty:
+                        break
+                    if len(req.prompt) <= pc:
+                        # Short prompt — normal batched admission path.
+                        self._backlog.insert(0, req)
+                        break
+                else:
+                    break
+                slot = self._alloc_slot_pages(req)
+                if slot is None:
+                    self._backlog.insert(0, req)
+                    break
+                self._prefilling.append({"req": req, "slot": slot,
+                                         "pos": 0})
         while self._free_slots:
             batch: List[Tuple[Request, int]] = []
             group_bucket = None
@@ -610,12 +683,10 @@ class LLMEngine:
                 if len(self._free_pages) < need:
                     self._backlog.append(req)  # wait for page frees
                     break
-                slot = self._free_slots.pop()
-                pages = [self._free_pages.pop() for _ in range(need)]
-                self._slot_pages[slot] = pages
-                row = np.full((self._maxp,), self._num_pages, np.int32)
-                row[: len(pages)] = pages
-                self._bt[slot] = row
+                slot = self._alloc_slot_pages(req, need=need)
+                if slot is None:
+                    self._backlog.append(req)
+                    break
                 batch.append((req, slot))
             if not batch:
                 return
@@ -693,6 +764,50 @@ class LLMEngine:
         if remaining > 0:
             return self._chunk_ladder[-1]  # 1-step chunk covers any tail
         return 0
+
+    def _dispatch_prefill_chunk(self) -> None:
+        """Advance ONE incremental prefill by one chunk (interleaved
+        with decode chunks, so a long prompt never blocks streams for
+        its whole prefill — chunked prefill à la Sarathi/vLLM).  Each
+        chunk enters the fetch pipe as a completion marker, so chunk
+        dispatch is pipeline-gated like decode — the device queue never
+        floods with back-to-back prefill chunks."""
+        st = self._prefilling[0]
+        req, slot, pos = st["req"], st["slot"], st["pos"]
+        C = self.config.prefill_chunk
+        chunk = req.prompt[pos:pos + C]
+        t = np.zeros((1, C), np.int32)
+        t[0, : len(chunk)] = chunk
+        slot_arr = np.asarray([slot], np.int32)
+        is_last = pos + len(chunk) >= len(req.prompt)
+        scatter = (slot_arr if is_last
+                   else np.asarray([self.config.max_slots], np.int32))
+        # Attend only over pages covering the prompt so far (rounded to
+        # a power of two for compile-shape bucketing) — a 256-token
+        # chunk must not pay max_seq_len-wide attention.
+        page = self.config.page_size
+        covered = -(-(pos + len(chunk)) // page)
+        nb = 1
+        while nb < covered:
+            nb *= 2
+        nb = min(nb, self._maxp)
+        self._cache, toks_dev, self._cur_dev = self._prefill_chunk_fn(
+            self._params, self._cache, t,
+            np.asarray([pos], np.int32),
+            np.asarray([len(chunk)], np.int32),
+            self._bt[slot][None, :nb],
+            np.asarray([req.temperature], np.float32),
+            self._next_seed(), self._cur_dev, scatter,
+        )
+        st["pos"] = pos + len(chunk)
+        if is_last:
+            self._prefilling.pop(0)
+            self._lens[slot] = len(req.prompt)
+            self._finish_admit([(req, slot)], toks_dev, slot_arr)
+        else:
+            # Completion marker: counts against the pipeline depth.
+            self._unprocessed += 1
+            self._fetchq.put(("pfchunk", toks_dev, 0, []))
 
     def _refresh_state_args(self) -> None:
         """Rebuild the per-slot control arrays only when admission or a
@@ -784,6 +899,8 @@ class LLMEngine:
             self._unprocessed -= 1
             (kind, _dev, chunk, participants), toks = item
             now = time.monotonic()
+            if kind == "pfchunk":
+                continue  # completion marker only (pipeline gating)
             if kind == "prefill":
                 for i, (req, slot) in enumerate(participants):
                     left = self._inflight_tokens.get(slot, 0) - 1
@@ -823,6 +940,7 @@ class LLMEngine:
             failing = list(self._slot_req.values())
             if self._paged:
                 failing += list(self._backlog)
+                failing += [st["req"] for st in self._prefilling]
             while True:
                 try:
                     failing.append(self._waiting.get_nowait())
@@ -834,7 +952,7 @@ class LLMEngine:
 
     def _loop_body(self):
         while not self._stopped.is_set():
-            backlog = self._paged and self._backlog
+            backlog = self._paged and (self._backlog or self._prefilling)
             if (not self._slot_req and self._waiting.empty()
                     and not backlog and self._unprocessed == 0):
                 self._work.wait(timeout=0.05)
@@ -843,6 +961,13 @@ class LLMEngine:
             self._process_fetched(block=False)
             self._admit()
             dispatched = False
+            if (self._prefilling
+                    and self._unprocessed < self._PIPELINE_DEPTH):
+                # One incremental-prefill chunk per iteration rides the
+                # device queue BETWEEN decode chunks: running streams
+                # stall at most one chunk per long-prompt segment.
+                self._dispatch_prefill_chunk()
+                dispatched = True
             if self._slot_req and self._unprocessed < self._PIPELINE_DEPTH:
                 chunk = self._chunk_size()
                 if chunk > 0:

@@ -254,3 +254,42 @@ def test_engine_paged_rejects_infeasible(tiny_cfg):
     with pytest.raises(ValueError, match="pages"):
         eng.submit(list(range(1, 100)), max_new_tokens=100)
     eng.shutdown()
+
+
+def test_chunked_prefill_matches_oneshot(tiny_cfg):
+    """A long prompt admitted through the incremental-prefill track
+    (EngineConfig.prefill_chunk) generates the same greedy tokens as
+    one-shot admission (chunked prefill à la Sarathi/vLLM)."""
+    from ray_tpu.serve.llm_engine import (
+        EngineConfig,
+        LLMEngine,
+        llama_paged_adapter,
+    )
+
+    cfg = tiny_cfg
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(9)
+    long_prompt = rng.integers(0, cfg.vocab_size, 90).tolist()
+    base = EngineConfig(max_slots=2, max_seq_len=128, decode_chunk=4,
+                        max_new_tokens_default=6, min_prefill_bucket=32,
+                        page_size=32)
+    one = LLMEngine(params, llama_paged_adapter(cfg), base)
+    want = one.generate(long_prompt)
+    one.shutdown()
+    chunked = LLMEngine(
+        params, llama_paged_adapter(cfg),
+        EngineConfig(max_slots=2, max_seq_len=128, decode_chunk=4,
+                     max_new_tokens_default=6, min_prefill_bucket=32,
+                     page_size=32, prefill_chunk=32),
+    )
+    got = chunked.generate(long_prompt)
+    # A long and a short prompt concurrently: the long one's prefill
+    # chunks interleave with the short one's decode.
+    s_long = chunked.submit(long_prompt, max_new_tokens=6)
+    s_short = chunked.submit(long_prompt[:8], max_new_tokens=6)
+    out_long = s_long.result(timeout_s=120)
+    out_short = s_short.result(timeout_s=120)
+    chunked.shutdown()
+    assert got == want
+    assert out_long == want
+    assert len(out_short) == 6
