@@ -1,0 +1,230 @@
+"""Import HuggingFace Llama weights into the ray_tpu param tree.
+
+The switch-over path for reference users: checkpoints trained/served
+with torch stacks load straight into this framework's functional JAX
+llama (ray_tpu/models/llama.py) — from a live ``transformers`` model,
+a state dict, or a directory of ``.safetensors`` shards — with
+optional on-the-fly int8 quantization for serving
+(ray_tpu/models/quant.py).  Numerical equivalence against the HF
+implementation is asserted in tests/test_hf_import.py.
+
+Weight layout mapping (HF stores [out, in]; we store [in, ...] with
+explicit head axes):
+
+    model.embed_tokens.weight  [V, d]    -> tok_embed       [V, d]
+    ...q_proj.weight           [H*hd, d] -> attn.wq         [d, H, hd]
+    ...k_proj/v_proj.weight    [KVH*hd,d]-> attn.wk/wv      [d, KVH, hd]
+    ...o_proj.weight           [d, H*hd] -> attn.wo         [H, hd, d]
+    ...gate_proj/up_proj       [m, d]    -> mlp.w_gate/w_up [d, m]
+    ...down_proj.weight        [d, m]    -> mlp.w_down      [m, d]
+    input_layernorm            [d]       -> ln_attn         [d]
+    post_attention_layernorm   [d]       -> ln_mlp          [d]
+    model.norm.weight          [d]       -> final_norm      [d]
+    lm_head.weight             [V, d]    -> lm_head         [d, V]
+
+Both use the rotate-half RoPE convention, so no permutation is needed.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.llama import LlamaConfig, Params
+
+
+def llama_config_from_hf(hf_cfg: Any,
+                         **overrides: Any) -> LlamaConfig:
+    """Translate a transformers LlamaConfig (object or dict)."""
+    get = (hf_cfg.get if isinstance(hf_cfg, dict)
+           else lambda k, d=None: getattr(hf_cfg, k, d))
+    rope_scaling = None
+    rs = get("rope_scaling")
+    if rs:
+        rs_get = rs.get if isinstance(rs, dict) else \
+            lambda k, d=None: getattr(rs, k, d)
+        rope_type = rs_get("rope_type", rs_get("type", ""))
+        if rope_type != "llama3":
+            raise NotImplementedError(
+                f"rope_scaling type {rope_type!r} is not supported "
+                f"(only the Llama-3.1 'llama3' scaling is) — importing "
+                f"anyway would silently change the model's outputs"
+            )
+        rope_scaling = (
+            float(rs_get("factor")),
+            float(rs_get("low_freq_factor")),
+            float(rs_get("high_freq_factor")),
+            int(rs_get("original_max_position_embeddings")),
+        )
+    if get("attention_bias", False) or get("mlp_bias", False):
+        raise NotImplementedError(
+            "this importer maps bias-free Llama checkpoints; "
+            "attention_bias/mlp_bias=True would be silently dropped"
+        )
+    kwargs = dict(
+        vocab_size=get("vocab_size"),
+        dim=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=get("num_attention_heads"),
+        n_kv_heads=get("num_key_value_heads",
+                       get("num_attention_heads")),
+        mlp_dim=get("intermediate_size"),
+        max_seq_len=get("max_position_embeddings", 8192),
+        rope_theta=float(get("rope_theta", 500_000.0)),
+        norm_eps=float(get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+        rope_scaling=rope_scaling,
+    )
+    kwargs.update(overrides)
+    return LlamaConfig(**kwargs)
+
+
+def _to_np(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().to("cpu").float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def params_from_hf_state_dict(sd: Dict[str, Any],
+                              cfg: LlamaConfig,
+                              param_dtype: Any = None,
+                              quantize: bool = False) -> Params:
+    """Build the stacked ray_tpu param tree from an HF Llama state
+    dict (torch tensors or numpy arrays).
+
+    ``quantize=True`` quantizes each weight matrix PER LAYER as it
+    streams in, so the full-precision tree never materializes on
+    device (an 8B import peaks at one layer's f32 temporaries + the
+    int8 tree, the same budget as quant.init_quantized_llama).
+    Unconsumed checkpoint tensors are an error, not a silent drop."""
+    pd = param_dtype or cfg.param_dtype
+    d, h, kvh, hd, m = (cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                        cfg.head_dim, cfg.mlp_dim)
+    L = cfg.n_layers
+    consumed = set()
+
+    def take(name: str) -> np.ndarray:
+        if name not in sd:
+            raise KeyError(
+                f"HF checkpoint is missing {name!r} — is this a Llama "
+                f"model with n_layers={L}?"
+            )
+        consumed.add(name)
+        return _to_np(sd[name])
+
+    if quantize:
+        from ray_tpu.models.quant import quantize_tensor
+
+        def qleaf(w: np.ndarray):
+            return quantize_tensor(jnp.asarray(w, jnp.float32))
+
+        def stack(fmt: str, transform) -> Any:
+            qs, scales = [], []
+            for i in range(L):
+                qd = qleaf(transform(take(fmt.format(i))))
+                qs.append(qd["q"])
+                scales.append(qd["scale"])
+            return {"q": jnp.stack(qs), "scale": jnp.stack(scales)}
+
+        def norm_stack(fmt: str) -> jnp.ndarray:
+            return jnp.asarray(
+                np.stack([take(fmt.format(i)) for i in range(L)]), pd)
+    else:
+        def stack(fmt: str, transform) -> jnp.ndarray:
+            return jnp.asarray(
+                np.stack([transform(take(fmt.format(i)))
+                          for i in range(L)]), pd)
+
+        def norm_stack(fmt: str) -> jnp.ndarray:
+            return stack(fmt, lambda w: w)
+
+    params: Params = {
+        "tok_embed": jnp.asarray(take("model.embed_tokens.weight"), pd),
+        "layers": {
+            "attn": {
+                "wq": stack("model.layers.{}.self_attn.q_proj.weight",
+                            lambda w: w.T.reshape(d, h, hd)),
+                "wk": stack("model.layers.{}.self_attn.k_proj.weight",
+                            lambda w: w.T.reshape(d, kvh, hd)),
+                "wv": stack("model.layers.{}.self_attn.v_proj.weight",
+                            lambda w: w.T.reshape(d, kvh, hd)),
+                "wo": stack("model.layers.{}.self_attn.o_proj.weight",
+                            lambda w: w.T.reshape(h, hd, d)),
+            },
+            "mlp": {
+                "w_gate": stack("model.layers.{}.mlp.gate_proj.weight",
+                                lambda w: w.T),
+                "w_up": stack("model.layers.{}.mlp.up_proj.weight",
+                              lambda w: w.T),
+                "w_down": stack("model.layers.{}.mlp.down_proj.weight",
+                                lambda w: w.T),
+            },
+            "ln_attn": norm_stack(
+                "model.layers.{}.input_layernorm.weight"),
+            "ln_mlp": norm_stack(
+                "model.layers.{}.post_attention_layernorm.weight"),
+        },
+        "final_norm": jnp.asarray(take("model.norm.weight"), pd),
+    }
+    if not cfg.tie_embeddings:
+        head = take("lm_head.weight").T
+        if quantize:
+            from ray_tpu.models.quant import quantize_tensor
+
+            params["lm_head"] = quantize_tensor(
+                jnp.asarray(head, jnp.float32))
+        else:
+            params["lm_head"] = jnp.asarray(head, pd)
+    leftovers = [k for k in sd
+                 if k not in consumed
+                 and not k.endswith("rotary_emb.inv_freq")]
+    if leftovers:
+        raise ValueError(
+            f"unconsumed checkpoint tensors {sorted(leftovers)[:8]}"
+            f"{' …' if len(leftovers) > 8 else ''} — refusing a silent "
+            f"partial import"
+        )
+    return params
+
+
+def _load_safetensors_dir(path: str) -> Dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    shards = sorted(f for f in os.listdir(path)
+                    if f.endswith(".safetensors"))
+    if not shards:
+        raise FileNotFoundError(f"no .safetensors files under {path!r}")
+    sd: Dict[str, np.ndarray] = {}
+    for shard in shards:
+        with safe_open(os.path.join(path, shard), framework="np") as f:
+            for name in f.keys():
+                sd[name] = f.get_tensor(name)
+    return sd
+
+
+def load_llama_from_hf(src: Any, *,
+                       config_overrides: Optional[Dict[str, Any]] = None,
+                       quantize: bool = False):
+    """One-call import: ``src`` is a transformers LlamaForCausalLM, a
+    (state_dict, config) pair, or a checkpoint directory containing
+    ``config.json`` + ``*.safetensors``.  Returns (params, cfg);
+    ``quantize=True`` converts weight matrices to int8 w8a16
+    (models/quant.py) for serving."""
+    overrides = config_overrides or {}
+    if isinstance(src, str):
+        import json
+
+        with open(os.path.join(src, "config.json")) as f:
+            hf_cfg = json.load(f)
+        sd = _load_safetensors_dir(src)
+    elif isinstance(src, tuple):
+        sd, hf_cfg = src
+    else:  # live transformers model
+        sd = src.state_dict()
+        hf_cfg = src.config
+    cfg = llama_config_from_hf(hf_cfg, **overrides)
+    params = params_from_hf_state_dict(sd, cfg, quantize=quantize)
+    return params, cfg

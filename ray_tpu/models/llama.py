@@ -58,6 +58,10 @@ class LlamaConfig:
     # sp_backend == "ulysses".
     sequence_parallel: bool = False
     sp_backend: str = "ring"
+    # Llama-3.1-style RoPE frequency scaling, as a hashable tuple
+    # (factor, low_freq_factor, high_freq_factor, original_max_pos) —
+    # None for unscaled RoPE (Llama-3.0 and earlier).
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
 
     @property
     def head_dim(self) -> int:
@@ -166,6 +170,23 @@ def rope_table(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.A
     """positions [B, S] → (sin, cos) each [B, S, head_dim//2], float32."""
     half = cfg.head_dim // 2
     freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    # getattr: sibling configs (Mixtral etc.) share this table without
+    # carrying the Llama-3.1 scaling field.
+    if getattr(cfg, "rope_scaling", None) is not None:
+        # Llama-3.1 frequency scaling (the "llama3" rope_type):
+        # long wavelengths divide by `factor`, short ones stay, the
+        # band between interpolates — matching transformers'
+        # ROPE_INIT_FUNCTIONS["llama3"].
+        factor, low_ff, high_ff, orig_max = cfg.rope_scaling
+        wavelen = 2 * jnp.pi / freqs
+        low_wl = orig_max / low_ff
+        high_wl = orig_max / high_ff
+        smooth = (orig_max / wavelen - low_ff) / (high_ff - low_ff)
+        scaled = jnp.where(
+            wavelen > low_wl, freqs / factor,
+            jnp.where(wavelen < high_wl, freqs,
+                      (1 - smooth) * freqs / factor + smooth * freqs))
+        freqs = scaled
     angles = positions[..., None].astype(jnp.float32) * freqs
     return jnp.sin(angles), jnp.cos(angles)
 
