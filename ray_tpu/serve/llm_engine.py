@@ -402,6 +402,10 @@ class LLMEngine:
             self._prefill_chunk_fn = None
         # Requests mid-incremental-prefill: [{req, slot, pos}].
         self._prefilling: List[Dict[str, Any]] = []
+        # Requests whose admission prefill is being dispatched — a
+        # crash mid-dispatch must fail them (they are in no other
+        # registry yet).
+        self._admitting: List[Request] = []
 
         if adapter.prefill_batch is not None:
             @partial(jax.jit, donate_argnums=(1,))
@@ -545,6 +549,7 @@ class LLMEngine:
                 true_lens[i] = len(req.prompt)
                 slot_ids[i] = slot
                 temps[i] = req.temperature
+            self._admitting = [req for req, _slot in batch]
             toks_dev = self._run_prefill(k, tokens, true_lens, slot_ids,
                                          temps,
                                          self._scatter_ids(slot_ids,
@@ -563,7 +568,9 @@ class LLMEngine:
         """One admission dispatch: batched [K, S] forward when the
         adapter provides it, else the fori_loop-of-rows program.  The
         sampled first tokens scatter into the device cur INSIDE the
-        program; host arrays ride the dispatch (no separate uploads)."""
+        program; host arrays ride the dispatch (no separate uploads).
+        Callers set self._admitting first: a crash inside the dispatch
+        must still fail these not-yet-registered requests."""
         if self._prefill_batched_fn is not None:
             self._cache, toks_dev, self._cur_dev = \
                 self._prefill_batched_fn(
@@ -592,6 +599,10 @@ class LLMEngine:
             # the prefill entry is processed.
             self._inflight_tokens[slot] = \
                 self._inflight_tokens.get(slot, 0) + 1
+        # Cleared only AFTER every request is registered: a crash in
+        # the window between the two registries would otherwise strand
+        # clients (an overlap double-fail is a benign extra put).
+        self._admitting = []
         self._state_dirty = True  # active/temps/bt/lens changed
         self._unprocessed += 1
         self._fetchq.put(("prefill", toks_dev, 0, list(batch)))
@@ -709,6 +720,7 @@ class LLMEngine:
                 np.int32)
             for req, slot in batch:
                 self._lens[slot] = len(req.prompt)
+            self._admitting = [req for req, _slot in batch]
             toks_dev = self._run_prefill(k, tokens, true_lens, pages_rows,
                                          temps,
                                          self._scatter_ids(slot_ids,
@@ -938,6 +950,7 @@ class LLMEngine:
             err = RuntimeError(f"LLM engine loop crashed: {e!r}")
             err.__cause__ = e
             failing = list(self._slot_req.values())
+            failing += list(self._admitting)
             if self._paged:
                 failing += list(self._backlog)
                 failing += [st["req"] for st in self._prefilling]

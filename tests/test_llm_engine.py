@@ -144,3 +144,42 @@ def test_serve_llm_deployment(params):
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_engine_crash_fails_clients_fast(params):
+    """An engine whose device loop raises must FAIL waiting clients
+    (and reject new submits) — never hang them (the loop-crash path in
+    LLMEngine._loop; the loop deliberately re-raises after failing
+    clients so the crash is visible in logs — hence the filtered
+    thread-exception warning)."""
+    from ray_tpu.serve.llm_engine import (
+        LLMEngine,
+        PagedEngineAdapter,
+        llama_paged_adapter,
+    )
+
+    cfg = CFG
+    good = llama_paged_adapter(cfg)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected device failure")
+
+    bad = PagedEngineAdapter(
+        init_cache=good.init_cache,
+        prefill_slot=boom,
+        decode_slots=boom,
+        prefill_batch=boom,
+    )
+    eng = LLMEngine(params, bad, EngineConfig(
+        max_slots=2, max_seq_len=64, decode_chunk=4,
+        max_new_tokens_default=4, min_prefill_bucket=16, page_size=16))
+    try:
+        with pytest.raises(RuntimeError, match="engine loop crashed"):
+            eng.generate([1, 2, 3])
+        # The engine is dead: new submissions fail fast, not hang.
+        with pytest.raises(RuntimeError, match="stopped"):
+            eng.submit([4, 5])
+    finally:
+        eng.shutdown()
