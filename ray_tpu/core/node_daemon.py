@@ -275,9 +275,29 @@ class NodeServer:
         # over a default advertise host (NAT-less clusters).
         if addr[0] in ("", "0.0.0.0"):
             addr = (peer[0], addr[1])
-        node_id = rt.register_remote_node(
-            agent, hello["resources"], hello.get("labels"), addr
-        )
+        reset_workers = False
+        if hello.get("node_id"):
+            # Rejoin: the daemon was already a member (this head
+            # restarted, or its channel blipped).  The runtime decides
+            # whether the old identity is still usable.
+            node_id, accepted = rt.rejoin_remote_node(
+                agent, hello["node_id"], hello["resources"],
+                hello.get("labels"), addr, hello.get("objects") or [],
+            )
+            if not accepted:
+                try:
+                    send_msg(conn, {"ok": False, "stale": True})
+                except Exception:
+                    pass
+                chan.close()
+                return
+            # The new head has no record of the daemon's previous
+            # leases/borrows — previous-epoch workers are leaked.
+            reset_workers = True
+        else:
+            node_id = rt.register_remote_node(
+                agent, hello["resources"], hello.get("labels"), addr
+            )
         agent.node_hex = node_id.hex()
         chan.on_close = lambda: self._node_lost(node_id)
         from ray_tpu.utils.config import get_config
@@ -290,6 +310,7 @@ class NodeServer:
                 "config": get_config().snapshot(),
                 "sys_path": list(sys.path),
                 "cwd": os.getcwd(),
+                "reset_workers": reset_workers,
             })
         except Exception:
             chan.close()
@@ -395,10 +416,22 @@ def make_daemon_pool(daemon: "NodeDaemon", rt_shim: "_DaemonRT"):
     return _Pool(rt_shim)
 
 
+class _StaleNodeError(ConnectionError):
+    """The head rejected a rejoin under the old node identity (it never
+    restarted and already declared this node dead)."""
+
+
 class NodeDaemon:
     """One machine's membership in the cluster: local worker pool +
     local object plane, a channel to the head, and a peer server for
-    node-to-node object pulls."""
+    node-to-node object pulls.
+
+    Head fault tolerance: if the head channel drops, the daemon keeps
+    its workers and arena alive and retries the join under its existing
+    node id for ``head_reconnect_window_s``, re-advertising its object
+    inventory so a restarted head re-pins locations (parity: raylets
+    reconnecting to a Redis-recovered GCS, gcs/gcs_client reconnect +
+    python/ray/tests/test_gcs_fault_tolerance.py)."""
 
     def __init__(self, head_addr: Tuple[str, int], *,
                  resources: Dict[str, float],
@@ -408,6 +441,11 @@ class NodeDaemon:
                  token: Optional[str] = None):
         self._token = _cluster_token(token)
         self._exit = threading.Event()
+        self._head_ok = threading.Event()
+        self._head_addr = (head_addr[0], int(head_addr[1]))
+        self._resources = dict(resources)
+        self._labels = dict(labels or {})
+        self._advertise_host = advertise_host
         # Peer listener FIRST (its port goes into the register frame).
         # Loopback unless the cluster token authenticates peers (same
         # trust rule as the head's join port).
@@ -421,27 +459,7 @@ class NodeDaemon:
         self.peer_port = self._peer_listener.getsockname()[1]
 
         # Join the head.
-        from ray_tpu.util.client.common import (
-            client_handshake,
-            recv_msg,
-            send_msg,
-        )
-
-        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.settimeout(15.0)
-        sock.connect(head_addr)
-        client_handshake(sock, self._token or None)
-        send_msg(sock, {
-            "op": "register",
-            "resources": resources,
-            "labels": labels or {},
-            "addr": (advertise_host, self.peer_port),
-            "pid": os.getpid(),
-        })
-        welcome = recv_msg(sock)
-        if not welcome.get("ok"):
-            raise ConnectionError(f"head rejected registration: {welcome}")
-        sock.settimeout(None)
+        sock, welcome = self._dial_head(rejoin=False)
         self.node_id = NodeID(welcome["node_id"])
         self.node_hex = self.node_id.hex()
         self._key_prefix = self.node_hex[:12] + "/"
@@ -482,14 +500,125 @@ class NodeDaemon:
         self._rt_shim = _DaemonRT(self, self.store, self.job_id)
         self.pool = make_daemon_pool(self, self._rt_shim)
         self.head.start()
+        self._head_ok.set()
         threading.Thread(target=self._peer_accept_loop, daemon=True,
                          name="peer-accept").start()
+
+    # -- head connection ---------------------------------------------------
+
+    def _dial_head(self, rejoin: bool) -> Tuple[socket.socket,
+                                                Dict[str, Any]]:
+        """Connect + handshake + register with the head.  A rejoin
+        carries the existing node id and the local object inventory so
+        a restarted head can re-pin locations."""
+        from ray_tpu.util.client.common import (
+            client_handshake,
+            recv_msg,
+            send_msg,
+        )
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(15.0)
+        try:
+            sock.connect(self._head_addr)
+            client_handshake(sock, self._token or None)
+            hello = {
+                "op": "register",
+                "resources": self._resources,
+                "labels": self._labels,
+                "addr": (self._advertise_host, self.peer_port),
+                "pid": os.getpid(),
+            }
+            if rejoin:
+                hello["node_id"] = self.node_id.binary()
+                hello["objects"] = self.store.inventory()
+            send_msg(sock, hello)
+            welcome = recv_msg(sock)
+        except BaseException:
+            sock.close()
+            raise
+        if not welcome.get("ok"):
+            sock.close()
+            if welcome.get("stale"):
+                raise _StaleNodeError(
+                    f"head declared node {getattr(self, 'node_hex', '?')[:12]}"
+                    " dead; identity not reusable")
+            raise ConnectionError(f"head rejected registration: {welcome}")
+        sock.settimeout(None)
+        return sock, welcome
 
     # -- lifecycle ---------------------------------------------------------
 
     def _on_head_lost(self) -> None:
-        # Head gone → this node has no cluster; terminate everything.
+        from ray_tpu.utils.config import get_config
+
+        self._head_ok.clear()
+        window = get_config().head_reconnect_window_s
+        if self._exit.is_set() or window <= 0:
+            # Clean shutdown, or reconnect disabled: pre-FT behavior.
+            self._exit.set()
+            return
+        threading.Thread(target=self._rejoin_loop, args=(window,),
+                         daemon=True, name="head-rejoin").start()
+
+    def _rejoin_loop(self, window: float) -> None:
+        from ray_tpu.utils.config import get_config
+
+        retry = max(0.05, get_config().head_reconnect_retry_s)
+        deadline = time.monotonic() + window
+        while not self._exit.is_set() and time.monotonic() < deadline:
+            try:
+                sock, welcome = self._dial_head(rejoin=True)
+            except _StaleNodeError:
+                # The head never restarted: it declared this node dead
+                # and already recovered its actors/objects elsewhere.
+                # Resuming under the old identity would race that
+                # recovery — exit; the process manager restarts us as a
+                # fresh node.
+                break
+            except Exception:
+                time.sleep(retry)
+                continue
+            self._adopt_head(sock, welcome)
+            return
         self._exit.set()
+
+    def _adopt_head(self, sock: socket.socket,
+                    welcome: Dict[str, Any]) -> None:
+        """Swap in a fresh head channel after a successful rejoin.
+        Workers keep their channels to THIS daemon throughout, so a
+        head restart is invisible to the object plane; only the
+        control plane pauses (callers block in _head_call)."""
+        self.job_id = JobID(bytes.fromhex(welcome["job_id"]))
+        self._rt_shim.job_id = self.job_id
+        self.head = MsgChannel(sock, self._handle_head_op, name="head",
+                               on_close=self._on_head_lost)
+        if welcome.get("reset_workers"):
+            self._reset_workers()
+        self.head.start()
+        self._head_ok.set()
+
+    def _reset_workers(self) -> None:
+        """Kill every previous-epoch worker: the restarted head has no
+        record of their leases/borrows (its reconcile contract — leaked
+        actors die; detached actors re-create from the restored spec)."""
+        self.pool.kill_all(graceful=False)
+
+    def _head_call(self, op: str, **payload):
+        """head.call for IDEMPOTENT (object-plane read) ops that rides
+        out a head restart: while the daemon is rejoining, callers
+        block; once the new channel is up, the op retries.  Worker
+        control-plane ops must NOT go through here — a mutating op
+        whose effect survived via GCS persistence would double-execute
+        on replay; those fail fast instead (_forward), and the
+        previous-epoch workers die on rejoin anyway (_reset_workers)."""
+        while True:
+            try:
+                return self.head.call(op, **payload)
+            except ChannelClosedError:
+                if self._exit.is_set():
+                    raise
+                self._head_ok.wait(1.0)
 
     def wait(self) -> None:
         self._exit.wait()
@@ -614,6 +743,10 @@ class NodeDaemon:
         from ray_tpu.core.worker_pool import _wkey
 
         payload["wkey"] = self._key_prefix + _wkey(chan)
+        # No restart-replay for worker control ops: a mutating op (task
+        # submit, actor create) may have executed + persisted before the
+        # head died — replay would double-execute it.  The worker gets
+        # the channel error; previous-epoch workers are killed on rejoin.
         return self.head.call(msg["op"], **payload)
 
     def _get_raw(self, msg: Dict[str, Any]) -> List[Tuple[str, Any]]:
@@ -643,8 +776,8 @@ class NodeDaemon:
             if ev is not None:
                 ev.wait(300.0)
                 continue
-            (entry,) = self.head.call("get_wire", oids=[oid_bin],
-                                      timeout=timeout)
+            (entry,) = self._head_call("get_wire", oids=[oid_bin],
+                                       timeout=timeout)
             kind = entry[0]
             if kind in ("b", "err"):
                 return entry
@@ -658,7 +791,7 @@ class NodeDaemon:
                 # Head thinks it's here but the local copy is gone
                 # (arena eviction): report and retry — the head
                 # invalidates + reconstructs.
-                self.head.call("report_lost", oid=oid_bin)
+                self._head_call("report_lost", oid=oid_bin)
                 time.sleep(0.2 * (attempt + 1))
                 continue
             try:
@@ -670,8 +803,8 @@ class NodeDaemon:
                 continue
         # Give the head one final authoritative try (it may have an
         # error sealed by now, which is the right thing to raise).
-        (entry,) = self.head.call("get_wire", oids=[oid_bin],
-                                  timeout=timeout)
+        (entry,) = self._head_call("get_wire", oids=[oid_bin],
+                                   timeout=timeout)
         if entry[0] in ("b", "err"):
             return entry
         raise OSError(f"object {oid.hex()}: unfetchable after retries")
@@ -690,7 +823,7 @@ class NodeDaemon:
             ev = self._pulls[oid_bin] = threading.Event()
         try:
             if node_hex == "" or addr is None:
-                data = _pull_bytes(self.head.call, oid_bin, size)
+                data = _pull_bytes(self._head_call, oid_bin, size)
             else:
                 peer = self._peer_channel(tuple(addr))
                 data = _pull_bytes(peer.call, oid_bin, size)

@@ -1081,6 +1081,12 @@ class LocalRuntime:
         # KV + detached-actor specs + detached PG specs survive a
         # driver restart, gcs/store_client/redis_store_client.h:33).
         self._detached_specs: Dict[str, bytes] = {}
+        # Restored detached-actor specs that could not place yet (no
+        # capacity at restart — e.g. the head came back before its
+        # daemons rejoined).  add_node retries them (parity: pending
+        # GCS actor-table entries placed on node add).
+        self._pending_restores: Dict[str, bytes] = {}
+        self._rejoin_lock = threading.Lock()
         self._persist = None
         self._restored_tables = None
         if cfg.gcs_persist_path:
@@ -1111,8 +1117,10 @@ class LocalRuntime:
     # -- cluster membership ------------------------------------------------
 
     def add_node(self, resources: Dict[str, float],
-                 labels: Optional[Dict[str, str]] = None) -> NodeID:
-        node_id = NodeID.from_random()
+                 labels: Optional[Dict[str, str]] = None,
+                 node_id: Optional[NodeID] = None) -> NodeID:
+        if node_id is None:
+            node_id = NodeID.from_random()
         int_id = next(self._node_int_ids)
         native = ((self._native_sched, int_id)
                   if self._native_sched is not None else None)
@@ -1137,6 +1145,9 @@ class LocalRuntime:
             self._reserve_bundles(
                 st, [b for b in st.bundles if b.node_id is None]
             )
+        if getattr(self, "_pending_restores", None):
+            threading.Thread(target=self._retry_detached_restores,
+                             daemon=True, name="detached-restore").start()
         self._notify()
         return node_id
 
@@ -1154,6 +1165,54 @@ class LocalRuntime:
             node.addr = tuple(addr)
         agent.bind(self, node)
         return node_id
+
+    def rejoin_remote_node(self, agent, node_id_bin: bytes,
+                           resources: Dict[str, float],
+                           labels: Optional[Dict[str, str]],
+                           addr: Tuple[str, int],
+                           objects: List[Tuple[bytes, int]]):
+        """A daemon that was already a cluster member reconnects —
+        either this head restarted (its node table is empty) or the
+        daemon's channel blipped.  Returns ``(node_id, accepted)``:
+        ``accepted=False`` tells the daemon its previous identity is
+        stale (the head declared it dead and rescheduled its work) and
+        it must re-register fresh.  On acceptance the daemon keeps its
+        node id and its advertised objects are re-pinned as locations
+        (parity: raylets re-registering with a Redis-recovered GCS,
+        gcs/gcs_server/gcs_server.cc:517-518 + gcs_node_manager
+        re-registration; object locations re-reported by the owner)."""
+        want = NodeID(node_id_bin)
+        # One rejoin admitted per node id: a daemon that redialed while
+        # its first attempt was still registering must not double-insert
+        # the id into the node tables (add_node takes _lock repeatedly,
+        # so the exists-check alone is not atomic with the insert).
+        with self._rejoin_lock:
+            with self._lock:
+                existing = self._nodes.get(want)
+            if existing is not None:
+                # The head never restarted: it has already declared this
+                # node dead (channel close → kill_node) and recovered
+                # its actors/objects elsewhere — or a concurrent rejoin
+                # already won.  Resurrecting the id would race that.
+                return want, False
+            node_id = self.add_node(resources, labels, node_id=want)
+        with self._lock:
+            node = self._nodes[node_id]
+            node.agent = agent
+            node.addr = tuple(addr)
+        agent.bind(self, node)
+        # Re-pin the daemon's surviving objects: location table + store
+        # remote-seal marks + a borrow keyed under the node so the pins
+        # evaporate if the node later dies.
+        node_hex = node_id.hex()
+        restore_key = node_hex[:12] + "/restored"
+        for oid_bin, size in objects:
+            oid = ObjectID(oid_bin)
+            if self.store.is_freed(oid):
+                continue
+            self.seal_remote_at(oid, node_hex, size)
+            self.refs.add_borrow(restore_key, oid)
+        return node_id, True
 
     def seal_remote_at(self, oid: ObjectID, node_hex: str,
                        size: int) -> None:
@@ -1376,9 +1435,44 @@ class LocalRuntime:
                 # Unplaceable/unreplayable NOW ≠ gone: keep the spec in
                 # the durable table so a later restart with capacity can
                 # still recover it (parity: an unplaceable detached
-                # actor stays pending in the GCS actor table).
+                # actor stays pending in the GCS actor table), and queue
+                # it for retry when capacity joins (daemons rejoin a
+                # restarted head AFTER its init).
                 with self._lock:
                     self._detached_specs.setdefault(name, blob)
+                    self._pending_restores.setdefault(name, blob)
+
+    def _retry_detached_restores(self) -> None:
+        """Retry restored-but-unplaced detached actors after a node
+        joined.  Every queued spec gets one attempt per round — a spec
+        that still cannot place must not strand later specs that can."""
+        import cloudpickle as _cp
+
+        with self._lock:
+            pending = dict(self._pending_restores)
+            self._pending_restores.clear()
+        failed: Dict[str, bytes] = {}
+        for name, blob in pending.items():
+            try:
+                cls, args, kwargs, options = _cp.loads(blob)
+            except Exception:
+                continue  # unreplayable spec; durable table keeps it
+            with self._lock:
+                taken = bool(options.name
+                             and options.name in self._named_actors)
+            if taken:
+                continue  # someone already (re)created it
+            try:
+                self.create_actor(cls, args, kwargs, options,
+                                  alloc_timeout=5.0)
+            except Exception:
+                # Still unplaceable (or lost a create race): back in
+                # the queue; the next node join retries.
+                failed[name] = blob
+        if failed:
+            with self._lock:
+                for name, blob in failed.items():
+                    self._pending_restores.setdefault(name, blob)
 
     # -- objects -----------------------------------------------------------
 

@@ -820,6 +820,32 @@ class LocalObjectStore:
             self._shm = None
         self._shm_failed = True  # don't resurrect after shutdown
 
+    def inventory(self) -> List[Tuple[bytes, int]]:
+        """(oid_binary, servable_size) for every object whose bytes this
+        store can serve over the pull plane (shm, in-process, or
+        spilled).  Used by a rejoining node daemon to re-advertise its
+        arena to a restarted head (parity: a raylet re-reporting object
+        locations to a recovered GCS)."""
+        from ray_tpu.core.spill import FileSystemStorage
+
+        with self._lock:
+            items = list(self._objects.items())
+        out: List[Tuple[bytes, int]] = []
+        for oid, st in items:
+            if not st.event.is_set() or st.error is not None:
+                continue
+            if st.in_shm:
+                out.append((oid.binary(), st.shm_size))
+            elif st.value_bytes is not None:
+                out.append((oid.binary(), len(st.value_bytes)))
+            elif st.spilled_uri is not None:
+                try:
+                    _, _, size = FileSystemStorage._parse(st.spilled_uri)
+                except ValueError:
+                    continue
+                out.append((oid.binary(), size))
+        return out
+
     def entries(self) -> List[Dict[str, Any]]:
         """Per-object rows for the state API (parity: `ray list objects`
         / the cluster reference table behind `ray memory`)."""

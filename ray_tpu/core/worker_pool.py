@@ -321,14 +321,25 @@ class WorkerPool:
             if wh in self._idle:
                 self._idle.remove(wh)
 
-    def shutdown(self) -> None:
+    def kill_all(self, graceful: bool = True) -> List[WorkerHandle]:
+        """Terminate every worker without closing the pool — the pool
+        keeps spawning fresh workers afterwards (used by a node daemon
+        discarding its previous epoch after a head restart)."""
         with self._lock:
-            self._closed = True
             workers = list(self._all.values())
             self._all.clear()
             self._idle.clear()
         for wh in workers:
-            wh.terminate()
+            try:
+                wh.terminate(graceful=graceful)
+            except Exception:
+                pass
+        return workers
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+        workers = self.kill_all()
         try:
             self._listener.close()
         except OSError:

@@ -165,9 +165,27 @@ def cmd_start(args, out) -> int:
     ray_tpu.init(num_cpus=args.num_cpus, ignore_reinit_error=True)
     server = NodeServer(api.runtime(), port=args.port)
     dash = DashboardHead(port=args.dashboard_port).start()
+    client_srv = None
+    if getattr(args, "client_port", -1) >= 0:
+        import os as _os
+
+        from ray_tpu.util.client.server import ClientServer
+
+        # Same trust rule as the node-join port: only a token-gated
+        # client server may listen beyond loopback (frames are pickles).
+        host = ("0.0.0.0" if _os.environ.get("RAYTPU_CLIENT_TOKEN")
+                else "127.0.0.1")
+        try:
+            client_srv = ClientServer(host, args.client_port).start()
+        except OSError as e:
+            # A taken default port must not abort head startup.
+            print(f"client server disabled (port {args.client_port}: "
+                  f"{e})", file=out)
     print(f"ray_tpu head started; join with "
           f"`ray_tpu start --address <this-host>:{server.port}`; "
-          f"dashboard at {dash.address}", file=out)
+          + (f"client driver port {client_srv.address}; "
+             if client_srv else "")
+          + f"dashboard at {dash.address}", file=out, flush=True)
     if args.block:
         import signal
 
@@ -177,6 +195,8 @@ def cmd_start(args, out) -> int:
             pass
         finally:
             server.close()
+            if client_srv is not None:
+                client_srv.stop()
             dash.stop()
             ray_tpu.shutdown()
     return 0
@@ -279,6 +299,8 @@ def build_parser() -> argparse.ArgumentParser:
                      help="extra resources as JSON")
     spp.add_argument("--labels", default="{}", help="node labels as JSON")
     spp.add_argument("--dashboard-port", type=int, default=8265)
+    spp.add_argument("--client-port", type=int, default=10001,
+                     help="head: client-mode driver port (-1 disables)")
     spp.add_argument("--block", action="store_true", default=True)
     spp.add_argument("--no-block", dest="block", action="store_false")
     return p

@@ -204,6 +204,17 @@ class ClientContext:
         return ([by_id[b] for b in ready_ids],
                 [by_id[b] for b in pending_ids])
 
+    def get_actor(self, name: str) -> ClientActorHandle:
+        """Attach to a named actor created by any driver."""
+        return ClientActorHandle(self, self._call("get_actor", name=name))
+
+    def hydrate_ref(self, binary_id: bytes) -> ClientObjectRef:
+        """Re-attach to an object id from a previous session (e.g. one
+        recorded before a head restart); errors if the cluster cannot
+        resolve it."""
+        return ClientObjectRef(self, self._call("hydrate_ref",
+                                                id=binary_id))
+
     def kill(self, actor: ClientActorHandle, *, no_restart: bool = True):
         self._call("kill_actor", actor_id=actor._actor_id,
                    no_restart=no_restart)

@@ -179,6 +179,30 @@ class ClientServer:
             for r in out_list:
                 session.refs[r.id.binary()] = r
             return [r.id.binary() for r in out_list]
+        if op == "get_actor":
+            # Parity: ray client supports ray.get_actor on named actors
+            # created by ANY driver (python/ray/util/client/api.py).
+            handle = ray_tpu.get_actor(msg["name"])
+            aid = handle._actor_id.binary()
+            session.actors[aid] = handle
+            return aid
+        if op == "hydrate_ref":
+            # Re-attach to an object created by a previous driver (the
+            # cross-driver ref handoff the reference does via ownership
+            # transfer / serialized refs).  Only ids the cluster can
+            # actually resolve are accepted — a fabricated id still
+            # errors instead of blocking forever.
+            from ray_tpu.core import api as _api
+            from ray_tpu.utils.ids import ObjectID
+
+            rt = _api.runtime()
+            oid = ObjectID(msg["id"])
+            if not rt.store.contains(oid):
+                raise KeyError(
+                    f"object {oid.hex()[:16]} unknown to this cluster")
+            ref = ObjectRef(oid)
+            session.refs[ref.id.binary()] = ref
+            return ref.id.binary()
         if op == "kill_actor":
             handle = session.actors.pop(msg["actor_id"], None)
             if handle is not None:

@@ -150,6 +150,14 @@ D("gcs_persist_path", str, "",
 D("gcs_flush_period_s", float, 0.2,
   "Dirty-snapshot flush period (crash loses at most this window, like "
   "Redis AOF everysec).")
+D("head_reconnect_window_s", float, 60.0,
+  "How long a node daemon keeps retrying to rejoin the head after its "
+  "channel drops before giving up and exiting (parity: raylets "
+  "reconnecting to a restarted GCS, gcs/gcs_client reconnect + "
+  "gcs_rpc_server_reconnect_timeout_s).  0 = exit immediately on head "
+  "loss (pre-FT behavior).")
+D("head_reconnect_retry_s", float, 0.5,
+  "Delay between daemon rejoin attempts while the head is unreachable.")
 
 # --- Fault tolerance ------------------------------------------------------
 D("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")

@@ -1,0 +1,212 @@
+"""Head fault tolerance: kill -9 the head, restart it, daemons rejoin.
+
+Parity targets: the reference's GCS fault tolerance — the GCS restarts
+from Redis-backed storage and every raylet/worker reconnects and
+re-registers (ray: src/ray/gcs/gcs_server/gcs_server.cc:133-137,517-518
+storage selection + replay; gcs/gcs_client reconnect;
+python/ray/tests/test_gcs_fault_tolerance.py).  Here the head process
+is a real subprocess (`ray_tpu start --head`) with GCS persistence on,
+two node-daemon subprocesses join it, a client-mode driver creates
+state, the head is SIGKILLed and restarted at the same ports, and the
+daemons rejoin under their existing node ids, re-advertising their
+object inventories:
+
+- the detached named actor re-resolves (init args replay — same
+  contract as a reference detached actor after GCS + process loss),
+- an object whose primary copy lives in a daemon's arena is still
+  pullable by a NEW driver session (location re-pinned from the
+  daemon's rejoin inventory).
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from ray_tpu.util.client.client import connect
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _base_env(persist_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAYTPU_GCS_PERSIST_PATH"] = persist_path
+    env["RAYTPU_GCS_FLUSH_PERIOD_S"] = "0.05"
+    env["RAYTPU_HEAD_RECONNECT_WINDOW_S"] = "120"
+    env["RAYTPU_HEAD_RECONNECT_RETRY_S"] = "0.25"
+    env.pop("RAYTPU_WORKERS", None)
+    return env
+
+
+def _spawn_head(node_port, client_port, persist_path):
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "start", "--head",
+         "--port", str(node_port), "--client-port", str(client_port),
+         "--dashboard-port", "0", "--num-cpus", "2"],
+        env=_base_env(persist_path),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _spawn_daemon(node_port, persist_path, label):
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_daemon",
+         "--address", f"127.0.0.1:{node_port}", "--num-cpus", "2",
+         "--resources", '{"slot": 1}',
+         "--labels", '{"daemon": "%s"}' % label],
+        env=_base_env(persist_path),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _connect_retry(client_port, deadline_s=60.0):
+    deadline = time.time() + deadline_s
+    last = None
+    while time.time() < deadline:
+        try:
+            return connect(f"127.0.0.1:{client_port}")
+        except Exception as e:  # noqa: BLE001 — conn refused while booting
+            last = e
+            time.sleep(0.3)
+    raise TimeoutError(f"client server never came up: {last}")
+
+
+def _wait_slots(ctx, n, deadline_s=90.0):
+    """Wait until the cluster advertises >= n 'slot' resources (i.e.
+    n daemons are members)."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            if ctx.cluster_resources().get("slot", 0) >= n:
+                return
+        except Exception:
+            pass
+        time.sleep(0.3)
+    raise TimeoutError(f"cluster never reached {n} slots")
+
+
+def test_head_kill9_daemons_rejoin(tmp_path):
+    persist = str(tmp_path / "gcs-snapshot.bin")
+    node_port, client_port = _free_port(), _free_port()
+    head = _spawn_head(node_port, client_port, persist)
+    daemons = []
+    try:
+        ctx = _connect_retry(client_port)
+        daemons = [_spawn_daemon(node_port, persist, f"d{i}")
+                   for i in range(2)]
+        _wait_slots(ctx, 2)
+
+        # -- state created before the crash ----------------------------
+        class Counter:
+            def __init__(self, start=0):
+                self.n = start
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        actor = ctx.remote(Counter, name="survivor", lifetime="detached",
+                           resources={"slot": 0.5}).remote(10)
+        assert ctx.get(actor.bump.remote(), timeout=60) == 11
+
+        def make_payload():
+            import numpy as _np
+
+            return _np.arange(200_000, dtype=_np.float64)
+
+        ref = ctx.remote(make_payload,
+                         resources={"slot": 0.01}).remote()
+        arr = ctx.get(ref, timeout=60)
+        assert arr[-1] == 199_999.0
+        oid = ref.binary_id
+        time.sleep(0.3)  # > flush period: specs must reach the snapshot
+
+        # -- kill -9 the head ------------------------------------------
+        head.send_signal(signal.SIGKILL)
+        head.wait(timeout=10)
+        for d in daemons:
+            assert d.poll() is None, "daemon died with the head"
+
+        # -- restart at the same ports ---------------------------------
+        head = _spawn_head(node_port, client_port, persist)
+        ctx2 = _connect_retry(client_port, deadline_s=90)
+        _wait_slots(ctx2, 2)  # both daemons rejoined
+        for d in daemons:
+            assert d.poll() is None, "daemon gave up instead of rejoining"
+
+        # Named detached actor re-resolves (init args replay; the
+        # restore may lag the daemons' rejoin by a few seconds).
+        deadline = time.time() + 60
+        handle = None
+        while time.time() < deadline:
+            try:
+                handle = ctx2.get_actor("survivor")
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert handle is not None, "named actor never re-resolved"
+        assert ctx2.get(handle.bump.remote(), timeout=60) == 11
+
+        # The pre-crash object is still pullable: its primary copy
+        # survived in a daemon arena and the rejoin inventory re-pinned
+        # its location at the restarted head.
+        ref2 = ctx2.hydrate_ref(oid)
+        arr2 = ctx2.get(ref2, timeout=60)
+        assert isinstance(arr2, np.ndarray)
+        assert arr2.shape == (200_000,) and arr2[-1] == 199_999.0
+    finally:
+        for p in daemons + [head]:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        for p in daemons + [head]:
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                pass
+
+
+def test_daemon_exits_when_reconnect_disabled(tmp_path):
+    """window=0 keeps the pre-FT contract: head loss ends the daemon."""
+    persist = str(tmp_path / "gcs.bin")
+    node_port, client_port = _free_port(), _free_port()
+    head = _spawn_head(node_port, client_port, persist)
+    daemon = None
+    try:
+        ctx = _connect_retry(client_port)
+        env = _base_env(persist)
+        env["RAYTPU_HEAD_RECONNECT_WINDOW_S"] = "0"
+        daemon = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_daemon",
+             "--address", f"127.0.0.1:{node_port}", "--num-cpus", "1",
+             "--resources", '{"slot": 1}'],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        _wait_slots(ctx, 1)
+        head.send_signal(signal.SIGKILL)
+        head.wait(timeout=10)
+        assert daemon.wait(timeout=30) == 0
+    finally:
+        for p in [daemon, head]:
+            if p is None:
+                continue
+            try:
+                p.kill()
+                p.wait(timeout=5)
+            except Exception:
+                pass
