@@ -16,7 +16,8 @@ from ray_tpu.utils.ids import ActorID
 
 _VALID_ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "name", "get_if_exists",
-    "max_restarts", "max_concurrency", "lifetime", "scheduling_strategy",
+    "max_restarts", "max_concurrency", "concurrency_groups",
+    "execute_out_of_order", "lifetime", "scheduling_strategy",
     "placement_group", "placement_bundle_index", "runtime_env",
 }
 
@@ -34,16 +35,27 @@ def method(**options):
     return wrap
 
 
-def collect_method_num_returns(cls: type) -> Dict[str, int]:
-    """@method(num_returns=...) table for a class — shared by direct
-    handles and handles recovered via get_actor."""
-    table: Dict[str, int] = {}
+def _collect_method_option(cls: type, key: str) -> Dict[str, Any]:
+    """name → value table of one @method(...) option across a class."""
+    table: Dict[str, Any] = {}
     for name in dir(cls):
         fn = getattr(cls, name, None)
         opts = getattr(fn, _METHOD_OPTION_ATTR, None)
-        if opts and "num_returns" in opts:
-            table[name] = opts["num_returns"]
+        if opts and key in opts:
+            table[name] = opts[key]
     return table
+
+
+def collect_method_num_returns(cls: type) -> Dict[str, int]:
+    """@method(num_returns=...) table for a class — shared by direct
+    handles and handles recovered via get_actor."""
+    return _collect_method_option(cls, "num_returns")
+
+
+def collect_method_cgroups(cls: type) -> Dict[str, str]:
+    """@method(concurrency_group=...) routing table (parity: ray's
+    decorated concurrency-group assignment, python/ray/actor.py)."""
+    return _collect_method_option(cls, "concurrency_group")
 
 
 def _make_actor_options(defaults: Dict[str, Any], overrides: Dict[str, Any]
@@ -59,10 +71,12 @@ def _make_actor_options(defaults: Dict[str, Any], overrides: Dict[str, Any]
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._cgroup = concurrency_group
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         from ray_tpu.core import api
@@ -70,14 +84,17 @@ class ActorMethod:
         refs = api.runtime().submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=self._num_returns,
+            concurrency_group=self._cgroup,
         )
         if self._num_returns == "streaming":
             return refs  # an ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
-    def options(self, *, num_returns: Optional[int] = None) -> "ActorMethod":
+    def options(self, *, num_returns: Optional[int] = None,
+                concurrency_group: Optional[str] = None) -> "ActorMethod":
         return ActorMethod(self._handle, self._name,
-                           num_returns or self._num_returns)
+                           num_returns or self._num_returns,
+                           concurrency_group or self._cgroup)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -89,17 +106,20 @@ class ActorMethod:
 class ActorHandle:
     def __init__(self, actor_id: ActorID, cls_name: str,
                  method_num_returns: Optional[Dict[str, int]] = None,
-                 creation_ref: Optional[ObjectRef] = None):
+                 creation_ref: Optional[ObjectRef] = None,
+                 method_cgroups: Optional[Dict[str, str]] = None):
         object.__setattr__(self, "_actor_id", actor_id)
         object.__setattr__(self, "_cls_name", cls_name)
         object.__setattr__(self, "_method_num_returns", method_num_returns or {})
         object.__setattr__(self, "_creation_ref", creation_ref)
+        object.__setattr__(self, "_method_cgroups", method_cgroups or {})
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
         return ActorMethod(
-            self, name, self._method_num_returns.get(name, 1)
+            self, name, self._method_num_returns.get(name, 1),
+            self._method_cgroups.get(name),
         )
 
     def __repr__(self):
@@ -108,7 +128,8 @@ class ActorHandle:
     def __reduce__(self):
         return (
             ActorHandle,
-            (self._actor_id, self._cls_name, self._method_num_returns, None),
+            (self._actor_id, self._cls_name, self._method_num_returns, None,
+             self._method_cgroups),
         )
 
 
@@ -117,6 +138,7 @@ class ActorClass:
         self._cls = cls
         self._default_options = default_options
         self._method_num_returns = collect_method_num_returns(cls)
+        self._method_cgroups = collect_method_cgroups(cls)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -146,7 +168,7 @@ class ActorClass:
         )
         return ActorHandle(
             shell.actor_id, self._cls.__name__, self._method_num_returns,
-            creation_ref,
+            creation_ref, self._method_cgroups,
         )
 
     @property

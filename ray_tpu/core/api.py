@@ -140,8 +140,9 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 
 def get_actor(name: str) -> ActorHandle:
-    actor_id, cls_name, table = runtime().named_actor_handle(name)
-    return ActorHandle(actor_id, cls_name, table)
+    actor_id, cls_name, table, cgroups = runtime().named_actor_handle(name)
+    return ActorHandle(actor_id, cls_name, table,
+                       method_cgroups=cgroups)
 
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:

@@ -108,6 +108,18 @@ class ActorOptions:
     get_if_exists: bool = False
     max_restarts: int = 0
     max_concurrency: int = 1
+    # Named concurrency groups: group → max concurrent calls.  Methods
+    # route via @method(concurrency_group=...) or per-call .options();
+    # each group executes independently, so a slow group cannot starve
+    # another (parity: ray concurrency groups,
+    # core_worker/transport/concurrency_group_manager.cc).
+    concurrency_groups: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    # Out-of-order execution: a queued call whose ObjectRef args are
+    # not ready yet does not block later calls (parity:
+    # out_of_order_actor_submit_queue.cc).  Ordering guarantees are
+    # forfeited, as in the reference.
+    execute_out_of_order: bool = False
     lifetime: Optional[str] = None  # None | "detached"
     scheduling_strategy: Any = "DEFAULT"
     placement_group: Any = None
@@ -334,6 +346,15 @@ class _CachedThreadPool:
 _ASYNC_DEFERRED = object()
 
 
+def _collect_arg_oids(args: tuple, kwargs: dict) -> List[ObjectID]:
+    """Top-level ObjectRef dependencies of one actor call (the same
+    top-level contract as resolve_args / the dependency index)."""
+    from ray_tpu.core.object_ref import ObjectRef as _OR
+
+    return [v.id for v in list(args) + list(kwargs.values())
+            if isinstance(v, _OR)]
+
+
 from ray_tpu.utils.interrupt import (
     async_raise as _async_raise,
     clear_async_exc as _clear_async_exc,
@@ -365,6 +386,12 @@ class _ActorShell:
         self.no_restart = False  # set by an explicit kill(no_restart=True)
         self.restarts_left = options.max_restarts
         self.queue: _queue.Queue = _queue.Queue()
+        # Named concurrency groups: each gets its own queue + thread
+        # pool, so groups execute independently (parity:
+        # concurrency_group_manager.cc — one BoundedExecutor per group).
+        self._group_queues: Dict[str, _queue.Queue] = {
+            g: _queue.Queue() for g in (options.concurrency_groups or ())
+        }
         self._creation_oid = creation_oid
         self.thread: Optional[threading.Thread] = None
         # Restart counter for per-attempt task events (parity: each
@@ -384,6 +411,16 @@ class _ActorShell:
         self._loop = None
         self._loop_thread: Optional[threading.Thread] = None
         self._async_sem = None
+        self._async_group_sems: Dict[str, Any] = {}
+        # Orders "dead/drained check + queue.put" against kill/_drain so
+        # a racing submit (esp. a dep-blocked out-of-order call whose
+        # wait spans the death) can't land in a queue nothing drains.
+        self._submit_gate = threading.Lock()
+        self._drained = False
+        # Out-of-order mode: dep-blocked calls park here; ONE dispatcher
+        # thread enqueues them as their deps seal.
+        self._ooo_pending: List[Any] = []
+        self._ooo_thread: Optional[threading.Thread] = None
 
     @property
     def node_id(self) -> Optional[NodeID]:
@@ -458,6 +495,18 @@ class _ActorShell:
             )
             for i in range(n - 1)
         ]
+        # One pool per named concurrency group, sized by its declared
+        # limit — a stalled group never borrows (or blocks) another
+        # group's threads.
+        for gname, gsize in (self.options.concurrency_groups or {}).items():
+            extra += [
+                threading.Thread(
+                    target=self._serve_loop,
+                    args=(self._group_queues[gname],), daemon=True,
+                    name=f"actor-{self.actor_id.hex()[:8]}-{gname}{i}",
+                )
+                for i in range(max(1, int(gsize)))
+            ]
         for t in extra:
             t.start()
         self._serve_loop()
@@ -466,15 +515,17 @@ class _ActorShell:
         self._drain(ActorDiedError(repr(self.cls), self.death_reason or "killed"))
         self.runtime._on_actor_death(self)
 
-    def _serve_loop(self):
+    def _serve_loop(self, queue: Optional[_queue.Queue] = None):
+        queue = queue if queue is not None else self.queue
         while True:
-            item = self.queue.get()
+            item = queue.get()
             if item is None:  # kill signal — re-post so sibling threads stop
-                self.queue.put(None)
+                queue.put(None)
                 return
             method_name, args, kwargs, return_ids, num_returns = item[:5]
             task_id = item[5] if len(item) > 5 else None
             trace_ctx = item[6] if len(item) > 6 else None
+            cgroup = item[7] if len(item) > 7 else None
             task_hex = task_id.hex() if task_id is not None else None
             ev = self.runtime.events
             qname = f"{self.cls.__name__}.{method_name}"
@@ -499,7 +550,8 @@ class _ActorShell:
             try:
                 outcome = self._execute_item(qname, method_name, args, kwargs,
                                              return_ids, num_returns, task_id,
-                                             trace_ctx, task_hex)
+                                             trace_ctx, task_hex,
+                                             cgroup=cgroup)
                 if task_hex and outcome is not _ASYNC_DEFERRED:
                     ev.record(task_hex, _ev.FINISHED)
             except BaseException as e:
@@ -521,7 +573,8 @@ class _ActorShell:
         return threading.current_thread().name
 
     def _execute_item(self, qname, method_name, args, kwargs, return_ids,
-                      num_returns, task_id, trace_ctx, task_hex):
+                      num_returns, task_id, trace_ctx, task_hex,
+                      cgroup=None):
         """Run one dequeued method call; overridden by the process
         shell to push it to the actor's worker process."""
         resolved_args, resolved_kwargs = self.runtime.resolve_args(
@@ -535,7 +588,8 @@ class _ActorShell:
             # actors).  Completion seals results from the callback.
             return self._execute_async(qname, method, resolved_args,
                                        resolved_kwargs, return_ids,
-                                       num_returns, task_id, task_hex)
+                                       num_returns, task_id, task_hex,
+                                       cgroup=cgroup)
         ctx = getattr(self, "_env_ctx", None)
         if task_id is not None:
             with self._cancel_lock:
@@ -565,6 +619,10 @@ class _ActorShell:
             self.runtime._store_results(result, return_ids, num_returns)
 
     def _ensure_loop(self):
+        with self._cancel_lock:
+            return self._ensure_loop_locked()
+
+    def _ensure_loop_locked(self):
         if self._loop is not None:
             return
         import asyncio
@@ -577,6 +635,13 @@ class _ActorShell:
         if limit <= 1:
             limit = 1000
         self._async_sem = asyncio.Semaphore(limit)
+        # Named groups bound their coroutines independently (parity:
+        # per-group event loops in the reference; one shared loop with
+        # per-group semaphores gives the same isolation contract).
+        self._async_group_sems = {
+            g: asyncio.Semaphore(max(1, int(n)))
+            for g, n in (self.options.concurrency_groups or {}).items()
+        }
         self._loop_thread = threading.Thread(
             target=self._loop.run_forever, daemon=True,
             name=f"actor-{self.actor_id.hex()[:8]}-loop",
@@ -584,12 +649,13 @@ class _ActorShell:
         self._loop_thread.start()
 
     def _execute_async(self, qname, method, args, kwargs, return_ids,
-                       num_returns, task_id, task_hex):
+                       num_returns, task_id, task_hex, cgroup=None):
         import asyncio
         import concurrent.futures as _cf
 
         self._ensure_loop()
-        sem = self._async_sem
+        sem = (self._async_group_sems.get(cgroup, self._async_sem)
+               if cgroup else self._async_sem)
 
         async def body():
             async with sem:
@@ -664,11 +730,21 @@ class _ActorShell:
             # actor dies on SystemExit et al
             self.dead = True
             self.death_reason = repr(e)
-            self.queue.put(None)
+            self._post_kill()
             return True
         return False
 
+    def _post_kill(self) -> None:
+        """Wake every serve pool (default + named groups) for exit."""
+        self.queue.put(None)
+        for q in self._group_queues.values():
+            q.put(None)
+
     def _drain(self, err: BaseException):
+        # Close the submit gate FIRST: anything enqueued before this
+        # point is swept below; anything after seals directly.
+        with self._submit_gate:
+            self._drained = True
         # In-flight async calls: seal the death error (so consumers
         # can't hang on a stopped loop) and cancel the coroutines.
         with self._cancel_lock:
@@ -680,46 +756,129 @@ class _ActorShell:
             fut.cancel()
         if self._loop is not None:
             self._loop.call_soon_threadsafe(lambda: None)  # wake the loop
-        while True:
-            try:
-                item = self.queue.get_nowait()
-            except _queue.Empty:
-                return
-            if item is None:
-                continue
-            for oid in item[3]:
-                self.runtime.store.put_error(oid, err)
-            if item[4] == "streaming" and len(item) > 5 and item[5]:
-                # Queued-but-never-started stream: index 0 is unsealed.
-                self.runtime.store.put_error(
-                    ObjectID.for_task_return(item[5], 0), err
-                )
-            if len(item) > 5 and item[5]:
-                self.runtime.events.record(item[5].hex(), _ev.FAILED,
-                                           error_message=repr(err))
+        for q in [self.queue, *self._group_queues.values()]:
+            while True:
+                try:
+                    item = q.get_nowait()
+                except _queue.Empty:
+                    break
+                if item is None:
+                    continue
+                for oid in item[3]:
+                    self.runtime.store.put_error(oid, err)
+                if item[4] == "streaming" and len(item) > 5 and item[5]:
+                    # Queued-but-never-started stream: index 0 unsealed.
+                    self.runtime.store.put_error(
+                        ObjectID.for_task_return(item[5], 0), err
+                    )
+                if len(item) > 5 and item[5]:
+                    self.runtime.events.record(item[5].hex(), _ev.FAILED,
+                                               error_message=repr(err))
+
+    def _seal_item_error(self, err: BaseException, return_ids, num_returns,
+                         task_id) -> None:
+        for oid in return_ids:
+            self.runtime.store.put_error(oid, err)
+        if num_returns == "streaming" and task_id is not None:
+            self.runtime.store.put_error(
+                ObjectID.for_task_return(task_id, 0), err
+            )
+        if task_id is not None:
+            self.runtime.events.record(task_id.hex(), _ev.FAILED,
+                                       error_message=repr(err))
+
+    def _seal_item_dead(self, return_ids, num_returns, task_id) -> None:
+        self._seal_item_error(
+            ActorDiedError(repr(self.cls), self.death_reason or "dead"),
+            return_ids, num_returns, task_id)
 
     def submit(self, method_name: str, args, kwargs, return_ids, num_returns,
-               task_id: Optional[TaskID] = None, trace_ctx=None):
+               task_id: Optional[TaskID] = None, trace_ctx=None,
+               concurrency_group: Optional[str] = None):
         if self.dead:
-            err = ActorDiedError(repr(self.cls), self.death_reason or "dead")
-            for oid in return_ids:
-                self.runtime.store.put_error(oid, err)
-            if num_returns == "streaming" and task_id is not None:
-                self.runtime.store.put_error(
-                    ObjectID.for_task_return(task_id, 0), err
-                )
-            if task_id is not None:
-                self.runtime.events.record(task_id.hex(), _ev.FAILED,
-                                           error_message=repr(err))
+            self._seal_item_dead(return_ids, num_returns, task_id)
             return
-        self.queue.put((method_name, args, kwargs, return_ids, num_returns,
-                        task_id, trace_ctx))
+        if concurrency_group and concurrency_group not in self._group_queues:
+            self._seal_item_error(
+                TaskError(
+                    f"{self.cls.__name__}.{method_name}",
+                    ValueError(f"unknown concurrency group "
+                               f"{concurrency_group!r}; declared: "
+                               f"{sorted(self._group_queues)}")),
+                return_ids, num_returns, task_id)
+            return
+        queue = (self._group_queues[concurrency_group]
+                 if concurrency_group else self.queue)
+        item = (method_name, args, kwargs, return_ids, num_returns,
+                task_id, trace_ctx, concurrency_group)
+        if self.options.execute_out_of_order:
+            # A call whose ObjectRef args are not sealed yet must not
+            # block later calls (parity: OutOfOrderActorSubmitQueue —
+            # dependency-ready tasks dispatch immediately).
+            deps = [oid for oid in _collect_arg_oids(args, kwargs)
+                    if not self.runtime.store.contains(oid)]
+            if deps:
+                self._ooo_add(queue, item, deps)
+                return
+        with self._submit_gate:
+            if self._drained:
+                self._seal_item_dead(return_ids, num_returns, task_id)
+                return
+            queue.put(item)
+
+    def _ooo_add(self, queue: _queue.Queue, item, deps) -> None:
+        """Park a dep-blocked out-of-order call on the shell's single
+        dispatcher thread (bounded: O(1) threads regardless of how many
+        calls are blocked, unlike a thread per call)."""
+        with self._submit_gate:
+            if self.dead:
+                self._seal_item_dead(item[3], item[4], item[5])
+                return
+            self._ooo_pending.append((queue, item, deps))
+            if self._ooo_thread is None:
+                self._ooo_thread = threading.Thread(
+                    target=self._ooo_loop, daemon=True,
+                    name=f"actor-{self.actor_id.hex()[:8]}-ooo",
+                )
+                self._ooo_thread.start()
+
+    def _ooo_loop(self) -> None:
+        store = self.runtime.store
+        while True:
+            with self._submit_gate:
+                if self.dead:
+                    pending, self._ooo_pending = self._ooo_pending, []
+                    self._ooo_thread = None
+                    break
+                if not self._ooo_pending:
+                    self._ooo_thread = None
+                    return
+                snapshot = list(self._ooo_pending)
+            ready = [(q, it, deps) for q, it, deps in snapshot
+                     if all(store.contains(d) for d in deps)]
+            with self._submit_gate:
+                for entry in ready:
+                    if entry in self._ooo_pending:
+                        self._ooo_pending.remove(entry)
+                        if not self._drained:
+                            entry[0].put(entry[1])
+                        else:
+                            it = entry[1]
+                            self._seal_item_dead(it[3], it[4], it[5])
+                remaining = [d for _, _, deps in self._ooo_pending
+                             for d in deps if not store.contains(d)]
+            if remaining:
+                # Woken by ANY dep sealing; bounded timeout re-checks
+                # death so a killed actor can't strand the loop.
+                store.wait(remaining, 1, 0.5)
+        for _, it, _ in pending:
+            self._seal_item_dead(it[3], it[4], it[5])
 
     def kill(self, no_restart: bool = True):
         self.dead = True
         self.no_restart = no_restart
         self.death_reason = "killed via ray_tpu.kill"
-        self.queue.put(None)
+        self._post_kill()
 
 
 class _RemoteInstance:
@@ -762,6 +921,8 @@ class _ProcessActorShell(_ActorShell):
                 env_plugins=self.runtime._ship_env(
                     self.options.runtime_env),
                 max_concurrency=self.options.max_concurrency,
+                concurrency_groups=dict(
+                    self.options.concurrency_groups or {}),
             )
             if isinstance(rep, dict):
                 self.runtime.apply_ref_batches(
@@ -781,13 +942,14 @@ class _ProcessActorShell(_ActorShell):
             return
         self.dead = True
         self.death_reason = "worker process died"
-        self.queue.put(None)
+        self._post_kill()
 
     def _worker_label(self) -> str:
         return f"pid-{getattr(self._worker, 'pid', '?')}"
 
     def _execute_item(self, qname, method_name, args, kwargs, return_ids,
-                      num_returns, task_id, trace_ctx, task_hex):
+                      num_returns, task_id, trace_ctx, task_hex,
+                      cgroup=None):
         import cloudpickle as _cp
 
         method = getattr(self.cls, method_name, None)
@@ -800,7 +962,7 @@ class _ProcessActorShell(_ActorShell):
             # equivalent across the process boundary).
             return self._execute_async_remote(
                 qname, method_name, args, kwargs, return_ids,
-                num_returns, task_id, trace_ctx, task_hex)
+                num_returns, task_id, trace_ctx, task_hex, cgroup=cgroup)
         wire_args, wire_kwargs = self.runtime._wire_args(args, kwargs)
         if task_id is not None:
             with self._cancel_lock:
@@ -815,6 +977,7 @@ class _ProcessActorShell(_ActorShell):
                     returns=[oid.binary() for oid in return_ids],
                     task=(task_id.binary() if task_id is not None else b""),
                     trace_ctx=_tracing().capture_context(),
+                    cgroup=cgroup,
                 )
         finally:
             if task_id is not None:
@@ -830,21 +993,30 @@ class _ProcessActorShell(_ActorShell):
 
     def _execute_async_remote(self, qname, method_name, args, kwargs,
                               return_ids, num_returns, task_id, trace_ctx,
-                              task_hex):
+                              task_hex, cgroup=None):
         import cloudpickle as _cp
 
         from ray_tpu.core.exceptions import WorkerDiedError
 
-        if self._async_sem is None:
-            limit = int(self.options.max_concurrency)
-            self._async_sem = threading.Semaphore(
-                limit if limit > 1 else 1000)
+        with self._cancel_lock:
+            if self._async_sem is None:
+                limit = int(self.options.max_concurrency)
+                self._async_sem = threading.Semaphore(
+                    limit if limit > 1 else 1000)
+                self._async_group_sems = {
+                    g: threading.Semaphore(max(1, int(n)))
+                    for g, n in
+                    (self.options.concurrency_groups or {}).items()
+                }
         wire_args, wire_kwargs = self.runtime._wire_args(args, kwargs)
         spec = _cp.dumps((wire_args, wire_kwargs))
         wh = self._worker
         # At the concurrency cap the serve loop blocks here — the same
-        # bound the thread shell's asyncio.Semaphore enforces.
-        self._async_sem.acquire()
+        # bound the thread shell's asyncio.Semaphore enforces (named
+        # groups bound independently).
+        sem = (self._async_group_sems.get(cgroup, self._async_sem)
+               if cgroup else self._async_sem)
+        sem.acquire()
         if task_id is not None:
             with self._cancel_lock:
                 self._running_sync[task_id] = True
@@ -861,6 +1033,7 @@ class _ProcessActorShell(_ActorShell):
                         task=(task_id.binary() if task_id is not None
                               else b""),
                         trace_ctx=ctx,
+                        cgroup=cgroup,
                     )
                 finally:
                     if task_id is not None:
@@ -886,7 +1059,7 @@ class _ProcessActorShell(_ActorShell):
                 if task_hex:
                     ev.record(task_hex, _ev.FAILED, error_message=repr(err))
             finally:
-                self._async_sem.release()
+                sem.release()
 
         threading.Thread(target=run, daemon=True,
                          name=f"{qname}-async").start()
@@ -1257,7 +1430,7 @@ class LocalRuntime:
         for shell in doomed:
             shell.death_reason = "node died"
             shell.dead = True
-            shell.queue.put(None)
+            shell._post_kill()
         # Re-reserve PG bundles that lived on this node.
         with self._lock:
             pgs = list(self._pgs.values())
@@ -2425,7 +2598,8 @@ class LocalRuntime:
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict,
                           num_returns: Any = 1,
-                          trace_ctx: Optional[Dict[str, str]] = None):
+                          trace_ctx: Optional[Dict[str, str]] = None,
+                          concurrency_group: Optional[str] = None):
         with self._lock:
             shell = self._actors.get(actor_id)
         task_id = TaskID.of(actor_id)
@@ -2452,7 +2626,8 @@ class LocalRuntime:
             shell.submit(method_name, args, kwargs, return_ids, num_returns,
                          task_id,
                          trace_ctx if trace_ctx is not None
-                         else _tracing().capture_context())
+                         else _tracing().capture_context(),
+                         concurrency_group=concurrency_group)
         if streaming:
             from ray_tpu.core.generator import ObjectRefGenerator
 
@@ -2475,9 +2650,13 @@ class LocalRuntime:
         return actor_id
 
     def named_actor_handle(self, name: str):
-        """(actor_id, class name, @method num_returns table) for handle
-        re-hydration — the same lookup worker processes do over RPC."""
-        from ray_tpu.core.actor import collect_method_num_returns
+        """(actor_id, class name, @method num_returns table, @method
+        concurrency-group table) for handle re-hydration — the same
+        lookup worker processes do over RPC."""
+        from ray_tpu.core.actor import (
+            collect_method_cgroups,
+            collect_method_num_returns,
+        )
 
         actor_id = self.get_named_actor(name)
         with self._lock:
@@ -2486,6 +2665,7 @@ class LocalRuntime:
             actor_id,
             shell.cls.__name__ if shell else "unknown",
             collect_method_num_returns(shell.cls) if shell else {},
+            collect_method_cgroups(shell.cls) if shell else {},
         )
 
     def _on_actor_death(self, shell: _ActorShell):
@@ -2535,8 +2715,10 @@ class LocalRuntime:
                         if alloc.node is not None:
                             alloc.node.actor_ids.add(shell.actor_id)
             if restartable:
-                shell.dead = False
-                shell.death_reason = ""
+                with shell._submit_gate:
+                    shell.dead = False
+                    shell.death_reason = ""
+                    shell._drained = False
                 shell.start()
                 return
         if not node_died:
@@ -2563,8 +2745,10 @@ class LocalRuntime:
                     with self._lock:
                         if alloc.node is not None:
                             alloc.node.actor_ids.add(shell.actor_id)
-                    shell.dead = False
-                    shell.death_reason = ""
+                    with shell._submit_gate:
+                        shell.dead = False
+                        shell.death_reason = ""
+                        shell._drained = False
                     shell.start()
                     return
                 time.sleep(0.05)

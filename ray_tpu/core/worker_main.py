@@ -332,13 +332,15 @@ class WorkerRuntime:
         return shell, ObjectRef(shell._creation_oid)
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
-                          args, kwargs, num_returns: Any = 1):
+                          args, kwargs, num_returns: Any = 1,
+                          concurrency_group: Optional[str] = None):
         from ray_tpu.util import tracing
 
         rep = self._chan.call(
             "submit_actor_task", actor_id=actor_id.binary(),
             method=method_name, spec=cloudpickle.dumps((args, kwargs)),
             num_returns=num_returns, trace_ctx=tracing.capture_context(),
+            cgroup=concurrency_group,
         )
         if "stream" in rep:
             from ray_tpu.core.generator import ObjectRefGenerator
@@ -361,7 +363,8 @@ class WorkerRuntime:
 
     def named_actor_handle(self, name: str):
         rep = self._chan.call("named_actor", name=name)
-        return ActorID(rep["actor_id"]), rep["cls_name"], rep["table"]
+        return (ActorID(rep["actor_id"]), rep["cls_name"], rep["table"],
+                rep.get("cgroups") or {})
 
     # -- placement groups --------------------------------------------------
 
@@ -456,6 +459,7 @@ class _WorkerServer:
         self._actor_env = None
         self._actor_env_plugins = None
         self._actor_exec: Optional[_ActorExecutor] = None
+        self._actor_group_execs: Dict[str, _ActorExecutor] = {}
         # ALL plain tasks run on one persistent executor thread — the
         # reference's model (a worker's main loop executes tasks one at
         # a time), and load-bearing here: native extensions imported in
@@ -731,6 +735,13 @@ class _WorkerServer:
         self._actor_env = msg.get("env")
         self._actor_env_plugins = msg.get("env_plugins")
         self._actor_exec = _ActorExecutor(msg.get("max_concurrency", 1))
+        # One executor pool per named concurrency group (parity:
+        # concurrency_group_manager.cc — per-group BoundedExecutor), so
+        # a stalled group cannot serialize another group's calls.
+        self._actor_group_execs = {
+            g: _ActorExecutor(max(1, int(n)))
+            for g, n in (msg.get("concurrency_groups") or {}).items()
+        }
 
         def construct():
             with self._env_context(self._actor_env,
@@ -755,8 +766,12 @@ class _WorkerServer:
             # loop interleaves all of them (parity: fiber.h async
             # actors) — routing through the 1-thread executor would
             # serialize exactly what async actors exist to overlap.
+            # (The driver-side shell bounds per-group async concurrency.)
             return self._actor_task_body(msg)
-        return self._actor_exec.run(lambda: self._actor_task_body(msg))
+        cgroup = msg.get("cgroup")
+        exec_ = (getattr(self, "_actor_group_execs", {}).get(cgroup)
+                 if cgroup else None) or self._actor_exec
+        return exec_.run(lambda: self._actor_task_body(msg))
 
     def _actor_task_body(self, msg: Dict[str, Any]) -> Any:
         args, kwargs = cloudpickle.loads(msg["spec"])

@@ -529,6 +529,7 @@ def handle_control_op(rt, key: str, msg: Dict[str, Any],
             ActorID(msg["actor_id"]), msg["method"], args, kwargs,
             num_returns=msg["num_returns"],
             trace_ctx=msg.get("trace_ctx"),
+            concurrency_group=msg.get("cgroup"),
         )
         if msg["num_returns"] == "streaming":
             return {"stream": out.task_id.binary()}
@@ -545,9 +546,9 @@ def handle_control_op(rt, key: str, msg: Dict[str, Any],
                       msg.get("no_restart", True))
         return None
     if op == "named_actor":
-        aid, cls_name, table = rt.named_actor_handle(msg["name"])
+        aid, cls_name, table, cgroups = rt.named_actor_handle(msg["name"])
         return {"actor_id": aid.binary(), "cls_name": cls_name,
-                "table": table}
+                "table": table, "cgroups": cgroups}
     if op == "create_pg":
         pg = rt.create_placement_group(
             msg["bundles"], msg["strategy"], msg["name"],

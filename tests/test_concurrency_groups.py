@@ -1,0 +1,193 @@
+"""Concurrency groups + out-of-order actor execution.
+
+Parity targets (ray): named concurrency groups give each group its own
+bounded executor so a stalled group cannot starve another
+(src/ray/core_worker/transport/concurrency_group_manager.cc, assigned
+via @ray.method(concurrency_group=...) or per-call .options()); and
+out-of-order actors dispatch dependency-ready calls ahead of earlier
+blocked ones (out_of_order_actor_submit_queue.cc).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def thread_rt(monkeypatch):
+    monkeypatch.setenv("RAYTPU_WORKERS", "thread")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(concurrency_groups={"io": 1, "compute": 1})
+class GroupedHost:
+    """Module-level so process workers unpickle it by reference."""
+
+    @ray_tpu.method(concurrency_group="io")
+    def slow_io(self, seconds):
+        time.sleep(seconds)
+        return "io-done"
+
+    @ray_tpu.method(concurrency_group="compute")
+    def quick(self):
+        return "quick"
+
+    def default_group(self):
+        return "default"
+
+
+def test_slow_group_does_not_block_fast_group(rt):
+    h = GroupedHost.remote()
+    blocked = h.slow_io.remote(5.0)
+    t0 = time.monotonic()
+    assert ray_tpu.get(h.quick.remote(), timeout=4) == "quick"
+    assert ray_tpu.get(h.default_group.remote(), timeout=4) == "default"
+    assert time.monotonic() - t0 < 4.0  # never waited on the io group
+    assert ray_tpu.get(blocked, timeout=30) == "io-done"
+
+
+def test_slow_group_does_not_block_fast_group_thread_shell(thread_rt):
+    h = GroupedHost.remote()
+    blocked = h.slow_io.remote(5.0)
+    t0 = time.monotonic()
+    assert ray_tpu.get(h.quick.remote(), timeout=4) == "quick"
+    assert time.monotonic() - t0 < 4.0
+    assert ray_tpu.get(blocked, timeout=30) == "io-done"
+
+
+def test_per_call_options_routing(rt):
+    """.options(concurrency_group=...) reroutes a default-group method
+    (parity: per-call group override)."""
+    h = GroupedHost.remote()
+    blocked = h.slow_io.remote(5.0)
+    # default_group would normally ride the default queue; route it to
+    # the compute group explicitly.
+    out = ray_tpu.get(
+        h.default_group.options(concurrency_group="compute").remote(),
+        timeout=4)
+    assert out == "default"
+    assert ray_tpu.get(blocked, timeout=30) == "io-done"
+
+
+def test_group_limit_bounds_concurrency(rt):
+    """A group of size 1 serializes its own calls even while other
+    groups run — the bound is per group, not per actor."""
+
+    @ray_tpu.remote(concurrency_groups={"g": 1})
+    class Counter:
+        def __init__(self):
+            self.active = 0
+            self.peak = 0
+
+        @ray_tpu.method(concurrency_group="g")
+        def work(self):
+            self.active += 1
+            self.peak = max(self.peak, self.active)
+            time.sleep(0.2)
+            self.active -= 1
+            return self.peak
+
+    c = Counter.remote()
+    out = ray_tpu.get([c.work.remote() for _ in range(4)], timeout=30)
+    assert max(out) == 1  # never two concurrent calls in the group
+
+
+def test_unknown_group_errors(rt):
+    h = GroupedHost.remote()
+    ref = h.default_group.options(concurrency_group="nope").remote()
+    with pytest.raises(Exception, match="unknown concurrency group"):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_named_actor_keeps_group_routing(rt):
+    """get_actor re-hydrates the @method concurrency-group table."""
+    GroupedHost.options(name="grouped").remote()
+    h = ray_tpu.get_actor("grouped")
+    assert h.slow_io._cgroup == "io"
+    blocked = h.slow_io.remote(5.0)
+    assert ray_tpu.get(h.quick.remote(), timeout=4) == "quick"
+    assert ray_tpu.get(blocked, timeout=30) == "io-done"
+
+
+@ray_tpu.remote(concurrency_groups={"bg": 2})
+class AsyncHost:
+    @ray_tpu.method(concurrency_group="bg")
+    async def park(self, seconds):
+        import asyncio
+
+        await asyncio.sleep(seconds)
+        return "parked"
+
+    async def ping(self):
+        return "pong"
+
+
+def test_async_groups_isolate(rt):
+    h = AsyncHost.remote()
+    parked = [h.park.remote(4.0), h.park.remote(4.0)]
+    assert ray_tpu.get(h.ping.remote(), timeout=3) == "pong"
+    assert ray_tpu.get(parked, timeout=30) == ["parked", "parked"]
+
+
+# -- out-of-order execution --------------------------------------------------
+
+
+@ray_tpu.remote
+def _slow_value(seconds, value):
+    time.sleep(seconds)
+    return value
+
+
+@ray_tpu.remote(execute_out_of_order=True)
+class OutOfOrder:
+    def consume(self, v):
+        return v
+
+    def fast(self):
+        return "fast"
+
+
+@ray_tpu.remote
+class InOrder:
+    def consume(self, v):
+        return v
+
+    def fast(self):
+        return "fast"
+
+
+def test_out_of_order_skips_blocked_call(rt):
+    """A call whose dep is not ready must not block later calls."""
+    h = OutOfOrder.remote()
+    dep = _slow_value.remote(4.0, 41)
+    first = h.consume.remote(dep)
+    t0 = time.monotonic()
+    assert ray_tpu.get(h.fast.remote(), timeout=3) == "fast"
+    assert time.monotonic() - t0 < 3.0
+    assert ray_tpu.get(first, timeout=30) == 41
+
+
+def test_in_order_actor_waits_for_dep(rt):
+    """Control: the default ordered queue runs calls in submission
+    order, so the dep-blocked call delays the next one (the reference's
+    ordering guarantee)."""
+    h = InOrder.remote()
+    dep = _slow_value.remote(2.0, 7)
+    first = h.consume.remote(dep)
+    t0 = time.monotonic()
+    assert ray_tpu.get(h.fast.remote(), timeout=30) == "fast"
+    assert time.monotonic() - t0 > 1.0  # waited behind the dep
+    assert ray_tpu.get(first, timeout=30) == 7
