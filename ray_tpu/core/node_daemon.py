@@ -333,6 +333,10 @@ class NodeServer:
             self._rt.refs.drop_worker(msg["wkey"])
             agent.worker_gone(msg.get("wid", ""))
             return None
+        if op == "log_batch":
+            self._rt.ingest_logs(agent.node_hex or "?", msg["file"],
+                                 msg.get("lines") or [])
+            return None
         if op == "heartbeat":
             return time.time()
         key = msg.get("wkey") or f"{agent.node_hex[:12]}/daemon"
@@ -400,6 +404,7 @@ class _DaemonRT:
         self.store = store
         self.job_id = job_id
         self.refs = _ForwardRefs(daemon)
+        self.log_dir = daemon.log_dir
 
 
 def make_daemon_pool(daemon: "NodeDaemon", rt_shim: "_DaemonRT"):
@@ -496,9 +501,20 @@ class NodeDaemon:
         self.head = MsgChannel(sock, self._handle_head_op, name="head",
                                on_close=self._on_head_lost)
         # Local worker pool (spawns ray_tpu.core.worker_main processes
-        # that attach THIS daemon's arena).
+        # that attach THIS daemon's arena).  Worker stdout/stderr land
+        # in this node's log dir; the monitor ships complete lines to
+        # the head over the channel (parity: per-node log_monitor.py
+        # publishing to the GCS log channel).
+        from ray_tpu.util.log_monitor import LogMonitor, resolve_log_dir
+
+        self.log_dir = resolve_log_dir()
         self._rt_shim = _DaemonRT(self, self.store, self.job_id)
         self.pool = make_daemon_pool(self, self._rt_shim)
+        from ray_tpu.utils.config import get_config as _gc
+
+        self._log_monitor = LogMonitor(
+            self.log_dir, self._publish_logs,
+            _gc().log_monitor_period_s)
         self.head.start()
         self._head_ok.set()
         threading.Thread(target=self._peer_accept_loop, daemon=True,
@@ -623,10 +639,21 @@ class NodeDaemon:
     def wait(self) -> None:
         self._exit.wait()
 
+    def _publish_logs(self, file: str, lines: List[str]) -> None:
+        # Best-effort cast: log lines are droppable while the head is
+        # away (the local files keep everything).
+        self.head.cast("log_batch", file=file, lines=lines)
+
     def shutdown(self) -> None:
         self._exit.set()
         try:
             self.pool.shutdown()
+        except Exception:
+            pass
+        try:
+            # AFTER the pool: the final sweep ships what dying workers
+            # flushed (best-effort — the head may already be gone).
+            self._log_monitor.stop()
         except Exception:
             pass
         try:

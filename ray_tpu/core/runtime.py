@@ -1246,10 +1246,27 @@ class LocalRuntime:
         # raylet/worker_pool.h:156).  RAYTPU_WORKERS=process.
         self.worker_mode = cfg.workers
         self.worker_pool = None
+        # Cluster log plane (parity: per-node log files + log_monitor.py
+        # tailing them + dashboard log views): this node's workers write
+        # to log_dir; the monitor ships complete lines to the LogBuffer;
+        # remote daemons ship theirs over the head channel.
+        from ray_tpu.util.log_monitor import LogBuffer
+
+        self.logs = LogBuffer(cfg.log_buffer_lines)
+        self.log_dir = None
+        self._log_monitor = None
         if self.worker_mode == "process":
             from ray_tpu.core.worker_pool import WorkerPool
+            from ray_tpu.util.log_monitor import (
+                LogMonitor,
+                resolve_log_dir,
+            )
 
+            self.log_dir = resolve_log_dir()
             self.worker_pool = WorkerPool(self)
+            self._log_monitor = LogMonitor(
+                self.log_dir, self._publish_local_logs,
+                cfg.log_monitor_period_s)
         # Control-plane persistence (parity: Redis-backed GCS storage —
         # KV + detached-actor specs + detached PG specs survive a
         # driver restart, gcs/store_client/redis_store_client.h:33).
@@ -3136,6 +3153,25 @@ class LocalRuntime:
                 "Labels": dict(self._nodes[nid].labels),
             } for nid in self._node_order]
 
+    # -- log plane ---------------------------------------------------------
+
+    def _publish_local_logs(self, file: str, lines: List[str]) -> None:
+        self.ingest_logs("head", file, lines)
+
+    def ingest_logs(self, node: str, file: str,
+                    lines: List[str]) -> None:
+        """One batch of worker log lines into the head buffer (+ echo
+        to the driver console — parity: ray's log_to_driver prefixing
+        lines with their producing worker/node)."""
+        self.logs.ingest(node, file, lines)
+        from ray_tpu.utils.config import get_config
+
+        if get_config().log_to_driver:
+            tag = file.rsplit(".", 1)[0]
+            where = f"{tag}" if node == "head" else f"{tag}, node={node[:8]}"
+            for ln in lines:
+                print(f"({where}) {ln}", flush=True)
+
     def shutdown(self):
         from ray_tpu.core import object_ref as _object_ref
 
@@ -3161,6 +3197,10 @@ class LocalRuntime:
             agent.shutdown_daemon()
         if self.worker_pool is not None:
             self.worker_pool.shutdown()
+        if self._log_monitor is not None:
+            # AFTER the pool: stop()'s final sweep then sees everything
+            # the dying workers flushed.
+            self._log_monitor.stop()
         if self._persist is not None:
             # Final snapshot AFTER actor teardown (specs were kept —
             # _finish_actor_removal skips spec removal once _shutdown).

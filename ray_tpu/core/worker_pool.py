@@ -266,10 +266,24 @@ class WorkerPool:
                 raise RuntimeError("worker pool is shut down")
             self._spawn_waiters[token] = waiter
         registered = False
+        # Per-worker log files (parity: worker stdout/stderr redirection
+        # at spawn, services.py start_ray_process); a LogMonitor tails
+        # the directory and ships lines to the head's LogBuffer.
+        log_dir = getattr(self._rt, "log_dir", None)
+        out_f = err_f = None
+        if log_dir:
+            from ray_tpu.util.log_monitor import open_worker_logs
+
+            try:
+                out_f, err_f = open_worker_logs(log_dir, token[:8])
+            except OSError:
+                out_f = err_f = None
         try:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.core.worker_main"],
                 env=env,
+                stdout=out_f if out_f is not None else None,
+                stderr=err_f if err_f is not None else None,
             )
             timeout = get_config().worker_register_timeout_s
             if not ev.wait(timeout):
@@ -280,6 +294,12 @@ class WorkerPool:
                 )
             registered = True
         finally:
+            for f in (out_f, err_f):
+                if f is not None:
+                    try:
+                        f.close()  # the child owns its copy of the fd
+                    except OSError:
+                        pass
             with self._lock:
                 self._spawn_waiters.pop(token, None)
             if not registered and waiter[1] is not None:

@@ -52,6 +52,18 @@ INDEX_HTML = """<!doctype html>
   <section><h2>Placement groups</h2><div id="pgs"></div></section>
   <section><h2>Jobs</h2><div id="jobs"></div></section>
   <section><h2>Serve</h2><div id="serve"></div></section>
+  <section style="grid-column: 1 / -1"><h2>Logs</h2>
+    <div style="margin-bottom:6px">
+      <select id="logsel"><option value="">all streams</option></select>
+      <label class="muted"><input type="checkbox" id="logpause"> pause</label>
+    </div>
+    <pre id="logview" style="max-height:260px;overflow:auto;margin:0;
+      font-size:12px;border:1px solid color-mix(in srgb, CanvasText 14%, Canvas);
+      border-radius:4px;padding:8px"></pre>
+  </section>
+  <section style="grid-column: 1 / -1"><h2>Timeline</h2>
+    <div id="tl" style="overflow-x:auto"></div>
+  </section>
 </main>
 <footer class="muted" id="err"></footer>
 <script>
@@ -124,9 +136,61 @@ async function refresh() {
       deployments: Object.keys(a.deployments || {}).length,
     }));
     $('serve').innerHTML = table(apps, ['app', 'status', 'deployments']);
+    await refreshLogs();
+    await refreshTimeline();
     $('err').textContent = '';
     $('uptime').textContent = new Date().toLocaleTimeString();
   } catch (e) { $('err').textContent = 'refresh failed: ' + e; }
+}
+async function refreshLogs() {
+  if ($('logpause').checked) return;
+  const idx = (await j('/api/v0/logs/index')).result || [];
+  const sel = $('logsel'), cur = sel.value;
+  sel.innerHTML = '<option value="">all streams</option>' + idx.map(s =>
+    `<option value="${esc(s.node)}|${esc(s.file)}">` +
+    `${esc(s.node.slice(0,8))}/${esc(s.file)} (${s.lines})</option>`).join('');
+  sel.value = cur;
+  const [node, file] = (cur || '|').split('|');
+  const q = `/api/v0/logs?tail=200&node=${encodeURIComponent(node)}` +
+            `&file=${encodeURIComponent(file)}`;
+  const rows = (await j(q)).result || [];
+  const view = $('logview');
+  const atEnd = view.scrollTop + view.clientHeight >= view.scrollHeight - 8;
+  view.textContent = rows.map(r =>
+    `[${r.node.slice(0,8)}/${r.file}] ${r.line}`).join('\\n');
+  if (atEnd) view.scrollTop = view.scrollHeight;
+}
+async function refreshTimeline() {
+  const evs = (await j('/timeline')) || [];
+  const all = evs.filter(e => e.ph === 'X' && e.dur > 0);
+  if (!all.length) { $('tl').innerHTML = '<div class="muted">no finished task attempts yet</div>'; return; }
+  const xs = all.slice(-400);  // window over exactly what is drawn
+  const t0 = Math.min(...xs.map(e => e.ts));
+  const t1 = Math.max(...xs.map(e => e.ts + e.dur));
+  const span = Math.max(t1 - t0, 1);
+  const rows = new Map();  // "pid tid" -> events
+  for (const e of xs) {
+    const k = `${e.pid} ${e.tid}`;
+    if (!rows.has(k)) rows.set(k, []);
+    rows.get(k).push(e);
+  }
+  const color = n => `hsl(${[...n].reduce((a,c)=>(a*31+c.charCodeAt(0))>>>0,0)%360} 55% 55%)`;
+  let h = `<div class="muted">${(span/1e6).toFixed(2)}s window · ${xs.length} events</div>`;
+  for (const [k, es] of [...rows.entries()].sort()) {
+    h += `<div style="display:flex;align-items:center;gap:8px;margin:2px 0">
+      <span class="muted" style="width:160px;flex:none;overflow:hidden;
+        text-overflow:ellipsis;font-size:11px">${esc(k)}</span>
+      <div style="position:relative;height:14px;flex:1;min-width:420px;
+        background:color-mix(in srgb, CanvasText 7%, Canvas);border-radius:3px">`;
+    for (const e of es) {
+      const l = 100 * (e.ts - t0) / span, w = Math.max(100 * e.dur / span, .25);
+      h += `<i title="${esc(e.name)} ${(e.dur/1e3).toFixed(1)}ms" style="position:absolute;
+        left:${l}%;width:${w}%;top:1px;bottom:1px;border-radius:2px;
+        background:${color(e.name)}"></i>`;
+    }
+    h += '</div></div>';
+  }
+  $('tl').innerHTML = h;
 }
 refresh(); setInterval(refresh, 2000);
 </script>

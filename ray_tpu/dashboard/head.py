@@ -13,6 +13,10 @@ metrics):
   GET /api/v0/nodes
   GET /api/v0/placement_groups
   GET /api/v0/tasks/summarize
+  GET /api/v0/logs               tail of the cluster log buffer
+                                 (?node=&file=&tail=; parity:
+                                 dashboard/modules/log/ log views)
+  GET /api/v0/logs/index         available (node, file) log streams
   GET /timeline                  Chrome trace JSON
   GET /metrics                   Prometheus text exposition
 """
@@ -77,6 +81,15 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/api/v0/placement_groups":
                 self._json({"result": _state.list_placement_groups(
                     limit=limit)})
+            elif url.path == "/api/v0/logs":
+                rt = api.runtime()
+                self._json({"result": rt.logs.query(
+                    node=(qs.get("node") or [None])[0],
+                    file=(qs.get("file") or [None])[0],
+                    tail=int((qs.get("tail") or ["500"])[0]),
+                )})
+            elif url.path == "/api/v0/logs/index":
+                self._json({"result": api.runtime().logs.index()})
             elif url.path == "/timeline":
                 self._json(_state.timeline())
             elif url.path.startswith("/api/jobs"):

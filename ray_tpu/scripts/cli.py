@@ -87,6 +87,26 @@ def cmd_list(args, out) -> int:
     return 0
 
 
+def cmd_logs(args, out) -> int:
+    """Tail cluster worker logs from the head's log buffer (parity:
+    `ray logs` / the dashboard log view, dashboard/modules/log/)."""
+    if args.index:
+        rows = _get_json(_address(args), "/api/v0/logs/index")["result"]
+        _print_table(rows, ["node", "file", "lines"], out)
+        return 0
+    from urllib.parse import quote
+
+    q = f"/api/v0/logs?tail={args.tail}"
+    if args.node:
+        q += f"&node={quote(args.node)}"
+    if args.file:
+        q += f"&file={quote(args.file)}"
+    for row in _get_json(_address(args), q)["result"]:
+        print(f"[{row['node'][:8]}/{row['file']}] {row['line']}",
+              file=out)
+    return 0
+
+
 def cmd_summary(args, out) -> int:
     payload = _get_json(_address(args), "/api/v0/tasks/summarize")["result"]
     print(json.dumps(payload, indent=2), file=out)
@@ -254,6 +274,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("summary", help="task summary by function and state")
 
+    lg = sub.add_parser("logs", help="tail cluster worker logs")
+    lg.add_argument("--node", default="", help="node id prefix filter")
+    lg.add_argument("--file", default="", help="log file substring filter")
+    lg.add_argument("--tail", type=int, default=200)
+    lg.add_argument("--index", action="store_true", default=False,
+                    help="list available (node, file) log streams")
+
     tp = sub.add_parser("timeline", help="dump Chrome trace of tasks")
     tp.add_argument("--output", "-o", default="timeline.json")
 
@@ -310,6 +337,7 @@ _DISPATCH = {
     "status": cmd_status,
     "list": cmd_list,
     "summary": cmd_summary,
+    "logs": cmd_logs,
     "timeline": cmd_timeline,
     "memory": cmd_memory,
     "job": cmd_job,

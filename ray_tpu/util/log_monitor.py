@@ -1,0 +1,153 @@
+"""Worker log plane: per-worker log files, a tailing monitor, and the
+head-side in-memory buffer.
+
+Parity with the reference's log pipeline (ray:
+python/ray/_private/log_monitor.py — a per-node process tailing
+session/logs and publishing new lines; dashboard/modules/log/ serving
+them; worker stdout/stderr redirected to per-worker files at spawn):
+workers write to ``worker-<id>.out/.err`` under a session log
+directory, one LogMonitor thread per node tails the directory and
+publishes complete lines, and the head keeps a bounded LogBuffer that
+the state API / dashboard / CLI query.  Remote daemons publish over
+their existing head channel (batched casts), so logs ride the same
+wire as everything else instead of a second socket.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class LogBuffer:
+    """Bounded, append-only view of cluster worker logs at the head.
+
+    Lines are (seq, node, file, text); the deque bounds memory the way
+    the reference bounds dashboard log tails (it serves files from
+    disk; here remote files stay remote, so the head keeps a window).
+    """
+
+    def __init__(self, max_lines: int = 10000):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._lines: deque = deque(maxlen=max_lines)
+
+    def ingest(self, node: str, file: str, lines: List[str]) -> None:
+        with self._lock:
+            for ln in lines:
+                self._seq += 1
+                self._lines.append((self._seq, node, file, ln))
+
+    def query(self, node: Optional[str] = None, file: Optional[str] = None,
+              tail: int = 500,
+              since_seq: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = list(self._lines)
+        out = []
+        for seq, n, f, ln in rows:
+            if node and not n.startswith(node):
+                continue
+            if file and file not in f:
+                continue
+            if since_seq is not None and seq <= since_seq:
+                continue
+            out.append({"seq": seq, "node": n, "file": f, "line": ln})
+        return out[-max(0, int(tail)):] if tail else out
+
+    def index(self) -> List[Dict[str, Any]]:
+        """Available (node, file) streams with line counts."""
+        counts: Dict[Tuple[str, str], int] = {}
+        with self._lock:
+            rows = list(self._lines)
+        for _, n, f, _ in rows:
+            counts[(n, f)] = counts.get((n, f), 0) + 1
+        return [{"node": n, "file": f, "lines": c}
+                for (n, f), c in sorted(counts.items())]
+
+
+class LogMonitor:
+    """Tails every ``*.out``/``*.err`` file in one directory and
+    publishes complete new lines (parity: LogMonitor's open-file loop,
+    log_monitor.py:40 — offsets per file, partial lines held back)."""
+
+    def __init__(self, directory: str,
+                 publish: Callable[[str, List[str]], None],
+                 period_s: float = 0.3):
+        self._dir = directory
+        self._publish = publish
+        self._period = period_s
+        self._offsets: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="log-monitor")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._period):
+            self.scan_once()
+        self.scan_once()  # final sweep so stop() doesn't drop lines
+
+    def scan_once(self) -> None:
+        try:
+            names = sorted(os.listdir(self._dir))
+        except OSError:
+            return
+        for name in names:
+            if not (name.endswith(".out") or name.endswith(".err")):
+                continue
+            path = os.path.join(self._dir, name)
+            off = self._offsets.get(name, 0)
+            try:
+                size = os.path.getsize(path)
+                if size <= off:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read(size - off)
+            except OSError:
+                continue
+            # Only complete lines move the offset — a partially written
+            # line is re-read whole on the next pass.
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                continue
+            self._offsets[name] = off + last_nl + 1
+            lines = chunk[:last_nl].decode("utf-8", "replace").split("\n")
+            try:
+                self._publish(name, lines)
+            except Exception:
+                pass  # publishing must never kill the tail loop
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+def resolve_log_dir() -> str:
+    """This node's worker-log directory: a node-unique subdir of the
+    configured ``log_dir``, or a fresh temp dir.  Log files are
+    retained after shutdown (they are the on-disk record the in-memory
+    LogBuffer windows over, like the reference's session_latest/logs)."""
+    import tempfile
+
+    from ray_tpu.utils.config import get_config
+
+    base = get_config().log_dir
+    if base:
+        d = os.path.join(base, f"node-{os.getpid()}")
+        os.makedirs(d, exist_ok=True)
+        return d
+    return tempfile.mkdtemp(prefix="raytpu-logs-")
+
+
+def open_worker_logs(log_dir: str, tag: str):
+    """(stdout_file, stderr_file) for one spawning worker — the spawn
+    redirection the reference does in services.py start_ray_process."""
+    os.makedirs(log_dir, exist_ok=True)
+    out = open(os.path.join(log_dir, f"worker-{tag}.out"), "ab",
+               buffering=0)
+    err = open(os.path.join(log_dir, f"worker-{tag}.err"), "ab",
+               buffering=0)
+    return out, err

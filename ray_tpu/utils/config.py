@@ -171,6 +171,16 @@ D("mesh_allow_cpu_fallback", bool, True,
 D("ici_contiguous_placement", bool, True,
   "Placement groups prefer ICI-contiguous chips within a slice.")
 
+# --- Logging --------------------------------------------------------------
+D("log_dir", str, "",
+  "Worker stdout/stderr log directory ('' = fresh temp dir per node).")
+D("log_to_driver", bool, True,
+  "Echo worker log lines at the head console, prefixed with their "
+  "worker/node (parity: ray's log_to_driver).")
+D("log_monitor_period_s", float, 0.3, "Log tail/publish period.")
+D("log_buffer_lines", int, 10000,
+  "Head-side bounded window of cluster worker log lines.")
+
 # --- Metrics / events -----------------------------------------------------
 D("metrics_export_interval_s", float, 10.0, "Metrics flush period.")
 D("event_log_dir", str, "", "Structured event log dir ('' = <session>/events).")

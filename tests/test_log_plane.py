@@ -1,0 +1,153 @@
+"""Cluster log plane: worker stdout/stderr → per-node files → head.
+
+Parity targets (ray): worker log redirection at spawn
+(python/ray/_private/services.py start_ray_process), the per-node log
+monitor tailing session logs and publishing new lines
+(python/ray/_private/log_monitor.py), log_to_driver echo, and the
+dashboard/CLI log views (dashboard/modules/log/).
+"""
+
+import io
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api as _api
+from ray_tpu.core.node_daemon import NodeServer
+from ray_tpu.core.placement_group import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield _api.runtime()
+    ray_tpu.shutdown()
+
+
+def _wait_for_line(rt, needle, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rows = rt.logs.query(tail=0)
+        hit = [r for r in rows if needle in r["line"]]
+        if hit:
+            return hit
+        time.sleep(0.2)
+    raise TimeoutError(
+        f"{needle!r} never reached the log buffer: {rt.logs.query(tail=0)}")
+
+
+def test_local_worker_print_captured(rt):
+    @ray_tpu.remote
+    def speak():
+        print("log-plane-local-marker")
+        return os.getpid()
+
+    pid = ray_tpu.get(speak.remote())
+    assert pid != os.getpid()  # really ran in a worker process
+    row = _wait_for_line(rt, "log-plane-local-marker")[0]
+    assert row["node"] == "head"
+    assert row["file"].startswith("worker-") and row["file"].endswith(".out")
+    # The backing file exists under the session log dir.
+    assert os.path.exists(os.path.join(rt.log_dir, row["file"]))
+
+
+def test_worker_stderr_captured(rt):
+    import sys
+
+    @ray_tpu.remote
+    def complain():
+        print("stderr-marker-xyz", file=sys.stderr)
+        return True
+
+    assert ray_tpu.get(complain.remote())
+    (row,) = _wait_for_line(rt, "stderr-marker-xyz")[-1:]
+    assert row["file"].endswith(".err")
+
+
+def test_remote_daemon_print_reaches_head():
+    """The VERDICT contract: a print inside a remote-daemon task is
+    retrievable at the head."""
+    import subprocess
+    import sys
+
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=2)
+    server = NodeServer(rt, host="127.0.0.1", port=0)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("RAYTPU_WORKERS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_daemon",
+         "--address", f"127.0.0.1:{server.port}", "--num-cpus", "2",
+         "--resources", '{"slot": 1}'],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if sum(1 for n in rt.nodes() if n["Alive"]) >= 2:
+                break
+            time.sleep(0.1)
+        nid = next(n["NodeID"] for n in rt.nodes()
+                   if n["Resources"].get("slot"))
+
+        @ray_tpu.remote
+        def speak():
+            print("log-plane-daemon-marker")
+            return os.getpid()
+
+        aff = NodeAffinitySchedulingStrategy(nid, soft=False)
+        ray_tpu.get(speak.options(scheduling_strategy=aff).remote())
+        (row,) = _wait_for_line(rt, "log-plane-daemon-marker")[-1:]
+        assert row["node"] not in ("head", "?")  # attributed to the node
+        assert row["node"] == nid
+    finally:
+        proc.kill()
+        server.close()
+        ray_tpu.shutdown()
+        try:
+            proc.wait(timeout=5)
+        except Exception:
+            pass
+
+
+def test_logs_rest_and_cli(rt):
+    from ray_tpu.dashboard import DashboardHead
+    from ray_tpu.scripts.cli import main as cli_main
+
+    @ray_tpu.remote
+    def speak(i):
+        print(f"rest-marker-{i}")
+        return i
+
+    ray_tpu.get([speak.remote(i) for i in range(3)])
+    _wait_for_line(rt, "rest-marker-2")
+    dash = DashboardHead(port=0).start()
+    try:
+        import json
+
+        base = dash.address
+        with urllib.request.urlopen(f"{base}/api/v0/logs?tail=50") as r:
+            rows = json.load(r)["result"]
+        assert any("rest-marker-" in row["line"] for row in rows)
+        with urllib.request.urlopen(f"{base}/api/v0/logs/index") as r:
+            idx = json.load(r)["result"]
+        assert idx and all({"node", "file", "lines"} <= set(i) for i in idx)
+
+        out = io.StringIO()
+        rc = cli_main(["--address", dash.address, "logs",
+                       "--tail", "50"], out=out)
+        assert rc == 0
+        assert "rest-marker-" in out.getvalue()
+        out = io.StringIO()
+        rc = cli_main(["--address", dash.address, "logs",
+                       "--index"], out=out)
+        assert rc == 0 and "worker-" in out.getvalue()
+    finally:
+        dash.stop()
