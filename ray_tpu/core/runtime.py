@@ -2349,15 +2349,26 @@ class LocalRuntime:
         if pool is None:
             pool = self.worker_pool
         wire_args, wire_kwargs = self._wire_args(pt.args, pt.kwargs)
-        spec = cloudpickle.dumps((pt.fn, wire_args, wire_kwargs))
+        spec = cloudpickle.dumps((wire_args, wire_kwargs))
+        fhash, fblob = self._export_fn(pt.fn)
         wh = pool.lease()
         with self._lock:
             entry = self._running_tasks.get(pt.task_id)
             if entry is not None:
                 entry["worker"] = wh  # cancellation targets the process
         try:
+            # Function ship-once (parity: the function manager exporting
+            # a remote function to each worker once, keyed by hash —
+            # python/ray/_private/function_manager.py): the pickled fn
+            # rides only the worker's FIRST call; later calls send the
+            # hash + args, which is most of the per-task pickle cost.
+            shipped = getattr(wh, "shipped_fns", None)
+            if shipped is None:
+                shipped = wh.shipped_fns = set()
             rep = wh.call(
                 "task", spec=spec, name=pt.function_name,
+                fn_hash=fhash,
+                fn_blob=(None if fhash in shipped else fblob),
                 streaming=pt.streaming, task=pt.task_id.binary(),
                 num_returns=pt.options.num_returns,
                 returns=[oid.binary() for oid in pt.return_ids],
@@ -2367,6 +2378,7 @@ class LocalRuntime:
                 # submissions from the worker parent to this task.
                 trace_ctx=_tracing().capture_context(),
             )
+            shipped.add(fhash)
         finally:
             pool.release(wh)
         wkey = self._worker_ref_key(wh)
@@ -2376,6 +2388,36 @@ class LocalRuntime:
             return
         self.seal_remote_results(pt.return_ids, rep, wkey,
                                  node_hex=getattr(wh, "node_hex", None))
+
+    def _export_fn(self, fn) -> Tuple[str, bytes]:
+        """(hash, pickled blob) of a task function, pickled once per fn
+        object (parity: function-manager export; closure mutations
+        after decoration do not re-export, as in the reference)."""
+        cache = getattr(self, "_fn_blob_cache", None)
+        if cache is None:
+            import weakref
+
+            cache = self._fn_blob_cache = weakref.WeakKeyDictionary()
+            self._fn_blob_lock = threading.Lock()
+        try:
+            with self._fn_blob_lock:
+                hit = cache.get(fn)
+            if hit is not None:
+                return hit
+        except TypeError:
+            hit = None  # unhashable/unweakrefable callable
+        import hashlib
+
+        import cloudpickle
+
+        blob = cloudpickle.dumps(fn)
+        fhash = hashlib.sha1(blob).hexdigest()[:16]
+        try:
+            with self._fn_blob_lock:
+                cache[fn] = (fhash, blob)
+        except TypeError:
+            pass
+        return fhash, blob
 
     @staticmethod
     def _worker_ref_key(wh) -> str:

@@ -16,11 +16,63 @@ waiting on an unsealed object) never stalls the reader loop.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import threading
 from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.util.client.common import recv_msg, send_msg
+
+
+class _HandlerPool:
+    """Cached threads for incoming-request handlers (thread-per-request
+    costs ~0.1 ms per spawn — at thousands of RPCs/s that alone caps
+    throughput).  Unbounded like the task-exec pool: handlers may block
+    arbitrarily long (nested gets), so a fixed pool would deadlock;
+    idle threads expire instead."""
+
+    def __init__(self, idle_timeout: float = 2.0):
+        self._cv = threading.Condition()
+        self._work: "collections.deque" = collections.deque()
+        self._idle = 0
+        self._timeout = idle_timeout
+        self._seq = itertools.count()
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        spawn = False
+        with self._cv:
+            self._work.append(fn)
+            if self._idle > 0:
+                self._cv.notify()
+            if len(self._work) > self._idle:
+                spawn = True
+        if spawn:
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"chan-h{next(self._seq)}").start()
+
+    def _worker(self) -> None:
+        import time as _time
+
+        while True:
+            with self._cv:
+                deadline = _time.monotonic() + self._timeout
+                self._idle += 1
+                try:
+                    while not self._work:
+                        left = deadline - _time.monotonic()
+                        if left <= 0 or not self._cv.wait(left):
+                            if not self._work:
+                                return
+                    fn = self._work.popleft()
+                finally:
+                    self._idle -= 1
+            try:
+                fn()
+            except BaseException:
+                pass
+
+
+_handler_pool = _HandlerPool()
 
 
 class ChannelClosedError(ConnectionError):
@@ -145,10 +197,7 @@ class MsgChannel:
                         else msg.get("error")
                     rep.event.set()
             elif kind == "req":
-                threading.Thread(
-                    target=self._run_handler, args=(msg,),
-                    name=f"{self._name}-{msg.get('op', '?')}", daemon=True,
-                ).start()
+                _handler_pool.submit(lambda m=msg: self._run_handler(m))
 
     def _run_handler(self, msg: Dict) -> None:
         mid = msg.get("mid")

@@ -460,6 +460,7 @@ class _WorkerServer:
         self._actor_env_plugins = None
         self._actor_exec: Optional[_ActorExecutor] = None
         self._actor_group_execs: Dict[str, _ActorExecutor] = {}
+        self._fn_cache: Dict[str, Any] = {}  # ship-once task functions
         # ALL plain tasks run on one persistent executor thread — the
         # reference's model (a worker's main loop executes tasks one at
         # a time), and load-bearing here: native extensions imported in
@@ -630,7 +631,21 @@ class _WorkerServer:
             self._wr.refs.flush()
 
     def _run_task(self, msg: Dict[str, Any]) -> Any:
-        fn, args, kwargs = cloudpickle.loads(msg["spec"])
+        fhash = msg.get("fn_hash")
+        if fhash is not None:
+            # Ship-once function protocol: the blob rides the first
+            # call only (parity: function-manager export by hash).
+            fn = self._fn_cache.get(fhash)
+            if fn is None:
+                blob = msg.get("fn_blob")
+                if blob is None:
+                    raise RuntimeError(
+                        f"unknown function hash {fhash} (no blob shipped)")
+                fn = cloudpickle.loads(blob)
+                self._fn_cache[fhash] = fn
+            args, kwargs = cloudpickle.loads(msg["spec"])
+        else:
+            fn, args, kwargs = cloudpickle.loads(msg["spec"])
         args, kwargs = self._decode_args(args, kwargs)
         with self._env_context(msg.get("env"), msg.get("env_plugins")), \
                 self._trace(msg.get("trace_ctx")), \
@@ -797,6 +812,49 @@ class _WorkerServer:
                 return {"streamed": True}
         return self._encode_reply(result, msg)
 
+    # -- direct transport --------------------------------------------------
+
+    def _direct_accept_loop(self, cluster_token: str) -> None:
+        from ray_tpu.util.client.common import server_handshake
+
+        while not self._exit.is_set():
+            try:
+                conn, peer = self._direct_listener.accept()
+            except OSError:
+                return
+
+            def serve(conn=conn, peer=peer):
+                conn.settimeout(10.0)
+                if not server_handshake(conn, cluster_token or None):
+                    conn.close()
+                    return
+                conn.settimeout(None)
+                MsgChannel(conn, self._handle_direct,
+                           name=f"direct-{peer[0]}").start()
+
+            threading.Thread(target=serve, daemon=True,
+                             name="direct-serve").start()
+
+    def _handle_direct(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:
+        """Ops pushed over a direct owner channel.  Results sealed into
+        the local arena must ALSO be indexed at this node's daemon (the
+        proxy path did that from the reply; direct replies bypass it).
+        The index update is SYNCHRONOUS, before the owner sees the
+        reply: the owner may immediately direct another node to pull
+        from this daemon, and the daemon's spill-ahead-of-eviction
+        policy needs to see arena pressure as it builds, not after."""
+        rep = self.handle(chan, msg)
+        if isinstance(rep, dict) and rep.get("results"):
+            for oid_bin, (kind, payload) in zip(msg.get("returns") or (),
+                                                rep["results"]):
+                if kind == "shm":
+                    try:
+                        self._chan.call("mark_shm_local", oid=oid_bin,
+                                        size=payload)
+                    except Exception:
+                        pass  # daemon gone: node death owns cleanup
+        return rep
+
     # -- bootstrap ---------------------------------------------------------
 
     def main(self) -> int:
@@ -812,8 +870,27 @@ class _WorkerServer:
         sock.connect(sock_path)
         from ray_tpu.util.client.common import recv_msg, send_msg
 
+        # Direct task transport (parity: the owner pushing tasks to a
+        # leased worker over its own gRPC channel rather than through
+        # the raylet, direct_task_transport.cc → PushTask): a TCP
+        # listener remote owners dial directly, skipping the daemon's
+        # per-task forwarding.  Token-gated beyond loopback (same trust
+        # rule as the peer/object plane).
+        cluster_token = os.environ.get("RAYTPU_CLUSTER_TOKEN", "")
+        self._direct_listener = socket.socket(socket.AF_INET,
+                                              socket.SOCK_STREAM)
+        self._direct_listener.setsockopt(socket.SOL_SOCKET,
+                                         socket.SO_REUSEADDR, 1)
+        self._direct_listener.bind(
+            ("0.0.0.0" if cluster_token else "127.0.0.1", 0))
+        self._direct_listener.listen(16)
+        wport = self._direct_listener.getsockname()[1]
+        # NOTE: the accept loop starts only after _wr exists — a direct
+        # push must never race runtime construction.
+
         send_msg(sock, {"kind": "req", "mid": 0, "op": "hello",
-                        "token": token, "pid": os.getpid()})
+                        "token": token, "pid": os.getpid(),
+                        "wport": wport})
         welcome = recv_msg(sock)
         if not welcome.get("ok"):
             return 3
@@ -854,6 +931,9 @@ class _WorkerServer:
         from ray_tpu.core import api
 
         api._runtime = self._wr
+        threading.Thread(target=self._direct_accept_loop,
+                         args=(cluster_token,), daemon=True,
+                         name="direct-accept").start()
 
         def ref_sweep():
             # Handles dropped by long-lived actor state between tasks

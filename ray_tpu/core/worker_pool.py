@@ -54,6 +54,7 @@ class WorkerHandle:
         self.chan = chan
         self.wid = wid
         self.pid = proc.pid
+        self.wport = getattr(chan, "wport", None)  # direct listener port
         self.dead = False
         self.dedicated = False  # actor hosts never return to the idle set
         # Actor shells hook this to learn about crashes while idle.
@@ -115,6 +116,21 @@ class WorkerPool:
         self._all: Dict[str, WorkerHandle] = {}
         self._spawn_waiters: Dict[str, Any] = {}  # token → [Event, handle]
         self._closed = False
+        # Soft worker-count cap (parity: the raylet bounding worker
+        # processes — num_workers_soft_limit / maximum_startup_
+        # concurrency).  Without it, a burst of tiny-resource tasks
+        # turns into one OS process per in-flight lease and the node
+        # dies in a fork/OOM storm (observed: a 500-noop burst at
+        # num_cpus=0.001 silently killing a node daemon).  Non-dedicated
+        # leases wait for a release instead of spawning past the cap;
+        # dedicated (actor) leases may exceed it — they are long-lived
+        # allocations already admitted by the resource ledger.
+        self._capacity = threading.Condition(self._lock)
+        self._spawning = 0
+        from ray_tpu.utils.config import get_config as _gc
+
+        self._max_workers = (_gc().num_workers_soft_limit
+                             or max(os.cpu_count() or 8, 8))
         self._sock_dir = tempfile.mkdtemp(prefix="raytpu-ipc-")
         self._sock_path = os.path.join(self._sock_dir, "driver.sock")
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -230,6 +246,7 @@ class WorkerPool:
             conn.close()
             return
         chan = MsgChannel(conn, self._handle, name=f"worker-{token[:8]}")
+        chan.wport = hello.get("wport")  # direct-transport listener
         with self._lock:
             if self._spawn_waiters.get(token) is not waiter:
                 # spawn() already timed out and withdrew the token.
@@ -315,16 +332,41 @@ class WorkerPool:
 
     # -- leasing -----------------------------------------------------------
 
-    def lease(self, dedicated: bool = False) -> WorkerHandle:
+    def lease(self, dedicated: bool = False,
+              block: bool = True) -> Optional[WorkerHandle]:
         """Pop an idle worker or spawn one (parity: PopWorker with
-        on-demand StartWorkerProcess)."""
+        on-demand StartWorkerProcess).  At the soft cap, non-dedicated
+        leases wait for a released worker; the wait is bounded by
+        worker_lease_timeout_s, after which the cap yields (it is a
+        soft limit, matching the reference's).  ``block=False`` returns
+        None at the cap instead (lease rejection — a remote head parks
+        the task for worker handoff rather than pinning a daemon
+        handler thread; parity: PopWorker's no-worker reply)."""
+        from ray_tpu.utils.config import get_config
+
+        deadline = (time.monotonic()
+                    + get_config().worker_lease_timeout_s)
         with self._lock:
-            while self._idle:
-                wh = self._idle.pop()
-                if not wh.dead:
-                    wh.dedicated = dedicated
-                    return wh
-        wh = self.spawn()
+            while True:
+                while self._idle:
+                    wh = self._idle.pop()
+                    if not wh.dead:
+                        wh.dedicated = dedicated
+                        return wh
+                live = len(self._all) + self._spawning
+                if (dedicated or live < self._max_workers
+                        or (block and time.monotonic() >= deadline)):
+                    self._spawning += 1
+                    break
+                if not block:
+                    return None
+                self._capacity.wait(2.0)
+        try:
+            wh = self.spawn()
+        finally:
+            with self._lock:
+                self._spawning -= 1
+                self._capacity.notify_all()
         wh.dedicated = dedicated
         return wh
 
@@ -334,12 +376,16 @@ class WorkerPool:
         with self._lock:
             if not self._closed:
                 self._idle.append(wh)
+                # ONE released worker serves ONE waiter — notify_all
+                # here is a thundering herd at burst queue depths.
+                self._capacity.notify(1)
 
     def _discard(self, wh: WorkerHandle) -> None:
         with self._lock:
             self._all.pop(wh.wid, None)
             if wh in self._idle:
                 self._idle.remove(wh)
+            self._capacity.notify(1)
 
     def kill_all(self, graceful: bool = True) -> List[WorkerHandle]:
         """Terminate every worker without closing the pool — the pool

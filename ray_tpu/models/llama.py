@@ -58,6 +58,11 @@ class LlamaConfig:
     # sp_backend == "ulysses".
     sequence_parallel: bool = False
     sp_backend: str = "ring"
+    # Serving-side tensor parallelism: decode's paged attention runs
+    # per-shard inside shard_map over the ambient mesh's "tp" axis
+    # (heads are embarrassingly parallel), and the engine shards
+    # params/KV over the same axis — see serve/llm_engine.py mesh=.
+    tensor_parallel: bool = False
     # Llama-3.1-style RoPE frequency scaling, as a hashable tuple
     # (factor, low_freq_factor, high_freq_factor, original_max_pos) —
     # None for unscaled RoPE (Llama-3.0 and earlier).
@@ -684,6 +689,67 @@ def _deq_head(node, dtype):
     return node.astype(dtype)
 
 
+# --- serving tensor parallelism --------------------------------------------
+
+_SERVING_RULES = {
+    # Serving meshes have only a "tp" axis: heads/kv-heads/mlp/vocab
+    # shard over it; everything else replicates (no fsdp/dp in the
+    # decode program — batch is the slot dimension, tiny).
+    "batch": None, "seq": None, "embed": None, "vocab": "tp",
+    "heads": "tp", "kv_heads": "tp", "mlp": "tp", "layers": None,
+    "head_dim": None,
+}
+
+
+def shard_params_for_serving(params: Params, cfg: LlamaConfig, mesh,
+                             axis: str = "tp") -> Params:
+    """Place a (possibly int8-quantized) serving param tree on a tp
+    mesh: heads/kv-heads/mlp/vocab dims shard over ``axis``; for
+    quantized leaves the scale tensor inherits the weight's spec on
+    its non-reduced dims (size-1 dims stay replicated).  Parity target:
+    SURVEY §7 phase 7 — serving a model too big for one chip."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.sharding import spec_for
+
+    rules = dict(_SERVING_RULES)
+    if axis != "tp":
+        rules = {k: (axis if v == "tp" else v) for k, v in rules.items()}
+    logical = logical_axes(cfg)
+
+    def place(axes, leaf):
+        spec = spec_for(axes, rules)
+        entries = list(spec) + [None] * (len(axes) - len(spec))
+        if _is_qdict(leaf):
+            q = jax.device_put(leaf["q"], NamedSharding(mesh, P(*entries)))
+            s_entries = [
+                e if leaf["scale"].shape[i] != 1 else None
+                for i, e in enumerate(entries[:leaf["scale"].ndim])
+            ]
+            scale = jax.device_put(
+                leaf["scale"], NamedSharding(mesh, P(*s_entries)))
+            return {"q": q, "scale": scale}
+        return jax.device_put(leaf, NamedSharding(mesh, P(*entries)))
+
+    return jax.tree.map(
+        place, logical, params,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(e, (str, type(None))) for e in x),
+    )
+
+
+def paged_cache_shardings(mesh, axis: str = "tp"):
+    """Shardings for the paged cache: k/v page pools
+    [L, KVH, P, page, D] shard on KVH over ``axis``.  The engine
+    allocates the pool UNDER these (jit out_shardings) — a
+    materialize-then-reshard would put the whole unsharded pool on one
+    chip first, which is exactly what tp serving exists to avoid."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P(None, axis, None, None, None))
+    return {"k": sh, "v": sh}
+
+
 # --- paged inference (block-table KV cache) --------------------------------
 
 def init_paged_cache(cfg: LlamaConfig, num_pages: int,
@@ -848,7 +914,13 @@ def decode_slots_paged(
     lengths [slots] → (logits [slots, V], cache, new_lengths).
     The new token's k/v is scattered into page
     block_tables[b, lengths[b] // page] at offset lengths[b] % page."""
-    from ray_tpu.ops.paged_attention import paged_decode_attention
+    from ray_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        paged_decode_attention_tp,
+    )
+
+    attn_fn = (paged_decode_attention_tp if cfg.tensor_parallel
+               else paged_decode_attention)
 
     page = cache["k"].shape[3]
     new_len = jnp.where(active, lengths + 1, lengths)
@@ -876,7 +948,7 @@ def decode_slots_paged(
             k[:, 0].swapaxes(0, 1), mode="drop")
         v_pages = v_pages.at[:, pids, offs].set(
             v[:, 0].swapaxes(0, 1), mode="drop")
-        out = paged_decode_attention(
+        out = attn_fn(
             q[:, 0], k_pages, v_pages, block_tables, new_len,
             soft_cap=cfg.logits_soft_cap,
         )  # [B, H*D grouped] → [B, H, D]

@@ -188,3 +188,43 @@ def paged_decode_attention_reference(
 
 def _interpret_mode() -> bool:
     return jax.devices()[0].platform == "cpu"
+
+
+def paged_decode_attention_tp(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    soft_cap: Optional[float] = None,
+    axis: str = "tp",
+) -> jax.Array:
+    """Tensor-parallel paged attention: heads are embarrassingly
+    parallel, so the pallas kernel runs per shard inside shard_map over
+    the ambient mesh's ``axis`` — q sharded on H, pages on KVH, block
+    tables/lengths replicated, NO collectives (the surrounding
+    projections carry the psum under GSPMD).  Falls back to the plain
+    kernel when no mesh (or a size-1 axis) is ambient, so model code
+    can call this unconditionally under cfg.tensor_parallel."""
+    from ray_tpu.ops.ring_attention import _ambient_mesh
+
+    try:
+        mesh = _ambient_mesh()
+    except Exception:
+        mesh = None
+    if mesh is None or mesh.shape.get(axis, 1) == 1:
+        return paged_decode_attention(q, k_pages, v_pages, block_table,
+                                      lengths, soft_cap=soft_cap)
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import shard_map_unchecked
+
+    mapped = shard_map_unchecked(
+        lambda qq, kk, vv, bt, ln: paged_decode_attention(
+            qq, kk, vv, bt, ln, soft_cap=soft_cap),
+        mesh=mesh,
+        in_specs=(P(None, axis, None), P(axis), P(axis), P(), P()),
+        out_specs=P(None, axis, None),
+    )
+    return mapped(q, k_pages, v_pages, block_table, lengths)

@@ -123,6 +123,16 @@ class PagedEngineAdapter:
     # chunk_lens[K], pages_rows[K,maxp], cache) -> (logits[K,V], cache)
     # — enables EngineConfig.prefill_chunk.
     prefill_chunk: Optional[Callable[..., Tuple[jax.Array, Any]]] = None
+    # Tensor-parallel serving (LLMEngine(mesh=...)): shard_params
+    # places params on the mesh (pass HOST arrays for big models — the
+    # transfer shards directly, never materializing an unsharded copy
+    # on one device); cache_shardings(mesh) returns the sharding tree
+    # matching init_cache's output so the engine can ALLOCATE the page
+    # pool under it.  GSPMD partitions the jitted programs from these
+    # placements; the model's decode attention runs per shard (llama:
+    # cfg.tensor_parallel + paged_decode_attention_tp).
+    shard_params: Optional[Callable[[Any, Any], Any]] = None
+    cache_shardings: Optional[Callable[[Any], Any]] = None
 
 
 def llama_paged_adapter(cfg) -> PagedEngineAdapter:
@@ -145,6 +155,9 @@ def llama_paged_adapter(cfg) -> PagedEngineAdapter:
         cache:
             llama.prefill_chunk_paged(params, tokens, start, chunk_lens,
                                       pages_rows, cfg, cache),
+        shard_params=lambda params, mesh:
+            llama.shard_params_for_serving(params, cfg, mesh),
+        cache_shardings=lambda mesh: llama.paged_cache_shardings(mesh),
     )
 
 
@@ -268,17 +281,37 @@ class LLMEngine:
     """Continuous-batching scheduler around jitted prefill/decode."""
 
     def __init__(self, params: Any, adapter: EngineAdapter,
-                 config: EngineConfig, *, seed: int = 0):
+                 config: EngineConfig, *, seed: int = 0, mesh: Any = None):
         self.config = config
         self.adapter = adapter
         self._params = params
         self._paged = isinstance(adapter, PagedEngineAdapter)
+        # Tensor-parallel serving: engine state lives sharded over the
+        # mesh; GSPMD partitions every program from the placements and
+        # the model's decode attention runs per shard (parity: serving
+        # a model bigger than one chip — SURVEY §7 phase 7).
+        self._mesh = mesh
+        if mesh is not None and not self._paged:
+            raise ValueError("mesh-sharded serving requires the paged "
+                             "adapter (PagedEngineAdapter)")
+        if mesh is not None and adapter.shard_params is not None:
+            self._params = params = adapter.shard_params(params, mesh)
         if self._paged:
             page = config.page_size
             self._maxp = -(-config.max_seq_len // page)
             self._num_pages = (config.num_pages
                                or config.max_slots * self._maxp)
-            self._cache = adapter.init_cache(self._num_pages, page)
+            if mesh is not None and adapter.cache_shardings is not None:
+                # Allocate the pool directly under its shardings: a
+                # materialize-then-reshard would briefly hold the WHOLE
+                # unsharded pool on one device — an OOM at exactly the
+                # model sizes tp serving exists for.
+                self._cache = jax.jit(
+                    partial(adapter.init_cache, self._num_pages, page),
+                    out_shardings=adapter.cache_shardings(mesh),
+                )()
+            else:
+                self._cache = adapter.init_cache(self._num_pages, page)
             self._free_pages = list(range(self._num_pages))
             self._slot_pages: Dict[int, List[int]] = {}
             # Unallocated block-table entries hold the OOB sentinel
@@ -300,6 +333,11 @@ class LLMEngine:
         # chunk N+1 dispatch before chunk N's tokens reach the host
         # (the depth-2 pipeline that hides the dispatch RTT).
         self._cur_dev = jnp.zeros((config.max_slots,), jnp.int32)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._cur_dev = jax.device_put(
+                self._cur_dev, NamedSharding(mesh, PartitionSpec()))
         self._temps = np.zeros((config.max_slots,), np.float32)
         # In-flight entries (prefill/decode) ride a dedicated FETCH
         # thread: the engine loop dispatches device work and emits
@@ -943,6 +981,13 @@ class LLMEngine:
 
     def _loop(self):
         try:
+            if self._mesh is not None:
+                # Ambient mesh for the whole engine thread: program
+                # traces (incl. the model's shard_map'd tp attention)
+                # happen on first dispatch, in here.
+                with self._mesh:
+                    self._loop_body()
+                return
             self._loop_body()
         except BaseException as e:  # engine crash — fail every client
             self._stopped.set()

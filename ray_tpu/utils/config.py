@@ -111,6 +111,10 @@ D("scheduler_top_k_fraction", float, 0.2,
 D("worker_lease_timeout_s", float, 30.0, "Worker lease request timeout.")
 D("max_pending_lease_requests_per_scheduling_class", int, 10,
   "Pipelined lease requests per distinct (fn, resources) class.")
+D("remote_lease_idle_s", float, 10.0,
+  "Head-side cached worker leases idle this long return to their node "
+  "daemon (lease pipelining parity: OnWorkerIdle keeps leased workers "
+  "hot between tasks, direct_task_transport.cc:191).")
 
 # --- Workers --------------------------------------------------------------
 D("workers", str, "process",

@@ -114,6 +114,69 @@ def bench_put_gbps(ray_tpu, mb: int) -> None:
     del out, ref
 
 
+def bench_cross_daemon(ray_tpu, n: int) -> None:
+    """Noop tasks + actor calls dispatched onto REAL node-daemon
+    subprocesses (parity: the reference's multi-node microbenchmarks;
+    exercises lease pipelining + the direct owner→worker transport)."""
+    import subprocess
+    import time as _time
+
+    from ray_tpu.core import api as _api
+    from ray_tpu.core.node_daemon import NodeServer
+
+    rt = _api.runtime()
+    server = NodeServer(rt, host="127.0.0.1", port=0)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("RAYTPU_WORKERS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_daemon",
+             "--address", f"127.0.0.1:{server.port}", "--num-cpus", "8",
+             "--resources", '{"slot": 1}'],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for _ in range(2)
+    ]
+    try:
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            if sum(1 for x in rt.nodes() if x["Alive"]) >= 3:
+                break
+            _time.sleep(0.1)
+
+        @ray_tpu.remote(num_cpus=0.001, resources={"slot": 0.0001})
+        def noop():
+            return None
+
+        ray_tpu.get([noop.remote() for _ in range(32)])  # warm pools
+        t0 = time.perf_counter()
+        ray_tpu.get([noop.remote() for _ in range(n)])
+        emit("cross_daemon_tasks_per_second",
+             _rate(n, time.perf_counter() - t0), "1/s")
+
+        @ray_tpu.remote(num_cpus=0.001, resources={"slot": 0.4},
+                        max_concurrency=4)
+        class A:
+            def noop(self):
+                return None
+
+        actors = [A.remote() for _ in range(4)]
+        ray_tpu.get([a.noop.remote() for a in actors])
+        t0 = time.perf_counter()
+        ray_tpu.get([actors[i % 4].noop.remote() for i in range(n)])
+        emit("cross_daemon_actor_calls_per_second",
+             _rate(n, time.perf_counter() - t0), "1/s")
+        for a in actors:
+            ray_tpu.kill(a)
+    finally:
+        for p in procs:
+            p.kill()
+        server.close()
+
+
 def main() -> int:
     quick = "--quick" in sys.argv
     n_tasks = 2_000 if quick else 20_000
@@ -129,6 +192,7 @@ def main() -> int:
         bench_async_actor_calls(ray_tpu, n_tasks)
         bench_put_small(ray_tpu, n_tasks)
         bench_put_gbps(ray_tpu, 64 if quick else 256)
+        bench_cross_daemon(ray_tpu, 2_000 if quick else 10_000)
     finally:
         ray_tpu.shutdown()
     return 0

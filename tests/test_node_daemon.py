@@ -213,10 +213,18 @@ def test_actor_on_daemon_and_restart_elsewhere(cluster):
     pid1 = None
     while time.time() < deadline:
         try:
-            pid1 = ray_tpu.get(h.pid.remote(), timeout=10)
-            break
+            p = ray_tpu.get(h.pid.remote(), timeout=10)
+            # A call submitted in the instant between the daemon's
+            # SIGKILL and the worker noticing its channel died can
+            # still succeed against the OLD worker over the direct
+            # transport (same window as the reference's owner→worker
+            # gRPC); keep probing until the restarted instance answers.
+            if p != pid0:
+                pid1 = p
+                break
         except Exception:
-            time.sleep(0.5)
+            pass
+        time.sleep(0.5)
     assert pid1 is not None and pid1 != pid0
 
 
@@ -329,6 +337,26 @@ def test_spilled_on_node_restores_across_wire():
             p.kill()
         server.close()
         ray_tpu.shutdown()
+
+
+def test_burst_of_tiny_tasks_does_not_kill_daemons(cluster):
+    """Root-cause regression for round 3's load-dependent flake: a
+    burst of tiny-resource tasks used to become one spawned worker
+    process per in-flight lease (no pool cap), and the daemon died in
+    the fork storm with 'peer hung up'.  With the worker cap + lease
+    pipelining the burst drains on a bounded pool and both daemons
+    survive."""
+
+    @ray_tpu.remote(num_cpus=0.001, resources={"slot": 0.0001})
+    def noop(i):
+        return i
+
+    out = ray_tpu.get([noop.remote(i) for i in range(600)], timeout=120)
+    assert out == list(range(600))
+    alive = [n for n in cluster.rt.nodes() if n["Alive"]]
+    assert len(alive) == 3, cluster.rt.nodes()
+    for p in cluster.procs:
+        assert p.poll() is None, "daemon process died during the burst"
 
 
 def test_nested_submission_from_daemon_worker(cluster):
