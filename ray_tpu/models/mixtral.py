@@ -49,6 +49,9 @@ class MixtralConfig:
     experts_per_token: int = 2
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.02
+    # "dense" (one-hot einsum dispatch) or "scatter" (ragged
+    # capacity-bounded scatter/gather — see moe_block docstring).
+    dispatch_mode: str = "dense"
     max_seq_len: int = 8192
     rope_theta: float = 1_000_000.0
     norm_eps: float = 1e-5
@@ -164,25 +167,16 @@ def capacity(cfg: MixtralConfig, num_tokens: int) -> int:
     return max(c, cfg.experts_per_token)
 
 
-def moe_block(x: jax.Array, moe: Params, cfg: MixtralConfig
-              ) -> Tuple[jax.Array, jax.Array]:
-    """x [B, S, D] → (out [B, S, D], aux_loss scalar).
-
-    Dropped tokens (over capacity) pass through with zero MoE output —
-    the residual connection carries them (standard Switch behavior).
-    """
-    B, S, D = x.shape
+def _route(xf: jax.Array, moe: Params, cfg: MixtralConfig, C: int):
+    """Shared routing math: top-k experts + capacity-bounded buffer
+    positions.  Returns (topk_idx [G,k], gate [G*k], pos [G*k] int32,
+    keep [G*k], probs [G,E], oh [G,k,E])."""
     E, k = cfg.n_experts, cfg.experts_per_token
-    G = B * S
-    C = capacity(cfg, G)
-    xf = x.reshape(G, D)
-
-    # Router in float32.
+    G = xf.shape[0]
     logits = xf.astype(jnp.float32) @ moe["w_router"].astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)                      # [G, E]
     topk_probs, topk_idx = lax.top_k(probs, k)                   # [G, k]
     topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
-
     # Position of each (token, slot) assignment in its expert's buffer:
     # flatten assignments token-major (earlier tokens win capacity).
     oh = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)          # [G, k, E]
@@ -191,27 +185,77 @@ def moe_block(x: jax.Array, moe: Params, cfg: MixtralConfig
     pos = jnp.sum(pos * flat, axis=-1)                           # [G*k]
     keep = (pos < C).astype(jnp.float32)
     gate = topk_probs.reshape(G * k) * keep
+    return topk_idx, gate, pos.astype(jnp.int32), keep, probs, oh
 
-    # Dispatch/combine tensors [G, E, C].
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C,
-                            dtype=jnp.float32)                   # [G*k, C]
-    dispatch = (flat[:, :, None] * pos_oh[:, None, :] * keep[:, None, None])
-    dispatch = dispatch.reshape(G, k, E, C).sum(axis=1)
-    combine = (flat[:, :, None] * pos_oh[:, None, :] * gate[:, None, None])
-    combine = combine.reshape(G, k, E, C).sum(axis=1)
 
-    # Gather expert inputs, run all expert FFNs as batched matmuls, and
-    # scatter back.  "expert" → ep: XLA turns the layout change into a
-    # token all-to-all over the ep axis.
-    dt = cfg.dtype
-    expert_in = jnp.einsum("gec,gd->ecd", dispatch.astype(dt), xf.astype(dt))
-    expert_in = constrain(expert_in, ("expert", None, "embed"))
+def _expert_ffn(expert_in: jax.Array, moe: Params, dt) -> jax.Array:
+    """[E, C, D] → [E, C, D] — all expert FFNs as batched matmuls."""
     g = jnp.einsum("ecd,edm->ecm", expert_in, moe["w_gate"].astype(dt))
     u = jnp.einsum("ecd,edm->ecm", expert_in, moe["w_up"].astype(dt))
     h = jax.nn.silu(g) * u
-    expert_out = jnp.einsum("ecm,emd->ecd", h, moe["w_down"].astype(dt))
-    expert_out = constrain(expert_out, ("expert", None, "embed"))
-    y = jnp.einsum("gec,ecd->gd", combine.astype(dt), expert_out)
+    return jnp.einsum("ecm,emd->ecd", h, moe["w_down"].astype(dt))
+
+
+def moe_block(x: jax.Array, moe: Params, cfg: MixtralConfig
+              ) -> Tuple[jax.Array, jax.Array]:
+    """x [B, S, D] → (out [B, S, D], aux_loss scalar).
+
+    Dropped tokens (over capacity) pass through with zero MoE output —
+    the residual connection carries them (standard Switch behavior).
+
+    Two dispatch paths (cfg.dispatch_mode):
+      "dense":   one-hot dispatch/combine [G, E, C] einsums (the
+                 original formulation — O(G·E·C) memory/flops in the
+                 layout change, friendly to GSPMD's all-to-all lowering)
+      "scatter": ragged capacity-bounded dispatch — tokens scatter-add
+                 into the [E, C, D] buffers at their (expert, position)
+                 and gather back (O(G·k·D) data movement, no one-hot
+                 tensors; the dispatch the explicit EP all-to-all op in
+                 ops/moe_a2a.py also uses).
+    """
+    B, S, D = x.shape
+    E, k = cfg.n_experts, cfg.experts_per_token
+    G = B * S
+    C = capacity(cfg, G)
+    xf = x.reshape(G, D)
+    topk_idx, gate, pos, keep, probs, oh = _route(xf, moe, cfg, C)
+    dt = cfg.dtype
+
+    if cfg.dispatch_mode == "scatter":
+        eidx = topk_idx.reshape(G * k)
+        # Dropped assignments route OOB — mode="drop" discards them
+        # (keep == 0 exactly when pos >= C, so no extra mask needed).
+        eidx = jnp.where(keep > 0, eidx, E)
+        xk = jnp.repeat(xf, k, axis=0).astype(dt)                # [G*k, D]
+        expert_in = jnp.zeros((E, C, D), dt).at[eidx, pos].add(
+            xk, mode="drop")
+        expert_in = constrain(expert_in, ("expert", None, "embed"))
+        expert_out = _expert_ffn(expert_in, moe, dt)
+        expert_out = constrain(expert_out, ("expert", None, "embed"))
+        # Gather each assignment's output and combine with its gate.
+        got = expert_out[jnp.minimum(eidx, E - 1), pos]          # [G*k, D]
+        y = jnp.sum(
+            (got * gate[:, None].astype(dt)).reshape(G, k, D), axis=1)
+    else:
+        # Dispatch/combine tensors [G, E, C].
+        flat = oh.reshape(G * k, E)
+        pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32)       # [G*k, C]
+        dispatch = (flat[:, :, None] * pos_oh[:, None, :]
+                    * keep[:, None, None])
+        dispatch = dispatch.reshape(G, k, E, C).sum(axis=1)
+        combine = (flat[:, :, None] * pos_oh[:, None, :]
+                   * gate[:, None, None])
+        combine = combine.reshape(G, k, E, C).sum(axis=1)
+
+        # Gather expert inputs, run all expert FFNs as batched matmuls,
+        # and scatter back.  "expert" → ep: XLA turns the layout change
+        # into a token all-to-all over the ep axis.
+        expert_in = jnp.einsum("gec,gd->ecd", dispatch.astype(dt),
+                               xf.astype(dt))
+        expert_in = constrain(expert_in, ("expert", None, "embed"))
+        expert_out = _expert_ffn(expert_in, moe, dt)
+        expert_out = constrain(expert_out, ("expert", None, "embed"))
+        y = jnp.einsum("gec,ecd->gd", combine.astype(dt), expert_out)
 
     # Switch load-balance loss: E * Σ_e fraction_dispatched_e · mean_prob_e.
     frac = jnp.mean(oh.sum(axis=1), axis=0)                      # [E]

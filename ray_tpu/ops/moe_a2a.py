@@ -1,0 +1,105 @@
+"""Explicit expert-parallel MoE dispatch via ``lax.all_to_all``.
+
+BASELINE.json's "ragged all-to-all" item: the GSPMD path in
+models/mixtral.moe_block lets XLA derive the token exchange from
+sharding constraints on dense [G, E, C] one-hot einsums.  This op is
+the explicit formulation — inside shard_map over the "ep" axis, each
+device scatters its LOCAL tokens into capacity-bounded per-expert
+buffers and exchanges them with one ``lax.all_to_all``, runs its local
+experts' FFNs, then reverses the exchange and combines (the
+DeepSpeed/Megatron token-dispatch pipeline, built on XLA collectives
+over ICI instead of NCCL).
+
+Capacity semantics: the buffer bound is per (device, expert), sized
+Cl = capacity_factor · G_local · k / E.  One expert can receive at
+most G_local local assignments (top-k indices are distinct per token),
+so ``capacity_factor ≥ n_experts / experts_per_token`` guarantees no
+drops and exact equality with the dense single-device block (the
+correctness test's regime); tighter factors drop per-device overflow
+like Switch does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import shard_map_unchecked
+
+
+def moe_block_ep(x: jax.Array, moe: Any, cfg, *,
+                 mesh: Optional[Mesh] = None,
+                 axis: str = "ep") -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel MoE block: x [B, S, D] sharded on batch over
+    ``axis``; each device holds E/ep experts' weights.  Returns
+    (out [B, S, D], aux) like models/mixtral.moe_block."""
+    from ray_tpu.models.mixtral import _expert_ffn, _route, capacity
+
+    if mesh is None:
+        from ray_tpu.ops.ring_attention import _ambient_mesh
+
+        mesh = _ambient_mesh()
+    ep = mesh.shape[axis]
+    E, k = cfg.n_experts, cfg.experts_per_token
+    if E % ep:
+        raise ValueError(f"n_experts {E} not divisible by ep={ep}")
+    B, S, D = x.shape
+    if B % ep:
+        raise ValueError(f"batch {B} not divisible by ep={ep}")
+    e_local = E // ep
+
+    def local_fn(xl, moe_l):
+        # xl [B/ep, S, D] — this device's tokens; moe_l holds the local
+        # expert slices [E/ep, ...] plus the replicated router.
+        bl = xl.shape[0]
+        G = bl * S
+        Cl = capacity(cfg, G)  # per-device per-expert capacity
+        xf = xl.reshape(G, D)
+        topk_idx, gate, pos, keep, probs, oh = _route(xf, moe_l, cfg, Cl)
+        dt = cfg.dtype
+        eidx = topk_idx.reshape(G * k)
+        # Dropped assignments route OOB — mode="drop" discards them.
+        eidx = jnp.where(keep > 0, eidx, E)
+        xk = jnp.repeat(xf, k, axis=0).astype(dt)
+        # Local scatter into [E, Cl, D] (every expert, local tokens).
+        send = jnp.zeros((E, Cl, D), dt).at[eidx, pos].add(
+            xk, mode="drop")
+        # Exchange: [ep, e_local, Cl, D] → every device receives its
+        # experts' rows from every peer → [ep, e_local, Cl, D] where
+        # axis 0 is now the SOURCE device.
+        send = send.reshape(ep, e_local, Cl, D)
+        recv = lax.all_to_all(send, axis, 0, 0, tiled=False)
+        # Local experts over all sources' tokens: [e_local, ep*Cl, D].
+        expert_in = recv.transpose(1, 0, 2, 3).reshape(
+            e_local, ep * Cl, D)
+        expert_out = _expert_ffn(expert_in, moe_l, dt)
+        # Reverse the exchange.
+        back = expert_out.reshape(e_local, ep, Cl, D).transpose(
+            1, 0, 2, 3)
+        got = lax.all_to_all(back, axis, 0, 0, tiled=False)
+        got = got.reshape(E, Cl, D)
+        # Combine locally.
+        rows = got[jnp.minimum(eidx, E - 1), pos]
+        y = jnp.sum(
+            (rows * gate[:, None].astype(dt)).reshape(G, k, D), axis=1)
+        frac = jnp.mean(oh.sum(axis=1), axis=0)
+        aux = E * jnp.sum(frac * jnp.mean(probs, axis=0))
+        # Mean aux across devices (each computed over its shard).
+        aux = lax.pmean(aux, axis)
+        return y.reshape(xl.shape), aux
+
+    # Router replicated; expert weights sharded on their leading E axis.
+    moe_specs = {
+        "w_router": P(),
+        "w_gate": P(axis), "w_up": P(axis), "w_down": P(axis),
+    }
+    mapped = shard_map_unchecked(
+        local_fn, mesh=mesh,
+        in_specs=(P(axis), moe_specs),
+        out_specs=(P(axis), P()),
+    )
+    return mapped(x, moe)

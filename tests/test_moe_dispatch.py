@@ -1,0 +1,95 @@
+"""MoE dispatch paths: ragged scatter vs dense one-hot vs explicit EP
+all-to-all (BASELINE.json "ragged all-to-all" item).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import mixtral
+
+CFG = mixtral.MixtralConfig(
+    vocab_size=128, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+    mlp_dim=64, n_experts=4, experts_per_token=2, max_seq_len=32,
+    dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+)
+
+
+def _moe_params(key, cfg):
+    params = mixtral.init_params(key, cfg)
+    # One layer's moe slice (drop the leading L axis).
+    return jax.tree.map(lambda t: t[0], params["layers"]["moe"])
+
+
+def _x(key, B=4, S=8):
+    return jax.random.normal(key, (B, S, CFG.dim), jnp.float32)
+
+
+def test_scatter_dispatch_matches_dense():
+    moe = _moe_params(jax.random.key(0), CFG)
+    x = _x(jax.random.key(1))
+    dense_y, dense_aux = mixtral.moe_block(x, moe, CFG)
+    scfg = dataclasses.replace(CFG, dispatch_mode="scatter")
+    scat_y, scat_aux = mixtral.moe_block(x, moe, scfg)
+    np.testing.assert_allclose(np.asarray(scat_y), np.asarray(dense_y),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(scat_aux), float(dense_aux),
+                               rtol=1e-6)
+
+
+def test_scatter_dispatch_matches_dense_with_drops():
+    """Tight capacity: both paths drop the SAME over-capacity
+    assignments (identical token-major position math)."""
+    tight = dataclasses.replace(CFG, capacity_factor=0.5)
+    moe = _moe_params(jax.random.key(2), tight)
+    x = _x(jax.random.key(3))
+    dense_y, _ = mixtral.moe_block(x, moe, tight)
+    scat_y, _ = mixtral.moe_block(
+        x, moe, dataclasses.replace(tight, dispatch_mode="scatter"))
+    np.testing.assert_allclose(np.asarray(scat_y), np.asarray(dense_y),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_scatter_dispatch_gradients():
+    scfg = dataclasses.replace(CFG, dispatch_mode="scatter")
+    moe = _moe_params(jax.random.key(4), scfg)
+    x = _x(jax.random.key(5))
+
+    def loss(moe, x):
+        y, aux = mixtral.moe_block(x, moe, scfg)
+        return jnp.sum(y ** 2) + aux
+
+    grads = jax.jit(jax.grad(loss))(moe, x)
+    assert all(np.all(np.isfinite(np.asarray(g)))
+               for g in jax.tree.leaves(grads))
+
+
+@pytest.mark.parametrize("ep", [2, 4])
+def test_ep_all_to_all_matches_dense(cpu_devices, ep):
+    """Explicit shard_map all-to-all dispatch == the dense block when
+    nothing drops (generous capacity)."""
+    from jax.sharding import Mesh
+
+    from ray_tpu.ops.moe_a2a import moe_block_ep
+
+    cfg = dataclasses.replace(CFG, capacity_factor=float(ep) * 2)
+    moe = _moe_params(jax.random.key(6), cfg)
+    x = _x(jax.random.key(7), B=4)
+    want, want_aux = mixtral.moe_block(x, moe, cfg)
+
+    mesh = Mesh(np.asarray(cpu_devices[:ep]).reshape(ep), ("ep",))
+    got, got_aux = jax.jit(
+        lambda x, moe: moe_block_ep(x, moe, cfg, mesh=mesh))(x, moe)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    # Aux is computed per shard then pmean'd (standard distributed-MoE
+    # semantics): a mean of per-shard products, not the global product
+    # of means.  Reference: dense aux per batch shard, averaged.
+    shard_aux = np.mean([
+        float(mixtral.moe_block(xs, moe, cfg)[1])
+        for xs in np.split(np.asarray(x), ep, axis=0)
+    ])
+    np.testing.assert_allclose(float(got_aux), shard_aux, rtol=1e-4)
