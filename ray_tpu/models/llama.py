@@ -287,7 +287,7 @@ def forward_hidden(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
     sin, cos = rope_table(cfg, positions)
-    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
 
     if cfg.remat_policy not in ("dots", "full"):
         raise ValueError(
@@ -450,7 +450,7 @@ def prefill(
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     sin, cos = rope_table(cfg, positions)
-    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
 
     ks, vs = [], []
 
@@ -495,7 +495,7 @@ def prefill_slot(
     S = tokens.shape[0]
     positions = jnp.arange(S)[None, :]
     sin, cos = rope_table(cfg, positions)
-    x = params["tok_embed"].astype(cfg.dtype)[tokens[None, :]]
+    x = params["tok_embed"][tokens[None, :]].astype(cfg.dtype)
 
     def body(carry, layer):
         x = carry
@@ -541,7 +541,7 @@ def prefill_batch(
     K, S = tokens.shape
     positions = jnp.arange(S)[None, :]
     sin, cos = rope_table(cfg, positions)
-    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
 
     def body(carry, layer):
         x = carry
@@ -584,7 +584,7 @@ def prefill_batch_paged(
     page = cache["k"].shape[3]
     positions = jnp.arange(S)[None, :]
     sin, cos = rope_table(cfg, positions)
-    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
 
     def body(carry, layer):
         x = carry
@@ -634,27 +634,35 @@ def decode_slots(
     new_len = jnp.where(active, cache["length"] + 1, cache["length"])
     positions = cache["length"][:, None]
     sin, cos = rope_table(cfg, positions)
-    x = params["tok_embed"].astype(cfg.dtype)[tokens[:, None]]
+    # Gather BEFORE convert (see decode_slots_paged).
+    x = params["tok_embed"][tokens[:, None]].astype(cfg.dtype)
+    B = tokens.shape[0]
 
-    def body(carry, inputs):
-        x = carry
-        layer, k_cache, v_cache = inputs
+    def body(carry, layer):
+        # Caches ride the CARRY (slice → update → write-back at the
+        # same index, XLA's in-place idiom): scanning them as xs/ys
+        # made XLA copy both full stacks every step.
+        x, k_all, v_all, li = carry
         normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
         q, k, v = _qkv(normed, layer, cfg, sin, cos)
         idx = cache["length"]
-        k_cache = jax.vmap(lambda c, kk, i: lax.dynamic_update_slice_in_dim(
-            c, kk, i, axis=0))(k_cache, k, idx)
-        v_cache = jax.vmap(lambda c, vv, i: lax.dynamic_update_slice_in_dim(
-            c, vv, i, axis=0))(v_cache, v, idx)
-        out = decode_attention(q, k_cache, v_cache, new_len,
+        rows = jnp.arange(B)
+        kc = lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
+        vc = lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+        kc = kc.at[rows, idx].set(k[:, 0])
+        vc = vc.at[rows, idx].set(v[:, 0])
+        out = decode_attention(q, kc, vc, new_len,
                                logits_soft_cap=cfg.logits_soft_cap)
+        k_all = lax.dynamic_update_index_in_dim(k_all, kc, li, 0)
+        v_all = lax.dynamic_update_index_in_dim(v_all, vc, li, 0)
         out = jnp.einsum("bshk,hkd->bsd", out,
                          layer["attn"]["wo"].astype(cfg.dtype))
         h = x + out
         h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
-        return h, (k_cache, v_cache)
+        return (h, k_all, v_all, li + 1), None
 
-    x, (k_new, v_new) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    (x, k_new, v_new, _), _ = lax.scan(
+        body, (x, cache["k"], cache["v"], jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))
@@ -754,9 +762,13 @@ def paged_cache_shardings(mesh, axis: str = "tp"):
 
 def init_paged_cache(cfg: LlamaConfig, num_pages: int,
                      page_size: int) -> Dict[str, jax.Array]:
-    """Page-pool cache: k/v [L, KVH, P, page, D] (kv-head-major per
-    layer — the paged kernel's layout, ops/paged_attention.py)."""
-    shape = (cfg.n_layers, cfg.n_kv_heads, num_pages, page_size,
+    """Page-pool cache: k/v [L, KVH, P+1, page, D] (kv-head-major per
+    layer — the paged kernel's layout, ops/paged_attention.py).  The
+    LAST physical page is a scratch page: OOB sentinel writes (inactive
+    slots, chunk-ladder overshoot — sentinel value == num_pages) land
+    there instead of clamping onto a live page, where an aliased
+    append's copy-through could race another slot's append."""
+    shape = (cfg.n_layers, cfg.n_kv_heads, num_pages + 1, page_size,
              cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype)}
@@ -778,7 +790,7 @@ def prefill_slot_paged(
     page = cache["k"].shape[3]
     positions = jnp.arange(S)[None, :]
     sin, cos = rope_table(cfg, positions)
-    x = params["tok_embed"].astype(cfg.dtype)[tokens[None, :]]
+    x = params["tok_embed"][tokens[None, :]].astype(cfg.dtype)
 
     def body(carry, layer):
         x = carry
@@ -838,7 +850,7 @@ def prefill_chunk_paged(
     KVH = cfg.n_kv_heads
     positions = start[:, None] + jnp.arange(C)[None, :]
     sin, cos = rope_table(cfg, positions)
-    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
     ctx = maxp * page
     group = cfg.n_heads // KVH
     key_idx = jnp.arange(ctx)[None, None, :]          # [1, 1, S_ctx]
@@ -913,58 +925,77 @@ def decode_slots_paged(
     tokens [slots], active [slots] bool, block_tables [slots, maxp],
     lengths [slots] → (logits [slots, V], cache, new_lengths).
     The new token's k/v is scattered into page
-    block_tables[b, lengths[b] // page] at offset lengths[b] % page."""
+    block_tables[b, lengths[b] // page] at offset lengths[b] % page.
+
+    Deferred-append design: inside the layer scan the page pools are
+    STRICTLY READ-ONLY — the layer-indexed pallas kernel returns flash
+    partials over past tokens and the current token's self-attention
+    folds in outside the kernel (combine_with_self).  Each layer's new
+    k/v rides out as tiny scan ys, and ONE scatter after the scan
+    appends all layers at once.  Any in-loop pool mutation made XLA
+    clone the multi-GB pools every layer/step (measured 10-30x off the
+    weight-bandwidth roofline); read-only loop + single post-scan
+    scatter is what lets the carried pools alias in place."""
     from ray_tpu.ops.paged_attention import (
-        paged_decode_attention,
-        paged_decode_attention_tp,
+        combine_with_self,
+        paged_append,
+        paged_append_tp,
+        paged_decode_attention_partial,
+        paged_decode_attention_partial_tp,
     )
 
-    attn_fn = (paged_decode_attention_tp if cfg.tensor_parallel
-               else paged_decode_attention)
+    attn_fn = (paged_decode_attention_partial_tp if cfg.tensor_parallel
+               else paged_decode_attention_partial)
+    append_fn = paged_append_tp if cfg.tensor_parallel else paged_append
 
     page = cache["k"].shape[3]
     new_len = jnp.where(active, lengths + 1, lengths)
     positions = lengths[:, None]
     sin, cos = rope_table(cfg, positions)
-    x = params["tok_embed"].astype(cfg.dtype)[tokens[:, None]]
+    # Gather BEFORE convert: converting the whole embedding per step is
+    # a vocab×dim materialization (1 GB at 8B) for an 8-row lookup.
+    x = params["tok_embed"][tokens[:, None]].astype(cfg.dtype)
     maxp = block_tables.shape[1]
-    num_pages = cache["k"].shape[2]
+    scratch = cache["k"].shape[2] - 1  # physical scratch page
     pids = jnp.take_along_axis(
         block_tables, jnp.minimum(lengths // page, maxp - 1)[:, None],
         axis=1)[:, 0]  # [B]
-    # Inactive slots must not write: their pages may already belong to
-    # another request — route them OOB so the scatter drops them.
-    pids = jnp.where(active, pids, jnp.int32(num_pages))
+    # Inactive slots must not write to live pages (theirs may already
+    # belong to another request) — route them to the scratch page.
+    # (Block-table OOB sentinels == logical num_pages == scratch too.)
+    pids = jnp.where(active, pids, jnp.int32(scratch))
     offs = lengths % page
 
-    def body(carry, inputs):
-        x = carry
-        layer, k_pages, v_pages = inputs
+    def body(carry, layer):
+        x, li = carry
         layer = _deq_layer(layer, cfg.dtype)
         normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
         q, k, v = _qkv(normed, layer, cfg, sin, cos)
-        # k/v [B, 1, KVH, D] → write at [kvh, pids[b], offs[b]].
-        k_pages = k_pages.at[:, pids, offs].set(
-            k[:, 0].swapaxes(0, 1), mode="drop")
-        v_pages = v_pages.at[:, pids, offs].set(
-            v[:, 0].swapaxes(0, 1), mode="drop")
-        out = attn_fn(
-            q[:, 0], k_pages, v_pages, block_tables, new_len,
+        k1, v1 = k[:, 0], v[:, 0]              # [B, KVH, D]
+        acc, m, l = attn_fn(
+            q[:, 0], cache["k"], cache["v"], li, block_tables, lengths,
             soft_cap=cfg.logits_soft_cap,
-        )  # [B, H*D grouped] → [B, H, D]
+        )
+        out = combine_with_self(q[:, 0], k1, v1, acc, m, l,
+                                soft_cap=cfg.logits_soft_cap)
         out = jnp.einsum("bhk,hkd->bd", out,
                          layer["attn"]["wo"].astype(cfg.dtype))[:, None]
         h = x + out
         h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
-        return h, (k_pages, v_pages)
+        return (h, li + 1), (k1, v1)
 
-    x, (k_new, v_new) = lax.scan(body, x, (params["layers"], cache["k"],
-                                           cache["v"]))
+    (x, _), (k_news, v_news) = lax.scan(
+        body, (x, jnp.int32(0)), params["layers"])
+    # One append for every layer, in place via the aliased pallas
+    # kernel (a jnp scatter here made XLA clone the pools per step).
+    k_pool, v_pool = append_fn(cache["k"], cache["v"], k_news, v_news,
+                               pids, offs)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["tok_embed"].T if cfg.tie_embeddings
             else params["lm_head"])
     logits = jnp.einsum("bd,dv->bv", x[:, 0], _deq_head(head, cfg.dtype))
-    return (logits.astype(jnp.float32), {"k": k_new, "v": v_new}, new_len)
+    return (logits.astype(jnp.float32), {"k": k_pool, "v": v_pool},
+            new_len)
 
 
 def decode_step(
@@ -977,29 +1008,32 @@ def decode_step(
     B = tokens.shape[0]
     positions = cache["length"][:, None]  # [B, 1]
     sin, cos = rope_table(cfg, positions)
-    x = params["tok_embed"].astype(cfg.dtype)[tokens[:, None]]
+    x = params["tok_embed"][tokens[:, None]].astype(cfg.dtype)
     new_len = cache["length"] + 1
 
-    def body(carry, inputs):
-        x = carry
-        layer, k_cache, v_cache = inputs
+    def body(carry, layer):
+        x, k_all, v_all, li = carry
         normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
         q, k, v = _qkv(normed, layer, cfg, sin, cos)
         # write new k/v at position length (per row)
         idx = cache["length"]  # [B]
-        k_cache = jax.vmap(lambda c, kk, i: lax.dynamic_update_slice_in_dim(
-            c, kk, i, axis=0))(k_cache, k, idx)
-        v_cache = jax.vmap(lambda c, vv, i: lax.dynamic_update_slice_in_dim(
-            c, vv, i, axis=0))(v_cache, v, idx)
-        out = decode_attention(q, k_cache, v_cache, new_len,
+        rows = jnp.arange(B)
+        kc = lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
+        vc = lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+        kc = kc.at[rows, idx].set(k[:, 0])
+        vc = vc.at[rows, idx].set(v[:, 0])
+        out = decode_attention(q, kc, vc, new_len,
                                logits_soft_cap=cfg.logits_soft_cap)
+        k_all = lax.dynamic_update_index_in_dim(k_all, kc, li, 0)
+        v_all = lax.dynamic_update_index_in_dim(v_all, vc, li, 0)
         out = jnp.einsum("bshk,hkd->bsd", out,
                          layer["attn"]["wo"].astype(cfg.dtype))
         h = x + out
         h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg)
-        return h, (k_cache, v_cache)
+        return (h, k_all, v_all, li + 1), None
 
-    x, (k_new, v_new) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    (x, k_new, v_new, _), _ = lax.scan(
+        body, (x, cache["k"], cache["v"], jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(cfg.dtype))

@@ -52,15 +52,20 @@ def _should_quantize(path: str, leaf: Any) -> bool:
     return not any(s in lowered for s in ("norm", "embed", "ln_"))
 
 
-def quantize_params(params: Any) -> Any:
+def quantize_params(params: Any, cast_rest: Any = None) -> Any:
     """Quantize every weight matrix of a model param pytree (norms and
-    embeddings stay full precision)."""
+    embeddings stay full precision by default).  ``cast_rest`` casts
+    the UNQUANTIZED leaves to a serving dtype — an fp32 embedding table
+    left in a serving artifact costs a full vocab×dim convert (1 GB at
+    8B) inside every decode step, plus double its resident footprint."""
 
     def walk(path: str, node: Any) -> Any:
         if isinstance(node, dict):
             return {k: walk(f"{path}/{k}", v) for k, v in node.items()}
         if _should_quantize(path, node):
             return quantize_tensor(node, stacked="/layers/" in path)
+        if cast_rest is not None and hasattr(node, "astype"):
+            return node.astype(cast_rest)
         return node
 
     return walk("", params)
@@ -128,11 +133,14 @@ def init_quantized_llama(rng_key, cfg) -> Any:
             .astype(pd)))(key)
         return w
 
+    # Unquantized leaves in the SERVING dtype: an fp32 embedding in an
+    # int8 artifact doubles its resident bytes for no decode benefit.
+    sd = cfg.dtype
     keys = jax.random.split(rng_key, 9)
     params: Any = {
         "tok_embed": jax.jit(
             lambda k: (jax.random.normal(k, (V, d), pd) * (d ** -0.5))
-            .astype(pd))(keys[0]),
+            .astype(sd))(keys[0]),
         "layers": {
             "attn": {
                 "wq": qleaf_stacked(keys[1], (d, h, hd), d),
@@ -145,10 +153,10 @@ def init_quantized_llama(rng_key, cfg) -> Any:
                 "w_up": qleaf_stacked(keys[6], (d, m), d),
                 "w_down": qleaf_stacked(keys[7], (m, d), m),
             },
-            "ln_attn": jnp.ones((L, d), pd),
-            "ln_mlp": jnp.ones((L, d), pd),
+            "ln_attn": jnp.ones((L, d), sd),
+            "ln_mlp": jnp.ones((L, d), sd),
         },
-        "final_norm": jnp.ones((d,), pd),
+        "final_norm": jnp.ones((d,), sd),
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = qleaf(keys[8], (d, V), d)

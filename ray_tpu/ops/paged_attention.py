@@ -154,6 +154,304 @@ def paged_decode_attention(
     return out[:, :, :qpg, :].reshape(B, H, D)
 
 
+def _kernel_partial(bt_ref, len_ref, _ly_ref, q_ref, k_ref, v_ref,
+                    acc_ref, m_ref, l_ref, m_scr, l_scr, acc_scr, *,
+                    page: int, scale: float, soft_cap: Optional[float],
+                    kvh: int, qpg_p: int):
+    """Layered flash partials: UNNORMALIZED accumulator + running max
+    and denominator per (kv-head, q row) — the caller folds in the
+    current token's self-attention term and normalizes.  The pools are
+    strictly read-only here, which is what lets the decode scan carry
+    them without XLA cloning the multi-GB buffers."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+
+    @pl.when(p * page < length)
+    def _compute():
+        for h in range(kvh):
+            lo, hi = h * qpg_p, (h + 1) * qpg_p
+            q = q_ref[0, h]
+            k = k_ref[0, h, 0]
+            s = lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if soft_cap is not None:
+                s = soft_cap * jnp.tanh(s / soft_cap)
+            pos = p * page + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(pos < length, s, NEG_INF)
+            m_prev = m_scr[lo:hi]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            probs = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[lo:hi] = (corr * l_scr[lo:hi]
+                            + jnp.sum(probs, axis=-1, keepdims=True))
+            v = v_ref[0, h, 0]
+            pv = lax.dot_general(
+                probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_scr[lo:hi] = acc_scr[lo:hi] * corr + pv
+            m_scr[lo:hi] = m_new
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        for h in range(kvh):
+            lo, hi = h * qpg_p, (h + 1) * qpg_p
+            acc_ref[0, h] = acc_scr[lo:hi]
+            m_ref[0, h] = m_scr[lo:hi]
+            l_ref[0, h] = l_scr[lo:hi]
+
+
+def paged_decode_attention_partial(
+    q: jax.Array,
+    k_pools: jax.Array,
+    v_pools: jax.Array,
+    layer: jax.Array,
+    block_table: jax.Array,
+    lengths: jax.Array,
+    *,
+    soft_cap: Optional[float] = None,
+):
+    """Read-only layered attention over PAST tokens only:
+    q [B, H, D], pools [L, KVH, P, page, D], lengths = tokens already
+    in the cache → (acc [B, H, D] f32 unnormalized, m [B, H, 1],
+    l [B, H, 1]).  Combine with the new token's self term via
+    ``combine_with_self``."""
+    B, H, D = q.shape
+    L, KVH, P, page, _ = k_pools.shape
+    maxp = block_table.shape[1]
+    qpg = H // KVH
+    qpg_p = max(qpg, _MIN_QPG)
+    scale = D ** -0.5
+
+    qg = q.reshape(B, KVH, qpg, D)
+    if qpg_p != qpg:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, qpg_p - qpg), (0, 0)))
+
+    def page_map(b, p, bt, ln, ly):
+        # Pages past the sequence's last used page repeat that page:
+        # consecutive identical block indices make Mosaic skip the
+        # DMA, so a short stream in a wide block-table row fetches its
+        # ~3 live pages, not all maxp (the full sweep was ~8 ms/step
+        # of dead HBM traffic at 8B).
+        last = jnp.maximum(ln[b] - 1, 0) // page
+        pe = jnp.minimum(p, last)
+        return (ly[0], 0, jnp.minimum(bt[b, pe], P - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # block_table, lengths, layer
+        grid=(B, maxp),
+        in_specs=[
+            pl.BlockSpec((1, KVH, qpg_p, D),
+                         lambda b, p, bt, ln, ly: (b, 0, 0, 0)),
+            pl.BlockSpec((1, KVH, 1, page, D), page_map),
+            pl.BlockSpec((1, KVH, 1, page, D), page_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, KVH, qpg_p, D),
+                         lambda b, p, bt, ln, ly: (b, 0, 0, 0)),
+            pl.BlockSpec((1, KVH, qpg_p, 1),
+                         lambda b, p, bt, ln, ly: (b, 0, 0, 0)),
+            pl.BlockSpec((1, KVH, qpg_p, 1),
+                         lambda b, p, bt, ln, ly: (b, 0, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((KVH * qpg_p, 1), jnp.float32),
+            pltpu.VMEM((KVH * qpg_p, 1), jnp.float32),
+            pltpu.VMEM((KVH * qpg_p, D), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(_kernel_partial, page=page, scale=scale,
+                          soft_cap=soft_cap, kvh=KVH, qpg_p=qpg_p),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, KVH, qpg_p, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, KVH, qpg_p, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, KVH, qpg_p, 1), jnp.float32),
+        ],
+        interpret=_interpret_mode(),
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      jnp.asarray(layer, jnp.int32).reshape(1), qg, k_pools, v_pools)
+    acc = acc[:, :, :qpg, :].reshape(B, H, D)
+    m = m[:, :, :qpg, :].reshape(B, H, 1)
+    l = l[:, :, :qpg, :].reshape(B, H, 1)
+    return acc, m, l
+
+
+def combine_with_self(q, k_new, v_new, acc, m, l, *,
+                      scale: Optional[float] = None,
+                      soft_cap: Optional[float] = None) -> jax.Array:
+    """Fold the CURRENT token's self-attention into flash partials:
+    q [B, H, D], k_new/v_new [B, KVH, D] (GQA-expanded here),
+    (acc, m, l) from paged_decode_attention_partial → out [B, H, D]."""
+    B, H, D = q.shape
+    KVH = k_new.shape[1]
+    group = H // KVH
+    kx = jnp.repeat(k_new, group, axis=1).astype(jnp.float32)
+    vx = jnp.repeat(v_new, group, axis=1).astype(jnp.float32)
+    scale = scale if scale is not None else D ** -0.5
+    s = jnp.sum(q.astype(jnp.float32) * kx, axis=-1,
+                keepdims=True) * scale                       # [B, H, 1]
+    if soft_cap is not None:
+        s = soft_cap * jnp.tanh(s / soft_cap)
+    m_new = jnp.maximum(m, s)
+    corr = jnp.exp(m - m_new)
+    p_self = jnp.exp(s - m_new)
+    out = (acc * corr + p_self * vx) / (l * corr + p_self)
+    return out.astype(q.dtype)
+
+
+def _append_kernel(pids_ref, offs_ref, knew_ref, vnew_ref,
+                   kin_ref, vin_ref, kout_ref, vout_ref):
+    b = pl.program_id(0)
+    # Masked FULL-page overwrite of the appended row (copy-through +
+    # where-select): dynamic single-row stores land in the sublane
+    # dim, which Mosaic requires 8-aligned — the iota select sidesteps
+    # that.  knew arrives pre-broadcast to the page shape (built
+    # outside; Mosaic rejects in-kernel rank-ups).  Sentinel slots
+    # write garbage into the dedicated SCRATCH page (never a live
+    # page), so no grid cell can clobber another's append.
+    off = offs_ref[b]
+    cur_k = kin_ref[...]
+    cur_v = vin_ref[...]
+    rows = lax.broadcasted_iota(jnp.int32, cur_k.shape, 3)
+    kout_ref[...] = jnp.where(rows == off, knew_ref[0], cur_k)
+    vout_ref[...] = jnp.where(rows == off, vnew_ref[0], cur_v)
+
+
+def paged_append(k_pools: jax.Array, v_pools: jax.Array,
+                 k_new: jax.Array, v_new: jax.Array,
+                 pids: jax.Array, offs: jax.Array):
+    """In-place append of one token per slot into the page pools, for
+    EVERY layer at once: pools [L, KVH, P, page, D],
+    k_new/v_new [L, B, KVH, D], pids/offs [B] (pids == P → skip, the
+    OOB convention for inactive slots).  Uses pallas
+    ``input_output_aliases`` so the multi-GB pools update in place —
+    the jnp scatter equivalents kept making XLA clone the pools inside
+    the decode loop."""
+    L, KVH, P, page, D = k_pools.shape
+    B = pids.shape[0]
+    # Pre-broadcast the new rows to the page-block shape (tiny: one
+    # page column per slot) so the kernel's masked write needs no
+    # in-kernel reshape/broadcast.
+    knew = jnp.broadcast_to(
+        k_new.transpose(1, 0, 2, 3)[:, :, :, None, None, :],
+        (B, L, KVH, 1, page, D))
+    vnew = jnp.broadcast_to(
+        v_new.transpose(1, 0, 2, 3)[:, :, :, None, None, :],
+        (B, L, KVH, 1, page, D))
+
+    # Grid over (slot, layer): one page column per cell keeps VMEM use
+    # at ~6 x page-block (a whole-L block was 32 MB and blew the 16 MB
+    # scoped-vmem budget at 8B).
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # pids, offs
+        grid=(B, L),
+        in_specs=[
+            pl.BlockSpec((1, 1, KVH, 1, page, D),
+                         lambda b, l, pi, of: (b, l, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 1, KVH, 1, page, D),
+                         lambda b, l, pi, of: (b, l, 0, 0, 0, 0)),
+            pl.BlockSpec((1, KVH, 1, page, D),
+                         lambda b, l, pi, of: (
+                             l, 0, jnp.minimum(pi[b], P - 1), 0, 0)),
+            pl.BlockSpec((1, KVH, 1, page, D),
+                         lambda b, l, pi, of: (
+                             l, 0, jnp.minimum(pi[b], P - 1), 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, KVH, 1, page, D),
+                         lambda b, l, pi, of: (
+                             l, 0, jnp.minimum(pi[b], P - 1), 0, 0)),
+            pl.BlockSpec((1, KVH, 1, page, D),
+                         lambda b, l, pi, of: (
+                             l, 0, jnp.minimum(pi[b], P - 1), 0, 0)),
+        ],
+    )
+    return pl.pallas_call(
+        _append_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_pools.shape, k_pools.dtype),
+            jax.ShapeDtypeStruct(v_pools.shape, v_pools.dtype),
+        ],
+        # Inputs count scalar-prefetch args first: pids=0, offs=1,
+        # knew=2, vnew=3, k_pools=4, v_pools=5.
+        input_output_aliases={4: 0, 5: 1},
+        interpret=_interpret_mode(),
+    )(pids.astype(jnp.int32), offs.astype(jnp.int32), knew, vnew,
+      k_pools, v_pools)
+
+
+def paged_append_tp(k_pools, v_pools, k_new, v_new, pids, offs, *,
+                    axis: str = "tp"):
+    """paged_append under tensor parallelism (pools + new rows sharded
+    on KVH; per-shard appends are independent)."""
+    from ray_tpu.ops.ring_attention import _ambient_mesh
+
+    try:
+        mesh = _ambient_mesh()
+    except Exception:
+        mesh = None
+    if mesh is None or mesh.shape.get(axis, 1) == 1:
+        return paged_append(k_pools, v_pools, k_new, v_new, pids, offs)
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import shard_map_unchecked
+
+    mapped = shard_map_unchecked(
+        paged_append,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis),
+                  P(None, None, axis), P(None, None, axis), P(), P()),
+        out_specs=(P(None, axis), P(None, axis)),
+    )
+    return mapped(k_pools, v_pools, k_new, v_new, pids, offs)
+
+
+def paged_decode_attention_partial_tp(
+    q, k_pools, v_pools, layer, block_table, lengths, *,
+    soft_cap: Optional[float] = None, axis: str = "tp",
+):
+    """Partial layered kernel under tensor parallelism (heads/KVH
+    sharded; partials come back sharded on H — the combine is local)."""
+    from ray_tpu.ops.ring_attention import _ambient_mesh
+
+    try:
+        mesh = _ambient_mesh()
+    except Exception:
+        mesh = None
+    if mesh is None or mesh.shape.get(axis, 1) == 1:
+        return paged_decode_attention_partial(
+            q, k_pools, v_pools, layer, block_table, lengths,
+            soft_cap=soft_cap)
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import shard_map_unchecked
+
+    mapped = shard_map_unchecked(
+        lambda qq, kk, vv, ly, bt, ln: paged_decode_attention_partial(
+            qq, kk, vv, ly, bt, ln, soft_cap=soft_cap),
+        mesh=mesh,
+        in_specs=(P(None, axis, None), P(None, axis), P(None, axis),
+                  P(), P(), P()),
+        out_specs=(P(None, axis, None), P(None, axis, None),
+                   P(None, axis, None)),
+    )
+    return mapped(q, k_pools, v_pools, layer, block_table, lengths)
+
+
 def paged_decode_attention_reference(
     q: jax.Array,
     k_pages: jax.Array,

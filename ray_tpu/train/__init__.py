@@ -1,4 +1,5 @@
 from ray_tpu.train.checkpoint import CheckpointManager
+from ray_tpu.train.optim8 import adamw8bit, scale_by_adam8bit
 from ray_tpu.train.state import (
     TrainState,
     create_train_state,
@@ -39,7 +40,9 @@ __all__ = [
     "WorkerGroup",
     "compile_train_step",
     "create_train_state",
+    "adamw8bit",
     "default_optimizer",
+    "scale_by_adam8bit",
     "get_checkpoint",
     "get_context",
     "make_train_step",

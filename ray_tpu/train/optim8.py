@@ -1,0 +1,163 @@
+"""Block-wise 8-bit Adam optimizer states (TPU-native bitsandbytes
+analogue).
+
+The reference ecosystem fits big models with 8-bit optimizers
+(bitsandbytes' CUDA kernels); on TPU the same memory play is plain XLA:
+Adam's m/v tensors live as int8 with one float32 absmax scale per
+256-element block, dequantized/requantized inside the fused update —
+2 bytes/param of optimizer state instead of 8, which is what lets a
+~2.4B-param AdamW config train on one 16 GB chip (bench.py's measured
+multi-billion point).  Quantization error behaves like rounding noise
+on m/v; each block keeps full dynamic range via its own scale.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+BLOCK = 256
+
+
+def _quantize(x: jax.Array):
+    """flat float32 → (int8 [nb, BLOCK], f32 scale [nb, 1])."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.shape[0]) % BLOCK
+    blocks = jnp.pad(flat, (0, pad)).reshape(-1, BLOCK)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
+    return (q, scale)
+
+def _dequantize(s, shape) -> jax.Array:
+    q, scale = s
+    n = math.prod(shape)
+    flat = (q.astype(jnp.float32) * scale).reshape(-1)
+    return flat[:n].reshape(shape)
+
+
+class ScaleByAdam8State(NamedTuple):
+    count: Any
+    mu: Any   # pytree with (q, scale) tuples at param leaf positions
+    nu: Any
+
+
+def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.95,
+                      eps: float = 1e-8) -> optax.GradientTransformation:
+    """Adam moment tracking with int8 block-quantized mu/nu."""
+
+    def init(params):
+        q0 = lambda p: _quantize(jnp.zeros(p.shape, jnp.float32))
+        return ScaleByAdam8State(
+            jnp.zeros([], jnp.int32),
+            jax.tree.map(q0, params),
+            jax.tree.map(q0, params),
+        )
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+
+        def upd(g, mq, nq):
+            # The whole update runs in BLOCK space, streamed over
+            # segments with lax.map: dequantizing a multi-hundred-M
+            # stacked leaf's m, v, and grads to f32 at once is
+            # ~5 x leaf f32 bytes of transient HBM — the difference
+            # between a 2.2B model fitting a 16 GB chip or not.
+            shape, dt = g.shape, g.dtype
+            nb = mq[0].shape[0]
+            pad = nb * BLOCK - math.prod(shape)
+            gb = jnp.pad(g.reshape(-1), (0, pad)).reshape(nb, BLOCK)
+            nseg = min(16, nb)
+            segp = (-nb) % nseg
+            def seg(args):
+                gs, mqs, mss, nqs, nss = args
+                g32 = gs.astype(jnp.float32)
+                m = mqs.astype(jnp.float32) * mss
+                # nu stored as sqrt(v): linear int8 only spans a 127:1
+                # ratio per block — storing the root doubles the
+                # covered dynamic range, which is the difference
+                # between converging and small-v blocks rounding to 0
+                # (update explosion).  (bitsandbytes uses a nonlinear
+                # dynamic code for the same reason.)
+                u = nqs.astype(jnp.float32) * nss
+                n = b2 * (u * u) + (1 - b2) * (g32 * g32)
+                m = b1 * m + (1 - b1) * g32
+                mhat = m / (1 - b1 ** cf)
+                nhat = n / (1 - b2 ** cf)
+                out = mhat / (jnp.sqrt(nhat) + eps)
+                out = jnp.clip(out, -10.0, 10.0).astype(dt)
+                ms2 = jnp.maximum(
+                    jnp.max(jnp.abs(m), axis=1, keepdims=True) / 127.0,
+                    1e-12)
+                mq2 = jnp.clip(jnp.round(m / ms2), -127, 127
+                               ).astype(jnp.int8)
+                un = jnp.sqrt(n)
+                ns2 = jnp.maximum(
+                    jnp.max(un, axis=1, keepdims=True) / 127.0, 1e-12)
+                nq2 = jnp.clip(jnp.round(un / ns2), -127, 127
+                               ).astype(jnp.int8)
+                return out, mq2, ms2, nq2, ns2
+
+            def segify(x):
+                if segp:
+                    x = jnp.concatenate(
+                        [x, jnp.zeros((segp,) + x.shape[1:], x.dtype)])
+                return x.reshape(nseg, -1, *x.shape[1:])
+
+            args = tuple(segify(a) for a in
+                         (gb, mq[0], mq[1], nq[0], nq[1]))
+            out, mq2, ms2, nq2, ns2 = jax.lax.map(seg, args)
+            out = out.reshape(-1)[: math.prod(shape)].reshape(shape)
+
+            def unseg(x):
+                x = x.reshape(-1, *x.shape[2:])
+                return x[:nb] if segp else x
+
+            return (out, (unseg(mq2), unseg(ms2)),
+                    (unseg(nq2), unseg(ns2)))
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_n = treedef.flatten_up_to(state.nu)
+        outs = [upd(g, m, n) for g, m, n in zip(flat_g, flat_m, flat_n)]
+        return (treedef.unflatten([o[0] for o in outs]),
+                ScaleByAdam8State(count,
+                                  treedef.unflatten([o[1] for o in outs]),
+                                  treedef.unflatten([o[2] for o in outs])))
+
+    return optax.GradientTransformation(init, update)
+
+
+def adamw8bit(
+    learning_rate: float = 3e-4,
+    *,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    eps: float = 1e-8,
+    grad_clip: float = 1.0,
+    warmup_steps: int = 100,
+    total_steps: Optional[int] = None,
+) -> optax.GradientTransformation:
+    """AdamW with 8-bit states + the same schedule/clipping wrapping as
+    train.default_optimizer."""
+    if total_steps:
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, learning_rate, warmup_steps,
+            max(total_steps, warmup_steps + 1))
+    else:
+        schedule = optax.linear_schedule(
+            0.0, learning_rate, max(1, warmup_steps))
+    parts = []
+    if grad_clip:
+        parts.append(optax.clip_by_global_norm(grad_clip))
+    parts.append(scale_by_adam8bit(b1=b1, b2=b2, eps=eps))
+    if weight_decay:
+        parts.append(optax.add_decayed_weights(weight_decay))
+    parts.append(optax.scale_by_learning_rate(schedule))
+    return optax.chain(*parts)

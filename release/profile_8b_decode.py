@@ -24,7 +24,7 @@ def main() -> int:
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--layers", type=int, default=32)
     ap.add_argument("--steps", type=int, default=32)
-    ap.add_argument("--pages", type=int, default=512)
+    ap.add_argument("--pages", type=int, default=384)
     ap.add_argument("--page-size", type=int, default=64)
     ap.add_argument("--kv-int8", action="store_true", default=False)
     args = ap.parse_args()
@@ -48,19 +48,21 @@ def main() -> int:
     print(f"config: L={cfg.n_layers} dim={cfg.dim} heads={cfg.n_heads} "
           f"kv={cfg.n_kv_heads} mlp={cfg.mlp_dim} vocab={cfg.vocab_size}")
 
-    # Random int8 params assembled DIRECTLY on device (host RAM can't
-    # hold the fp32 tree).
+    # Random int8 params built ON HOST (1 layer, broadcast to L —
+    # identical layers are fine for bandwidth measurement), streamed to
+    # the chip once; building on device leaves fp32 temps that eat HBM.
     t0 = time.time()
-    params = llama.init_params(jax.random.key(0), dataclasses.replace(
-        cfg, n_layers=1))
-    # Expand the single layer to L by broadcasting the quantized stack
-    # (identical layers are fine for bandwidth measurement).
-    qparams = quantize_params(params)
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        params = llama.init_params(jax.random.key(0), dataclasses.replace(
+            cfg, n_layers=1))
+        qparams = quantize_params(params, cast_rest=jnp.bfloat16)
+        del params
+        qparams = jax.tree.map(np.asarray, qparams)
     qparams["layers"] = jax.tree.map(
-        lambda x: np.broadcast_to(np.asarray(x),
-                                  (cfg.n_layers,) + x.shape[1:]),
+        lambda x: np.broadcast_to(x, (cfg.n_layers,) + x.shape[1:]),
         qparams["layers"])
-    qparams = jax.device_put(qparams)
+    qparams = jax.device_put(qparams, dev)
     jax.block_until_ready(jax.tree.leaves(qparams)[0])
     int8_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(qparams))

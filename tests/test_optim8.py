@@ -1,0 +1,87 @@
+"""8-bit Adam states (train/optim8.py) vs full-precision AdamW."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.train.optim8 import BLOCK, adamw8bit, scale_by_adam8bit
+
+
+def _fit(opt, steps=500):
+    """Train a small least-squares problem; return final loss."""
+    key = jax.random.key(0)
+    kw, kx = jax.random.split(key)
+    w_true = jax.random.normal(kw, (37, 5))  # 37: exercises block padding
+    X = jax.random.normal(kx, (256, 37))
+    y = X @ w_true
+    params = {"w": jnp.zeros((37, 5))}
+    state = opt.init(params)
+
+    def loss_fn(p):
+        return jnp.mean((X @ p["w"] - y) ** 2)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    for _ in range(steps):
+        params, state, loss = step(params, state)
+    return float(loss)
+
+
+def test_tracks_full_precision_adam():
+    lr = 0.05
+    full = _fit(optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.scale_by_adam(b1=0.9, b2=0.95),
+        optax.scale_by_learning_rate(lr)))
+    eight = _fit(optax.chain(
+        optax.clip_by_global_norm(1.0),
+        scale_by_adam8bit(b1=0.9, b2=0.95),
+        optax.scale_by_learning_rate(lr)))
+    # Both must converge; int8 states cost at most a modest factor.
+    assert full < 1e-2
+    assert eight < 5e-2
+    assert eight < 10 * max(full, 1e-4)
+
+
+def test_state_is_int8():
+    opt = scale_by_adam8bit()
+    params = {"w": jnp.zeros((300, 7))}  # non-multiple of BLOCK
+    state = opt.init(params)
+    q, scale = state.mu["w"]
+    assert q.dtype == jnp.int8
+    assert q.shape[1] == BLOCK
+    assert scale.dtype == jnp.float32
+    # State bytes ≈ 1 byte/param + scale overhead (f32 per 256).
+    nbytes = q.size + scale.size * 4
+    assert nbytes < 300 * 7 * 1.2 + BLOCK
+
+
+def test_adamw8bit_trains_llama_tiny():
+    from ray_tpu.models import llama
+
+    cfg = llama.LLAMA_TINY
+    params = llama.init_params(jax.random.key(0), cfg)
+    opt = adamw8bit(1e-3, warmup_steps=1)
+    state = opt.init(params)
+    tokens = jax.random.randint(jax.random.key(1), (2, 64), 0,
+                                cfg.vocab_size)
+
+    @jax.jit
+    def step(params, state):
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, {"tokens": tokens}, cfg),
+            has_aux=True)(params)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    losses = []
+    for _ in range(8):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # actually learning
