@@ -1,0 +1,119 @@
+"""General cluster pub/sub: named channels with long-poll pull.
+
+Parity: the reference's GCS pubsub (ray: src/ray/pubsub/publisher.h:307
+— per-channel publishers with long-poll subscribers; channel types in
+src/ray/protobuf/pubsub.proto: actor / node / object / logs / error
+channels).  Here one head-side Publisher holds a bounded ring per
+channel; subscribers long-poll ``pull(channel, cursor)`` over whatever
+transport already reaches the head (driver: in-process; workers: the
+control channel; daemons' workers: forwarded automatically; clients:
+the client op) — no extra socket, matching how everything else rides
+the existing planes.
+
+Built-in channels the runtime publishes to:
+  "node"   — {event: "added"|"died", node_id, resources?}
+  "actor"  — {event: "created"|"died", actor_id, name, class, reason?}
+  "logs"   — {node, file, lines}  (only while someone has pulled it)
+  "error"  — {source, task_id, message}  (retries-exhausted failures)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Publisher:
+    """Bounded per-channel rings + a condvar for long-poll wakeups."""
+
+    def __init__(self, maxlen: int = 1000):
+        self._cv = threading.Condition()
+        self._maxlen = maxlen
+        self._chans: Dict[str, deque] = {}
+        self._seqs: Dict[str, int] = {}
+        self._pulled: set = set()  # channels someone has ever pulled
+
+    def has_consumers(self, channel: str) -> bool:
+        """True once ANY subscriber has pulled the channel — lets hot
+        publishers (log batches) skip channels nobody listens to."""
+        with self._cv:
+            return channel in self._pulled
+
+    def publish(self, channel: str, msg: Any) -> None:
+        with self._cv:
+            ring = self._chans.get(channel)
+            if ring is None:
+                ring = self._chans[channel] = deque(maxlen=self._maxlen)
+            seq = self._seqs.get(channel, 0) + 1
+            self._seqs[channel] = seq
+            ring.append((seq, msg))
+            self._cv.notify_all()
+
+    def pull(self, channel: str, cursor: int = 0,
+             timeout: Optional[float] = None
+             ) -> Tuple[int, List[Any]]:
+        """(new_cursor, messages with seq > cursor); blocks up to
+        ``timeout`` when nothing is newer (long poll).  A cursor older
+        than the ring start silently skips to what is retained (the
+        reference's at-most-once channel semantics)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cv:
+            self._pulled.add(channel)
+            while True:
+                ring = self._chans.get(channel)
+                if ring:
+                    out = [m for s, m in ring if s > cursor]
+                    if out:
+                        return self._seqs[channel], out
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return (cursor, [])
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait(1.0)  # untimed: loop on wakeups
+
+    def channels(self) -> List[str]:
+        with self._cv:
+            return sorted(self._chans)
+
+
+class Subscription:
+    """Iterator view of one channel via a pull function — works over
+    any transport that exposes ``pull(channel, cursor, timeout)``."""
+
+    def __init__(self, pull_fn, channel: str, poll_timeout: float = 10.0):
+        self._pull = pull_fn
+        self.channel = channel
+        self._cursor = 0
+        self._timeout = poll_timeout
+
+    def poll(self, timeout: Optional[float] = None) -> List[Any]:
+        cursor, msgs = self._pull(self.channel, self._cursor,
+                                  timeout if timeout is not None
+                                  else self._timeout)
+        if msgs:
+            self._cursor = cursor
+        return msgs
+
+    def __iter__(self):
+        while True:
+            yield from self.poll()
+
+
+def subscribe(channel: str, *, poll_timeout: float = 10.0) -> Subscription:
+    """Subscribe from the current process: direct Publisher access on
+    the driver/head, the forwarded ``ps_pull`` control op inside
+    workers (parity: ray.util's subscriber surfaces over GCS pubsub)."""
+    from ray_tpu.core import api
+
+    rt = api.runtime()
+    if hasattr(rt, "pubsub"):
+        return Subscription(rt.pubsub.pull, channel, poll_timeout)
+    # Worker runtime: long-poll through the control channel.
+    return Subscription(
+        lambda ch, cur, to: tuple(rt.ps_pull(ch, cur, to)),
+        channel, poll_timeout)

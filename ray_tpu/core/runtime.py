@@ -1250,8 +1250,12 @@ class LocalRuntime:
         # tailing them + dashboard log views): this node's workers write
         # to log_dir; the monitor ships complete lines to the LogBuffer;
         # remote daemons ship theirs over the head channel.
+        from ray_tpu.core.pubsub import Publisher
         from ray_tpu.util.log_monitor import LogBuffer
 
+        # General pubsub channels (parity: GCS pubsub, publisher.h:307
+        # — node/actor/logs/error channels, long-poll subscribers).
+        self.pubsub = Publisher()
         self.logs = LogBuffer(cfg.log_buffer_lines)
         self.log_dir = None
         self._log_monitor = None
@@ -1338,6 +1342,10 @@ class LocalRuntime:
         if getattr(self, "_pending_restores", None):
             threading.Thread(target=self._retry_detached_restores,
                              daemon=True, name="detached-restore").start()
+        self.pubsub.publish("node", {
+            "event": "added", "node_id": node_id.hex(),
+            "resources": dict(resources),
+        })
         self._notify()
         return node_id
 
@@ -1461,6 +1469,8 @@ class LocalRuntime:
             if lost:
                 self._reserve_bundles(st, lost)
         self._recover_lost_objects(node_id)
+        self.pubsub.publish("node", {"event": "died",
+                                     "node_id": node_id.hex()})
         self._notify()
 
     def _recover_lost_objects(self, node_id: NodeID) -> None:
@@ -2311,6 +2321,13 @@ class LocalRuntime:
                     )
                     for oid in pt.return_ids:
                         self.store.put_error(oid, err)
+                    # Retries exhausted: surface cluster-wide (parity:
+                    # the GCS error-info channel).
+                    self.pubsub.publish("error", {
+                        "source": pt.function_name,
+                        "task_id": pt.task_id.hex(),
+                        "message": repr(e)[:500],
+                    })
             finally:
                 with self._lock:
                     self._running_tasks.pop(pt.task_id, None)
@@ -2651,6 +2668,10 @@ class LocalRuntime:
                 self._detached_specs[options.name] = spec_blob
         if spec_blob is not None:
             self._mark_gcs_dirty()
+        self.pubsub.publish("actor", {
+            "event": "created", "actor_id": actor_id.hex(),
+            "name": options.name or "", "class": cls.__name__,
+        })
         shell.start()
         return shell, ObjectRef(creation_oid)
 
@@ -2827,6 +2848,12 @@ class LocalRuntime:
         }
 
     def _finish_actor_removal(self, shell: _ActorShell):
+        self.pubsub.publish("actor", {
+            "event": "died", "actor_id": shell.actor_id.hex(),
+            "name": shell.options.name or "",
+            "class": shell.cls.__name__,
+            "reason": shell.death_reason or "",
+        })
         # Drop the creation oid's permanent pin (its error/None value
         # stays readable through any still-held handles; the pin removal
         # lets it free once those drop).
@@ -3206,6 +3233,12 @@ class LocalRuntime:
         to the driver console — parity: ray's log_to_driver prefixing
         lines with their producing worker/node)."""
         self.logs.ingest(node, file, lines)
+        # Publish only once someone has pulled the channel: with no
+        # subscriber the ring would duplicate LogBuffer's retention and
+        # every batch would wake all other channels' waiters for nothing.
+        if self.pubsub.has_consumers("logs"):
+            self.pubsub.publish("logs", {"node": node, "file": file,
+                                         "lines": list(lines)})
         from ray_tpu.utils.config import get_config
 
         if get_config().log_to_driver:

@@ -357,6 +357,15 @@ class WorkerRuntime:
         self._chan.call("kill_actor", actor_id=actor_id.binary(),
                         no_restart=no_restart)
 
+    def ps_pull(self, channel: str, cursor: int = 0,
+                timeout: float = 10.0):
+        """Long-poll a head pubsub channel (core/pubsub.py) through
+        the control plane; from a daemon's worker this forwards to the
+        head like every other control op."""
+        return tuple(self._chan.call(
+            "ps_pull", rpc_timeout=timeout + 30.0,
+            channel=channel, cursor=cursor, timeout=timeout))
+
     def get_named_actor(self, name: str) -> ActorID:
         return ActorID(self._chan.call("named_actor", name=name)
                        ["actor_id"])
@@ -868,7 +877,13 @@ class _WorkerServer:
             return 2
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.connect(sock_path)
-        from ray_tpu.util.client.common import recv_msg, send_msg
+        from ray_tpu.util.client.common import (
+            exchange_versions,
+            recv_msg,
+            send_msg,
+        )
+
+        exchange_versions(sock)
 
         # Direct task transport (parity: the owner pushing tasks to a
         # leased worker over its own gRPC channel rather than through

@@ -210,9 +210,14 @@ class WorkerPool:
                              daemon=True, name="worker-register").start()
 
     def _register(self, conn: socket.socket) -> None:
-        from ray_tpu.util.client.common import recv_msg, send_msg
+        from ray_tpu.util.client.common import (
+            exchange_versions,
+            recv_msg,
+            send_msg,
+        )
 
         try:
+            exchange_versions(conn)
             hello = recv_msg(conn)
             token = hello.get("token", "")
         except Exception:
@@ -611,6 +616,13 @@ def handle_control_op(rt, key: str, msg: Dict[str, Any],
         rt.kill_actor(ActorID(msg["actor_id"]),
                       msg.get("no_restart", True))
         return None
+    if op == "ps_pull":
+        # Long-poll bounded server-side so a handler thread can't park
+        # past the worker's rpc timeout (explicit 0 stays non-blocking).
+        to = msg.get("timeout")
+        to = 10.0 if to is None else float(to)
+        return rt.pubsub.pull(msg["channel"], msg.get("cursor", 0),
+                              min(to, 25.0))
     if op == "named_actor":
         aid, cls_name, table, cgroups = rt.named_actor_handle(msg["name"])
         return {"actor_id": aid.binary(), "cls_name": cls_name,

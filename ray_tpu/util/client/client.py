@@ -204,6 +204,18 @@ class ClientContext:
         return ([by_id[b] for b in ready_ids],
                 [by_id[b] for b in pending_ids])
 
+    def subscribe(self, channel: str, *, poll_timeout: float = 10.0):
+        """Subscription over a head pubsub channel (node/actor/logs/
+        error — core/pubsub.py).  The client sends one request at a
+        time, so a parked long-poll delays other calls on THIS context
+        — use a dedicated ClientContext for subscriptions."""
+        from ray_tpu.core.pubsub import Subscription
+
+        return Subscription(
+            lambda ch, cur, to: tuple(self._call(
+                "ps_pull", channel=ch, cursor=cur, timeout=to)),
+            channel, poll_timeout)
+
     def get_actor(self, name: str) -> ClientActorHandle:
         """Attach to a named actor created by any driver."""
         return ClientActorHandle(self, self._call("get_actor", name=name))

@@ -30,6 +30,34 @@ MAX_FRAME = 1 << 31
 _NONCE_LEN = 32
 TOKEN_ENV = "RAYTPU_CLIENT_TOKEN"
 
+# Wire protocol version (parity: the reference's versioned protobuf
+# schemas, src/ray/protobuf/*.proto — here a single version number
+# negotiated per connection, because frames are cloudpickle and any
+# skew between head/daemon/client would otherwise fail undiagnosably
+# deep inside an op).  Bump on ANY incompatible frame-shape change.
+PROTOCOL_VERSION = 1
+_PREAMBLE = struct.Struct(">4sHH")
+
+
+def exchange_versions(sock: socket.socket) -> int:
+    """Full-duplex version preamble, sent BEFORE the token handshake
+    and before any pickle: both ends send magic + version + flags and
+    verify the peer's.  Raises ConnectionError on foreign endpoints or
+    version skew (with both versions named, so operators see 'upgrade
+    the daemon' instead of an unpickling traceback)."""
+    sock.sendall(_PREAMBLE.pack(b"RTPW", PROTOCOL_VERSION, 0))
+    head = _recv_exact(sock, _PREAMBLE.size)
+    magic, ver, _flags = _PREAMBLE.unpack(head)
+    if magic != b"RTPW":
+        raise ConnectionError(
+            "peer did not send a ray_tpu wire preamble — incompatible "
+            "build or foreign endpoint")
+    if ver != PROTOCOL_VERSION:
+        raise ConnectionError(
+            f"wire protocol version skew: local v{PROTOCOL_VERSION}, "
+            f"peer v{ver} — run the same ray_tpu version on both ends")
+    return ver
+
 
 def _digest(token: str, nonce: bytes) -> bytes:
     return hmac.new(token.encode(), nonce, hashlib.sha256).digest()
@@ -37,11 +65,17 @@ def _digest(token: str, nonce: bytes) -> bytes:
 
 def server_handshake(sock: socket.socket,
                      token: Optional[str] = None) -> bool:
-    """Challenge the peer before any pickle crosses the wire.
+    """Version preamble + token challenge before any pickle crosses
+    the wire.
 
-    No token configured → no-op (loopback trust, documented above).
-    Returns False (caller should drop the connection) on a bad proof.
+    No token configured → version exchange only (loopback trust,
+    documented above).  Returns False (caller should drop the
+    connection) on a bad proof or version skew.
     """
+    try:
+        exchange_versions(sock)
+    except (ConnectionError, OSError):
+        return False
     token = token if token is not None else os.environ.get(TOKEN_ENV)
     if not token:
         return True
@@ -56,7 +90,9 @@ def server_handshake(sock: socket.socket,
 
 def client_handshake(sock: socket.socket,
                      token: Optional[str] = None) -> None:
-    """Answer the server's challenge (symmetric to server_handshake)."""
+    """Version preamble + answer the server's challenge (symmetric to
+    server_handshake)."""
+    exchange_versions(sock)
     token = token if token is not None else os.environ.get(TOKEN_ENV)
     if not token:
         return

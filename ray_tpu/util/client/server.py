@@ -209,6 +209,13 @@ class ClientServer:
                 ray_tpu.kill(handle,
                              no_restart=msg.get("no_restart", True))
             return None
+        if op == "ps_pull":
+            from ray_tpu.core import api as _api
+
+            to = msg.get("timeout")
+            to = 10.0 if to is None else float(to)
+            return _api.runtime().pubsub.pull(
+                msg["channel"], msg.get("cursor", 0), min(to, 25.0))
         if op == "cluster_resources":
             return ray_tpu.cluster_resources()
         if op == "available_resources":

@@ -1,0 +1,190 @@
+"""General pubsub channels + wire protocol version negotiation.
+
+Parity: GCS pubsub (ray: src/ray/pubsub/publisher.h:307 — node/actor/
+log/error channels, long-poll subscribers) and versioned wire schemas
+(src/ray/protobuf/ — here a per-connection version preamble).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api as _api
+from ray_tpu.core.pubsub import Publisher, subscribe
+
+
+# -- publisher unit ----------------------------------------------------------
+
+
+def test_publish_pull_cursor():
+    p = Publisher(maxlen=10)
+    p.publish("c", {"n": 1})
+    p.publish("c", {"n": 2})
+    cur, msgs = p.pull("c", 0, timeout=0.1)
+    assert [m["n"] for m in msgs] == [1, 2]
+    _, empty = p.pull("c", cur, timeout=0.05)
+    assert empty == []
+    p.publish("c", {"n": 3})
+    cur2, msgs = p.pull("c", cur, timeout=0.1)
+    assert [m["n"] for m in msgs] == [3] and cur2 == cur + 1
+
+
+def test_long_poll_wakes_on_publish():
+    p = Publisher()
+    out = {}
+
+    def waiter():
+        out["r"] = p.pull("c", 0, timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    p.publish("c", "hello")
+    t.join(timeout=5)
+    assert out["r"][1] == ["hello"]
+
+
+def test_ring_bound_skips_to_retained():
+    p = Publisher(maxlen=3)
+    for i in range(10):
+        p.publish("c", i)
+    _, msgs = p.pull("c", 0, timeout=0.05)
+    assert msgs == [7, 8, 9]
+
+
+# -- runtime channels --------------------------------------------------------
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield _api.runtime()
+    ray_tpu.shutdown()
+
+
+def test_actor_lifecycle_channel(rt):
+    sub = subscribe("actor", poll_timeout=1.0)
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.options(name="pubsub-a").remote()
+    ray_tpu.get(a.ping.remote())
+    events = sub.poll(timeout=5.0)
+    assert any(e["event"] == "created" and e["name"] == "pubsub-a"
+               for e in events)
+    ray_tpu.kill(a)
+    deadline = time.time() + 10
+    died = []
+    while time.time() < deadline and not died:
+        died = [e for e in sub.poll(timeout=1.0)
+                if e["event"] == "died" and e["name"] == "pubsub-a"]
+    assert died
+
+
+def test_node_channel_carries_head_node(rt):
+    _, msgs = rt.pubsub.pull("node", 0, timeout=0.5)
+    assert any(m["event"] == "added" for m in msgs)
+
+
+def test_error_channel_on_exhausted_task(rt):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("kapow")
+
+    ref = boom.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=30)
+    deadline = time.time() + 10
+    errs = []
+    while time.time() < deadline and not errs:
+        _, errs = rt.pubsub.pull("error", 0, timeout=1.0)
+    assert any("kapow" in e["message"] for e in errs)
+
+
+def test_worker_side_subscription(rt):
+    """A task subscribes through the forwarded control op and sees the
+    node channel (parity: workers consuming GCS pubsub)."""
+
+    @ray_tpu.remote
+    def watch():
+        from ray_tpu.core.pubsub import subscribe as sub
+
+        s = sub("node", poll_timeout=5.0)
+        msgs = s.poll()
+        return [m["event"] for m in msgs]
+
+    events = ray_tpu.get(watch.remote(), timeout=60)
+    assert "added" in events
+
+
+def test_logs_channel(rt):
+    sub = subscribe("logs", poll_timeout=1.0)
+
+    @ray_tpu.remote
+    def speak():
+        print("pubsub-log-marker")
+        return True
+
+    assert ray_tpu.get(speak.remote())
+    deadline = time.time() + 15
+    hit = False
+    while time.time() < deadline and not hit:
+        for m in sub.poll(timeout=1.0):
+            if any("pubsub-log-marker" in ln for ln in m["lines"]):
+                hit = True
+    assert hit
+
+
+# -- wire version negotiation ------------------------------------------------
+
+
+def test_version_skew_rejected():
+    from ray_tpu.util.client.common import (
+        PROTOCOL_VERSION,
+        exchange_versions,
+        server_handshake,
+    )
+
+    a, b = socket.socketpair()
+    try:
+        # Peer speaks a future version.
+        b.sendall(struct.pack(">4sHH", b"RTPW", PROTOCOL_VERSION + 7, 0))
+        with pytest.raises(ConnectionError, match="version skew"):
+            exchange_versions(a)
+    finally:
+        a.close()
+        b.close()
+
+    a, b = socket.socketpair()
+    try:
+        b.sendall(b"GARBAGE!")
+        assert server_handshake(a, None) is False
+    finally:
+        a.close()
+        b.close()
+
+
+def test_matching_versions_accepted():
+    from ray_tpu.util.client.common import exchange_versions
+
+    a, b = socket.socketpair()
+    out = {}
+
+    def peer():
+        out["v"] = exchange_versions(b)
+
+    t = threading.Thread(target=peer)
+    t.start()
+    v = exchange_versions(a)
+    t.join(timeout=5)
+    assert v == out["v"]
+    a.close()
+    b.close()
