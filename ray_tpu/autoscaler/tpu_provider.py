@@ -74,14 +74,19 @@ class TPUPodProvider(NodeProvider):
 
     # -- startup -----------------------------------------------------------
 
-    def _startup_script(self) -> str:
+    def _startup_script(self, labels: Optional[Dict[str, str]] = None
+                        ) -> str:
         """Runs on EVERY worker host of the slice: join the head as a
         node daemon (multi-host slices get one daemon per host, the
-        same one-worker-per-host shape Train expects)."""
+        same one-worker-per-host shape Train expects).  ``labels`` from
+        create_node (e.g. autoscaler-v2's instance id) ride into the
+        daemon's node labels — reconciliation matches on them."""
         cfg = self.config
         token = (f"export RAYTPU_CLUSTER_TOKEN="
                  f"{shlex.quote(cfg.cluster_token)}\n"
                  if cfg.cluster_token else "")
+        extra = "".join(
+            f', \\"{k}\\": \\"{v}\\"' for k, v in (labels or {}).items())
         return (
             "#! /bin/bash\n"
             f"{token}"
@@ -90,7 +95,8 @@ class TPUPodProvider(NodeProvider):
             f"--num-tpus {cfg.num_tpus_per_host} "
             # Double quotes: $(hostname) must expand per host — the
             # slice label is each worker's identity.
-            f'--labels "{{\\"raytpu.io/tpu-slice\\": \\"$(hostname)\\"}}" '
+            f'--labels "{{\\"raytpu.io/tpu-slice\\": \\"$(hostname)\\"'
+            f'{extra}}}" '
             f">> /var/log/raytpu-node.log 2>&1 &\n"
         )
 
@@ -110,7 +116,7 @@ class TPUPodProvider(NodeProvider):
                 f"--accelerator-type={cfg.accelerator_type}",
                 f"--runtime-version={cfg.runtime_version}",
                 "--metadata",
-                f"startup-script={self._startup_script()}",
+                f"startup-script={self._startup_script(labels)}",
             ]
             if cfg.reserved:
                 cmd.append("--reserved")
@@ -123,7 +129,7 @@ class TPUPodProvider(NodeProvider):
                 f"--accelerator-type={cfg.accelerator_type}",
                 f"--version={cfg.runtime_version}",
                 "--metadata",
-                f"startup-script={self._startup_script()}",
+                f"startup-script={self._startup_script(labels)}",
             ]
             if cfg.spot:
                 cmd.append("--spot")

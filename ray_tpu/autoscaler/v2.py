@@ -1,0 +1,299 @@
+"""Autoscaler v2: instance-manager reconciliation.
+
+Parity: the reference's autoscaler v2 (ray: python/ray/autoscaler/v2/
+— instance_manager/instance_manager.py's explicit Instance records and
+state machine, reconciled against cloud + control-plane state each
+tick; src/ray/gcs/gcs_server/gcs_autoscaler_state_manager.h feeding
+cluster state).  v1 (autoscaler.py) diffs demand directly against the
+provider; v2 keeps a durable instance table whose states converge to
+reality, so drift (a VM that never joined, a node that died while the
+VM lives, a terminate that didn't stick) is REPAIRED rather than
+re-triggered blindly.
+
+Instance states (subset of instance_manager.proto's):
+
+    QUEUED      → create_node not yet issued
+    REQUESTED   → create_node issued, provider id known
+    RAY_RUNNING → the node registered with the head and is alive
+    RAY_STOPPED → control plane says dead but the provider still
+                  lists the machine → terminate it
+    TERMINATED  → gone on both planes (kept for audit, bounded)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.autoscaler.autoscaler import (
+    NodeTypeConfig,
+    ResourceDemandScheduler,
+    _runtime_load_source,
+)
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+RAY_RUNNING = "RAY_RUNNING"
+RAY_STOPPED = "RAY_STOPPED"
+TERMINATED = "TERMINATED"
+
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    state: str = QUEUED
+    provider_id: Optional[str] = None
+    node_id: Optional[str] = None       # control-plane node hex
+    launched_at: float = 0.0
+    updated_at: float = 0.0
+
+    def transition(self, state: str) -> None:
+        self.state = state
+        self.updated_at = time.monotonic()
+
+
+def node_types_of(config: Dict[str, Any]) -> List[NodeTypeConfig]:
+    out = []
+    for name, t in (config.get("worker_types") or {}).items():
+        out.append(NodeTypeConfig(
+            name=name,
+            resources=dict(t.get("resources") or {"CPU": 1}),
+            min_workers=int(t.get("min_workers", 0)),
+            max_workers=int(t.get("max_workers", 1)),
+        ))
+    return out
+
+
+class AutoscalerV2:
+    """Instance table + per-tick reconciler + demand-driven launches."""
+
+    def __init__(self, provider: NodeProvider,
+                 node_types: List[NodeTypeConfig], *,
+                 runtime=None,
+                 idle_timeout_s: float = 60.0,
+                 launch_timeout_s: float = 120.0):
+        self.provider = provider
+        self.node_types = {t.name: t for t in node_types}
+        self._runtime = runtime
+        self._sched = ResourceDemandScheduler(node_types)
+        self.idle_timeout_s = idle_timeout_s
+        self.launch_timeout_s = launch_timeout_s
+        self.instances: Dict[str, Instance] = {}
+        self._iids = itertools.count()
+        self._idle_since: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._monitor = None
+        self._max_terminated_kept = 128
+
+    def _rt(self):
+        if self._runtime is not None:
+            return self._runtime
+        from ray_tpu.core import api
+
+        return api.runtime()
+
+    # -- state views -------------------------------------------------------
+
+    def _cluster_nodes(self) -> Dict[str, Dict[str, Any]]:
+        """Alive control-plane nodes by id hex (workers only: nodes
+        carrying a node-type label or matching a tracked provider id)."""
+        out = {}
+        for row in self._rt().nodes():
+            if row["Alive"]:
+                out[row["NodeID"]] = row
+        return out
+
+    def _live_counts(self) -> Dict[str, int]:
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for inst in self.instances.values():
+                if inst.state in (QUEUED, REQUESTED, RAY_RUNNING):
+                    counts[inst.node_type] = counts.get(inst.node_type,
+                                                       0) + 1
+            return counts
+
+    # -- reconciliation ----------------------------------------------------
+
+    def reconcile(self) -> None:
+        """Converge instance states to (provider, control plane)
+        reality — the heart of v2 (parity:
+        instance_manager.py Reconciler.reconcile)."""
+        provider_nodes = self.provider.non_terminated_nodes()
+        cluster = self._cluster_nodes()
+        # Instances match cluster nodes via the instance-id label the
+        # launch stamped on the node; the FakeNodeProvider's provider
+        # id IS the node id, so that works as a fallback.
+        cluster_by_iid: Dict[str, str] = {}
+        for hexid, row in cluster.items():
+            iid = row["Labels"].get("raytpu.io/instance-id")
+            if iid:
+                cluster_by_iid[iid] = hexid
+        now = time.monotonic()
+        with self._lock:
+            for inst in self.instances.values():
+                if inst.state == TERMINATED:
+                    continue
+                pid = inst.provider_id
+                provider_alive = pid in provider_nodes if pid else False
+                node_hex = (inst.node_id
+                            or cluster_by_iid.get(inst.instance_id)
+                            or (pid if pid in cluster else None))
+                node_alive = node_hex in cluster if node_hex else False
+                if node_alive:
+                    inst.node_id = node_hex
+                    if inst.state != RAY_RUNNING:
+                        inst.transition(RAY_RUNNING)
+                    continue
+                if inst.state == RAY_RUNNING:
+                    # Node died.  Machine still up → stop it first.
+                    inst.transition(RAY_STOPPED if provider_alive
+                                    else TERMINATED)
+                    continue
+                if inst.state == REQUESTED:
+                    if not provider_alive:
+                        inst.transition(TERMINATED)  # launch failed
+                    elif now - inst.launched_at > self.launch_timeout_s:
+                        # Provisioned but never registered: repair by
+                        # terminating; demand relaunches next tick.
+                        try:
+                            self.provider.terminate_node(pid)
+                        except Exception:
+                            pass
+                        inst.transition(TERMINATED)
+                if inst.state == RAY_STOPPED:
+                    if provider_alive:
+                        try:
+                            self.provider.terminate_node(pid)
+                            inst.transition(TERMINATED)
+                        except Exception:
+                            pass  # retried next tick
+                    else:
+                        inst.transition(TERMINATED)
+            # Bound the audit tail of TERMINATED records.
+            dead = sorted(
+                (i for i in self.instances.values()
+                 if i.state == TERMINATED),
+                key=lambda i: i.updated_at)
+            for inst in dead[: max(0, len(dead)
+                                   - self._max_terminated_kept)]:
+                del self.instances[inst.instance_id]
+
+    # -- scaling -----------------------------------------------------------
+
+    def update(self) -> Dict[str, Any]:
+        """One tick: reconcile, then launch to cover min_workers +
+        unfulfilled demand within max_workers."""
+        self.reconcile()
+        live = self._live_counts()
+        to_launch: Dict[str, int] = {}
+        # Floor: min_workers per type.
+        for name, t in self.node_types.items():
+            missing = t.min_workers - live.get(name, 0)
+            if missing > 0:
+                to_launch[name] = missing
+        # Demand: unfulfilled resource asks (same scheduler as v1).
+        try:
+            demands = _runtime_load_source(self._rt())
+        except Exception:
+            demands = []
+        if demands:
+            gmax = sum(t.max_workers for t in self.node_types.values())
+            merged = {k: live.get(k, 0) + to_launch.get(k, 0)
+                      for k in set(live) | set(to_launch)}
+            extra = self._sched.get_nodes_to_launch(
+                demands, merged, gmax)
+            for name, n in extra.items():
+                to_launch[name] = to_launch.get(name, 0) + n
+        launched: List[str] = []
+        for name, n in to_launch.items():
+            t = self.node_types[name]
+            for _ in range(n):
+                if (self._live_counts().get(name, 0)
+                        >= t.max_workers):
+                    break
+                inst = Instance(f"i-{next(self._iids)}", name,
+                                launched_at=time.monotonic())
+                with self._lock:
+                    self.instances[inst.instance_id] = inst
+                try:
+                    pid = self.provider.create_node(
+                        name, dict(t.resources),
+                        {"raytpu.io/instance-id": inst.instance_id})
+                except Exception:
+                    inst.transition(TERMINATED)
+                    continue
+                inst.provider_id = pid
+                inst.transition(REQUESTED)
+                launched.append(inst.instance_id)
+        downed = self._scale_down_idle()
+        return {
+            "launched": launched,
+            "terminated_idle": downed,
+            "states": {i.instance_id: i.state
+                       for i in self.instances.values()},
+        }
+
+    def _scale_down_idle(self) -> List[str]:
+        """Terminate RAY_RUNNING instances above their type's
+        min_workers once idle (no running work, no actors) for
+        idle_timeout_s (parity: v1's idle reaper, through the instance
+        table)."""
+        rt = self._rt()
+        now = time.monotonic()
+        with rt._lock:
+            busy = {n.node_id.hex(): (n.pool.utilization() > 0
+                                      or bool(n.actor_ids))
+                    for n in rt._nodes.values() if n.alive}
+        downed: List[str] = []
+        with self._lock:
+            counts: Dict[str, int] = {}
+            running = [i for i in self.instances.values()
+                       if i.state == RAY_RUNNING]
+            for i in running:
+                counts[i.node_type] = counts.get(i.node_type, 0) + 1
+            for inst in running:
+                if inst.node_id is None or busy.get(inst.node_id, True):
+                    self._idle_since.pop(inst.instance_id, None)
+                    continue
+                since = self._idle_since.setdefault(inst.instance_id,
+                                                    now)
+                t = self.node_types.get(inst.node_type)
+                floor = t.min_workers if t else 0
+                if (now - since >= self.idle_timeout_s
+                        and counts.get(inst.node_type, 0) > floor):
+                    try:
+                        self.provider.terminate_node(inst.provider_id)
+                    except Exception:
+                        continue
+                    inst.transition(TERMINATED)
+                    counts[inst.node_type] -= 1
+                    downed.append(inst.instance_id)
+                    self._idle_since.pop(inst.instance_id, None)
+        return downed
+
+    # -- monitor -----------------------------------------------------------
+
+    def start_monitor(self, period_s: float = 5.0) -> "AutoscalerV2":
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(period_s):
+                try:
+                    self.update()
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="autoscaler-v2")
+        t.start()
+        self._monitor = (stop, t)
+        return self
+
+    def stop(self) -> None:
+        if self._monitor is not None:
+            self._monitor[0].set()

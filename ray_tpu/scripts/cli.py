@@ -87,6 +87,30 @@ def cmd_list(args, out) -> int:
     return 0
 
 
+def cmd_up(args, out) -> int:
+    """Launch a cluster from a YAML config: head in THIS process,
+    workers via the config's provider (parity: `ray up cluster.yaml`)."""
+    from ray_tpu.autoscaler.launcher import up
+
+    cluster = up(args.config)
+    from ray_tpu.core import api as _api
+
+    n = sum(1 for x in _api.runtime().nodes() if x["Alive"])
+    print(f"cluster up: {n} nodes (join port "
+          f"{cluster.node_server.port})", file=out, flush=True)
+    if args.block:
+        import signal
+
+        try:
+            signal.pause()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            cluster.down()
+            print("cluster down", file=out)
+    return 0
+
+
 def cmd_logs(args, out) -> int:
     """Tail cluster worker logs from the head's log buffer (parity:
     `ray logs` / the dashboard log view, dashboard/modules/log/)."""
@@ -274,6 +298,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("summary", help="task summary by function and state")
 
+    upp = sub.add_parser(
+        "up", help="launch a cluster from a YAML config (head here, "
+                   "workers via the provider)")
+    upp.add_argument("config", help="cluster YAML/JSON config path")
+    upp.add_argument("--block", action="store_true", default=True)
+    upp.add_argument("--no-block", dest="block", action="store_false")
+
     lg = sub.add_parser("logs", help="tail cluster worker logs")
     lg.add_argument("--node", default="", help="node id prefix filter")
     lg.add_argument("--file", default="", help="log file substring filter")
@@ -338,6 +369,7 @@ _DISPATCH = {
     "list": cmd_list,
     "summary": cmd_summary,
     "logs": cmd_logs,
+    "up": cmd_up,
     "timeline": cmd_timeline,
     "memory": cmd_memory,
     "job": cmd_job,
