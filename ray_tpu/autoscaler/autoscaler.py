@@ -87,6 +87,35 @@ class ResourceDemandScheduler:
         return to_launch
 
 
+def unfulfilled_demands(runtime, demands: List[Dict[str, float]]
+                        ) -> List[Dict[str, float]]:
+    """Demands no live node can currently satisfy from its *available*
+    pool — simulated placement against a snapshot (parity: the
+    scheduler's fit check before bin-packing).  Shared by v1 and v2."""
+    with runtime._lock:
+        avail = [dict(n.pool.available)
+                 for n in runtime._nodes.values() if n.alive]
+    out = []
+    for d in demands:
+        for pool in avail:
+            if all(pool.get(k, 0) >= v for k, v in d.items()):
+                for k, v in d.items():
+                    pool[k] = pool.get(k, 0) - v
+                break
+        else:
+            out.append(d)
+    return out
+
+
+def node_busy_map(runtime) -> Dict[str, bool]:
+    """node hex → has running work or actors (the idle-reaper's
+    busy test, shared by v1 and v2)."""
+    with runtime._lock:
+        return {n.node_id.hex(): (n.pool.utilization() > 0
+                                  or bool(n.actor_ids))
+                for n in runtime._nodes.values() if n.alive}
+
+
 def _runtime_load_source(runtime) -> List[Dict[str, float]]:
     """Pending resource demands the cluster can't place right now:
     queued task demands + unplaced PG bundles (parity: the load the
@@ -137,23 +166,7 @@ class StandardAutoscaler:
 
     def _unfulfilled(self, demands: List[Dict[str, float]]
                      ) -> List[Dict[str, float]]:
-        """Demands no live node can currently satisfy from its
-        *available* pool — simulated placement against a snapshot
-        (parity: the scheduler's fit check before bin-packing)."""
-        rt = self._rt()
-        with rt._lock:
-            avail = [dict(n.pool.available)
-                     for n in rt._nodes.values() if n.alive]
-        out = []
-        for d in demands:
-            for pool in avail:
-                if all(pool.get(k, 0) >= v for k, v in d.items()):
-                    for k, v in d.items():
-                        pool[k] = pool.get(k, 0) - v
-                    break
-            else:
-                out.append(d)
-        return out
+        return unfulfilled_demands(self._rt(), demands)
 
     def update(self) -> Tuple[Dict[str, int], List[str]]:
         """One reconcile round; returns (launched_by_type,
@@ -197,12 +210,8 @@ class StandardAutoscaler:
 
     def _terminate_idle(self, current: Dict[str, str],
                         counts: Dict[str, int]) -> List[str]:
-        rt = self._rt()
         now = time.monotonic()
-        with rt._lock:
-            busy = {n.node_id.hex(): (n.pool.utilization() > 0
-                                      or bool(n.actor_ids))
-                    for n in rt._nodes.values() if n.alive}
+        busy = node_busy_map(self._rt())
         terminated: List[str] = []
         for pid, type_name in list(current.items()):
             if busy.get(pid, True):

@@ -85,6 +85,18 @@ class TPUPodProvider(NodeProvider):
         token = (f"export RAYTPU_CLUSTER_TOKEN="
                  f"{shlex.quote(cfg.cluster_token)}\n"
                  if cfg.cluster_token else "")
+        # Labels interpolate into JSON inside a double-quoted bash
+        # string: restrict to shell- and JSON-inert characters rather
+        # than attempt nested escaping (a quote or $() in a label would
+        # otherwise be a shell injection on the TPU VM).
+        import re
+
+        safe = re.compile(r"^[A-Za-z0-9_./\-]+$")
+        for k, v in (labels or {}).items():
+            if not safe.match(str(k)) or not safe.match(str(v)):
+                raise ValueError(
+                    f"node label {k!r}={v!r} contains characters unsafe "
+                    f"for the startup script (allowed: [A-Za-z0-9_./-])")
         extra = "".join(
             f', \\"{k}\\": \\"{v}\\"' for k, v in (labels or {}).items())
         return (

@@ -32,6 +32,8 @@ from ray_tpu.autoscaler.autoscaler import (
     NodeTypeConfig,
     ResourceDemandScheduler,
     _runtime_load_source,
+    node_busy_map,
+    unfulfilled_demands,
 )
 from ray_tpu.autoscaler.node_provider import NodeProvider
 
@@ -160,11 +162,14 @@ class AutoscalerV2:
                     elif now - inst.launched_at > self.launch_timeout_s:
                         # Provisioned but never registered: repair by
                         # terminating; demand relaunches next tick.
+                        # Only mark TERMINATED once the terminate call
+                        # SUCCEEDS — otherwise the live machine would
+                        # fall off the books forever.
                         try:
                             self.provider.terminate_node(pid)
+                            inst.transition(TERMINATED)
                         except Exception:
-                            pass
-                        inst.transition(TERMINATED)
+                            pass  # retried next tick
                 if inst.state == RAY_STOPPED:
                     if provider_alive:
                         try:
@@ -198,7 +203,11 @@ class AutoscalerV2:
                 to_launch[name] = missing
         # Demand: unfulfilled resource asks (same scheduler as v1).
         try:
-            demands = _runtime_load_source(self._rt())
+            # Only demands live nodes can't place from FREE capacity —
+            # without the filter every submit-vs-tick race launches a
+            # node for work that places itself moments later.
+            demands = unfulfilled_demands(
+                self._rt(), _runtime_load_source(self._rt()))
         except Exception:
             demands = []
         if demands:
@@ -243,12 +252,8 @@ class AutoscalerV2:
         min_workers once idle (no running work, no actors) for
         idle_timeout_s (parity: v1's idle reaper, through the instance
         table)."""
-        rt = self._rt()
         now = time.monotonic()
-        with rt._lock:
-            busy = {n.node_id.hex(): (n.pool.utilization() > 0
-                                      or bool(n.actor_ids))
-                    for n in rt._nodes.values() if n.alive}
+        busy = node_busy_map(self._rt())
         downed: List[str] = []
         with self._lock:
             counts: Dict[str, int] = {}
@@ -296,4 +301,8 @@ class AutoscalerV2:
 
     def stop(self) -> None:
         if self._monitor is not None:
-            self._monitor[0].set()
+            stop, thread = self._monitor
+            stop.set()
+            # Join: an in-flight update() could otherwise launch nodes
+            # AFTER the caller's teardown terminated everything.
+            thread.join(timeout=30.0)

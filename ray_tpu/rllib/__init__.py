@@ -13,8 +13,9 @@ Distributed sampling uses EnvRunner actors over ray_tpu.core.
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.algorithms import (DQN, IMPALA, PPO, SAC, DQNConfig,
-                                      IMPALAConfig, PPOConfig, SACConfig,
+from ray_tpu.rllib.algorithms import (A2C, DQN, IMPALA, PPO, SAC, TD3,
+                                      A2CConfig, DQNConfig, IMPALAConfig,
+                                      PPOConfig, SACConfig, TD3Config,
                                       vtrace)
 from ray_tpu.rllib.env import (CartPole, ExternalEnv, Pendulum, make_env,
                                register_env)
@@ -24,7 +25,15 @@ from ray_tpu.rllib.multi_agent import (MultiAgentPPO, MultiAgentPPOConfig,
                                        TwoAgentReach)
 from ray_tpu.rllib.offline import (BC, BCConfig, CQL, CQLConfig,
                                    OfflineDataset)
-from ray_tpu.rllib.replay_buffer import DeviceReplayBuffer, HostReplayBuffer
+from ray_tpu.rllib.connectors import (ClipActions, Connector,
+                                      ConnectorPipeline,
+                                      FlattenObservations, FrameStack,
+                                      MeanStdFilter)
+from ray_tpu.rllib.evaluation import EvaluationWorkerSet
+from ray_tpu.rllib.replay_buffer import (DeviceReplayBuffer,
+                                         EpisodeReplayBuffer,
+                                         HostReplayBuffer,
+                                         PrioritizedDeviceReplayBuffer)
 
 __all__ = [
     "Algorithm", "AlgorithmConfig",
@@ -35,5 +44,10 @@ __all__ = [
     "vtrace",
     "CartPole", "Pendulum", "ExternalEnv", "make_env", "register_env",
     "EnvRunnerGroup", "ActorCritic",
+    "A2C", "A2CConfig", "TD3", "TD3Config",
     "DeviceReplayBuffer", "HostReplayBuffer",
+    "PrioritizedDeviceReplayBuffer", "EpisodeReplayBuffer",
+    "Connector", "ConnectorPipeline", "FlattenObservations",
+    "ClipActions", "MeanStdFilter", "FrameStack",
+    "EvaluationWorkerSet",
 ]

@@ -1,8 +1,11 @@
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.grpo import GRPO, GRPOConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config
 
-__all__ = ["GRPO", "GRPOConfig", "PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA",
-           "IMPALAConfig", "vtrace", "SAC", "SACConfig"]
+__all__ = ["A2C", "A2CConfig", "GRPO", "GRPOConfig", "PPO", "PPOConfig",
+           "DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "vtrace",
+           "SAC", "SACConfig", "TD3", "TD3Config"]

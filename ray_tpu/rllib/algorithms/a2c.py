@@ -1,0 +1,104 @@
+"""A2C — synchronous advantage actor-critic, one-jit-per-iteration.
+
+Parity target: the reference's A2C (ray: rllib/algorithms/a2c/ —
+PPO's machinery minus the clipped surrogate: a single on-policy
+gradient step per rollout with n-step/GAE advantages).  Shares this
+build's sampler (lax.scan rollouts + GAE) so one iteration is one XLA
+program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sampler
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO
+from ray_tpu.rllib.models import ActorCritic
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 7e-4
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.lambda_ = 1.0           # plain n-step returns by default
+        self.grad_clip = 0.5
+
+    @property
+    def algo_class(self):
+        return A2C
+
+
+class A2C(PPO):
+    """Reuses PPO's setup/serve surface; only the iteration differs
+    (single unclipped policy-gradient update, no epochs/minibatches)."""
+
+    config_class = A2CConfig
+
+    def _setup(self) -> None:
+        cfg = self.config
+        env = self.env
+        self.net = ActorCritic(
+            env.observation_size, env.action_size,
+            discrete=env.discrete, hidden=cfg.hidden,
+        )
+        key = jax.random.key(cfg.seed)
+        key, k_init, k_reset = jax.random.split(key, 3)
+        self.params = self.net.init(k_init)
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr),
+        )
+        self.opt_state = self.tx.init(self.params)
+        reset_keys = jax.random.split(k_reset, cfg.num_envs)
+        self.env_state, self.obs = jax.vmap(env.reset)(reset_keys)
+        self.ep_ret = jnp.zeros(cfg.num_envs)
+        self.ep_len = jnp.zeros(cfg.num_envs, jnp.int32)
+        self.key = key
+        scfg = (cfg.rollout_length, cfg.vf_loss_coeff, cfg.entropy_coeff,
+                cfg.gamma, cfg.lambda_)
+        self._iteration_fn = jax.jit(
+            partial(_a2c_iteration, env, self.net, self.tx, scfg))
+
+
+def _a2c_iteration(env, net, tx, scfg, params, opt_state, env_state, obs,
+                   ep_ret, ep_len, key):
+    T, vf_coef, ent_coef, gamma, lam = scfg
+    k_roll, _ = jax.random.split(key)
+    env_state, obs, ep_ret, ep_len, roll = sampler.unroll(
+        env, net, params, env_state, obs, ep_ret, ep_len, k_roll, T
+    )
+    advs, returns = sampler.gae(
+        roll.reward, roll.done, roll.value, roll.last_value,
+        gamma=gamma, lam=lam,
+    )
+    n = roll.obs.shape[0] * roll.obs.shape[1]
+    flat = lambda x: x.reshape((n,) + x.shape[2:])
+    b_obs, b_act = flat(roll.obs), flat(roll.action)
+    b_adv, b_ret = flat(advs), flat(returns)
+
+    def loss_fn(p):
+        dist = net.action_dist(p, b_obs)
+        logp = dist.log_prob(b_act)
+        pg_loss = -jnp.mean(logp * b_adv)
+        value = net.value(p, b_obs)
+        vf_loss = jnp.mean((value - b_ret) ** 2)
+        entropy = jnp.mean(dist.entropy())
+        total = pg_loss + vf_coef * vf_loss - ent_coef * entropy
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    metrics = {"total_loss": loss, **aux,
+               **sampler.episode_stats(roll)}
+    return params, opt_state, env_state, obs, ep_ret, ep_len, metrics

@@ -1,0 +1,265 @@
+"""TD3 — twin-delayed DDPG for continuous control.
+
+Parity target: the reference's TD3/DDPG family (ray:
+rllib/algorithms/td3/ — deterministic actor, twin Q critics with a
+min-backup, target-policy smoothing noise, delayed actor updates).
+Same TPU execution model as SAC here: device-resident replay buffer,
+K env steps interleaved with updates inside one lax.scan, one jit per
+training iteration.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import apply_mlp, init_mlp
+from ray_tpu.rllib.replay_buffer import DeviceReplayBuffer
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env = "Pendulum-v1"
+        self.lr = 3e-4
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1_000
+        self.train_batch_size = 256
+        self.tau = 0.005
+        self.exploration_noise = 0.1       # σ of behavior noise
+        self.target_noise = 0.2            # smoothing σ on target action
+        self.noise_clip = 0.5
+        self.policy_delay = 2              # critic updates per actor update
+        self.action_scale: float = None
+        self.steps_per_iteration = 256
+        self.num_envs = 8
+        self.hidden = (128, 128)
+
+    @property
+    def algo_class(self):
+        return TD3
+
+
+def _pi(params, obs, scale):
+    return jnp.tanh(apply_mlp(params, obs)) * scale
+
+
+def _q(params, obs, act):
+    return jnp.squeeze(
+        apply_mlp(params, jnp.concatenate([obs, act], axis=-1)), -1)
+
+
+class TD3(Algorithm):
+    config_class = TD3Config
+
+    def _setup(self) -> None:
+        cfg = self.config
+        env = self.env
+        if env.discrete:
+            raise ValueError("TD3 targets continuous action spaces")
+        obs_dim, act_dim = env.observation_size, env.action_size
+        if cfg.action_scale is None:
+            cfg.action_scale = float(getattr(env, "max_torque", 1.0))
+        key = jax.random.key(cfg.seed)
+        key, ka, k1, k2, kr = jax.random.split(key, 5)
+        self.params = {
+            "actor": init_mlp(ka, obs_dim, cfg.hidden, act_dim,
+                              final_scale=0.01),
+            "q1": init_mlp(k1, obs_dim + act_dim, cfg.hidden, 1,
+                           final_scale=1.0),
+            "q2": init_mlp(k2, obs_dim + act_dim, cfg.hidden, 1,
+                           final_scale=1.0),
+        }
+        self.target = jax.tree.map(lambda x: x, self.params)
+        # SEPARATE actor/critic optimizers: one shared Adam would keep
+        # nudging the actor from retained momentum on critic-only
+        # steps, silently defeating policy_delay.
+        self.tx_actor = optax.adam(cfg.lr)
+        self.tx_critic = optax.adam(cfg.lr)
+        self.opt_state = (
+            self.tx_actor.init(self.params["actor"]),
+            self.tx_critic.init({"q1": self.params["q1"],
+                                 "q2": self.params["q2"]}),
+        )
+        self.buffer = DeviceReplayBuffer(cfg.buffer_capacity, {
+            "obs": ((obs_dim,), jnp.float32),
+            "action": ((act_dim,), jnp.float32),
+            "reward": ((), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "done": ((), jnp.float32),
+        })
+        self.buf_state = self.buffer.init()
+        reset_keys = jax.random.split(kr, cfg.num_envs)
+        self.env_state, self.obs = jax.vmap(env.reset)(reset_keys)
+        self.ep_ret = jnp.zeros(cfg.num_envs)
+        self.total_env_steps = jnp.zeros((), jnp.int32)
+        self.key = key
+        scfg = (cfg.steps_per_iteration, cfg.train_batch_size, cfg.gamma,
+                cfg.tau, cfg.exploration_noise, cfg.target_noise,
+                cfg.noise_clip, cfg.policy_delay, cfg.action_scale,
+                cfg.learning_starts)
+        self._iteration_fn = jax.jit(
+            partial(_td3_iteration, env, self.buffer,
+                    (self.tx_actor, self.tx_critic), scfg))
+
+    def _train_once(self) -> Dict[str, Any]:
+        self.key, it_key = jax.random.split(self.key)
+        (self.params, self.target, self.opt_state, self.buf_state,
+         self.env_state, self.obs, self.ep_ret, self.total_env_steps,
+         metrics) = self._iteration_fn(
+            self.params, self.target, self.opt_state, self.buf_state,
+            self.env_state, self.obs, self.ep_ret, self.total_env_steps,
+            it_key,
+        )
+        out = {k: float(v) for k, v in metrics.items()}
+        out["_timesteps"] = (self.config.steps_per_iteration
+                             * self.config.num_envs)
+        return out
+
+    def compute_single_action(self, obs, explore: bool = False):
+        cfg = self.config
+        obs = jnp.asarray(obs)[None]
+        a = _pi(self.params["actor"], obs, cfg.action_scale)[0]
+        if explore:
+            self.key, k = jax.random.split(self.key)
+            a = a + cfg.exploration_noise * cfg.action_scale \
+                * jax.random.normal(k, a.shape)
+            a = jnp.clip(a, -cfg.action_scale, cfg.action_scale)
+        return np.asarray(a)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "params": jax.device_get(self.params),
+            "target": jax.device_get(self.target),
+            "opt_state": jax.device_get(self.opt_state),
+            "iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "total_env_steps": int(self.total_env_steps),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.target = jax.device_put(state["target"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+        self.total_env_steps = jnp.asarray(state["total_env_steps"],
+                                           jnp.int32)
+
+
+def _td3_iteration(env, buffer, txs, scfg, params, target, opt_state,
+                   buf_state, env_state, obs, ep_ret, total_steps, key):
+    tx_actor, tx_critic = txs
+    (T, batch_size, gamma, tau, expl_noise, tgt_noise, noise_clip,
+     policy_delay, scale, learning_starts) = scfg
+    n_envs = obs.shape[0]
+    v_step = jax.vmap(env.step)
+    v_reset = jax.vmap(env.reset)
+
+    def critic_loss_fn(q_params, actor_params, tgt, mb, k):
+        noise = jnp.clip(
+            tgt_noise * scale * jax.random.normal(
+                k, mb["action"].shape),
+            -noise_clip * scale, noise_clip * scale)
+        a_next = jnp.clip(
+            _pi(tgt["actor"], mb["next_obs"], scale) + noise,
+            -scale, scale)
+        q_next = jnp.minimum(
+            _q(tgt["q1"], mb["next_obs"], a_next),
+            _q(tgt["q2"], mb["next_obs"], a_next))
+        y = lax.stop_gradient(
+            mb["reward"] + gamma * (1 - mb["done"]) * q_next)
+        q1 = _q(q_params["q1"], mb["obs"], mb["action"])
+        q2 = _q(q_params["q2"], mb["obs"], mb["action"])
+        return jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+
+    def actor_loss_fn(actor_params, q1_params, mb):
+        a_pi = _pi(actor_params, mb["obs"], scale)
+        return -jnp.mean(_q(q1_params, mb["obs"], a_pi))
+
+    def one_step(carry, step_key):
+        (params, target, opt_state, buf_state, env_state, obs, ep_ret,
+         total_steps, ret_sum, ret_cnt) = carry
+        k_act, k_reset, k_sample, k_loss = jax.random.split(step_key, 4)
+        a = _pi(params["actor"], obs, scale)
+        a = jnp.clip(
+            a + expl_noise * scale
+            * jax.random.normal(k_act, a.shape),
+            -scale, scale)
+        next_env_state, next_obs, reward, done = v_step(env_state, a)
+        buf_state = buffer.add_batch(buf_state, {
+            "obs": obs, "action": a, "reward": reward,
+            "next_obs": next_obs, "done": done.astype(jnp.float32),
+        })
+        ep_ret = ep_ret + reward
+        ret_sum = ret_sum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+        ret_cnt = ret_cnt + jnp.sum(done)
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        reset_keys = jax.random.split(k_reset, n_envs)
+        r_state, r_obs = v_reset(reset_keys)
+        next_env_state = jax.tree_util.tree_map(
+            lambda r, c: jnp.where(
+                jnp.reshape(done, done.shape + (1,) * (r.ndim - 1)),
+                r, c),
+            r_state, next_env_state)
+        next_obs = jnp.where(done[:, None], r_obs, next_obs)
+        total_steps = total_steps + n_envs
+        update_actor = ((total_steps // n_envs) % policy_delay == 0
+                        ).astype(jnp.float32)
+
+        def do_update(args):
+            params, target, opt_state = args
+            actor_opt, critic_opt = opt_state
+            mb = buffer.sample(buf_state, k_sample, batch_size)
+            qp = {"q1": params["q1"], "q2": params["q2"]}
+            closs, cgrads = jax.value_and_grad(critic_loss_fn)(
+                qp, params["actor"], target, mb, k_loss)
+            cupd, critic_opt = tx_critic.update(cgrads, critic_opt, qp)
+            qp = optax.apply_updates(qp, cupd)
+            params = {**params, "q1": qp["q1"], "q2": qp["q2"]}
+
+            def upd_actor(args2):
+                actor_p, actor_opt = args2
+                agrads = jax.grad(actor_loss_fn)(
+                    actor_p, lax.stop_gradient(params["q1"]), mb)
+                aupd, actor_opt = tx_actor.update(agrads, actor_opt,
+                                                  actor_p)
+                return optax.apply_updates(actor_p, aupd), actor_opt
+
+            actor_p, actor_opt = lax.cond(
+                update_actor > 0, upd_actor, lambda a: a,
+                (params["actor"], actor_opt))
+            params = {**params, "actor": actor_p}
+            target = jax.tree_util.tree_map(
+                lambda t, o: (1 - tau) * t + tau * o, target, params)
+            return params, target, (actor_opt, critic_opt), closs
+
+        should = buf_state.size >= learning_starts
+        params, target, opt_state, closs = lax.cond(
+            should, do_update,
+            lambda args: (args[0], args[1], args[2], jnp.float32(0.0)),
+            (params, target, opt_state))
+        carry = (params, target, opt_state, buf_state, next_env_state,
+                 next_obs, ep_ret, total_steps, ret_sum, ret_cnt)
+        return carry, closs
+
+    step_keys = jax.random.split(key, T)
+    init = (params, target, opt_state, buf_state, env_state, obs,
+            ep_ret, total_steps, jnp.float32(0.0), jnp.int32(0))
+    (params, target, opt_state, buf_state, env_state, obs, ep_ret,
+     total_steps, ret_sum, ret_cnt), closses = lax.scan(
+        one_step, init, step_keys)
+    metrics = {
+        "episode_return_mean": jnp.where(
+            ret_cnt > 0, ret_sum / jnp.maximum(ret_cnt, 1), jnp.nan),
+        "critic_loss_mean": jnp.mean(closses),
+    }
+    return (params, target, opt_state, buf_state, env_state, obs,
+            ep_ret, total_steps, metrics)

@@ -1,0 +1,109 @@
+"""Connector pipelines: composable observation/action transforms.
+
+Parity: the reference's connector framework (ray: rllib/connectors/ —
+env-to-module and module-to-env pipelines of small stateful
+transforms).  TPU-first twist: connectors are pure functions over
+(data, state) so a pipeline can run INSIDE a jitted rollout (the
+reference's run as Python between env and torch module); stateful ones
+(running mean/std) thread their state explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Connector:
+    """One transform.  init_state() → pytree; __call__(x, state) →
+    (x', state')."""
+
+    def init_state(self) -> Any:
+        return ()
+
+    def __call__(self, x: jax.Array, state: Any) -> Tuple[jax.Array, Any]:
+        raise NotImplementedError
+
+
+class FlattenObservations(Connector):
+    def __call__(self, x, state):
+        return x.reshape((x.shape[0], -1)) if x.ndim > 2 else x, state
+
+
+class ClipActions(Connector):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, x, state):
+        return jnp.clip(x, self.low, self.high), state
+
+
+class MeanStdState(NamedTuple):
+    mean: jax.Array
+    var: jax.Array
+    count: jax.Array
+
+
+class MeanStdFilter(Connector):
+    """Running observation normalization (parity: the reference's
+    MeanStdFilter connector) — Welford update, jittable."""
+
+    def __init__(self, shape: Sequence[int], clip: float = 10.0):
+        self.shape = tuple(shape)
+        self.clip = clip
+
+    def init_state(self) -> MeanStdState:
+        return MeanStdState(jnp.zeros(self.shape), jnp.ones(self.shape),
+                            jnp.ones(()))
+
+    def __call__(self, x, state: MeanStdState):
+        bmean = jnp.mean(x, axis=0)
+        bvar = jnp.var(x, axis=0)
+        bn = jnp.float32(x.shape[0])
+        delta = bmean - state.mean
+        tot = state.count + bn
+        mean = state.mean + delta * bn / tot
+        m_a = state.var * state.count
+        m_b = bvar * bn
+        var = (m_a + m_b + delta ** 2 * state.count * bn / tot) / tot
+        out = jnp.clip((x - mean) / jnp.sqrt(var + 1e-8),
+                       -self.clip, self.clip)
+        return out, MeanStdState(mean, var, tot)
+
+
+class FrameStack(Connector):
+    """Stack the last k observations along the feature axis."""
+
+    def __init__(self, k: int, obs_shape: Sequence[int]):
+        self.k = k
+        self.obs_shape = tuple(obs_shape)
+
+    def init_state(self):
+        return jnp.zeros((self.k,) + self.obs_shape)
+
+    def __call__(self, x, state):
+        # x [B, ...] with B == 1 conceptually per env; vectorized envs
+        # should vmap the pipeline.
+        state = jnp.concatenate([state[1:], x[None, 0]], axis=0)
+        out = state.reshape((1, -1))
+        return jnp.broadcast_to(out, (x.shape[0], out.shape[-1])), state
+
+
+class ConnectorPipeline:
+    """Ordered connectors with one combined state pytree."""
+
+    def __init__(self, connectors: List[Connector]):
+        self.connectors = list(connectors)
+
+    def init_state(self) -> Tuple[Any, ...]:
+        return tuple(c.init_state() for c in self.connectors)
+
+    def __call__(self, x, state: Tuple[Any, ...]):
+        out_states = []
+        for c, s in zip(self.connectors, state):
+            x, s2 = c(x, s)
+            out_states.append(s2)
+        return x, tuple(out_states)

@@ -1,0 +1,94 @@
+"""Evaluation worker set: parallel greedy-policy evaluation via actors.
+
+Parity: the reference's evaluation workers (ray:
+rllib/evaluation/worker_set.py:80 — a separate WorkerSet running the
+current weights for evaluation episodes, in parallel with training).
+Workers are ray_tpu actors; weights ship as plain host arrays through
+the object plane; each worker jits its env loop on the CPU backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=1)
+class _EvalWorker:
+    """One evaluation runner: rebuilds env + net from specs, runs
+    greedy episodes with pushed weights."""
+
+    def __init__(self, env_name: str, env_config: Optional[dict],
+                 hidden, seed: int):
+        import jax
+
+        from ray_tpu.rllib.env import make_env
+        from ray_tpu.rllib.models import ActorCritic
+
+        self.env = make_env(env_name, **(env_config or {}))
+        self.net = ActorCritic(self.env.observation_size,
+                               self.env.action_size,
+                               discrete=self.env.discrete, hidden=hidden)
+        self._step = jax.jit(self.env.step)
+        self.seed = seed
+
+    def run_episodes(self, params: Any, n: int) -> List[float]:
+        import jax
+
+        params = jax.device_put(params)
+        rets = []
+        key = jax.random.key(self.seed)
+        for i in range(n):
+            key, k = jax.random.split(key)
+            state, obs = self.env.reset(k)
+            total, done = 0.0, False
+            while not done:
+                a = self.net.action_dist(params, obs).mode()
+                state, obs, r, d = self._step(state, a)
+                total += float(r)
+                done = bool(d)
+            rets.append(total)
+        return rets
+
+
+class EvaluationWorkerSet:
+    """N parallel evaluation actors sharing episode load (parity:
+    WorkerSet.foreach_worker over evaluation workers)."""
+
+    def __init__(self, env_name: str, *, num_workers: int = 2,
+                 env_config: Optional[dict] = None, hidden=(64, 64),
+                 seed: int = 0):
+        self.workers = [
+            _EvalWorker.remote(env_name, env_config, tuple(hidden),
+                               seed + 1000 * (i + 1))
+            for i in range(max(1, num_workers))
+        ]
+
+    def evaluate(self, params: Any, num_episodes: int = 10,
+                 timeout_s: float = 300.0) -> Dict[str, Any]:
+        import jax
+
+        host_params = jax.device_get(params)
+        per = -(-num_episodes // len(self.workers))
+        refs = [w.run_episodes.remote(host_params, per)
+                for w in self.workers]
+        rets: List[float] = []
+        for chunk in ray_tpu.get(refs, timeout=timeout_s):
+            rets.extend(chunk)
+        rets = rets[:num_episodes]
+        return {
+            "evaluation_episode_return_mean": float(np.mean(rets)),
+            "evaluation_episode_return_min": float(np.min(rets)),
+            "evaluation_episode_return_max": float(np.max(rets)),
+            "evaluation_num_episodes": len(rets),
+        }
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

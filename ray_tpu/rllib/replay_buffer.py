@@ -63,6 +63,122 @@ class DeviceReplayBuffer:
         return {k: v[idx] for k, v in state.data.items()}
 
 
+class PrioritizedState(NamedTuple):
+    base: BufferState
+    priority: jax.Array  # [capacity] float32 (0 = empty slot)
+
+
+class PrioritizedDeviceReplayBuffer:
+    """Proportional prioritized replay, fully jittable (parity:
+    rllib/utils/replay_buffers/prioritized_replay_buffer.py — there a
+    host-side sum tree; here sampling draws a Gumbel-top-k over
+    log-priorities, equivalent to sampling without replacement
+    proportional to p^alpha, and stays on device)."""
+
+    def __init__(self, capacity: int,
+                 specs: Dict[str, Tuple[tuple, Any]],
+                 *, alpha: float = 0.6, beta: float = 0.4,
+                 eps: float = 1e-6):
+        self._ring = DeviceReplayBuffer(capacity, specs)
+        self.capacity = capacity
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+
+    def init(self) -> PrioritizedState:
+        return PrioritizedState(
+            self._ring.init(), jnp.zeros((self.capacity,), jnp.float32))
+
+    def add_batch(self, state: PrioritizedState,
+                  batch: Dict[str, jax.Array]) -> PrioritizedState:
+        """New transitions enter at MAX current priority (the standard
+        bias toward replaying the newest data at least once)."""
+        n = next(iter(batch.values())).shape[0]
+        idx = (state.base.ptr + jnp.arange(n)) % self.capacity
+        pmax = jnp.maximum(jnp.max(state.priority), 1.0)
+        prio = state.priority.at[idx].set(pmax)
+        return PrioritizedState(self._ring.add_batch(state.base, batch),
+                                prio)
+
+    def sample(self, state: PrioritizedState, key: jax.Array,
+               batch_size: int):
+        """(batch, idx, importance_weights) — weights normalized to
+        max 1 (the (N·P)^-beta correction)."""
+        logits = self.alpha * jnp.log(state.priority + self.eps)
+        logits = jnp.where(state.priority > 0, logits, -jnp.inf)
+        g = jax.random.gumbel(key, (self.capacity,))
+        _, idx = jax.lax.top_k(logits + g, batch_size)
+        # batch_size > filled slots: top_k spills into empty (-inf)
+        # slots — remap those onto real entries (duplicates, the same
+        # behavior as sampling with replacement from a small buffer)
+        # instead of returning zero transitions with max weight.
+        valid = state.priority[idx] > 0
+        idx = jnp.where(valid, idx,
+                        idx % jnp.maximum(state.base.size, 1))
+        probs = (state.priority[idx] ** self.alpha)
+        probs = probs / jnp.maximum(
+            jnp.sum(state.priority ** self.alpha), self.eps)
+        n = jnp.maximum(state.base.size, 1).astype(jnp.float32)
+        w = (n * jnp.maximum(probs, self.eps)) ** (-self.beta)
+        w = w / jnp.maximum(jnp.max(w), self.eps)
+        batch = {k: v[idx] for k, v in state.base.data.items()}
+        return batch, idx, w
+
+    def update_priorities(self, state: PrioritizedState, idx: jax.Array,
+                          td_error: jax.Array) -> PrioritizedState:
+        prio = state.priority.at[idx].set(
+            jnp.abs(td_error) + self.eps)
+        return PrioritizedState(state.base, prio)
+
+
+class EpisodeReplayBuffer:
+    """Host-side episode buffer sampling fixed-length SEGMENTS (parity:
+    rllib/utils/replay_buffers/episode_replay_buffer.py — the buffer
+    recurrent/sequence learners sample from)."""
+
+    def __init__(self, capacity_episodes: int):
+        self.capacity = capacity_episodes
+        self._episodes: list = []
+        self._ptr = 0
+
+    def __len__(self) -> int:
+        return len(self._episodes)
+
+    def add_episode(self, episode: Dict[str, np.ndarray]) -> None:
+        """episode: name → [T, ...] arrays, equal T."""
+        if len(self._episodes) < self.capacity:
+            self._episodes.append(episode)
+        else:
+            self._episodes[self._ptr] = episode
+        self._ptr = (self._ptr + 1) % self.capacity
+
+    def sample_segments(self, batch_size: int, seg_len: int,
+                        rng: np.random.Generator = None
+                        ) -> Dict[str, np.ndarray]:
+        """[B, seg_len, ...] stacked segments; short episodes pad with
+        their last step and carry a 'mask'."""
+        rng = rng or np.random.default_rng()
+        out: Dict[str, list] = {}
+        masks = []
+        for _ in range(batch_size):
+            ep = self._episodes[rng.integers(0, len(self._episodes))]
+            T = len(next(iter(ep.values())))
+            start = int(rng.integers(0, max(1, T - seg_len + 1)))
+            end = min(start + seg_len, T)
+            mask = np.zeros((seg_len,), np.float32)
+            mask[: end - start] = 1.0
+            masks.append(mask)
+            for k, v in ep.items():
+                seg = v[start:end]
+                if len(seg) < seg_len:
+                    pad = np.repeat(seg[-1:], seg_len - len(seg), axis=0)
+                    seg = np.concatenate([seg, pad], axis=0)
+                out.setdefault(k, []).append(seg)
+        stacked = {k: np.stack(v) for k, v in out.items()}
+        stacked["mask"] = np.stack(masks)
+        return stacked
+
+
 class HostReplayBuffer:
     """Numpy ring buffer (parity: the reference's ReplayBuffer)."""
 

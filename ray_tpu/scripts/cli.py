@@ -98,6 +98,13 @@ def cmd_up(args, out) -> int:
     n = sum(1 for x in _api.runtime().nodes() if x["Alive"])
     print(f"cluster up: {n} nodes (join port "
           f"{cluster.node_server.port})", file=out, flush=True)
+    if not args.block:
+        # The head lives in THIS process: when it exits the workers
+        # must go too, or they'd orphan dialing a dead port (and cloud
+        # VMs would bill with no handle left to delete them).
+        import atexit
+
+        atexit.register(cluster.down)
     if args.block:
         import signal
 
