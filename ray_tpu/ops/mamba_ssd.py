@@ -31,43 +31,55 @@ def _interpret_mode() -> bool:
 
 
 def _ssd_kernel(x_ref, la_ref, b_ref, c_ref, o_ref, state_scr, *,
-                chunk: int):
-    z = pl.program_id(2)
+                chunk: int, heads: int, head_dim: int):
+    """One program per (batch, chunk): every head handled in a static
+    loop so B/C load once per chunk and the launch count stays small
+    (a per-head grid axis measured SLOWER than the XLA einsum path —
+    1000+ tiny programs re-fetching the shared B/C blocks)."""
+    z = pl.program_id(1)
 
     @pl.when(z == 0)
     def _init():
         state_scr[:] = jnp.zeros_like(state_scr)
 
     f32 = jnp.float32
-    la = la_ref[0, 0, :, :].astype(f32)          # [c, 1]
-    cum = jnp.cumsum(la, axis=0)                 # [c, 1]
-    total = cum[chunk - 1:chunk, :]              # [1, 1]
     Cc = c_ref[0, 0].astype(f32)                 # [c, N]
     Bc = b_ref[0, 0].astype(f32)                 # [c, N]
-    xc = x_ref[0, 0, :, 0, :].astype(f32)        # [c, P]
-
-    # Intra-chunk: masked decay-weighted attention-like matmuls.
     scores = jax.lax.dot_general(
         Cc, Bc, (((1,), (1,)), ((), ())), preferred_element_type=f32
-    )                                            # [c, c]
-    diff = cum - cum.reshape(1, chunk)           # [c, c] cum_i - cum_j
-    i = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 0)
-    j = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 1)
-    w = jnp.where(i >= j, scores * jnp.exp(diff), 0.0)
-    state = state_scr[:]                         # [N, P]
-    y = jax.lax.dot_general(
-        w, xc, (((1,), (0,)), ((), ())), preferred_element_type=f32
-    )
-    # Carried-in state contribution: decay start→i applied to C_i·S.
-    y = y + jnp.exp(cum) * jax.lax.dot_general(
-        Cc, state, (((1,), (0,)), ((), ())), preferred_element_type=f32
-    )
-    # State update: S ← exp(total)·S + Σ_j exp(total - cum_j) B_j x_j^T.
-    dte = jnp.exp(total - cum)                   # [c, 1]
-    state_scr[:] = jnp.exp(total) * state + jax.lax.dot_general(
-        Bc * dte, xc, (((0,), (0,)), ((), ())), preferred_element_type=f32
-    )                                            # [N, P]
-    o_ref[0, 0, :, 0, :] = y.astype(o_ref.dtype)
+    )                                            # [c, c] (head-shared)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (chunk, chunk), 1)
+    tri = (ii >= jj).astype(f32)
+    la_all = la_ref[0, 0].astype(f32)            # [c, H]
+    # cumsum as a lower-triangular matmul (no cumsum lowering on TPU);
+    # one dot covers every head.
+    cum_all = jax.lax.dot_general(
+        tri, la_all, (((1,), (0,)), ((), ())),
+        preferred_element_type=f32)              # [c, H]
+
+    N = state_scr.shape[0] // heads
+    for h in range(heads):                       # static unroll
+        lo, hi = h * head_dim, (h + 1) * head_dim
+        cum = cum_all[:, h:h + 1]                # [c, 1]
+        total = cum[chunk - 1:chunk, :]          # [1, 1]
+        xc = x_ref[0, 0, :, lo:hi].astype(f32)   # [c, P]
+        diff = cum - cum.reshape(1, chunk)       # [c, c]
+        w = jnp.where(ii >= jj, scores * jnp.exp(diff), 0.0)
+        state = state_scr[h * N:(h + 1) * N]     # [N, P]
+        y = jax.lax.dot_general(
+            w, xc, (((1,), (0,)), ((), ())), preferred_element_type=f32
+        )
+        y = y + jnp.exp(cum) * jax.lax.dot_general(
+            Cc, state, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)
+        dte = jnp.exp(total - cum)               # [c, 1]
+        decay_all = jnp.exp(total[0, 0])         # scalar (2-D bcast ban)
+        state_scr[h * N:(h + 1) * N] = (
+            decay_all * state + jax.lax.dot_general(
+                Bc * dte, xc, (((0,), (0,)), ((), ())),
+                preferred_element_type=f32))
+        o_ref[0, 0, :, lo:hi] = y.astype(o_ref.dtype)
 
 
 def _ssd_pallas_fwd_impl(x, log_a, Bm, Cm, chunk: int):
@@ -75,33 +87,38 @@ def _ssd_pallas_fwd_impl(x, log_a, Bm, Cm, chunk: int):
     N = Bm.shape[-1]
     assert S % chunk == 0, f"seq {S} not divisible by chunk {chunk}"
     nc = S // chunk
-    xc = x.reshape(B, nc, chunk, H, P)
+    # Feature-flattened layout [.., c, H*P]: the blocked (sublane,
+    # lane) dims must be (chunk, features) — a separate head axis in
+    # the block violates TPU (8, 128) tiling on real hardware.
+    xc = x.reshape(B, nc, chunk, H * P)
     la = log_a.reshape(B, nc, chunk, H)
     Bc = Bm.reshape(B, nc, chunk, N)
     Cc = Cm.reshape(B, nc, chunk, N)
 
-    grid = (B, H, nc)  # nc innermost: sequential per (batch, head)
+    grid = (B, nc)  # nc innermost: sequential chunk walk per batch
     out = pl.pallas_call(
-        functools.partial(_ssd_kernel, chunk=chunk),
+        functools.partial(_ssd_kernel, chunk=chunk, heads=H,
+                          head_dim=P),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, chunk, 1, P),
-                         lambda b, h, z: (b, z, 0, h, 0)),
-            pl.BlockSpec((1, 1, chunk, 1),
-                         lambda b, h, z: (b, z, 0, h)),
+            pl.BlockSpec((1, 1, chunk, H * P),
+                         lambda b, z: (b, z, 0, 0)),
+            pl.BlockSpec((1, 1, chunk, H),
+                         lambda b, z: (b, z, 0, 0)),
             pl.BlockSpec((1, 1, chunk, N),
-                         lambda b, h, z: (b, z, 0, 0)),
+                         lambda b, z: (b, z, 0, 0)),
             pl.BlockSpec((1, 1, chunk, N),
-                         lambda b, h, z: (b, z, 0, 0)),
+                         lambda b, z: (b, z, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, chunk, 1, P),
-                               lambda b, h, z: (b, z, 0, h, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, nc, chunk, H, P), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((N, P), jnp.float32)],
-        # Only the chunk walk is stateful; (batch, head) iterations are
-        # independent so Mosaic may split them across TensorCores.
+        out_specs=pl.BlockSpec((1, 1, chunk, H * P),
+                               lambda b, z: (b, z, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, nc, chunk, H * P),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((H * N, P), jnp.float32)],
+        # Only the chunk walk is stateful; batches are independent so
+        # Mosaic may split them across TensorCores.
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret_mode(),
     )(xc, la, Bc, Cc)
     return out.reshape(B, S, H, P)
