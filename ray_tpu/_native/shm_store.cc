@@ -22,13 +22,17 @@
 
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
 namespace {
 
-constexpr uint64_t kMagic = 0x7470755f73746f72ULL;  // "tpu_stor"
+// Magic doubles as the layout version: any change to Header/Slot/
+// FreeBlock layout MUST bump it so a mixed-build process gets a clean
+// -EINVAL on attach instead of silently mis-striding the slot table.
+constexpr uint64_t kMagic = 0x7470755f73743032ULL;  // "tpu_st02"
 constexpr int kIdSize = 32;
 constexpr uint32_t kFreeListCap = 4096;
 
@@ -42,10 +46,11 @@ enum SlotState : uint32_t {
 struct Slot {
   uint8_t id[kIdSize];
   uint32_t state;
-  uint32_t refcount;   // outstanding gets
-  uint64_t offset;     // into data arena
+  uint32_t refcount;    // outstanding gets
+  uint64_t offset;      // into data arena
   uint64_t size;
-  uint64_t lru_tick;   // last touch
+  uint64_t lru_tick;    // last touch
+  uint64_t creator_pid; // producer of a CREATED slot; abort is creator-only
 };
 
 struct FreeBlock {
@@ -110,6 +115,16 @@ class Guard {
  private:
   Header* h_;
 };
+
+// True iff the process that created an unsealed slot no longer exists
+// (kill(pid, 0) probe).  Lets orphaned CREATED slots — producer died
+// mid-write — be reclaimed by eviction, delete, or a peer's abort.
+// pid reuse can delay reclamation until the imposter exits; never
+// causes premature frees because live producers always match getpid().
+bool producer_dead(const Slot* s) {
+  if (s->creator_pid == 0) return true;
+  return kill((pid_t)s->creator_pid, 0) != 0 && errno == ESRCH;
+}
 
 // FNV-1a over the 32-byte id.
 uint64_t hash_id(const uint8_t* id) {
@@ -212,8 +227,10 @@ void free_insert(Header* h, uint64_t offset, uint64_t size) {
 }
 
 // Rebuild free list + counters from the slot table after a torn
-// allocator mutation (robust-mutex recovery).  Unsealed (CREATED) slots
-// may belong to the dead producer — drop them.
+// allocator mutation (robust-mutex recovery).  CREATED slots whose
+// producer died are dropped; a LIVE producer's CREATED slot must
+// survive — it is still writing through its pointer, and freeing the
+// range would let a later create overlap it.
 void rebuild_allocator(Header* h) {
   Slot* tab = slots(h);
   h->free_count = 0;
@@ -222,18 +239,23 @@ void rebuild_allocator(Header* h) {
   uint64_t max_end = 0;
   for (uint32_t i = 0; i < h->num_slots; i++) {
     Slot* s = &tab[i];
-    if (s->state == SLOT_CREATED) {
+    // Acquire pairs with create's release commit: a state that reads
+    // CREATED guarantees the extent fields below it are visible.
+    uint32_t st = __atomic_load_n(&s->state, __ATOMIC_ACQUIRE);
+    if (st == SLOT_CREATED && producer_dead(s)) {
       s->state = SLOT_TOMBSTONE;
       h->tombstones++;
+      st = SLOT_TOMBSTONE;
     }
-    if (s->state == SLOT_SEALED) {
+    if (st == SLOT_SEALED || st == SLOT_CREATED) {
       h->bytes_used += s->size;
       h->num_objects++;
       if (s->offset + s->size > max_end) max_end = s->offset + s->size;
     }
   }
   // Free space = everything below the live high-water mark that no
-  // sealed slot covers.  Collect gaps by sorting live extents.
+  // live (sealed or surviving-CREATED) slot covers.  Collect gaps by
+  // sorting live extents.
   h->bump = max_end;
   // Insertion-sort live extents into a bounded stack array; fall back
   // to "no free list" (bump-only) if there are too many.
@@ -241,7 +263,9 @@ void rebuild_allocator(Header* h) {
   static thread_local FreeBlock live[kMaxLive];
   uint32_t n = 0;
   for (uint32_t i = 0; i < h->num_slots && n < kMaxLive; i++) {
-    if (tab[i].state == SLOT_SEALED) live[n++] = {tab[i].offset, tab[i].size};
+    if (tab[i].state == SLOT_SEALED || tab[i].state == SLOT_CREATED) {
+      live[n++] = {tab[i].offset, tab[i].size};
+    }
   }
   if (n < kMaxLive) {
     for (uint32_t i = 1; i < n; i++) {
@@ -287,6 +311,48 @@ int64_t alloc_block(Header* h, uint64_t size) {
 
 // Evict least-recently-used sealed refcount-0 objects until `size` fits.
 // Parity: plasma EvictionPolicy::RequireSpace (eviction_policy.h).
+// Return a slot's bytes to the allocator and tombstone it.  The single
+// accounting path for every reclamation (evict, delete, abort, orphan
+// reuse) — keeps bytes_used/num_objects in lockstep with the free map.
+// May rehash the table (clear_slot): callers must hold no slot pointers.
+void reclaim_slot(Header* h, Slot* s) {
+  free_insert(h, s->offset, s->size);
+  h->bytes_used -= s->size;
+  h->num_objects--;
+  clear_slot(h, s);
+}
+
+// Victim selection: dead-producer orphans FIRST — they are garbage,
+// while a sealed victim is live cached data somebody may have to
+// respill or refetch.  The kill(2) liveness probe runs only on CREATED
+// slots, which are rare and short-lived.
+Slot* pick_victim(Header* h) {
+  Slot* tab = slots(h);
+  for (uint32_t i = 0; i < h->num_slots; i++) {
+    Slot* s = &tab[i];
+    if (s->state == SLOT_CREATED && producer_dead(s)) return s;
+  }
+  Slot* victim = nullptr;
+  for (uint32_t i = 0; i < h->num_slots; i++) {
+    Slot* s = &tab[i];
+    if (s->state == SLOT_SEALED && s->refcount == 0 &&
+        (victim == nullptr || s->lru_tick < victim->lru_tick)) {
+      victim = s;
+    }
+  }
+  return victim;
+}
+
+// Reclaim one victim.  Orphan cleanup is not a cache eviction — only
+// sealed victims count toward the evictions stat.
+bool evict_one(Header* h) {
+  Slot* victim = pick_victim(h);
+  if (victim == nullptr) return false;
+  if (victim->state == SLOT_SEALED) h->evictions++;
+  reclaim_slot(h, victim);
+  return true;
+}
+
 bool evict_for(Header* h, uint64_t size) {
   while (true) {
     FreeBlock* fl = free_list(h);
@@ -295,22 +361,7 @@ bool evict_for(Header* h, uint64_t size) {
       fits = fl[i].size >= size;
     }
     if (fits) return true;
-
-    Slot* victim = nullptr;
-    Slot* tab = slots(h);
-    for (uint32_t i = 0; i < h->num_slots; i++) {
-      Slot* s = &tab[i];
-      if (s->state == SLOT_SEALED && s->refcount == 0 &&
-          (victim == nullptr || s->lru_tick < victim->lru_tick)) {
-        victim = s;
-      }
-    }
-    if (victim == nullptr) return false;
-    free_insert(h, victim->offset, victim->size);
-    h->bytes_used -= victim->size;
-    h->num_objects--;
-    h->evictions++;
-    clear_slot(h, victim);
+    if (!evict_one(h)) return false;
   }
 }
 
@@ -395,20 +446,48 @@ int shm_store_close(Store* s, int unlink_segment) {
 int shm_obj_create(Store* s, const uint8_t* id, uint64_t size, uint8_t** out) {
   Guard g(s->hdr);
   Header* h = s->hdr;
-  if (find_slot(h, id) != nullptr) return -EEXIST;
-  Slot* slot = insert_slot(h, id);
-  if (slot == nullptr) return -ENOSPC;
-  if (slot->state == SLOT_TOMBSTONE) h->tombstones--;
+  Slot* prior = find_slot(h, id);
+  if (prior != nullptr) {
+    // A CREATED slot whose producer died is an orphan: reclaim it so
+    // the id can be re-put (every other path — evict, delete, abort —
+    // already treats it as reclaimable).
+    if (prior->state != SLOT_CREATED || !producer_dead(prior)) {
+      return -EEXIST;
+    }
+    reclaim_slot(h, prior);  // may rehash — no slot pointers held
+  }
   if (size > h->capacity) return -ENOMEM;
+  // Evict + allocate BEFORE picking the slot: eviction can trigger the
+  // tombstone rehash inside clear_slot, which moves entries and would
+  // invalidate (worse: repopulate) a slot pointer captured earlier.
   if (!evict_for(h, size)) return -ENOMEM;
   int64_t off = alloc_block(h, size);
   if (off < 0) return -ENOMEM;
+  // A full slot table is also recoverable by eviction (a reclaimed
+  // victim tombstones its slot); only fail -ENOSPC once nothing is
+  // evictable.
+  Slot* slot = insert_slot(h, id);
+  while (slot == nullptr) {
+    if (!evict_one(h)) {
+      free_insert(h, (uint64_t)off, size);
+      return -ENOSPC;
+    }
+    slot = insert_slot(h, id);
+  }
+  if (slot->state == SLOT_TOMBSTONE) h->tombstones--;
+  // Populate every field BEFORE the state word: robust-mutex recovery
+  // trusts offset/size/creator_pid of any slot whose state says
+  // CREATED, so the state transition must be the commit point — a
+  // release store, or the compiler/CPU may float it above the field
+  // stores (a SIGKILL between the two would hand recovery a CREATED
+  // slot with garbage extent fields).
   memcpy(slot->id, id, kIdSize);
-  slot->state = SLOT_CREATED;
   slot->refcount = 0;
   slot->offset = (uint64_t)off;
   slot->size = size;
   slot->lru_tick = ++h->lru_clock;
+  slot->creator_pid = (uint64_t)getpid();
+  __atomic_store_n(&slot->state, SLOT_CREATED, __ATOMIC_RELEASE);
   h->bytes_used += size;
   h->num_objects++;
   *out = arena(s) + off;
@@ -446,23 +525,45 @@ int shm_obj_release(Store* s, const uint8_t* id) {
   return 0;
 }
 
+// Producer-side discard of an object created but not yet sealed (the
+// plasma Abort counterpart): reclaims the arena block after a failed
+// write.  Only CREATED slots qualify — sealed objects go through
+// shm_obj_delete's refcount discipline — and only the creating process
+// may abort (-EPERM otherwise): a peer aborting an in-progress slot
+// would free arena bytes the producer is still writing through.
+int shm_obj_abort(Store* s, const uint8_t* id) {
+  Guard g(s->hdr);
+  Header* h = s->hdr;
+  Slot* slot = find_slot(h, id);
+  if (slot == nullptr) return -ENOENT;
+  if (slot->state != SLOT_CREATED) return -EINVAL;
+  if (slot->creator_pid != (uint64_t)getpid() && !producer_dead(slot)) {
+    return -EPERM;
+  }
+  reclaim_slot(h, slot);
+  return 0;
+}
+
 int shm_obj_contains(Store* s, const uint8_t* id) {
   Guard g(s->hdr);
   Slot* slot = find_slot(s->hdr, id);
   return (slot != nullptr && slot->state == SLOT_SEALED) ? 1 : 0;
 }
 
-// Delete regardless of refcount==0 wait semantics: -EBUSY if referenced.
+// Delete regardless of refcount==0 wait semantics: -EBUSY if referenced
+// or still being written (an unsealed object belongs to its producer —
+// parity with plasma's Abort-vs-Delete split: only the creating client
+// may discard an object it has not sealed).
 int shm_obj_delete(Store* s, const uint8_t* id) {
   Guard g(s->hdr);
   Header* h = s->hdr;
   Slot* slot = find_slot(h, id);
   if (slot == nullptr) return -ENOENT;
   if (slot->refcount > 0) return -EBUSY;
-  free_insert(h, slot->offset, slot->size);
-  h->bytes_used -= slot->size;
-  h->num_objects--;
-  clear_slot(h, slot);
+  // An unsealed object belongs to its producer while that producer is
+  // alive; once it is dead the slot is an orphan anyone may reclaim.
+  if (slot->state == SLOT_CREATED && !producer_dead(slot)) return -EBUSY;
+  reclaim_slot(h, slot);
   return 0;
 }
 

@@ -1,0 +1,163 @@
+// Sanitizer stress driver for the shared-memory object store.
+//
+// Exercises the store's whole lifecycle concurrently — create/seal/get/
+// release/delete with eviction pressure — from multiple threads and
+// (fork-before-threads) multiple processes, so that TSAN can check the
+// process-shared robust mutex discipline and ASAN/UBSAN the allocator
+// arithmetic.  Parity intent: the reference runs its C++ under TSAN/ASAN
+// CI jobs (ray: BUILD.bazel tsan/asan configs); this is the equivalent
+// harness for our native layer.
+//
+// Built and run by scripts/sanitize.sh; compiled together with
+// shm_store.cc (single TU link, no .so indirection, so sanitizers see
+// every frame).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <sys/wait.h>
+#include <unistd.h>
+
+struct Store;
+extern "C" {
+int shm_store_open(const char* name, uint64_t capacity, uint32_t num_slots,
+                   int create, Store** out);
+int shm_store_close(Store* s, int unlink_segment);
+int shm_obj_create(Store* s, const uint8_t* id, uint64_t size, uint8_t** out);
+int shm_obj_seal(Store* s, const uint8_t* id);
+int shm_obj_get(Store* s, const uint8_t* id, uint8_t** out, uint64_t* size);
+int shm_obj_release(Store* s, const uint8_t* id);
+int shm_obj_contains(Store* s, const uint8_t* id);
+int shm_obj_delete(Store* s, const uint8_t* id);
+int shm_store_stats(Store* s, uint64_t* capacity, uint64_t* used,
+                    uint64_t* num_objects, uint64_t* evictions);
+}
+
+namespace {
+
+constexpr int kIdSize = 32;
+std::atomic<long> g_errors{0};
+
+void make_id(uint8_t* id, int actor, int key) {
+  memset(id, 0, kIdSize);
+  memcpy(id, &actor, sizeof(actor));
+  memcpy(id + sizeof(actor), &key, sizeof(key));
+}
+
+// One worker: loop create→write→seal→get→verify→release→(sometimes delete)
+// over a small key space so threads collide on ids and eviction runs.
+void worker(Store* s, int actor, int iters, int keyspace) {
+  unsigned seed = 0x9e3779b9u * (unsigned)(actor + 1);
+  auto rnd = [&seed]() {
+    seed = seed * 1664525u + 1013904223u;
+    return seed;
+  };
+  for (int i = 0; i < iters; ++i) {
+    uint8_t id[kIdSize];
+    make_id(id, actor % 4, (int)(rnd() % (unsigned)keyspace));
+    uint64_t size = 256 + rnd() % (48 * 1024);
+    uint8_t* w = nullptr;
+    int rc = shm_obj_create(s, id, size, &w);
+    if (rc == 0) {
+      memset(w, (int)(size & 0xff), size);
+      rc = shm_obj_seal(s, id);
+      if (rc != 0) {
+        // Nothing may touch our CREATED slot between create and seal:
+        // eviction skips unsealed objects and delete returns -EBUSY on
+        // them, so any nonzero rc is a store bug.
+        fprintf(stderr, "seal rc=%d\n", rc);
+        g_errors++;
+      }
+    } else if (rc != -EEXIST && rc != -ENOMEM && rc != -ENOSPC) {
+      fprintf(stderr, "create rc=%d\n", rc);
+      g_errors++;
+    }
+    uint8_t* r = nullptr;
+    uint64_t rsize = 0;
+    rc = shm_obj_get(s, id, &r, &rsize);
+    if (rc == 0) {
+      // Verify fill byte at both ends while pinned.
+      uint8_t expect = (uint8_t)(rsize & 0xff);
+      if (r[0] != expect || r[rsize - 1] != expect) {
+        fprintf(stderr, "corrupt read size=%llu\n",
+                (unsigned long long)rsize);
+        g_errors++;
+      }
+      shm_obj_release(s, id);
+    } else if (rc != -ENOENT && rc != -EAGAIN) {
+      fprintf(stderr, "get rc=%d\n", rc);
+      g_errors++;
+    }
+    if ((rnd() & 7) == 0) {
+      rc = shm_obj_delete(s, id);
+      if (rc != 0 && rc != -ENOENT && rc != -EBUSY) {
+        fprintf(stderr, "delete rc=%d\n", rc);
+        g_errors++;
+      }
+    }
+  }
+}
+
+int run_threads(Store* s, int nthreads, int iters, int keyspace) {
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nthreads; ++t) {
+    ts.emplace_back(worker, s, t, iters, keyspace);
+  }
+  for (auto& t : ts) t.join();
+  return g_errors.load() == 0 ? 0 : 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int iters = argc > 1 ? atoi(argv[1]) : 2000;
+  int nprocs = argc > 2 ? atoi(argv[2]) : 2;
+  const char* seg = "/raytpu_sanitize_stress";
+
+  Store* s = nullptr;
+  // 2 MiB arena + 512 slots: small enough that eviction and -ENOMEM
+  // paths run constantly.
+  int rc = shm_store_open(seg, 2u << 20, 512, /*create=*/1, &s);
+  if (rc != 0) {
+    fprintf(stderr, "open rc=%d\n", rc);
+    return 2;
+  }
+
+  // Fork BEFORE any thread exists (TSAN requirement): each child opens
+  // the same segment and runs its own thread pool, exercising the
+  // process-shared mutex across address spaces.
+  std::vector<pid_t> kids;
+  for (int p = 0; p < nprocs; ++p) {
+    pid_t pid = fork();
+    if (pid == 0) {
+      Store* cs = nullptr;
+      rc = shm_store_open(seg, 0, 0, /*create=*/0, &cs);
+      if (rc != 0) _exit(2);
+      int bad = run_threads(cs, 4, iters, 64);
+      shm_store_close(cs, 0);
+      _exit(bad);
+    }
+    kids.push_back(pid);
+  }
+
+  int bad = run_threads(s, 4, iters, 64);
+
+  for (pid_t pid : kids) {
+    int st = 0;
+    waitpid(pid, &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) bad = 1;
+  }
+
+  uint64_t cap, used, n, ev;
+  shm_store_stats(s, &cap, &used, &n, &ev);
+  fprintf(stderr, "done: objects=%llu used=%llu evictions=%llu errors=%ld\n",
+          (unsigned long long)n, (unsigned long long)used,
+          (unsigned long long)ev, g_errors.load());
+  shm_store_close(s, /*unlink=*/1);
+  return bad;
+}

@@ -46,12 +46,14 @@ def _load_lib():
     lib.shm_obj_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shm_obj_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shm_obj_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_obj_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shm_store_stats.argtypes = [ctypes.c_void_p] + [
         ctypes.POINTER(ctypes.c_uint64)
     ] * 4
     for fn in ("shm_store_open", "shm_store_close", "shm_obj_create",
                "shm_obj_seal", "shm_obj_get", "shm_obj_release",
-               "shm_obj_contains", "shm_obj_delete", "shm_store_stats"):
+               "shm_obj_contains", "shm_obj_delete", "shm_obj_abort",
+               "shm_store_stats"):
         getattr(lib, fn).restype = ctypes.c_int
     return lib
 
@@ -173,6 +175,19 @@ class SharedMemoryStore:
     def seal(self, object_id: bytes) -> None:
         _check(self._lib.shm_obj_seal(self._h(), _pad_id(object_id)),
                "seal")
+
+    def abort(self, object_id: bytes) -> None:
+        """Discard an object created but not sealed (failed write).
+
+        Fully best-effort: every failure (create never happened, already
+        sealed, foreign producer, store closed) is swallowed — abort is
+        always called from error paths that must proceed to a fallback
+        tier, never turn into a hard failure themselves.
+        """
+        try:
+            self._lib.shm_obj_abort(self._h(), _pad_id(object_id))
+        except OSError:
+            pass
 
     def put_bytes(self, object_id: bytes, data: bytes) -> None:
         buf = self.create(object_id, len(data))

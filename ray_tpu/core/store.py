@@ -171,6 +171,10 @@ class LocalObjectStore:
                     st.in_shm = True
                     st.shm_size = size
                 except Exception:
+                    # Reclaim a half-written CREATED slot (best-effort);
+                    # a live producer's unsealed slot is invisible to
+                    # eviction and delete, so this frees the bytes.
+                    shm.abort(oid.binary())
                     shm = None  # full/unavailable → local tier
             if shm is None:
                 out = bytearray(size)

@@ -268,8 +268,10 @@ class WorkerRuntime:
                 write_framed(buf, meta, buffers)
                 self._shm.seal(oid_bin)
                 sealed = True
-            except OSError:
-                pass  # arena full → inline fallback
+            except Exception:
+                # Reclaim a half-written CREATED slot (abort is
+                # best-effort by contract); fall through to inline.
+                self._shm.abort(oid_bin)
             if sealed:
                 # Outside the try: a ChannelClosedError here is a real
                 # failure (the value IS in the arena), not arena-full.
@@ -514,8 +516,8 @@ class _WorkerServer:
                 write_framed(buf, meta, buffers)
                 self._shm.seal(dest_oid)
                 return ("shm", size), nested_bins
-            except OSError:
-                pass
+            except Exception:
+                self._shm.abort(dest_oid)  # best-effort reclaim
         out = bytearray(size)
         write_framed(memoryview(out), meta, buffers)
         return ("b", bytes(out)), nested_bins

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Sanitizer CI for the native layer (SURVEY §5.2 parity: the reference
+# runs its C++ under TSAN/ASAN bazel configs; this is our equivalent).
+#
+#   scripts/sanitize.sh [iters]
+#
+# Builds the shm object store and cluster scheduler together with their
+# stress drivers under -fsanitize=thread and -fsanitize=address,undefined
+# and runs them.  Any data race / heap error / invariant violation makes
+# the script exit nonzero.  Invoked by tests/test_sanitizers.py.
+set -u
+ITERS="${1:-1500}"
+HERE="$(cd "$(dirname "$0")/.." && pwd)"
+SRC="$HERE/ray_tpu/_native"
+OUT="$(mktemp -d /tmp/raytpu_sanitize.XXXXXX)"
+trap 'rm -rf "$OUT"' EXIT
+
+CXX="${CXX:-g++}"
+COMMON="-std=c++17 -g -O1 -fno-omit-frame-pointer -pthread"
+FAIL=0
+
+build_run() {
+  local tag="$1" flags="$2" driver="$3" lib="$4"; shift 4
+  local bin="$OUT/${driver%.cc}_$tag"
+  if ! "$CXX" $COMMON $flags -o "$bin" "$SRC/$driver" "$SRC/$lib" -lrt 2>"$OUT/build_$tag.log"; then
+    echo "BUILD FAIL [$tag $driver]"; cat "$OUT/build_$tag.log"; FAIL=1; return
+  fi
+  if ! "$bin" "$@" >"$OUT/run_${driver%.cc}_$tag.log" 2>&1; then
+    echo "SANITIZE FAIL [$tag $driver]"
+    tail -40 "$OUT/run_${driver%.cc}_$tag.log"
+    FAIL=1
+  else
+    echo "ok [$tag $driver] $(tail -1 "$OUT/run_${driver%.cc}_$tag.log")"
+  fi
+}
+
+TSAN="-fsanitize=thread"
+ASAN="-fsanitize=address,undefined -fno-sanitize-recover=all"
+
+export TSAN_OPTIONS="halt_on_error=1 second_deadlock_stack=1"
+export ASAN_OPTIONS="detect_leaks=1 abort_on_error=0"
+export UBSAN_OPTIONS="halt_on_error=1 print_stacktrace=1"
+
+build_run tsan "$TSAN" stress_sched.cc scheduler.cc "$ITERS"
+build_run asan "$ASAN" stress_sched.cc scheduler.cc "$ITERS"
+# shm store: threads + forked processes over one mapped segment.  TSAN
+# cannot follow the forked children (it sees the parent's threads only);
+# run it single-process multi-thread there and full multi-process under
+# ASAN.
+build_run tsan "$TSAN" stress_shm.cc shm_store.cc "$ITERS" 0
+build_run asan "$ASAN" stress_shm.cc shm_store.cc "$ITERS" 2
+
+exit $FAIL

@@ -1,0 +1,41 @@
+"""Native-layer sanitizer gate (SURVEY §5.2).
+
+Parity: the reference wires TSAN/ASAN bazel configs over its C++ core
+(ray: .bazelrc --config=tsan / --config=asan and the tsan CI jobs); we
+run the equivalent here — the shm object store and the cluster
+scheduler compiled under -fsanitize=thread and
+-fsanitize=address,undefined and driven by dedicated stress binaries
+(_native/stress_shm.cc, _native/stress_sched.cc): concurrent
+create/seal/get/release/delete with eviction pressure across threads
+AND forked processes for the store; acquire/release storms with node
+kill/re-add churn plus a conservation check for the scheduler.
+"""
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _has_sanitizers() -> bool:
+    gxx = shutil.which("g++")
+    if not gxx:
+        return False
+    probe = subprocess.run(
+        [gxx, "-fsanitize=thread", "-x", "c++", "-", "-o", "/dev/null"],
+        input=b"int main(){return 0;}", capture_output=True)
+    return probe.returncode == 0
+
+
+@pytest.mark.skipif(not _has_sanitizers(),
+                    reason="g++ with sanitizer runtimes not available")
+def test_native_layer_clean_under_tsan_and_asan():
+    r = subprocess.run(
+        ["bash", str(REPO / "scripts" / "sanitize.sh"), "600"],
+        capture_output=True, text=True, timeout=600)
+    sys.stdout.write(r.stdout)
+    sys.stderr.write(r.stderr)
+    assert r.returncode == 0, "sanitizer stress failed (see output)"

@@ -118,6 +118,61 @@ def test_delete_and_busy(store):
     assert not store.contains(b"d")
 
 
+def test_abort_reclaims_unsealed_slot(store):
+    # A created-but-unsealed object is invisible to delete (the producer
+    # owns it) and to eviction; abort is the only reclamation path.
+    store.create(b"w", 4096)
+    with pytest.raises(ShmStoreError):
+        store.delete(b"w")  # unsealed → EBUSY
+    used_before = store.stats()["bytes_used"]
+    store.abort(b"w")
+    assert store.stats()["bytes_used"] == used_before - 4096
+    assert not store.contains(b"w")
+    # The id is reusable after abort, and abort of a sealed or missing
+    # object is a harmless no-op.
+    store.put_bytes(b"w", b"ok")
+    store.abort(b"w")
+    assert store.contains(b"w")
+    store.abort(b"never-created")
+
+
+def _child_creates_and_dies(name):
+    s = SharedMemoryStore.connect(name)
+    s.create(b"orphan", 256 * 1024)
+    os._exit(0)  # die without sealing — the slot is now an orphan
+
+
+def test_orphaned_unsealed_slot_is_reclaimable():
+    """A producer killed mid-write must not leak its CREATED slot: the
+    liveness probe lets delete reclaim it and eviction use its bytes."""
+    name = f"/raytpu-orphan-{os.getpid()}"
+    s = SharedMemoryStore(name, capacity=1 << 20, num_slots=64)
+    try:
+        ctx = multiprocessing.get_context("spawn")
+        p = ctx.Process(target=_child_creates_and_dies, args=(name,))
+        p.start()
+        p.join(timeout=60)
+        used = s.stats()["bytes_used"]
+        assert used >= 256 * 1024  # the orphan's bytes are accounted
+        # An 800 KB put cannot fit the 1 MB arena alongside the 256 KB
+        # orphan and there is no sealed victim — eviction must reclaim
+        # the orphan itself or this raises ENOMEM.
+        s.put_bytes(b"big", bytes(800 * 1024))
+        assert s.stats()["bytes_used"] == 800 * 1024
+        # Re-putting the orphaned id reclaims the slot inline (create
+        # must not -EEXIST on a dead producer's slot), and explicit
+        # delete of the fresh object works.
+        p = ctx.Process(target=_child_creates_and_dies, args=(name,))
+        p.start()
+        p.join(timeout=60)
+        s.put_bytes(b"orphan", b"fresh")
+        assert s.get_bytes(b"orphan") == b"fresh"
+        s.delete(b"orphan")
+        assert not s.contains(b"orphan")
+    finally:
+        s.close(unlink=True)
+
+
 def test_capacity_exceeded_raises(store):
     with pytest.raises(ShmStoreError):
         store.create(b"huge", 2 << 20)  # bigger than the whole store
