@@ -441,9 +441,12 @@ class NodeServer:
                              daemon=True, name="node-register").start()
 
     def _register(self, conn: socket.socket, peer) -> None:
+        import cloudpickle
+
+        from ray_tpu.protocol import Frame, JoinReply
         from ray_tpu.util.client.common import (
             recv_msg,
-            send_msg,
+            send_frame,
             server_handshake,
         )
 
@@ -487,7 +490,9 @@ class NodeServer:
             )
             if not accepted:
                 try:
-                    send_msg(conn, {"ok": False, "stale": True})
+                    send_frame(conn, Frame(
+                        kind=Frame.REP,
+                        join_reply=JoinReply(ok=False, stale=True)))
                 except Exception:
                     pass
                 chan.close()
@@ -504,15 +509,15 @@ class NodeServer:
         from ray_tpu.utils.config import get_config
 
         try:
-            send_msg(conn, {
-                "ok": True,
-                "node_id": node_id.binary(),
-                "job_id": rt.job_id.hex(),
-                "config": get_config().snapshot(),
-                "sys_path": list(sys.path),
-                "cwd": os.getcwd(),
-                "reset_workers": reset_workers,
-            })
+            send_frame(conn, Frame(kind=Frame.REP, join_reply=JoinReply(
+                ok=True,
+                node_id=node_id.binary(),
+                job_id=rt.job_id.hex(),
+                config_pickle=cloudpickle.dumps(get_config().snapshot()),
+                sys_path=list(sys.path),
+                cwd=os.getcwd(),
+                reset_workers=reset_workers,
+            )))
         except Exception:
             chan.close()
             rt.kill_node(node_id)
@@ -729,10 +734,11 @@ class NodeDaemon:
         """Connect + handshake + register with the head.  A rejoin
         carries the existing node id and the local object inventory so
         a restarted head can re-pin locations."""
+        from ray_tpu.protocol import Frame, JoinRequest, ObjectMeta
         from ray_tpu.util.client.common import (
             client_handshake,
             recv_msg,
-            send_msg,
+            send_frame,
         )
 
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -740,17 +746,22 @@ class NodeDaemon:
         try:
             sock.connect(self._head_addr)
             client_handshake(sock, self._token or None)
-            hello = {
-                "op": "register",
-                "resources": self._resources,
-                "labels": self._labels,
-                "addr": (self._advertise_host, self.peer_port),
-                "pid": os.getpid(),
-            }
+            # Typed join (raytpu.proto JoinRequest): the head parses the
+            # registration without executing any pickle.
+            join = JoinRequest(
+                resources={k: float(v)
+                           for k, v in (self._resources or {}).items()},
+                labels={k: str(v) for k, v in (self._labels or {}).items()},
+                advertise_host=self._advertise_host or "",
+                peer_port=self.peer_port,
+                pid=os.getpid(),
+            )
             if rejoin:
-                hello["node_id"] = self.node_id.binary()
-                hello["objects"] = self.store.inventory()
-            send_msg(sock, hello)
+                join.node_id = self.node_id.binary()
+                join.objects.extend(
+                    ObjectMeta(id=oid, size=size)
+                    for oid, size in self.store.inventory())
+            send_frame(sock, Frame(kind=Frame.REQ, op="register", join=join))
             welcome = recv_msg(sock)
         except BaseException:
             sock.close()

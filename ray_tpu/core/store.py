@@ -30,6 +30,7 @@ from ray_tpu.utils.serialization import (
     deserialize_object,
     framed_size,
     serialize_parts,
+    try_shm_put,
     write_framed,
 )
 
@@ -163,18 +164,10 @@ class LocalObjectStore:
             shm = (self._shm_store()
                    if size >= self._shm_threshold else None)
             if shm is not None:
-                try:
-                    # Frame straight into the arena — no intermediate copy.
-                    buf = shm.create(oid.binary(), size)
-                    write_framed(buf, meta, buffers)
-                    shm.seal(oid.binary())
+                if try_shm_put(shm, oid.binary(), meta, buffers, size):
                     st.in_shm = True
                     st.shm_size = size
-                except Exception:
-                    # Reclaim a half-written CREATED slot (best-effort);
-                    # a live producer's unsealed slot is invisible to
-                    # eviction and delete, so this frees the bytes.
-                    shm.abort(oid.binary())
+                else:
                     shm = None  # full/unavailable → local tier
             if shm is None:
                 out = bytearray(size)

@@ -42,6 +42,7 @@ from ray_tpu.utils.serialization import (
     deserialize_object,
     framed_size,
     serialize_parts,
+    try_shm_put,
     write_framed,
 )
 
@@ -262,16 +263,7 @@ class WorkerRuntime:
         if self._shm is not None and size >= self._shm_threshold:
             oid_bin = self._chan.call("alloc_put_oid")
             self.refs.adopt(oid_bin)  # owner pre-registered our borrow
-            sealed = False
-            try:
-                buf = self._shm.create(oid_bin, size)
-                write_framed(buf, meta, buffers)
-                self._shm.seal(oid_bin)
-                sealed = True
-            except Exception:
-                # Reclaim a half-written CREATED slot (abort is
-                # best-effort by contract); fall through to inline.
-                self._shm.abort(oid_bin)
+            sealed = try_shm_put(self._shm, oid_bin, meta, buffers, size)
             if sealed:
                 # Outside the try: a ChannelClosedError here is a real
                 # failure (the value IS in the arena), not arena-full.
@@ -511,13 +503,8 @@ class _WorkerServer:
         size = framed_size(meta, buffers)
         if (self._shm is not None and dest_oid is not None
                 and size >= self._shm_threshold):
-            try:
-                buf = self._shm.create(dest_oid, size)
-                write_framed(buf, meta, buffers)
-                self._shm.seal(dest_oid)
+            if try_shm_put(self._shm, dest_oid, meta, buffers, size):
                 return ("shm", size), nested_bins
-            except Exception:
-                self._shm.abort(dest_oid)  # best-effort reclaim
         out = bytearray(size)
         write_framed(memoryview(out), meta, buffers)
         return ("b", bytes(out)), nested_bins

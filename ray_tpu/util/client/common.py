@@ -30,12 +30,12 @@ MAX_FRAME = 1 << 31
 _NONCE_LEN = 32
 TOKEN_ENV = "RAYTPU_CLIENT_TOKEN"
 
-# Wire protocol version (parity: the reference's versioned protobuf
-# schemas, src/ray/protobuf/*.proto — here a single version number
-# negotiated per connection, because frames are cloudpickle and any
-# skew between head/daemon/client would otherwise fail undiagnosably
-# deep inside an op).  Bump on ANY incompatible frame-shape change.
-PROTOCOL_VERSION = 1
+# Wire protocol version, negotiated per connection BEFORE any frame is
+# parsed.  Frames themselves are schema'd protobuf (raytpu.proto Frame)
+# — within a version, proto3 unknown-field semantics absorb additive
+# change; bump this on any incompatible change (frame encoding, op
+# contract, handshake).  v2: cloudpickle envelope → protobuf Frame.
+PROTOCOL_VERSION = 2
 _PREAMBLE = struct.Struct(">4sHH")
 
 
@@ -112,19 +112,114 @@ def client_handshake(sock: socket.socket,
     sock.sendall(_digest(token, head[4:]))
 
 
+def _pb():
+    # Deferred: protocol imports google.protobuf (and may run protoc on
+    # a stale checkout); the handshake helpers above must stay
+    # importable even if that fails.
+    from ray_tpu.protocol import pb
+
+    return pb
+
+
 def send_msg(sock: socket.socket, obj: Any) -> None:
-    payload = cloudpickle.dumps(obj)
+    """Frame ``obj`` as a schema'd protobuf envelope.
+
+    Request/reply dicts (the MsgChannel shapes) map onto Frame fields —
+    mid/kind/op/ok parse without pickle on the far side; only the
+    kwargs / reply value ride as a cloudpickle payload (empty for
+    payload-less ops, e.g. health-check pings).  Anything else is a RAW
+    frame with the whole object pickled.  Typed bodies (join handshake)
+    are sent via send_frame directly.
+    """
+    pb = _pb()
+    f = pb.Frame()
+    kind = obj.get("kind") if isinstance(obj, dict) else None
+    if kind == "req":
+        f.mid = obj["mid"]
+        f.kind = pb.Frame.REQ
+        f.op = obj["op"]
+        rest = {k: v for k, v in obj.items()
+                if k not in ("mid", "kind", "op")}
+        if rest:
+            f.payload = cloudpickle.dumps(rest)
+    elif kind == "rep":
+        f.mid = obj["mid"]
+        f.kind = pb.Frame.REP
+        f.ok = bool(obj.get("ok"))
+        body = obj.get("value") if f.ok else obj.get("error")
+        if body is not None:
+            f.payload = cloudpickle.dumps(body)
+    else:
+        f.kind = pb.Frame.RAW
+        f.payload = cloudpickle.dumps(obj)
+    send_frame(sock, f)
+
+
+def send_frame(sock: socket.socket, frame) -> None:
+    payload = frame.SerializeToString()
     if len(payload) > MAX_FRAME:
         raise ValueError(f"frame too large: {len(payload)}")
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def recv_msg(sock: socket.socket) -> Any:
+def recv_frame(sock: socket.socket):
     header = _recv_exact(sock, _LEN.size)
     (size,) = _LEN.unpack(header)
     if size > MAX_FRAME:
         raise ValueError(f"frame too large: {size}")
-    return cloudpickle.loads(_recv_exact(sock, size))
+    f = _pb().Frame()
+    f.ParseFromString(_recv_exact(sock, size))
+    return f
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    """Receive a Frame and translate back to the dict shapes the
+    channel layer and handlers consume (the inverse of send_msg)."""
+    pb = _pb()
+    f = recv_frame(sock)
+    if f.kind == pb.Frame.REQ:
+        msg = {"mid": f.mid, "kind": "req", "op": f.op}
+        if f.HasField("join"):
+            msg.update(join_request_to_dict(f.join))
+        elif f.payload:
+            msg.update(cloudpickle.loads(f.payload))
+        return msg
+    if f.kind == pb.Frame.REP:
+        if f.HasField("join_reply"):
+            # The join exchange is raw (pre-channel, no mid): hand the
+            # caller the flat welcome dict it consumes.
+            return join_reply_to_dict(f.join_reply)
+        body = cloudpickle.loads(f.payload) if f.payload else None
+        key = "value" if f.ok else "error"
+        return {"mid": f.mid, "kind": "rep", "ok": f.ok, key: body}
+    return cloudpickle.loads(f.payload)
+
+
+def join_request_to_dict(j) -> dict:
+    msg = {
+        "resources": dict(j.resources),
+        "labels": dict(j.labels),
+        "addr": (j.advertise_host, j.peer_port),
+        "pid": j.pid,
+    }
+    if j.node_id:
+        msg["node_id"] = j.node_id
+        msg["objects"] = [(o.id, o.size) for o in j.objects]
+    return msg
+
+
+def join_reply_to_dict(r) -> dict:
+    return {
+        "ok": r.ok,
+        "stale": r.stale,
+        "node_id": r.node_id,
+        "job_id": r.job_id,
+        "config": cloudpickle.loads(r.config_pickle)
+        if r.config_pickle else {},
+        "sys_path": list(r.sys_path),
+        "cwd": r.cwd,
+        "reset_workers": r.reset_workers,
+    }
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

@@ -90,6 +90,32 @@ def framed_size(meta: bytes, buffers: List[memoryview]) -> int:
     return _U32.size + len(meta) + 8 + 8 * len(buffers) + sum(b.nbytes for b in buffers)
 
 
+def try_shm_put(shm, object_id: bytes, meta: bytes,
+                buffers: List[memoryview], size: int) -> bool:
+    """Frame straight into the shared arena: create → write → seal.
+
+    Returns False when the value must fall back to another tier (arena
+    full, store closed, duplicate id, write error), aborting OUR
+    half-written slot on the way out.  The abort fires only after a
+    successful create — a failed create (-EEXIST) means a concurrent
+    same-pid producer owns the in-flight slot and aborting would free
+    bytes it is still writing.  This is THE create→seal protocol; do
+    not inline copies of it (its failure invariant has to change in
+    one place).
+    """
+    created = False
+    try:
+        buf = shm.create(object_id, size)
+        created = True
+        write_framed(buf, meta, buffers)
+        shm.seal(object_id)
+        return True
+    except Exception:
+        if created:
+            shm.abort(object_id)  # best-effort by contract
+        return False
+
+
 def write_framed(out: memoryview, meta: bytes, buffers: List[memoryview]) -> int:
     """Write the frame into ``out`` (e.g. store allocation); returns size."""
     out = out.cast("B") if (out.format != "B" or out.ndim != 1) else out

@@ -1,0 +1,143 @@
+"""Wire schema tests (raytpu.proto Frame envelope + typed join).
+
+Parity: the reference's wire surface is protobuf end-to-end
+(src/ray/protobuf/*.proto); here the envelope and the membership
+contract are schema'd while Python payloads ride as pickle bytes
+inside schema fields (as the reference does for TaskSpec args).
+"""
+import socket
+
+import pytest
+
+from ray_tpu.protocol import Frame, JoinReply, JoinRequest, ObjectMeta
+from ray_tpu.util.client.common import (
+    join_reply_to_dict,
+    join_request_to_dict,
+    recv_frame,
+    recv_msg,
+    send_frame,
+    send_msg,
+)
+
+
+def _pair():
+    return socket.socketpair()
+
+
+def _roundtrip(obj):
+    a, b = _pair()
+    try:
+        send_msg(a, obj)
+        return recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_request_envelope_roundtrip():
+    msg = {"mid": 7, "kind": "req", "op": "lease", "dedicated": True,
+           "n": 3}
+    assert _roundtrip(msg) == msg
+
+
+def test_payloadless_request_has_no_pickle():
+    """Health-check pings must cross the wire without any pickle: the
+    Frame carries only mid/kind/op."""
+    a, b = _pair()
+    try:
+        send_msg(a, {"mid": 1, "kind": "req", "op": "ping"})
+        f = recv_frame(b)
+        assert f.payload == b""
+        assert f.op == "ping" and f.kind == Frame.REQ
+    finally:
+        a.close()
+        b.close()
+
+
+def test_reply_ok_and_error_roundtrip():
+    assert _roundtrip({"mid": 3, "kind": "rep", "ok": True,
+                       "value": [1, "x"]}) == {
+        "mid": 3, "kind": "rep", "ok": True, "value": [1, "x"]}
+    out = _roundtrip({"mid": 4, "kind": "rep", "ok": False,
+                      "error": ValueError("boom")})
+    assert out["ok"] is False
+    assert isinstance(out["error"], ValueError)
+
+
+def test_raw_frame_roundtrip():
+    assert _roundtrip({"op": "put", "data": b"z"}) == {
+        "op": "put", "data": b"z"}
+    assert _roundtrip([1, 2, 3]) == [1, 2, 3]
+
+
+def test_typed_join_roundtrip_without_pickle():
+    join = JoinRequest(resources={"CPU": 4.0}, labels={"zone": "a"},
+                       advertise_host="10.0.0.5", peer_port=1234, pid=99,
+                       node_id=b"n" * 16,
+                       objects=[ObjectMeta(id=b"o" * 28, size=100)])
+    f = Frame(kind=Frame.REQ, op="register", join=join)
+    assert f.payload == b""  # no pickle anywhere in the join frame
+    a, b = _pair()
+    try:
+        send_frame(a, f)
+        hello = recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+    assert hello["op"] == "register"
+    assert hello["resources"] == {"CPU": 4.0}
+    assert hello["labels"] == {"zone": "a"}
+    assert hello["addr"] == ("10.0.0.5", 1234)
+    assert hello["node_id"] == b"n" * 16
+    assert hello["objects"] == [(b"o" * 28, 100)]
+
+
+def test_typed_join_reply_roundtrip():
+    import cloudpickle
+
+    rep = JoinReply(ok=True, node_id=b"x" * 16, job_id="ab" * 8,
+                    config_pickle=cloudpickle.dumps({"k": 1}),
+                    sys_path=["/a"], cwd="/tmp", reset_workers=True)
+    a, b = _pair()
+    try:
+        send_frame(a, Frame(kind=Frame.REP, join_reply=rep))
+        welcome = recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+    assert welcome["ok"] is True
+    assert welcome["node_id"] == b"x" * 16
+    assert welcome["config"] == {"k": 1}
+    assert welcome["reset_workers"] is True
+    # First-join request omits node_id/objects entirely.
+    first = join_request_to_dict(JoinRequest(resources={"CPU": 1.0}))
+    assert "node_id" not in first and "objects" not in first
+    assert join_reply_to_dict(JoinReply(ok=False, stale=True))["stale"]
+
+
+def test_version_skew_is_diagnosed():
+    """A peer speaking a different protocol version is rejected in the
+    preamble with both versions named — never an unpickling error."""
+    import threading
+
+    from ray_tpu.util.client import common
+
+    a, b = _pair()
+    errs = []
+
+    def server():
+        try:
+            common.exchange_versions(b)
+        except ConnectionError as e:
+            errs.append(str(e))
+
+    t = threading.Thread(target=server)
+    t.start()
+    try:
+        a.sendall(common._PREAMBLE.pack(b"RTPW", 999, 0))
+    finally:
+        t.join(timeout=10)
+        a.close()
+        b.close()
+    assert errs and "999" in errs[0] and str(
+        common.PROTOCOL_VERSION) in errs[0]
