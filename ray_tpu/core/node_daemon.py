@@ -195,6 +195,8 @@ class RemoteNodeAgent:
     request used to spawn a fresh worker process nearly every cycle.
     Surplus leases return to the daemon after ``remote_lease_idle_s``."""
 
+    local_lseq = 0  # highest applied local-dispatch delta (view sync ack)
+
     def __init__(self, chan: MsgChannel, node_hex: str):
         self.chan = chan
         self.node_hex = node_hex
@@ -426,6 +428,9 @@ class NodeServer:
         if get_config().health_check_period_s > 0:
             threading.Thread(target=self._health_loop, daemon=True,
                              name="node-health").start()
+        if get_config().resource_view_sync_period_s > 0:
+            threading.Thread(target=self._view_sync_loop, daemon=True,
+                             name="node-view-sync").start()
 
     @property
     def address(self) -> str:
@@ -470,7 +475,17 @@ class NodeServer:
         def handler(chan, msg):
             return self._handle(agent, chan, msg)
 
-        chan = MsgChannel(conn, handler, name=f"node-{peer[0]}")
+        # Bookkeeping ops whose relative order IS the protocol: a local
+        # dispatch's register must be processed before its completion
+        # and before any later ref-drop from the submitting worker —
+        # the concurrent handler pool would reorder them (wire.py
+        # serial_ops runs these on a per-channel FIFO lane).
+        chan = MsgChannel(
+            conn, handler, name=f"node-{peer[0]}",
+            serial_ops=frozenset({
+                "local_task", "local_task_done", "local_task_failed",
+                "ref", "worker_gone",
+            }))
         agent = RemoteNodeAgent(chan, "")
         # Register BEFORE welcome: the daemon's first forwarded op must
         # find the node present.
@@ -545,6 +560,35 @@ class NodeServer:
             return None
         if op == "heartbeat":
             return time.time()
+        if op == "reclaim_leases":
+            # The daemon's local fast path found its pool exhausted by
+            # our cached idle leases — return them now instead of
+            # waiting out remote_lease_idle_s.
+            agent.reap_idle_leases(0.0)
+            return None
+        # Daemon-local dispatch bookkeeping (core/local_dispatch.py):
+        # ordered casts; the lseq rides back on the next view sync so
+        # the daemon can drop its unacked ledger deltas.
+        if op == "local_task":
+            self._rt.register_external_task(
+                msg["task"], msg["returns"], msg["spec"], msg["options"],
+                msg.get("deps") or [], msg.get("demand") or {},
+                msg["wkey"], agent.node_hex, pins=msg.get("pins"))
+            agent.local_lseq = max(agent.local_lseq, msg.get("lseq", 0))
+            return None
+        if op == "local_task_done":
+            self._rt.finish_external_task(
+                msg["task"], msg["returns"], msg["rep"],
+                msg.get("exec_wkey"), agent.node_hex)
+            agent.local_lseq = max(agent.local_lseq, msg.get("lseq", 0))
+            return None
+        if op == "local_task_failed":
+            self._rt.finish_external_task(
+                msg["task"], msg["returns"], None, None, agent.node_hex,
+                error=msg.get("error"),
+                retryable=bool(msg.get("retryable")))
+            agent.local_lseq = max(agent.local_lseq, msg.get("lseq", 0))
+            return None
         key = msg.get("wkey") or f"{agent.node_hex[:12]}/daemon"
         return handle_control_op(self._rt, key, msg,
                                  node_hex=agent.node_hex)
@@ -564,6 +608,28 @@ class NodeServer:
                 agent.reap_idle_leases(cfg.remote_lease_idle_s)
                 threading.Thread(target=self._probe, args=(agent, window),
                                  daemon=True, name="node-probe").start()
+
+    def _view_sync_loop(self) -> None:
+        """Broadcast the cluster resource view to every daemon (parity:
+        the Ray Syncer's periodic resource broadcast,
+        ray_syncer.h:86).  Each cast carries the receiving daemon's
+        highest applied local-dispatch lseq so it can drop unacked
+        ledger deltas; daemons schedule nested submissions against
+        this view without a head round-trip."""
+        from ray_tpu.utils.config import get_config
+
+        period = get_config().resource_view_sync_period_s
+        while not self._closed:
+            time.sleep(period)
+            with self._rt._lock:
+                agents = [n.agent for n in self._rt._nodes.values()
+                          if n.alive and n.agent is not None]
+            if not agents:
+                continue
+            view = self._rt.resource_view()
+            for agent in agents:
+                agent.chan.cast("resource_view", nodes=view,
+                                ack=agent.local_lseq)
 
     def _probe(self, agent: RemoteNodeAgent, window: float) -> None:
         try:
@@ -717,6 +783,9 @@ class NodeDaemon:
         self.log_dir = resolve_log_dir()
         self._rt_shim = _DaemonRT(self, self.store, self.job_id)
         self.pool = make_daemon_pool(self, self._rt_shim)
+        from ray_tpu.core.local_dispatch import LocalDispatcher
+
+        self.local = LocalDispatcher(self)
         from ray_tpu.utils.config import get_config as _gc
 
         self._log_monitor = LogMonitor(
@@ -822,6 +891,9 @@ class NodeDaemon:
         self._rt_shim.job_id = self.job_id
         self.head = MsgChannel(sock, self._handle_head_op, name="head",
                                on_close=self._on_head_lost)
+        # The new head never saw this epoch's local-dispatch casts:
+        # drop view/ledger state and wait for its first sync.
+        self.local.reset()
         if welcome.get("reset_workers"):
             self._reset_workers()
         self.head.start()
@@ -933,9 +1005,16 @@ class NodeDaemon:
         if op == "stats":
             st = self.pool.stats()
             st["store"] = self.store.stats()
+            st["local_dispatch"] = self.local.stats()
             return st
         if op == "ping":
             return "pong"
+        if op == "resource_view":
+            self.local.on_view(msg["nodes"], msg.get("ack", 0))
+            return None
+        if op == "cancel_local":
+            self.local.cancel(msg["task"], bool(msg.get("force")))
+            return None
         if op == "shutdown":
             self._exit.set()
             return None
@@ -981,6 +1060,19 @@ class NodeDaemon:
             kind, payload = msg["entry"]
             if kind == "shm":
                 self.store.mark_shm_sealed(ObjectID(msg["oid"]), payload)
+            return self._forward(chan, msg)
+        if op == "submit_task":
+            # Local fast path over the synced resource view (parity:
+            # raylet-local scheduling — core/local_dispatch.py); falls
+            # through to the head when ineligible.
+            rep = self.local.maybe_submit(msg, chan)
+            if rep is not None:
+                return rep
+            return self._forward(chan, msg)
+        if op == "available_resources":
+            view = self.local.cluster_available()
+            if view is not None:
+                return view  # served from the synced view, no head RPC
             return self._forward(chan, msg)
         # Everything else is control-plane: forward to the head with
         # this worker's borrower key attached.

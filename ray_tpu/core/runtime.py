@@ -278,6 +278,16 @@ class _PendingTask:
     waiting_on: Optional[set] = None
     # Resource demand, computed once at submission (hot path).
     demand: Optional[Dict[str, float]] = None
+    # Explicit dependency list (nested submissions ship WireRef args +
+    # a deps list instead of live handles — parity: TaskSpec's
+    # dependency ids).  None → collect ObjectRefs from args/kwargs.
+    arg_oids: Optional[List[ObjectID]] = None
+    # Head-side handles pinning explicit deps (same lifetime as the
+    # handles that live inside args on the normal path).
+    arg_refs: Optional[list] = None
+    # Pickled (fn, args, kwargs) of a daemon-dispatched task; hydrated
+    # lazily only if the head must re-run it (retry, reconstruction).
+    spec_blob: Optional[bytes] = None
 
 
 class _CachedThreadPool:
@@ -1200,6 +1210,16 @@ class LocalRuntime:
         # reference counts reconstruction against the retry budget).
         self._reconstructing: set = set()
         self._recon_attempts: Dict[int, int] = {}
+        # Daemon-dispatched (external) tasks in flight: task_bin →
+        # {"pt", "node_hex", "acquired"} (see register_external_task).
+        self._external: Dict[bytes, Dict[str, Any]] = {}
+        # Completion casts with no matching register: same-epoch
+        # reordering CANNOT happen (local_task/done/failed ride the
+        # node channel's serial FIFO lane — wire.py serial_ops, which
+        # is load-bearing, do not remove it); what lands here is
+        # stale-epoch garbage after a head restart, absorbed bounded
+        # and consumed by a register only in pathological replays.
+        self._external_early: Dict[bytes, Dict[str, Any]] = {}
         # Running normal tasks, for cancellation: task_id → {"pt", and
         # "thread" (thread mode) or "worker" (process mode)} (parity:
         # the executing-tasks map HandleCancelTask consults).
@@ -1469,6 +1489,7 @@ class LocalRuntime:
             if lost:
                 self._reserve_bundles(st, lost)
         self._recover_lost_objects(node_id)
+        self._reroute_external_on_node_death(node_id.hex())
         self.pubsub.publish("node", {"event": "died",
                                      "node_id": node_id.hex()})
         self._notify()
@@ -1518,6 +1539,11 @@ class LocalRuntime:
             pt = self._lineage.get(oid)
             if pt is None:
                 pt_missing = True
+            elif pt.task_id.binary() in self._external:
+                # Still running on its daemon: the node-death reroute
+                # owns re-enqueue; a fetch-triggered rebuild here would
+                # double-run it.
+                return
             else:
                 pt_missing = False
                 key = id(pt)
@@ -1528,6 +1554,7 @@ class LocalRuntime:
                     exhausted = True
                 else:
                     exhausted = False
+                    self._hydrate_external(pt)  # no-op for normal tasks
                     self._recon_attempts[key] = attempts + 1
                     self._reconstructing.add(key)
                     options = pt.options
@@ -1689,6 +1716,19 @@ class LocalRuntime:
 
     # -- ownership / GC ----------------------------------------------------
 
+    def _record_lineage_locked(self, return_ids: Sequence[ObjectID],
+                               pt: _PendingTask) -> None:
+        """Insert into the lineage table with cap eviction.  Evicting
+        lineage also drops the location entry and reconstruction
+        counters — the three tables stay bounded together.  Caller
+        holds _lock."""
+        for oid in return_ids:
+            self._lineage[oid] = pt
+        while len(self._lineage) > self._lineage_cap:
+            old_oid, old_pt = self._lineage.popitem(last=False)
+            self._object_locations.pop(old_oid, None)
+            self._recon_attempts.pop(id(old_pt), None)
+
     def _pin_returns(self, return_ids: Sequence[ObjectID]) -> None:
         """Pin task-return oids from submission until seal, so dropping
         the future before the task finishes can't free the slot under
@@ -1801,14 +1841,24 @@ class LocalRuntime:
 
     def resolve_args(self, args: tuple, kwargs: dict) -> Tuple[tuple, dict]:
         """Replace top-level ObjectRef args with their values
-        (parity: LocalDependencyResolver inlining)."""
+        (parity: LocalDependencyResolver inlining).  Wire-form specs
+        (nested submissions) carry WireRef("fetch") markers instead of
+        handles — resolve those too so a re-enqueued external task can
+        execute in-process."""
+        from ray_tpu.core.wire import WireRef
 
         def res(v):
-            return self.get(v) if isinstance(v, ObjectRef) else v
+            if isinstance(v, ObjectRef):
+                return self.get(v)
+            if isinstance(v, WireRef) and v.kind == "fetch":
+                return self.get(ObjectRef(ObjectID(v.oid)))
+            return v
 
         return tuple(res(a) for a in args), {k: res(v) for k, v in kwargs.items()}
 
     def _task_arg_oids(self, pt: _PendingTask) -> List[ObjectID]:
+        if pt.arg_oids is not None:
+            return pt.arg_oids
         return [v.id for v in list(pt.args) + list(pt.kwargs.values())
                 if isinstance(v, ObjectRef)]
 
@@ -2105,7 +2155,9 @@ class LocalRuntime:
 
     def submit_task(self, fn: Callable, args: tuple, kwargs: dict,
                     options: TaskOptions,
-                    trace_ctx: Optional[Dict[str, str]] = None
+                    trace_ctx: Optional[Dict[str, str]] = None,
+                    arg_oids: Optional[List[ObjectID]] = None,
+                    pin_oids: Optional[List[ObjectID]] = None,
                     ) -> List[ObjectRef]:
         demand = options.resource_demand()
         strategy = options.effective_strategy()
@@ -2135,6 +2187,14 @@ class LocalRuntime:
                        else _tracing().capture_context()),
         )
         pt.demand = demand  # computed once; dispatch + events reuse it
+        if arg_oids is not None:
+            # Nested submission with wire-form args: pin the explicit
+            # deps AND the pin-only inner refs with head-side handles
+            # (the normal path pins both via the ObjectRef instances
+            # living inside pt.args).  Only arg_oids park the task.
+            pt.arg_oids = arg_oids
+            pt.arg_refs = [ObjectRef(o)
+                           for o in arg_oids + list(pin_oids or ())]
         self.events.record(
             task_id.hex(), _ev.PENDING_NODE_ASSIGNMENT,
             name=pt.function_name, type=_ev.NORMAL_TASK,
@@ -2142,15 +2202,7 @@ class LocalRuntime:
         )
         if not streaming:
             with self._lock:
-                for oid in return_ids:
-                    self._lineage[oid] = pt
-                while len(self._lineage) > self._lineage_cap:
-                    # Evicting lineage also drops the location entry and
-                    # reconstruction counters — all three tables stay
-                    # bounded together.
-                    old_oid, old_pt = self._lineage.popitem(last=False)
-                    self._object_locations.pop(old_oid, None)
-                    self._recon_attempts.pop(id(old_pt), None)
+                self._record_lineage_locked(return_ids, pt)
         self._enqueue_task(pt)
         if streaming:
             from ray_tpu.core.generator import ObjectRefGenerator
@@ -2483,6 +2535,189 @@ class LocalRuntime:
         if worker_key is not None:
             self.apply_ref_batches(rep, worker_key, which="rem")
 
+    # -- daemon-dispatched (external) tasks --------------------------------
+    #
+    # Parity: raylet-local scheduling over the Ray Syncer's resource
+    # view — a daemon dispatches its workers' nested submissions onto
+    # its own pool and the head only does the owner-side bookkeeping,
+    # off the submit critical path (see core/local_dispatch.py).
+
+    def register_external_task(self, task_bin: bytes,
+                               return_bins: List[bytes], spec: bytes,
+                               options: TaskOptions,
+                               deps: List[bytes],
+                               demand: Dict[str, float],
+                               submit_wkey: str, node_hex: str,
+                               pins: Optional[List[bytes]] = None,
+                               ) -> None:
+        """Owner-side bookkeeping for a task a daemon dispatched
+        locally: return-oid pins + submitter borrows, explicit-dep
+        pins, lineage (lazily hydratable from ``spec``), events, and
+        the cached-ledger debit.  Applied from the daemon's ordered
+        cast, so it lands before any later ref-drop or get that could
+        mention these ids."""
+        task_id = TaskID(task_bin)
+        return_ids = [ObjectID(b) for b in return_bins]
+        self._pin_returns(return_ids)
+        pt = _PendingTask(
+            fn=None, args=(), kwargs={}, options=options,
+            return_ids=return_ids, retries_left=options.max_retries,
+            task_id=task_id,
+            function_name=options.name or "nested",
+            spec_blob=spec,
+            arg_oids=[ObjectID(b) for b in deps],
+        )
+        pt.arg_refs = [ObjectRef(ObjectID(b))
+                       for b in list(deps) + list(pins or ())]
+        pt.demand = demand
+        node = self.node_by_hex(node_hex)
+        if node is None or not node.alive:
+            # The daemon died between sending this cast and its
+            # processing — the node-death reroute already ran (and
+            # found nothing), and no completion cast will ever come.
+            # Re-run through the normal scheduler instead of
+            # registering an orphan (reconstruction explicitly skips
+            # in-flight external tasks).  Safe double-run-wise: the
+            # dead daemon's workers are killed on rejoin.
+            self._hydrate_external(pt)
+            with self._lock:
+                self._record_lineage_locked(return_ids, pt)
+            for b in return_bins:
+                self.refs.add_borrow(submit_wkey, ObjectID(b))
+            self._enqueue_task(pt)
+            return
+        acquired = bool(node.pool.try_acquire(demand))
+        with self._lock:
+            self._record_lineage_locked(return_ids, pt)
+            self._external[task_bin] = {
+                "pt": pt, "node_hex": node_hex, "acquired": acquired,
+            }
+        for b in return_bins:
+            self.refs.add_borrow(submit_wkey, ObjectID(b))
+        self.events.record(
+            task_id.hex(), _ev.PENDING_NODE_ASSIGNMENT,
+            name=pt.function_name, type=_ev.NORMAL_TASK,
+            job_id=self.job_id.hex(), required_resources=demand,
+        )
+        self.events.record(task_id.hex(), _ev.RUNNING,
+                           node_id=node_hex)
+        # Defensive only: the serial lane orders register before its
+        # completion within an epoch, so a hit here means a replayed
+        # stale completion — applying it beats orphaning the task.
+        with self._lock:
+            early = self._external_early.pop(task_bin, None)
+        if early is not None:
+            self.finish_external_task(task_bin, return_bins, **early)
+
+    def _hydrate_external(self, pt: _PendingTask) -> None:
+        """Materialize fn/args/kwargs from the cast's spec — only when
+        the head itself must re-run the task (retry after a local
+        worker crash, reconstruction after node loss).  Args hold
+        WireRef("fetch") markers, so a re-dispatch executes on any
+        node."""
+        if pt.fn is not None or pt.spec_blob is None:
+            return
+        import cloudpickle
+
+        pt.fn, pt.args, pt.kwargs = cloudpickle.loads(pt.spec_blob)
+
+    def _release_external(self, rec: Dict[str, Any]) -> None:
+        if rec.get("acquired"):
+            node = self.node_by_hex(rec["node_hex"])
+            if node is not None:
+                node.pool.release(rec["pt"].demand or {})
+            rec["acquired"] = False
+
+    def finish_external_task(self, task_bin: bytes,
+                             return_bins: List[bytes],
+                             rep: Optional[Dict[str, Any]],
+                             exec_wkey: Optional[str],
+                             node_hex: str,
+                             error: Optional[BaseException] = None,
+                             retryable: bool = False) -> None:
+        """Completion of a daemon-dispatched task.  Success seals the
+        results (shm entries as locations on the executing node);
+        an app failure seals the error; an infra failure (local worker
+        crash) re-enqueues through the normal scheduler while retries
+        remain — the same retry semantics the head path has."""
+        with self._lock:
+            rec = self._external.pop(task_bin, None)
+            if rec is None:
+                # Unknown epoch (head restart) — or a register that
+                # re-routed at a dead node.  Park bounded; mostly
+                # garbage that ages out of the cap.
+                self._external_early[task_bin] = {
+                    "rep": rep, "exec_wkey": exec_wkey,
+                    "node_hex": node_hex, "error": error,
+                    "retryable": retryable,
+                }
+                while len(self._external_early) > 10000:
+                    self._external_early.pop(
+                        next(iter(self._external_early)))
+                return
+        pt: _PendingTask = rec["pt"]
+        self._release_external(rec)
+        task_id = pt.task_id
+        if rep is not None:
+            self.seal_remote_results(pt.return_ids, rep, exec_wkey,
+                                     node_hex=node_hex)
+            self.events.record(task_id.hex(), _ev.FINISHED)
+            self._notify()
+            return
+        if retryable and not pt.cancelled and pt.retries_left > 0:
+            pt.retries_left -= 1
+            self._hydrate_external(pt)
+            self.events.record(task_id.hex(), _ev.PENDING_NODE_ASSIGNMENT,
+                               name=pt.function_name)
+            self._enqueue_task(pt)
+            return
+        from ray_tpu.core.exceptions import TaskError
+
+        if pt.cancelled:
+            self._seal_cancelled(task_id, pt.return_ids, pt.streaming)
+            self.events.record(task_id.hex(), _ev.FAILED,
+                               error_message="cancelled")
+        else:
+            err = error if error is not None else TaskError(
+                f"task {task_id.hex()[:12]} failed on node "
+                f"{node_hex[:12]}")
+            for oid in pt.return_ids:
+                self.store.put_error(oid, err)
+            self.events.record(task_id.hex(), _ev.FAILED,
+                               error_message=repr(err))
+        self._notify()
+
+    def _reroute_external_on_node_death(self, node_hex: str) -> None:
+        """Daemon died with local tasks in flight: re-enqueue each one
+        through the normal scheduler (retries permitting) — the cast
+        gave the head everything it needs to re-run them elsewhere."""
+        with self._lock:
+            doomed = [(b, rec) for b, rec in self._external.items()
+                      if rec["node_hex"] == node_hex]
+        from ray_tpu.core.exceptions import WorkerDiedError
+
+        for task_bin, rec in doomed:
+            self.finish_external_task(
+                task_bin, [o.binary() for o in rec["pt"].return_ids],
+                None, None, node_hex,
+                error=WorkerDiedError(f"node {node_hex[:12]} died"),
+                retryable=True)
+
+    def resource_view(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        """Seq-free per-node availability snapshot for the view sync
+        (parity: the Ray Syncer's NodeResourceInfo broadcast)."""
+        out: Dict[str, Dict[str, Dict[str, float]]] = {}
+        with self._lock:
+            nodes = list(self._nodes.values())
+        for n in nodes:
+            if not n.alive:
+                continue
+            out[n.node_id.hex()] = {
+                "available": dict(n.pool.available),
+                "total": dict(n.pool.total),
+            }
+        return out
+
     def _notify(self):
         with self._dispatch_cv:
             self._dispatch_cv.notify_all()
@@ -2548,6 +2783,19 @@ class LocalRuntime:
                 target.on_done()
             self.events.record(task_id.hex(), _ev.FAILED,
                                error_message="cancelled")
+            return
+        # 1b. Running on a node daemon's local fast path: mark, then
+        # ask THAT daemon (the head never held the worker lease).
+        with self._lock:
+            rec = self._external.get(task_id.binary())
+            if rec is not None:
+                rec["pt"].cancelled = True
+                node = self._nodes.get(
+                    NodeID(bytes.fromhex(rec["node_hex"])))
+        if rec is not None:
+            if node is not None and node.agent is not None:
+                node.agent.chan.cast("cancel_local",
+                                     task=task_id.binary(), force=force)
             return
         # 2. Running normal task.
         wh = None

@@ -105,11 +105,21 @@ class _Reply:
 
 
 class MsgChannel:
-    """One socket, two directions, mid-correlated request/reply."""
+    """One socket, two directions, mid-correlated request/reply.
+
+    ``serial_ops``: ops whose handlers must run in SOCKET ORDER
+    relative to each other (bookkeeping sequences like register→done→
+    ref-drop, where handler-pool concurrency would reorder them).
+    They run on a per-channel single-thread FIFO lane, enqueued
+    directly from the reader loop; everything else keeps the
+    concurrent pool (blocking handlers like nested gets must never
+    stall the lane).
+    """
 
     def __init__(self, sock, handler: Callable[["MsgChannel", Dict], Any],
                  name: str = "chan",
-                 on_close: Optional[Callable[[], None]] = None):
+                 on_close: Optional[Callable[[], None]] = None,
+                 serial_ops: Optional[frozenset] = None):
         self._sock = sock
         self._handler = handler
         self._name = name
@@ -120,6 +130,9 @@ class MsgChannel:
         self._pending_lock = threading.Lock()
         self.closed = False
         self._reader: Optional[threading.Thread] = None
+        self._serial_ops = serial_ops or frozenset()
+        self._serial_q: Optional["collections.deque"] = None
+        self._serial_cv: Optional[threading.Condition] = None
 
     def start(self) -> "MsgChannel":
         self._reader = threading.Thread(
@@ -197,7 +210,43 @@ class MsgChannel:
                         else msg.get("error")
                     rep.event.set()
             elif kind == "req":
-                _handler_pool.submit(lambda m=msg: self._run_handler(m))
+                if msg.get("op") in self._serial_ops:
+                    self._serial_submit(msg)
+                else:
+                    _handler_pool.submit(lambda m=msg: self._run_handler(m))
+
+    def _serial_submit(self, msg: Dict) -> None:
+        """Enqueue onto this channel's FIFO lane (created lazily —
+        only the reader thread calls this, so no init race); the lane
+        thread drains in read order and exits when idle."""
+        if self._serial_cv is None:
+            self._serial_cv = threading.Condition()
+            self._serial_q = collections.deque()
+        spawn = False
+        with self._serial_cv:
+            self._serial_q.append(msg)
+            self._serial_cv.notify()
+            if not getattr(self, "_serial_running", False):
+                self._serial_running = True
+                spawn = True
+        if spawn:
+            threading.Thread(target=self._serial_loop, daemon=True,
+                             name=f"{self._name}-serial").start()
+
+    def _serial_loop(self) -> None:
+        import time as _time
+
+        while True:
+            with self._serial_cv:
+                deadline = _time.monotonic() + 2.0
+                while not self._serial_q:
+                    left = deadline - _time.monotonic()
+                    if left <= 0 or not self._serial_cv.wait(left):
+                        if not self._serial_q:
+                            self._serial_running = False
+                            return
+                msg = self._serial_q.popleft()
+            self._run_handler(msg)
 
     def _run_handler(self, msg: Dict) -> None:
         mid = msg.get("mid")

@@ -299,10 +299,36 @@ class WorkerRuntime:
 
     def submit_task(self, fn, args, kwargs, options):
         from ray_tpu.util import tracing
+        from ray_tpu.core.object_ref import collect_nested_refs
 
+        # Ship the spec in wire form: top-level ObjectRef args become
+        # location-agnostic WireRef("fetch") markers the EXECUTING
+        # worker resolves through its own daemon, and the dependency
+        # ids travel explicitly (parity: TaskSpec's dependency list).
+        # This is what lets the host daemon dispatch the task locally
+        # without unpickling anything (core/local_dispatch.py), and
+        # the head park it on deps without live handles.
+        def wire(v):
+            if isinstance(v, ObjectRef):
+                return WireRef("fetch", None, v.id.binary())
+            return v
+
+        top = [v.id.binary() for v in list(args) + list(kwargs.values())
+               if isinstance(v, ObjectRef)]
+        wargs = tuple(wire(a) for a in args)
+        wkwargs = {k: wire(v) for k, v in kwargs.items()}
+        with collect_nested_refs() as inner:
+            spec = cloudpickle.dumps((fn, wargs, wkwargs))
+        deps = list(dict.fromkeys(top))
+        # Refs nested INSIDE container args are pinned by the owner but
+        # are NOT scheduling dependencies (the task may never get()
+        # them) — same top-level-only parking contract as the driver
+        # path.
+        pins = [b for b in dict.fromkeys(o.binary() for o in inner)
+                if b not in set(deps)]
         rep = self._chan.call(
-            "submit_task", spec=cloudpickle.dumps((fn, args, kwargs)),
-            options=options, trace_ctx=tracing.capture_context(),
+            "submit_task", spec=spec, options=options, deps=deps,
+            pins=pins, trace_ctx=tracing.capture_context(),
         )
         if "stream" in rep:
             from ray_tpu.core.generator import ObjectRefGenerator

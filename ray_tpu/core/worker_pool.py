@@ -572,8 +572,15 @@ def handle_control_op(rt, key: str, msg: Dict[str, Any],
     if op == "submit_task":
         fn, args, kwargs = cloudpickle.loads(msg["spec"])
         options = msg["options"]
-        out = rt.submit_task(fn, args, kwargs, options,
-                             trace_ctx=msg.get("trace_ctx"))
+        deps = msg.get("deps")
+        out = rt.submit_task(
+            fn, args, kwargs, options, trace_ctx=msg.get("trace_ctx"),
+            # Wire-form specs (WireRef args) carry explicit dep ids the
+            # dependency index parks on in place of live handles, plus
+            # pin-only inner refs.
+            arg_oids=(None if deps is None
+                      else [ObjectID(b) for b in deps]),
+            pin_oids=[ObjectID(b) for b in msg.get("pins") or ()])
         if options.num_returns == "streaming":
             return {"stream": out.task_id.binary()}
         # Pre-register the caller's borrows: the worker constructs

@@ -111,6 +111,11 @@ D("scheduler_top_k_fraction", float, 0.2,
 D("worker_lease_timeout_s", float, 30.0, "Worker lease request timeout.")
 D("max_pending_lease_requests_per_scheduling_class", int, 10,
   "Pipelined lease requests per distinct (fn, resources) class.")
+D("resource_view_sync_period_s", float, 0.25,
+  "Head→daemon resource-view broadcast period (parity: the Ray "
+  "Syncer's resource gossip).  Daemons schedule their workers' nested "
+  "submissions locally against this view — bounded overcommit within "
+  "one period; 0 disables the sync AND the daemon-local fast path.")
 D("remote_lease_idle_s", float, 10.0,
   "Head-side cached worker leases idle this long return to their node "
   "daemon (lease pipelining parity: OnWorkerIdle keeps leased workers "

@@ -157,6 +157,39 @@ def bench_cross_daemon(ray_tpu, n: int) -> None:
         emit("cross_daemon_tasks_per_second",
              _rate(n, time.perf_counter() - t0), "1/s")
 
+        # Nested fan-out: a worker ON a daemon submits children its own
+        # daemon can run — the local fast path over the synced resource
+        # view (core/local_dispatch.py; parity: raylet-local scheduling
+        # of nested submissions over the Ray Syncer's view).  Measures
+        # the submitter-observed rate with the head off the hot path.
+        @ray_tpu.remote(num_cpus=0.001, resources={"slot": 0.0001})
+        def nested_parent(k):
+            import time as _t
+
+            dl = _t.time() + 10
+            while (_t.time() < dl
+                   and ray_tpu.available_resources().get("CPU", 0) <= 0):
+                _t.sleep(0.1)
+
+            @ray_tpu.remote(num_cpus=0.001)
+            def child():
+                return None
+
+            ray_tpu.get([child.remote() for _ in range(32)])  # warm
+            t0 = _t.perf_counter()
+            ray_tpu.get([child.remote() for _ in range(k)])
+            return k / (_t.perf_counter() - t0)
+
+        k = max(200, n // 4)
+        rate = ray_tpu.get(nested_parent.remote(k))
+        emit("nested_local_dispatch_tasks_per_second", round(rate, 1),
+             "1/s")
+        st = [x for x in rt._nodes.values() if x.agent is not None]
+        local = sum(x.agent.stats()["local_dispatch"]["dispatched"]
+                    for x in st)
+        emit("nested_local_dispatch_fraction",
+             round(local / max(1, k + 32), 3), "")
+
         @ray_tpu.remote(num_cpus=0.001, resources={"slot": 0.4},
                         max_concurrency=4)
         class A:

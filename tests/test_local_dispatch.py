@@ -1,0 +1,279 @@
+"""Daemon-local dispatch over the synced resource view.
+
+Parity targets: the reference's Ray Syncer resource broadcast
+(ray: src/ray/common/ray_syncer/ray_syncer.h:86) and raylet-local
+scheduling of nested submissions (a worker's child tasks are scheduled
+by its OWN raylet, not the GCS).  Here: the head broadcasts the
+per-node resource view to every daemon; a daemon runs its workers'
+eligible nested submissions on its own pool with fire-and-forget
+bookkeeping casts to the head (ray_tpu/core/local_dispatch.py).
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.node_daemon import NodeServer
+from ray_tpu.core.placement_group import NodeAffinitySchedulingStrategy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_daemon(port, *, num_cpus=3, labels="{}"):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("RAYTPU_WORKERS", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_daemon",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", str(num_cpus),
+         "--labels", labels],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _wait_nodes(rt, n, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if sum(1 for x in rt.nodes() if x["Alive"]) >= n:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"cluster never reached {n} nodes")
+
+
+class _Cluster:
+    def __init__(self, rt, server, procs):
+        self.rt = rt
+        self.server = server
+        self.procs = procs
+
+    def daemon_nodes(self):
+        return [n for n in self.rt._nodes.values()
+                if n.agent is not None and n.alive]
+
+    def affinity(self, node):
+        return NodeAffinitySchedulingStrategy(node.node_id.hex(),
+                                              soft=False)
+
+    def dispatch_stats(self):
+        out = {}
+        for n in self.daemon_nodes():
+            out[n.node_id.hex()] = n.agent.stats()["local_dispatch"]
+        return out
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=2)
+    server = NodeServer(rt, host="127.0.0.1", port=0)
+    procs = [_spawn_daemon(server.port, labels='{"daemon": "d%d"}' % i)
+             for i in range(2)]
+    _wait_nodes(rt, 3)
+    yield _Cluster(rt, server, procs)
+    for p in procs:
+        p.kill()
+    server.close()
+    ray_tpu.shutdown()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except Exception:
+            pass
+
+
+def _wait_view(timeout=10):
+    """Inside a worker: spin until the host daemon's synced view serves
+    available_resources (the fast path needs a fresh view)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) > 0:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_nested_fanout_dispatches_locally(cluster):
+    node = cluster.daemon_nodes()[0]
+
+    @ray_tpu.remote(num_cpus=1)
+    def parent(n):
+        assert _wait_view()
+
+        @ray_tpu.remote(num_cpus=1)
+        def child(i):
+            return i * i
+
+        return ray_tpu.get([child.remote(i) for i in range(n)])
+
+    out = ray_tpu.get(
+        parent.options(scheduling_strategy=cluster.affinity(node))
+        .remote(40))
+    assert out == [i * i for i in range(40)]
+    # The fan-out must have run on the daemon's local fast path, and
+    # every local dispatch must have completed (conservation).
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        st = cluster.dispatch_stats()[node.node_id.hex()]
+        if st["dispatched"] >= 20 and st["inflight"] == 0 \
+                and st["completed"] == st["dispatched"]:
+            break
+        time.sleep(0.2)
+    assert st["dispatched"] >= 20, st
+    assert st["completed"] == st["dispatched"], st
+    assert st["inflight"] == 0, st
+
+
+def test_nested_results_reach_the_driver(cluster):
+    """Refs minted by the daemon resolve anywhere: the driver pulls a
+    large (arena) result and a small (inline) one across the wire."""
+    node = cluster.daemon_nodes()[0]
+
+    @ray_tpu.remote(num_cpus=1)
+    def parent():
+        _wait_view()
+
+        @ray_tpu.remote(num_cpus=1)
+        def big():
+            return np.arange(300_000, dtype=np.float32)
+
+        @ray_tpu.remote(num_cpus=1)
+        def small():
+            return {"tiny": 1}
+
+        return big.remote(), small.remote()
+
+    big_ref, small_ref = ray_tpu.get(
+        parent.options(scheduling_strategy=cluster.affinity(node))
+        .remote())
+    arr = ray_tpu.get(big_ref)
+    np.testing.assert_array_equal(arr, np.arange(300_000,
+                                                 dtype=np.float32))
+    assert ray_tpu.get(small_ref) == {"tiny": 1}
+
+
+def test_nested_deps_and_strategies_fall_back(cluster):
+    """Ineligible submissions (affinity strategy; dep produced by the
+    parent but living at the head) forward to the head and still give
+    correct results."""
+    n0, n1 = cluster.daemon_nodes()[:2]
+    other_hex = n1.node_id.hex()
+
+    @ray_tpu.remote(num_cpus=1)
+    def parent(other):
+        _wait_view()
+
+        @ray_tpu.remote(num_cpus=1)
+        def here():
+            return os.getpid()
+
+        @ray_tpu.remote(num_cpus=1)
+        def add(a, b):
+            return a + b
+
+        pinned = here.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                other, soft=False)).remote()
+        x = ray_tpu.put(5)
+        chained = add.remote(x, 2)  # dep is local: fast path ok
+        return ray_tpu.get(pinned), ray_tpu.get(chained)
+
+    pid, s = ray_tpu.get(
+        parent.options(scheduling_strategy=cluster.affinity(n0))
+        .remote(other_hex))
+    assert s == 7
+    assert pid != os.getpid()
+
+
+def test_nested_failure_surfaces_to_submitter(cluster):
+    node = cluster.daemon_nodes()[0]
+
+    @ray_tpu.remote(num_cpus=1)
+    def parent():
+        assert _wait_view()
+
+        @ray_tpu.remote(num_cpus=1)
+        def boom():
+            raise ValueError("nested-boom")
+
+        try:
+            ray_tpu.get(boom.remote())
+            return "no-error"
+        except Exception as e:
+            return repr(e)
+
+    out = ray_tpu.get(
+        parent.options(scheduling_strategy=cluster.affinity(node))
+        .remote())
+    assert "nested-boom" in out
+
+
+def test_worker_crash_hands_task_back_to_head(cluster):
+    """A local worker crash mid-task re-enqueues the task through the
+    head's scheduler (retryable infra failure), which re-runs it —
+    possibly on another node — to completion."""
+    node = cluster.daemon_nodes()[0]
+    flag = os.path.join(tempfile.gettempdir(),
+                        f"raytpu-crash-once-{os.getpid()}")
+    if os.path.exists(flag):
+        os.unlink(flag)
+
+    @ray_tpu.remote(num_cpus=1)
+    def parent(flag):
+        assert _wait_view()
+
+        @ray_tpu.remote(num_cpus=1, max_retries=2)
+        def crash_once(flag):
+            if not os.path.exists(flag):
+                open(flag, "w").close()
+                os._exit(1)
+            return "survived"
+
+        return ray_tpu.get(crash_once.remote(flag))
+
+    try:
+        out = ray_tpu.get(
+            parent.options(scheduling_strategy=cluster.affinity(node))
+            .remote(flag), timeout=120)
+        assert out == "survived"
+    finally:
+        if os.path.exists(flag):
+            os.unlink(flag)
+
+
+def test_ledger_conservation_after_fanout(cluster):
+    """Once the dust settles, the head's per-node availability matches
+    totals again — every local debit was matched by a credit."""
+    node = cluster.daemon_nodes()[0]
+
+    @ray_tpu.remote(num_cpus=1)
+    def parent(n):
+        _wait_view()
+
+        @ray_tpu.remote(num_cpus=1)
+        def child():
+            return 1
+
+        return sum(ray_tpu.get([child.remote() for _ in range(n)]))
+
+    assert ray_tpu.get(
+        parent.options(scheduling_strategy=cluster.affinity(node))
+        .remote(30)) == 30
+    deadline = time.time() + 15
+    ok = False
+    while time.time() < deadline and not ok:
+        view = cluster.rt.resource_view()
+        ok = all(
+            abs(entry["available"].get("CPU", 0)
+                - entry["total"].get("CPU", 0)) < 1e-6
+            for entry in view.values())
+        if not ok:
+            time.sleep(0.3)
+    assert ok, cluster.rt.resource_view()
