@@ -13,18 +13,19 @@ Distributed sampling uses EnvRunner actors over ray_tpu.core.
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.algorithms import (A2C, DQN, IMPALA, PPO, SAC, TD3,
-                                      A2CConfig, DQNConfig, IMPALAConfig,
-                                      PPOConfig, SACConfig, TD3Config,
-                                      vtrace)
+from ray_tpu.rllib.algorithms import (A2C, APPO, DDPG, DQN, IMPALA, PPO,
+                                      SAC, TD3, A2CConfig, APPOConfig,
+                                      DDPGConfig, DQNConfig,
+                                      IMPALAConfig, PPOConfig, SACConfig,
+                                      TD3Config, vtrace)
 from ray_tpu.rllib.env import (CartPole, ExternalEnv, Pendulum, make_env,
                                register_env)
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
 from ray_tpu.rllib.models import ActorCritic
 from ray_tpu.rllib.multi_agent import (MultiAgentPPO, MultiAgentPPOConfig,
                                        TwoAgentReach)
-from ray_tpu.rllib.offline import (BC, BCConfig, CQL, CQLConfig,
-                                   OfflineDataset)
+from ray_tpu.rllib.offline import (BC, BCConfig, CQL, CQLConfig, MARWIL,
+                                   MARWILConfig, OfflineDataset)
 from ray_tpu.rllib.connectors import (ClipActions, Connector,
                                       ConnectorPipeline,
                                       FlattenObservations, FrameStack,
@@ -40,7 +41,8 @@ __all__ = [
     "PPO", "PPOConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
     "SAC", "SACConfig", "MultiAgentPPO", "MultiAgentPPOConfig",
     "TwoAgentReach", "BC", "BCConfig", "CQL", "CQLConfig",
-    "OfflineDataset",
+    "MARWIL", "MARWILConfig", "OfflineDataset",
+    "APPO", "APPOConfig", "DDPG", "DDPGConfig",
     "vtrace",
     "CartPole", "Pendulum", "ExternalEnv", "make_env", "register_env",
     "EnvRunnerGroup", "ActorCritic",

@@ -1,0 +1,83 @@
+"""APPO — asynchronous PPO (IMPALA architecture, PPO surrogate loss).
+
+Parity target: the reference's APPO (ray: rllib/algorithms/appo/ —
+IMPALA's async EnvRunner/learner decoupling with V-trace off-policy
+correction, but the PPO clipped-surrogate objective instead of the
+plain V-trace policy gradient).  Reuses this package's IMPALA
+machinery (EnvRunnerGroup, async in-flight rollouts, one jit'd update)
+and swaps the loss: ratio = exp(logp_target − logp_behavior), advantage
+from V-trace, clipped surrogate with the usual ε window.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+
+    @property
+    def algo_class(self):
+        return APPO
+
+
+class APPO(IMPALA):
+    config_class = APPOConfig
+
+    def _setup(self) -> None:
+        super()._setup()
+        cfg = self.config
+        # Replace IMPALA's update with the clipped-surrogate one.
+        self._update = jax.jit(
+            partial(_appo_update, self.net, self.tx,
+                    (cfg.gamma, cfg.vf_loss_coeff, cfg.entropy_coeff,
+                     cfg.vtrace_clip_rho, cfg.vtrace_clip_c,
+                     cfg.clip_param)))
+
+
+def _appo_update(net, tx, scfg, params, opt_state, batch):
+    gamma, vf_coef, ent_coef, clip_rho, clip_c, clip_param = scfg
+
+    def loss_fn(p):
+        obs, action = batch["obs"], batch["action"]
+        dist = net.action_dist(p, obs)
+        target_logp = dist.log_prob(action)
+        value = net.value(p, obs)
+        last_value = net.value(p, batch["last_obs"])
+        vs, pg_adv = vtrace(
+            batch["log_prob"], lax.stop_gradient(target_logp),
+            batch["reward"], batch["done"], lax.stop_gradient(value),
+            lax.stop_gradient(last_value), gamma=gamma,
+            clip_rho=clip_rho, clip_c=clip_c,
+        )
+        adv = lax.stop_gradient(pg_adv)
+        ratio = jnp.exp(target_logp - batch["log_prob"])
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param) * adv)
+        pg_loss = -jnp.mean(surr)
+        vf_loss = 0.5 * jnp.mean((value - lax.stop_gradient(vs)) ** 2)
+        entropy = jnp.mean(dist.entropy())
+        total = pg_loss + vf_coef * vf_loss - ent_coef * entropy
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "clip_fraction": jnp.mean(
+                           (jnp.abs(ratio - 1.0) > clip_param)
+                           .astype(jnp.float32))}
+
+    (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    aux["total_loss"] = total
+    return params, opt_state, aux

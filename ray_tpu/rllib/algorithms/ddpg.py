@@ -1,0 +1,29 @@
+"""DDPG — deterministic policy gradient for continuous control.
+
+Parity target: the reference's DDPG (ray: rllib/algorithms/ddpg/ —
+deterministic actor, single Q critic, target networks with polyak
+averaging, Ornstein-Uhlenbeck/Gaussian exploration).  Implemented as
+the twin_q=False / no-smoothing / no-delay point of the TD3 machinery
+(TD3 *is* DDPG plus those three fixes), sharing the device-resident
+replay buffer and one-jit-per-iteration execution model.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config
+
+
+class DDPGConfig(TD3Config):
+    def __init__(self):
+        super().__init__()
+        self.twin_q = False        # single critic
+        self.target_noise = 0.0    # no target-policy smoothing
+        self.policy_delay = 1      # actor updates every critic step
+
+    @property
+    def algo_class(self):
+        return DDPG
+
+
+class DDPG(TD3):
+    config_class = DDPGConfig

@@ -21,8 +21,17 @@ import optax
 from jax import lax
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.models import init_q_net, q_values
-from ray_tpu.rllib.replay_buffer import BufferState, DeviceReplayBuffer
+from ray_tpu.rllib.models import (
+    dueling_q_values,
+    init_dueling_q_net,
+    init_q_net,
+    q_values,
+)
+from ray_tpu.rllib.replay_buffer import (
+    BufferState,
+    DeviceReplayBuffer,
+    PrioritizedDeviceReplayBuffer,
+)
 
 
 class DQNConfig(AlgorithmConfig):
@@ -38,6 +47,13 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_end = 0.05
         self.epsilon_decay_steps = 10_000
         self.double_q = True
+        # Rainbow-family knobs (parity: rllib DQN dueling /
+        # prioritized_replay config keys; together with double_q these
+        # cover the classic "Rainbow-lite" triple).
+        self.dueling = False
+        self.prioritized_replay = False
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
         self.steps_per_iteration = 1_024
         self.num_envs = 8
 
@@ -57,19 +73,32 @@ class DQN(Algorithm):
         obs_dim, act_dim = env.observation_size, env.action_size
         key = jax.random.key(cfg.seed)
         key, k_init, k_reset = jax.random.split(key, 3)
-        self.params = init_q_net(k_init, obs_dim, act_dim, cfg.hidden)
+        if cfg.dueling:
+            self.params = init_dueling_q_net(k_init, obs_dim, act_dim,
+                                             cfg.hidden)
+            self._q_fn = dueling_q_values
+        else:
+            self.params = init_q_net(k_init, obs_dim, act_dim, cfg.hidden)
+            self._q_fn = q_values
         self.target_params = jax.tree_util.tree_map(
             lambda x: x, self.params
         )
         self.tx = optax.adam(cfg.lr)
         self.opt_state = self.tx.init(self.params)
-        self.buffer = DeviceReplayBuffer(cfg.buffer_capacity, {
+        specs = {
             "obs": ((obs_dim,), jnp.float32),
             "action": ((), jnp.int32),
             "reward": ((), jnp.float32),
             "next_obs": ((obs_dim,), jnp.float32),
             "done": ((), jnp.float32),
-        })
+        }
+        if cfg.prioritized_replay:
+            self.buffer = PrioritizedDeviceReplayBuffer(
+                cfg.buffer_capacity, specs,
+                alpha=cfg.prioritized_replay_alpha,
+                beta=cfg.prioritized_replay_beta)
+        else:
+            self.buffer = DeviceReplayBuffer(cfg.buffer_capacity, specs)
         self.buf_state = self.buffer.init()
         reset_keys = jax.random.split(k_reset, cfg.num_envs)
         self.env_state, self.obs = jax.vmap(env.reset)(reset_keys)
@@ -78,7 +107,7 @@ class DQN(Algorithm):
         self.key = key
         self._iteration_fn = jax.jit(
             partial(_dqn_iteration, env, self.buffer, self.tx,
-                    _static_cfg(cfg))
+                    self._q_fn, _static_cfg(cfg))
         )
 
     def _train_once(self) -> Dict[str, Any]:
@@ -110,7 +139,7 @@ class DQN(Algorithm):
                 return int(jax.random.randint(
                     k2, (), 0, self.env.action_size
                 ))
-        q = q_values(self.params, jnp.asarray(obs))
+        q = self._q_fn(self.params, jnp.asarray(obs))
         return int(jnp.argmax(q))
 
     def get_state(self) -> Dict[str, Any]:
@@ -141,29 +170,32 @@ def _static_cfg(cfg: DQNConfig):
             cfg.learning_starts)
 
 
-def _dqn_iteration(env, buffer, tx, scfg, params, target_params, opt_state,
-                   buf_state, env_state, obs, ep_ret, total_steps, key):
+def _dqn_iteration(env, buffer, tx, q_fn, scfg, params, target_params,
+                   opt_state, buf_state, env_state, obs, ep_ret,
+                   total_steps, key):
     (T, batch_size, train_freq, target_freq, gamma, eps0, eps1,
      eps_decay, double_q, learning_starts) = scfg
     n_envs = obs.shape[0]
     v_step = jax.vmap(env.step)
     v_reset = jax.vmap(env.reset)
+    prioritized = isinstance(buffer, PrioritizedDeviceReplayBuffer)
 
-    def td_loss(p, tp, mb):
-        q = q_values(p, mb["obs"])
+    def td_loss(p, tp, mb, w):
+        q = q_fn(p, mb["obs"])
         q_taken = jnp.take_along_axis(
             q, mb["action"][:, None], axis=1
         )[:, 0]
-        q_next_target = q_values(tp, mb["next_obs"])
+        q_next_target = q_fn(tp, mb["next_obs"])
         if double_q:
-            a_star = jnp.argmax(q_values(p, mb["next_obs"]), axis=1)
+            a_star = jnp.argmax(q_fn(p, mb["next_obs"]), axis=1)
             q_next = jnp.take_along_axis(
                 q_next_target, a_star[:, None], axis=1
             )[:, 0]
         else:
             q_next = jnp.max(q_next_target, axis=1)
         target = mb["reward"] + gamma * (1.0 - mb["done"]) * q_next
-        return jnp.mean((q_taken - lax.stop_gradient(target)) ** 2)
+        err = q_taken - lax.stop_gradient(target)
+        return jnp.mean(w * err ** 2), err
 
     def one_step(carry, step_key):
         (params, target_params, opt_state, buf_state, env_state, obs,
@@ -172,7 +204,7 @@ def _dqn_iteration(env, buffer, tx, scfg, params, target_params, opt_state,
         eps = jnp.clip(
             eps0 + (eps1 - eps0) * total_steps / eps_decay, eps1, eps0
         )
-        q = q_values(params, obs)
+        q = q_fn(params, obs)
         greedy = jnp.argmax(q, axis=1).astype(jnp.int32)
         random_a = jax.random.randint(
             k_act, (n_envs,), 0, env.action_size
@@ -200,22 +232,30 @@ def _dqn_iteration(env, buffer, tx, scfg, params, target_params, opt_state,
         total_steps = total_steps + n_envs
 
         def do_update(args):
-            params, opt_state = args
-            mb = buffer.sample(buf_state, k_sample, batch_size)
-            loss, grads = jax.value_and_grad(td_loss)(
-                params, target_params, mb
-            )
+            params, opt_state, buf_state = args
+            if prioritized:
+                mb, idx, w = buffer.sample(buf_state, k_sample,
+                                           batch_size)
+            else:
+                mb = buffer.sample(buf_state, k_sample, batch_size)
+                w = jnp.ones((batch_size,), jnp.float32)
+            (loss, err), grads = jax.value_and_grad(
+                td_loss, has_aux=True)(params, target_params, mb, w)
             updates, opt_state = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
+            if prioritized:
+                buf_state = buffer.update_priorities(buf_state, idx, err)
+            return (optax.apply_updates(params, updates), opt_state,
+                    buf_state, loss)
 
+        filled = buf_state.base.size if prioritized else buf_state.size
         should_train = (
-            (buf_state.size >= learning_starts)
+            (filled >= learning_starts)
             & ((total_steps // n_envs) % max(train_freq // n_envs, 1) == 0)
         )
-        params, opt_state, loss = lax.cond(
+        params, opt_state, buf_state, loss = lax.cond(
             should_train, do_update,
-            lambda args: (args[0], args[1], jnp.float32(0.0)),
-            (params, opt_state),
+            lambda args: (args[0], args[1], args[2], jnp.float32(0.0)),
+            (params, opt_state, buf_state),
         )
         target_params = lax.cond(
             (total_steps // n_envs) % max(target_freq // n_envs, 1) == 0,
@@ -237,7 +277,8 @@ def _dqn_iteration(env, buffer, tx, scfg, params, target_params, opt_state,
             ret_cnt > 0, ret_sum / jnp.maximum(ret_cnt, 1), jnp.nan
         ),
         "loss_mean": jnp.mean(losses),
-        "buffer_size": buf_state.size,
+        "buffer_size": (buf_state.base.size if prioritized
+                        else buf_state.size),
         "epsilon": jnp.clip(
             eps0 + (eps1 - eps0) * total_steps / eps_decay, eps1, eps0
         ),

@@ -37,6 +37,7 @@ class TD3Config(AlgorithmConfig):
         self.target_noise = 0.2            # smoothing σ on target action
         self.noise_clip = 0.5
         self.policy_delay = 2              # critic updates per actor update
+        self.twin_q = True                 # False → plain DDPG backup
         self.action_scale: float = None
         self.steps_per_iteration = 256
         self.num_envs = 8
@@ -74,19 +75,20 @@ class TD3(Algorithm):
                               final_scale=0.01),
             "q1": init_mlp(k1, obs_dim + act_dim, cfg.hidden, 1,
                            final_scale=1.0),
-            "q2": init_mlp(k2, obs_dim + act_dim, cfg.hidden, 1,
-                           final_scale=1.0),
         }
+        if cfg.twin_q:
+            self.params["q2"] = init_mlp(k2, obs_dim + act_dim,
+                                         cfg.hidden, 1, final_scale=1.0)
         self.target = jax.tree.map(lambda x: x, self.params)
         # SEPARATE actor/critic optimizers: one shared Adam would keep
         # nudging the actor from retained momentum on critic-only
         # steps, silently defeating policy_delay.
         self.tx_actor = optax.adam(cfg.lr)
         self.tx_critic = optax.adam(cfg.lr)
+        qp = {k: v for k, v in self.params.items() if k != "actor"}
         self.opt_state = (
             self.tx_actor.init(self.params["actor"]),
-            self.tx_critic.init({"q1": self.params["q1"],
-                                 "q2": self.params["q2"]}),
+            self.tx_critic.init(qp),
         )
         self.buffer = DeviceReplayBuffer(cfg.buffer_capacity, {
             "obs": ((obs_dim,), jnp.float32),
@@ -104,7 +106,7 @@ class TD3(Algorithm):
         scfg = (cfg.steps_per_iteration, cfg.train_batch_size, cfg.gamma,
                 cfg.tau, cfg.exploration_noise, cfg.target_noise,
                 cfg.noise_clip, cfg.policy_delay, cfg.action_scale,
-                cfg.learning_starts)
+                cfg.learning_starts, cfg.twin_q)
         self._iteration_fn = jax.jit(
             partial(_td3_iteration, env, self.buffer,
                     (self.tx_actor, self.tx_critic), scfg))
@@ -158,7 +160,7 @@ def _td3_iteration(env, buffer, txs, scfg, params, target, opt_state,
                    buf_state, env_state, obs, ep_ret, total_steps, key):
     tx_actor, tx_critic = txs
     (T, batch_size, gamma, tau, expl_noise, tgt_noise, noise_clip,
-     policy_delay, scale, learning_starts) = scfg
+     policy_delay, scale, learning_starts, twin_q) = scfg
     n_envs = obs.shape[0]
     v_step = jax.vmap(env.step)
     v_reset = jax.vmap(env.reset)
@@ -171,14 +173,18 @@ def _td3_iteration(env, buffer, txs, scfg, params, target, opt_state,
         a_next = jnp.clip(
             _pi(tgt["actor"], mb["next_obs"], scale) + noise,
             -scale, scale)
-        q_next = jnp.minimum(
-            _q(tgt["q1"], mb["next_obs"], a_next),
-            _q(tgt["q2"], mb["next_obs"], a_next))
+        q_next = _q(tgt["q1"], mb["next_obs"], a_next)
+        if twin_q:  # static: scfg is closed over, not traced
+            q_next = jnp.minimum(
+                q_next, _q(tgt["q2"], mb["next_obs"], a_next))
         y = lax.stop_gradient(
             mb["reward"] + gamma * (1 - mb["done"]) * q_next)
         q1 = _q(q_params["q1"], mb["obs"], mb["action"])
-        q2 = _q(q_params["q2"], mb["obs"], mb["action"])
-        return jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+        loss = jnp.mean((q1 - y) ** 2)
+        if twin_q:
+            q2 = _q(q_params["q2"], mb["obs"], mb["action"])
+            loss = loss + jnp.mean((q2 - y) ** 2)
+        return loss
 
     def actor_loss_fn(actor_params, q1_params, mb):
         a_pi = _pi(actor_params, mb["obs"], scale)
@@ -218,12 +224,12 @@ def _td3_iteration(env, buffer, txs, scfg, params, target, opt_state,
             params, target, opt_state = args
             actor_opt, critic_opt = opt_state
             mb = buffer.sample(buf_state, k_sample, batch_size)
-            qp = {"q1": params["q1"], "q2": params["q2"]}
+            qp = {k: v for k, v in params.items() if k != "actor"}
             closs, cgrads = jax.value_and_grad(critic_loss_fn)(
                 qp, params["actor"], target, mb, k_loss)
             cupd, critic_opt = tx_critic.update(cgrads, critic_opt, qp)
             qp = optax.apply_updates(qp, cupd)
-            params = {**params, "q1": qp["q1"], "q2": qp["q2"]}
+            params = {**params, **qp}
 
             def upd_actor(args2):
                 actor_p, actor_opt = args2

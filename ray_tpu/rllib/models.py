@@ -131,3 +131,25 @@ def init_q_net(key, obs_dim: int, act_dim: int,
 
 def q_values(params: Params, obs: jax.Array) -> jax.Array:
     return apply_mlp(params, obs)
+
+
+def init_dueling_q_net(key, obs_dim: int, act_dim: int,
+                       hidden: Sequence[int] = (64, 64)) -> Params:
+    """Dueling head (parity: rllib DQN dueling=True): a shared torso
+    with separate value and advantage streams, combined as
+    Q = V + A - mean(A)."""
+    k_t, k_a, k_v = jax.random.split(key, 3)
+    torso_out = hidden[-1]
+    return {
+        "torso": init_mlp(k_t, obs_dim, tuple(hidden[:-1]), torso_out,
+                          final_scale=1.0),
+        "adv": init_mlp(k_a, torso_out, (), act_dim, final_scale=1.0),
+        "val": init_mlp(k_v, torso_out, (), 1, final_scale=1.0),
+    }
+
+
+def dueling_q_values(params: Params, obs: jax.Array) -> jax.Array:
+    h = jax.nn.relu(apply_mlp(params["torso"], obs))
+    adv = apply_mlp(params["adv"], h)
+    val = apply_mlp(params["val"], h)
+    return val + adv - jnp.mean(adv, axis=-1, keepdims=True)

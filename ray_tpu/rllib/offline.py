@@ -385,3 +385,152 @@ def _cql_iteration(tx, scfg, params, target_q, opt_state, data, key):
         jax.random.split(key, updates_n))
     metrics = {k2: jnp.mean(v) for k2, v in auxes.items()}
     return params, target_q, opt_state, metrics
+
+
+class MARWILConfig(AlgorithmConfig):
+    """MARWIL — monotonic advantage re-weighted imitation learning
+    (parity: rllib/algorithms/marwil/marwil.py: a value network fit on
+    the logged data plus exponentially advantage-weighted behavior
+    cloning; beta=0 degenerates to plain BC)."""
+
+    def __init__(self):
+        super().__init__()
+        self.env = "Pendulum-v1"
+        self.dataset: Optional[OfflineDataset] = None
+        self.train_batch_size = 256
+        self.updates_per_iteration = 64
+        self.action_scale: float = None
+        self.lr = 1e-3
+        self.beta = 1.0           # advantage weighting temperature
+        self.vf_coeff = 1.0
+        self.moving_average_sqd_adv_norm_update_rate = 1e-2
+        self.hidden = (128, 128)
+
+    @property
+    def algo_class(self):
+        return MARWIL
+
+
+class MARWIL(Algorithm):
+    """Advantage-weighted cloning: fit V by regression on the logged
+    episodes' Monte-Carlo returns-to-go (computed once at setup from
+    the sequential dataset — no bootstrapped target, so no offline
+    TD divergence), weight each cloning term by exp(beta * A / c)
+    where A = R - V(s) and c is a running norm of A (the
+    moving-average squared-advantage estimate the reference keeps);
+    weights are batch-mean-normalized so beta only shifts RELATIVE
+    emphasis, never the effective learning rate.
+    """
+
+    config_class = MARWILConfig
+
+    def _setup(self) -> None:
+        cfg = self.config
+        env = self.env
+        if cfg.dataset is None:
+            raise ValueError("MARWILConfig.dataset is required (offline)")
+        if env.discrete:
+            raise ValueError("this MARWIL targets continuous actions")
+        if cfg.action_scale is None:
+            cfg.action_scale = float(getattr(env, "max_torque", 1.0))
+        obs_dim, act_dim = env.observation_size, env.action_size
+        key = jax.random.key(cfg.seed)
+        key, ka, kv = jax.random.split(key, 3)
+        self.params = {
+            "actor": init_mlp(ka, obs_dim, cfg.hidden, 2 * act_dim,
+                              final_scale=0.01),
+            "value": init_mlp(kv, obs_dim, cfg.hidden, 1,
+                              final_scale=1.0),
+        }
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.adv_norm = jnp.float32(1.0)  # running E[A^2]
+        # Discounted returns-to-go over the sequentially-logged
+        # episodes (done flags delimit them; a truncated final episode
+        # carries the standard truncation bias).
+        r = np.asarray(cfg.dataset.reward, np.float32)
+        d = np.asarray(cfg.dataset.done, np.float32)
+        rtg = np.zeros_like(r)
+        acc = 0.0
+        for t in range(len(r) - 1, -1, -1):
+            acc = r[t] + cfg.gamma * acc * (1.0 - d[t])
+            rtg[t] = acc
+        self.data = jax.device_put({
+            "obs": jnp.asarray(cfg.dataset.obs),
+            "action": jnp.asarray(cfg.dataset.action),
+            "ret": jnp.asarray(rtg),
+        })
+        self.key = key
+        scfg = (cfg.updates_per_iteration, cfg.train_batch_size,
+                cfg.action_scale, cfg.beta, cfg.vf_coeff,
+                cfg.moving_average_sqd_adv_norm_update_rate)
+        self._iteration_fn = jax.jit(partial(_marwil_iteration, self.tx,
+                                             scfg))
+
+    def _train_once(self) -> Dict[str, Any]:
+        self.key, k = jax.random.split(self.key)
+        (self.params, self.opt_state, self.adv_norm,
+         metrics) = self._iteration_fn(
+            self.params, self.opt_state, self.adv_norm, self.data, k)
+        out = {k2: float(v) for k2, v in metrics.items()}
+        out["_timesteps"] = (self.config.updates_per_iteration
+                             * self.config.train_batch_size)
+        return out
+
+    def compute_single_action(self, obs, explore: bool = False):
+        mu, _ = _actor_dist(self.params["actor"], jnp.asarray(obs)[None])
+        return np.asarray(jnp.tanh(mu[0]) * self.config.action_scale)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state),
+                "adv_norm": float(self.adv_norm),
+                "iteration": self.iteration,
+                "timesteps_total": self._timesteps_total}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.adv_norm = jnp.float32(state["adv_norm"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+
+
+def _marwil_iteration(tx, scfg, params, opt_state, adv_norm, data, key):
+    (updates_n, batch, scale, beta, vf_coeff, ma_rate) = scfg
+    n = data["obs"].shape[0]
+
+    def losses(p, mb, c):
+        v = jnp.squeeze(apply_mlp(p["value"], mb["obs"]), -1)
+        adv = lax.stop_gradient(mb["ret"] - v)
+        vf_loss = jnp.mean((v - mb["ret"]) ** 2)
+        # exp-weighted cloning, exponent bounded for stability (the
+        # reference clips the weighted advantage similarly), weights
+        # normalized to batch mean 1 so beta shifts relative emphasis
+        # without scaling the effective learning rate.
+        w = jnp.exp(jnp.clip(beta * adv / jnp.sqrt(c + 1e-8), -5.0, 5.0))
+        w = w / jnp.maximum(jnp.mean(w), 1e-8)
+        mu, _ls = _actor_dist(p["actor"], mb["obs"])
+        pred = jnp.tanh(mu) * scale
+        clone = jnp.mean(
+            lax.stop_gradient(w) * jnp.sum((pred - mb["action"]) ** 2, -1))
+        total = clone + vf_coeff * vf_loss
+        new_c = (1 - ma_rate) * c + ma_rate * jnp.mean(adv ** 2)
+        return total, (vf_loss, clone, new_c)
+
+    def step(carry, k):
+        params, opt_state, c = carry
+        idx = jax.random.randint(k, (batch,), 0, n)
+        mb = {col: v[idx] for col, v in data.items()}
+        (l, (vf_loss, clone, c)), grads = jax.value_and_grad(
+            losses, has_aux=True)(params, mb, c)
+        upd, opt_state = tx.update(grads, opt_state, params)
+        return ((optax.apply_updates(params, upd), opt_state, c),
+                (l, vf_loss, clone))
+
+    (params, opt_state, adv_norm), (ls, vfs, clones) = lax.scan(
+        step, (params, opt_state, adv_norm),
+        jax.random.split(key, updates_n))
+    return params, opt_state, adv_norm, {
+        "total_loss": jnp.mean(ls), "vf_loss": jnp.mean(vfs),
+        "weighted_clone_loss": jnp.mean(clones)}

@@ -1,0 +1,160 @@
+"""RLlib breadth, round 2: DDPG, APPO, MARWIL, Rainbow-lite DQN.
+
+Parity targets (ray): rllib/algorithms/{ddpg,appo,marwil}/ and the
+DQN dueling / prioritized_replay config keys (the Rainbow components
+the reference exposes on its DQN).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (
+    APPOConfig,
+    DDPGConfig,
+    DQNConfig,
+    MARWIL,
+    MARWILConfig,
+    OfflineDataset,
+    SACConfig,
+)
+from ray_tpu.rllib.env import Pendulum
+
+
+def test_ddpg_runs_pendulum_single_critic():
+    algo = (DDPGConfig()
+            .environment("Pendulum-v1")
+            .training(num_envs=4, steps_per_iteration=128,
+                      learning_starts=128, train_batch_size=64)
+            .debugging(seed=0)
+            .build())
+    assert "q2" not in algo.params  # single critic — DDPG, not TD3
+    m = algo.train()
+    m = algo.train()
+    assert np.isfinite(m["critic_loss_mean"])
+    a = algo.compute_single_action(np.zeros(3, np.float32), explore=True)
+    assert a.shape == (1,)
+
+
+def test_appo_learns_cartpole():
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .training(num_env_runners=2, num_envs=8, rollout_length=64,
+                      updates_per_iteration=4, lr=5e-3)
+            .debugging(seed=0)
+            .build())
+    try:
+        first = algo.train()
+        assert "clip_fraction" in first  # the PPO surrogate ran
+        last = first
+        for _ in range(12):
+            last = algo.train()
+        assert np.isfinite(last["total_loss"])
+        assert last["episode_return_mean"] > first["episode_return_mean"]
+    finally:
+        algo.stop()
+
+
+def test_rainbow_lite_dqn_learns_cartpole():
+    """double + dueling + prioritized replay together."""
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .training(num_envs=8, steps_per_iteration=512,
+                      learning_starts=500, double_q=True, dueling=True,
+                      prioritized_replay=True, lr=1e-3)
+            .debugging(seed=0)
+            .build())
+    assert "torso" in algo.params  # dueling head in use
+    first = algo.train()
+    last = first
+    for _ in range(12):
+        last = algo.train()
+    assert np.isfinite(last["loss_mean"])
+    assert last["episode_return_mean"] > first["episode_return_mean"]
+    assert algo.compute_single_action(
+        np.zeros(4, np.float32)) in range(2)
+
+
+@pytest.fixture(scope="module")
+def pendulum_dataset():
+    sac = (SACConfig()
+           .environment("Pendulum-v1")
+           .training(steps_per_iteration=256, train_batch_size=128,
+                     learning_starts=500)
+           .debugging(seed=0).build())
+    for _ in range(15):
+        sac.train()
+
+    def behavior(obs, rng):
+        a = sac.compute_single_action(obs)
+        return np.clip(a + rng.normal(0, 0.35, a.shape), -2.0, 2.0
+                       ).astype(np.float32)
+
+    return OfflineDataset.collect(Pendulum(), behavior,
+                                  num_steps=3000, seed=3)
+
+
+def _rollout_return(env, act_fn, seed=11, episodes=3):
+    import jax
+    import jax.numpy as jnp
+
+    total = 0.0
+    key = jax.random.key(seed)
+    for _ in range(episodes):
+        key, k = jax.random.split(key)
+        state, obs = env.reset(k)
+        done = False
+        while not done:
+            a = act_fn(np.asarray(obs))
+            state, obs, r, d = env.step(state, jnp.asarray(a))
+            total += float(r)
+            done = bool(d)
+    return total / episodes
+
+
+def test_marwil_learns_from_offline_data(pendulum_dataset):
+    cfg = MARWILConfig().environment("Pendulum-v1").training(
+        updates_per_iteration=64, train_batch_size=256, beta=1.0)
+    cfg.dataset = pendulum_dataset
+    algo = cfg.debugging(seed=0).build()
+    for _ in range(12):
+        last = algo.train()
+    assert np.isfinite(last["total_loss"])
+    assert np.isfinite(last["vf_loss"])
+    a = algo.compute_single_action(np.zeros(3, np.float32))
+    assert a.shape == (1,) and np.all(np.abs(a) <= 2.0)
+    # Behavioral check (vf/clone losses chase bootstrapped, re-weighted
+    # targets and are not monotone): the advantage-weighted clone must
+    # clearly beat a random policy on real rollouts.
+    env = Pendulum()
+    rng = np.random.default_rng(5)
+    rand_ret = _rollout_return(
+        env, lambda o: rng.uniform(-2.0, 2.0, (1,)).astype(np.float32))
+    marwil_ret = _rollout_return(env, algo.compute_single_action)
+    assert marwil_ret > rand_ret + 100.0, (marwil_ret, rand_ret)
+    # beta=0 degenerates to plain BC (uniform weights) and still runs.
+    cfg0 = MARWILConfig().environment("Pendulum-v1").training(beta=0.0)
+    cfg0.dataset = pendulum_dataset
+    bc_like = cfg0.debugging(seed=0).build()
+    assert np.isfinite(bc_like.train()["weighted_clone_loss"])
+
+
+def test_marwil_requires_dataset():
+    with pytest.raises(ValueError):
+        MARWILConfig().environment("Pendulum-v1").build()
+
+
+def test_marwil_checkpoint_roundtrip(pendulum_dataset):
+    import jax
+
+    cfg = MARWILConfig().environment("Pendulum-v1")
+    cfg.dataset = pendulum_dataset
+    algo = cfg.debugging(seed=0).build()
+    algo.train()
+    state = algo.get_state()
+    cfg2 = MARWILConfig().environment("Pendulum-v1")
+    cfg2.dataset = pendulum_dataset
+    algo2 = cfg2.debugging(seed=0).build()
+    algo2.set_state(state)
+    for x, y in zip(jax.tree.leaves(algo.params),
+                    jax.tree.leaves(algo2.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
