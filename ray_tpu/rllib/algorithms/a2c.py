@@ -78,7 +78,8 @@ def _a2c_iteration(env, net, tx, scfg, params, opt_state, env_state, obs,
     )
     advs, returns = sampler.gae(
         roll.reward, roll.done, roll.value, roll.last_value,
-        gamma=gamma, lam=lam,
+        gamma=gamma, lam=lam, terminal=roll.terminal,
+        next_value=roll.next_value,
     )
     n = roll.obs.shape[0] * roll.obs.shape[1]
     flat = lambda x: x.reshape((n,) + x.shape[2:])

@@ -55,11 +55,17 @@ def _appo_update(net, tx, scfg, params, opt_state, batch):
         target_logp = dist.log_prob(action)
         value = net.value(p, obs)
         last_value = net.value(p, batch["last_obs"])
+        trunc_kw = {}
+        if "terminal" in batch:  # jax-env rollouts carry the split
+            trunc_kw = dict(
+                terminal=batch["terminal"],
+                next_value=lax.stop_gradient(
+                    net.value(p, batch["next_obs"])))
         vs, pg_adv = vtrace(
             batch["log_prob"], lax.stop_gradient(target_logp),
             batch["reward"], batch["done"], lax.stop_gradient(value),
             lax.stop_gradient(last_value), gamma=gamma,
-            clip_rho=clip_rho, clip_c=clip_c,
+            clip_rho=clip_rho, clip_c=clip_c, **trunc_kw,
         )
         adv = lax.stop_gradient(pg_adv)
         ratio = jnp.exp(target_logp - batch["log_prob"])

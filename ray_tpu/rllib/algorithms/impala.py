@@ -48,20 +48,31 @@ class IMPALAConfig(AlgorithmConfig):
 
 def vtrace(behavior_log_prob, target_log_prob, reward, done, value,
            last_value, *, gamma: float, clip_rho: float = 1.0,
-           clip_c: float = 1.0):
+           clip_c: float = 1.0, terminal=None, next_value=None):
     """V-trace targets (Espeholt et al. 2018, eq. 1) over [T, N] batches.
 
     Returns (vs, pg_advantage).  Pure function; reverse lax.scan, tested
     against a numpy reference in tests/test_rllib.py.
+
+    With ``terminal``/``next_value`` provided, one-step bootstraps
+    distinguish time-limit truncations (bootstrap V(pre-reset
+    next_obs)) from true terminals (zero); the vs-accumulation stops at
+    every episode boundary either way.  Without them every ``done``
+    zeroes the bootstrap (legacy behavior, kept for the numpy
+    reference tests).
     """
     rho = jnp.exp(target_log_prob - behavior_log_prob)
     clipped_rho = jnp.minimum(rho, clip_rho)
     clipped_c = jnp.minimum(rho, clip_c)
     not_done = 1.0 - done.astype(jnp.float32)
-    next_values = jnp.concatenate([value[1:], last_value[None]], axis=0)
-    deltas = clipped_rho * (
-        reward + gamma * next_values * not_done - value
-    )
+    trunc_aware = terminal is not None and next_value is not None
+    if trunc_aware:
+        boot = next_value * (1.0 - terminal.astype(jnp.float32))
+    else:
+        next_values = jnp.concatenate([value[1:], last_value[None]],
+                                      axis=0)
+        boot = next_values * not_done
+    deltas = clipped_rho * (reward + gamma * boot - value)
 
     def backward(acc, inputs):
         delta, c, nd = inputs
@@ -74,9 +85,16 @@ def vtrace(behavior_log_prob, target_log_prob, reward, done, value,
     )
     vs = vs_minus_v + value
     next_vs = jnp.concatenate([vs[1:], last_value[None]], axis=0)
-    pg_adv = clipped_rho * (
-        reward + gamma * next_vs * not_done - value
-    )
+    if trunc_aware:
+        # Successor vs where the episode continues; at a boundary the
+        # successor row is the post-reset state, so fall back to the
+        # truncation bootstrap (V(next) or zero at true terminals).
+        next_vs = jnp.where(done.astype(bool), boot, next_vs)
+        pg_adv = clipped_rho * (reward + gamma * next_vs - value)
+    else:
+        pg_adv = clipped_rho * (
+            reward + gamma * next_vs * not_done - value
+        )
     return vs, pg_adv
 
 
@@ -193,11 +211,17 @@ def _impala_update(net, tx, scfg, params, opt_state, batch):
         target_logp = dist.log_prob(action)
         value = net.value(p, obs)
         last_value = net.value(p, batch["last_obs"])
+        trunc_kw = {}
+        if "terminal" in batch:  # jax-env rollouts carry the split
+            trunc_kw = dict(
+                terminal=batch["terminal"],
+                next_value=lax.stop_gradient(
+                    net.value(p, batch["next_obs"])))
         vs, pg_adv = vtrace(
             batch["log_prob"], lax.stop_gradient(target_logp),
             batch["reward"], batch["done"], lax.stop_gradient(value),
             lax.stop_gradient(last_value), gamma=gamma,
-            clip_rho=clip_rho, clip_c=clip_c,
+            clip_rho=clip_rho, clip_c=clip_c, **trunc_kw,
         )
         pg_loss = -jnp.mean(target_logp * lax.stop_gradient(pg_adv))
         vf_loss = 0.5 * jnp.mean((value - lax.stop_gradient(vs)) ** 2)

@@ -21,6 +21,7 @@ import optax
 from jax import lax
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import terminal_mask
 from ray_tpu.rllib.models import apply_mlp, init_mlp
 from ray_tpu.rllib.replay_buffer import DeviceReplayBuffer
 
@@ -228,7 +229,10 @@ def _sac_iteration(env, buffer, tx, scfg, params, target_q, opt_state,
         next_env_state, next_obs, reward, done = v_step(env_state, action)
         buf_state = buffer.add_batch(buf_state, {
             "obs": obs, "action": action, "reward": reward,
-            "next_obs": next_obs, "done": done.astype(jnp.float32),
+            "next_obs": next_obs,
+            # Bootstrap through time-limit truncations; only true
+            # terminals zero the target (see env.terminal_mask).
+            "done": terminal_mask(env, next_env_state, done),
         })
         ep_ret = ep_ret + reward
         ret_sum = ret_sum + jnp.sum(jnp.where(done, ep_ret, 0.0))

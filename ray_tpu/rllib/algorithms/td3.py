@@ -20,6 +20,7 @@ import optax
 from jax import lax
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import terminal_mask
 from ray_tpu.rllib.models import apply_mlp, init_mlp
 from ray_tpu.rllib.replay_buffer import DeviceReplayBuffer
 
@@ -193,16 +194,25 @@ def _td3_iteration(env, buffer, txs, scfg, params, target, opt_state,
     def one_step(carry, step_key):
         (params, target, opt_state, buf_state, env_state, obs, ep_ret,
          total_steps, ret_sum, ret_cnt) = carry
-        k_act, k_reset, k_sample, k_loss = jax.random.split(step_key, 4)
+        (k_act, k_warm, k_reset, k_sample,
+         k_loss) = jax.random.split(step_key, 5)
         a = _pi(params["actor"], obs, scale)
         a = jnp.clip(
             a + expl_noise * scale
             * jax.random.normal(k_act, a.shape),
             -scale, scale)
+        # Warmup: until the buffer can serve its first update the actor
+        # is untrained (tanh(~0) ≈ 0 torque) and σ-noise around it
+        # barely covers the action space — act uniformly instead.
+        a = jnp.where(total_steps < learning_starts,
+                      jax.random.uniform(k_warm, a.shape,
+                                         minval=-scale, maxval=scale),
+                      a)
         next_env_state, next_obs, reward, done = v_step(env_state, a)
         buf_state = buffer.add_batch(buf_state, {
             "obs": obs, "action": a, "reward": reward,
-            "next_obs": next_obs, "done": done.astype(jnp.float32),
+            "next_obs": next_obs,
+            "done": terminal_mask(env, next_env_state, done),
         })
         ep_ret = ep_ret + reward
         ret_sum = ret_sum + jnp.sum(jnp.where(done, ep_ret, 0.0))

@@ -122,6 +122,29 @@ class Pendulum:
         return new_state, self._obs(theta, theta_dot), -cost, done
 
 
+def terminal_mask(env, next_state, done):
+    """``done`` minus time-limit truncation, as float32.
+
+    1.0 only where the episode TRULY terminated.  For envs with a step
+    cap, hitting the cap is a TIME LIMIT: TD targets must bootstrap
+    the next-state value there, or every value function learns an
+    artificially truncated horizon (the terminated/truncated split the
+    reference's gymnasium-era stack keeps; on Pendulum — where every
+    ``done`` is a truncation — conflating them visibly stalls
+    DDPG/TD3).  An episode that truly terminates exactly at the cap is
+    treated as truncated — the standard conservative choice."""
+    max_steps = getattr(env, "max_steps", None)
+    if max_steps is None:
+        return done.astype(jnp.float32)
+    try:
+        t = next_state["t"]
+    except (KeyError, TypeError):
+        return done.astype(jnp.float32)
+    trunc = (t >= max_steps).astype(jnp.float32)
+    # Arithmetic form: custom envs may return done as float.
+    return done.astype(jnp.float32) * (1.0 - trunc)
+
+
 class ExternalEnv:
     """Adapter for Python (gym/gymnasium-style) envs.
 

@@ -91,6 +91,11 @@ class _EnvRunnerImpl:
             "obs": roll.obs, "action": roll.action, "reward": roll.reward,
             "done": roll.done, "log_prob": roll.log_prob,
             "last_obs": self.obs, "episode_return": roll.episode_return,
+            # Pre-reset successor obs + done-minus-truncation flag:
+            # learners bootstrap V(next_obs) at time limits (the host
+            # path can't distinguish — ExternalEnv collapses
+            # terminated/truncated — so these keys are jax-path only).
+            "next_obs": roll.next_obs, "terminal": roll.terminal,
         }
         return {k: np.asarray(v) for k, v in out.items()}
 

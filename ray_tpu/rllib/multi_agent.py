@@ -23,6 +23,7 @@ import optax
 from jax import lax
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import terminal_mask
 from ray_tpu.rllib.models import ActorCritic
 from ray_tpu.rllib import sampler
 
@@ -195,6 +196,12 @@ def _ma_ppo_iteration(env, net, tx, scfg, params, opt_state, env_state,
             agent_dist_sample, in_axes=(0, 1, 0), out_axes=1
         )(params, obs, ks[:A])  # [N, A, ...]
         next_state, next_obs, reward, done = v_step(env_state, act)
+        # Pre-reset successor values + done-minus-truncation flag for
+        # the GAE bootstrap (see sampler.gae / env.terminal_mask).
+        term = terminal_mask(env, next_state, done)
+        next_value = jax.vmap(
+            lambda p_a, o: net.value(p_a, o), in_axes=(0, 1), out_axes=1
+        )(params, next_obs)  # [N, A]
         ep_ret = ep_ret + reward
         done_b = done[:, None]
         ret_sum = ret_sum + jnp.sum(jnp.where(done_b, ep_ret, 0.0), axis=0)
@@ -211,7 +218,9 @@ def _ma_ppo_iteration(env, net, tx, scfg, params, opt_state, env_state,
         next_obs = jnp.where(done[:, None, None], r_obs, next_obs)
         out = {"obs": obs, "action": act, "log_prob": logp,
                "value": value, "reward": reward,
-               "done": jnp.broadcast_to(done_b, reward.shape)}
+               "done": jnp.broadcast_to(done_b, reward.shape),
+               "terminal": jnp.broadcast_to(term[:, None], reward.shape),
+               "next_value": next_value}
         return (next_state, next_obs, ep_ret, ret_sum, ret_cnt), out
 
     step_keys = jax.random.split(key, T + 1)
@@ -227,10 +236,12 @@ def _ma_ppo_iteration(env, net, tx, scfg, params, opt_state, env_state,
 
     # GAE per agent: sampler.gae expects [T, N]; vmap the agent axis.
     advs, rets = jax.vmap(
-        lambda r, d, v, lv: sampler.gae(r, d, v, lv, gamma=gamma,
-                                        lam=lam),
-        in_axes=(2, 2, 2, 1), out_axes=2,
-    )(roll["reward"], roll["done"], roll["value"], last_value)
+        lambda r, d, v, lv, tm, nv: sampler.gae(
+            r, d, v, lv, gamma=gamma, lam=lam, terminal=tm,
+            next_value=nv),
+        in_axes=(2, 2, 2, 1, 2, 2), out_axes=2,
+    )(roll["reward"], roll["done"], roll["value"], last_value,
+      roll["terminal"], roll["next_value"])
 
     n = T * N
     batch = {

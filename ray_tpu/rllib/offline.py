@@ -39,7 +39,17 @@ class OfflineDataset:
     action: np.ndarray     # [N, act_dim] (continuous) or [N] (discrete)
     reward: np.ndarray     # [N]
     next_obs: np.ndarray   # [N, obs_dim]
-    done: np.ndarray       # [N]
+    done: np.ndarray       # [N] episode boundary (terminal OR time limit)
+    # 1.0 where the episode ended by TIME LIMIT, not a true terminal.
+    # Return targets must bootstrap V(next_obs) there (the reference
+    # sets last_r = vf(last_obs) for truncated episodes in
+    # rllib/evaluation/postprocessing.py compute_advantages); treating
+    # a truncation as terminal poisons late-episode advantages.
+    truncated: np.ndarray = None
+
+    def __post_init__(self):
+        if self.truncated is None:
+            self.truncated = np.zeros_like(np.asarray(self.done))
 
     def __len__(self) -> int:
         return len(self.obs)
@@ -54,9 +64,11 @@ class OfflineDataset:
         key = jax.random.key(seed)
         key, k = jax.random.split(key)
         state, obs = env.reset(k)
+        from ray_tpu.rllib.env import terminal_mask
+
         rows: Dict[str, list] = {c: [] for c in
                                  ("obs", "action", "reward", "next_obs",
-                                  "done")}
+                                  "done", "truncated")}
         for _ in range(num_steps):
             o = np.asarray(obs)
             a = np.asarray(policy(o, rng), np.float32)
@@ -66,6 +78,10 @@ class OfflineDataset:
             rows["reward"].append(float(r))
             rows["next_obs"].append(np.asarray(nobs))
             rows["done"].append(float(bool(d)))
+            # Time-limit detection (same guard set as terminal_mask —
+            # done minus true-terminal is the truncation flag).
+            term = float(terminal_mask(env, state, jnp.asarray(d)))
+            rows["truncated"].append(float(bool(d)) - term)
             if bool(d):
                 key, k = jax.random.split(key)
                 state, obs = env.reset(k)
@@ -77,13 +93,14 @@ class OfflineDataset:
     def save(self, path: str) -> None:
         np.savez(path, obs=self.obs, action=self.action,
                  reward=self.reward, next_obs=self.next_obs,
-                 done=self.done)
+                 done=self.done, truncated=self.truncated)
 
     @classmethod
     def load(cls, path: str) -> "OfflineDataset":
         z = np.load(path)
         return cls(obs=z["obs"], action=z["action"], reward=z["reward"],
-                   next_obs=z["next_obs"], done=z["done"])
+                   next_obs=z["next_obs"], done=z["done"],
+                   truncated=z["truncated"] if "truncated" in z else None)
 
 
 class BCConfig(AlgorithmConfig):
@@ -251,11 +268,17 @@ class CQL(Algorithm):
         self.tx = optax.adam(cfg.lr)
         self.opt_state = self.tx.init(self.params)
         d = cfg.dataset
+        # The TD target bootstraps through time-limit truncations:
+        # only TRUE terminals zero the next-state value (same
+        # terminated/truncated split the reference's gymnasium-era
+        # stack keeps).
+        terminal = (np.asarray(d.done, np.float32)
+                    * (1.0 - np.asarray(d.truncated, np.float32)))
         self.data = jax.device_put({
             "obs": jnp.asarray(d.obs), "action": jnp.asarray(d.action),
             "reward": jnp.asarray(d.reward),
             "next_obs": jnp.asarray(d.next_obs),
-            "done": jnp.asarray(d.done),
+            "done": jnp.asarray(terminal),
         })
         self.key = key
         self._iteration_fn = jax.jit(partial(
@@ -404,6 +427,13 @@ class MARWILConfig(AlgorithmConfig):
         self.beta = 1.0           # advantage weighting temperature
         self.vf_coeff = 1.0
         self.moving_average_sqd_adv_norm_update_rate = 1e-2
+        # GAE(lambda) advantages (the reference's compute_advantages
+        # path — rllib/evaluation/postprocessing.py).  On long
+        # time-limit tasks the plain Monte-Carlo advantage R - V(s) is
+        # dominated by trajectory luck the value net cannot explain;
+        # the TD-residual form isolates per-action quality.
+        self.use_gae = True
+        self.lambda_ = 0.95
         self.hidden = (128, 128)
 
     @property
@@ -413,13 +443,26 @@ class MARWILConfig(AlgorithmConfig):
 
 class MARWIL(Algorithm):
     """Advantage-weighted cloning: fit V by regression on the logged
-    episodes' Monte-Carlo returns-to-go (computed once at setup from
-    the sequential dataset — no bootstrapped target, so no offline
-    TD divergence), weight each cloning term by exp(beta * A / c)
-    where A = R - V(s) and c is a running norm of A (the
-    moving-average squared-advantage estimate the reference keeps);
-    weights are batch-mean-normalized so beta only shifts RELATIVE
-    emphasis, never the effective learning rate.
+    episodes' returns-to-go, weight each cloning term by
+    exp(beta * A / c) where A = R - V(s) and c is a running norm of A
+    (the moving-average squared-advantage estimate the reference
+    keeps); weights are batch-mean-normalized so beta only shifts
+    RELATIVE emphasis, never the effective learning rate.
+
+    Two details matter and both mirror the reference
+    (rllib/evaluation/postprocessing.py compute_advantages):
+
+    * **Truncation bootstrap.** Episodes that end by TIME LIMIT get
+      ``V(next_obs)`` folded into the return at the cut, recomputed
+      each iteration with the live value params.  Without it the last
+      steps of every episode carry near-zero-horizon returns, which
+      reads as a huge spurious advantage for whatever states happen to
+      sit near episode ends — the exp-weighting then amplifies exactly
+      that noise and the clone UNDERPERFORMS plain BC (observed:
+      −1427 vs BC's −543 on Pendulum before this fix).
+    * **Advantage-norm warm start.** ``adv_norm`` starts at the
+      dataset-scale E[A²] under the initial V rather than 1.0, so
+      early weights are near-uniform instead of clip-saturated binary.
     """
 
     config_class = MARWILConfig
@@ -444,28 +487,45 @@ class MARWIL(Algorithm):
         }
         self.tx = optax.adam(cfg.lr)
         self.opt_state = self.tx.init(self.params)
-        self.adv_norm = jnp.float32(1.0)  # running E[A^2]
-        # Discounted returns-to-go over the sequentially-logged
-        # episodes (done flags delimit them; a truncated final episode
-        # carries the standard truncation bias).
-        r = np.asarray(cfg.dataset.reward, np.float32)
-        d = np.asarray(cfg.dataset.done, np.float32)
+        ds = cfg.dataset
+        self.data = jax.device_put({
+            "obs": jnp.asarray(ds.obs),
+            "action": jnp.asarray(ds.action),
+            "reward": jnp.asarray(ds.reward),
+            "next_obs": jnp.asarray(ds.next_obs),
+            "done": jnp.asarray(ds.done),
+            "truncated": jnp.asarray(ds.truncated, jnp.float32),
+        })
+        # Return-scale normalization for the value head: Adam's
+        # per-leaf step size means a net can only GROW into targets of
+        # scale ±hundreds at ~lr per step — fitting Pendulum returns
+        # raw took thousands of updates while the advantage weights
+        # fed on the unfit V's noise.  The net regresses
+        # (ret - mu) / sd instead and V(s) is read back as
+        # mu + sd * net(s).  mu/sd come from the dataset's empirical
+        # reward-only returns-to-go, so they are static across jit.
+        r = np.asarray(ds.reward, np.float32)
+        d = np.asarray(ds.done, np.float32)
         rtg = np.zeros_like(r)
         acc = 0.0
         for t in range(len(r) - 1, -1, -1):
             acc = r[t] + cfg.gamma * acc * (1.0 - d[t])
             rtg[t] = acc
-        self.data = jax.device_put({
-            "obs": jnp.asarray(cfg.dataset.obs),
-            "action": jnp.asarray(cfg.dataset.action),
-            "ret": jnp.asarray(rtg),
-        })
+        self._v_mu = float(rtg.mean())
+        self._v_sd = float(rtg.std() + 1e-6)
         self.key = key
         scfg = (cfg.updates_per_iteration, cfg.train_batch_size,
                 cfg.action_scale, cfg.beta, cfg.vf_coeff,
-                cfg.moving_average_sqd_adv_norm_update_rate)
+                cfg.moving_average_sqd_adv_norm_update_rate, cfg.gamma,
+                cfg.lambda_, cfg.use_gae, self._v_mu, self._v_sd)
         self._iteration_fn = jax.jit(partial(_marwil_iteration, self.tx,
                                              scfg))
+        # Warm-start the running E[A^2] at the data scale under the
+        # initial V so the first updates' weights are near-uniform.
+        _, adv0 = _marwil_targets(self.params, self.data, cfg.gamma,
+                                  cfg.lambda_, cfg.use_gae,
+                                  self._v_mu, self._v_sd)
+        self.adv_norm = jnp.mean(adv0 ** 2)
 
     def _train_once(self) -> Dict[str, Any]:
         self.key, k = jax.random.split(self.key)
@@ -496,32 +556,84 @@ class MARWIL(Algorithm):
         self._timesteps_total = state["timesteps_total"]
 
 
+def _marwil_value(params, obs, mu, sd):
+    """Value read-out: the net predicts in return-normalized space."""
+    return mu + sd * jnp.squeeze(apply_mlp(params["value"], obs), -1)
+
+
+def _marwil_targets(params, data, gamma, lam, use_gae, mu, sd):
+    """Value targets + advantages over the sequentially-logged
+    episodes, both bootstrapping V(next_obs) where an episode ended by
+    TIME LIMIT (and for the truncated tail of the log itself).
+
+    Returns (rtg, adv): discounted returns-to-go for the V regression,
+    and either GAE(lambda) advantages (TD residuals accumulated within
+    each episode) or the Monte-Carlo form rtg - V(s)."""
+    v = _marwil_value(params, data["obs"], mu, sd)
+    v_next = _marwil_value(params, data["next_obs"], mu, sd)
+    boot = data["truncated"] * v_next
+
+    def back_ret(acc, xs):
+        r, d, b = xs
+        acc = r + gamma * jnp.where(d > 0, b, acc)
+        return acc, acc
+
+    _, rtg = lax.scan(back_ret, v_next[-1],
+                      (data["reward"], data["done"], boot), reverse=True)
+    if use_gae:
+        # Only TRUE terminals zero the next-state value; the
+        # accumulation itself stops at every episode boundary.
+        term = data["done"] * (1.0 - data["truncated"])
+        delta = data["reward"] + gamma * (1.0 - term) * v_next - v
+
+        def back_adv(acc, xs):
+            dlt, d = xs
+            acc = dlt + gamma * lam * (1.0 - d) * acc
+            return acc, acc
+
+        _, adv = lax.scan(back_adv, jnp.float32(0.0),
+                          (delta, data["done"]), reverse=True)
+    else:
+        adv = rtg - v
+    return lax.stop_gradient(rtg), lax.stop_gradient(adv)
+
+
 def _marwil_iteration(tx, scfg, params, opt_state, adv_norm, data, key):
-    (updates_n, batch, scale, beta, vf_coeff, ma_rate) = scfg
+    (updates_n, batch, scale, beta, vf_coeff, ma_rate, gamma, lam,
+     use_gae, mu, sd) = scfg
     n = data["obs"].shape[0]
 
     def losses(p, mb, c):
-        v = jnp.squeeze(apply_mlp(p["value"], mb["obs"]), -1)
-        adv = lax.stop_gradient(mb["ret"] - v)
-        vf_loss = jnp.mean((v - mb["ret"]) ** 2)
+        # Regress in normalized-return space so the loss (and Adam's
+        # effective step) is O(1) regardless of the env's return scale.
+        v_n = jnp.squeeze(apply_mlp(p["value"], mb["obs"]), -1)
+        adv = mb["adv"]
+        vf_loss = jnp.mean((v_n - (mb["ret"] - mu) / sd) ** 2)
         # exp-weighted cloning, exponent bounded for stability (the
         # reference clips the weighted advantage similarly), weights
         # normalized to batch mean 1 so beta shifts relative emphasis
         # without scaling the effective learning rate.
         w = jnp.exp(jnp.clip(beta * adv / jnp.sqrt(c + 1e-8), -5.0, 5.0))
         w = w / jnp.maximum(jnp.mean(w), 1e-8)
-        mu, _ls = _actor_dist(p["actor"], mb["obs"])
-        pred = jnp.tanh(mu) * scale
+        a_mu, _ls = _actor_dist(p["actor"], mb["obs"])
+        pred = jnp.tanh(a_mu) * scale
         clone = jnp.mean(
             lax.stop_gradient(w) * jnp.sum((pred - mb["action"]) ** 2, -1))
         total = clone + vf_coeff * vf_loss
         new_c = (1 - ma_rate) * c + ma_rate * jnp.mean(adv ** 2)
         return total, (vf_loss, clone, new_c)
 
+    # Value targets + advantages are recomputed per iteration with the
+    # incoming value params (fitted-value-iteration style), then held
+    # fixed for this iteration's minibatch scan.
+    ret, adv_all = _marwil_targets(params, data, gamma, lam, use_gae,
+                                   mu, sd)
+
     def step(carry, k):
         params, opt_state, c = carry
         idx = jax.random.randint(k, (batch,), 0, n)
-        mb = {col: v[idx] for col, v in data.items()}
+        mb = {"obs": data["obs"][idx], "action": data["action"][idx],
+              "ret": ret[idx], "adv": adv_all[idx]}
         (l, (vf_loss, clone, c)), grads = jax.value_and_grad(
             losses, has_aux=True)(params, mb, c)
         upd, opt_state = tx.update(grads, opt_state, params)

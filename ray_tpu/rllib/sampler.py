@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.rllib.env import terminal_mask
+
 
 class Rollout(NamedTuple):
     """Time-major [T, N, ...] trajectory batch (the SampleBatch slot)."""
@@ -31,6 +33,9 @@ class Rollout(NamedTuple):
     last_value: jax.Array      # [N] bootstrap value of the final obs
     episode_return: jax.Array  # [T, N] completed-episode returns (NaN elsewhere)
     episode_length: jax.Array  # [T, N] completed-episode lengths (0 elsewhere)
+    next_obs: jax.Array        # [T, N, D] PRE-reset successor obs
+    terminal: jax.Array        # [T, N] done minus time-limit truncation
+    next_value: jax.Array      # [T, N] V(next_obs) under rollout params
 
 
 def unroll(env, net, params, state, obs, ep_ret, ep_len, key,
@@ -53,6 +58,12 @@ def unroll(env, net, params, state, obs, ep_ret, ep_len, key,
         )
         value = net.value(params, obs)
         next_state, next_obs, reward, done = v_step(state, action)
+        # Capture the TRUE successor before auto-reset overwrites it:
+        # GAE/vtrace must bootstrap V(next_obs) at time-limit
+        # truncations, and value[t+1] in the stacked rollout is the
+        # value of the RESET obs at those steps.
+        term = terminal_mask(env, next_state, done)
+        pre_reset_next_obs = next_obs
         ep_ret = ep_ret + reward
         ep_len = ep_len + 1
         # record completed episodes at the step they finish
@@ -70,29 +81,50 @@ def unroll(env, net, params, state, obs, ep_ret, ep_len, key,
         )
         next_obs = jnp.where(done[:, None], reset_obs, next_obs)
         out = (obs, action, reward, done, log_prob, value,
-               completed_ret, completed_len)
+               completed_ret, completed_len, pre_reset_next_obs, term)
         return (next_state, next_obs, ep_ret, ep_len), out
 
     step_keys = jax.random.split(key, num_steps)
     (state, obs, ep_ret, ep_len), outs = lax.scan(
         one_step, (state, obs, ep_ret, ep_len), step_keys
     )
-    (obs_t, act_t, rew_t, done_t, logp_t, val_t, cret_t, clen_t) = outs
+    (obs_t, act_t, rew_t, done_t, logp_t, val_t, cret_t, clen_t,
+     nobs_t, term_t) = outs
     last_value = net.value(params, obs)
+    # One batched forward over the stacked [T, N, D] successors (the
+    # value MLP maps over leading dims) — cheaper than a per-step call
+    # inside the scan, and off-policy consumers (IMPALA/APPO) recompute
+    # it learner-side with live params anyway.
+    nval_t = net.value(params, nobs_t)
     roll = Rollout(obs_t, act_t, rew_t, done_t, logp_t, val_t,
-                   last_value, cret_t, clen_t)
+                   last_value, cret_t, clen_t, nobs_t, term_t, nval_t)
     return state, obs, ep_ret, ep_len, roll
 
 
-def gae(reward, done, value, last_value, *, gamma: float, lam: float):
+def gae(reward, done, value, last_value, *, gamma: float, lam: float,
+        terminal=None, next_value=None):
     """Generalized advantage estimation over a [T, N] rollout.
 
-    Computed as a reverse ``lax.scan`` (no Python loop over T), masking
-    bootstrap across episode boundaries.
+    Computed as a reverse ``lax.scan`` (no Python loop over T).  The
+    accumulation always stops at episode boundaries (``done``); with
+    ``terminal``/``next_value`` provided (from :class:`Rollout`), the
+    one-step bootstrap distinguishes time-limit truncations from true
+    terminals — V(pre-reset next_obs) is bootstrapped at truncations
+    instead of zeroed (the terminated/truncated split of the
+    reference's gymnasium-era postprocessing).  Without them, every
+    ``done`` zeroes the bootstrap (legacy behavior, kept for the numpy
+    reference tests).
     """
-    next_values = jnp.concatenate([value[1:], last_value[None]], axis=0)
     not_done = 1.0 - done.astype(jnp.float32)
-    deltas = reward + gamma * next_values * not_done - value
+    if terminal is None or next_value is None:
+        next_values = jnp.concatenate([value[1:], last_value[None]],
+                                      axis=0)
+        deltas = reward + gamma * next_values * not_done - value
+    else:
+        deltas = (reward
+                  + gamma * next_value
+                  * (1.0 - terminal.astype(jnp.float32))
+                  - value)
 
     def backward(adv, inputs):
         delta, nd = inputs

@@ -33,3 +33,32 @@ def cpu_devices():
     devices = jax.devices("cpu")
     assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
     return devices
+
+
+# ---------------------------------------------------------------------------
+# RLlib learning gates: every algorithm's learning test records its
+# (algo, env, achieved, gate) here and the suite prints one table at the
+# end — the reference's rllib/tuned_examples/ pattern, condensed.
+_LEARNING_ROWS = []
+
+
+@pytest.fixture
+def learning_table():
+    """Record an algorithm's achieved return against its solved gate."""
+
+    def record(algo: str, env: str, achieved: float, gate: float):
+        _LEARNING_ROWS.append((algo, env, float(achieved), float(gate)))
+
+    return record
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if not _LEARNING_ROWS:
+        return
+    terminalreporter.section("RLlib learning gates")
+    terminalreporter.write_line(
+        f"{'algorithm':12s} {'env':14s} {'achieved':>10s} {'gate':>10s}")
+    for algo, env, ach, gate in sorted(_LEARNING_ROWS):
+        mark = "ok" if ach > gate else "FAIL"
+        terminalreporter.write_line(
+            f"{algo:12s} {env:14s} {ach:10.1f} {gate:10.1f}  {mark}")

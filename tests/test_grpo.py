@@ -39,15 +39,15 @@ def _config(**overrides):
     return cfg
 
 
-def test_grpo_improves_reward():
+def test_grpo_improves_reward(learning_table):
     algo = GRPO(config=_config())
     first = algo.train()
     base_rate = 1.0 / 32  # uniform chance of the target token
     for _ in range(30):
         last = algo.train()
-    assert last["reward_mean"] > max(4 * base_rate,
-                                     2 * first["reward_mean"] + 1e-9), \
-        (first, last)
+    gate = max(4 * base_rate, 2 * first["reward_mean"] + 1e-9)
+    learning_table("GRPO", "token-reward", last["reward_mean"], gate)
+    assert last["reward_mean"] > gate, (first, last)
     assert last["kl"] >= 0  # k3 estimator is non-negative
 
 

@@ -140,7 +140,7 @@ def test_device_replay_buffer_wraparound_and_sample():
     assert set(np.unique(np.asarray(sample["x"]))) <= {1.0, 2.0}
 
 
-def test_ppo_learns_cartpole():
+def test_ppo_learns_cartpole(learning_table):
     cfg = (
         PPOConfig()
         .environment("CartPole-v1")
@@ -155,6 +155,8 @@ def test_ppo_learns_cartpole():
     assert result["training_iteration"] == 15
     assert result["timesteps_total"] == 15 * 32 * 128
     # untrained CartPole averages ~20; >100 demonstrates learning
+    learning_table("PPO", "CartPole-v1",
+                   result["episode_return_mean"], 100)
     assert result["episode_return_mean"] > 100, result
 
 
@@ -183,7 +185,7 @@ def test_ppo_checkpoint_roundtrip(tmp_path):
     assert algo2.iteration == 1
 
 
-def test_dqn_learns_cartpole():
+def test_dqn_learns_cartpole(learning_table):
     cfg = (
         DQNConfig()
         .environment("CartPole-v1")
@@ -197,6 +199,8 @@ def test_dqn_learns_cartpole():
     for _ in range(10):
         result = algo.train()
     assert result["buffer_size"] > 0
+    learning_table("DQN", "CartPole-v1",
+                   result["episode_return_mean"], 60)
     assert result["episode_return_mean"] > 60, result
 
 
@@ -263,6 +267,26 @@ def test_impala_distributed_sampling(rt):
         assert r1["timesteps_total"] == 4 * 8 * 32
         r2 = algo.train()
         assert r2["training_iteration"] == 2
+    finally:
+        algo.stop()
+
+
+def test_impala_learns_cartpole(rt, learning_table):
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs=8, rollout_length=64)
+        .training(updates_per_iteration=8, lr=2e-3)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        rets = []
+        for _ in range(20):
+            rets.append(algo.train()["episode_return_mean"])
+        achieved = float(np.nanmean(rets[-5:]))
+        learning_table("IMPALA", "CartPole-v1", achieved, 70)
+        assert achieved > 70, rets
     finally:
         algo.stop()
 

@@ -35,7 +35,23 @@ def test_ddpg_runs_pendulum_single_critic():
     assert a.shape == (1,)
 
 
-def test_appo_learns_cartpole():
+def test_ddpg_learns_pendulum(learning_table):
+    algo = (DDPGConfig()
+            .environment("Pendulum-v1")
+            .training(num_envs=4, steps_per_iteration=256,
+                      learning_starts=500, train_batch_size=128)
+            .debugging(seed=0)
+            .build())
+    rets = []
+    for _ in range(25):
+        rets.append(algo.train()["episode_return_mean"])
+    achieved = float(np.nanmean(rets[-5:]))
+    # random ≈ -1250; gate well above it (observed -470..-620).
+    learning_table("DDPG", "Pendulum-v1", achieved, -800)
+    assert achieved > -800, rets
+
+
+def test_appo_learns_cartpole(learning_table):
     algo = (APPOConfig()
             .environment("CartPole-v1")
             .training(num_env_runners=2, num_envs=8, rollout_length=64,
@@ -45,16 +61,19 @@ def test_appo_learns_cartpole():
     try:
         first = algo.train()
         assert "clip_fraction" in first  # the PPO surrogate ran
-        last = first
-        for _ in range(12):
+        rets = []
+        for _ in range(20):
             last = algo.train()
+            rets.append(last["episode_return_mean"])
         assert np.isfinite(last["total_loss"])
-        assert last["episode_return_mean"] > first["episode_return_mean"]
+        achieved = float(np.nanmean(rets[-5:]))
+        learning_table("APPO", "CartPole-v1", achieved, 80)
+        assert achieved > 80, rets
     finally:
         algo.stop()
 
 
-def test_rainbow_lite_dqn_learns_cartpole():
+def test_rainbow_lite_dqn_learns_cartpole(learning_table):
     """double + dueling + prioritized replay together."""
     algo = (DQNConfig()
             .environment("CartPole-v1")
@@ -64,12 +83,14 @@ def test_rainbow_lite_dqn_learns_cartpole():
             .debugging(seed=0)
             .build())
     assert "torso" in algo.params  # dueling head in use
-    first = algo.train()
-    last = first
+    rets = []
     for _ in range(12):
         last = algo.train()
+        rets.append(last["episode_return_mean"])
     assert np.isfinite(last["loss_mean"])
-    assert last["episode_return_mean"] > first["episode_return_mean"]
+    achieved = float(np.nanmean(rets[-5:]))
+    learning_table("RainbowDQN", "CartPole-v1", achieved, 120)
+    assert achieved > 120, rets
     assert algo.compute_single_action(
         np.zeros(4, np.float32)) in range(2)
 
@@ -111,7 +132,8 @@ def _rollout_return(env, act_fn, seed=11, episodes=3):
     return total / episodes
 
 
-def test_marwil_learns_from_offline_data(pendulum_dataset):
+def test_marwil_learns_from_offline_data(pendulum_dataset,
+                                         learning_table):
     cfg = MARWILConfig().environment("Pendulum-v1").training(
         updates_per_iteration=64, train_batch_size=256, beta=1.0)
     cfg.dataset = pendulum_dataset
@@ -124,13 +146,17 @@ def test_marwil_learns_from_offline_data(pendulum_dataset):
     assert a.shape == (1,) and np.all(np.abs(a) <= 2.0)
     # Behavioral check (vf/clone losses chase bootstrapped, re-weighted
     # targets and are not monotone): the advantage-weighted clone must
-    # clearly beat a random policy on real rollouts.
+    # land near the behavior policy's level, far above random
+    # (random ≈ -1450; observed ≈ -580 with GAE advantages + the
+    # normalized value head).
     env = Pendulum()
     rng = np.random.default_rng(5)
     rand_ret = _rollout_return(
         env, lambda o: rng.uniform(-2.0, 2.0, (1,)).astype(np.float32))
     marwil_ret = _rollout_return(env, algo.compute_single_action)
-    assert marwil_ret > rand_ret + 100.0, (marwil_ret, rand_ret)
+    learning_table("MARWIL", "Pendulum-v1", marwil_ret,
+                   rand_ret + 500.0)
+    assert marwil_ret > rand_ret + 500.0, (marwil_ret, rand_ret)
     # beta=0 degenerates to plain BC (uniform weights) and still runs.
     cfg0 = MARWILConfig().environment("Pendulum-v1").training(beta=0.0)
     cfg0.dataset = pendulum_dataset

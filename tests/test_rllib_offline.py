@@ -69,7 +69,7 @@ def test_dataset_collect_save_load(tmp_path, pendulum_dataset):
     np.testing.assert_array_equal(ds.obs, ds2.obs)
 
 
-def test_bc_clones_behavior_policy(pendulum_dataset):
+def test_bc_clones_behavior_policy(pendulum_dataset, learning_table):
     cfg = BCConfig()
     cfg.dataset = pendulum_dataset
     algo = cfg.debugging(seed=0).build()
@@ -80,10 +80,11 @@ def test_bc_clones_behavior_policy(pendulum_dataset):
     # The cloned policy performs at the behavior policy's level —
     # far above random (random ≈ -1200; the controller ≈ -150..-400).
     ret = _rollout_return(Pendulum(), algo.compute_single_action)
+    learning_table("BC", "Pendulum-v1", ret, -700)
     assert ret > -700, ret
 
 
-def test_cql_learns_from_offline_data(pendulum_dataset):
+def test_cql_learns_from_offline_data(pendulum_dataset, learning_table):
     cfg = CQLConfig()
     cfg.dataset = pendulum_dataset
     cfg.cql_alpha = 0.5
@@ -92,6 +93,7 @@ def test_cql_learns_from_offline_data(pendulum_dataset):
         m = algo.train()
     assert np.isfinite(m["bellman"]) and np.isfinite(m["cql_penalty"])
     ret = _rollout_return(Pendulum(), algo.compute_single_action)
+    learning_table("CQL", "Pendulum-v1", ret, -700)
     assert ret > -700, ret
 
 

@@ -17,7 +17,7 @@ from ray_tpu.rllib.multi_agent import (
 )
 
 
-def test_sac_learns_pendulum():
+def test_sac_learns_pendulum(learning_table):
     """Pendulum swing-up: untrained ≈ -1100..-1600; < -900 within a
     small CPU budget demonstrates learning."""
     algo = (SACConfig()
@@ -29,6 +29,8 @@ def test_sac_learns_pendulum():
     result = None
     for _ in range(20):
         result = algo.train()
+    learning_table("SAC", "Pendulum-v1",
+                   result["episode_return_mean"], -900)
     assert result["episode_return_mean"] > -900, result
     # Entropy temperature is being adapted, not stuck at init.
     assert result["alpha"] > 0.0
