@@ -19,7 +19,12 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rllib.algorithms.impala import (
+    IMPALA,
+    IMPALAConfig,
+    truncation_kwargs,
+    vtrace,
+)
 
 
 class APPOConfig(IMPALAConfig):
@@ -55,12 +60,7 @@ def _appo_update(net, tx, scfg, params, opt_state, batch):
         target_logp = dist.log_prob(action)
         value = net.value(p, obs)
         last_value = net.value(p, batch["last_obs"])
-        trunc_kw = {}
-        if "terminal" in batch:  # jax-env rollouts carry the split
-            trunc_kw = dict(
-                terminal=batch["terminal"],
-                next_value=lax.stop_gradient(
-                    net.value(p, batch["next_obs"])))
+        trunc_kw = truncation_kwargs(net, p, batch)
         vs, pg_adv = vtrace(
             batch["log_prob"], lax.stop_gradient(target_logp),
             batch["reward"], batch["done"], lax.stop_gradient(value),

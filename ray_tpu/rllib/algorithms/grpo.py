@@ -50,6 +50,14 @@ class GRPOConfig(AlgorithmConfig):
         self.clip_param = 0.2
         self.kl_coef = 0.02
         self.grad_clip = 1.0
+        # Data-parallel learners (parity:
+        # rllib/core/learner/learner_group.py:61): the whole iteration
+        # — sampling, reward, advantage, SGD — shard_maps over a dp
+        # mesh axis with prompt-groups sharded and gradients pmean-ed.
+        # Per-row sampling keys make trajectories identical under any
+        # sharding, so dp=N reproduces dp=1 exactly (up to float
+        # reassociation).  num_prompts must divide by it.
+        self.num_learners = 1
         # reward_fn(prompt_tokens (B,P) i32, completion (B,N) i32) -> (B,)
         # float32; must be jax-traceable (compiled into the iteration).
         self.reward_fn: Optional[Callable] = None
@@ -86,34 +94,38 @@ def _completion_logps(params, buf, mcfg, P, N, temperature=1.0):
     return jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
 
 
-def _sample(params, prompts, key, mcfg, st: _Static):
+def _sample(params, prompts, row_keys, mcfg, st: _Static):
     """Autoregressive sampling: (B,P) prompts → ((B,P+N) buffer,
     (B,N) sampling-time logps).  Full-buffer forward per step — the
     causal mask makes unwritten future positions irrelevant; for the
-    RLHF loop the whole scan compiles once."""
+    RLHF loop the whole scan compiles once.
+
+    ``row_keys`` is one PRNG key PER ROW: row i's token stream depends
+    only on (row i's prompt, row_keys[i]), so sharding the batch over a
+    dp mesh axis reproduces the single-device trajectories exactly —
+    the property the LearnerGroup parity test relies on."""
     B = prompts.shape[0]
     P, N = st.prompt_len, st.max_new
     buf = jnp.concatenate(
         [prompts, jnp.zeros((B, N), prompts.dtype)], axis=1
     )
 
-    def step(carry, t):
-        buf, key = carry
+    def step(buf, t):
         logits = llama.forward(params, buf, mcfg).astype(jnp.float32)
         step_logits = jax.lax.dynamic_index_in_dim(
             logits, P - 1 + t, axis=1, keepdims=False
         ) / st.temperature
-        key, k = jax.random.split(key)
-        tok = jax.random.categorical(k, step_logits)
+        keys_t = jax.vmap(lambda rk: jax.random.fold_in(rk, t))(row_keys)
+        tok = jax.vmap(jax.random.categorical)(keys_t, step_logits)
         logp = jnp.take_along_axis(
             jax.nn.log_softmax(step_logits, axis=-1), tok[:, None], axis=-1
         )[:, 0]
         buf = jax.lax.dynamic_update_index_in_dim(
             buf, tok.astype(buf.dtype), P + t, axis=1
         )
-        return (buf, key), logp
+        return buf, logp
 
-    (buf, _), logps = jax.lax.scan(step, (buf, key), jnp.arange(N))
+    buf, logps = jax.lax.scan(step, buf, jnp.arange(N))
     return buf, logps.T  # (B, N)
 
 
@@ -134,33 +146,39 @@ def _grpo_loss(params, buf, old_logps, ref_logps, adv, mcfg, st: _Static):
     }
 
 
-def _grpo_iteration(mcfg, tx, reward_fn, prompt_source, st: _Static,
-                    params, ref_params, opt_state, key):
-    kp, ks = jax.random.split(key)
-    prompts = prompt_source(kp)                            # (n, P)
-    prompts = jnp.repeat(prompts, st.group, axis=0)        # (n*G, P)
-    buf, old_logps = _sample(params, prompts, ks, mcfg, st)
+def _grpo_body(mcfg, learner, reward_fn, st: _Static, axis_name,
+               params, ref_params, opt_state, prompts, row_keys):
+    """Sampling + reward + group advantages + SGD epochs for one batch
+    shard.  The gradient step is :meth:`Learner.update_fn` — the same
+    body LearnerGroup shard_maps — so with ``axis_name`` set gradients
+    and metrics are pmean-ed across the dp axis (the reference
+    LearnerGroup's gradient all-reduce,
+    rllib/core/learner/learner_group.py:61, here an XLA collective
+    riding ICI)."""
+    buf, old_logps = _sample(params, prompts, row_keys, mcfg, st)
     completions = buf[:, st.prompt_len:]
     rewards = reward_fn(prompts, completions).astype(jnp.float32)
 
-    # Group-relative advantages: normalize within each prompt's group.
-    grp = rewards.reshape(st.num_prompts, st.group)
+    # Group-relative advantages: normalize within each prompt's group
+    # (whole groups live on one shard, so this needs no communication).
+    grp = rewards.reshape(-1, st.group)
     adv = ((grp - grp.mean(axis=1, keepdims=True))
            / (grp.std(axis=1, keepdims=True) + 1e-6)).reshape(-1)
 
     ref_logps = _completion_logps(ref_params, buf, mcfg,
                                   st.prompt_len, st.max_new,
                                   st.temperature)
-    old_logps = jax.lax.stop_gradient(old_logps)
+    batch = {
+        "buf": buf, "old_logps": jax.lax.stop_gradient(old_logps),
+        "ref_logps": ref_logps, "adv": adv,
+    }
 
     def epoch(carry, _):
         params, opt_state = carry
-        (loss, aux), grads = jax.value_and_grad(_grpo_loss, has_aux=True)(
-            params, buf, old_logps, ref_logps, adv, mcfg, st
-        )
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return (params, opt_state), (loss, aux["kl"])
+        params, opt_state, m = learner.update_fn(
+            params, opt_state, batch, jax.random.key(0),
+            axis_name=axis_name)
+        return (params, opt_state), (m["loss"], m["kl"])
 
     (params, opt_state), (losses, kls) = jax.lax.scan(
         epoch, (params, opt_state), None, length=st.num_epochs
@@ -171,7 +189,38 @@ def _grpo_iteration(mcfg, tx, reward_fn, prompt_source, st: _Static,
         "loss": losses[-1],
         "kl": kls[-1],
     }
+    if axis_name is not None:
+        metrics["reward_mean"] = jax.lax.pmean(metrics["reward_mean"],
+                                               axis_name)
+        metrics["reward_max"] = jax.lax.pmax(metrics["reward_max"],
+                                             axis_name)
     return params, opt_state, metrics
+
+
+def _grpo_iteration(mcfg, learner, reward_fn, prompt_source,
+                    st: _Static, mesh, params, ref_params, opt_state,
+                    key):
+    kp, ks = jax.random.split(key)
+    prompts = prompt_source(kp)                            # (n, P)
+    prompts = jnp.repeat(prompts, st.group, axis=0)        # (n*G, P)
+    row_keys = jax.random.split(ks, prompts.shape[0])
+
+    if mesh is None:
+        return _grpo_body(mcfg, learner, reward_fn, st, None,
+                          params, ref_params, opt_state, prompts,
+                          row_keys)
+
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import shard_map_unchecked
+
+    body = partial(_grpo_body, mcfg, learner, reward_fn, st, "dp")
+    sharded = shard_map_unchecked(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P()),
+    )
+    return sharded(params, ref_params, opt_state, prompts, row_keys)
 
 
 class GRPO(Algorithm):
@@ -206,9 +255,27 @@ class GRPO(Algorithm):
                 k, (cfg.num_prompts, cfg.prompt_len), 0, mcfg.vocab_size
             ).astype(jnp.int32)
         )
+        self.mesh = None
+        if cfg.num_learners > 1:
+            if cfg.num_prompts % cfg.num_learners:
+                raise ValueError(
+                    f"num_prompts={cfg.num_prompts} must divide by "
+                    f"num_learners={cfg.num_learners} (whole prompt "
+                    f"groups shard together)")
+            from ray_tpu.rllib.learner import dp_mesh
+
+            self.mesh = dp_mesh(cfg.num_learners)
+        from ray_tpu.rllib.learner import Learner, LearnerSpec
+
+        learner = Learner(LearnerSpec(
+            loss_fn=lambda p, b, rng: _grpo_loss(
+                p, b["buf"], b["old_logps"], b["ref_logps"], b["adv"],
+                mcfg, st),
+            optimizer=self.tx,
+        ))
         self._iteration_fn = jax.jit(partial(
-            _grpo_iteration, mcfg, self.tx, cfg.reward_fn,
-            prompt_source, st,
+            _grpo_iteration, mcfg, learner, cfg.reward_fn,
+            prompt_source, st, self.mesh,
         ))
 
     def _train_once(self) -> Dict[str, Any]:
@@ -235,7 +302,8 @@ class GRPO(Algorithm):
                      cfg.num_prompts, cfg.temperature, cfg.clip_param,
                      cfg.kl_coef, cfg.num_epochs)
         key = key if key is not None else jax.random.key(0)
-        buf, _ = _sample(self.params, jnp.asarray(prompts), key,
+        row_keys = jax.random.split(key, prompts.shape[0])
+        buf, _ = _sample(self.params, jnp.asarray(prompts), row_keys,
                          cfg.model, st)
         return buf[:, cfg.prompt_len:]
 

@@ -98,6 +98,19 @@ def vtrace(behavior_log_prob, target_log_prob, reward, done, value,
     return vs, pg_adv
 
 
+def truncation_kwargs(net, params, batch):
+    """vtrace kwargs for the terminated/truncated split when the
+    rollout carries it (jax-env EnvRunner batches; the host/ExternalEnv
+    path can't distinguish and omits the keys).  Shared by the APPO and
+    IMPALA updates so the truncation contract lives in one place."""
+    if "terminal" not in batch:
+        return {}
+    return dict(
+        terminal=batch["terminal"],
+        next_value=lax.stop_gradient(
+            net.value(params, batch["next_obs"])))
+
+
 class IMPALA(Algorithm):
     config_class = IMPALAConfig
 
@@ -211,12 +224,7 @@ def _impala_update(net, tx, scfg, params, opt_state, batch):
         target_logp = dist.log_prob(action)
         value = net.value(p, obs)
         last_value = net.value(p, batch["last_obs"])
-        trunc_kw = {}
-        if "terminal" in batch:  # jax-env rollouts carry the split
-            trunc_kw = dict(
-                terminal=batch["terminal"],
-                next_value=lax.stop_gradient(
-                    net.value(p, batch["next_obs"])))
+        trunc_kw = truncation_kwargs(net, p, batch)
         vs, pg_adv = vtrace(
             batch["log_prob"], lax.stop_gradient(target_logp),
             batch["reward"], batch["done"], lax.stop_gradient(value),

@@ -1,0 +1,182 @@
+"""Learner / LearnerGroup — multi-accelerator RL updates.
+
+Parity target: the reference's next-gen learner stack (ray:
+rllib/core/learner/learner.py:229 ``Learner`` — owns one model copy +
+optimizer and computes gradients on its accelerator;
+rllib/core/learner/learner_group.py:61 ``LearnerGroup`` — coordinates N
+learners, shards each train batch across them, and all-reduces
+gradients before the optimizer step).
+
+TPU redesign: instead of N Python learner actors wrapping N GPUs and a
+NCCL allreduce, a LearnerGroup here is ONE jitted SPMD program
+``shard_map``-ped over a ``dp`` axis of a jax Mesh: the train batch is
+sharded on its leading axis, every device computes gradients on its
+shard, ``lax.pmean`` averages them over ICI, and the optimizer applies
+the identical update on every replica.  Params stay replicated, the
+update stays a pure function, and the same code runs on one device,
+eight virtual CPU devices, or a pod slice — there is no separate
+"distributed" code path to keep in sync with the single-device one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class LearnerSpec:
+    """What a Learner needs to update a module (parity: the reference's
+    LearnerSpec — module + optimizer + loss — rllib/core/learner).
+
+    ``loss_fn(params, batch, rng) -> (loss, aux_dict)``.  The loss must
+    be a MEAN over the batch's leading axis: LearnerGroup averages
+    shard losses/grads with ``pmean``, which reproduces the global mean
+    exactly when shards are equal-sized.
+    """
+
+    loss_fn: Callable[[Any, Dict[str, jax.Array], jax.Array], Any]
+    optimizer: optax.GradientTransformation
+    has_aux: bool = True
+
+
+def dp_mesh(num_learners: int,
+            devices: Optional[Sequence[jax.Device]] = None,
+            axis_name: str = "dp") -> Mesh:
+    """A 1-D ``dp`` mesh over the first ``num_learners`` devices — the
+    layout every LearnerGroup-style consumer (GRPO, APEX) shards over."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < num_learners:
+        raise ValueError(f"num_learners={num_learners} but only "
+                         f"{len(devices)} devices visible")
+    return Mesh(np.asarray(list(devices)[:num_learners]), (axis_name,))
+
+
+class Learner:
+    """Single-replica learner: pure gradient update on one device.
+
+    Also serves as the per-shard body of :class:`LearnerGroup` — the
+    single- and multi-device paths share this exact function.
+    """
+
+    def __init__(self, spec: LearnerSpec):
+        self.spec = spec
+        self._jit_update = jax.jit(self.update_fn)
+
+    def init_optimizer(self, params):
+        return self.spec.optimizer.init(params)
+
+    def update_fn(self, params, opt_state, batch, rng,
+                  axis_name: Optional[str] = None):
+        """(params, opt_state, metrics) after one SGD step.  When
+        ``axis_name`` is set (inside shard_map), grads and metrics are
+        pmean-ed across it before the optimizer applies."""
+        loss_fn = self.spec.loss_fn
+        if self.spec.has_aux:
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch, rng)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+            aux = {}
+        if axis_name is not None:
+            grads = lax.pmean(grads, axis_name)
+            loss = lax.pmean(loss, axis_name)
+            aux = jax.tree.map(lambda x: lax.pmean(x, axis_name), aux)
+        updates, opt_state = self.spec.optimizer.update(
+            grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {"loss": loss,
+                   "grad_norm": optax.global_norm(grads), **aux}
+        return params, opt_state, metrics
+
+    def update(self, params, opt_state, batch, rng=None):
+        if rng is None:
+            rng = jax.random.key(0)
+        return self._jit_update(params, opt_state, batch, rng)
+
+
+class LearnerGroup:
+    """Shard-mapped data-parallel update over a ``dp`` mesh axis.
+
+    ``update()`` shards every batch leaf on its leading axis across the
+    group's devices, runs the shared :class:`Learner` body per shard,
+    pmean-reduces gradients over ICI, and applies the identical
+    optimizer step on every replica.  With a mean-reduced loss and
+    equal shard sizes this matches the single-device update on the same
+    batch (up to float reassociation in the reduction).
+    """
+
+    def __init__(self, spec: LearnerSpec, *,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 num_learners: Optional[int] = None,
+                 mesh: Optional[Mesh] = None,
+                 axis_name: str = "dp"):
+        self.learner = Learner(spec)
+        self.axis_name = axis_name
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            if devices is None:
+                devices = jax.devices()
+            n = (num_learners if num_learners is not None
+                 else len(devices))
+            self.mesh = dp_mesh(n, devices, axis_name)
+        self.num_learners = self.mesh.shape[axis_name]
+        self._jit_update = None
+
+    def _build(self, rng_per_shard: bool):
+        ax = self.axis_name
+
+        def body(params, opt_state, batch, rng):
+            if rng_per_shard:
+                rng = jax.random.fold_in(rng, lax.axis_index(ax))
+            return self.learner.update_fn(params, opt_state, batch, rng,
+                                          axis_name=ax)
+
+        from ray_tpu.parallel.mesh import shard_map_unchecked
+
+        sharded = shard_map_unchecked(
+            body, mesh=self.mesh,
+            in_specs=(P(), P(), P(ax), P()),
+            out_specs=(P(), P(), P()),
+        )
+        return jax.jit(sharded)
+
+    def init(self, params):
+        """Replicated (params, opt_state) laid out for this mesh."""
+        opt_state = self.learner.init_optimizer(params)
+        rep = NamedSharding(self.mesh, P())
+        return (jax.device_put(params, rep),
+                jax.device_put(opt_state, rep))
+
+    def update(self, params, opt_state, batch, rng=None, *,
+               rng_per_shard: bool = False):
+        """One synchronized SGD step across the group.
+
+        ``rng_per_shard=False`` hands every shard the same key (exact
+        parity with a single-device update on the full batch);
+        ``True`` folds the shard index in (independent noise per
+        shard, e.g. for dropout or sampled regularizers).
+        """
+        if rng is None:
+            rng = jax.random.key(0)
+        if self._jit_update is None or \
+                self._rng_per_shard != rng_per_shard:
+            self._jit_update = self._build(rng_per_shard)
+            self._rng_per_shard = rng_per_shard
+        n = self.num_learners
+        for leaf in jax.tree.leaves(batch):
+            if leaf.shape[0] % n:
+                raise ValueError(
+                    f"batch leading dim {leaf.shape[0]} not divisible "
+                    f"by num_learners={n}")
+        return self._jit_update(params, opt_state, batch, rng)

@@ -196,12 +196,11 @@ def _ma_ppo_iteration(env, net, tx, scfg, params, opt_state, env_state,
             agent_dist_sample, in_axes=(0, 1, 0), out_axes=1
         )(params, obs, ks[:A])  # [N, A, ...]
         next_state, next_obs, reward, done = v_step(env_state, act)
-        # Pre-reset successor values + done-minus-truncation flag for
-        # the GAE bootstrap (see sampler.gae / env.terminal_mask).
+        # Pre-reset successor + done-minus-truncation flag for the GAE
+        # bootstrap (see sampler.gae / env.terminal_mask); V(next_obs)
+        # runs once batched after the scan.
         term = terminal_mask(env, next_state, done)
-        next_value = jax.vmap(
-            lambda p_a, o: net.value(p_a, o), in_axes=(0, 1), out_axes=1
-        )(params, next_obs)  # [N, A]
+        pre_reset_next_obs = next_obs
         ep_ret = ep_ret + reward
         done_b = done[:, None]
         ret_sum = ret_sum + jnp.sum(jnp.where(done_b, ep_ret, 0.0), axis=0)
@@ -220,7 +219,7 @@ def _ma_ppo_iteration(env, net, tx, scfg, params, opt_state, env_state,
                "value": value, "reward": reward,
                "done": jnp.broadcast_to(done_b, reward.shape),
                "terminal": jnp.broadcast_to(term[:, None], reward.shape),
-               "next_value": next_value}
+               "next_obs": pre_reset_next_obs}
         return (next_state, next_obs, ep_ret, ret_sum, ret_cnt), out
 
     step_keys = jax.random.split(key, T + 1)
@@ -233,6 +232,11 @@ def _ma_ppo_iteration(env, net, tx, scfg, params, opt_state, env_state,
     last_value = jax.vmap(
         lambda p_a, o: net.value(p_a, o), in_axes=(0, 1), out_axes=1
     )(params, obs)  # [N, A]
+    # One batched forward per agent over the stacked [T, N, A, D]
+    # pre-reset successors (same pattern as sampler.unroll).
+    next_value = jax.vmap(
+        lambda p_a, o: net.value(p_a, o), in_axes=(0, 2), out_axes=2
+    )(params, roll["next_obs"])  # [T, N, A]
 
     # GAE per agent: sampler.gae expects [T, N]; vmap the agent axis.
     advs, rets = jax.vmap(
@@ -241,7 +245,7 @@ def _ma_ppo_iteration(env, net, tx, scfg, params, opt_state, env_state,
             next_value=nv),
         in_axes=(2, 2, 2, 1, 2, 2), out_axes=2,
     )(roll["reward"], roll["done"], roll["value"], last_value,
-      roll["terminal"], roll["next_value"])
+      roll["terminal"], next_value)
 
     n = T * N
     batch = {
