@@ -258,7 +258,11 @@ class MsgChannel:
             return
         try:
             value = self._handler(self, msg)
-            rep = {"mid": mid, "kind": "rep", "ok": True, "value": value}
+            # "op" travels to send_msg only to select a typed reply
+            # encoding (lease/submit replies); it is not a wire field
+            # on REP frames.
+            rep = {"mid": mid, "kind": "rep", "ok": True, "value": value,
+                   "op": msg.get("op")}
         except BaseException as e:
             rep = {"mid": mid, "kind": "rep", "ok": False, "error": e}
         try:

@@ -35,7 +35,10 @@ TOKEN_ENV = "RAYTPU_CLIENT_TOKEN"
 # — within a version, proto3 unknown-field semantics absorb additive
 # change; bump this on any incompatible change (frame encoding, op
 # contract, handshake).  v2: cloudpickle envelope → protobuf Frame.
-PROTOCOL_VERSION = 2
+# v3: the task surface (submit/lease/seal/free/resource-view) moved
+# from pickled payloads into typed Frame bodies — a v2 peer would
+# drop those fields as unknowns, so the preamble must reject the mix.
+PROTOCOL_VERSION = 3
 _PREAMBLE = struct.Struct(">4sHH")
 
 
@@ -130,6 +133,18 @@ def send_msg(sock: socket.socket, obj: Any) -> None:
     payload-less ops, e.g. health-check pings).  Anything else is a RAW
     frame with the whole object pickled.  Typed bodies (join handshake)
     are sent via send_frame directly.
+
+    The TASK SURFACE is typed: submit_task / lease / seal_value / free
+    / resource_view requests (and the lease / submit replies) encode
+    into dedicated Frame bodies — no pickle for the descriptor, the
+    resource demand, the retry/scheduling policy, or the seal/free/
+    view exchanges; fn+args stay pickled bytes INSIDE SubmitTask.spec
+    exactly as the reference ships serialized args in TaskSpec.args.
+    A payload that doesn't fit the schema (unexpected kwargs, exotic
+    option types) falls back to the pickled form — both forms parse on
+    a v3 peer.  The typed bodies are NOT understood by v2 builds
+    (unknown proto fields are dropped), which is why PROTOCOL_VERSION
+    moved to 3: the preamble rejects mixed builds up front.
     """
     pb = _pb()
     f = pb.Frame()
@@ -140,14 +155,17 @@ def send_msg(sock: socket.socket, obj: Any) -> None:
         f.op = obj["op"]
         rest = {k: v for k, v in obj.items()
                 if k not in ("mid", "kind", "op")}
-        if rest:
+        enc = _TYPED_REQ.get(obj["op"])
+        if rest and not (enc is not None and enc(pb, f, rest)):
             f.payload = cloudpickle.dumps(rest)
     elif kind == "rep":
         f.mid = obj["mid"]
         f.kind = pb.Frame.REP
         f.ok = bool(obj.get("ok"))
         body = obj.get("value") if f.ok else obj.get("error")
-        if body is not None:
+        enc = _TYPED_REP.get(obj.get("op")) if f.ok else None
+        if body is not None and not (enc is not None
+                                     and enc(pb, f, body)):
             f.payload = cloudpickle.dumps(body)
     else:
         f.kind = pb.Frame.RAW
@@ -181,6 +199,16 @@ def recv_msg(sock: socket.socket) -> Any:
         msg = {"mid": f.mid, "kind": "req", "op": f.op}
         if f.HasField("join"):
             msg.update(join_request_to_dict(f.join))
+        elif f.HasField("submit"):
+            msg.update(_dec_submit(f.submit))
+        elif f.HasField("lease"):
+            msg.update(dedicated=f.lease.dedicated, block=f.lease.block)
+        elif f.HasField("seal"):
+            msg.update(_dec_seal(f.seal))
+        elif f.HasField("free"):
+            msg.update(oids=list(f.free.oids))
+        elif f.HasField("resource_view"):
+            msg.update(_dec_view(f.resource_view))
         elif f.payload:
             msg.update(cloudpickle.loads(f.payload))
         return msg
@@ -189,10 +217,267 @@ def recv_msg(sock: socket.socket) -> Any:
             # The join exchange is raw (pre-channel, no mid): hand the
             # caller the flat welcome dict it consumes.
             return join_reply_to_dict(f.join_reply)
-        body = cloudpickle.loads(f.payload) if f.payload else None
+        if f.HasField("lease_reply"):
+            body = _dec_lease_reply(f.lease_reply)
+        elif f.HasField("submit_reply"):
+            body = _dec_submit_reply(f.submit_reply)
+        else:
+            body = cloudpickle.loads(f.payload) if f.payload else None
         key = "value" if f.ok else "error"
         return {"mid": f.mid, "kind": "rep", "ok": f.ok, key: body}
     return cloudpickle.loads(f.payload)
+
+
+# --- typed task-surface codec ----------------------------------------------
+#
+# Encoders return False when the payload doesn't fit the schema (the
+# caller falls back to pickle); they must leave the frame untouched in
+# that case, so each builds a local message and CopyFrom()s on success.
+
+
+def _enc_options(pb, dst, o) -> bool:
+    m = pb.TaskOptions()
+    try:
+        m.num_cpus = float(o.num_cpus)
+        m.num_tpus = float(o.num_tpus)
+        for k, v in (o.resources or {}).items():
+            if not isinstance(k, str):
+                return False
+            m.resources[k] = float(v)
+        if o.num_returns == "streaming":
+            m.streaming = True
+        elif isinstance(o.num_returns, int):
+            m.num_returns = o.num_returns
+        else:
+            return False
+        m.max_retries = int(o.max_retries)
+        m.name = o.name or ""
+        s = o.scheduling_strategy
+        if isinstance(s, str):
+            m.scheduling_strategy = s
+        elif s is not None:
+            m.strategy_pickle = cloudpickle.dumps(s)
+        if o.placement_group is not None:
+            m.placement_group_pickle = cloudpickle.dumps(o.placement_group)
+        m.placement_bundle_index = int(o.placement_bundle_index)
+        if o.runtime_env is not None:
+            m.runtime_env_pickle = cloudpickle.dumps(o.runtime_env)
+    except (TypeError, ValueError, AttributeError):
+        return False
+    dst.CopyFrom(m)
+    return True
+
+
+def _dec_options(o):
+    from ray_tpu.core.runtime import TaskOptions
+
+    return TaskOptions(
+        num_cpus=o.num_cpus, num_tpus=o.num_tpus,
+        resources=dict(o.resources),
+        num_returns=("streaming" if o.streaming else o.num_returns),
+        max_retries=o.max_retries, name=o.name,
+        scheduling_strategy=(cloudpickle.loads(o.strategy_pickle)
+                             if o.strategy_pickle
+                             else o.scheduling_strategy),
+        placement_group=(cloudpickle.loads(o.placement_group_pickle)
+                         if o.placement_group_pickle else None),
+        placement_bundle_index=o.placement_bundle_index,
+        runtime_env=(cloudpickle.loads(o.runtime_env_pickle)
+                     if o.runtime_env_pickle else None),
+    )
+
+
+def _enc_submit(pb, f, kw) -> bool:
+    from ray_tpu.core.runtime import TaskOptions
+
+    if set(kw) - {"spec", "options", "deps", "pins", "trace_ctx",
+                  "wkey"}:
+        return False
+    o = kw.get("options")
+    if not isinstance(o, TaskOptions) or not isinstance(
+            kw.get("spec"), bytes):
+        return False
+    m = pb.SubmitTask()
+    m.spec = kw["spec"]
+    if not _enc_options(pb, m.options, o):
+        return False
+    tc = kw.get("trace_ctx")
+    if tc:
+        if not all(isinstance(k, str) and isinstance(v, str)
+                   for k, v in tc.items()):
+            return False
+        for k, v in tc.items():
+            m.trace[k] = v
+    try:
+        m.deps.extend(kw.get("deps") or [])
+        m.pins.extend(kw.get("pins") or [])
+    except TypeError:
+        return False
+    if kw.get("wkey"):
+        m.wkey = kw["wkey"]
+    f.submit.CopyFrom(m)
+    return True
+
+
+def _dec_submit(m) -> dict:
+    out = {"spec": m.spec, "options": _dec_options(m.options),
+           "deps": list(m.deps), "pins": list(m.pins),
+           "trace_ctx": dict(m.trace) or None}
+    if m.wkey:
+        out["wkey"] = m.wkey
+    return out
+
+
+def _enc_lease(pb, f, kw) -> bool:
+    if set(kw) - {"dedicated", "block"}:
+        return False
+    m = pb.LeaseRequest()
+    m.dedicated = bool(kw.get("dedicated"))
+    m.block = bool(kw.get("block", True))
+    f.lease.CopyFrom(m)
+    return True
+
+
+def _enc_seal(pb, f, kw) -> bool:
+    if set(kw) - {"oid", "entry", "nested", "wkey"}:
+        return False
+    entry = kw.get("entry")
+    if (not isinstance(kw.get("oid"), bytes)
+            or not isinstance(entry, tuple) or len(entry) != 2):
+        return False
+    kind, payload = entry
+    m = pb.SealValue()
+    m.oid = kw["oid"]
+    if kind == "shm" and isinstance(payload, int):
+        m.kind = "shm"
+        m.shm_size = payload
+    elif kind == "b" and isinstance(payload, (bytes, bytearray)):
+        m.kind = "b"
+        m.data = bytes(payload)
+    else:
+        return False
+    try:
+        m.nested.extend(kw.get("nested") or [])
+    except TypeError:
+        return False
+    if kw.get("wkey"):
+        m.wkey = kw["wkey"]
+    f.seal.CopyFrom(m)
+    return True
+
+
+def _dec_seal(m) -> dict:
+    entry = ("shm", m.shm_size) if m.kind == "shm" else ("b", m.data)
+    out = {"oid": m.oid, "entry": entry, "nested": list(m.nested)}
+    if m.wkey:
+        out["wkey"] = m.wkey
+    return out
+
+
+def _enc_free(pb, f, kw) -> bool:
+    if set(kw) != {"oids"}:
+        return False
+    m = pb.FreeObjects()
+    try:
+        m.oids.extend(kw["oids"])
+    except TypeError:
+        return False
+    f.free.CopyFrom(m)
+    return True
+
+
+def _enc_view(pb, f, kw) -> bool:
+    if set(kw) - {"nodes", "ack"}:
+        return False
+    m = pb.ResourceView()
+    try:
+        m.ack = int(kw.get("ack") or 0)
+        for nid, res in (kw.get("nodes") or {}).items():
+            nr = m.nodes[nid]
+            for k, v in res.get("available", {}).items():
+                nr.available[k] = float(v)
+            for k, v in res.get("total", {}).items():
+                nr.total[k] = float(v)
+    except (TypeError, ValueError, AttributeError):
+        return False
+    f.resource_view.CopyFrom(m)
+    return True
+
+
+def _dec_view(m) -> dict:
+    return {
+        "nodes": {nid: {"available": dict(nr.available),
+                        "total": dict(nr.total)}
+                  for nid, nr in m.nodes.items()},
+        "ack": m.ack,
+    }
+
+
+def _enc_lease_reply(pb, f, val) -> bool:
+    if not isinstance(val, dict):
+        return False
+    m = pb.LeaseReply()
+    if val.get("busy"):
+        m.busy = True
+        f.lease_reply.CopyFrom(m)
+        return True
+    if set(val) - {"wid", "key", "pid", "wport"}:
+        return False
+    try:
+        if not isinstance(val["wid"], str):  # wids are uuid hex strings
+            return False
+        m.wid = val["wid"]
+        m.key = val["key"]
+        m.pid = int(val["pid"])
+        w = val.get("wport")
+        m.wport = -1 if w is None else int(w)
+    except (KeyError, TypeError, ValueError):
+        return False
+    f.lease_reply.CopyFrom(m)
+    return True
+
+
+def _dec_lease_reply(m) -> dict:
+    if m.busy:
+        return {"busy": True}
+    return {"wid": m.wid, "key": m.key, "pid": m.pid,
+            "wport": None if m.wport == -1 else m.wport}
+
+
+def _enc_submit_reply(pb, f, val) -> bool:
+    if not isinstance(val, dict):
+        return False
+    m = pb.SubmitReply()
+    if set(val) == {"stream"} and isinstance(val["stream"], bytes):
+        m.stream = val["stream"]
+    elif set(val) == {"oids"}:
+        try:
+            m.oids.extend(val["oids"])
+        except TypeError:
+            return False
+    else:
+        return False
+    f.submit_reply.CopyFrom(m)
+    return True
+
+
+def _dec_submit_reply(m) -> dict:
+    if m.stream:
+        return {"stream": m.stream}
+    return {"oids": list(m.oids)}
+
+
+_TYPED_REQ = {
+    "submit_task": _enc_submit,
+    "lease": _enc_lease,
+    "seal_value": _enc_seal,
+    "free": _enc_free,
+    "resource_view": _enc_view,
+}
+_TYPED_REP = {
+    "submit_task": _enc_submit_reply,
+    "lease": _enc_lease_reply,
+}
 
 
 def join_request_to_dict(j) -> dict:

@@ -141,3 +141,160 @@ def test_version_skew_is_diagnosed():
         b.close()
     assert errs and "999" in errs[0] and str(
         common.PROTOCOL_VERSION) in errs[0]
+
+
+# --- typed task surface (protocol v2 additive) -----------------------------
+
+
+def _send_recv_frame(msg):
+    """send_msg then return the RAW Frame (pre-translation)."""
+    a, b = _pair()
+    try:
+        send_msg(a, msg)
+        return recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_typed_submit_roundtrips_without_pickle():
+    from ray_tpu.core.runtime import TaskOptions
+
+    opts = TaskOptions(num_cpus=2.0, num_tpus=0.5,
+                       resources={"mem": 4.0}, num_returns=2,
+                       max_retries=3, name="f",
+                       scheduling_strategy="SPREAD")
+    msg = {"mid": 9, "kind": "req", "op": "submit_task",
+           "spec": b"pickled-fn-and-args", "options": opts,
+           "deps": [b"d1", b"d2"], "pins": [b"p1"],
+           "trace_ctx": {"trace_id": "t", "span_id": "s"}}
+    f = _send_recv_frame(msg)
+    # The descriptor is schema'd: no pickle payload on the wire; the
+    # fn/args blob rides INSIDE SubmitTask.spec (itself pickle by
+    # design, like the reference's TaskSpec.args).
+    assert f.payload == b"" and f.HasField("submit")
+    assert f.submit.options.num_cpus == 2.0
+    assert f.submit.options.scheduling_strategy == "SPREAD"
+    out = _roundtrip(msg)
+    assert out == msg
+
+
+def test_typed_submit_streaming_and_structured_strategy():
+    from ray_tpu.core.runtime import TaskOptions
+
+    class FakeStrategy:
+        def __eq__(self, other):
+            return isinstance(other, FakeStrategy)
+
+    opts = TaskOptions(num_returns="streaming",
+                       scheduling_strategy=FakeStrategy())
+    msg = {"mid": 2, "kind": "req", "op": "submit_task",
+           "spec": b"s", "options": opts, "deps": [], "pins": [],
+           "trace_ctx": None}
+    f = _send_recv_frame(msg)
+    assert f.payload == b"" and f.submit.options.streaming
+    assert f.submit.options.strategy_pickle  # structured → pickle field
+    out = _roundtrip(msg)
+    assert out["options"].num_returns == "streaming"
+    assert out["options"].scheduling_strategy == FakeStrategy()
+
+
+def test_typed_lease_and_reply_without_pickle():
+    f = _send_recv_frame({"mid": 4, "kind": "req", "op": "lease",
+                          "dedicated": True, "block": False})
+    assert f.payload == b"" and f.HasField("lease")
+    # Reply: wire.py attaches the op so send_msg can pick LeaseReply.
+    rep = {"mid": 4, "kind": "rep", "ok": True, "op": "lease",
+           "value": {"wid": "a3f9c2d1e4b56789a3f9c2d1e4b56789",
+                     "key": "w:1", "pid": 4242, "wport": None}}
+    f = _send_recv_frame(rep)
+    assert f.payload == b"" and f.HasField("lease_reply")
+    out = _roundtrip(rep)
+    assert out == {"mid": 4, "kind": "rep", "ok": True,
+                   "value": {"wid": "a3f9c2d1e4b56789a3f9c2d1e4b56789",
+                             "key": "w:1", "pid": 4242,
+                             "wport": None}}
+    busy = _roundtrip({"mid": 5, "kind": "rep", "ok": True,
+                       "op": "lease", "value": {"busy": True}})
+    assert busy["value"] == {"busy": True}
+
+
+def test_typed_seal_free_view_without_pickle():
+    for msg, field in [
+        ({"mid": 1, "kind": "req", "op": "seal_value", "oid": b"o1",
+          "entry": ("shm", 4096), "nested": [b"n1"]}, "seal"),
+        ({"mid": 2, "kind": "req", "op": "seal_value", "oid": b"o2",
+          "entry": ("b", b"bytes"), "nested": [], "wkey": "wk"},
+         "seal"),
+        ({"mid": 0, "kind": "req", "op": "free", "oids": [b"a", b"b"]},
+         "free"),
+        ({"mid": 0, "kind": "req", "op": "resource_view",
+          "nodes": {"ab12": {"available": {"CPU": 3.0},
+                             "total": {"CPU": 4.0}}},
+          "ack": 17}, "resource_view"),
+    ]:
+        f = _send_recv_frame(msg)
+        assert f.payload == b"", msg["op"]
+        assert f.HasField(field), msg["op"]
+        out = _roundtrip(msg)
+        expect = dict(msg)
+        assert out == expect, msg["op"]
+
+
+def test_unfitting_payload_falls_back_to_pickle():
+    """A submit whose options aren't a TaskOptions (or with extra
+    kwargs) still crosses the wire — as the legacy pickled payload."""
+    msg = {"mid": 3, "kind": "req", "op": "submit_task",
+           "spec": b"s", "options": {"not": "TaskOptions"},
+           "deps": [], "pins": [], "trace_ctx": None}
+    f = _send_recv_frame(msg)
+    assert not f.HasField("submit") and f.payload != b""
+    assert _roundtrip(msg) == msg
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        out += bytes([b7 | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def test_task_surface_change_is_field_safe():
+    """A NEWER peer adds a field to SubmitTask: the old side must parse
+    the frame, ignore the unknown field, and keep every known field —
+    proto3 additive-change semantics on the task surface (no pickle
+    traceback, no rejected connection)."""
+    import struct
+
+    from ray_tpu.core.runtime import TaskOptions
+    from ray_tpu.protocol import pb
+
+    m = pb.SubmitTask()
+    m.spec = b"blob"
+    m.options.num_cpus = 1.0
+    m.options.scheduling_strategy = "DEFAULT"
+    m.deps.append(b"d")
+    # Unknown field 99 (varint, value 1) appended inside SubmitTask —
+    # what a future build's extra field looks like on the wire.
+    submit_plus = m.SerializeToString() + _varint((99 << 3) | 0) + b"\x01"
+    shell = pb.Frame()
+    shell.mid = 6
+    shell.kind = pb.Frame.REQ
+    shell.op = "submit_task"
+    raw = (shell.SerializeToString()
+           + _varint((8 << 3) | 2) + _varint(len(submit_plus))
+           + submit_plus)
+    a, b = _pair()
+    try:
+        a.sendall(struct.pack(">Q", len(raw)) + raw)
+        out = recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+    assert out["op"] == "submit_task" and out["spec"] == b"blob"
+    assert out["deps"] == [b"d"]
+    assert isinstance(out["options"], TaskOptions)
+    assert out["options"].num_cpus == 1.0
