@@ -170,7 +170,9 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
     make_adapter = adapter_factory or llama_paged_adapter
     eng = LLMEngine(
         params, make_adapter(cfg),
-        EngineConfig(max_slots=slots, max_seq_len=512, decode_chunk=8,
+        EngineConfig(max_slots=slots,
+                     max_seq_len=min(512, cfg.max_seq_len),
+                     decode_chunk=8,
                      max_new_tokens_default=gen, page_size=64),
     )
     rng = np.random.default_rng(1)
@@ -192,20 +194,58 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
             sorted_vals[min(len(sorted_vals) - 1,
                             int(q * len(sorted_vals)))] * 1e3, 1)
 
-    # Open loop: paced arrivals.
-    t0 = time.perf_counter()
-    streams = []
-    for i, p in enumerate(prompts):
-        target = t0 + i / arrival_rate
-        delay = target - time.perf_counter()
-        if delay > 0:
-            time.sleep(delay)
-        streams.append(eng.submit(p, max_new_tokens=gen, temperature=0.0))
-    outs = [s.result(timeout_s=600) for s in streams]
-    open_dt = time.perf_counter() - t0
-    ttfts = sorted(s._req.ttft_s for s in streams
-                   if s._req.ttft_s is not None)
-    assert all(len(o) == gen for o in outs)
+    def open_loop_point(rate: float, n: int) -> dict:
+        t0 = time.perf_counter()
+        streams = []
+        for i in range(n):
+            target = t0 + i / rate
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            streams.append(eng.submit(prompts[i % len(prompts)],
+                                      max_new_tokens=gen,
+                                      temperature=0.0))
+        outs = [s.result(timeout_s=600) for s in streams]
+        dt = time.perf_counter() - t0
+        ttfts = sorted(s._req.ttft_s for s in streams
+                       if s._req.ttft_s is not None)
+        assert all(len(o) == gen for o in outs)
+        # Steady-state served rate: completions per second between the
+        # 10th and last completion (trimming the warmup ramp and not
+        # charging the post-arrival service tail as a deficit).  A
+        # system keeping up completes at the arrival rate → ~1.0; a
+        # saturated one completes at its ceiling μ → μ/rate.
+        done = sorted(s._req.finished_at for s in streams)
+        k = max(1, n // 10)
+        span = max(done[-1] - done[k - 1], 1e-9)
+        served_ss = (n - k) / span
+        completion = min(1.0, served_ss / rate)
+        return {
+            "offered_req_s": rate,
+            "req_per_s": round(n / dt, 2),
+            "completion": round(completion, 3),
+            "decode_tokens_per_s": round(n * gen / dt, 1),
+            "ttft_p50_ms": pct(ttfts, 0.50),
+            "ttft_p95_ms": pct(ttfts, 0.95),
+        }
+
+    # Arrival-rate LADDER: climb offered load until the system stops
+    # completing ≥99% of it; the KNEE is the last sustainable point
+    # and the headline TTFT is measured there, not past saturation.
+    ladder = []
+    rate = arrival_rate / 4.0
+    knee = None
+    for _ in range(6):
+        n = max(24, min(int(rate * 10), 160))
+        point = open_loop_point(rate, n)
+        ladder.append(point)
+        if point["completion"] >= 0.99:
+            knee = point
+            rate *= 1.5
+        else:
+            break
+    if knee is None:  # even the lowest point saturated
+        knee = ladder[0]
 
     # Burst: everything at once — the throughput ceiling.
     t0 = time.perf_counter()
@@ -216,16 +256,22 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
     burst_dt = time.perf_counter() - t0
     eng.shutdown()
     return {
-        "arrival_rate_req_s": arrival_rate,
-        "req_per_s": round(n_requests / open_dt, 2),
-        "decode_tokens_per_s": round(n_requests * gen / open_dt, 1),
-        "ttft_p50_ms": pct(ttfts, 0.50),
-        "ttft_p95_ms": pct(ttfts, 0.95),
+        # Headline open-loop numbers are AT THE KNEE (highest offered
+        # load still completing ≥99%), so TTFT never conflates service
+        # with queueing delay past saturation.
+        "arrival_rate_req_s": knee["offered_req_s"],
+        "req_per_s": knee["req_per_s"],
+        "decode_tokens_per_s": knee["decode_tokens_per_s"],
+        "ttft_p50_ms": knee["ttft_p50_ms"],
+        "ttft_p95_ms": knee["ttft_p95_ms"],
+        "ladder": ladder,
+        "knee_req_s": knee["offered_req_s"],
         "burst_req_per_s": round(n_requests / burst_dt, 2),
         "burst_decode_tokens_per_s": round(n_requests * gen / burst_dt, 1),
         "prompt_len": prompt_len,
         "gen": gen,
         "slots": slots,
+        "kv": "int8" if getattr(cfg, "kv_int8", False) else "bf16",
     }
 
 
@@ -245,17 +291,23 @@ def _measure_8b(peak_flops: float) -> dict:
     """
     from ray_tpu.models import quant
 
+    # int8 KV pages (per-page scales): the bf16 pool at 24 slots was
+    # 3.2 GB; int8 at 48 slots × 4 pages is 0.4 GB — double the slots
+    # AND less HBM, with live-page decode reads halved.
     cfg8 = llama.LlamaConfig(
         vocab_size=128_256, dim=4096, n_layers=32, n_heads=32,
-        n_kv_heads=8, mlp_dim=14336, max_seq_len=512,
+        n_kv_heads=8, mlp_dim=14336, max_seq_len=256, kv_int8=True,
     )
     out: dict = {"params_b": round(cfg8.num_params() / 1e9, 2)}
 
     qparams = quant.init_quantized_llama(jax.random.PRNGKey(0), cfg8)
+    # Fused qkv + gate/up: 5 projection matmuls → 2 per layer (decode
+    # is per-op latency-bound on top of the weight reads).
+    qparams = quant.fuse_for_decode(qparams, cfg8)
     jax.block_until_ready(qparams)
     out["int8_weight_gb"] = round(quant.quantized_bytes(qparams) / 2**30, 2)
     serving = _measure_serving(
-        cfg8, n_requests=48, prompt_len=128, gen=32, slots=24,
+        cfg8, n_requests=96, prompt_len=128, gen=32, slots=48,
         arrival_rate=4.0, params=qparams,
         adapter_factory=quant.llama_paged_adapter_quant,
     )

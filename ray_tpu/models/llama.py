@@ -67,6 +67,11 @@ class LlamaConfig:
     # (factor, low_freq_factor, high_freq_factor, original_max_pos) —
     # None for unscaled RoPE (Llama-3.0 and earlier).
     rope_scaling: Optional[Tuple[float, float, float, int]] = None
+    # INT8 KV page pools with one f32 scale per physical page
+    # (ops/paged_attention.py quantized kernels): halves live-page
+    # decode reads and doubles slot capacity per GB of HBM.  Serving
+    # only (paged cache paths).
+    kv_int8: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -209,12 +214,25 @@ def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
 
 
 def _qkv(x, layer, cfg: LlamaConfig, sin, cos):
-    """Shared q/k/v projection + RoPE (used by train, prefill and decode)."""
+    """Shared q/k/v projection + RoPE (used by train, prefill and decode).
+
+    A fused serving artifact (models/quant.py fuse_for_decode) carries
+    one ``wqkv`` operand instead of wq/wk/wv — one matmul instead of
+    three, for the per-op-latency-bound decode regime."""
     a = layer["attn"]
     dt = cfg.dtype
-    q = jnp.einsum("bsd,dhk->bshk", x, a["wq"].astype(dt))
-    k = jnp.einsum("bsd,dhk->bshk", x, a["wk"].astype(dt))
-    v = jnp.einsum("bsd,dhk->bshk", x, a["wv"].astype(dt))
+    if "wqkv" in a:
+        H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        B, S = x.shape[0], x.shape[1]
+        qkv = jnp.einsum("bsd,dc->bsc", x, a["wqkv"].astype(dt))
+        q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd], axis=-1)
+        q = q.reshape(B, S, H, hd)
+        k = k.reshape(B, S, KVH, hd)
+        v = v.reshape(B, S, KVH, hd)
+    else:
+        q = jnp.einsum("bsd,dhk->bshk", x, a["wq"].astype(dt))
+        k = jnp.einsum("bsd,dhk->bshk", x, a["wk"].astype(dt))
+        v = jnp.einsum("bsd,dhk->bshk", x, a["wv"].astype(dt))
     return apply_rope(q, sin, cos), apply_rope(k, sin, cos), v
 
 
@@ -257,8 +275,12 @@ def _attn_block(x, layer, cfg: LlamaConfig, sin, cos, segment_ids,
 def _mlp_block(x, layer, cfg: LlamaConfig):
     m = layer["mlp"]
     dt = cfg.dtype
-    gate = jnp.einsum("bsd,dm->bsm", x, m["w_gate"].astype(dt))
-    up = jnp.einsum("bsd,dm->bsm", x, m["w_up"].astype(dt))
+    if "w_gateup" in m:  # fused serving artifact (quant.fuse_for_decode)
+        gu = jnp.einsum("bsd,dm->bsm", x, m["w_gateup"].astype(dt))
+        gate, up = jnp.split(gu, 2, axis=-1)
+    else:
+        gate = jnp.einsum("bsd,dm->bsm", x, m["w_gate"].astype(dt))
+        up = jnp.einsum("bsd,dm->bsm", x, m["w_up"].astype(dt))
     return jnp.einsum("bsm,md->bsd", jax.nn.silu(gate) * up,
                       m["w_down"].astype(dt))
 
@@ -559,7 +581,7 @@ def prefill_batch(
     )[:, 0]  # [K, D]
     head = (params["tok_embed"].T if cfg.tie_embeddings
             else params["lm_head"])
-    logits = last @ _deq_head(head, cfg.dtype)
+    logits = _head_matmul(last, head, cfg)
 
     # k_all/v_all [L, K, S, KVH, D] → scatter whole rows into slots.
     cache = dict(cache)
@@ -602,7 +624,7 @@ def prefill_batch_paged(
     )[:, 0]
     head = (params["tok_embed"].T if cfg.tie_embeddings
             else params["lm_head"])
-    logits = last @ _deq_head(head, cfg.dtype)
+    logits = _head_matmul(last, head, cfg)
 
     # [L, K, S, KVH, D] → [L, KVH, K * S/page, page, D]; one scatter.
     npg = S // page
@@ -613,8 +635,20 @@ def prefill_batch_paged(
 
     page_ids = pages_rows[:, :npg].reshape(K * npg)
     cache = dict(cache)
-    cache["k"] = cache["k"].at[:, :, page_ids].set(to_pages(k_all))
-    cache["v"] = cache["v"].at[:, :, page_ids].set(to_pages(v_all))
+    if "k_scale" in cache:
+        qk, sk = _quant_pages(to_pages(k_all))
+        qv, sv = _quant_pages(to_pages(v_all))
+        cache["k"] = cache["k"].at[:, :, page_ids].set(qk)
+        cache["v"] = cache["v"].at[:, :, page_ids].set(qv)
+        # Scales are page-major [L, P, KVH, 1]; _quant_pages returns
+        # [L, KVH, pages].
+        cache["k_scale"] = cache["k_scale"].at[:, page_ids].set(
+            sk.transpose(0, 2, 1)[..., None])
+        cache["v_scale"] = cache["v_scale"].at[:, page_ids].set(
+            sv.transpose(0, 2, 1)[..., None])
+    else:
+        cache["k"] = cache["k"].at[:, :, page_ids].set(to_pages(k_all))
+        cache["v"] = cache["v"].at[:, :, page_ids].set(to_pages(v_all))
     return logits.astype(jnp.float32), cache
 
 
@@ -691,10 +725,18 @@ def _deq_layer(layer, dtype):
     return walk(layer)
 
 
-def _deq_head(node, dtype):
-    if _is_qdict(node):
-        return node["q"].astype(dtype) * node["scale"].astype(dtype)
-    return node.astype(dtype)
+def _head_matmul(x, head, cfg):
+    """Logits projection x [..., d] @ head [d, V].
+
+    For an int8 head the per-OUTPUT-channel scale [1, V] is applied to
+    the matmul RESULT instead of the operand: the operand is then a
+    bare int8→bf16 convert, which XLA always fuses into the dot's
+    operand read — a scale-multiplied operand risks materializing the
+    full bf16 head (≈1 GB at 8B vocab) as a per-step temp."""
+    if not _is_qdict(head):
+        return jnp.einsum("...d,dv->...v", x, head.astype(cfg.dtype))
+    out = jnp.einsum("...d,dv->...v", x, head["q"].astype(cfg.dtype))
+    return out.astype(jnp.float32) * head["scale"][0].astype(jnp.float32)
 
 
 # --- serving tensor parallelism --------------------------------------------
@@ -746,19 +788,36 @@ def shard_params_for_serving(params: Params, cfg: LlamaConfig, mesh,
     )
 
 
-def paged_cache_shardings(mesh, axis: str = "tp"):
+def paged_cache_shardings(mesh, axis: str = "tp",
+                          kv_int8: bool = False):
     """Shardings for the paged cache: k/v page pools
-    [L, KVH, P, page, D] shard on KVH over ``axis``.  The engine
-    allocates the pool UNDER these (jit out_shardings) — a
-    materialize-then-reshard would put the whole unsharded pool on one
-    chip first, which is exactly what tp serving exists to avoid."""
+    [L, KVH, P, page, D] shard on KVH over ``axis`` (scale pools
+    [L, KVH, P] likewise).  The engine allocates the pool UNDER these
+    (jit out_shardings) — a materialize-then-reshard would put the
+    whole unsharded pool on one chip first, which is exactly what tp
+    serving exists to avoid."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     sh = NamedSharding(mesh, P(None, axis, None, None, None))
-    return {"k": sh, "v": sh}
+    out = {"k": sh, "v": sh}
+    if kv_int8:
+        ssh = NamedSharding(mesh, P(None, None, axis, None))
+        out["k_scale"] = ssh
+        out["v_scale"] = ssh
+    return out
 
 
 # --- paged inference (block-table KV cache) --------------------------------
+
+def _quant_pages(pages: jax.Array):
+    """[..., n_pages, page, D] values → (int8 pages, [..., n_pages] f32
+    per-page absmax scales) — the int8 KV pool's write-side quant."""
+    a = jnp.max(jnp.abs(pages.astype(jnp.float32)), axis=(-2, -1))
+    scale = jnp.maximum(a / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(pages.astype(jnp.float32)
+                           / scale[..., None, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
 
 def init_paged_cache(cfg: LlamaConfig, num_pages: int,
                      page_size: int) -> Dict[str, jax.Array]:
@@ -767,9 +826,22 @@ def init_paged_cache(cfg: LlamaConfig, num_pages: int,
     LAST physical page is a scratch page: OOB sentinel writes (inactive
     slots, chunk-ladder overshoot — sentinel value == num_pages) land
     there instead of clamping onto a live page, where an aliased
-    append's copy-through could race another slot's append."""
+    append's copy-through could race another slot's append.
+
+    With ``cfg.kv_int8`` the pools are int8 plus one f32 scale per
+    physical page per kv head (``k_scale``/``v_scale``
+    [L, P+1, KVH, 1] — page-major so the append kernel's write block
+    is exactly one page's scale column, a layout Mosaic tiles):
+    live-page decode reads halve and a 16 GB chip holds twice the
+    slots."""
     shape = (cfg.n_layers, cfg.n_kv_heads, num_pages + 1, page_size,
              cfg.head_dim)
+    if cfg.kv_int8:
+        sshape = (cfg.n_layers, num_pages + 1, cfg.n_kv_heads, 1)
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, jnp.float32),
+                "v_scale": jnp.zeros(sshape, jnp.float32)}
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype)}
 
@@ -806,20 +878,39 @@ def prefill_slot_paged(
     last = lax.dynamic_index_in_dim(x[0], true_len - 1, axis=0, keepdims=False)
     head = (params["tok_embed"].T if cfg.tie_embeddings
             else params["lm_head"])
-    logits = last @ _deq_head(head, cfg.dtype)
+    logits = _head_matmul(last, head, cfg)
 
     # k_all/v_all [L, S, KVH, D] → [L, KVH, S, D], then one
     # dynamic_update_slice per page chunk.
     k_all = k_all.swapaxes(1, 2)
     v_all = v_all.swapaxes(1, 2)
+    quantized = "k_scale" in cache
     ck, cv = cache["k"], cache["v"]
+    if quantized:
+        cks, cvs = cache["k_scale"], cache["v_scale"]
     for j in range(S // page):
         chunk_k = lax.dynamic_slice_in_dim(k_all, j * page, page, axis=2)
         chunk_v = lax.dynamic_slice_in_dim(v_all, j * page, page, axis=2)
-        ck = lax.dynamic_update_slice(
-            ck, chunk_k[:, :, None], (0, 0, pages[j], 0, 0))
-        cv = lax.dynamic_update_slice(
-            cv, chunk_v[:, :, None], (0, 0, pages[j], 0, 0))
+        if quantized:
+            qk, sk = _quant_pages(chunk_k[:, :, None])
+            qv, sv = _quant_pages(chunk_v[:, :, None])
+            ck = lax.dynamic_update_slice(ck, qk, (0, 0, pages[j], 0, 0))
+            cv = lax.dynamic_update_slice(cv, qv, (0, 0, pages[j], 0, 0))
+            # [L, KVH, 1] → page-major [L, 1, KVH, 1].
+            cks = lax.dynamic_update_slice(
+                cks, sk.transpose(0, 2, 1)[..., None],
+                (0, pages[j], 0, 0))
+            cvs = lax.dynamic_update_slice(
+                cvs, sv.transpose(0, 2, 1)[..., None],
+                (0, pages[j], 0, 0))
+        else:
+            ck = lax.dynamic_update_slice(
+                ck, chunk_k[:, :, None], (0, 0, pages[j], 0, 0))
+            cv = lax.dynamic_update_slice(
+                cv, chunk_v[:, :, None], (0, 0, pages[j], 0, 0))
+    if quantized:
+        return logits.astype(jnp.float32), {
+            "k": ck, "v": cv, "k_scale": cks, "v_scale": cvs}
     return logits.astype(jnp.float32), {"k": ck, "v": cv}
 
 
@@ -843,6 +934,12 @@ def prefill_chunk_paged(
     (prior chunks + this one, causal).  Returns (logits [K, V] at each
     row's last true position — only meaningful on the final chunk —
     and the cache)."""
+    if "k_scale" in cache:
+        raise NotImplementedError(
+            "chunked prefill with kv_int8 pools: per-token scatters "
+            "would need page-scale growth on the gather path; admit "
+            "long prompts via batched prefill (raise "
+            "prefill_chunk_tokens) or serve with bf16 KV")
     K, C = tokens.shape
     page = cache["k"].shape[3]
     maxp = pages_rows.shape[1]
@@ -907,7 +1004,7 @@ def prefill_chunk_paged(
         axis=1)[:, 0]
     head = (params["tok_embed"].T if cfg.tie_embeddings
             else params["lm_head"])
-    logits = last @ _deq_head(head, cfg.dtype)
+    logits = _head_matmul(last, head, cfg)
     return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
 
 
@@ -939,14 +1036,24 @@ def decode_slots_paged(
     from ray_tpu.ops.paged_attention import (
         combine_with_self,
         paged_append,
+        paged_append_quantized,
+        paged_append_quantized_tp,
         paged_append_tp,
         paged_decode_attention_partial,
         paged_decode_attention_partial_tp,
     )
 
+    quantized = "k_scale" in cache
     attn_fn = (paged_decode_attention_partial_tp if cfg.tensor_parallel
                else paged_decode_attention_partial)
-    append_fn = paged_append_tp if cfg.tensor_parallel else paged_append
+    if quantized:
+        attn_fn = partial(attn_fn, k_scales=cache["k_scale"],
+                          v_scales=cache["v_scale"])
+        append_fn = (paged_append_quantized_tp if cfg.tensor_parallel
+                     else paged_append_quantized)
+    else:
+        append_fn = (paged_append_tp if cfg.tensor_parallel
+                     else paged_append)
 
     page = cache["k"].shape[3]
     new_len = jnp.where(active, lengths + 1, lengths)
@@ -988,14 +1095,21 @@ def decode_slots_paged(
         body, (x, jnp.int32(0)), params["layers"])
     # One append for every layer, in place via the aliased pallas
     # kernel (a jnp scatter here made XLA clone the pools per step).
-    k_pool, v_pool = append_fn(cache["k"], cache["v"], k_news, v_news,
-                               pids, offs)
+    if quantized:
+        k_pool, v_pool, k_sc, v_sc = append_fn(
+            cache["k"], cache["v"], cache["k_scale"], cache["v_scale"],
+            k_news, v_news, pids, offs)
+        new_cache = {"k": k_pool, "v": v_pool, "k_scale": k_sc,
+                     "v_scale": v_sc}
+    else:
+        k_pool, v_pool = append_fn(cache["k"], cache["v"], k_news,
+                                   v_news, pids, offs)
+        new_cache = {"k": k_pool, "v": v_pool}
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (params["tok_embed"].T if cfg.tie_embeddings
             else params["lm_head"])
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], _deq_head(head, cfg.dtype))
-    return (logits.astype(jnp.float32), {"k": k_pool, "v": v_pool},
-            new_len)
+    logits = _head_matmul(x[:, 0], head, cfg)
+    return logits.astype(jnp.float32), new_cache, new_len
 
 
 def decode_step(

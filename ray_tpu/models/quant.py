@@ -171,3 +171,62 @@ def llama_paged_adapter_quant(cfg):
     from ray_tpu.serve.llm_engine import llama_paged_adapter
 
     return llama_paged_adapter(cfg)
+
+
+def fuse_for_decode(qparams: Any, cfg) -> Any:
+    """Fuse each layer's q/k/v projections into ONE int8 matmul operand
+    ``attn.wqkv`` [L, d, (H+2·KVH)·hd] and gate/up into ``mlp.w_gateup``
+    [L, d, 2m], re-quantized per OUTPUT channel.
+
+    Decode at serving batch sizes is per-op latency-bound on top of the
+    weight reads (measured ~0.2-0.4 ms/layer of pipeline overhead at 8B
+    with 5 separate projections); fusing cuts the projection matmuls
+    per layer from 5 to 2 at identical weight bytes.  Values already
+    sit on the original int8 grid, so the requant adds at most half an
+    LSB of the (finer, per-channel) new grid.
+
+    Single-device serving only: tensor-parallel sharding would split
+    the concatenated output axis across q/k/v segment boundaries.
+    Runs layer-by-layer under one jit (lax.map) so peak extra HBM is
+    one layer's f32 temporaries, not a second model.
+    """
+    import jax
+    from jax import lax
+
+    if getattr(cfg, "tensor_parallel", False):
+        raise ValueError(
+            "fuse_for_decode is single-device only: tensor-parallel "
+            "sharding would split the concatenated qkv/gateup output "
+            "axis across segment boundaries — serve tp from the "
+            "unfused artifact")
+    H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    d = cfg.dim
+    attn = qparams["layers"]["attn"]
+    mlp = qparams["layers"]["mlp"]
+
+    def deq(t):
+        return t["q"].astype(jnp.float32) * t["scale"].astype(jnp.float32)
+
+    @jax.jit
+    def fuse_all(wq, wk, wv, wg, wu):
+        def one(args):
+            lwq, lwk, lwv, lwg, lwu = args
+            qkv = jnp.concatenate(
+                [deq(lwq).reshape(d, H * hd),
+                 deq(lwk).reshape(d, KVH * hd),
+                 deq(lwv).reshape(d, KVH * hd)], axis=1)
+            gateup = jnp.concatenate([deq(lwg), deq(lwu)], axis=1)
+            return quantize_tensor(qkv), quantize_tensor(gateup)
+
+        return lax.map(one, (wq, wk, wv, wg, wu))
+
+    wqkv, w_gateup = fuse_all(attn["wq"], attn["wk"], attn["wv"],
+                              mlp["w_gate"], mlp["w_up"])
+    out = dict(qparams)
+    out["layers"] = dict(qparams["layers"])
+    out["layers"]["attn"] = {"wqkv": wqkv, "wo": attn["wo"]}
+    out["layers"]["mlp"] = {"w_gateup": w_gateup,
+                            "w_down": mlp["w_down"]}
+    out["layers"]["ln_attn"] = qparams["layers"]["ln_attn"]
+    out["layers"]["ln_mlp"] = qparams["layers"]["ln_mlp"]
+    return out

@@ -21,11 +21,9 @@ eight virtual CPU devices, or a pod slice — there is no separate
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 from jax import lax

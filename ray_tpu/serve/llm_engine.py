@@ -157,7 +157,8 @@ def llama_paged_adapter(cfg) -> PagedEngineAdapter:
                                       pages_rows, cfg, cache),
         shard_params=lambda params, mesh:
             llama.shard_params_for_serving(params, cfg, mesh),
-        cache_shardings=lambda mesh: llama.paged_cache_shardings(mesh),
+        cache_shardings=lambda mesh: llama.paged_cache_shardings(
+            mesh, kv_int8=cfg.kv_int8),
     )
 
 
@@ -312,6 +313,15 @@ class LLMEngine:
                 )()
             else:
                 self._cache = adapter.init_cache(self._num_pages, page)
+            if (isinstance(self._cache, dict)
+                    and "k_scale" in self._cache
+                    and config.prefill_chunk > 0):
+                raise ValueError(
+                    "kv_int8 pools do not support chunked prefill "
+                    "(per-token page scatters cannot grow page scales "
+                    "on the gather path) — set "
+                    "EngineConfig.prefill_chunk=0 or serve with bf16 "
+                    "KV")
             self._free_pages = list(range(self._num_pages))
             self._slot_pages: Dict[int, List[int]] = {}
             # Unallocated block-table entries hold the OOB sentinel

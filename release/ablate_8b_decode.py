@@ -1,0 +1,208 @@
+"""Decode-step ablation probe: where do the non-weight milliseconds go?
+
+Compiles ONE decode step at reduced depth (--layers, default 4) in
+several ablated variants and reports per-variant device time + temp
+memory.  Differences between variants attribute time to the attention
+kernel, the append kernel, the sampling head, and the rest.
+
+Run: python release/ablate_8b_decode.py [--layers 4] [--slots 24]
+     [--kv-int8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=24)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--pages", type=int, default=0)
+    ap.add_argument("--page-size", type=int, default=64)
+    ap.add_argument("--kv-int8", action="store_true", default=False)
+    ap.add_argument("--steps", type=int, default=64)
+    args = ap.parse_args()
+    pages = args.pages or args.slots * 4
+
+    import dataclasses
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import llama
+    from ray_tpu.models.quant import quantize_params
+
+    dev = jax.devices()[0]
+    cfg = dataclasses.replace(
+        llama.LLAMA3_8B, n_layers=args.layers,
+        max_seq_len=pages * args.page_size // max(1, args.slots),
+        kv_int8=args.kv_int8,
+    )
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        params = llama.init_params(jax.random.key(0), dataclasses.replace(
+            cfg, n_layers=1))
+        qparams = quantize_params(params, cast_rest=jnp.bfloat16)
+        del params
+        qparams = jax.tree.map(np.asarray, qparams)
+    qparams["layers"] = jax.tree.map(
+        lambda x: np.broadcast_to(x, (cfg.n_layers,) + x.shape[1:]),
+        qparams["layers"])
+    qparams = jax.device_put(qparams, dev)
+    jax.block_until_ready(jax.tree.leaves(qparams)[0])
+    wbytes = sum(x.size * x.dtype.itemsize
+                 for x in jax.tree.leaves(qparams))
+    print(f"L={cfg.n_layers} slots={args.slots} pages={pages} "
+          f"kv_int8={args.kv_int8} weights={wbytes/1e9:.2f} GB")
+
+    slots, maxp = args.slots, pages // args.slots
+    bt = jnp.asarray(np.arange(pages, dtype=np.int32)
+                     .reshape(slots, maxp))
+    lengths = jnp.full((slots,), 128, jnp.int32)
+    tokens = jnp.ones((slots,), jnp.int32)
+    active = jnp.ones((slots,), bool)
+
+    def run_variant(name, fn):
+        def k_steps(params, cache, tokens, lengths):
+            def body(carry, _):
+                toks, cache, lens = carry
+                toks, cache, lens = fn(params, cache, toks, lens)
+                return (toks, cache, lens), ()
+
+            (toks, cache, lens), _ = jax.lax.scan(
+                body, (tokens, cache, lengths), None, length=args.steps)
+            return toks, cache, lens
+
+        # Fresh pool per variant: donation consumes it.
+        cache = llama.init_paged_cache(cfg, pages, args.page_size)
+        jitted = jax.jit(k_steps, donate_argnums=(1,))
+        t0 = time.time()
+        lowered = jitted.lower(qparams, cache, tokens, lengths)
+        compiled = lowered.compile()
+        ct = time.time() - t0
+        try:
+            ma = compiled.memory_analysis()
+            temp = ma.temp_size_in_bytes / 1e9
+        except Exception:
+            temp = float("nan")
+        toks, cache2, lens = compiled(qparams, cache, tokens, lengths)
+        float(jax.device_get(jnp.sum(lens)))
+        t0 = time.perf_counter()
+        toks, cache2, lens = compiled(qparams, cache2, toks, lens)
+        float(jax.device_get(jnp.sum(lens)))
+        ms = (time.perf_counter() - t0) / args.steps * 1e3
+        print(f"{name:22s} {ms:7.3f} ms/step  temp={temp:.3f} GB "
+              f"(compile {ct:.0f}s)")
+        return ms
+
+    full = partial(_step, llama, cfg, bt, active, True, True, True)
+    no_head = partial(_step, llama, cfg, bt, active, True, True, False)
+    no_append = partial(_step, llama, cfg, bt, active, True, False, True)
+    no_attn = partial(_step, llama, cfg, bt, active, False, True, True)
+    mlp_only = partial(_step, llama, cfg, bt, active, False, False, False)
+
+    run_variant("full", full)
+    run_variant("no-head", no_head)
+    run_variant("no-append", no_append)
+    run_variant("no-attn-kernel", no_attn)
+    run_variant("mlp+qkv only", mlp_only)
+    return 0
+
+
+def _step(llama, cfg, bt, active, with_attn, with_append, with_head,
+          params, cache, tokens, lengths):
+    """Re-implementation of decode_slots_paged with ablation switches —
+    kept in lockstep with models/llama.py decode_slots_paged."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ray_tpu.models.llama import (
+        _deq_layer,
+        _head_matmul,
+        _mlp_block,
+        _qkv,
+        rms_norm,
+        rope_table,
+    )
+    from ray_tpu.ops.paged_attention import (
+        combine_with_self,
+        paged_append,
+        paged_append_quantized,
+        paged_decode_attention_partial,
+    )
+
+    quantized = "k_scale" in cache
+    page = cache["k"].shape[3]
+    new_len = jnp.where(active, lengths + 1, lengths)
+    positions = lengths[:, None]
+    sin, cos = rope_table(cfg, positions)
+    x = params["tok_embed"][tokens[:, None]].astype(cfg.dtype)
+    maxp = bt.shape[1]
+    scratch = cache["k"].shape[2] - 1
+    pids = jnp.take_along_axis(
+        bt, jnp.minimum(lengths // page, maxp - 1)[:, None], axis=1)[:, 0]
+    pids = jnp.where(active, pids, jnp.int32(scratch))
+    offs = lengths % page
+
+    attn_kw = {}
+    if quantized:
+        attn_kw = dict(k_scales=cache["k_scale"],
+                       v_scales=cache["v_scale"])
+
+    def body(carry, layer):
+        x, li = carry
+        layer = _deq_layer(layer, cfg.dtype)
+        normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        q, k, v = _qkv(normed, layer, cfg, sin, cos)
+        k1, v1 = k[:, 0], v[:, 0]
+        if with_attn:
+            acc, m, l = paged_decode_attention_partial(
+                q[:, 0], cache["k"], cache["v"], li, bt, lengths,
+                soft_cap=cfg.logits_soft_cap, **attn_kw)
+            out = combine_with_self(q[:, 0], k1, v1, acc, m, l,
+                                    soft_cap=cfg.logits_soft_cap)
+        else:
+            out = v[:, 0].repeat(cfg.n_heads // cfg.n_kv_heads, axis=1)
+        out = jnp.einsum("bhk,hkd->bd", out,
+                         layer["attn"]["wo"].astype(cfg.dtype))[:, None]
+        h = x + out
+        h = h + _mlp_block(rms_norm(h, layer["ln_mlp"], cfg.norm_eps),
+                           layer, cfg)
+        return (h, li + 1), (k1, v1)
+
+    (x, _), (k_news, v_news) = lax.scan(
+        body, (x, jnp.int32(0)), params["layers"])
+    if with_append:
+        if quantized:
+            kp, vp, ks, vs = paged_append_quantized(
+                cache["k"], cache["v"], cache["k_scale"],
+                cache["v_scale"], k_news, v_news, pids, offs)
+            new_cache = {"k": kp, "v": vp, "k_scale": ks, "v_scale": vs}
+        else:
+            kp, vp = paged_append(cache["k"], cache["v"], k_news,
+                                  v_news, pids, offs)
+            new_cache = {"k": kp, "v": vp}
+    else:
+        new_cache = cache
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if with_head:
+        head = params["lm_head"]
+        logits = _head_matmul(x[:, 0], head, cfg)
+        toks = jnp.argmax(logits, -1).astype(jnp.int32)
+    else:
+        toks = jnp.sum(x[:, 0], -1).astype(jnp.int32) % 1000
+    return toks, new_cache, new_len
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,7 @@ def main() -> int:
     ap.add_argument("--pages", type=int, default=384)
     ap.add_argument("--page-size", type=int, default=64)
     ap.add_argument("--kv-int8", action="store_true", default=False)
+    ap.add_argument("--fuse", action="store_true", default=False)
     args = ap.parse_args()
 
     import dataclasses
@@ -44,6 +45,7 @@ def main() -> int:
     cfg = dataclasses.replace(
         llama.LLAMA3_8B, n_layers=args.layers,
         max_seq_len=args.pages * args.page_size // max(1, args.slots),
+        kv_int8=args.kv_int8,
     )
     print(f"config: L={cfg.n_layers} dim={cfg.dim} heads={cfg.n_heads} "
           f"kv={cfg.n_kv_heads} mlp={cfg.mlp_dim} vocab={cfg.vocab_size}")
@@ -64,6 +66,11 @@ def main() -> int:
         qparams["layers"])
     qparams = jax.device_put(qparams, dev)
     jax.block_until_ready(jax.tree.leaves(qparams)[0])
+    if args.fuse:
+        from ray_tpu.models.quant import fuse_for_decode
+
+        qparams = fuse_for_decode(qparams, cfg)
+        jax.block_until_ready(jax.tree.leaves(qparams)[0])
     int8_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(qparams))
     print(f"weights resident: {int8_bytes / 1e9:.2f} GB "

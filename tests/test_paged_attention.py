@@ -293,3 +293,129 @@ def test_chunked_prefill_matches_oneshot(tiny_cfg):
     assert got == want
     assert out_long == want
     assert len(out_short) == 6
+
+
+# --- int8 KV pools (per-page scales) ---------------------------------------
+
+
+def test_quantized_partial_kernel_close_to_fp(tiny_cfg):
+    """The int8 partial kernel's combined attention output tracks the
+    full-precision kernel within int8 quantization tolerance."""
+    rng = np.random.default_rng(7)
+    L, B, H, KVH, D, page, maxp = 2, 3, 2, 1, 128, 64, 4
+    P = B * maxp
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((L, KVH, P + 1, page, D)),
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((L, KVH, P + 1, page, D)),
+                    jnp.float32)
+    bt = jnp.asarray(np.arange(P, dtype=np.int32).reshape(B, maxp))
+    lengths = jnp.asarray([5, 100, 256], jnp.int32)
+    qk, sk = llama._quant_pages(k)
+    qv, sv = llama._quant_pages(v)
+    # Scale pools are page-major [L, P, KVH, 1].
+    sk = sk.transpose(0, 2, 1)[..., None]
+    sv = sv.transpose(0, 2, 1)[..., None]
+    for layer in range(L):
+        acc_f, m_f, l_f = pa.paged_decode_attention_partial(
+            q, k, v, jnp.int32(layer), bt, lengths)
+        acc_q, m_q, l_q = pa.paged_decode_attention_partial(
+            q, qk, qv, jnp.int32(layer), bt, lengths,
+            k_scales=sk, v_scales=sv)
+        out_f = np.asarray(acc_f / np.asarray(l_f))
+        out_q = np.asarray(acc_q / np.asarray(l_q))
+        np.testing.assert_allclose(out_q, out_f, atol=0.08, rtol=0.08)
+
+
+def test_quantized_append_grows_scale_and_preserves_rows():
+    """Appends that exceed the page scale grow it and requantize; rows
+    written under a stable scale are untouched bit-for-bit; a write at
+    page offset 0 RESETS the scale (recycled pages must not inherit
+    the previous occupant's)."""
+    L, KVH, P, page, D, B = 1, 1, 3, 8, 128, 1
+    k = jnp.zeros((L, KVH, P + 1, page, D), jnp.int8)
+    v = jnp.zeros_like(k)
+    ks = jnp.zeros((L, P + 1, KVH, 1), jnp.float32)
+    vs = jnp.zeros_like(ks)
+    rng = np.random.default_rng(11)
+    r0 = jnp.asarray(rng.standard_normal((L, B, KVH, D)), jnp.float32)
+    k, v, ks, vs = pa.paged_append_quantized(
+        k, v, ks, vs, r0, r0, jnp.asarray([0]), jnp.asarray([0]))
+    s0 = float(np.asarray(ks)[0, 0, 0, 0])
+    assert s0 > 0
+    row0 = np.asarray(k)[0, 0, 0, 0].copy()
+    # Second row, smaller magnitude: scale must not change, row 0 must
+    # be preserved exactly.
+    r1 = r0 * 0.5
+    k, v, ks, vs = pa.paged_append_quantized(
+        k, v, ks, vs, r1, r1, jnp.asarray([0]), jnp.asarray([1]))
+    assert float(np.asarray(ks)[0, 0, 0, 0]) == s0
+    np.testing.assert_array_equal(np.asarray(k)[0, 0, 0, 0], row0)
+    # Third row, larger: scale grows, old rows requantize consistently.
+    r2 = r0 * 3.0
+    k, v, ks, vs = pa.paged_append_quantized(
+        k, v, ks, vs, r2, r2, jnp.asarray([0]), jnp.asarray([2]))
+    s2 = float(np.asarray(ks)[0, 0, 0, 0])
+    assert s2 > s0
+    deq0 = np.asarray(k)[0, 0, 0, 0].astype(np.float32) * s2
+    np.testing.assert_allclose(deq0, np.asarray(r0)[0, 0, 0],
+                               atol=2.5 * s2)
+    # Recycle: a small row written at offset 0 resets the scale DOWN
+    # instead of quantizing against the stale larger one.
+    tiny = r0 * 0.01
+    k, v, ks, vs = pa.paged_append_quantized(
+        k, v, ks, vs, tiny, tiny, jnp.asarray([0]), jnp.asarray([0]))
+    s_new = float(np.asarray(ks)[0, 0, 0, 0])
+    assert s_new < s2 * 0.1, (s_new, s2)
+    deq = np.asarray(k)[0, 0, 0, 0].astype(np.float32) * s_new
+    np.testing.assert_allclose(deq, np.asarray(tiny)[0, 0, 0],
+                               atol=2.0 * s_new)
+
+
+def test_llama_paged_int8_tracks_fp(tiny_cfg):
+    """End-to-end int8-KV decode: greedy tokens match the fp paged path
+    over several steps (tiny model, moderate lengths)."""
+    cfg = dataclasses.replace(tiny_cfg, kv_int8=True)
+    page, slots, maxp = 64, 2, 4
+    params = llama.init_params(jax.random.PRNGKey(0), tiny_cfg)
+    rng = np.random.default_rng(5)
+    bt = np.arange(slots * maxp, dtype=np.int32).reshape(slots, maxp)
+
+    fp = llama.init_paged_cache(tiny_cfg, num_pages=slots * maxp,
+                                page_size=page)
+    qd = llama.init_paged_cache(cfg, num_pages=slots * maxp,
+                                page_size=page)
+    assert qd["k"].dtype == jnp.int8 and "k_scale" in qd
+    lengths = np.zeros((slots,), np.int32)
+    for s, plen in enumerate([37, 64]):
+        toks = np.zeros((64,), np.int32)
+        toks[:plen] = rng.integers(0, cfg.vocab_size, plen)
+        jt = jnp.asarray(toks)
+        lg_f, fp = llama.prefill_slot_paged(
+            params, jt, jnp.int32(plen), jnp.asarray(bt[s][:1]),
+            tiny_cfg, fp)
+        lg_q, qd = llama.prefill_slot_paged(
+            params, jt, jnp.int32(plen), jnp.asarray(bt[s][:1]), cfg, qd)
+        np.testing.assert_allclose(np.asarray(lg_q), np.asarray(lg_f),
+                                   atol=1e-4, rtol=1e-4)
+        lengths[s] = plen
+
+    cur = np.asarray([3, 9], np.int32)
+    active = jnp.ones((slots,), bool)
+    agree = 0
+    for step in range(6):
+        lg_f, fp, nl_f = llama.decode_slots_paged(
+            params, jnp.asarray(cur), active, jnp.asarray(bt),
+            jnp.asarray(lengths), tiny_cfg, fp)
+        lg_q, qd, nl_q = llama.decode_slots_paged(
+            params, jnp.asarray(cur), active, jnp.asarray(bt),
+            jnp.asarray(lengths), cfg, qd)
+        tf = np.argmax(np.asarray(lg_f), -1)
+        tq = np.argmax(np.asarray(lg_q), -1)
+        agree += int((tf == tq).all())
+        cur = tq.astype(np.int32)
+        lengths = np.asarray(nl_q)
+    # int8 KV is an approximation: demand agreement on the clear
+    # majority of steps (tiny random models amplify quant noise far
+    # beyond trained-model behavior).
+    assert agree >= 4, agree

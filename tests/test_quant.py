@@ -86,3 +86,54 @@ def test_quantized_bytes_counts_int8(tiny):
              for l in jax.tree_util.tree_leaves(params))
     # Weight matrices dominate; int8 tree must be far below the f32 one.
     assert qb < 0.45 * fb
+
+
+def test_fused_decode_matches_unfused():
+    """fuse_for_decode (wqkv + w_gateup) tracks the unfused quantized
+    model through the serving path: same prefill logits (tight) and
+    same greedy decode tokens on a tiny config."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import llama, quant
+
+    cfg = llama.LlamaConfig(
+        vocab_size=199, dim=128, n_layers=2, n_heads=2, n_kv_heads=1,
+        mlp_dim=256, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    q = quant.quantize_params(params, cast_rest=jnp.float32)
+    fused = quant.fuse_for_decode(q, cfg)
+    assert "wqkv" in fused["layers"]["attn"]
+    assert "w_gateup" in fused["layers"]["mlp"]
+
+    page, slots, maxp = 64, 1, 4
+    rng = np.random.default_rng(1)
+    toks = np.zeros((64,), np.int32)
+    toks[:40] = rng.integers(0, cfg.vocab_size, 40)
+    bt = np.arange(slots * maxp, dtype=np.int32).reshape(slots, maxp)
+
+    outs = {}
+    for name, p in (("unfused", q), ("fused", fused)):
+        cache = llama.init_paged_cache(cfg, slots * maxp, page)
+        lg, cache = llama.prefill_slot_paged(
+            p, jnp.asarray(toks), jnp.int32(40),
+            jnp.asarray(bt[0][:1]), cfg, cache)
+        lengths = np.asarray([40], np.int32)
+        cur = np.asarray([int(np.argmax(np.asarray(lg)))], np.int32)
+        seq = [int(cur[0])]
+        for _ in range(5):
+            lg, cache, nl = llama.decode_slots_paged(
+                p, jnp.asarray(cur), jnp.ones((slots,), bool),
+                jnp.asarray(bt), jnp.asarray(lengths), cfg, cache)
+            cur = np.argmax(np.asarray(lg), -1).astype(np.int32)
+            seq.append(int(cur[0]))
+            lengths = np.asarray(nl)
+        outs[name] = (np.asarray(lg), seq)
+    np.testing.assert_allclose(outs["fused"][0], outs["unfused"][0],
+                               atol=0.15, rtol=0.15)
+    assert outs["fused"][1] == outs["unfused"][1]
