@@ -31,9 +31,18 @@ from jax.sharding import Mesh
 
 AXIS_ORDER: Tuple[str, ...] = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
+# Hybrid DCN×ICI axes (SURVEY §5.8 plane 3, megascale-style): the
+# outer, slower network dimension hosts only collective-light
+# parallelism — pure gradient psum (dcn_dp), ZeRO gathers amortized
+# per layer (dcn_fsdp), stage-boundary p2p (dcn_pp).  Model axes
+# (tp/sp/ep) stay strictly within a slice's ICI.  Present in a mesh
+# only when a hybrid spec asks for them, so flat single-slice meshes
+# keep their canonical six axes.
+DCN_AXIS_ORDER: Tuple[str, ...] = ("dcn_pp", "dcn_dp", "dcn_fsdp")
+
 # Axes over which a replica of the model parameters is complete.  Data is
 # split over these; params are replicated (dp) or sharded-and-gathered (fsdp).
-DATA_AXES: Tuple[str, ...] = ("dp", "fsdp")
+DATA_AXES: Tuple[str, ...] = ("dcn_dp", "dcn_fsdp", "dp", "fsdp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +52,12 @@ class MeshSpec:
     Sizes of -1 mean "absorb remaining devices" (at most one axis may be
     -1).  Axes of size 1 are still present in the mesh so sharding rules
     can always refer to every canonical axis.
+
+    ``dcn_*`` sizes > 1 request a HYBRID DCN×ICI mesh: devices group by
+    host/slice (jax ``process_index``/``slice_index``), the dcn axes
+    index the groups, and the canonical axes lay out each group's ICI —
+    the layout ``jax.experimental.mesh_utils.create_hybrid_device_mesh``
+    builds, expressed in this spec language.
     """
 
     pp: int = 1
@@ -51,23 +66,48 @@ class MeshSpec:
     ep: int = 1
     sp: int = 1
     tp: int = 1
+    dcn_pp: int = 1
+    dcn_dp: int = 1
+    dcn_fsdp: int = 1
+
+    @property
+    def hybrid(self) -> bool:
+        return any(getattr(self, a) != 1 for a in DCN_AXIS_ORDER)
+
+    def dcn_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in DCN_AXIS_ORDER}
 
     def sizes(self, num_devices: int) -> Dict[str, int]:
+        for a, s in self.dcn_sizes().items():
+            if s < 1:
+                raise ValueError(
+                    f"{a}={s}: DCN axes take explicit sizes >= 1 (the "
+                    f"-1 wildcard applies to in-slice axes only)")
+        n_groups = math.prod(self.dcn_sizes().values())
+        if num_devices % n_groups:
+            raise ValueError(
+                f"{num_devices} devices not divisible into {n_groups} "
+                f"DCN groups")
+        per_group = num_devices // n_groups
         sizes = {a: getattr(self, a) for a in AXIS_ORDER}
         wild = [a for a, s in sizes.items() if s == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one axis may be -1, got {wild}")
         fixed = math.prod(s for s in sizes.values() if s != -1)
         if wild:
-            if num_devices % fixed:
+            if per_group % fixed:
                 raise ValueError(
-                    f"{num_devices} devices not divisible by fixed axes product {fixed}"
+                    f"{per_group} devices/group not divisible by fixed "
+                    f"axes product {fixed}"
                 )
-            sizes[wild[0]] = num_devices // fixed
-        elif fixed != num_devices:
+            sizes[wild[0]] = per_group // fixed
+        elif fixed != per_group:
             raise ValueError(
-                f"mesh wants {fixed} devices but {num_devices} are available"
+                f"mesh wants {fixed} devices per group but {per_group} "
+                f"are available"
             )
+        if self.hybrid:
+            sizes.update(self.dcn_sizes())
         return sizes
 
     def with_axes(self, **kwargs) -> "MeshSpec":
@@ -94,17 +134,55 @@ def _order_devices_for_ici(devices: List[jax.Device]) -> List[jax.Device]:
     return sorted(devices, key=key)
 
 
+def _group_devices_for_dcn(devs: List[jax.Device],
+                           n_groups: int) -> List[List[jax.Device]]:
+    """Split devices into DCN groups: by ``process_index`` when the
+    world really spans processes, by ``slice_index`` when the backend
+    labels slices, else contiguous equal chunks (the virtual-CPU test
+    shape, where grouping is synthetic by construction)."""
+    for attr in ("process_index", "slice_index"):
+        keys = sorted({getattr(d, attr, None) or 0 for d in devs})
+        if len(keys) == n_groups:
+            groups = {k: [] for k in keys}
+            for d in devs:
+                groups[getattr(d, attr, None) or 0].append(d)
+            counts = {len(g) for g in groups.values()}
+            if len(counts) == 1:
+                return [groups[k] for k in keys]
+    if len(devs) % n_groups:
+        raise ValueError(
+            f"{len(devs)} devices not divisible into {n_groups} groups")
+    per = len(devs) // n_groups
+    return [devs[i * per:(i + 1) * per] for i in range(n_groups)]
+
+
 def create_mesh(
     spec: Optional[MeshSpec] = None,
     *,
     devices: Optional[Sequence[jax.Device]] = None,
     axis_names: Tuple[str, ...] = AXIS_ORDER,
 ) -> Mesh:
-    """Build a Mesh laying canonical axes over ICI-ordered devices."""
+    """Build a Mesh laying canonical axes over ICI-ordered devices.
+
+    A hybrid spec (any dcn_* > 1) produces a mesh named
+    (dcn_pp, dcn_dp, dcn_fsdp) + the canonical axes: DCN axes index
+    host/slice groups, canonical axes lay out each group's ICI."""
     spec = spec or MeshSpec()
     devs = list(devices) if devices is not None else list(jax.devices())
-    devs = _order_devices_for_ici(devs)
     sizes = spec.sizes(len(devs))
+    if spec.hybrid:
+        n_groups = math.prod(sizes[a] for a in DCN_AXIS_ORDER)
+        groups = _group_devices_for_dcn(devs, n_groups)
+        inner_shape = tuple(sizes[a] for a in axis_names)
+        stacked = np.stack([
+            np.asarray(_order_devices_for_ici(g), dtype=object)
+            .reshape(inner_shape)
+            for g in groups
+        ])
+        dcn_shape = tuple(sizes[a] for a in DCN_AXIS_ORDER)
+        arr = stacked.reshape(dcn_shape + inner_shape)
+        return Mesh(arr, DCN_AXIS_ORDER + tuple(axis_names))
+    devs = _order_devices_for_ici(devs)
     shape = tuple(sizes[a] for a in axis_names)
     arr = np.asarray(devs, dtype=object).reshape(shape)
     return Mesh(arr, axis_names)

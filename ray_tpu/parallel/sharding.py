@@ -39,9 +39,20 @@ DEFAULT_RULES: Rules = {
     "state": None,
 }
 
+# Hybrid DCN×ICI meshes: when the target mesh carries a dcn_* axis,
+# the matching in-slice axis expands to (dcn pair, axis) MECHANICALLY
+# at spec time — rule tables stay written in the flat six-axis
+# vocabulary and bare spec_for() calls keep their historical meaning.
+_DCN_EXPANSION = {"dp": "dcn_dp", "fsdp": "dcn_fsdp", "pp": "dcn_pp"}
 
-def spec_for(logical_axes: Sequence[Optional[str]], rules: Optional[Rules] = None) -> P:
-    """Map a tuple of logical axis names (None = replicated dim) to a PartitionSpec."""
+
+def spec_for(logical_axes: Sequence[Optional[str]],
+             rules: Optional[Rules] = None, *,
+             mesh_axes: Optional[frozenset] = None) -> P:
+    """Map a tuple of logical axis names (None = replicated dim) to a
+    PartitionSpec.  ``mesh_axes``: the target mesh's axis names — used
+    to expand dp/fsdp/pp over their DCN partners on hybrid meshes and
+    to drop axes the mesh doesn't carry."""
     rules = {**DEFAULT_RULES, **(rules or {})}
     out = []
     used = set()
@@ -57,6 +68,14 @@ def spec_for(logical_axes: Sequence[Optional[str]], rules: Optional[Rules] = Non
             continue
         if isinstance(axes, str):
             axes = (axes,)
+        if mesh_axes is not None:
+            expanded = []
+            for a in axes:
+                dcn = _DCN_EXPANSION.get(a)
+                if dcn is not None and dcn in mesh_axes:
+                    expanded.append(dcn)
+                expanded.append(a)
+            axes = tuple(a for a in expanded if a in mesh_axes)
         # A mesh axis may appear only once in a PartitionSpec.
         axes = tuple(a for a in axes if a not in used)
         used.update(axes)
@@ -74,7 +93,9 @@ def sharding_for(
     logical_axes: Sequence[Optional[str]],
     rules: Optional[Rules] = None,
 ) -> NamedSharding:
-    return NamedSharding(mesh, spec_for(logical_axes, rules))
+    return NamedSharding(
+        mesh, spec_for(logical_axes, rules,
+                       mesh_axes=frozenset(mesh.axis_names)))
 
 
 def tree_shardings(
@@ -105,15 +126,18 @@ def constrain(x: jax.Array, logical_axes: Sequence[Optional[str]],
     thread-resources mesh is populated — jax.sharding.get_abstract_mesh()
     stays empty — so a bare-PartitionSpec constraint would either raise
     or be dropped; bind the spec to the concrete mesh instead."""
-    spec = spec_for(logical_axes, rules)
     abstract = jax.sharding.get_abstract_mesh()
     if not abstract.empty:
+        spec = spec_for(logical_axes, rules,
+                        mesh_axes=frozenset(abstract.axis_names))
         return jax.lax.with_sharding_constraint(x, spec)
     from jax._src import mesh as _mesh_lib
 
     physical = _mesh_lib.thread_resources.env.physical_mesh
     if physical.empty:
         return x
+    spec = spec_for(logical_axes, rules,
+                    mesh_axes=frozenset(physical.axis_names))
     return jax.lax.with_sharding_constraint(x, NamedSharding(physical, spec))
 
 

@@ -1,0 +1,108 @@
+"""Hybrid DCN×ICI meshes (round-4 verdict item 6).
+
+Parity target: SURVEY §5.8 plane 3 — cross-slice data parallelism over
+DCN with model axes inside a slice's ICI, the layout
+``jax.experimental.mesh_utils.create_hybrid_device_mesh`` builds.
+Here it's a MeshSpec property (dcn_pp/dcn_dp/dcn_fsdp) flowing through
+the same create_mesh + rule-table machinery as flat meshes.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    create_mesh,
+    data_axis_size,
+)
+from ray_tpu.parallel.sharding import DEFAULT_RULES, spec_for
+
+
+def test_hybrid_mesh_axes_and_shape(cpu_devices):
+    mesh = create_mesh(MeshSpec(dcn_dp=2, dp=-1, tp=4),
+                       devices=cpu_devices[:8])
+    assert mesh.axis_names[:3] == ("dcn_pp", "dcn_dp", "dcn_fsdp")
+    assert mesh.shape["dcn_dp"] == 2 and mesh.shape["tp"] == 4
+    assert mesh.shape["dp"] == 1
+    assert data_axis_size(mesh) == 2
+
+
+def test_flat_mesh_unchanged(cpu_devices):
+    mesh = create_mesh(MeshSpec(dp=-1, tp=2), devices=cpu_devices[:8])
+    assert "dcn_dp" not in mesh.axis_names
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+
+
+def test_hybrid_groups_are_contiguous_without_topology(cpu_devices):
+    """Virtual CPU devices carry no slice topology: groups fall back to
+    contiguous equal chunks, keeping each group's devices adjacent."""
+    mesh = create_mesh(MeshSpec(dcn_dp=2, dp=-1), devices=cpu_devices[:8])
+    arr = np.asarray(mesh.devices).reshape(2, 4)
+    ids = [[d.id for d in row] for row in arr]
+    assert sorted(ids[0] + ids[1]) == sorted(d.id for d in
+                                             cpu_devices[:8])
+    assert max(ids[0]) < min(ids[1])  # contiguous split
+
+
+def test_spec_for_drops_axes_absent_from_mesh():
+    flat = frozenset({"pp", "dp", "fsdp", "ep", "sp", "tp"})
+    p = spec_for(("batch", None), DEFAULT_RULES, mesh_axes=flat)
+    assert p == jax.sharding.PartitionSpec(("dp", "fsdp"), None)
+    p = spec_for(("vocab", "embed"), DEFAULT_RULES, mesh_axes=flat)
+    assert p == jax.sharding.PartitionSpec("tp", "fsdp")
+    # On a hybrid mesh dp/fsdp expand over their DCN partners — rule
+    # tables stay written in the flat vocabulary.
+    hybrid = flat | {"dcn_pp", "dcn_dp", "dcn_fsdp"}
+    p = spec_for(("batch", None), DEFAULT_RULES, mesh_axes=hybrid)
+    assert p[0] == ("dcn_dp", "dp", "dcn_fsdp", "fsdp")
+    p = spec_for(("embed", None), DEFAULT_RULES, mesh_axes=hybrid)
+    assert p[0] == ("dcn_fsdp", "fsdp")
+    # Bare spec_for keeps its historical flat meaning.
+    assert spec_for(("batch",))[0] == ("dp", "fsdp")
+
+
+def test_indivisible_groups_rejected(cpu_devices):
+    with pytest.raises(ValueError, match="DCN groups"):
+        MeshSpec(dcn_dp=3).sizes(8)
+
+
+def test_trainer_accepts_hybrid_spec(cpu_devices):
+    """Train accepts MeshSpec(dcn_dp=2, tp=4): dp rides the DCN axis,
+    tensor parallelism stays inside each 4-device group."""
+    from ray_tpu.models import llama
+    from ray_tpu.train import (
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+        default_optimizer,
+    )
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        mlp_dim=64, max_seq_len=32, remat=True,
+    )
+    trainer = JaxTrainer(
+        init_params=lambda r: llama.init_params(r, cfg),
+        loss_fn=lambda p, b: llama.loss_fn(p, b, cfg),
+        params_axes=llama.logical_axes(cfg),
+        batch_axes={"tokens": ("batch", None)},
+        optimizer=default_optimizer(1e-3),
+        scaling_config=ScalingConfig(
+            mesh_spec=MeshSpec(dcn_dp=2, dp=-1, tp=4),
+            devices=cpu_devices[:8]),
+        run_config=RunConfig(report_every=1),
+    )
+    assert trainer.mesh.shape["dcn_dp"] == 2
+    assert trainer.mesh.shape["tp"] == 4
+    rng = np.random.default_rng(0)
+
+    def batches():
+        while True:
+            yield {"tokens": rng.integers(0, cfg.vocab_size, (4, 16),
+                                          dtype=np.int64)
+                   .astype(np.int32)}
+
+    result = trainer.fit(batches(), num_steps=2)
+    assert result.error is None, result.error
+    assert np.isfinite(result.metrics["loss"])

@@ -35,13 +35,14 @@ def test_create_mesh(cpu_devices):
 
 
 def test_spec_for_rules():
+    flat = frozenset({"pp", "dp", "fsdp", "ep", "sp", "tp"})
     # batch maps to (dp, fsdp); embed to fsdp — but fsdp already used by batch,
     # so embed must come out replicated in the same spec.
-    s = spec_for(("batch", None, "embed"))
+    s = spec_for(("batch", None, "embed"), mesh_axes=flat)
     assert s[0] == ("dp", "fsdp")
     assert s[2] is None
     # params don't mention batch, so embed gets fsdp there
-    s2 = spec_for(("embed", "mlp"))
+    s2 = spec_for(("embed", "mlp"), mesh_axes=flat)
     assert s2 == P("fsdp", "tp")
 
 
