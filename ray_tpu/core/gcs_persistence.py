@@ -1,13 +1,28 @@
 """Control-plane persistence — the Redis-backed GCS storage equivalent.
 
 Parity with the reference's pluggable GCS store (ray:
-src/ray/gcs/store_client/redis_store_client.h:33 behind GcsTableStorage,
-selection at gcs_server.cc:517-518): the control plane's durable tables
-(KV, detached-actor creation specs, placement-group specs) snapshot to a
-file; a driver restart pointed at the same path rebuilds them
-(gcs_init_data.cc replays tables the same way).  Snapshots are atomic
-(tmp + rename); a crash loses at most one flush period of writes —
-Redis "appendfsync everysec" semantics.
+src/ray/gcs/store_client/store_client.h — the StoreClient interface;
+src/ray/gcs/store_client/redis_store_client.h:33 the external backend
+behind GcsTableStorage; selection at gcs_server.cc:517-518): the
+control plane's durable tables (KV, detached-actor creation specs,
+placement-group specs) snapshot through a :class:`StoreClient`.
+
+Backends:
+
+* :class:`FileStore` — atomic local snapshot (tmp + rename); a crash
+  loses at most one flush period of writes — Redis "appendfsync
+  everysec" semantics.  Survives head PROCESS loss.
+* :class:`MirroredStore` — a primary plus replica stores, written
+  best-effort on every flush.  With a replica on another failure
+  domain (a peer machine's export, an NFS/GCS-bucket mount), the
+  control plane survives head MACHINE loss: bootstrap loads the
+  NEWEST readable snapshot across primary + mirrors, so a head
+  restarted on a fresh machine with only the mirror reachable
+  recovers its tables (the Redis deployment's role, without requiring
+  a Redis in the image).
+
+A driver/head restart pointed at the same store rebuilds the tables
+(gcs_init_data.cc replays tables the same way).
 """
 
 from __future__ import annotations
@@ -16,53 +31,62 @@ import os
 import pickle
 import tempfile
 import threading
-from typing import Any, Callable, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Optional, Sequence
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
 
 
-class GcsPersistence:
-    """Atomic snapshot file + dirty-flag flusher thread."""
+class StoreClient:
+    """Minimal durable-snapshot interface (parity:
+    src/ray/gcs/store_client/store_client.h, narrowed to the snapshot
+    granularity this control plane persists at)."""
 
-    def __init__(self, path: str, flush_period_s: float = 0.2):
+    def load_blob(self) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def save_blob(self, blob: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        raise NotImplementedError
+
+
+class FileStore(StoreClient):
+    """Atomic snapshot file (tmp + fsync + rename)."""
+
+    def __init__(self, path: str):
         self.path = path
-        self._period = flush_period_s
-        self._dirty = threading.Event()
-        self._stop = threading.Event()
-        # Serializes saves: the final flush must never lose to a stale
-        # in-flight periodic save's os.replace.
-        self._save_lock = threading.Lock()
-        self._collect: Optional[Callable[[], Dict[str, Any]]] = None
-        self._thread: Optional[threading.Thread] = None
 
-    # -- load --------------------------------------------------------------
-
-    def load(self) -> Optional[Dict[str, Any]]:
-        """The last snapshot, or None (missing/corrupt file — a torn
-        write can't happen thanks to rename, but a foreign file can)."""
+    def load_blob(self) -> Optional[Dict[str, Any]]:
         try:
             with open(self.path, "rb") as f:
                 blob = pickle.load(f)
         except Exception:
             # OSError, UnpicklingError, but also AttributeError/
             # ImportError/ValueError from foreign or corrupt pickles —
-            # any unreadable snapshot means "start fresh", never "fail
-            # init" (recovery is the whole point of this file).
+            # any unreadable snapshot means "no data here", never
+            # "fail init" (recovery is the whole point).
             return None
-        if (not isinstance(blob, dict)
-                or blob.get("version") != _FORMAT_VERSION):
+        if not isinstance(blob, dict):
             return None
-        return blob.get("tables")
+        if blob.get("version") == 1 and "tables" in blob:
+            # v1 (pre-mirror) snapshots carry no seq/saved_at: migrate
+            # in place rather than silently dropping a cluster's
+            # persisted control plane on upgrade.
+            return {"version": _FORMAT_VERSION, "seq": 0,
+                    "saved_at": 0.0, "tables": blob["tables"]}
+        if blob.get("version") != _FORMAT_VERSION:
+            return None
+        return blob
 
-    # -- save --------------------------------------------------------------
-
-    def save(self, tables: Dict[str, Any]) -> None:
+    def save_blob(self, blob: Dict[str, Any]) -> None:
         d = os.path.dirname(os.path.abspath(self.path)) or "."
         os.makedirs(d, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".gcs-snap-")
         try:
             with os.fdopen(fd, "wb") as f:
-                pickle.dump({"version": _FORMAT_VERSION, "tables": tables}, f)
+                pickle.dump(blob, f)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
@@ -72,6 +96,109 @@ class GcsPersistence:
             except OSError:
                 pass
             raise
+
+    def describe(self) -> str:
+        return f"file:{self.path}"
+
+
+class MirroredStore(StoreClient):
+    """Primary + best-effort replicas; loads pick the NEWEST readable
+    snapshot (each blob carries a monotonic save counter + wall time),
+    so bootstrap works from whichever copy survived."""
+
+    def __init__(self, primary: StoreClient,
+                 mirrors: Sequence[StoreClient]):
+        self.primary = primary
+        self.mirrors = list(mirrors)
+        self._warned: set = set()
+
+    def load_blob(self) -> Optional[Dict[str, Any]]:
+        candidates = []
+        for store in [self.primary] + self.mirrors:
+            blob = store.load_blob()
+            if blob is not None:
+                candidates.append(blob)
+        if not candidates:
+            return None
+        return max(candidates,
+                   key=lambda b: (b.get("seq", 0), b.get("saved_at", 0)))
+
+    def save_blob(self, blob: Dict[str, Any]) -> None:
+        # The primary's failure is the caller's failure (same contract
+        # as the single-file store); replicas are best-effort — an
+        # unreachable mirror mount must not take the control plane
+        # down with it.  A failing mirror is WARNED once: the
+        # machine-loss protection it provides must not rot silently.
+        self.primary.save_blob(blob)
+        for m in self.mirrors:
+            try:
+                m.save_blob(blob)
+                self._warned.discard(m.describe())
+            except Exception as e:
+                key = m.describe()
+                if key not in self._warned:
+                    self._warned.add(key)
+                    import logging
+
+                    logging.getLogger("ray_tpu.gcs").warning(
+                        "GCS mirror %s is failing (%r) — head "
+                        "machine-loss recovery is degraded until it "
+                        "recovers", key, e)
+
+    def describe(self) -> str:
+        return " + ".join(s.describe()
+                          for s in [self.primary] + self.mirrors)
+
+
+def make_store(path: str, mirror_paths: Sequence[str] = ()) -> StoreClient:
+    """Store from config strings (parity: gcs_server.cc:517-518
+    choosing the storage backend from flags)."""
+    primary = FileStore(path)
+    mirrors = [FileStore(p) for p in mirror_paths if p]
+    if mirrors:
+        return MirroredStore(primary, mirrors)
+    return primary
+
+
+class GcsPersistence:
+    """Snapshot + dirty-flag flusher thread over a StoreClient."""
+
+    def __init__(self, path: str, flush_period_s: float = 0.2,
+                 mirror_paths: Sequence[str] = ()):
+        self.store = make_store(path, mirror_paths)
+        self.path = path
+        self._period = flush_period_s
+        self._dirty = threading.Event()
+        self._stop = threading.Event()
+        # Serializes saves: the final flush must never lose to a stale
+        # in-flight periodic save's os.replace.
+        self._save_lock = threading.Lock()
+        self._seq = 0
+        self._collect: Optional[Callable[[], Dict[str, Any]]] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- load --------------------------------------------------------------
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        """The newest readable snapshot's tables, or None."""
+        blob = self.store.load_blob()
+        if blob is None:
+            return None
+        # Resume the save counter past the restored snapshot so a
+        # restart's snapshots outrank the old generation on mirrors.
+        self._seq = int(blob.get("seq", 0))
+        return blob.get("tables")
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, tables: Dict[str, Any]) -> None:
+        self._seq += 1
+        self.store.save_blob({
+            "version": _FORMAT_VERSION,
+            "seq": self._seq,
+            "saved_at": time.time(),
+            "tables": tables,
+        })
 
     # -- flusher -----------------------------------------------------------
 

@@ -1307,7 +1307,10 @@ class LocalRuntime:
             from ray_tpu.core.gcs_persistence import GcsPersistence
 
             self._persist = GcsPersistence(
-                cfg.gcs_persist_path, cfg.gcs_flush_period_s
+                cfg.gcs_persist_path, cfg.gcs_flush_period_s,
+                mirror_paths=[p.strip() for p in
+                              cfg.gcs_persist_mirrors.split(",")
+                              if p.strip()],
             )
             self._restored_tables = self._persist.load()
             if self._restored_tables:

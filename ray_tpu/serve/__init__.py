@@ -26,11 +26,17 @@ from ray_tpu.serve.handle import (
     DeploymentResponse,
     _shutdown_routers,
 )
+from ray_tpu.serve.graph import (
+    DAGDriver,
+    InputNode,
+    build_graph_app,
+)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "DeploymentResponse", "batch", "deployment",
+    "DAGDriver", "DeploymentHandle", "DeploymentResponse",
+    "InputNode", "batch", "build_graph_app", "deployment",
     "delete", "get_app_handle", "get_deployment_handle",
     "get_multiplexed_model_id", "multiplexed", "run", "shutdown",
     "start", "status",

@@ -1,0 +1,211 @@
+"""Serve deployment graphs — DAG → multi-deployment application.
+
+Parity with the reference's deployment-graph build
+(ray: python/ray/serve/_private/deployment_graph_build.py and the
+DAGDriver ingress): a request-time dataflow is authored with the DAG
+idiom —
+
+    with serve.InputNode() as inp:
+        a = Preprocess.bind()           # @serve.deployment class
+        b = Model.bind()
+        out = b.predict.bind(a.clean.bind(inp))
+    app = serve.build_graph_app(out)
+    serve.run(app)
+
+Each bound deployment becomes its OWN deployment with independent
+replica scaling; ``build_graph_app`` flattens the method-call DAG into
+a declarative node spec and wraps it in a generated ingress deployment
+(the DAGDriver) that executes the spec per request, passing
+DeploymentResponses straight into downstream handles so independent
+branches run pipelined, never serialized through ``.result()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+
+
+class InputNode:
+    """Placeholder for the per-request input (parity:
+    ray.dag.InputNode used by serve graphs)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class DAGMethodNode:
+    """One ``app.method.bind(...)`` call in the request dataflow."""
+
+    def __init__(self, app: Application, method: str, args: tuple,
+                 kwargs: dict):
+        self.app = app
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs
+
+    def __getattr__(self, name: str):
+        raise AttributeError(
+            f"DAGMethodNode has no attribute {name!r} — chain further "
+            f"calls on a bound deployment, not on a method node")
+
+
+class _MethodBinder:
+    def __init__(self, app: Application, method: str):
+        self._app = app
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> DAGMethodNode:
+        return DAGMethodNode(self._app, self._method, args, kwargs)
+
+
+def _app_getattr(self: Application, name: str):
+    if name.startswith("_"):
+        raise AttributeError(name)
+    target = self.deployment.func_or_class
+    # Only real methods of the deployment's class bind — a typo'd
+    # attribute must stay a loud AttributeError, not become a silent
+    # _MethodBinder.
+    if not hasattr(target, name):
+        raise AttributeError(
+            f"Application has no attribute {name!r} and deployment "
+            f"class {getattr(target, '__name__', target)!r} defines "
+            f"no such method")
+    return _MethodBinder(self, name)
+
+
+# Application grows the method-binding surface here (kept out of
+# deployment.py so the graph layer owns the DAG idiom).
+Application.__getattr__ = _app_getattr  # type: ignore[attr-defined]
+
+
+# --- declarative node spec (what ships into the driver) --------------------
+#
+# Arg references: ("input",) | ("node", idx) | ("const", value).
+
+@dataclasses.dataclass
+class _NodeSpec:
+    deployment_name: str
+    method: str
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+
+
+class DAGDriver:
+    """Generated ingress: executes the node spec per request.
+
+    Submits each node as soon as its argument nodes are SUBMITTED
+    (DeploymentResponses pass straight into downstream ``.remote``
+    calls — the composition contract), so parallel branches pipeline;
+    only the terminal node's response is resolved."""
+
+    def __init__(self, spec: List[_NodeSpec], handles: Dict[str, Any]):
+        self._spec = spec
+        self._handles = handles
+
+    def __call__(self, request_value: Any) -> Any:
+        results: List[Any] = []
+        for node in self._spec:
+            def deref(ref, nested=False):
+                kind = ref[0]
+                if kind == "input":
+                    return request_value
+                if kind == "node":
+                    r = results[ref[1]]
+                    # Replicas resolve upstream responses only at the
+                    # TOP level of the args tuple; a response nested
+                    # inside a container must resolve here (that
+                    # branch loses pipelining — keep hot-path nodes as
+                    # direct arguments).
+                    return r.result() if nested else r
+                if kind == "seq":
+                    seq = [deref(e, nested=True) for e in ref[2]]
+                    return tuple(seq) if ref[1] else seq
+                if kind == "map":
+                    return {k: deref(e, nested=True)
+                            for k, e in ref[1].items()}
+                return ref[1]  # const
+
+            handle = self._handles[node.deployment_name]
+            method = getattr(handle, node.method)
+            resp = method.remote(*[deref(a) for a in node.args],
+                                 **{k: deref(v)
+                                    for k, v in node.kwargs.items()})
+            results.append(resp)
+        return results[-1].result()
+
+
+def build_graph_app(output: DAGMethodNode, *,
+                    driver_name: str = "DAGDriver",
+                    max_ongoing_requests: int = 64) -> Application:
+    """Flatten a method-call DAG into one Application: the returned
+    ingress wraps a DAGDriver whose init args carry each bound
+    deployment as a nested Application — the existing
+    ``build_application`` pass turns those into DeploymentHandles, so
+    every graph node scales independently."""
+    if not isinstance(output, DAGMethodNode):
+        raise TypeError("build_graph_app expects the DAG's terminal "
+                        "app.method.bind(...) node")
+    order: List[DAGMethodNode] = []
+    index: Dict[int, int] = {}
+    apps: Dict[str, Application] = {}
+    visiting: set = set()
+
+    def visit(node: DAGMethodNode) -> int:
+        key = id(node)
+        if key in index:
+            return index[key]
+        if key in visiting:
+            raise ValueError("deployment graph has a cycle")
+        visiting.add(key)
+        name = node.app.deployment.name
+        seen = apps.get(name)
+        if seen is not None and seen is not node.app:
+            raise ValueError(
+                f"duplicate deployment name {name!r} in the graph — "
+                f"use .options(name=...) to disambiguate")
+        apps[name] = node.app
+
+        def ref_of(v) -> Tuple:
+            if isinstance(v, InputNode):
+                return ("input",)
+            if isinstance(v, DAGMethodNode):
+                return ("node", visit(v))
+            if isinstance(v, Application):
+                raise TypeError(
+                    "a bound deployment appeared as a call argument — "
+                    "bind a METHOD of it (app.method.bind(...)) or "
+                    "pass it as an init arg instead")
+            # Containers recurse so nodes nested in lists/dicts wire
+            # up instead of shipping as opaque constants.
+            if isinstance(v, (list, tuple)):
+                return ("seq", type(v) is tuple,
+                        tuple(ref_of(e) for e in v))
+            if isinstance(v, dict):
+                return ("map", {k: ref_of(e) for k, e in v.items()})
+            return ("const", v)
+
+        spec_args = tuple(ref_of(a) for a in node.args)
+        spec_kwargs = {k: ref_of(v) for k, v in node.kwargs.items()}
+        visiting.discard(key)
+        order.append(node)
+        idx = len(order) - 1
+        index[key] = idx
+        node._spec = _NodeSpec(name, node.method, spec_args,
+                               spec_kwargs)  # type: ignore[attr-defined]
+        return idx
+
+    visit(output)
+    spec = [n._spec for n in order]  # type: ignore[attr-defined]
+    driver = deployment(
+        DAGDriver, name=driver_name,
+        max_ongoing_requests=max_ongoing_requests)
+    # Nested Applications in init args become DeploymentHandles at
+    # deploy time (deployment.build_application) — the graph's nodes
+    # each get their own deployment + replica set.
+    return driver.bind(spec, dict(apps))

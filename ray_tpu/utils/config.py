@@ -159,6 +159,13 @@ D("gcs_persist_path", str, "",
 D("gcs_flush_period_s", float, 0.2,
   "Dirty-snapshot flush period (crash loses at most this window, like "
   "Redis AOF everysec).")
+D("gcs_persist_mirrors", str, "",
+  "Comma-separated replica snapshot paths mirrored best-effort on "
+  "every flush (a peer machine's export / NFS / bucket mount).  Head "
+  "bootstrap loads the NEWEST readable snapshot across primary + "
+  "mirrors, so the control plane survives head MACHINE loss — the "
+  "external-Redis deployment's role (gcs_server.cc:517-518).  "
+  "Env: RAYTPU_GCS_PERSIST_MIRRORS.")
 D("head_reconnect_window_s", float, 60.0,
   "How long a node daemon keeps retrying to rejoin the head after its "
   "channel drops before giving up and exiting (parity: raylets "

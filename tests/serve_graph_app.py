@@ -1,0 +1,25 @@
+"""A deployment-graph application imported by the serve YAML schema
+test (tests/test_serve_graph.py::test_graph_from_yaml_schema)."""
+
+from ray_tpu import serve
+
+
+@serve.deployment
+class Words:
+    def split(self, text):
+        return text.split()
+
+
+@serve.deployment
+class Scale:
+    def __init__(self, k):
+        self.k = k
+
+    def times(self, tokens):
+        return self.k * float(len(tokens))
+
+
+with serve.InputNode() as _inp:
+    app = serve.build_graph_app(
+        Scale.bind(3.0).times.bind(Words.bind().split.bind(_inp)),
+        driver_name="YamlGraphDriver")

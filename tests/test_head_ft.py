@@ -40,12 +40,14 @@ def _free_port() -> int:
     return port
 
 
-def _base_env(persist_path):
+def _base_env(persist_path, mirror_path=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     env["JAX_PLATFORMS"] = "cpu"
     env["RAYTPU_GCS_PERSIST_PATH"] = persist_path
+    if mirror_path:
+        env["RAYTPU_GCS_PERSIST_MIRRORS"] = mirror_path
     env["RAYTPU_GCS_FLUSH_PERIOD_S"] = "0.05"
     env["RAYTPU_HEAD_RECONNECT_WINDOW_S"] = "120"
     env["RAYTPU_HEAD_RECONNECT_RETRY_S"] = "0.25"
@@ -53,12 +55,12 @@ def _base_env(persist_path):
     return env
 
 
-def _spawn_head(node_port, client_port, persist_path):
+def _spawn_head(node_port, client_port, persist_path, mirror_path=None):
     return subprocess.Popen(
         [sys.executable, "-m", "ray_tpu.scripts.cli", "start", "--head",
          "--port", str(node_port), "--client-port", str(client_port),
          "--dashboard-port", "0", "--num-cpus", "2"],
-        env=_base_env(persist_path),
+        env=_base_env(persist_path, mirror_path),
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
 
@@ -101,9 +103,14 @@ def _wait_slots(ctx, n, deadline_s=90.0):
 
 
 def test_head_kill9_daemons_rejoin(tmp_path):
+    """Head kill -9 AND loss of its primary snapshot: the restarted
+    head bootstraps from the MIRROR store (the external-Redis role —
+    head MACHINE loss, not just process restart; round-4 verdict item
+    8), and the daemons rejoin with state intact."""
     persist = str(tmp_path / "gcs-snapshot.bin")
+    mirror = str(tmp_path / "mirror" / "gcs-snapshot.bin")
     node_port, client_port = _free_port(), _free_port()
-    head = _spawn_head(node_port, client_port, persist)
+    head = _spawn_head(node_port, client_port, persist, mirror)
     daemons = []
     try:
         ctx = _connect_retry(client_port)
@@ -136,14 +143,16 @@ def test_head_kill9_daemons_rejoin(tmp_path):
         oid = ref.binary_id
         time.sleep(0.3)  # > flush period: specs must reach the snapshot
 
-        # -- kill -9 the head ------------------------------------------
+        # -- kill -9 the head, DESTROY its primary snapshot ------------
         head.send_signal(signal.SIGKILL)
         head.wait(timeout=10)
         for d in daemons:
             assert d.poll() is None, "daemon died with the head"
+        assert os.path.exists(mirror), "mirror snapshot never written"
+        os.unlink(persist)  # simulate losing the head machine's disk
 
-        # -- restart at the same ports ---------------------------------
-        head = _spawn_head(node_port, client_port, persist)
+        # -- restart at the same ports: bootstrap from the mirror ------
+        head = _spawn_head(node_port, client_port, persist, mirror)
         ctx2 = _connect_retry(client_port, deadline_s=90)
         _wait_slots(ctx2, 2)  # both daemons rejoined
         for d in daemons:

@@ -1,0 +1,158 @@
+"""Serve deployment graphs: DAG → multi-deployment application.
+
+Parity target: ray python/ray/serve/_private/deployment_graph_build.py
+(+ the DAGDriver ingress) — a request dataflow authored with
+InputNode/.bind() deploys as independent deployments behind one
+generated ingress.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance():
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    serve.start()
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@serve.deployment
+class Tokenize:
+    def clean(self, text):
+        return text.strip().lower().split()
+
+
+@serve.deployment(num_replicas=2)
+class Score:
+    def __init__(self, weight=1.0):
+        self.weight = weight
+
+    def predict(self, tokens):
+        return self.weight * float(len(tokens))
+
+
+@serve.deployment
+class Combine:
+    def merge(self, a, b):
+        return {"sum": a + b, "max": max(a, b)}
+
+
+def test_two_stage_graph_over_http(serve_instance):
+    """ingress → Tokenize → Score, each its own deployment with its
+    own replica count, served end-to-end through the HTTP proxy."""
+    with serve.InputNode() as inp:
+        tok = Tokenize.bind()
+        score = Score.bind(2.0)
+        out = score.predict.bind(tok.clean.bind(inp))
+    app = serve.build_graph_app(out)
+    handle = serve.run(app, name="pipeline", route_prefix="/pipeline")
+
+    # Independent scaling: the graph's stages are separate deployments
+    # with their own replica sets.
+    deps = serve.status()["applications"]["pipeline"]["deployments"]
+    assert set(deps) >= {"DAGDriver", "Tokenize", "Score"}
+    assert deps["Score"]["target_replicas"] == 2
+    assert deps["Tokenize"]["target_replicas"] == 1
+
+    r = handle.remote("  Hello Serve Graph  ").result(timeout_s=30)
+    assert r == 6.0  # 3 tokens * weight 2.0
+
+    proxy = serve.start(http_port=0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{proxy.port}/pipeline",
+        data=json.dumps("a b c d").encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert json.loads(resp.read()) == 8.0
+
+
+def test_diamond_graph_branches_pipeline(serve_instance):
+    """Two scorers branch off one shared upstream node and merge — the
+    fan-out/fan-in shape; branch responses feed Combine as
+    DeploymentResponses (no host-side result() in the driver)."""
+    with serve.InputNode() as inp:
+        cleaned = Tokenize.bind().clean.bind(inp)
+        sa = Score.options(name="ScoreA").bind(1.0)
+        sb = Score.options(name="ScoreB").bind(10.0)
+        out = Combine.bind().merge.bind(sa.predict.bind(cleaned),
+                                        sb.predict.bind(cleaned))
+    app = serve.build_graph_app(out, driver_name="DiamondDriver")
+    handle = serve.run(app, name="diamond", route_prefix="/diamond")
+    r = handle.remote("x y").result(timeout_s=30)
+    assert r == {"sum": 2.0 + 20.0, "max": 20.0}
+    deps = serve.status()["applications"]["diamond"]["deployments"]
+    assert set(deps) >= {"DiamondDriver", "Tokenize", "ScoreA",
+                         "ScoreB", "Combine"}
+
+
+def test_graph_rejects_duplicate_names(serve_instance):
+    with serve.InputNode() as inp:
+        a = Score.bind(1.0)
+        b = Score.bind(2.0)
+        out = Combine.bind().merge.bind(a.predict.bind(inp),
+                                        b.predict.bind(inp))
+    with pytest.raises(ValueError, match="duplicate deployment name"):
+        serve.build_graph_app(out)
+
+
+def test_graph_from_yaml_schema(serve_instance, tmp_path):
+    """The schema/YAML path deploys a graph app via import_path —
+    deployment graphs ride the declarative config like any app."""
+    from ray_tpu.serve import schema as serve_schema
+
+    cfg = tmp_path / "graph.yaml"
+    cfg.write_text(
+        "applications:\n"
+        "  - name: gapp\n"
+        "    route_prefix: /gapp\n"
+        "    import_path: tests.serve_graph_app:app\n"
+    )
+    serve_schema.deploy(str(cfg))
+    deadline = time.time() + 30
+    handle = None
+    while time.time() < deadline:
+        try:
+            handle = serve.get_app_handle("gapp")
+            break
+        except Exception:
+            time.sleep(0.3)
+    assert handle is not None
+    assert handle.remote("one two three").result(timeout_s=30) == 9.0
+
+
+def test_graph_nodes_nested_in_containers(serve_instance):
+    """Nodes inside list/dict arguments wire up (resolved driver-side)
+    instead of shipping as opaque constants."""
+
+    @serve.deployment
+    class Gather:
+        def collect(self, parts, named):
+            return sorted(parts) + [named["x"]]
+
+    with serve.InputNode() as inp:
+        cleaned = Tokenize.bind().clean.bind(inp)
+        sa = Score.options(name="SeqA").bind(1.0)
+        sb = Score.options(name="SeqB").bind(5.0)
+        out = Gather.bind().collect.bind(
+            [sa.predict.bind(cleaned), sb.predict.bind(cleaned)],
+            {"x": 7.0})
+    app = serve.build_graph_app(out, driver_name="GatherDriver")
+    handle = serve.run(app, name="gather", route_prefix="/gather")
+    assert handle.remote("a b c").result(timeout_s=30) == [3.0, 15.0,
+                                                          7.0]
+
+
+def test_application_typo_stays_loud(serve_instance):
+    app = Score.bind(1.0)
+    with pytest.raises(AttributeError, match="no such method"):
+        app.predictt  # noqa: B018 — typo must not become a binder
