@@ -120,30 +120,47 @@ class MirroredStore(StoreClient):
                 candidates.append(blob)
         if not candidates:
             return None
+        # Wall time dominates, seq breaks ties: after a lineage
+        # divergence (a mirror that was unreachable while the primary
+        # kept writing, then came back with a HIGHER old seq), the
+        # fresher copy must win — restoring the stale generation would
+        # resurrect deleted actors and drop recent writes.  Clock skew
+        # between a head and its replacement is far smaller than the
+        # staleness windows that matter here.
         return max(candidates,
-                   key=lambda b: (b.get("seq", 0), b.get("saved_at", 0)))
+                   key=lambda b: (b.get("saved_at", 0), b.get("seq", 0)))
+
+    def _warn_once(self, store: StoreClient, err: Exception,
+                   role: str) -> None:
+        key = store.describe()
+        if key not in self._warned:
+            self._warned.add(key)
+            import logging
+
+            logging.getLogger("ray_tpu.gcs").warning(
+                "GCS %s store %s is failing (%r) — snapshot "
+                "durability is degraded until it recovers", role, key,
+                err)
 
     def save_blob(self, blob: Dict[str, Any]) -> None:
-        # The primary's failure is the caller's failure (same contract
-        # as the single-file store); replicas are best-effort — an
-        # unreachable mirror mount must not take the control plane
-        # down with it.  A failing mirror is WARNED once: the
-        # machine-loss protection it provides must not rot silently.
-        self.primary.save_blob(blob)
-        for m in self.mirrors:
+        # Every store is written INDEPENDENTLY — a dead primary (the
+        # exact head-disk failure mirroring exists for) must not stop
+        # the replicas from advancing.  Each failing store WARNS once;
+        # the save as a whole fails only when NO copy persisted.
+        first_err: Optional[Exception] = None
+        ok = 0
+        for role, store in [("primary", self.primary)] + [
+                ("mirror", m) for m in self.mirrors]:
             try:
-                m.save_blob(blob)
-                self._warned.discard(m.describe())
+                store.save_blob(blob)
+                ok += 1
+                self._warned.discard(store.describe())
             except Exception as e:
-                key = m.describe()
-                if key not in self._warned:
-                    self._warned.add(key)
-                    import logging
-
-                    logging.getLogger("ray_tpu.gcs").warning(
-                        "GCS mirror %s is failing (%r) — head "
-                        "machine-loss recovery is degraded until it "
-                        "recovers", key, e)
+                if first_err is None:
+                    first_err = e
+                self._warn_once(store, e, role)
+        if ok == 0 and first_err is not None:
+            raise first_err
 
     def describe(self) -> str:
         return " + ".join(s.describe()

@@ -13,8 +13,9 @@ Distributed sampling uses EnvRunner actors over ray_tpu.core.
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.algorithms import (A2C, APPO, DDPG, DQN, IMPALA, PPO,
-                                      SAC, TD3, A2CConfig, APPOConfig,
+from ray_tpu.rllib.algorithms import (A2C, APEXDQN, APPO, DDPG, DQN,
+                                      IMPALA, PPO, SAC, TD3, A2CConfig,
+                                      APEXDQNConfig, APPOConfig,
                                       DDPGConfig, DQNConfig,
                                       IMPALAConfig, PPOConfig, SACConfig,
                                       TD3Config, vtrace)

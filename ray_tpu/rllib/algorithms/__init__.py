@@ -1,6 +1,7 @@
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig
+from ray_tpu.rllib.algorithms.apex_dqn import APEXDQN, APEXDQNConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.grpo import GRPO, GRPOConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
@@ -10,5 +11,5 @@ from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config
 
 __all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig", "DDPG",
            "DDPGConfig", "GRPO", "GRPOConfig", "PPO", "PPOConfig",
-           "DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "vtrace",
+           "APEXDQN", "APEXDQNConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "vtrace",
            "SAC", "SACConfig", "TD3", "TD3Config"]

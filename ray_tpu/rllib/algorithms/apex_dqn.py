@@ -1,0 +1,427 @@
+"""APEX-DQN — distributed prioritized experience replay.
+
+Parity target: the reference's Ape-X stack (ray:
+rllib/algorithms/apex_dqn/ — Horgan et al. 2018): N rollout ACTORS
+with a per-actor epsilon ladder stream transitions to a central
+learner; the learner samples from a prioritized buffer at a high
+update-to-sample ratio, refreshes priorities from its own TD errors
+ASYNCHRONOUSLY (actors keep collecting with slightly stale weights),
+and pushes fresh weights back on a period.
+
+TPU redesign: rollout actors are core-runtime actors running a jitted
+epsilon-greedy ``lax.scan`` unroll; the learner is the LearnerGroup
+pattern (rllib/learner.py) — with ``num_learners > 1`` the prioritized
+buffer state is SHARDED over a dp mesh (each shard owns
+capacity/num_learners slots, ingests its slice of every incoming
+stream, and samples its own minibatch) and one shard_mapped program
+does sample → TD gradients → pmean → apply → per-shard priority
+update per step.  Buffer, sampling, and priority math are the pure
+device functions of PrioritizedDeviceReplayBuffer, so the sharded and
+single-device paths share all of it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.dqn import DQNConfig
+from ray_tpu.rllib.env import make_env, terminal_mask
+from ray_tpu.rllib.models import (
+    dueling_q_values,
+    init_dueling_q_net,
+    init_q_net,
+    q_values,
+)
+from ray_tpu.rllib.replay_buffer import PrioritizedDeviceReplayBuffer
+
+
+class APEXDQNConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 2
+        self.runner_envs = 8          # vectorized envs per runner
+        self.rollout_length = 32      # env steps per runner batch
+        # Epsilon ladder (Ape-X eq. 1): runner i explores at
+        # eps_base ** (1 + i/(N-1) * eps_alpha) — one near-greedy
+        # runner, one heavy explorer, the rest spread between.
+        self.eps_base = 0.4
+        self.eps_alpha = 7.0
+        # Learner: SGD steps per ingested runner batch (the high
+        # update-to-sample ratio that defines Ape-X).
+        self.updates_per_batch = 8
+        self.target_update_updates = 200
+        self.num_learners = 1         # dp shards of the buffer+update
+        self.steps_per_iteration = 512
+
+    @property
+    def algo_class(self):
+        return APEXDQN
+
+
+class _ApexRunnerCls:
+    """Rollout actor: jitted epsilon-greedy unroll at a FIXED epsilon
+    (its rung of the ladder)."""
+
+    def __init__(self, env_spec, env_config, dueling, hidden, num_envs,
+                 rollout_length, seed, epsilon):
+        import jax
+        import jax.numpy as jnp
+
+        self.env = make_env(env_spec, **(env_config or {}))
+        env = self.env
+        q_fn = dueling_q_values if dueling else q_values
+        self.key = jax.random.key(seed)
+        self.key, kr = jax.random.split(self.key)
+        self.env_state, self.obs = jax.vmap(env.reset)(
+            jax.random.split(kr, num_envs))
+        self.ep_ret = jnp.zeros(num_envs)
+        n_envs = num_envs
+
+        def unroll(params, env_state, obs, ep_ret, key):
+            v_step = jax.vmap(env.step)
+            v_reset = jax.vmap(env.reset)
+
+            def one(carry, k):
+                env_state, obs, ep_ret, ret_sum, ret_cnt = carry
+                k_eps, k_act, k_reset = jax.random.split(k, 3)
+                q = q_fn(params, obs)
+                greedy = jnp.argmax(q, axis=1).astype(jnp.int32)
+                rand_a = jax.random.randint(
+                    k_act, (n_envs,), 0, env.action_size)
+                explore = jax.random.uniform(k_eps, (n_envs,)) < epsilon
+                action = jnp.where(explore, rand_a, greedy)
+                nstate, nobs, reward, done = v_step(env_state, action)
+                term = terminal_mask(env, nstate, done)
+                ep_ret = ep_ret + reward
+                ret_sum = ret_sum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+                ret_cnt = ret_cnt + jnp.sum(done)
+                ep_ret = jnp.where(done, 0.0, ep_ret)
+                out = {"obs": obs, "action": action, "reward": reward,
+                       "next_obs": nobs, "done": term}
+                rk = jax.random.split(k_reset, n_envs)
+                rs, ro = v_reset(rk)
+                nstate = jax.tree_util.tree_map(
+                    lambda r, c: jnp.where(
+                        jnp.reshape(done,
+                                    done.shape + (1,) * (r.ndim - 1)),
+                        r, c), rs, nstate)
+                nobs = jnp.where(done[:, None], ro, nobs)
+                return (nstate, nobs, ep_ret, ret_sum, ret_cnt), out
+
+            keys = jax.random.split(key, rollout_length)
+            (env_state, obs, ep_ret, ret_sum, ret_cnt), traj = \
+                jax.lax.scan(one, (env_state, obs, ep_ret,
+                                   jnp.float32(0.0), jnp.int32(0)), keys)
+            flat = {k: v.reshape((-1,) + v.shape[2:])
+                    for k, v in traj.items()}
+            return env_state, obs, ep_ret, flat, ret_sum, ret_cnt
+
+        self._unroll = jax.jit(unroll)
+
+    def rollout(self, params) -> Dict[str, Any]:
+        import jax
+        import numpy as np
+
+        self.key, k = jax.random.split(self.key)
+        (self.env_state, self.obs, self.ep_ret, flat, ret_sum,
+         ret_cnt) = self._unroll(params, self.env_state, self.obs,
+                                 self.ep_ret, k)
+        out = {k2: np.asarray(v) for k2, v in flat.items()}
+        out["_ret_sum"] = float(ret_sum)
+        out["_ret_cnt"] = int(ret_cnt)
+        return out
+
+
+class APEXDQN(Algorithm):
+    config_class = APEXDQNConfig
+
+    def _setup(self) -> None:
+        cfg = self.config
+        env = self.env
+        if not env.discrete:
+            raise ValueError("APEX-DQN requires a discrete action space")
+        obs_dim, act_dim = env.observation_size, env.action_size
+        key = jax.random.key(cfg.seed)
+        key, k_init = jax.random.split(key)
+        if cfg.dueling:
+            self.params = init_dueling_q_net(k_init, obs_dim, act_dim,
+                                             cfg.hidden)
+            self._q_fn = dueling_q_values
+        else:
+            self.params = init_q_net(k_init, obs_dim, act_dim,
+                                     cfg.hidden)
+            self._q_fn = q_values
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.key = key
+
+        L = max(1, cfg.num_learners)
+        self._L = L
+        batch_n = cfg.runner_envs * cfg.rollout_length
+        if batch_n % L:
+            raise ValueError(
+                f"runner batch {batch_n} not divisible by "
+                f"num_learners={L}")
+        specs = {
+            "obs": ((obs_dim,), jnp.float32),
+            "action": ((), jnp.int32),
+            "reward": ((), jnp.float32),
+            "next_obs": ((obs_dim,), jnp.float32),
+            "done": ((), jnp.float32),
+        }
+        self.buffer = PrioritizedDeviceReplayBuffer(
+            cfg.buffer_capacity // L, specs,
+            alpha=cfg.prioritized_replay_alpha,
+            beta=cfg.prioritized_replay_beta)
+        states = [self.buffer.init() for _ in range(L)]
+        self.buf_state = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *states)
+        self.mesh = None
+        if L > 1:
+            from ray_tpu.rllib.learner import dp_mesh
+
+            self.mesh = dp_mesh(L)
+            sh = NamedSharding(self.mesh, P("dp"))
+            self.buf_state = jax.device_put(
+                self.buf_state, jax.tree_util.tree_map(
+                    lambda _: sh, self.buf_state))
+        self._build_programs()
+
+        # Rollout actor fleet with the epsilon ladder.
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(num_cpus=max(4, cfg.num_env_runners + 1))
+        N = cfg.num_env_runners
+        Runner = ray_tpu.remote(_ApexRunnerCls)
+        self._runners = []
+        self._eps = []
+        for i in range(N):
+            frac = i / max(N - 1, 1)
+            eps = cfg.eps_base ** (1 + frac * cfg.eps_alpha)
+            self._eps.append(eps)
+            self._runners.append(Runner.options(num_cpus=1).remote(
+                cfg.env, cfg.env_config, cfg.dueling, cfg.hidden,
+                cfg.runner_envs, cfg.rollout_length,
+                cfg.seed * 1000 + i, eps))
+        host_params = jax.device_get(self.params)
+        self._inflight = {
+            r.rollout.remote(host_params): i
+            for i, r in enumerate(self._runners)
+        }
+        self._total_samples = 0
+        self._updates = 0
+
+    # -- device programs ---------------------------------------------------
+
+    def _build_programs(self):
+        cfg = self.config
+        buffer = self.buffer
+        tx = self.tx
+        q_fn = self._q_fn
+        L = self._L
+        gamma, double_q = cfg.gamma, cfg.double_q
+        batch_size = cfg.train_batch_size
+        K = cfg.updates_per_batch
+
+        def td_loss(p, tp, mb, w):
+            q = q_fn(p, mb["obs"])
+            q_taken = jnp.take_along_axis(
+                q, mb["action"][:, None], axis=1)[:, 0]
+            q_next_t = q_fn(tp, mb["next_obs"])
+            if double_q:
+                a_star = jnp.argmax(q_fn(p, mb["next_obs"]), axis=1)
+                q_next = jnp.take_along_axis(
+                    q_next_t, a_star[:, None], axis=1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_t, axis=1)
+            target = mb["reward"] + gamma * (1.0 - mb["done"]) * q_next
+            err = q_taken - lax.stop_gradient(target)
+            return jnp.mean(w * err ** 2), err
+
+        def add_body(st, batch):
+            return buffer.add_batch(st, batch)
+
+        def update_body(params, target, opt_state, st, key, axis):
+            def one(carry, k):
+                params, opt_state, st = carry
+                mb, idx, w = buffer.sample(st, k, batch_size)
+                (loss, err), grads = jax.value_and_grad(
+                    td_loss, has_aux=True)(params, target, mb, w)
+                if axis is not None:
+                    grads = lax.pmean(grads, axis)
+                    loss = lax.pmean(loss, axis)
+                upd, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, upd)
+                # Priority refresh from THIS update's TD errors — the
+                # asynchronous write-back (actors never wait on it).
+                st = buffer.update_priorities(st, idx, err)
+                return (params, opt_state, st), loss
+
+            (params, opt_state, st), losses = lax.scan(
+                one, (params, opt_state, st), jax.random.split(key, K))
+            return params, opt_state, st, jnp.mean(losses)
+
+        if L == 1:
+            def sq(tree):
+                return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+            def ex(tree):
+                return jax.tree_util.tree_map(lambda x: x[None], tree)
+
+            self._add = jax.jit(lambda st, b: ex(
+                add_body(sq(st), jax.tree_util.tree_map(
+                    lambda x: x.reshape((-1,) + x.shape[2:]), b))))
+            self._update = jax.jit(
+                lambda p, t, o, st, k: (lambda out: (
+                    out[0], out[1], ex(out[2]), out[3]))(
+                    update_body(p, t, o, sq(st), k, None)))
+        else:
+            from ray_tpu.parallel.mesh import shard_map_unchecked
+
+            def add_sharded(st, b):
+                st1 = jax.tree_util.tree_map(lambda x: x[0], st)
+                b1 = jax.tree_util.tree_map(
+                    lambda x: x.reshape((-1,) + x.shape[2:]), b)
+                out = add_body(st1, b1)
+                return jax.tree_util.tree_map(lambda x: x[None], out)
+
+            self._add = jax.jit(shard_map_unchecked(
+                add_sharded, mesh=self.mesh,
+                in_specs=(P("dp"), P("dp")), out_specs=P("dp")))
+
+            def upd_sharded(p, t, o, st, k):
+                st1 = jax.tree_util.tree_map(lambda x: x[0], st)
+                k = jax.random.fold_in(k, lax.axis_index("dp"))
+                p, o, st1, loss = update_body(p, t, o, st1, k, "dp")
+                return (p, o, jax.tree_util.tree_map(
+                    lambda x: x[None], st1), loss)
+
+            self._update = jax.jit(shard_map_unchecked(
+                upd_sharded, mesh=self.mesh,
+                in_specs=(P(), P(), P(), P("dp"), P()),
+                out_specs=(P(), P(), P("dp"), P())))
+
+    # -- training loop -----------------------------------------------------
+
+    def _train_once(self) -> Dict[str, Any]:
+        cfg = self.config
+        L = self._L
+        N = cfg.num_env_runners
+        got, losses = 0, []
+        ret_sum = np.zeros(N)
+        ret_cnt = np.zeros(N, np.int64)
+        while got < cfg.steps_per_iteration:
+            ready, _ = ray_tpu.wait(list(self._inflight),
+                                    num_returns=1, timeout=60.0)
+            if not ready:
+                raise TimeoutError("no APEX runner produced a rollout "
+                                   "within 60s")
+            ref = ready[0]
+            idx = self._inflight.pop(ref)
+            batch = ray_tpu.get(ref)
+            ret_sum[idx] += batch.pop("_ret_sum")
+            ret_cnt[idx] += batch.pop("_ret_cnt")
+            n = batch["obs"].shape[0]
+            got += n
+            self._total_samples += n
+            # Relaunch IMMEDIATELY with fresh weights (the async
+            # contract: collection never waits on learning).
+            host_params = jax.device_get(self.params)
+            self._inflight[self._runners[idx].rollout.remote(
+                host_params)] = idx
+            # Shard the stream: each dp shard ingests its slice.
+            shards = {
+                k: jnp.asarray(v).reshape((L, n // L) + v.shape[1:])
+                for k, v in batch.items()
+            }
+            self.buf_state = self._add(self.buf_state, shards)
+            if self._total_samples >= cfg.learning_starts:
+                self.key, k = jax.random.split(self.key)
+                (self.params, self.opt_state, self.buf_state,
+                 loss) = self._update(self.params, self.target_params,
+                                      self.opt_state, self.buf_state, k)
+                self._updates += cfg.updates_per_batch
+                losses.append(float(loss))
+                if (self._updates % cfg.target_update_updates) < \
+                        cfg.updates_per_batch:
+                    self.target_params = jax.tree_util.tree_map(
+                        lambda x: x, self.params)
+        # Headline return: the NEAR-GREEDY rung's episodes (the
+        # policy's performance; the explorer rungs' episodes are
+        # epsilon-corrupted by design — reporting their mean would
+        # understate a solved policy).  The all-rungs mean ships as a
+        # separate metric, per-rung detail alongside.
+        per_rung = [
+            float(ret_sum[i] / ret_cnt[i]) if ret_cnt[i] else float("nan")
+            for i in range(N)
+        ]
+        greedy = per_rung[-1]
+        if greedy != greedy:  # no greedy episode finished this iter
+            finished = [r for r in per_rung if r == r]
+            greedy = finished[-1] if finished else float("nan")
+        total_cnt = int(ret_cnt.sum())
+        out = {
+            "episode_return_mean": greedy,
+            "episode_return_mean_all_rungs": (
+                float(ret_sum.sum()) / total_cnt if total_cnt
+                else float("nan")),
+            "episode_return_per_rung": per_rung,
+            "loss_mean": (float(np.mean(losses)) if losses
+                          else float("nan")),
+            "num_updates": self._updates,
+            "epsilons": list(self._eps),
+            "_timesteps": got,
+        }
+        return out
+
+    def compute_single_action(self, obs, explore: bool = False):
+        if explore:
+            # Epsilon-greedy at the near-greedy rung's epsilon — the
+            # same contract as DQN.compute_single_action(explore=True).
+            self.key, k1, k2 = jax.random.split(self.key, 3)
+            if float(jax.random.uniform(k1)) < self._eps[-1]:
+                return int(jax.random.randint(
+                    k2, (), 0, self.env.action_size))
+        q = self._q_fn(self.params, jnp.asarray(obs)[None])
+        return int(jnp.argmax(q[0]))
+
+    def stop(self) -> None:
+        for ref in list(self._inflight):
+            try:
+                ray_tpu.cancel(ref)
+            except Exception:
+                pass
+        self._inflight = {}
+        for r in getattr(self, "_runners", []):
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._runners = []
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "params": jax.device_get(self.params),
+            "target_params": jax.device_get(self.target_params),
+            "opt_state": jax.device_get(self.opt_state),
+            "iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.target_params = jax.device_put(state["target_params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]

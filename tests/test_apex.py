@@ -1,0 +1,135 @@
+"""APEX-DQN: distributed prioritized replay.
+
+Parity target: ray rllib/algorithms/apex_dqn/ — rollout actors with an
+epsilon ladder streaming into a central prioritized buffer, a high
+update-to-sample-ratio learner, asynchronous priority refresh, and
+(here) the buffer SHARDED over the LearnerGroup's dp mesh.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import APEXDQN, APEXDQNConfig, DQNConfig
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_apex_mechanics_and_epsilon_ladder(rt):
+    algo = (APEXDQNConfig()
+            .environment("CartPole-v1")
+            .training(num_env_runners=2, runner_envs=4,
+                      rollout_length=16, steps_per_iteration=128,
+                      learning_starts=64, train_batch_size=32,
+                      updates_per_batch=4)
+            .debugging(seed=0)
+            .build())
+    try:
+        eps = algo._eps
+        assert len(eps) == 2
+        assert eps[0] == pytest.approx(0.4)          # heavy explorer
+        assert eps[-1] == pytest.approx(0.4 ** 8)    # near-greedy rung
+        m = algo.train()
+        assert m["num_updates"] > 0
+        assert np.isfinite(m["loss_mean"])
+        # Priorities refreshed asynchronously: the buffer's priority
+        # vector is no longer the flat insert-max everywhere.
+        prio = np.asarray(algo.buf_state.priority)
+        filled = prio[prio > 0]
+        assert filled.size > 0 and np.unique(filled).size > 1
+        assert algo.compute_single_action(
+            np.zeros(4, np.float32)) in range(2)
+    finally:
+        algo.stop()
+
+
+def test_apex_sharded_buffer_matches_contract(rt, cpu_devices):
+    """num_learners=2: the buffer shards over the dp mesh (each shard
+    owns capacity/2 slots and ingests half of every stream); updates
+    pmean-synchronize, so params stay replicated and finite."""
+    algo = (APEXDQNConfig()
+            .environment("CartPole-v1")
+            .training(num_env_runners=2, runner_envs=4,
+                      rollout_length=16, steps_per_iteration=128,
+                      learning_starts=64, train_batch_size=32,
+                      updates_per_batch=4, num_learners=2,
+                      buffer_capacity=4096)
+            .debugging(seed=0)
+            .build())
+    try:
+        assert algo.buf_state.priority.shape == (2, 2048)
+        m = algo.train()
+        assert m["num_updates"] > 0 and np.isfinite(m["loss_mean"])
+        # Both shards received data.
+        prio = np.asarray(algo.buf_state.priority)
+        assert (prio[0] > 0).any() and (prio[1] > 0).any()
+        assert all(np.all(np.isfinite(np.asarray(x)))
+                   for x in __import__("jax").tree.leaves(algo.params))
+    finally:
+        algo.stop()
+
+
+def test_apex_beats_single_runner_dqn_wall_clock(rt, learning_table):
+    """The Ape-X claim, scaled to this CPU mesh: WALL-CLOCK TO REWARD —
+    the 2-runner fleet (epsilon ladder: one explorer, one near-greedy)
+    beats the SINGLE-RUNNER DQN on the same distributed machinery
+    (one actor at a fixed middle epsilon, same learner and replay).
+    Median over 3 seeds: CartPole time-to-threshold has large
+    episode-granularity variance on this box.
+
+    (The monolithic fused single-device DQN in algorithms/dqn.py is
+    NOT the baseline here: with the env stepping inside the learner's
+    own jit it pays zero IPC, which no distributed architecture can
+    beat on a one-core host — the reference comparison is Ape-X vs a
+    one-worker configuration of the same stack.)"""
+    budget_s = 60.0
+    threshold = 350.0
+
+    def t_to_threshold(algo_builder):
+        """Seconds until the training return first reaches the
+        threshold (budget_s when it never does).  One warmup
+        iteration runs OFF the clock — jit compile time is a one-time
+        cost, not part of the steady-state claim (symmetric: both
+        sides also get one iteration of learning)."""
+        algo = algo_builder()
+        try:
+            algo.train()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < budget_s:
+                m = algo.train()
+                r = m.get("episode_return_mean")
+                if r == r and r >= threshold:
+                    return time.monotonic() - t0
+            return budget_s
+        finally:
+            algo.stop()
+
+    def build(seed, **kw):
+        return (APEXDQNConfig()
+                .environment("CartPole-v1")
+                .training(runner_envs=8, rollout_length=16,
+                          steps_per_iteration=512, learning_starts=400,
+                          train_batch_size=64, updates_per_batch=24,
+                          double_q=True, dueling=True, lr=1e-3, **kw)
+                .debugging(seed=seed)
+                .build())
+
+    seeds = (0, 1, 2)
+    fleet = [t_to_threshold(lambda: build(s, num_env_runners=2))
+             for s in seeds]
+    single = [t_to_threshold(lambda: build(
+        s, num_env_runners=1, eps_base=0.13, eps_alpha=0.0))
+        for s in seeds]
+    fleet_med = float(np.median(fleet))
+    single_med = float(np.median(single))
+    # Table reports negated seconds so "higher is better" holds.
+    learning_table("APEX-DQN", "CartPole t-to-350", -fleet_med,
+                   -single_med)
+    assert fleet_med < single_med, (fleet, single)
