@@ -211,14 +211,16 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
                        if s._req.ttft_s is not None)
         assert all(len(o) == gen for o in outs)
         # Steady-state served rate: the OLS slope of completion
-        # timestamps vs completion index after trimming the warmup
-        # fifth.  Completions arrive in decode-chunk BURSTS, so an
-        # endpoint-ratio estimator wobbles by a burst width (enough to
-        # flap the knee); the regression slope averages the bursts
-        # out.  A system keeping up completes at the arrival rate →
-        # ~1.0; a saturated one at its ceiling μ → μ/rate.
+        # timestamps vs completion index over the MIDDLE of the run
+        # (first fifth = warmup ramp, last twentieth = the drain
+        # burst, both trimmed).  Completions arrive in decode-chunk
+        # BURSTS, so an endpoint-ratio estimator wobbles by a burst
+        # width (enough to flap the knee); the regression slope over
+        # the trimmed window averages the bursts out.  A system
+        # keeping up completes at the arrival rate → ~1.0; a
+        # saturated one at its ceiling μ → μ/rate.
         done = sorted(s._req.finished_at for s in streams)
-        ts = np.asarray(done[max(1, n // 5):])
+        ts = np.asarray(done[max(1, n // 5):-max(1, n // 20)])
         idx = np.arange(len(ts))
         slope = float(np.polyfit(idx, ts, 1)[0]) if len(ts) > 2 else 1.0
         served_ss = 1.0 / max(slope, 1e-9)
@@ -359,7 +361,7 @@ def _measure_8b(peak_flops: float) -> dict:
 
 
 def _measure_ssd(B=4, S=4096, H=8, P=64, N=128, chunk=128,
-                 iters=32) -> dict:
+                 iters=64) -> dict:
     """Fused Pallas SSD kernel vs the einsum+associative_scan path
     (models/mamba2.ssd_chunked), same inputs, forward pass.
 
@@ -379,7 +381,7 @@ def _measure_ssd(B=4, S=4096, H=8, P=64, N=128, chunk=128,
     Bm = jax.random.normal(k3, (B, S, N), jnp.float32) * 0.3
     Cm = jax.random.normal(k4, (B, S, N), jnp.float32) * 0.3
 
-    def timed(fn):
+    def compiled(fn):
         def many(x0):
             def body(carry, _):
                 out = fn(carry, la, Bm, Cm)
@@ -393,13 +395,27 @@ def _measure_ssd(B=4, S=4096, H=8, P=64, N=128, chunk=128,
         f = jax.jit(many)
         out = f(x)
         float(jax.device_get(out[0, 0, 0, 0]))  # compile + fence
+        return f
+
+    def timed_once(f):
         t0 = time.perf_counter()
         out = f(x)
         float(jax.device_get(out[0, 0, 0, 0]))
         return (time.perf_counter() - t0) / iters
 
-    t_scan = timed(lambda *a: ssd_chunked(*a, chunk=chunk))
-    t_pallas = timed(lambda *a: ssd_pallas(*a, chunk))
+    f_scan = compiled(lambda *a: ssd_chunked(*a, chunk=chunk))
+    f_pallas = compiled(lambda *a: ssd_pallas(*a, chunk))
+    # The tunneled chip's effective speed drifts on minute timescales
+    # (common mode: both paths swing together).  INTERLEAVE the two
+    # paths' timed calls and take per-path medians so the ratio
+    # samples the same windows — a ratio from two disjoint windows can
+    # be off 40% in either direction.
+    reps_s, reps_p = [], []
+    for _ in range(5):
+        reps_s.append(timed_once(f_scan))
+        reps_p.append(timed_once(f_pallas))
+    t_scan = float(np.median(reps_s))
+    t_pallas = float(np.median(reps_p))
     # On-chip correctness ride-along: interpret-mode CPU tests can't
     # catch a hardware-only Mosaic miscompile of the flattened layout.
     out_scan = jax.jit(lambda *a: ssd_chunked(*a, chunk=chunk))(
@@ -500,18 +516,21 @@ def main():
                 n_requests=64, slots=32, arrival_rate=12.0)
         except Exception as e:
             extra["serving_1b"] = {"error": repr(e)[:120]}
+        # BASELINE.json config-matrix: Pallas SSD kernel vs the
+        # associative_scan/einsum path, measured on-chip.  Runs BEFORE
+        # the 8B leg: after 8+ GB of weights churn through HBM the
+        # chip measures both paths slower and noisier (observed 1.21x
+        # post-8B vs 1.60x on a fresh chip).
+        try:
+            extra["mamba_ssd"] = _measure_ssd()
+        except Exception as e:
+            extra["mamba_ssd"] = {"error": repr(e)[:200]}
         # North star #3: the 8B artifact — int8 serving (measured) +
         # per-layer train extrapolation (BASELINE.md north-star row).
         try:
             extra["llama_8b"] = _measure_8b(peak)
         except Exception as e:
             extra["llama_8b"] = {"error": repr(e)[:200]}
-        # BASELINE.json config-matrix: Pallas SSD kernel vs the
-        # associative_scan/einsum path, measured on-chip.
-        try:
-            extra["mamba_ssd"] = _measure_ssd()
-        except Exception as e:
-            extra["mamba_ssd"] = {"error": repr(e)[:200]}
 
     result = {
         "metric": f"llama_{cfg.num_params()/1e6:.0f}M_train_tokens_per_sec_per_chip",
