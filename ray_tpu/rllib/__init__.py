@@ -14,11 +14,11 @@ Distributed sampling uses EnvRunner actors over ray_tpu.core.
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms import (A2C, APEXDQN, APPO, DDPG, DQN,
-                                      IMPALA, PPO, SAC, TD3, A2CConfig,
-                                      APEXDQNConfig, APPOConfig,
-                                      DDPGConfig, DQNConfig,
-                                      IMPALAConfig, PPOConfig, SACConfig,
-                                      TD3Config, vtrace)
+                                      IMPALA, PG, PPO, SAC, TD3,
+                                      A2CConfig, APEXDQNConfig,
+                                      APPOConfig, DDPGConfig, DQNConfig,
+                                      IMPALAConfig, PGConfig, PPOConfig,
+                                      SACConfig, TD3Config, vtrace)
 from ray_tpu.rllib.env import (CartPole, ExternalEnv, Pendulum, make_env,
                                register_env)
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
@@ -48,6 +48,7 @@ __all__ = [
     "CartPole", "Pendulum", "ExternalEnv", "make_env", "register_env",
     "EnvRunnerGroup", "ActorCritic",
     "A2C", "A2CConfig", "TD3", "TD3Config",
+    "APEXDQN", "APEXDQNConfig", "PG", "PGConfig",
     "DeviceReplayBuffer", "HostReplayBuffer",
     "PrioritizedDeviceReplayBuffer", "EpisodeReplayBuffer",
     "Connector", "ConnectorPipeline", "FlattenObservations",

@@ -3,6 +3,7 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig
 from ray_tpu.rllib.algorithms.apex_dqn import APEXDQN, APEXDQNConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.pg import PG, PGConfig
 from ray_tpu.rllib.algorithms.grpo import GRPO, GRPOConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
@@ -11,5 +12,5 @@ from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config
 
 __all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig", "DDPG",
            "DDPGConfig", "GRPO", "GRPOConfig", "PPO", "PPOConfig",
-           "APEXDQN", "APEXDQNConfig", "DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "vtrace",
+           "APEXDQN", "APEXDQNConfig", "DQN", "DQNConfig", "PG", "PGConfig", "IMPALA", "IMPALAConfig", "vtrace",
            "SAC", "SACConfig", "TD3", "TD3Config"]

@@ -150,6 +150,10 @@ class APEXDQN(Algorithm):
         env = self.env
         if not env.discrete:
             raise ValueError("APEX-DQN requires a discrete action space")
+        if cfg.num_atoms > 1:
+            raise ValueError("APEX-DQN does not support the C51 head "
+                             "(num_atoms > 1) — use plain DQN for "
+                             "distributional training")
         obs_dim, act_dim = env.observation_size, env.action_size
         key = jax.random.key(cfg.seed)
         key, k_init = jax.random.split(key)

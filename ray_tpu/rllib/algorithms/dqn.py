@@ -55,6 +55,13 @@ class DQNConfig(AlgorithmConfig):
         self.prioritized_replay = False
         self.prioritized_replay_alpha = 0.6
         self.prioritized_replay_beta = 0.4
+        # Distributional C51 (parity: rllib DQN num_atoms/v_min/v_max
+        # — num_atoms > 1 switches the head to a categorical return
+        # distribution over a fixed support and the loss to the
+        # projected-Bellman cross-entropy, Bellemare et al. 2017).
+        self.num_atoms = 1
+        self.v_min = 0.0
+        self.v_max = 200.0
         self.steps_per_iteration = 1_024
         self.num_envs = 8
 
@@ -74,13 +81,37 @@ class DQN(Algorithm):
         obs_dim, act_dim = env.observation_size, env.action_size
         key = jax.random.key(cfg.seed)
         key, k_init, k_reset = jax.random.split(key, 3)
-        if cfg.dueling:
+        if cfg.num_atoms > 1:
+            # C51: the head predicts a categorical return distribution
+            # per action over a fixed support; Q(s,a) = E_z[p(z|s,a)].
+            if cfg.dueling:
+                raise ValueError(
+                    "num_atoms > 1 with dueling is not supported — "
+                    "pick one head")
+            K = cfg.num_atoms
+            self.params = init_q_net(k_init, obs_dim, act_dim * K,
+                                     cfg.hidden)
+            z = jnp.linspace(cfg.v_min, cfg.v_max, K)
+
+            def dist_logits(p, obs):
+                out = q_values(p, obs)
+                return out.reshape(out.shape[:-1] + (act_dim, K))
+
+            def expected_q(p, obs):
+                probs = jax.nn.softmax(dist_logits(p, obs), axis=-1)
+                return jnp.sum(probs * z, axis=-1)
+
+            self._dist_fn = dist_logits
+            self._q_fn = expected_q
+        elif cfg.dueling:
             self.params = init_dueling_q_net(k_init, obs_dim, act_dim,
                                              cfg.hidden)
             self._q_fn = dueling_q_values
+            self._dist_fn = None
         else:
             self.params = init_q_net(k_init, obs_dim, act_dim, cfg.hidden)
             self._q_fn = q_values
+            self._dist_fn = None
         self.target_params = jax.tree_util.tree_map(
             lambda x: x, self.params
         )
@@ -108,7 +139,7 @@ class DQN(Algorithm):
         self.key = key
         self._iteration_fn = jax.jit(
             partial(_dqn_iteration, env, self.buffer, self.tx,
-                    self._q_fn, _static_cfg(cfg))
+                    self._q_fn, self._dist_fn, _static_cfg(cfg))
         )
 
     def _train_once(self) -> Dict[str, Any]:
@@ -168,20 +199,23 @@ def _static_cfg(cfg: DQNConfig):
     return (cfg.steps_per_iteration, cfg.train_batch_size, cfg.train_freq,
             cfg.target_update_freq, cfg.gamma, cfg.epsilon_start,
             cfg.epsilon_end, cfg.epsilon_decay_steps, cfg.double_q,
-            cfg.learning_starts)
+            cfg.learning_starts, cfg.num_atoms, cfg.v_min, cfg.v_max)
 
 
-def _dqn_iteration(env, buffer, tx, q_fn, scfg, params, target_params,
-                   opt_state, buf_state, env_state, obs, ep_ret,
-                   total_steps, key):
+def _dqn_iteration(env, buffer, tx, q_fn, dist_fn, scfg, params,
+                   target_params, opt_state, buf_state, env_state, obs,
+                   ep_ret, total_steps, key):
     (T, batch_size, train_freq, target_freq, gamma, eps0, eps1,
-     eps_decay, double_q, learning_starts) = scfg
+     eps_decay, double_q, learning_starts, num_atoms, v_min,
+     v_max) = scfg
     n_envs = obs.shape[0]
     v_step = jax.vmap(env.step)
     v_reset = jax.vmap(env.reset)
     prioritized = isinstance(buffer, PrioritizedDeviceReplayBuffer)
 
     def td_loss(p, tp, mb, w):
+        if num_atoms > 1:
+            return _c51_loss(p, tp, mb, w)
         q = q_fn(p, mb["obs"])
         q_taken = jnp.take_along_axis(
             q, mb["action"][:, None], axis=1
@@ -197,6 +231,39 @@ def _dqn_iteration(env, buffer, tx, q_fn, scfg, params, target_params,
         target = mb["reward"] + gamma * (1.0 - mb["done"]) * q_next
         err = q_taken - lax.stop_gradient(target)
         return jnp.mean(w * err ** 2), err
+
+    def _c51_loss(p, tp, mb, w):
+        """Projected-Bellman categorical cross-entropy (C51,
+        Bellemare et al. 2017; parity: rllib DQN num_atoms>1)."""
+        K = num_atoms
+        z = jnp.linspace(v_min, v_max, K)
+        dz = (v_max - v_min) / (K - 1)
+        logits = dist_fn(p, mb["obs"])                  # [B, A, K]
+        logp = jax.nn.log_softmax(jnp.take_along_axis(
+            logits, mb["action"][:, None, None], axis=1)[:, 0], -1)
+        if double_q:
+            a_star = jnp.argmax(q_fn(p, mb["next_obs"]), axis=1)
+        else:
+            a_star = jnp.argmax(q_fn(tp, mb["next_obs"]), axis=1)
+        next_logits = jnp.take_along_axis(
+            dist_fn(tp, mb["next_obs"]), a_star[:, None, None],
+            axis=1)[:, 0]                               # [B, K]
+        p_next = jax.nn.softmax(next_logits, -1)
+        tz = jnp.clip(
+            mb["reward"][:, None]
+            + gamma * (1.0 - mb["done"])[:, None] * z[None, :],
+            v_min, v_max)                               # [B, K]
+        b = (tz - v_min) / dz
+        low = jnp.clip(jnp.floor(b), 0, K - 1)
+        up = jnp.clip(low + 1, 0, K - 1)
+        wu = b - low
+        wl = 1.0 - wu                                   # low==up → all wl
+        m = (jnp.einsum("bk,bkj->bj", p_next * wl,
+                        jax.nn.one_hot(low.astype(jnp.int32), K))
+             + jnp.einsum("bk,bkj->bj", p_next * wu,
+                          jax.nn.one_hot(up.astype(jnp.int32), K)))
+        ce = -jnp.sum(lax.stop_gradient(m) * logp, axis=-1)  # [B]
+        return jnp.mean(w * ce), ce
 
     def one_step(carry, step_key):
         (params, target_params, opt_state, buf_state, env_state, obs,

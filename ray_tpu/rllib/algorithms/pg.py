@@ -1,0 +1,137 @@
+"""PG — vanilla policy gradient (REINFORCE with a value baseline).
+
+Parity target: the reference's simplest algorithm (ray:
+rllib/algorithms/pg/ — on-policy Monte-Carlo policy gradient; the
+"hello world" of the algorithm zoo and the reference's recommended
+starting point for custom algorithms).  Same TPU execution model as
+PPO here: rollout + returns + one gradient step compile into a single
+jitted program per iteration; the sampler's truncation-aware rollout
+supplies the V(next_obs) bootstrap at time limits.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ray_tpu.rllib import sampler
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import ActorCritic
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_envs = 16
+        self.rollout_length = 128
+        self.lr = 1e-3
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 10.0
+
+    @property
+    def algo_class(self):
+        return PG
+
+
+class PG(Algorithm):
+    config_class = PGConfig
+
+    def _setup(self) -> None:
+        cfg = self.config
+        env = self.env
+        self.net = ActorCritic(env.observation_size, env.action_size,
+                               discrete=env.discrete, hidden=cfg.hidden)
+        key = jax.random.key(cfg.seed)
+        self.key, k_init, k_reset = jax.random.split(key, 3)
+        self.params = self.net.init(k_init)
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr),
+        )
+        self.opt_state = self.tx.init(self.params)
+        reset_keys = jax.random.split(k_reset, cfg.num_envs)
+        self.env_state, self.obs = jax.vmap(env.reset)(reset_keys)
+        self.ep_ret = jnp.zeros(cfg.num_envs)
+        self.ep_len = jnp.zeros(cfg.num_envs, jnp.int32)
+        scfg = (cfg.rollout_length, cfg.vf_loss_coeff, cfg.entropy_coeff,
+                cfg.gamma)
+        self._iteration_fn = jax.jit(partial(
+            _pg_iteration, env, self.net, self.tx, scfg))
+
+    def _train_once(self) -> Dict[str, Any]:
+        self.key, k = jax.random.split(self.key)
+        (self.params, self.opt_state, self.env_state, self.obs,
+         self.ep_ret, self.ep_len, metrics) = self._iteration_fn(
+            self.params, self.opt_state, self.env_state, self.obs,
+            self.ep_ret, self.ep_len, k)
+        out = {k2: float(v) for k2, v in metrics.items()}
+        out["_timesteps"] = (self.config.rollout_length
+                             * self.config.num_envs)
+        return out
+
+    def compute_single_action(self, obs, explore: bool = False):
+        obs = jnp.asarray(obs)[None]
+        dist = self.net.action_dist(self.params, obs)
+        if explore:
+            self.key, k = jax.random.split(self.key)
+            a = dist.sample(k)[0]
+        else:
+            a = dist.mode()[0]
+        return (int(a) if self.env.discrete else np.asarray(a))
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state),
+                "iteration": self.iteration,
+                "timesteps_total": self._timesteps_total}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+
+
+def _pg_iteration(env, net, tx, scfg, params, opt_state, env_state, obs,
+                  ep_ret, ep_len, key):
+    T, vf_coef, ent_coef, gamma = scfg
+    env_state, obs, ep_ret, ep_len, roll = sampler.unroll(
+        env, net, params, env_state, obs, ep_ret, ep_len, key, T)
+    # Monte-Carlo returns-to-go with the sampler's truncation-aware
+    # bootstrap (GAE with lam=1 == discounted returns; the baseline
+    # only enters through the advantage, the REINFORCE form).
+    advs, returns = sampler.gae(
+        roll.reward, roll.done, roll.value, roll.last_value,
+        gamma=gamma, lam=1.0, terminal=roll.terminal,
+        next_value=roll.next_value)
+
+    n = roll.obs.shape[0] * roll.obs.shape[1]
+    flat = lambda x: x.reshape((n,) + x.shape[2:])
+    b_obs, b_act = flat(roll.obs), flat(roll.action)
+    b_adv, b_ret = flat(advs), flat(returns)
+    b_adv = (b_adv - b_adv.mean()) / (b_adv.std() + 1e-8)
+
+    def loss_fn(p):
+        dist = net.action_dist(p, b_obs)
+        logp = dist.log_prob(b_act)
+        pg_loss = -jnp.mean(logp * lax.stop_gradient(b_adv))
+        v = net.value(p, b_obs)
+        vf_loss = 0.5 * jnp.mean((v - lax.stop_gradient(b_ret)) ** 2)
+        entropy = jnp.mean(dist.entropy())
+        total = pg_loss + vf_coef * vf_loss - ent_coef * entropy
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy, "total_loss": total}
+
+    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    metrics = dict(aux)
+    metrics.update(sampler.episode_stats(roll))
+    return params, opt_state, env_state, obs, ep_ret, ep_len, metrics
