@@ -46,9 +46,13 @@ INDEX_HTML = """<!doctype html>
 </header>
 <main>
   <section><h2>Resources</h2><div id="resources"></div></section>
+  <section><h2>Utilization</h2><div id="charts"></div></section>
   <section><h2>Nodes</h2><div id="nodes"></div></section>
   <section><h2>Task summary</h2><div id="tasks"></div></section>
-  <section><h2>Actors</h2><div id="actors"></div></section>
+  <section><h2>Actors <span class="muted" style="text-transform:none">
+    (click a row to drill down)</span></h2><div id="actors"></div></section>
+  <section><h2>Detail</h2><div id="detail" class="muted">
+    click an actor or job</div></section>
   <section><h2>Placement groups</h2><div id="pgs"></div></section>
   <section><h2>Jobs</h2><div id="jobs"></div></section>
   <section><h2>Serve</h2><div id="serve"></div></section>
@@ -109,7 +113,8 @@ async function refresh() {
     const stateCols = [...new Set(rows.flatMap(r =>
       Object.keys(r).filter(k => k !== 'name')))];
     $('tasks').innerHTML = table(rows, ['name', ...stateCols]);
-    const actors = ((await j('/api/v0/actors')).result || []).map(a => ({
+    const actorRows = (await j('/api/v0/actors')).result || [];
+    const actors = actorRows.map(a => ({
       id: (a.actor_id || '').slice(0, 12), class: a.class_name,
       state: a.state, name: a.name || '',
       node: (a.node_id || '').slice(0, 8),
@@ -117,6 +122,16 @@ async function refresh() {
     $('actors').innerHTML = table(actors, ['id', 'class', 'state', 'name', 'node'])
       .replaceAll('>ALIVE<', ' class="ok">ALIVE<')
       .replaceAll('>DEAD<', ' class="bad">DEAD<');
+    // Per-actor drill-down: row click → /api/v0/actors/detail.
+    const nActorRows = Math.min(actorRows.length, 50);
+    [...$('actors').querySelectorAll('tr')].slice(1, 1 + nActorRows)
+      .forEach((tr, i) => {
+        const full = actorRows[i] && actorRows[i].actor_id;
+        if (!full) return;
+        tr.style.cursor = 'pointer';
+        tr.onclick = () => showActor(full);
+      });
+    await refreshCharts();
     const pgs = (await j('/api/v0/placement_groups')).result || [];
     $('pgs').innerHTML = table(pgs.map(p => ({
       id: (p.placement_group_id || '').slice(0, 12),
@@ -129,6 +144,12 @@ async function refresh() {
       id: x.submission_id, status: x.status,
       entrypoint: (x.entrypoint || '').slice(0, 60),
     })), ['id', 'status', 'entrypoint']);
+    [...$('jobs').querySelectorAll('tr')].slice(1, 1 + Math.min(jobs.length, 50))
+      .forEach((tr, i) => {
+        if (!jobs[i]) return;
+        tr.style.cursor = 'pointer';
+        tr.onclick = () => showJob(jobs[i].submission_id);
+      });
     let serve = {};
     try { serve = await j('/api/serve/applications'); } catch (e) {}
     const apps = Object.entries(serve.applications || {}).map(([name, a]) => ({
@@ -191,6 +212,79 @@ async function refreshTimeline() {
     h += '</div></div>';
   }
   $('tl').innerHTML = h;
+}
+function spark(pts, w, h, color) {
+  // pts in [0, 1]; inline SVG sparkline with an area fill.
+  if (!pts.length) return '<span class="muted">no samples yet</span>';
+  const step = w / Math.max(pts.length - 1, 1);
+  const xy = pts.map((v, i) =>
+    `${(i * step).toFixed(1)},${(h - v * (h - 2) - 1).toFixed(1)}`);
+  return `<svg width="${w}" height="${h}" style="vertical-align:middle">
+    <polyline points="0,${h} ${xy.join(' ')} ${w},${h}" fill="${color}22"
+      stroke="none"/>
+    <polyline points="${xy.join(' ')}" fill="none" stroke="${color}"
+      stroke-width="1.5"/></svg>`;
+}
+async function refreshCharts() {
+  const hist = (await j('/api/v0/metrics/history')).result || [];
+  if (!hist.length) { $('charts').innerHTML =
+    '<div class="muted">no samples yet</div>'; return; }
+  let h = '<table>';
+  const keys = Object.keys(hist[hist.length - 1].total || {}).sort();
+  for (const k of keys) {
+    const pts = hist.map(p =>
+      (p.total[k] ? (p.used[k] || 0) / p.total[k] : 0));
+    const cur = Math.round(pts[pts.length - 1] * 100);
+    h += `<tr><th>${esc(k)}</th><td>${spark(pts, 220, 26, '#5b8def')}</td>
+          <td>${cur}%</td></tr>`;
+  }
+  // Task completion rate from the finished-counter deltas.
+  const rates = [];
+  for (let i = 1; i < hist.length; i++) {
+    const dt = hist[i].ts - hist[i - 1].ts;
+    rates.push(dt > 0 ? Math.max(
+      hist[i].tasks_finished - hist[i - 1].tasks_finished, 0) / dt : 0);
+  }
+  const peak = Math.max(...rates, 1e-9);
+  h += `<tr><th>tasks/s</th><td>${spark(rates.map(r => r / peak), 220,
+        26, '#2e9e5b')}</td>
+        <td>${(rates[rates.length - 1] || 0).toFixed(1)}/s
+        <span class="muted">(peak ${peak.toFixed(1)})</span></td></tr>`;
+  $('charts').innerHTML = h + '</table>';
+}
+function kvTable(obj) {
+  return '<table>' + Object.entries(obj).map(([k, v]) =>
+    `<tr><th>${esc(k)}</th><td>${esc(
+      typeof v === 'object' ? JSON.stringify(v) : v)}</td></tr>`
+  ).join('') + '</table>';
+}
+async function showActor(id) {
+  try {
+    const d = await j('/api/v0/actors/detail?id=' + encodeURIComponent(id));
+    if (d.error) { $('detail').innerHTML = esc(d.error); return; }
+    let h = kvTable(d.actor || {});
+    const tasks = (d.tasks || []).slice(-20).map(t => ({
+      name: t.name, state: t.state, attempt: t.attempt,
+      error: (t.error_message || '').slice(0, 40),
+    }));
+    h += '<h2 style="margin-top:10px">recent task attempts</h2>'
+       + table(tasks, ['name', 'state', 'attempt', 'error']);
+    $('detail').innerHTML = h;
+    $('detail').classList.remove('muted');
+  } catch (e) { $('detail').textContent = 'detail failed: ' + e; }
+}
+async function showJob(id) {
+  try {
+    const info = await j('/api/jobs/' + encodeURIComponent(id));
+    let logs = {};
+    try { logs = await j('/api/jobs/' + encodeURIComponent(id) + '/logs'); }
+    catch (e) {}
+    let h = kvTable(info);
+    h += '<h2 style="margin-top:10px">job log tail</h2><pre style="max-height:160px;overflow:auto;font-size:12px">'
+       + esc((logs.logs || '').split('\\n').slice(-30).join('\\n')) + '</pre>';
+    $('detail').innerHTML = h;
+    $('detail').classList.remove('muted');
+  } catch (e) { $('detail').textContent = 'detail failed: ' + e; }
 }
 refresh(); setInterval(refresh, 2000);
 </script>

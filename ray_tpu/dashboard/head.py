@@ -13,6 +13,14 @@ metrics):
   GET /api/v0/nodes
   GET /api/v0/placement_groups
   GET /api/v0/tasks/summarize
+  GET /api/v0/actors/detail      ?id= one actor + its task attempts
+                                 (parity: the React client's actor
+                                 drill-down pages,
+                                 dashboard/modules/actor/)
+  GET /api/v0/metrics/history    sampled utilization/throughput ring
+                                 for the frontend's charts (parity:
+                                 the Grafana panels the reference
+                                 embeds)
   GET /api/v0/logs               tail of the cluster log buffer
                                  (?node=&file=&tail=; parity:
                                  dashboard/modules/log/ log views)
@@ -23,6 +31,7 @@ metrics):
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -74,6 +83,22 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json({"result": _state.summarize_tasks()})
             elif url.path == "/api/v0/actors":
                 self._json({"result": _state.list_actors(limit=limit)})
+            elif url.path == "/api/v0/actors/detail":
+                aid = (qs.get("id") or [""])[0]
+                actors = _state.list_actors(
+                    filters=[("actor_id", "=", aid)], limit=1)
+                if not actors:
+                    self._json({"error": f"no actor {aid}"}, 404)
+                else:
+                    # Attempts are newest-LAST; keep the newest
+                    # ``limit`` (a head-truncation would pin the pane
+                    # to an actor's oldest history).
+                    attempts = _state.list_tasks(
+                        filters=[("actor_id", "=", aid)],
+                        limit=1 << 30, detail=True)[-limit:]
+                    self._json({"actor": actors[0], "tasks": attempts})
+            elif url.path == "/api/v0/metrics/history":
+                self._json({"result": self.server.metrics_history()})
             elif url.path == "/api/v0/objects":
                 self._json({"result": _state.list_objects(limit=limit)})
             elif url.path == "/api/v0/nodes":
@@ -178,14 +203,67 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
 
 
+class _Server(ThreadingHTTPServer):
+    """HTTP server + a metrics-history sampler ring the chart routes
+    read (parity: the utilization time series the reference exports to
+    Prometheus/Grafana, kept in-process here)."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, handler, sample_period_s: float = 2.0):
+        super().__init__(addr, handler)
+        self._period = sample_period_s
+        self._hist: collections.deque = collections.deque(maxlen=300)
+        self._hist_lock = threading.Lock()
+        self._sampler_stop = threading.Event()
+        self._sampler: Optional[threading.Thread] = None
+
+    def start_sampler(self) -> None:
+        self._sampler = threading.Thread(
+            target=self._sample_loop, name="dash-sampler", daemon=True)
+        self._sampler.start()
+
+    def _sample_loop(self) -> None:
+        import time
+
+        from ray_tpu.core import api
+
+        while not self._sampler_stop.wait(self._period):
+            try:
+                if not api.is_initialized():
+                    continue
+                total = api.cluster_resources()
+                avail = api.available_resources()
+                rt = api.runtime()
+                finished = sum(1 for a in rt.events.snapshot()
+                               if a.state == "FINISHED")
+                point = {
+                    "ts": time.time(),
+                    "used": {k: total[k] - avail.get(k, 0.0)
+                             for k in total},
+                    "total": dict(total),
+                    "tasks_finished": finished,
+                }
+                with self._hist_lock:
+                    self._hist.append(point)
+            except Exception:
+                pass  # sampling is best-effort; next tick retries
+
+    def metrics_history(self):
+        with self._hist_lock:
+            return list(self._hist)
+
+    def stop_sampler(self) -> None:
+        self._sampler_stop.set()
+
+
 class DashboardHead:
     """Owns the HTTP server thread (parity: DashboardHead lifecycle in
     dashboard/head.py — minus the agent/GCS plumbing a single process
     doesn't need)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._server = ThreadingHTTPServer((host, port), _Handler)
-        self._server.daemon_threads = True
+        self._server = _Server((host, port), _Handler)
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -199,9 +277,11 @@ class DashboardHead:
             daemon=True,
         )
         self._thread.start()
+        self._server.start_sampler()
         return self
 
     def stop(self) -> None:
+        self._server.stop_sampler()
         self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:

@@ -58,3 +58,56 @@ def test_dashboard_404(dash):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _get(dash.address + "/api/nope")
     assert ei.value.code == 404
+
+
+def test_actor_drilldown_and_metrics_history(dash):
+    """Per-actor detail + the sampled utilization ring behind the
+    frontend's charts (parity: the React client's actor pages and the
+    embedded Grafana utilization panels)."""
+    import time
+
+    @ray_tpu.remote
+    class Counter:
+        def bump(self):
+            return 1
+
+    c = Counter.options(name="dash-actor").remote()
+    ray_tpu.get([c.bump.remote() for _ in range(3)])
+
+    status, body = _get(dash.address + "/api/v0/actors?limit=10")
+    actors = json.loads(body)["result"]
+    aid = next(a["actor_id"] for a in actors
+               if a.get("name") == "dash-actor")
+
+    status, body = _get(dash.address
+                        + f"/api/v0/actors/detail?id={aid}")
+    assert status == 200
+    d = json.loads(body)
+    assert d["actor"]["actor_id"] == aid
+    assert d["actor"]["class_name"] == "Counter"
+    names = {t["name"] for t in d["tasks"]}
+    assert any("bump" in n for n in names), names
+    # Every returned attempt belongs to THIS actor.
+    assert all(t["actor_id"] == aid for t in d["tasks"])
+
+    # Unknown actor → clean 404.
+    try:
+        _get(dash.address + "/api/v0/actors/detail?id=nope")
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    # The sampler fills the history ring (2s period); poll until a
+    # sample taken AFTER the bumps finished shows up.
+    deadline = time.time() + 10
+    point = None
+    while time.time() < deadline:
+        _, body = _get(dash.address + "/api/v0/metrics/history")
+        hist = json.loads(body)["result"]
+        if hist and hist[-1]["tasks_finished"] >= 3:
+            point = hist[-1]
+            break
+        time.sleep(0.5)
+    assert point is not None, "sampler never saw the finished tasks"
+    assert point["total"]["CPU"] == 2.0
+    assert 0.0 <= point["used"]["CPU"] <= 2.0
