@@ -174,3 +174,41 @@ class TaskEventBuffer:
     def dump_json(self, filename: str) -> None:
         with open(filename, "w") as f:
             json.dump(self.chrome_tracing_dump(), f)
+
+
+def spans_to_chrome_events(
+        spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Tracer spans (util/tracing.py records) as Chrome trace events,
+    mergeable with ``chrome_tracing_dump`` output into one Perfetto
+    view.  Rows: pid = the span's plane (the dotted-name prefix —
+    "serve", "llm", "data", "train", ...), tid = the trace id, so every
+    request/pipeline/step lands on its own row with its children."""
+    out: List[Dict[str, Any]] = []
+    seen_rows = set()
+    for s in spans:
+        end = s.get("end")
+        if end is None:
+            continue
+        plane = (s["name"].split(".", 1)[0]
+                 if "." in s["name"] else "trace")
+        if plane not in seen_rows:
+            seen_rows.add(plane)
+            out.append({"ph": "M", "pid": plane, "name": "process_name",
+                        "args": {"name": f"plane:{plane}"}})
+        out.append({
+            "ph": "X",
+            "name": s["name"],
+            "cat": "span",
+            "pid": plane,
+            "tid": s["trace_id"][:8],
+            "ts": s["start"] * 1e6,
+            "dur": max(0.0, end - s["start"]) * 1e6,
+            "args": {
+                "trace_id": s["trace_id"],
+                "span_id": s["span_id"],
+                "parent_id": s.get("parent_id", ""),
+                **{k: repr(v) for k, v in
+                   (s.get("attributes") or {}).items()},
+            },
+        })
+    return out

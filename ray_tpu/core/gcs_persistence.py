@@ -120,15 +120,17 @@ class MirroredStore(StoreClient):
                 candidates.append(blob)
         if not candidates:
             return None
-        # Wall time dominates, seq breaks ties: after a lineage
-        # divergence (a mirror that was unreachable while the primary
-        # kept writing, then came back with a HIGHER old seq), the
-        # fresher copy must win — restoring the stale generation would
-        # resurrect deleted actors and drop recent writes.  Clock skew
-        # between a head and its replacement is far smaller than the
-        # staleness windows that matter here.
+        # Seq dominates, wall time breaks ties: the save counter is
+        # resumed from the restored blob on restart, so it is monotonic
+        # across head generations — unlike saved_at, which a replacement
+        # head with a skewed (or stepped-back) clock can stamp EARLIER
+        # than a genuinely stale copy, silently restoring a dead
+        # generation that resurrects deleted actors and drops recent
+        # writes.  saved_at only arbitrates between copies of the same
+        # seq (e.g. a mirror that got the write and a primary that got
+        # re-written after a partial failure).
         return max(candidates,
-                   key=lambda b: (b.get("saved_at", 0), b.get("seq", 0)))
+                   key=lambda b: (b.get("seq", 0), b.get("saved_at", 0)))
 
     def _warn_once(self, store: StoreClient, err: Exception,
                    role: str) -> None:

@@ -2503,6 +2503,18 @@ class LocalRuntime:
     def apply_ref_batches(self, rep: Dict[str, Any], worker_key: str,
                           which: str = "both") -> None:
         """Apply borrow add/del batches piggybacked on a worker reply."""
+        # Worker-finished spans and metric snapshots also ride the
+        # reply (pop: this runs twice per reply on the sealing path —
+        # add then rem).
+        if isinstance(rep, dict):
+            spans = rep.pop("spans", None)
+            if spans and _tracing().is_enabled():
+                _tracing().ingest(spans)
+            snap = rep.pop("metrics", None)
+            if snap:
+                from ray_tpu.util import metrics as _metrics
+
+                _metrics.merge_remote(worker_key, snap)
         if which in ("both", "add"):
             for b in rep.get("ref_add") or ():
                 self.refs.add_borrow(worker_key, ObjectID(b))

@@ -31,6 +31,7 @@ import os
 import socket
 import sys
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -565,6 +566,17 @@ class _WorkerServer:
     def _trace(ctx):
         from ray_tpu.util import tracing
 
+        # The driver sends a context iff tracing is on over there —
+        # mirror the flag so spans opened by user/library code in this
+        # worker actually record (they ride the reply back via
+        # drain_finished in _run_op).  A ctx-less call while enabled
+        # means the driver turned tracing off; follow it down so the
+        # is_enabled() fast path goes back to zero overhead.
+        if ctx is not None:
+            if not tracing.is_enabled():
+                tracing.enable_tracing()
+        elif tracing.is_enabled():
+            tracing.disable_tracing()
         return tracing.activate(ctx)
 
     # -- request handling --------------------------------------------------
@@ -645,6 +657,26 @@ class _WorkerServer:
                 rep["ref_add"] = adds
             if dels:
                 rep["ref_rem"] = dels
+            from ray_tpu.util import tracing
+
+            if tracing.is_enabled():
+                # Spans finished in this worker ride the reply home;
+                # concurrent calls may drain each other's spans, which
+                # is fine — they all land in the same driver buffer.
+                spans = tracing.drain_finished()
+                if spans:
+                    rep["spans"] = spans
+            # Metric snapshots ride at most once per second per worker
+            # (absolute cumulative state, so skipped replies lose
+            # nothing — the next snapshot covers them).
+            now = time.monotonic()
+            if now - getattr(self, "_metrics_ship_t", 0.0) >= 1.0:
+                from ray_tpu.util import metrics
+
+                snap = metrics.snapshot_samples()
+                if snap:
+                    rep["metrics"] = snap
+                    self._metrics_ship_t = now
             return rep
         finally:
             with self._busy_lock:

@@ -34,6 +34,76 @@ from ray_tpu.data.block import (
 )
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.util import tracing
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Per-operator metric singletons (re-registered on refetch — see
+    serve/llm_engine._telemetry for the registry-clear rationale)."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "tasks": metrics.Counter(
+                "raytpu_data_op_tasks_total",
+                "Block tasks launched, by operator stage.",
+                tag_keys=("op",),
+            ),
+            "wall": metrics.Counter(
+                "raytpu_data_op_wall_seconds_total",
+                "Wall-clock seconds a stage spent from first launch to "
+                "drain, by operator stage.",
+                tag_keys=("op",),
+            ),
+            "block_wait": metrics.Counter(
+                "raytpu_data_op_block_wait_seconds_total",
+                "Seconds a stage spent blocked on upstream blocks, by "
+                "operator stage.",
+                tag_keys=("op",),
+            ),
+            "inflight": metrics.Gauge(
+                "raytpu_data_op_inflight_tasks",
+                "Block tasks currently in flight, by operator stage.",
+                tag_keys=("op",),
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
+
+
+class _StageTrace:
+    """One pre-allocated span per operator stage.  Task submissions run
+    under ``activate()`` so every block task's span parents to the
+    stage; ``close()`` records the stage span itself once the stage
+    drains.  All no-ops when tracing is disabled."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.start = time.time()
+        if tracing.is_enabled():
+            self.parent = tracing.capture_context()
+            self.span_id = tracing.new_span_id()
+            self.ctx = {"trace_id": self.parent["trace_id"],
+                        "span_id": self.span_id}
+        else:
+            self.parent = self.span_id = self.ctx = None
+
+    def activate(self):
+        return tracing.activate(self.ctx)
+
+    def close(self, stat: "StageStats") -> None:
+        if self.ctx is not None:
+            tracing.record_span(
+                f"data.{self.name}", self.start, time.time(),
+                ctx=self.parent, span_id=self.span_id,
+                attributes={"tasks": stat.tasks,
+                            "block_wait_s": round(stat.block_wait_s, 6)})
 
 
 # ---------------------------------------------------------------------------
@@ -137,6 +207,7 @@ class StageStats:
     name: str
     tasks: int = 0
     wall_s: float = 0.0
+    block_wait_s: float = 0.0  # time blocked on upstream next(stream)
 
 
 class StreamingExecutor:
@@ -150,6 +221,7 @@ class StreamingExecutor:
         self.ops = self.plan.ops
         self.ctx = ctx or DataContext.get_current()
         self.stats: List[StageStats] = []
+        self._tm = _telemetry()
         self._remote_chain_read = ray_tpu.remote(
             num_cpus=self.ctx.cpus_per_task)(_chain_read)
         self._remote_chain_block = ray_tpu.remote(
@@ -200,6 +272,18 @@ class StreamingExecutor:
         budget = self.ctx.op_memory_budget_bytes
         return budget <= 0 or self._live_bytes() < budget
 
+    def _close_stage(self, stat: StageStats, trace: _StageTrace) -> None:
+        """Flush a drained stage's stats into the registry and record
+        its span.  Runs from the stage generator's ``finally``, so an
+        abandoned stage (e.g. cut short by a downstream Limit) still
+        reports what it did."""
+        tags = {"op": stat.name}
+        self._tm["tasks"].inc(stat.tasks, tags=tags)
+        self._tm["wall"].inc(stat.wall_s, tags=tags)
+        self._tm["block_wait"].inc(stat.block_wait_s, tags=tags)
+        self._tm["inflight"].set(0, tags=tags)
+        trace.close(stat)
+
     # -- public -----------------------------------------------------------
 
     def execute(self) -> Iterator[Any]:
@@ -216,10 +300,15 @@ class StreamingExecutor:
                 stream = self._run_actor_pool(stream, seg[1])
             elif isinstance(seg, AllToAllOp):
                 t0 = time.perf_counter()
-                refs = list(stream)
-                refs = seg.fn(refs, self)
-                self.stats.append(StageStats(seg.name, len(refs),
-                                             time.perf_counter() - t0))
+                trace = _StageTrace(seg.name)
+                refs = list(stream)  # barrier: drain upstream first
+                wait_s = time.perf_counter() - t0
+                with trace.activate():
+                    refs = seg.fn(refs, self)
+                stat = StageStats(seg.name, len(refs),
+                                  time.perf_counter() - t0, wait_s)
+                self.stats.append(stat)
+                self._close_stage(stat, trace)
                 stream = iter(refs)
             elif isinstance(seg, LimitOp):
                 stream = self._run_limit(stream, seg.n)
@@ -281,6 +370,7 @@ class StreamingExecutor:
         t0 = time.perf_counter()
         stat = StageStats(name, len(tasks))
         self.stats.append(stat)
+        trace = _StageTrace(name)
         window = self.ctx.max_in_flight_tasks
         pending = deque()
         it = iter(tasks)
@@ -294,19 +384,24 @@ class StreamingExecutor:
                 not pending or self._under_budget()
             ):
                 try:
-                    ref = self._remote_chain_read.remote(next(it), fns)
+                    with trace.activate():
+                        ref = self._remote_chain_read.remote(next(it), fns)
                 except StopIteration:
                     it = None
                     return
                 self._track(ref)
                 pending.append(ref)
 
-        launch_more()
-        while pending:
-            ref = pending.popleft()
+        try:
             launch_more()
-            yield ref
-        stat.wall_s = time.perf_counter() - t0
+            while pending:
+                ref = pending.popleft()
+                launch_more()
+                self._tm["inflight"].set(len(pending), tags={"op": name})
+                yield ref
+        finally:
+            stat.wall_s = time.perf_counter() - t0
+            self._close_stage(stat, trace)
 
     def _run_map_segment(self, stream: Iterator[Any],
                          fused: List[MapOp]) -> Iterator[Any]:
@@ -315,26 +410,35 @@ class StreamingExecutor:
         t0 = time.perf_counter()
         stat = StageStats(name)
         self.stats.append(stat)
+        trace = _StageTrace(name)
         window = self.ctx.max_in_flight_tasks
         pending = deque()
         exhausted = False
-        while True:
-            while not exhausted and len(pending) < window and (
-                not pending or self._under_budget()
-            ):
-                try:
-                    up = next(stream)
-                except StopIteration:
-                    exhausted = True
+        try:
+            while True:
+                while not exhausted and len(pending) < window and (
+                    not pending or self._under_budget()
+                ):
+                    w0 = time.perf_counter()
+                    try:
+                        up = next(stream)
+                    except StopIteration:
+                        exhausted = True
+                        stat.block_wait_s += time.perf_counter() - w0
+                        break
+                    stat.block_wait_s += time.perf_counter() - w0
+                    with trace.activate():
+                        ref = self._remote_chain_block.remote(up, fns)
+                    self._track(ref)
+                    pending.append(ref)
+                    stat.tasks += 1
+                if not pending:
                     break
-                ref = self._remote_chain_block.remote(up, fns)
-                self._track(ref)
-                pending.append(ref)
-                stat.tasks += 1
-            if not pending:
-                break
-            yield pending.popleft()
-        stat.wall_s = time.perf_counter() - t0
+                self._tm["inflight"].set(len(pending), tags={"op": name})
+                yield pending.popleft()
+        finally:
+            stat.wall_s = time.perf_counter() - t0
+            self._close_stage(stat, trace)
 
     def _run_actor_pool(self, stream: Iterator[Any], op: MapOp) -> Iterator[Any]:
         if op.fn_constructor is None:
@@ -345,6 +449,7 @@ class StreamingExecutor:
         t0 = time.perf_counter()
         stat = StageStats(f"{op.name}(pool={op.actor_pool_size})")
         self.stats.append(stat)
+        trace = _StageTrace(stat.name)
         pending = deque()
         window = max(self.ctx.max_in_flight_tasks, op.actor_pool_size)
         idx = 0
@@ -352,22 +457,28 @@ class StreamingExecutor:
         try:
             while True:
                 while not exhausted and len(pending) < window:
+                    w0 = time.perf_counter()
                     try:
                         up = next(stream)
                     except StopIteration:
                         exhausted = True
+                        stat.block_wait_s += time.perf_counter() - w0
                         break
+                    stat.block_wait_s += time.perf_counter() - w0
                     w = workers[idx % len(workers)]
                     idx += 1
-                    pending.append(w.apply.remote(up, op.batch_size))
+                    with trace.activate():
+                        pending.append(w.apply.remote(up, op.batch_size))
                     stat.tasks += 1
                 if not pending:
                     break
+                self._tm["inflight"].set(len(pending), tags={"op": stat.name})
                 yield pending.popleft()
         finally:
             for w in workers:
                 ray_tpu.kill(w)
-        stat.wall_s = time.perf_counter() - t0
+            stat.wall_s = time.perf_counter() - t0
+            self._close_stage(stat, trace)
 
     def _run_limit(self, stream: Iterator[Any], n: int) -> Iterator[Any]:
         remaining = n
@@ -387,7 +498,9 @@ class StreamingExecutor:
     def stats_summary(self) -> str:
         lines = ["Execution stats:"]
         for s in self.stats:
-            lines.append(f"  {s.name}: {s.tasks} tasks, {s.wall_s:.3f}s wall")
+            lines.append(
+                f"  {s.name}: {s.tasks} tasks, {s.wall_s:.3f}s wall, "
+                f"{s.block_wait_s:.3f}s block-wait")
         return "\n".join(lines)
 
 

@@ -20,6 +20,36 @@ import ray_tpu
 from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
 from ray_tpu.data.context import DataContext
 
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Consumption-side metric singletons (re-registered on refetch —
+    see serve/llm_engine._telemetry for the registry-clear rationale).
+
+    Rows/bytes are counted here, at block materialization, because the
+    executor only moves ObjectRefs — the iterator is the first place
+    the actual blocks exist to be measured."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "rows": metrics.Counter(
+                "raytpu_data_output_rows_total",
+                "Rows materialized by batch iteration.",
+            ),
+            "bytes": metrics.Counter(
+                "raytpu_data_output_bytes_total",
+                "Bytes materialized by batch iteration.",
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
+
 
 def iter_batches_from_refs(
     ref_iter: Iterator[Any],
@@ -63,11 +93,15 @@ def iter_batches_from_refs(
                     return
 
         min_needed = (local_shuffle_buffer_size or 0) + (batch_size or 0)
+        tm = _telemetry()
         for ref in ref_iter:
             block = ray_tpu.get(ref)
-            n = BlockAccessor(block).num_rows()
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
             if n == 0:
                 continue
+            tm["rows"].inc(n)
+            tm["bytes"].inc(acc.size_bytes())
             buffer.append(block)
             buffered_rows += n
             yield from drain(max(min_needed, batch_size or 1))

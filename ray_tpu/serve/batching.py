@@ -16,6 +16,31 @@ import threading
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional
 
+from ray_tpu.util import tracing
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """@serve.batch metric singletons (re-registered on refetch — see
+    llm_engine._telemetry for the registry-clear rationale)."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "batch_size": metrics.Histogram(
+                "raytpu_serve_batch_size",
+                "Items flushed per @serve.batch call.",
+                boundaries=[1, 2, 4, 8, 16, 32, 64, 128],
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
+
 
 class _BatchQueue:
     """One flusher thread per (function, owner).  The owner is held only
@@ -32,6 +57,7 @@ class _BatchQueue:
         self._max = max_batch_size
         self._wait = batch_wait_timeout_s
         self._q: "queue.Queue" = queue.Queue()
+        self._tm = _telemetry()
         self._loop_obj = None  # lazy per-thread loop for async handlers
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -41,7 +67,10 @@ class _BatchQueue:
 
     def submit(self, item: Any) -> Future:
         fut: Future = Future()
-        self._q.put((item, fut))
+        # The caller's span context rides with the item: batches flush
+        # on the flusher thread, so formation/execution spans parent to
+        # the FIRST item's request rather than floating rootless.
+        self._q.put((item, fut, tracing.capture_context()))
         return fut
 
     def _bound_fn(self) -> Optional[Callable]:
@@ -62,18 +91,19 @@ class _BatchQueue:
     def _loop(self):
         while True:
             try:
-                item, fut = self._q.get(timeout=5.0)
+                item, fut, ctx = self._q.get(timeout=5.0)
             except queue.Empty:
                 if self._owner_ref is not None and self._owner_ref() is None:
                     if self._loop_obj is not None:
                         self._loop_obj.close()  # release epoll/pipe fds
                     return  # owner collected — exit
                 continue
-            batch = [(item, fut)]
+            batch = [(item, fut, ctx)]
             # Wait up to batch_wait_timeout_s to fill the batch
             # (parity: _BatchQueue wait loop).
             import time
 
+            form_start = time.time()
             deadline = time.monotonic() + self._wait
             while len(batch) < self._max:
                 remaining = deadline - time.monotonic()
@@ -85,28 +115,34 @@ class _BatchQueue:
                 except queue.Empty:
                     break
             items = [b[0] for b in batch]
+            self._tm["batch_size"].observe(len(items))
+            tracing.record_span(
+                "serve.batch_form", form_start, time.time(), ctx=ctx,
+                attributes={"batch_size": len(items)})
             try:
                 bound = self._bound_fn()
                 if bound is None:
                     raise RuntimeError("batch owner was garbage-collected")
-                results = bound(items)
-                if inspect.iscoroutine(results):
-                    # async batched fns are supported (parity: the
-                    # reference's @serve.batch wraps async handlers).
-                    # One persistent loop per batch thread: handlers may
-                    # cache loop-bound state across batches.
-                    results = self._event_loop().run_until_complete(
-                        results
-                    )
+                with tracing.span("serve.batch_call", ctx=ctx,
+                                  attributes={"batch_size": len(items)}):
+                    results = bound(items)
+                    if inspect.iscoroutine(results):
+                        # async batched fns are supported (parity: the
+                        # reference's @serve.batch wraps async handlers).
+                        # One persistent loop per batch thread: handlers
+                        # may cache loop-bound state across batches.
+                        results = self._event_loop().run_until_complete(
+                            results
+                        )
                 if len(results) != len(items):
                     raise ValueError(
                         f"batched function returned {len(results)} results "
                         f"for {len(items)} inputs"
                     )
-                for (_, f), r in zip(batch, results):
+                for (_, f, _c), r in zip(batch, results):
                     f.set_result(r)
             except Exception as e:
-                for _, f in batch:
+                for _, f, _c in batch:
                     f.set_exception(e)
 
 

@@ -32,6 +32,51 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.util import tracing
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Engine metric singletons (created on first engine construction,
+    re-registered on later fetches so a test's registry clear() cannot
+    silently drop the serving plane from /metrics)."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "ttft": metrics.Histogram(
+                "raytpu_serve_ttft_seconds",
+                "Time from submit to first generated token, per request.",
+                boundaries=[0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                            1.0, 2.5, 5.0, 10.0, 30.0],
+            ),
+            "tpot": metrics.Histogram(
+                "raytpu_serve_tpot_seconds",
+                "Mean per-output-token latency after the first token, "
+                "per request.",
+                boundaries=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                            0.05, 0.1, 0.25, 1.0],
+            ),
+            "queue_depth": metrics.Gauge(
+                "raytpu_serve_queue_depth",
+                "Requests admitted nowhere yet: waiting queue + paged "
+                "backlog, sampled at dispatch time.",
+            ),
+            "batch_size": metrics.Histogram(
+                "raytpu_serve_decode_batch_size",
+                "Active slots per decode dispatch (continuous-batch "
+                "occupancy).",
+                boundaries=[1, 2, 4, 8, 16, 32, 64],
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
+
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
@@ -183,6 +228,11 @@ class Request:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     tokens: List[int] = dataclasses.field(default_factory=list)
+    # Telemetry: the submitter's span context (None when tracing is
+    # off) and the prefill-dispatch stamp splitting queue wait from
+    # prefill in the request's span tree.
+    trace_ctx: Optional[Dict[str, str]] = None
+    admitted_at: Optional[float] = None
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -364,6 +414,7 @@ class LLMEngine:
         self._work = threading.Event()
         self._steps = 0
         self._tokens_out = 0
+        self._tm = _telemetry()
 
         slots = config.max_slots
 
@@ -518,6 +569,8 @@ class LLMEngine:
             temperature=float(temperature),
             stream=queue.Queue(),
             req_id=next(self._req_counter),
+            trace_ctx=(tracing.capture_context()
+                       if tracing.is_enabled() else None),
         )
         if self._paged:
             # Reject requests the page pool can NEVER satisfy — they
@@ -640,9 +693,12 @@ class LLMEngine:
         device_get covers several entries — each sync get costs a full
         ~100 ms round trip on tunneled devices); slots register NOW so
         decode chunks dispatch behind the prefill without waiting."""
+        now = time.monotonic()
         for req, slot in batch:
             self._slot_req[slot] = req
             self._temps[slot] = req.temperature
+            if req.admitted_at is None:
+                req.admitted_at = now
             # The pending first token counts against the budget until
             # the prefill entry is processed.
             self._inflight_tokens[slot] = \
@@ -717,6 +773,7 @@ class LLMEngine:
                 if slot is None:
                     self._backlog.insert(0, req)
                     break
+                req.admitted_at = time.monotonic()
                 self._prefilling.append({"req": req, "slot": slot,
                                          "pos": 0})
         while self._free_slots:
@@ -788,6 +845,7 @@ class LLMEngine:
         )
         if done:
             req.finished_at = time.monotonic()
+            self._observe_request(req)
             req.stream.put(_DONE)
             del self._slot_req[slot]
             self._free_slots.append(slot)
@@ -796,6 +854,40 @@ class LLMEngine:
                 self._free_pages.extend(self._slot_pages.pop(slot, []))
                 self._bt[slot] = self._num_pages
                 self._lens[slot] = 0
+
+    def _observe_request(self, req: Request) -> None:
+        """Request-completion telemetry: TTFT/TPOT histograms, and the
+        request's span tree (queue wait → prefill → decode) when
+        tracing is on.  Spans are recorded retroactively from the
+        monotonic stamps the engine loop takes anyway, so the decode
+        hot path itself carries no tracing code."""
+        if req.ttft_s is not None:
+            self._tm["ttft"].observe(req.ttft_s)
+        if (req.first_token_at is not None and len(req.tokens) > 1):
+            self._tm["tpot"].observe(
+                (req.finished_at - req.first_token_at)
+                / (len(req.tokens) - 1))
+        if not tracing.is_enabled():
+            return
+        # Monotonic stamps → wall clock for the trace view.
+        off = time.time() - time.monotonic()
+        root = tracing.record_span(
+            "llm.request", req.submitted_at + off, req.finished_at + off,
+            ctx=req.trace_ctx,
+            attributes={"req_id": req.req_id,
+                        "prompt_len": len(req.prompt),
+                        "num_tokens": len(req.tokens)},
+        )
+        ctx = {"trace_id": root["trace_id"], "span_id": root["span_id"]}
+        admitted = req.admitted_at or req.submitted_at
+        tracing.record_span("llm.queue_wait", req.submitted_at + off,
+                            admitted + off, ctx=ctx)
+        if req.first_token_at is not None:
+            tracing.record_span("llm.prefill", admitted + off,
+                                req.first_token_at + off, ctx=ctx)
+            tracing.record_span("llm.decode", req.first_token_at + off,
+                                req.finished_at + off, ctx=ctx,
+                                attributes={"tokens": len(req.tokens)})
 
     def _chunk_size(self) -> int:
         """Largest compiled chunk that no active request can out-finish
@@ -909,6 +1001,10 @@ class LLMEngine:
                 self._active_arg, self._temps_arg, self._next_seed(),
             )
         self._steps += chunk
+        self._tm["batch_size"].observe(len(self._slot_req))
+        self._tm["queue_depth"].set(
+            self._waiting.qsize()
+            + (len(self._backlog) if self._paged else 0))
         participants = list(self._slot_req.items())
         for slot, _req in participants:
             self._inflight_tokens[slot] = (

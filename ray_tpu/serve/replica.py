@@ -14,6 +14,38 @@ import time
 from typing import Any, Dict, Optional
 
 from ray_tpu.serve.deployment import _HandlePlaceholder
+from ray_tpu.util import tracing
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Replica metric singletons (re-registered on refetch — see
+    llm_engine._telemetry for the registry-clear rationale)."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "latency": metrics.Histogram(
+                "raytpu_serve_request_latency_seconds",
+                "End-to-end user-code latency inside the replica, by "
+                "deployment.",
+                boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                            5.0, 10.0, 60.0],
+                tag_keys=("deployment",),
+            ),
+            "ongoing": metrics.Gauge(
+                "raytpu_serve_replica_ongoing",
+                "Requests currently executing, by replica.",
+                tag_keys=("deployment", "replica"),
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
 
 
 def _resolve_placeholders(value: Any) -> Any:
@@ -40,6 +72,8 @@ class ReplicaActor:
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
+        self._tm = _telemetry()
+        self._tags = {"deployment": deployment_name, "replica": replica_id}
         init_args = _resolve_placeholders(init_args)
         init_kwargs = _resolve_placeholders(init_kwargs)
         if inspect.isclass(func_or_class):
@@ -88,23 +122,34 @@ class ReplicaActor:
             k: api.get(v) if isinstance(v, ObjectRef) else v
             for k, v in kwargs.items()
         }
+        t0 = time.perf_counter()
         with self._lock:
             self._ongoing += 1
             self._total += 1
+            self._tm["ongoing"].set(self._ongoing, tags=self._tags)
         mux_token = _mux._set_model_id(
             (metadata or {}).get("multiplexed_model_id", "")
         )
         try:
-            result = self._target(method_name)(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                import asyncio
+            with tracing.span(
+                    "serve.replica",
+                    attributes={"deployment": self.deployment_name,
+                                "replica": self.replica_id,
+                                "method": method_name}):
+                result = self._target(method_name)(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    import asyncio
 
-                result = asyncio.run(result)
-            return result
+                    result = asyncio.run(result)
+                return result
         finally:
             _mux._reset_model_id(mux_token)
+            self._tm["latency"].observe(
+                time.perf_counter() - t0,
+                tags={"deployment": self.deployment_name})
             with self._lock:
                 self._ongoing -= 1
+                self._tm["ongoing"].set(self._ongoing, tags=self._tags)
 
     async def handle_request_async(self, method_name: str, args: tuple,
                                    kwargs: dict, metadata: dict = None):
@@ -124,13 +169,18 @@ class ReplicaActor:
             k: (await v) if isinstance(v, ObjectRef) else v
             for k, v in kwargs.items()
         }
+        t0 = time.perf_counter()
         with self._lock:
             self._ongoing += 1
             self._total += 1
+            self._tm["ongoing"].set(self._ongoing, tags=self._tags)
         mux_token = _mux._set_model_id(
             (metadata or {}).get("multiplexed_model_id", "")
         )
         try:
+            # Metrics only on the async plane: a span context manager
+            # around an await would leak its thread-local ctx across
+            # every coroutine interleaved on the loop.
             target = self._target(method_name)
             # Per-METHOD dispatch: the deployment is announced async off
             # its __call__, but a sync named method must not run inline
@@ -152,8 +202,12 @@ class ReplicaActor:
             return result
         finally:
             _mux._reset_model_id(mux_token)
+            self._tm["latency"].observe(
+                time.perf_counter() - t0,
+                tags={"deployment": self.deployment_name})
             with self._lock:
                 self._ongoing -= 1
+                self._tm["ongoing"].set(self._ongoing, tags=self._tags)
 
     # -- control plane -----------------------------------------------------
 

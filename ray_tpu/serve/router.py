@@ -17,6 +17,35 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import api
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.util import tracing
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Router metric singletons (re-registered on refetch — see
+    llm_engine._telemetry for the registry-clear rationale)."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "requests": metrics.Counter(
+                "raytpu_serve_router_requests_total",
+                "Requests routed to a replica, by deployment.",
+                tag_keys=("deployment",),
+            ),
+            "inflight": metrics.Gauge(
+                "raytpu_serve_router_inflight",
+                "Requests assigned but not yet completed, by deployment.",
+                tag_keys=("deployment",),
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
 
 
 class _ReplicaInfo:
@@ -46,6 +75,7 @@ class Router:
         self._model_affinity: Dict[str, str] = {}
         self._stopped = threading.Event()
         self._client = None
+        self._tm = _telemetry()
         self._subscribe()
         threading.Thread(
             target=self._reaper_loop, daemon=True,
@@ -105,6 +135,31 @@ class Router:
         replica; if everything is excluded we wait for the controller's
         replacement broadcast)."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        # The request's root span: replica selection (with its queue
+        # wait) and the submit happen inside it, so the replica's task
+        # span — and everything the user code spawns — parent here.
+        with tracing.span(
+                "serve.request",
+                attributes={"deployment": self.deployment_name,
+                            "method": method_name}):
+            with tracing.span("serve.queue_wait"):
+                chosen = self._select_replica(deadline, timeout, exclude,
+                                              model_id)
+            metadata = ({"multiplexed_model_id": model_id}
+                        if model_id else None)
+            entry = (chosen.handle.handle_request_async if chosen.is_async
+                     else chosen.handle.handle_request)
+            ref = entry.remote(method_name, args, kwargs, metadata)
+        self._tm["requests"].inc(
+            tags={"deployment": self.deployment_name})
+        with self._cv:
+            self._outstanding[ref] = chosen.replica_id
+            self._tm["inflight"].set(
+                len(self._outstanding),
+                tags={"deployment": self.deployment_name})
+        return ref, chosen.replica_id
+
+    def _select_replica(self, deadline, timeout, exclude, model_id):
         with self._cv:
             while True:
                 candidates = [
@@ -147,13 +202,7 @@ class Router:
                         f"available within {timeout}s"
                     )
                 self._cv.wait(0.05 if remaining is None else min(remaining, 0.05))
-        metadata = {"multiplexed_model_id": model_id} if model_id else None
-        entry = (chosen.handle.handle_request_async if chosen.is_async
-                 else chosen.handle.handle_request)
-        ref = entry.remote(method_name, args, kwargs, metadata)
-        with self._cv:
-            self._outstanding[ref] = chosen.replica_id
-        return ref, chosen.replica_id
+        return chosen
 
     def _reaper_loop(self):
         """Decrement in-flight counts as results land (parity: the
@@ -181,6 +230,9 @@ class Router:
                     err = rt.store.peek_error(ref.id)
                     if isinstance(err, ActorDiedError):
                         self._replicas.pop(replica_id, None)
+                self._tm["inflight"].set(
+                    len(self._outstanding),
+                    tags={"deployment": self.deployment_name})
                 self._cv.notify_all()
 
     def num_outstanding(self) -> int:

@@ -15,6 +15,29 @@ from typing import Any, Dict, Optional
 
 _tls = threading.local()
 
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Session metric singleton (re-registered on refetch — see
+    serve/llm_engine._telemetry for the registry-clear rationale)."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "reports": metrics.Counter(
+                "raytpu_train_reports_total",
+                "train.report() calls streamed to the driver, by rank.",
+                tag_keys=("rank",),
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
+
 
 @dataclasses.dataclass
 class TrainContext:
@@ -73,7 +96,10 @@ def report(metrics: Dict[str, Any],
            checkpoint: Optional[Any] = None) -> None:
     """Stream metrics (and optionally a checkpoint payload) to the
     driver (parity: ray.train.report)."""
-    _get_session().report_fn(dict(metrics), checkpoint)
+    s = _get_session()
+    _telemetry()["reports"].inc(
+        tags={"rank": str(s.context.world_rank)})
+    s.report_fn(dict(metrics), checkpoint)
 
 
 def get_context() -> TrainContext:

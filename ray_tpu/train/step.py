@@ -10,6 +10,7 @@ hides inside torch DDP; ray: python/ray/train/torch/config.py:63).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -18,6 +19,51 @@ import optax
 
 from ray_tpu.parallel.sharding import Rules, tree_shardings
 from ray_tpu.train.state import TrainState, state_shardings
+from ray_tpu.util import tracing
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Step-compilation metric singleton (re-registered on refetch —
+    see serve/llm_engine._telemetry for the registry-clear rationale)."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "compile": metrics.Counter(
+                "raytpu_train_compile_seconds_total",
+                "Seconds spent in first-call XLA compilation of train "
+                "steps.",
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
+
+
+def _instrument_first_call(jitted):
+    """The first invocation of a jitted step traces + compiles the XLA
+    program; time it so compile cost shows up next to step time in the
+    registry and the timeline.  Subsequent calls pass straight through."""
+    compiled = []
+
+    def wrapped(state, batch):
+        if compiled:
+            return jitted(state, batch)
+        t0 = time.time()
+        out = jitted(state, batch)
+        compiled.append(True)
+        elapsed = time.time() - t0
+        _telemetry()["compile"].inc(elapsed)
+        tracing.record_span("train.compile", t0, t0 + elapsed)
+        return out
+
+    wrapped.__wrapped__ = jitted
+    return wrapped
 
 LossFn = Callable[[Any, Dict[str, jax.Array]], Tuple[jax.Array, Dict[str, jax.Array]]]
 
@@ -66,4 +112,4 @@ def compile_train_step(
         out_shardings=(st_sh, None),
         donate_argnums=(0,),
     )
-    return jitted, st_sh, batch_sh
+    return _instrument_first_call(jitted), st_sh, batch_sh

@@ -28,6 +28,75 @@ from ray_tpu.parallel.sharding import Rules
 from ray_tpu.train.checkpoint import CheckpointManager
 from ray_tpu.train.state import TrainState, create_train_state, default_optimizer
 from ray_tpu.train.step import compile_train_step
+from ray_tpu.util import tracing
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Trainer metric singletons (re-registered on refetch — see
+    serve/llm_engine._telemetry for the registry-clear rationale)."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "step_s": metrics.Histogram(
+                "raytpu_train_step_seconds",
+                "Host-side duration of one training step (dispatch, plus "
+                "device sync on report steps).",
+                boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                            5.0, 30.0, 120.0],
+            ),
+            "data_wait_s": metrics.Histogram(
+                "raytpu_train_data_wait_seconds",
+                "Seconds each step waited on the input iterator + batch "
+                "sharding.",
+                boundaries=[0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                            1.0, 5.0],
+            ),
+            "steps": metrics.Counter(
+                "raytpu_train_steps_total",
+                "Training steps completed.",
+            ),
+            "checkpoints": metrics.Counter(
+                "raytpu_train_checkpoints_total",
+                "Checkpoints written by the trainer.",
+            ),
+            "mem_in_use": metrics.Gauge(
+                "raytpu_train_device_mem_bytes_in_use",
+                "Device memory currently allocated, by local device.",
+                tag_keys=("device",),
+            ),
+            "mem_peak": metrics.Gauge(
+                "raytpu_train_device_mem_bytes_peak",
+                "Device memory high watermark, by local device.",
+                tag_keys=("device",),
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
+
+
+def _record_device_memory(tm) -> None:
+    """Device memory watermarks → gauges.  TPU/GPU backends expose
+    memory_stats(); CPU returns None/raises — then the gauges simply
+    never appear."""
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            return
+        if not stats:
+            continue
+        tags = {"device": f"{d.platform}:{d.id}"}
+        if "bytes_in_use" in stats:
+            tm["mem_in_use"].set(stats["bytes_in_use"], tags=tags)
+        if "peak_bytes_in_use" in stats:
+            tm["mem_peak"].set(stats["peak_bytes_in_use"], tags=tags)
 
 
 @dataclasses.dataclass
@@ -149,26 +218,47 @@ class JaxTrainer:
 
         history: List[Dict[str, float]] = []
         last_metrics: Dict[str, float] = {}
+        tm = _telemetry()
         it = iter(data)
         t0 = time.perf_counter()
         error: Optional[BaseException] = None
         try:
             with self.mesh:
                 for i in range(num_steps):
-                    batch = self.shard_batch(next(it))
-                    self._state, metrics = self._step_fn(self._state, batch)
                     step = i + 1
-                    if step % rc.report_every == 0 or step == num_steps:
-                        m = {k: float(jax.device_get(v)) for k, v in metrics.items()}
-                        m["steps_per_sec"] = step / (time.perf_counter() - t0)
-                        history.append(m)
-                        last_metrics = m
-                        if report:
-                            report(m)
-                    if ckpt and rc.checkpoint_every and step % rc.checkpoint_every == 0:
-                        # sharded arrays go straight to orbax — each host
-                        # writes its own shards, no host gather
-                        ckpt.save(step, self._state)
+                    with tracing.span("train.step",
+                                      attributes={"step": step}):
+                        w0 = time.perf_counter()
+                        with tracing.span("train.data_wait"):
+                            batch = self.shard_batch(next(it))
+                        c0 = time.perf_counter()
+                        tm["data_wait_s"].observe(c0 - w0)
+                        # Host-side timing: jax dispatch is async, so
+                        # off-report steps measure dispatch cost; report
+                        # steps sync below via device_get.
+                        with tracing.span("train.compute"):
+                            self._state, metrics = self._step_fn(
+                                self._state, batch)
+                        tm["step_s"].observe(time.perf_counter() - c0)
+                        tm["steps"].inc()
+                        if step % rc.report_every == 0 or step == num_steps:
+                            m = {k: float(jax.device_get(v))
+                                 for k, v in metrics.items()}
+                            m["steps_per_sec"] = step / (
+                                time.perf_counter() - t0)
+                            history.append(m)
+                            last_metrics = m
+                            _record_device_memory(tm)
+                            if report:
+                                report(m)
+                        if ckpt and rc.checkpoint_every \
+                                and step % rc.checkpoint_every == 0:
+                            # sharded arrays go straight to orbax — each
+                            # host writes its own shards, no host gather
+                            with tracing.span("train.checkpoint",
+                                              attributes={"step": step}):
+                                ckpt.save(step, self._state)
+                            tm["checkpoints"].inc()
         except BaseException as e:  # report partial progress + the failure
             error = e
             if not isinstance(e, Exception):

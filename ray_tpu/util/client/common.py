@@ -417,7 +417,12 @@ def _enc_lease_reply(pb, f, val) -> bool:
     if not isinstance(val, dict):
         return False
     m = pb.LeaseReply()
-    if val.get("busy"):
+    # Exact-shape match only: a payload with "busy" PLUS other fields is
+    # not a lease reply (the proto would silently drop the extras) —
+    # fall back to pickle so nothing is lost in transit.  {"busy":
+    # False} also falls through: the decoder reads busy=False as the
+    # wid shape.
+    if set(val) == {"busy"} and val["busy"]:
         m.busy = True
         f.lease_reply.CopyFrom(m)
         return True

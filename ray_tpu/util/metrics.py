@@ -169,14 +169,35 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, Metric] = {}
+        self._collisions: List[str] = []
 
     def register(self, metric: Metric) -> None:
         with self._lock:
+            prev = self._metrics.get(metric.name)
+            if prev is not None and prev is not metric:
+                # Newest instance wins (documented), but a DIFFERENT
+                # instance claiming a live name is almost always two
+                # modules colliding — remembered so the metrics smoke
+                # check (scripts/check_metrics.py) can fail loudly
+                # instead of one plane silently shadowing another.
+                self._collisions.append(metric.name)
             self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def collisions(self) -> List[str]:
+        """Names re-registered by a different Metric instance since the
+        last clear()."""
+        with self._lock:
+            return list(self._collisions)
 
     def clear(self) -> None:
         with self._lock:
             self._metrics.clear()
+            self._collisions.clear()
+        clear_remote()
 
     def collect(self) -> List[Metric]:
         with self._lock:
@@ -188,6 +209,39 @@ _default_registry = MetricsRegistry()
 
 def registry() -> MetricsRegistry:
     return _default_registry
+
+
+# -- cross-process merge ----------------------------------------------------
+#
+# Worker processes (replica actors, pool workers) observe into their own
+# process-local registry; their absolute sample state rides task replies
+# back to the driver (see worker_main._run_op), which stores the latest
+# snapshot per worker here.  export_prometheus renders them under a
+# ``proc`` label, so one driver scrape shows every process's series —
+# the single-scrape-endpoint analogue of Prometheus federation.
+
+_remote_lock = threading.Lock()
+_remote_snapshots: Dict[str, list] = {}
+
+
+def snapshot_samples() -> list:
+    """Absolute sample state of every registered metric:
+    [(family, type, help, [(sample_name, tag_tuple, value), ...]), ...].
+    The worker-side half of the cross-process merge."""
+    return [(m.name, m._type, m.description, list(m._samples()))
+            for m in _default_registry.collect()]
+
+
+def merge_remote(proc: str, snapshot: list) -> None:
+    """Store a worker process's sample snapshot (driver-side half).
+    Snapshots are absolute cumulative state, so last-write-wins."""
+    with _remote_lock:
+        _remote_snapshots[proc] = snapshot
+
+
+def clear_remote() -> None:
+    with _remote_lock:
+        _remote_snapshots.clear()
 
 
 # -- internal runtime metrics (parity: src/ray/stats/metric_defs.cc) -------
@@ -264,11 +318,27 @@ def export_prometheus(include_internal: bool = True) -> str:
     """Prometheus text exposition format 0.0.4 of every registered
     metric (+ internal runtime metrics)."""
     lines: List[str] = []
+    declared = set()
     for m in _default_registry.collect():
+        declared.add(m.name)
         lines.append(f"# HELP {m.name} {m.description}")
         lines.append(f"# TYPE {m.name} {m._type}")
         for name, tags, value in m._samples():
             lines.append(f"{name}{_fmt_tags(tags)} {value}")
+    with _remote_lock:
+        remote = sorted(_remote_snapshots.items())
+    for proc, snapshot in remote:
+        for fam, typ, help_, samples in snapshot:
+            if fam not in declared:
+                declared.add(fam)
+                lines.append(f"# HELP {fam} {help_}")
+                lines.append(f"# TYPE {fam} {typ}")
+            for sname, tags, value in samples:
+                # proc distinguishes the same series observed by
+                # different worker processes (federation's instance
+                # label, collapsed into the one driver scrape).
+                tags = tuple(map(tuple, tags)) + (("proc", proc),)
+                lines.append(f"{sname}{_fmt_tags(tags)} {value}")
     if include_internal:
         seen_help = set()
         for name, typ, help_, tags, value in _internal_samples():

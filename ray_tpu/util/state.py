@@ -115,10 +115,19 @@ def _count_by(rows: List[Dict[str, Any]], key: str) -> Dict[str, int]:
 
 def timeline(filename: Optional[str] = None) -> Optional[List[Dict[str, Any]]]:
     """Chrome trace of every recorded task attempt (parity: `ray
-    timeline`, python/ray/_private/state.py:434 chrome_tracing_dump).
+    timeline`, python/ray/_private/state.py:434 chrome_tracing_dump),
+    merged with the tracer's finished spans so serve/data/train library
+    phases land in the same Perfetto view as the tasks they ran.
     Returns the event list, or writes it to ``filename`` if given."""
-    buf = _runtime().events
+    from ray_tpu.core.events import spans_to_chrome_events
+    from ray_tpu.util import tracing
+
+    events = (_runtime().events.chrome_tracing_dump()
+              + spans_to_chrome_events(tracing.finished_spans()))
     if filename is None:
-        return buf.chrome_tracing_dump()
-    buf.dump_json(filename)
+        return events
+    import json
+
+    with open(filename, "w") as f:
+        json.dump(events, f)
     return None

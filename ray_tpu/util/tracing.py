@@ -58,6 +58,23 @@ def clear() -> None:
         _finished.clear()
 
 
+def drain_finished() -> List[Dict[str, Any]]:
+    """Atomically take every finished span.  Worker processes call this
+    to piggyback their spans on a task reply; the driver ingests them
+    into its own buffer so one process holds the whole trace."""
+    with _lock:
+        out = list(_finished)
+        _finished.clear()
+        return out
+
+
+def ingest(spans: List[Dict[str, Any]]) -> None:
+    """Append span records finished in another process (the receiving
+    end of the reply piggyback)."""
+    for rec in spans:
+        _finish(rec)
+
+
 def _current() -> Optional[Dict[str, str]]:
     return getattr(_tls, "ctx", None)
 
@@ -94,6 +111,17 @@ def activate(ctx: Optional[Dict[str, str]]):
         _tls.ctx = prev
 
 
+def _finish(rec: Dict[str, Any]) -> None:
+    with _lock:
+        _finished.append(rec)
+        if _export_path:
+            try:
+                with open(_export_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            except OSError:
+                pass
+
+
 @contextlib.contextmanager
 def span(name: str, ctx: Optional[Dict[str, str]] = None,
          attributes: Optional[Dict[str, Any]] = None):
@@ -121,14 +149,41 @@ def span(name: str, ctx: Optional[Dict[str, str]] = None,
     finally:
         rec["end"] = time.time()
         _tls.ctx = prev
-        with _lock:
-            _finished.append(rec)
-            if _export_path:
-                try:
-                    with open(_export_path, "a") as f:
-                        f.write(json.dumps(rec) + "\n")
-                except OSError:
-                    pass
+        _finish(rec)
+
+
+def record_span(name: str, start: float, end: float, *,
+                ctx: Optional[Dict[str, str]] = None,
+                span_id: Optional[str] = None,
+                attributes: Optional[Dict[str, Any]] = None,
+                ) -> Optional[Dict[str, Any]]:
+    """Append an already-measured span (wall-clock ``start``/``end``)
+    without touching the thread-local context.  For code that measures
+    phases itself — an engine loop stamping request lifecycles, a
+    streaming executor closing an operator stage — where a live
+    ``with span(...)`` cannot bracket the work.  ``ctx`` is the PARENT
+    context; ``span_id`` pins the id so children recorded elsewhere can
+    parent to a span before it is finished.  Returns the record (its
+    trace_id/span_id make a ctx for children), or None when tracing is
+    disabled."""
+    if not _enabled:
+        return None
+    rec = {
+        "trace_id": (ctx or {}).get("trace_id") or uuid.uuid4().hex,
+        "span_id": span_id or uuid.uuid4().hex[:16],
+        "parent_id": (ctx or {}).get("span_id") or "",
+        "name": name,
+        "start": start,
+        "end": end,
+        "attributes": dict(attributes or {}),
+    }
+    _finish(rec)
+    return rec
+
+
+def new_span_id() -> str:
+    """A fresh span id for record_span(span_id=...) pre-allocation."""
+    return uuid.uuid4().hex[:16]
 
 
 def task_span(name: str, ctx: Optional[Dict[str, str]],

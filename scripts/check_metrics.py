@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Metric-name smoke check over the Prometheus text exposition.
+
+Scrapes the live registry (or a saved exposition file) and fails when
+any metric family violates the naming contract:
+
+  * name grammar  — Prometheus metric names ``[a-zA-Z_:][a-zA-Z0-9_:]*``
+    and label names ``[a-zA-Z_][a-zA-Z0-9_]*``;
+  * repo grammar  — application families start with ``raytpu_`` and use
+    lowercase snake_case (no uppercase, no dots, no dashes);
+  * duplicates    — a family declared by more than one ``# TYPE`` line,
+    or two live Metric instances registered under one name (a plane
+    silently shadowing another plane's series);
+  * histogram shape — ``histogram`` families expose exactly their
+    ``_bucket``/``_sum``/``_count`` sample names.
+
+Usage:
+    python scripts/check_metrics.py            # scrape in-process
+    python scripts/check_metrics.py FILE       # check a saved scrape
+Exit status 0 = clean, 1 = violations (listed on stderr).
+
+The tier-1 telemetry test invokes ``check_exposition()`` directly, so
+every CI run validates whatever metric set the suite just exercised.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import Dict, List
+
+METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+REPO_NAME_RE = re.compile(r"raytpu_[a-z0-9_]+$")
+SAMPLE_LINE_RE = re.compile(
+    r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$")
+LABEL_PAIR_RE = re.compile(r'([^=,{]+)="((?:[^"\\]|\\.)*)"')
+
+
+def check_exposition(text: str) -> List[str]:
+    """Return a list of violations (empty = clean)."""
+    problems: List[str] = []
+    families: Dict[str, str] = {}  # family -> type
+    sample_names: Dict[str, set] = {}  # family -> sample suffix names
+    seen_series: set = set()  # (sample name, sorted label pairs)
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) < 4:
+                problems.append(f"line {lineno}: malformed TYPE line")
+                continue
+            name, typ = parts[2], parts[3]
+            if name in families:
+                problems.append(
+                    f"line {lineno}: duplicate family {name!r} "
+                    f"(declared {families[name]!r}, redeclared {typ!r})")
+            families[name] = typ
+            if not METRIC_NAME_RE.match(name):
+                problems.append(
+                    f"line {lineno}: family {name!r} violates the "
+                    f"Prometheus name grammar")
+            elif not REPO_NAME_RE.match(name):
+                problems.append(
+                    f"line {lineno}: family {name!r} violates the repo "
+                    f"grammar raytpu_<plane>_<what>[_<unit>]")
+            continue
+        if line.startswith("#"):
+            continue
+        m = SAMPLE_LINE_RE.match(line)
+        if m is None:
+            problems.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        sname, _, labels, _ = m.groups()
+        if not METRIC_NAME_RE.match(sname):
+            problems.append(
+                f"line {lineno}: sample name {sname!r} violates the "
+                f"Prometheus name grammar")
+        fam = next((f for f in families
+                    if sname == f or (sname.startswith(f + "_")
+                                      and sname[len(f):] in
+                                      ("_bucket", "_sum", "_count"))),
+                   None)
+        if fam is None:
+            problems.append(
+                f"line {lineno}: sample {sname!r} has no # TYPE "
+                f"declaration")
+        else:
+            sample_names.setdefault(fam, set()).add(sname[len(fam):])
+        pairs = LABEL_PAIR_RE.findall(labels or "")
+        for lname, _v in pairs:
+            if not LABEL_NAME_RE.match(lname):
+                problems.append(
+                    f"line {lineno}: label {lname!r} violates the "
+                    f"Prometheus label grammar")
+        series = (sname, tuple(sorted(pairs)))
+        if series in seen_series:
+            problems.append(
+                f"line {lineno}: duplicate series {sname}"
+                f"{{{','.join(k + '=' + v for k, v in series[1])}}}")
+        seen_series.add(series)
+
+    for fam, typ in families.items():
+        suffixes = sample_names.get(fam, set())
+        if typ == "histogram":
+            bad = suffixes - {"_bucket", "_sum", "_count"}
+            if bad:
+                problems.append(
+                    f"family {fam!r}: histogram exposes unexpected "
+                    f"sample suffixes {sorted(bad)}")
+        elif suffixes - {""}:
+            problems.append(
+                f"family {fam!r}: {typ} exposes suffixed samples "
+                f"{sorted(suffixes - {''})}")
+    return problems
+
+
+def check_registry() -> List[str]:
+    """In-process checks that the text format can't express."""
+    from ray_tpu.util import metrics
+
+    return [
+        f"registry collision: two Metric instances registered as {n!r}"
+        for n in metrics.registry().collisions()
+    ]
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) > 1:
+        text = open(argv[1]).read()
+        problems = check_exposition(text)
+    else:
+        from ray_tpu.util import metrics
+
+        problems = check_exposition(metrics.export_prometheus())
+        problems += check_registry()
+    for p in problems:
+        print(f"check_metrics: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print("check_metrics: exposition clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

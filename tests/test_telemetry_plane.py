@@ -1,0 +1,214 @@
+"""The unified telemetry plane, end to end: one serve request, one LLM
+engine request, one data pipeline, and a short train run must all land
+in the SAME tracer buffer and the SAME Prometheus registry, with the
+merged ``ray_tpu.timeline()`` showing every plane — and tracing
+disabled must add zero spans anywhere.
+"""
+
+import importlib.util
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu import serve
+from ray_tpu.models import llama
+from ray_tpu.serve.llm_engine import EngineConfig, LLMEngine, llama_adapter
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.parallel import MeshSpec
+from ray_tpu.util import metrics, tracing
+
+CFG = llama.LlamaConfig(
+    vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    mlp_dim=64, max_seq_len=128, remat=False,
+)
+
+
+def _load_check_metrics():
+    path = (pathlib.Path(__file__).resolve().parent.parent
+            / "scripts" / "check_metrics.py")
+    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    tracing.clear()
+    yield
+    tracing.disable_tracing()
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _run_serve_request():
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x + 1
+
+    handle = serve.run(Echo.bind(), name="echo", route_prefix=None)
+    assert handle.remote(41).result() == 42
+
+
+def _run_engine_request():
+    params = llama.init_params(jax.random.key(0), CFG)
+    eng = LLMEngine(
+        params, llama_adapter(CFG),
+        EngineConfig(max_slots=2, max_seq_len=128, min_prefill_bucket=16),
+    )
+    try:
+        out = eng.generate([1, 5, 9], max_new_tokens=4, temperature=0.0)
+        assert len(out) == 4
+    finally:
+        eng.shutdown()
+
+
+def _run_data_pipeline():
+    ds = rd.range(64).map_batches(lambda b: {"id": b["id"] * 2})
+    total = 0
+    for batch in ds.iter_batches(batch_size=16):
+        total += len(batch["id"])
+    assert total == 64
+
+
+def _run_train_steps(num_steps=2):
+    def init_params(r):
+        return {"w": jax.random.normal(r, (8, 4))}
+
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"]
+        return jnp.mean((pred - b["y"]) ** 2), {}
+
+    def batches():
+        rng = np.random.default_rng(0)
+        while True:
+            yield {
+                "x": rng.normal(size=(16, 8)).astype(np.float32),
+                "y": rng.normal(size=(16, 4)).astype(np.float32),
+            }
+
+    trainer = JaxTrainer(
+        init_params=init_params,
+        loss_fn=loss_fn,
+        params_axes={"w": (None, None)},
+        batch_axes={"x": ("batch", None), "y": ("batch", None)},
+        scaling_config=ScalingConfig(mesh_spec=MeshSpec()),
+        run_config=RunConfig(report_every=1),
+    )
+    result = trainer.fit(batches(), num_steps=num_steps)
+    assert result.error is None
+
+
+def _sample_value(text, sample_name):
+    for line in text.splitlines():
+        if line.startswith(sample_name) and not line.startswith("#"):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
+    tracing.enable_tracing()
+
+    with tracing.span("workload"):
+        _run_serve_request()
+        _run_engine_request()
+    _run_data_pipeline()
+    _run_train_steps()
+
+    spans = {s["name"]: s for s in tracing.finished_spans()}
+
+    # Serve plane: router root span with the queue wait under it, and
+    # the replica's user-code span in the same trace.
+    assert {"serve.request", "serve.queue_wait", "serve.replica"} \
+        <= set(spans)
+    assert (spans["serve.queue_wait"]["parent_id"]
+            == spans["serve.request"]["span_id"])
+    assert (spans["serve.replica"]["trace_id"]
+            == spans["serve.request"]["trace_id"])
+    # The serve request parents under the driver's workload span.
+    assert (spans["serve.request"]["trace_id"]
+            == spans["workload"]["trace_id"])
+
+    # LLM engine: per-request phase spans hang off llm.request, which
+    # joined the driver's trace via the submit-time context capture.
+    assert {"llm.request", "llm.queue_wait", "llm.prefill", "llm.decode"} \
+        <= set(spans)
+    assert (spans["llm.request"]["trace_id"]
+            == spans["workload"]["trace_id"])
+    for child in ("llm.queue_wait", "llm.prefill", "llm.decode"):
+        assert spans[child]["parent_id"] == spans["llm.request"]["span_id"]
+
+    # Data plane: one span per operator stage (the read fuses with the
+    # map, so the stage name carries both).
+    data_spans = [n for n in spans if n.startswith("data.")]
+    assert data_spans, sorted(spans)
+    assert any("Range" in n for n in data_spans)
+
+    # Train plane: per-step span with data-wait and compute children,
+    # plus the first-call compile span.
+    assert {"train.step", "train.data_wait", "train.compute",
+            "train.compile"} <= set(spans)
+    assert (spans["train.data_wait"]["parent_id"]
+            == spans["train.step"]["span_id"])
+    assert (spans["train.compute"]["parent_id"]
+            == spans["train.step"]["span_id"])
+
+    # One merged timeline: task events and library spans from every
+    # plane in a single chrome-trace dump.
+    out = tmp_path / "timeline.json"
+    ray_tpu.timeline(str(out))
+    events = json.loads(out.read_text())
+    pids = {e["pid"] for e in events if e.get("ph") == "X"}
+    assert {"serve", "llm", "data", "train"} <= pids, pids
+
+    # One registry: every plane's families in a single scrape, with the
+    # request/step observations actually recorded.
+    text = metrics.export_prometheus()
+    assert _sample_value(text, "raytpu_serve_ttft_seconds_count") >= 1
+    assert _sample_value(text, "raytpu_serve_tpot_seconds_count") >= 1
+    assert "raytpu_serve_router_requests_total{" in text
+    assert "raytpu_serve_request_latency_seconds_bucket{" in text
+    assert "raytpu_data_op_tasks_total{" in text
+    assert _sample_value(text, "raytpu_data_output_rows_total") == 64
+    assert _sample_value(text, "raytpu_train_steps_total") == 2
+    assert _sample_value(text, "raytpu_train_compile_seconds_total") > 0
+
+    # The smoke check passes over the full live exposition.
+    cm = _load_check_metrics()
+    assert cm.check_exposition(text) == []
+    assert cm.check_registry() == []
+
+
+def test_disabled_tracing_records_zero_spans(rt):
+    assert not tracing.is_enabled()
+    _run_engine_request()
+    _run_data_pipeline()
+    assert tracing.finished_spans() == []
+
+
+def test_check_metrics_flags_bad_names():
+    cm = _load_check_metrics()
+    bad = (
+        "# HELP other_counter_total x\n"
+        "# TYPE other_counter_total counter\n"
+        "other_counter_total 1\n"
+        "# HELP raytpu_bad.name x\n"
+        "# TYPE raytpu_bad.name gauge\n"
+        "# HELP raytpu_dup_total x\n"
+        "# TYPE raytpu_dup_total counter\n"
+        "# TYPE raytpu_dup_total counter\n"
+        "raytpu_dup_total 1\n"
+    )
+    problems = cm.check_exposition(bad)
+    assert any("other_counter_total" in p and "repo grammar" in p
+               for p in problems)
+    assert any("raytpu_bad.name" in p for p in problems)
+    assert any("duplicate family" in p for p in problems)
