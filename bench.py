@@ -241,20 +241,57 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
     # Arrival-rate LADDER: climb offered load until the system stops
     # completing ≥99% of it; the KNEE is the last sustainable point
     # and the headline TTFT is measured there, not past saturation.
+    # A rung whose TTFT p95 blows past 10x its p50 hit a bimodal stall
+    # (one-off compile, page thrash, preempted host) rather than a
+    # smooth queueing regime: flag it ``stalled`` and retry once — the
+    # flagged sample stays in the ladder for the record, the retry's
+    # numbers stand.  BENCH_r05's 1.14B rung (p95 203x p50, completion
+    # 0.116) is the motivating specimen — and it must NEVER be
+    # promoted to "knee" just for being the only rung measured: a
+    # ladder with no sustaining rung reports knee: null + saturated.
     ladder = []
-    rate = arrival_rate / 4.0
-    knee = None
-    for _ in range(6):
+
+    def probe(rate: float) -> dict:
         n = max(32, min(int(rate * 12), 192))
         point = open_loop_point(rate, n)
+        if (point["ttft_p50_ms"] > 0
+                and point["ttft_p95_ms"] > 10.0 * point["ttft_p50_ms"]):
+            point["stalled"] = True
+            ladder.append(point)
+            point = open_loop_point(rate, n)
+            point["retry_of_stalled"] = True
+            if (point["ttft_p50_ms"] > 0
+                    and point["ttft_p95_ms"]
+                    > 10.0 * point["ttft_p50_ms"]):
+                point["stalled"] = True  # reproduced: a real regime
         ladder.append(point)
+        return point
+
+    rate = arrival_rate / 4.0
+    knee = None
+    first_fail = None
+    for _ in range(6):
+        point = probe(rate)
         if point["completion"] >= 0.99:
             knee = point
             rate *= 1.5
         else:
+            first_fail = point
             break
-    if knee is None:  # even the lowest point saturated
-        knee = ladder[0]
+    # Refine the bracket between the last sustaining and the first
+    # failing rung down to <=1.25x spacing (geometric bisection), so
+    # the reported knee is within one fine rung of the true one.
+    if knee is not None and first_fail is not None:
+        lo = knee["offered_req_s"]
+        hi = first_fail["offered_req_s"]
+        while hi / lo > 1.25 and len(ladder) < 12:
+            mid = (lo * hi) ** 0.5
+            point = probe(mid)
+            if point["completion"] >= 0.99:
+                knee, lo = point, mid
+            else:
+                hi = mid
+    saturated = knee is None  # not even the lowest rung sustained
 
     # Burst: everything at once — the throughput ceiling.
     t0 = time.perf_counter()
@@ -264,23 +301,34 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
         s.result(timeout_s=600)
     burst_dt = time.perf_counter() - t0
     eng.shutdown()
+    # Headline open-loop numbers are AT THE KNEE (highest offered load
+    # still completing ≥99%), so TTFT never conflates service with
+    # queueing delay past saturation.  A saturated ladder (no rung
+    # sustained) has NO honest headline: those fields go null and the
+    # per-rung data lives in "ladder" — knee and saturated are
+    # mutually exclusive by construction (scripts/bench_schema.py
+    # enforces this on every record).
+    head = knee if knee is not None else {
+        "offered_req_s": None, "req_per_s": None,
+        "decode_tokens_per_s": None, "ttft_p50_ms": None,
+        "ttft_p95_ms": None}
     return {
-        # Headline open-loop numbers are AT THE KNEE (highest offered
-        # load still completing ≥99%), so TTFT never conflates service
-        # with queueing delay past saturation.
-        "arrival_rate_req_s": knee["offered_req_s"],
-        "req_per_s": knee["req_per_s"],
-        "decode_tokens_per_s": knee["decode_tokens_per_s"],
-        "ttft_p50_ms": knee["ttft_p50_ms"],
-        "ttft_p95_ms": knee["ttft_p95_ms"],
+        "arrival_rate_req_s": head["offered_req_s"],
+        "req_per_s": head["req_per_s"],
+        "decode_tokens_per_s": head["decode_tokens_per_s"],
+        "ttft_p50_ms": head["ttft_p50_ms"],
+        "ttft_p95_ms": head["ttft_p95_ms"],
         "ladder": ladder,
-        "knee_req_s": knee["offered_req_s"],
+        "knee_req_s": None if knee is None else knee["offered_req_s"],
+        "saturated": saturated,
         "burst_req_per_s": round(n_requests / burst_dt, 2),
         "burst_decode_tokens_per_s": round(n_requests * gen / burst_dt, 1),
         "prompt_len": prompt_len,
         "gen": gen,
         "slots": slots,
         "kv": "int8" if getattr(cfg, "kv_int8", False) else "bf16",
+        "decode_kernel": ("fused" if getattr(cfg, "fused_decode", False)
+                          else "unfused"),
     }
 
 
@@ -303,9 +351,14 @@ def _measure_8b(peak_flops: float) -> dict:
     # int8 KV pages (per-page scales): the bf16 pool at 24 slots was
     # 3.2 GB; int8 at 48 slots × 4 pages is 0.4 GB — double the slots
     # AND less HBM, with live-page decode reads halved.
+    # fused_decode: the per-layer megakernel (ops/fused_decode.py)
+    # collapses each layer's decode op graph into one Pallas program —
+    # the per-op dispatch latency it removes is what held 8B decode at
+    # 56% of the weight-read roofline in BENCH_r05.
     cfg8 = llama.LlamaConfig(
         vocab_size=128_256, dim=4096, n_layers=32, n_heads=32,
         n_kv_heads=8, mlp_dim=14336, max_seq_len=256, kv_int8=True,
+        fused_decode=True,
     )
     out: dict = {"params_b": round(cfg8.num_params() / 1e9, 2)}
 
@@ -543,7 +596,16 @@ def main():
         "vs_baseline": round(tps / baseline_tps, 3) if baseline_tps == baseline_tps else None,
         "extra": extra,
     }
-    print(json.dumps(result))
+    # The record survives two independent ways: BENCH_OUT.json on disk
+    # AND the final stdout line.  Driver wrappers have truncated the
+    # stdout capture mid-JSON before (BENCH_r05's "parsed": null);
+    # scripts/gen_perf_tables.py knows how to recover the last complete
+    # JSON line from such a wrapper, and the file copy makes even that
+    # unnecessary when the filesystem comes home.
+    blob = json.dumps(result)
+    with open("BENCH_OUT.json", "w") as f:
+        f.write(blob + "\n")
+    print(blob)
 
 
 if __name__ == "__main__":

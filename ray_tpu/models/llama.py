@@ -72,6 +72,13 @@ class LlamaConfig:
     # decode reads and doubles slot capacity per GB of HBM.  Serving
     # only (paged cache paths).
     kv_int8: bool = False
+    # Route decode_slots_paged through the per-layer fused megakernel
+    # (ops/fused_decode.py): RMSNorm -> qkv -> RoPE -> paged attention
+    # -> o-proj -> RMSNorm -> MLP in ONE Pallas program per layer,
+    # eliminating the per-op dispatch latency that dominates decode at
+    # small batches.  Falls back to the unfused path under
+    # tensor_parallel (the fused kernel is single-shard).
+    fused_decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -1033,6 +1040,9 @@ def decode_slots_paged(
     clone the multi-GB pools every layer/step (measured 10-30x off the
     weight-bandwidth roofline); read-only loop + single post-scan
     scatter is what lets the carried pools alias in place."""
+    if cfg.fused_decode and not cfg.tensor_parallel:
+        return decode_slots_paged_fused(
+            params, tokens, active, block_tables, lengths, cfg, cache)
     from ray_tpu.ops.paged_attention import (
         combine_with_self,
         paged_append,
@@ -1106,6 +1116,74 @@ def decode_slots_paged(
                                    v_news, pids, offs)
         new_cache = {"k": k_pool, "v": v_pool}
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = _head_matmul(x[:, 0], head, cfg)
+    return logits.astype(jnp.float32), new_cache, new_len
+
+
+def decode_slots_paged_fused(
+    params: Params,
+    tokens: jax.Array,
+    active: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array], jax.Array]:
+    """decode_slots_paged with the per-layer megakernel.
+
+    Same contract and same deferred-append design: pools are read-only
+    inside the scan, every layer's k/v rides out as scan ys, one
+    aliased append after the scan.  The difference is the scan body —
+    the whole per-layer op graph collapses into one
+    ops/fused_decode.fused_decode_layer call, so XLA sees a scan of
+    single kernels instead of ~15 small ops per layer."""
+    from ray_tpu.ops.fused_decode import fused_decode_layer
+    from ray_tpu.ops.paged_attention import (
+        paged_append,
+        paged_append_quantized,
+    )
+
+    quantized = "k_scale" in cache
+    page = cache["k"].shape[3]
+    new_len = jnp.where(active, lengths + 1, lengths)
+    sin, cos = rope_table(cfg, lengths[:, None])
+    sin, cos = sin[:, 0], cos[:, 0]                      # [B, hd//2]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)    # [B, D]
+    maxp = block_tables.shape[1]
+    scratch = cache["k"].shape[2] - 1
+    pids = jnp.take_along_axis(
+        block_tables, jnp.minimum(lengths // page, maxp - 1)[:, None],
+        axis=1)[:, 0]
+    pids = jnp.where(active, pids, jnp.int32(scratch))
+    offs = lengths % page
+
+    layer_fn = partial(
+        fused_decode_layer,
+        eps=cfg.norm_eps, n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads, soft_cap=cfg.logits_soft_cap,
+        k_scales=cache.get("k_scale"), v_scales=cache.get("v_scale"))
+
+    def body(carry, layer):
+        x, li = carry
+        x, k1, v1 = layer_fn(x, layer, cache["k"], cache["v"], li,
+                             block_tables, lengths, sin, cos)
+        return (x, li + 1), (k1, v1)
+
+    (x, _), (k_news, v_news) = lax.scan(
+        body, (x, jnp.int32(0)), params["layers"])
+    if quantized:
+        k_pool, v_pool, k_sc, v_sc = paged_append_quantized(
+            cache["k"], cache["v"], cache["k_scale"], cache["v_scale"],
+            k_news, v_news, pids, offs)
+        new_cache = {"k": k_pool, "v": v_pool, "k_scale": k_sc,
+                     "v_scale": v_sc}
+    else:
+        k_pool, v_pool = paged_append(cache["k"], cache["v"], k_news,
+                                      v_news, pids, offs)
+        new_cache = {"k": k_pool, "v": v_pool}
+    x = rms_norm(x[:, None], params["final_norm"], cfg.norm_eps)
     head = (params["tok_embed"].T if cfg.tie_embeddings
             else params["lm_head"])
     logits = _head_matmul(x[:, 0], head, cfg)

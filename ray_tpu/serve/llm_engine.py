@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import logging
 import queue
 import threading
 import time
+from collections import deque
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -34,7 +36,14 @@ import numpy as np
 
 from ray_tpu.util import tracing
 
+log = logging.getLogger(__name__)
+
 _TELEMETRY = None
+
+# A decode step slower than this many times its running median is a
+# stall worth shouting about (BENCH_r05's 1.14B collapse showed p95
+# TTFT 200x p50 with no engine-side signal of WHERE time went).
+STALL_FACTOR = 5.0
 
 
 def _telemetry():
@@ -69,6 +78,20 @@ def _telemetry():
                 "Active slots per decode dispatch (continuous-batch "
                 "occupancy).",
                 boundaries=[1, 2, 4, 8, 16, 32, 64],
+            ),
+            "step_wall": metrics.Gauge(
+                "raytpu_serve_step_wall_seconds",
+                "High-water mark of per-decode-step wall time "
+                "(dispatch-to-fetch wall of a chunk / steps in it — an "
+                "upper bound on device step time including pipeline "
+                "queueing).",
+            ),
+            "queue_age": metrics.Gauge(
+                "raytpu_serve_admission_queue_age_seconds",
+                "Age of the oldest request still waiting for admission "
+                "(waiting queue + paged backlog), sampled at dispatch "
+                "time.  Climbing age with stable depth = stalled "
+                "admission, not load.",
             ),
         }
     else:
@@ -415,6 +438,8 @@ class LLMEngine:
         self._steps = 0
         self._tokens_out = 0
         self._tm = _telemetry()
+        self._step_walls: deque = deque(maxlen=64)  # recent s/step
+        self._step_wall_hw = 0.0  # watermark mirrored to the gauge
 
         slots = config.max_slots
 
@@ -709,7 +734,8 @@ class LLMEngine:
         self._admitting = []
         self._state_dirty = True  # active/temps/bt/lens changed
         self._unprocessed += 1
-        self._fetchq.put(("prefill", toks_dev, 0, list(batch)))
+        self._fetchq.put(("prefill", toks_dev, 0, list(batch),
+                          time.monotonic()))
 
     def _alloc_slot_pages(self, req: Request,
                           need: Optional[int] = None) -> Optional[int]:
@@ -959,7 +985,8 @@ class LLMEngine:
         else:
             # Completion marker: counts against the pipeline depth.
             self._unprocessed += 1
-            self._fetchq.put(("pfchunk", toks_dev, 0, []))
+            self._fetchq.put(("pfchunk", toks_dev, 0, [],
+                              time.monotonic()))
 
     def _refresh_state_args(self) -> None:
         """Rebuild the per-slot control arrays only when admission or a
@@ -977,6 +1004,43 @@ class LLMEngine:
             self._bt_arg = np.array(self._bt)
             self._lens_arg = np.array(self._lens)
         self._state_dirty = False
+
+    def _admission_queue_age(self) -> float:
+        """Seconds since the oldest still-unadmitted request was
+        submitted (0.0 when nothing waits).  Snapshot over the waiting
+        queue's and backlog's internals — both only ever hold Request
+        objects and a stale read just shifts the gauge one sample."""
+        oldest = None
+        for req in list(self._waiting.queue) + (
+                list(self._backlog) if self._paged else []):
+            if oldest is None or req.submitted_at < oldest:
+                oldest = req.submitted_at
+        return 0.0 if oldest is None else time.monotonic() - oldest
+
+    def _note_step_time(self, wall_s: float, chunk: int) -> bool:
+        """Record a decode chunk's dispatch-to-fetch wall time as
+        per-step cost; returns True (and logs a warning) when the step
+        blows past STALL_FACTOR x its running median.  The median is
+        over the last 64 chunks, so a slow ramp moves the baseline
+        while a one-off stall (page thrash, preempted host, device
+        queue collapse) stands out."""
+        per_step = wall_s / max(chunk, 1)
+        history = sorted(self._step_walls)
+        self._step_walls.append(per_step)
+        if per_step > self._step_wall_hw:
+            self._step_wall_hw = per_step
+            self._tm["step_wall"].set(per_step)
+        if len(history) < 8:
+            return False
+        median = history[len(history) // 2]
+        if median > 0 and per_step > STALL_FACTOR * median:
+            log.warning(
+                "decode step stall: %.1f ms/step vs running median "
+                "%.1f ms (x%.1f, chunk=%d, active=%d)",
+                per_step * 1e3, median * 1e3, per_step / median,
+                chunk, len(self._slot_req))
+            return True
+        return False
 
     def _dispatch_decode(self, chunk: int) -> None:
         """Enqueue one decode chunk WITHOUT a host sync: cur and lens
@@ -1005,13 +1069,15 @@ class LLMEngine:
         self._tm["queue_depth"].set(
             self._waiting.qsize()
             + (len(self._backlog) if self._paged else 0))
+        self._tm["queue_age"].set(self._admission_queue_age())
         participants = list(self._slot_req.items())
         for slot, _req in participants:
             self._inflight_tokens[slot] = (
                 self._inflight_tokens.get(slot, 0) + chunk
             )
         self._unprocessed += 1
-        self._fetchq.put(("decode", toks_dev, chunk, participants))
+        self._fetchq.put(("decode", toks_dev, chunk, participants,
+                          time.monotonic()))
 
     def _fetch_loop(self) -> None:
         """Dedicated fetch thread: drain every queued entry, batch them
@@ -1053,8 +1119,10 @@ class LLMEngine:
                 raise item
             processed = True
             self._unprocessed -= 1
-            (kind, _dev, chunk, participants), toks = item
+            (kind, _dev, chunk, participants, t_disp), toks = item
             now = time.monotonic()
+            if kind == "decode":
+                self._note_step_time(now - t_disp, chunk)
             if kind == "pfchunk":
                 continue  # completion marker only (pipeline gating)
             if kind == "prefill":

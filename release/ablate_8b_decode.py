@@ -108,12 +108,35 @@ def main() -> int:
     no_attn = partial(_step, llama, cfg, bt, active, False, True, True)
     mlp_only = partial(_step, llama, cfg, bt, active, False, False, False)
 
-    run_variant("full", full)
-    run_variant("no-head", no_head)
-    run_variant("no-append", no_append)
+    # The fused megakernel replaces the whole per-layer op graph
+    # (ops/fused_decode.py) — same head and append as "full", so the
+    # difference is pure per-layer dispatch+glue savings.
+    cfg_fused = dataclasses.replace(cfg, fused_decode=True)
+
+    def fused_fn(params, cache, toks, lens):
+        logits, cache, new_len = llama.decode_slots_paged(
+            params, toks, active, bt, lens, cfg_fused, cache)
+        return jnp.argmax(logits, -1).astype(jnp.int32), cache, new_len
+
+    ms_full = run_variant("full", full)
+    ms_no_head = run_variant("no-head", no_head)
+    ms_no_append = run_variant("no-append", no_append)
     run_variant("no-attn-kernel", no_attn)
     run_variant("mlp+qkv only", mlp_only)
-    return 0
+    ms_fused = run_variant("fused megakernel", fused_fn)
+
+    # Per-layer attribution: head and append cost the same in both
+    # paths (shared code), so subtract them and divide by depth.
+    head_ms = max(ms_full - ms_no_head, 0.0)
+    append_ms = max(ms_full - ms_no_append, 0.0)
+    per_u = (ms_full - head_ms - append_ms) / cfg.n_layers
+    per_f = (ms_fused - head_ms - append_ms) / cfg.n_layers
+    print(f"per-layer unfused {per_u:.3f} ms   fused {per_f:.3f} ms   "
+          f"({'fused WINS' if per_f < per_u else 'fused LOSES'} "
+          f"{abs(per_u - per_f) * cfg.n_layers:.3f} ms/step at this "
+          f"depth; x32 = {abs(per_u - per_f) * 32:.2f} ms on the full "
+          f"model)")
+    return 0 if per_f < per_u else 1
 
 
 def _step(llama, cfg, bt, active, with_attn, with_append, with_head,

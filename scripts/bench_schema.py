@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Schema check for the bench record (BENCH_OUT.json / bench stdout).
+
+Hand-rolled validator (no jsonschema dependency) for the contract the
+rest of the tooling leans on: scripts/gen_perf_tables.py renders the
+README from these records, and the knee-honesty rules in bench.py are
+only worth anything if a record violating them cannot land silently.
+
+Checked:
+
+  * top level    — metric (str), value (number), unit (str),
+    extra (object) all present and typed;
+  * serving blocks (extra.serving, extra.serving_1b,
+    extra.llama_8b.serving_int8 — whichever exist and are not
+    {"error": ...}):
+      - required keys: ladder, knee_req_s, saturated, burst_req_per_s,
+        burst_decode_tokens_per_s, prompt_len, gen, slots, kv,
+        decode_kernel;
+      - knee-vs-saturated EXCLUSIVITY: saturated is true iff
+        knee_req_s is null (a collapsed ladder may never present a
+        rung as its knee, and a ladder with a knee may not also claim
+        saturation);
+      - headline fields (arrival_rate_req_s, req_per_s,
+        decode_tokens_per_s, ttft_p50_ms, ttft_p95_ms) are numbers at
+        a knee and null when saturated;
+      - each ladder rung has numeric offered_req_s / completion /
+        ttft_p50_ms / ttft_p95_ms.
+
+Usage:
+    python scripts/bench_schema.py BENCH_OUT.json
+Accepts a raw record or a driver wrapper ({"parsed": ..., "tail": ...}).
+Exit status 0 = clean, 1 = violations (listed on stderr).
+
+The tier-1 test (tests/test_bench_schema.py) calls validate_record()
+directly on synthetic records and on BENCH_OUT.json when present.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, List
+
+HEADLINE_KEYS = ("arrival_rate_req_s", "req_per_s",
+                 "decode_tokens_per_s", "ttft_p50_ms", "ttft_p95_ms")
+SERVING_REQUIRED = ("ladder", "knee_req_s", "saturated",
+                    "burst_req_per_s", "burst_decode_tokens_per_s",
+                    "prompt_len", "gen", "slots", "kv", "decode_kernel")
+RUNG_REQUIRED = ("offered_req_s", "completion", "ttft_p50_ms",
+                 "ttft_p95_ms")
+
+
+def _num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_serving(name: str, d: Any, problems: List[str]) -> None:
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    if "error" in d:  # bench leg failed; the record says so — valid
+        return
+    for k in SERVING_REQUIRED:
+        if k not in d:
+            problems.append(f"{name}: missing required key {k!r}")
+    knee = d.get("knee_req_s")
+    saturated = d.get("saturated")
+    if "saturated" in d and not isinstance(saturated, bool):
+        problems.append(f"{name}: saturated must be a bool, got "
+                        f"{type(saturated).__name__}")
+    elif "saturated" in d and "knee_req_s" in d:
+        if saturated and knee is not None:
+            problems.append(
+                f"{name}: saturated=true with knee_req_s={knee!r} — "
+                f"a ladder is either saturated or has a knee, not both")
+        if not saturated and knee is None:
+            problems.append(
+                f"{name}: saturated=false but knee_req_s is null — a "
+                f"non-saturated ladder must name its knee")
+        if saturated:
+            for k in HEADLINE_KEYS:
+                if d.get(k) is not None:
+                    problems.append(
+                        f"{name}: saturated record carries headline "
+                        f"{k}={d[k]!r} (must be null: no rung "
+                        f"sustained, so there is no honest headline)")
+        else:
+            for k in HEADLINE_KEYS:
+                if k in d and not _num(d[k]):
+                    problems.append(
+                        f"{name}: headline {k}={d[k]!r} is not a "
+                        f"number at a knee")
+    ladder = d.get("ladder")
+    if ladder is not None:
+        if not isinstance(ladder, list) or not ladder:
+            problems.append(f"{name}: ladder must be a non-empty list")
+        else:
+            for i, rung in enumerate(ladder):
+                if not isinstance(rung, dict):
+                    problems.append(f"{name}: ladder[{i}] not an object")
+                    continue
+                for k in RUNG_REQUIRED:
+                    if not _num(rung.get(k)):
+                        problems.append(
+                            f"{name}: ladder[{i}].{k} missing or "
+                            f"non-numeric: {rung.get(k)!r}")
+
+
+def validate_record(rec: Any) -> List[str]:
+    """Return a list of violations (empty = clean)."""
+    problems: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, expected an object"]
+    if not isinstance(rec.get("metric"), str):
+        problems.append("missing/non-string top-level key 'metric'")
+    if not _num(rec.get("value")):
+        problems.append("missing/non-numeric top-level key 'value'")
+    if not isinstance(rec.get("unit"), str):
+        problems.append("missing/non-string top-level key 'unit'")
+    extra = rec.get("extra")
+    if not isinstance(extra, dict):
+        problems.append("missing/non-object top-level key 'extra'")
+        return problems
+    for name, block in (("extra.serving", extra.get("serving")),
+                        ("extra.serving_1b", extra.get("serving_1b"))):
+        if block is not None:
+            _check_serving(name, block, problems)
+    b8 = extra.get("llama_8b")
+    if isinstance(b8, dict) and b8.get("serving_int8") is not None:
+        _check_serving("extra.llama_8b.serving_int8",
+                       b8["serving_int8"], problems)
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    path = argv[1] if len(argv) > 1 else "BENCH_OUT.json"
+    with open(path) as f:
+        rec = json.load(f)
+    if isinstance(rec, dict) and "parsed" in rec:
+        if rec["parsed"] is None:
+            print(f"bench_schema: {path}: driver wrapper with "
+                  f"parsed=null — validate the BENCH_OUT.json the run "
+                  f"wrote instead", file=sys.stderr)
+            return 1
+        rec = rec["parsed"]
+    problems = validate_record(rec)
+    for p in problems:
+        print(f"bench_schema: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print(f"bench_schema: {path} clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

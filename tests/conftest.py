@@ -52,6 +52,13 @@ def learning_table():
     return record
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy interpret-mode kernel tests, excluded from the "
+        "tier-1 `-m 'not slow'` sweep (run explicitly with -m slow)")
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
     if not _LEARNING_ROWS:
         return

@@ -1,0 +1,193 @@
+"""Bench-record contract: schema validation + damaged-record recovery.
+
+scripts/bench_schema.py guards the record bench.py emits (BENCH_OUT.json
++ final stdout line); scripts/gen_perf_tables.py must recover a record
+from a driver wrapper whose ``parsed`` is null — and fail loudly when
+the stdout tail was truncated mid-object (BENCH_r05's actual damage)."""
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load(name):
+    path = REPO / "scripts" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def schema():
+    return _load("bench_schema")
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return _load("gen_perf_tables")
+
+
+def _rung(rate, completion=1.0, p50=50.0, p95=80.0):
+    return {"offered_req_s": rate, "req_per_s": rate,
+            "completion": completion, "decode_tokens_per_s": rate * 32,
+            "ttft_p50_ms": p50, "ttft_p95_ms": p95}
+
+
+def _serving(knee=2.0, saturated=False):
+    head = ({k: None for k in ("arrival_rate_req_s", "req_per_s",
+                               "decode_tokens_per_s", "ttft_p50_ms",
+                               "ttft_p95_ms")}
+            if saturated else
+            {"arrival_rate_req_s": knee, "req_per_s": knee,
+             "decode_tokens_per_s": knee * 32, "ttft_p50_ms": 50.0,
+             "ttft_p95_ms": 80.0})
+    return dict(head, ladder=[_rung(1.0), _rung(2.0)],
+                knee_req_s=None if saturated else knee,
+                saturated=saturated, burst_req_per_s=9.0,
+                burst_decode_tokens_per_s=288.0, prompt_len=128,
+                gen=32, slots=48, kv="int8", decode_kernel="fused")
+
+
+def _record(**serving_kw):
+    return {"metric": "llama_319M_train_tokens_per_sec_per_chip",
+            "value": 1234.5, "unit": "tokens/sec/chip",
+            "extra": {"serving": _serving(**serving_kw)}}
+
+
+def test_valid_record_is_clean(schema):
+    assert schema.validate_record(_record()) == []
+
+
+def test_valid_saturated_record_is_clean(schema):
+    assert schema.validate_record(_record(saturated=True)) == []
+
+
+def test_missing_top_level_keys(schema):
+    rec = _record()
+    del rec["metric"]
+    rec["value"] = "fast"
+    probs = schema.validate_record(rec)
+    assert any("metric" in p for p in probs)
+    assert any("value" in p for p in probs)
+
+
+def test_knee_and_saturated_are_exclusive(schema):
+    rec = _record()
+    rec["extra"]["serving"]["saturated"] = True  # but knee_req_s set
+    probs = schema.validate_record(rec)
+    assert any("not both" in p for p in probs)
+
+    rec = _record(saturated=True)
+    rec["extra"]["serving"]["saturated"] = False  # but knee is null
+    probs = schema.validate_record(rec)
+    assert any("must name its knee" in p for p in probs)
+
+
+def test_saturated_record_may_not_carry_headline_numbers(schema):
+    rec = _record(saturated=True)
+    rec["extra"]["serving"]["ttft_p50_ms"] = 247.1
+    probs = schema.validate_record(rec)
+    assert any("headline" in p for p in probs)
+
+
+def test_ladder_rungs_must_be_numeric(schema):
+    rec = _record()
+    rec["extra"]["serving"]["ladder"][1]["completion"] = None
+    probs = schema.validate_record(rec)
+    assert any("ladder[1].completion" in p for p in probs)
+
+
+def test_error_leg_is_valid(schema):
+    rec = _record()
+    rec["extra"]["serving_1b"] = {"error": "RESOURCE_EXHAUSTED"}
+    assert schema.validate_record(rec) == []
+
+
+def test_bench_out_if_present(schema):
+    """Whatever BENCH_OUT.json the last bench run left behind must
+    satisfy the schema (skips when no run has happened here)."""
+    path = REPO / "BENCH_OUT.json"
+    if not path.exists():
+        pytest.skip("no BENCH_OUT.json in the repo")
+    rec = json.loads(path.read_text())
+    assert schema.validate_record(rec) == []
+
+
+def test_bench_main_emits_file_and_stdout_line(schema, tmp_path,
+                                               monkeypatch, capsys):
+    """bench.main() end-to-end (measurement stubbed): the record lands
+    in BENCH_OUT.json AND as the final stdout line, the two copies are
+    byte-identical JSON, and the record satisfies the schema."""
+    spec = importlib.util.spec_from_file_location("bench",
+                                                  REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setattr(bench, "_measure", lambda *a, **k: 1000.0)
+    monkeypatch.chdir(tmp_path)
+    bench.main()
+    lines = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(lines[-1])
+    file_rec = json.loads((tmp_path / "BENCH_OUT.json").read_text())
+    assert rec == file_rec
+    assert schema.validate_record(rec) == []
+
+
+# --- gen_perf_tables damaged-record recovery -------------------------------
+
+
+def test_recover_last_json_line(tables):
+    rec = _record()
+    wrapper = {"n": 6, "cmd": "python bench.py", "rc": 0, "parsed": None,
+               "tail": ("some warning line\n"
+                        '{"not": "the record"}\n'
+                        + json.dumps(rec) + "\n")}
+    got = tables.recover_record(wrapper)
+    assert got == rec
+
+
+def test_recovery_fails_loudly_on_truncated_tail(tables):
+    """BENCH_r05's damage: the tail starts mid-object, so no complete
+    JSON line survives — the script must die loudly, not guess."""
+    wrapper = {"parsed": None,
+               "tail": '_s": 21.64, "completion": 0.985}}}'}
+    with pytest.raises(SystemExit, match="no complete bench JSON"):
+        tables.recover_record(wrapper)
+
+
+def test_recovery_fails_loudly_on_real_r05_wrapper(tables):
+    wrapper = json.loads((REPO / "BENCH_r05.json").read_text())
+    assert wrapper["parsed"] is None
+    with pytest.raises(SystemExit):
+        tables.recover_record(wrapper, "BENCH_r05.json")
+
+
+def test_render_saturated_ladder_never_shows_a_knee(tables):
+    """Old records (no ``saturated`` key) with a collapsed ladder must
+    render as saturated, not present the lowest rung as the knee —
+    the exact mislabeling BENCH_r05's 1.14B row shipped with."""
+    legacy = {"burst_req_per_s": 5.0, "burst_decode_tokens_per_s": 160.0,
+              "slots": 32, "kv": "bf16", "knee_req_s": 3.0,
+              "arrival_rate_req_s": 3.0, "ttft_p50_ms": 247.1,
+              "ttft_p95_ms": 50156.4,
+              "ladder": [_rung(3.0, completion=0.116, p50=247.1,
+                               p95=50156.4)]}
+    rec = {"metric": "m", "value": 1.0, "unit": "u",
+           "extra": {"serving_1b": legacy}}
+    block = tables.render(rec)
+    row = next(l for l in block.splitlines() if "1.14B" in l)
+    assert "saturated" in row
+    assert "3.0" not in row and "247.1" not in row
+
+
+def test_render_fused_kernel_row_labeled(tables):
+    rec = _record()
+    block = tables.render(rec)
+    row = next(l for l in block.splitlines()
+               if "319M" in l and "slots" in l)
+    assert "fused decode" in row
+    assert "2.0" in row  # the knee rate
