@@ -556,7 +556,8 @@ class NodeServer:
             return None
         if op == "log_batch":
             self._rt.ingest_logs(agent.node_hex or "?", msg["file"],
-                                 msg.get("lines") or [])
+                                 msg.get("lines") or [],
+                                 truncated=msg.get("truncated", False))
             return None
         if op == "heartbeat":
             return time.time()
@@ -924,10 +925,12 @@ class NodeDaemon:
     def wait(self) -> None:
         self._exit.wait()
 
-    def _publish_logs(self, file: str, lines: List[str]) -> None:
+    def _publish_logs(self, file: str, lines: List[str],
+                      truncated: bool = False) -> None:
         # Best-effort cast: log lines are droppable while the head is
         # away (the local files keep everything).
-        self.head.cast("log_batch", file=file, lines=lines)
+        self.head.cast("log_batch", file=file, lines=lines,
+                       truncated=truncated)
 
     def shutdown(self) -> None:
         self._exit.set()

@@ -3487,15 +3487,18 @@ class LocalRuntime:
 
     # -- log plane ---------------------------------------------------------
 
-    def _publish_local_logs(self, file: str, lines: List[str]) -> None:
-        self.ingest_logs("head", file, lines)
+    def _publish_local_logs(self, file: str, lines: List[str],
+                            truncated: bool = False) -> None:
+        self.ingest_logs("head", file, lines, truncated=truncated)
 
     def ingest_logs(self, node: str, file: str,
-                    lines: List[str]) -> None:
+                    lines: List[str], truncated: bool = False) -> None:
         """One batch of worker log lines into the head buffer (+ echo
         to the driver console — parity: ray's log_to_driver prefixing
-        lines with their producing worker/node)."""
-        self.logs.ingest(node, file, lines)
+        lines with their producing worker/node).  ``truncated`` marks a
+        stream whose file was rotated/truncated mid-tail (these lines
+        are a readable suffix)."""
+        self.logs.ingest(node, file, lines, truncated=truncated)
         # Publish only once someone has pulled the channel: with no
         # subscriber the ring would duplicate LogBuffer's retention and
         # every batch would wake all other channels' waiters for nothing.

@@ -594,10 +594,25 @@ class _WorkerServer:
             return self._cancel(msg["task"])
         if op == "ping":
             return "pong"
+        if op == "profile":
+            # Blocking is fine: MsgChannel runs handlers on a pooled
+            # thread per request, so tasks keep flowing during capture.
+            return self._profile(msg)
         if op == "exit":
             self._exit.set()
             return None
         raise ValueError(f"unknown driver op {op!r}")
+
+    @staticmethod
+    def _profile(msg: Dict[str, Any]) -> List[str]:
+        """One bounded jax.profiler capture in THIS worker (the fan-out
+        target of the dashboard's POST /api/v0/profile).  Unavailable
+        profiler → empty list, never an error reply."""
+        from ray_tpu.util import xprof
+
+        paths = xprof.capture(float(msg.get("duration_s", 1.0)),
+                              msg.get("out_dir"))
+        return paths or []
 
     def _cancel(self, task_bin: bytes) -> None:
         from ray_tpu.core.exceptions import TaskCancelledError

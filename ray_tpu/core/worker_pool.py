@@ -433,6 +433,13 @@ class WorkerPool:
         with self._lock:
             return {"workers": len(self._all), "idle": len(self._idle)}
 
+    def all_workers(self) -> List[WorkerHandle]:
+        """Snapshot of every live worker, idle or busy — the fan-out
+        set for cluster-wide control ops (xprof's distributed profiler
+        capture)."""
+        with self._lock:
+            return [wh for wh in self._all.values() if not wh.dead]
+
     # -- nested-API dispatch (worker → driver) -----------------------------
 
     def _handle(self, chan: MsgChannel, msg: Dict[str, Any]) -> Any:

@@ -27,6 +27,10 @@ metrics):
   GET /api/v0/logs/index         available (node, file) log streams
   GET /timeline                  Chrome trace JSON
   GET /metrics                   Prometheus text exposition
+  POST /api/v0/profile           {duration_s} → distributed
+                                 jax.profiler capture (driver + every
+                                 pool worker), replies with the
+                                 collected trace paths (util/xprof)
 """
 
 from __future__ import annotations
@@ -67,6 +71,17 @@ class _Handler(BaseHTTPRequestHandler):
             if url.path in ("/", "/index.html"):
                 self._send(_INDEX.encode(), "text/html")
             elif url.path == "/metrics":
+                try:
+                    # Scrape-time refresh of the device plane (the
+                    # repo's gauge-callback pattern): roofline joins +
+                    # HBM watermarks reflect the spans/devices as of
+                    # THIS scrape.
+                    from ray_tpu.util import xprof
+
+                    xprof.roofline()
+                    xprof.sample_device_memory()
+                except Exception:
+                    pass
                 self._send(_metrics.export_prometheus().encode(),
                            "text/plain; version=0.0.4")
             elif not api.is_initialized():
@@ -108,11 +123,17 @@ class _Handler(BaseHTTPRequestHandler):
                     limit=limit)})
             elif url.path == "/api/v0/logs":
                 rt = api.runtime()
-                self._json({"result": rt.logs.query(
-                    node=(qs.get("node") or [None])[0],
-                    file=(qs.get("file") or [None])[0],
-                    tail=int((qs.get("tail") or ["500"])[0]),
-                )})
+                node = (qs.get("node") or [None])[0]
+                file = (qs.get("file") or [None])[0]
+                self._json({
+                    "result": rt.logs.query(
+                        node=node, file=file,
+                        tail=int((qs.get("tail") or ["500"])[0]),
+                    ),
+                    # True when a queried stream was rotated/truncated
+                    # mid-tail: the rows are the readable suffix.
+                    "truncated": rt.logs.was_truncated(node, file),
+                })
             elif url.path == "/api/v0/logs/index":
                 self._json({"result": api.runtime().logs.index()})
             elif url.path == "/timeline":
@@ -144,6 +165,27 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._json(_api.get(controller.status.remote()))
 
+    def _profile(self, body) -> None:
+        """POST /api/v0/profile {duration_s}: one on-demand distributed
+        jax.profiler capture — driver process + every pool worker —
+        replying with the collected trace paths.  The handler blocks
+        for the capture window; ThreadingHTTPServer keeps other routes
+        responsive meanwhile."""
+        from ray_tpu.core import api
+        from ray_tpu.util import xprof
+
+        if not api.is_initialized():
+            self._json({"error": "runtime not initialized"}, 503)
+            return
+        try:
+            duration = float(body.get("duration_s", 1.0))
+        except (TypeError, ValueError):
+            self._json({"error": "duration_s must be a number"}, 400)
+            return
+        duration = min(max(duration, 0.0), 60.0)
+        traces = xprof.distributed_capture(duration)
+        self._json({"duration_s": duration, "traces": traces})
+
     # -- job REST routes (parity: dashboard/modules/job/job_head.py) -------
 
     def _jobs_get(self, path: str) -> None:
@@ -169,15 +211,18 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802 (stdlib handler API)
         import dataclasses  # noqa: F401
 
-        from ray_tpu.job_submission import job_manager
-
         url = urlparse(self.path)
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(length) or b"{}") \
                 if length else {}
-            jm = job_manager()
             parts = [p for p in url.path.split("/") if p]
+            if url.path == "/api/v0/profile":
+                self._profile(body)
+                return
+            from ray_tpu.job_submission import job_manager
+
+            jm = job_manager()
             if parts[:2] == ["api", "jobs"] and len(parts) == 2:
                 sid = jm.submit_job(
                     entrypoint=body["entrypoint"],
@@ -254,7 +299,21 @@ class _Server(ThreadingHTTPServer):
             return list(self._hist)
 
     def stop_sampler(self) -> None:
+        """Stop AND join the sampler: a merely-signalled daemon thread
+        can still be mid-sample at interpreter teardown (or holding the
+        runtime alive in a test), so the stop is not done until the
+        thread is."""
         self._sampler_stop.set()
+        t = self._sampler
+        if t is not None and t.is_alive():
+            t.join(timeout=self._period + 2.0)
+        self._sampler = None
+
+    def server_close(self) -> None:
+        # Every close path (DashboardHead.stop, bare server_close in
+        # tests/teardowns) must take the sampler down with the server.
+        self.stop_sampler()
+        super().server_close()
 
 
 class DashboardHead:

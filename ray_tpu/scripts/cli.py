@@ -34,6 +34,15 @@ def _get_json(address: str, path: str) -> Any:
         return json.loads(r.read())
 
 
+def _post_json(address: str, path: str, payload: Dict[str, Any],
+               timeout: float = 10.0) -> Any:
+    req = urllib.request.Request(
+        address + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
 def _print_table(rows: List[Dict[str, Any]], columns: List[str],
                  out) -> None:
     if not rows:
@@ -151,6 +160,22 @@ def cmd_timeline(args, out) -> int:
     print(f"wrote {len(events)} events to {args.output} "
           f"(open in chrome://tracing or Perfetto)", file=out)
     return 0
+
+
+def cmd_profile(args, out) -> int:
+    """On-demand distributed device profiling: POST /api/v0/profile
+    fans a jax.profiler capture to the driver + every pool worker and
+    returns the collected trace paths (open the .trace.json.gz in
+    Perfetto)."""
+    payload = _post_json(_address(args), "/api/v0/profile",
+                         {"duration_s": args.duration},
+                         timeout=args.duration + 60.0)
+    traces = payload.get("traces", [])
+    for t in traces:
+        print(t, file=out)
+    print(f"captured {len(traces)} trace file(s) over "
+          f"{payload.get('duration_s', args.duration):g}s", file=out)
+    return 0 if traces else 1
 
 
 def cmd_memory(args, out) -> int:
@@ -290,6 +315,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ray_tpu",
         description="ray_tpu cluster CLI (see `<cmd> -h`)",
+        epilog="commands: status, list, summary, up, logs, timeline, "
+               "profile (on-demand jax.profiler capture on every "
+               "worker), memory, job, serve, start",
     )
     p.add_argument("--address", default=None,
                    help="dashboard address of the cluster "
@@ -321,6 +349,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     tp = sub.add_parser("timeline", help="dump Chrome trace of tasks")
     tp.add_argument("--output", "-o", default="timeline.json")
+
+    pp = sub.add_parser(
+        "profile",
+        help="capture a jax.profiler trace on the driver + every "
+             "worker (POST /api/v0/profile)")
+    pp.add_argument("--duration", type=float, default=2.0,
+                    help="capture window in seconds (clamped to 60)")
 
     mp = sub.add_parser("memory", help="object store contents")
     mp.add_argument("--limit", type=int, default=1000)
@@ -378,6 +413,7 @@ _DISPATCH = {
     "logs": cmd_logs,
     "up": cmd_up,
     "timeline": cmd_timeline,
+    "profile": cmd_profile,
     "memory": cmd_memory,
     "job": cmd_job,
     "serve": cmd_serve,

@@ -440,6 +440,7 @@ class LLMEngine:
         self._tm = _telemetry()
         self._step_walls: deque = deque(maxlen=64)  # recent s/step
         self._step_wall_hw = 0.0  # watermark mirrored to the gauge
+        self._xprof_recorded: set = set()  # programs already registered
 
         slots = config.max_slots
 
@@ -689,6 +690,35 @@ class LLMEngine:
         out[n_real:] = self.config.max_slots
         return out
 
+    def _instrumented_dispatch(self, name, fn, args, span_name,
+                               steps_attr=None):
+        """Dispatch one jitted program; the FIRST dispatch of each
+        named program also registers it in the device plane
+        (util/xprof): lowered cost analysis must happen before the call
+        (the program donates its cache — afterwards those buffers are
+        deleted), while the timed call itself measures trace+compile
+        wall.  Later dispatches pass straight through."""
+        if name in self._xprof_recorded:
+            return fn(*args)
+        self._xprof_recorded.add(name)
+        lowered = None
+        try:
+            lowered = fn.lower(*args)
+        except Exception:
+            pass
+        t0 = time.time()
+        out = fn(*args)
+        if lowered is not None:
+            try:
+                from ray_tpu.util import xprof
+
+                xprof.record_compiled(
+                    name, lowered, compile_time_s=time.time() - t0,
+                    span_name=span_name, steps_attr=steps_attr)
+            except Exception:
+                pass  # device-plane attribution is best-effort
+        return out
+
     def _run_prefill(self, k, tokens, true_lens, slot_or_pages, temps,
                      slot_ids):
         """One admission dispatch: batched [K, S] forward when the
@@ -699,17 +729,22 @@ class LLMEngine:
         must still fail these not-yet-registered requests."""
         if self._prefill_batched_fn is not None:
             self._cache, toks_dev, self._cur_dev = \
-                self._prefill_batched_fn(
-                    self._params, self._cache, tokens, true_lens,
-                    slot_or_pages, temps, self._next_seed(),
-                    self._cur_dev, slot_ids,
+                self._instrumented_dispatch(
+                    "serve.prefill", self._prefill_batched_fn,
+                    (self._params, self._cache, tokens, true_lens,
+                     slot_or_pages, temps, self._next_seed(),
+                     self._cur_dev, slot_ids),
+                    span_name="llm.prefill",
                 )
         else:
-            self._cache, toks_dev, self._cur_dev = self._prefill_batch_fn(
-                k, self._params, self._cache, tokens, true_lens,
-                slot_or_pages, temps, self._next_seed(),
-                self._cur_dev, slot_ids,
-            )
+            self._cache, toks_dev, self._cur_dev = \
+                self._instrumented_dispatch(
+                    "serve.prefill", self._prefill_batch_fn,
+                    (k, self._params, self._cache, tokens, true_lens,
+                     slot_or_pages, temps, self._next_seed(),
+                     self._cur_dev, slot_ids),
+                    span_name="llm.prefill",
+                )
         return toks_dev
 
     def _finish_admit(self, batch, toks_dev, slot_ids) -> None:
@@ -1051,19 +1086,25 @@ class LLMEngine:
         self._refresh_state_args()
         if self._paged:
             self._cache, toks_dev, self._cur_dev, self._lens_arg = \
-                self._decode_fn(
-                    chunk, self._params, self._cache, self._cur_dev,
-                    self._active_arg, self._temps_arg,
-                    self._next_seed(), self._bt_arg, self._lens_arg,
+                self._instrumented_dispatch(
+                    "serve.decode", self._decode_fn,
+                    (chunk, self._params, self._cache, self._cur_dev,
+                     self._active_arg, self._temps_arg,
+                     self._next_seed(), self._bt_arg, self._lens_arg),
+                    span_name="llm.decode", steps_attr="tokens",
                 )
             # Host mirror advances for slots active in THIS dispatch.
             for slot in self._slot_req:
                 self._lens[slot] += chunk
         else:
-            self._cache, toks_dev, self._cur_dev, _ = self._decode_fn(
-                chunk, self._params, self._cache, self._cur_dev,
-                self._active_arg, self._temps_arg, self._next_seed(),
-            )
+            self._cache, toks_dev, self._cur_dev, _ = \
+                self._instrumented_dispatch(
+                    "serve.decode", self._decode_fn,
+                    (chunk, self._params, self._cache, self._cur_dev,
+                     self._active_arg, self._temps_arg,
+                     self._next_seed()),
+                    span_name="llm.decode", steps_attr="tokens",
+                )
         self._steps += chunk
         self._tm["batch_size"].observe(len(self._slot_req))
         self._tm["queue_depth"].set(

@@ -54,12 +54,29 @@ def _instrument_first_call(jitted):
     def wrapped(state, batch):
         if compiled:
             return jitted(state, batch)
+        # Lower BEFORE executing: the step donates ``state``, so after
+        # the call those buffers are gone and cost analysis would have
+        # nothing to trace against.
+        lowered = None
+        try:
+            lowered = jitted.lower(state, batch)
+        except Exception:
+            pass
         t0 = time.time()
         out = jitted(state, batch)
         compiled.append(True)
         elapsed = time.time() - t0
         _telemetry()["compile"].inc(elapsed)
         tracing.record_span("train.compile", t0, t0 + elapsed)
+        if lowered is not None:
+            try:
+                from ray_tpu.util import xprof
+
+                xprof.record_compiled(
+                    "train.step", lowered, compile_time_s=elapsed,
+                    span_name="train.compute")
+            except Exception:
+                pass  # device-plane attribution is best-effort
         return out
 
     wrapped.__wrapped__ = jitted

@@ -28,7 +28,7 @@ from ray_tpu.parallel.sharding import Rules
 from ray_tpu.train.checkpoint import CheckpointManager
 from ray_tpu.train.state import TrainState, create_train_state, default_optimizer
 from ray_tpu.train.step import compile_train_step
-from ray_tpu.util import tracing
+from ray_tpu.util import tracing, xprof
 
 _TELEMETRY = None
 
@@ -63,40 +63,12 @@ def _telemetry():
                 "raytpu_train_checkpoints_total",
                 "Checkpoints written by the trainer.",
             ),
-            "mem_in_use": metrics.Gauge(
-                "raytpu_train_device_mem_bytes_in_use",
-                "Device memory currently allocated, by local device.",
-                tag_keys=("device",),
-            ),
-            "mem_peak": metrics.Gauge(
-                "raytpu_train_device_mem_bytes_peak",
-                "Device memory high watermark, by local device.",
-                tag_keys=("device",),
-            ),
         }
     else:
         reg = metrics.registry()
         for m in _TELEMETRY.values():
             reg.register(m)
     return _TELEMETRY
-
-
-def _record_device_memory(tm) -> None:
-    """Device memory watermarks → gauges.  TPU/GPU backends expose
-    memory_stats(); CPU returns None/raises — then the gauges simply
-    never appear."""
-    for d in jax.local_devices():
-        try:
-            stats = d.memory_stats()
-        except Exception:
-            return
-        if not stats:
-            continue
-        tags = {"device": f"{d.platform}:{d.id}"}
-        if "bytes_in_use" in stats:
-            tm["mem_in_use"].set(stats["bytes_in_use"], tags=tags)
-        if "peak_bytes_in_use" in stats:
-            tm["mem_peak"].set(stats["peak_bytes_in_use"], tags=tags)
 
 
 @dataclasses.dataclass
@@ -248,7 +220,9 @@ class JaxTrainer:
                                 time.perf_counter() - t0)
                             history.append(m)
                             last_metrics = m
-                            _record_device_memory(tm)
+                            # Shared device-plane sampler (TPU/GPU HBM
+                            # watermarks; absent on CPU backends).
+                            xprof.sample_device_memory()
                             if report:
                                 report(m)
                         if ckpt and rc.checkpoint_every \

@@ -33,12 +33,33 @@ class LogBuffer:
         self._lock = threading.Lock()
         self._seq = 0
         self._lines: deque = deque(maxlen=max_lines)
+        # (node, file) streams whose tail was rotated/truncated at some
+        # point: their buffered lines are a readable suffix, not the
+        # whole file — surfaced as the /api/v0/logs ``truncated`` flag.
+        self._truncated: set = set()
 
-    def ingest(self, node: str, file: str, lines: List[str]) -> None:
+    def ingest(self, node: str, file: str, lines: List[str],
+               truncated: bool = False) -> None:
         with self._lock:
+            if truncated:
+                self._truncated.add((node, file))
             for ln in lines:
                 self._seq += 1
                 self._lines.append((self._seq, node, file, ln))
+
+    def was_truncated(self, node: Optional[str] = None,
+                      file: Optional[str] = None) -> bool:
+        """Whether any stream matching the (prefix/substring) filters
+        ever lost bytes to rotation/truncation."""
+        with self._lock:
+            marks = list(self._truncated)
+        for n, f in marks:
+            if node and not n.startswith(node):
+                continue
+            if file and file not in f:
+                continue
+            return True
+        return False
 
     def query(self, node: Optional[str] = None, file: Optional[str] = None,
               tail: int = 500,
@@ -70,15 +91,24 @@ class LogBuffer:
 class LogMonitor:
     """Tails every ``*.out``/``*.err`` file in one directory and
     publishes complete new lines (parity: LogMonitor's open-file loop,
-    log_monitor.py:40 — offsets per file, partial lines held back)."""
+    log_monitor.py:40 — offsets per file, partial lines held back).
+
+    ``publish(file, lines, truncated)`` — ``truncated`` is True when
+    the file shrank under the saved offset (rotation / truncation
+    mid-read): the offset resets and the published lines are the
+    readable suffix, so the tail recovers instead of wedging past
+    EOF."""
 
     def __init__(self, directory: str,
-                 publish: Callable[[str, List[str]], None],
+                 publish: Callable[[str, List[str], bool], None],
                  period_s: float = 0.3):
         self._dir = directory
         self._publish = publish
         self._period = period_s
         self._offsets: Dict[str, int] = {}
+        # Files that shrank but whose post-shrink suffix hasn't been
+        # published yet (no complete line at the time of detection).
+        self._pending_trunc: set = set()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="log-monitor")
@@ -101,6 +131,14 @@ class LogMonitor:
             off = self._offsets.get(name, 0)
             try:
                 size = os.path.getsize(path)
+                if size < off:
+                    # The file shrank under us (rotation or truncation
+                    # mid-read).  Restart from the top and publish the
+                    # readable suffix — a stuck past-EOF offset would
+                    # silence the stream forever.
+                    off = 0
+                    self._offsets[name] = 0
+                    self._pending_trunc.add(name)
                 if size <= off:
                     continue
                 with open(path, "rb") as f:
@@ -116,9 +154,11 @@ class LogMonitor:
             self._offsets[name] = off + last_nl + 1
             lines = chunk[:last_nl].decode("utf-8", "replace").split("\n")
             try:
-                self._publish(name, lines)
+                self._publish(name, lines,
+                              name in self._pending_trunc)
             except Exception:
                 pass  # publishing must never kill the tail loop
+            self._pending_trunc.discard(name)
 
     def stop(self) -> None:
         self._stop.set()

@@ -117,13 +117,15 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict[str, Any]]]:
     """Chrome trace of every recorded task attempt (parity: `ray
     timeline`, python/ray/_private/state.py:434 chrome_tracing_dump),
     merged with the tracer's finished spans so serve/data/train library
-    phases land in the same Perfetto view as the tasks they ran.
+    phases land in the same Perfetto view as the tasks they ran, plus
+    the device plane's per-device program rows (util/xprof).
     Returns the event list, or writes it to ``filename`` if given."""
     from ray_tpu.core.events import spans_to_chrome_events
-    from ray_tpu.util import tracing
+    from ray_tpu.util import tracing, xprof
 
     events = (_runtime().events.chrome_tracing_dump()
-              + spans_to_chrome_events(tracing.finished_spans()))
+              + spans_to_chrome_events(tracing.finished_spans())
+              + xprof.device_timeline_events())
     if filename is None:
         return events
     import json

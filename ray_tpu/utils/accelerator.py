@@ -33,6 +33,38 @@ _JAX_PLATFORM_VERSIONS = {
     "tpu v6e": GOOGLE_TPU_V6E,
 }
 
+# Per-chip peak dense bf16 flops and HBM bandwidth (public spec
+# sheets) — the denominators of the device-plane roofline
+# (util/xprof.roofline).
+_CHIP_SPECS = {
+    GOOGLE_TPU_V4: {"peak_flops": 275e12,
+                    "peak_hbm_bytes_per_s": 1228e9},
+    GOOGLE_TPU_V5E: {"peak_flops": 197e12,
+                     "peak_hbm_bytes_per_s": 819e9},
+    GOOGLE_TPU_V5P: {"peak_flops": 459e12,
+                     "peak_hbm_bytes_per_s": 2765e9},
+    GOOGLE_TPU_V6E: {"peak_flops": 918e12,
+                     "peak_hbm_bytes_per_s": 1640e9},
+}
+
+# Nominal one-core CPU envelope so roofline math still runs end to end
+# off-TPU (utilization numbers against it are order-of-magnitude only;
+# the point is exercising the same code path tier-1 tests cover).
+_CPU_FALLBACK_SPEC = {"peak_flops": 100e9,
+                      "peak_hbm_bytes_per_s": 50e9}
+
+
+def chip_spec(version: Optional[str] = None) -> Dict[str, float]:
+    """Peak flops + HBM bandwidth for one chip: ``{"chip", "peak_flops",
+    "peak_hbm_bytes_per_s"}``.  ``version`` defaults to the detected
+    TPU version; unknown/absent hardware gets the nominal CPU fallback
+    so callers never branch on None."""
+    version = version or tpu_version()
+    spec = _CHIP_SPECS.get(version)
+    if spec is None:
+        return {"chip": version or "cpu", **_CPU_FALLBACK_SPEC}
+    return {"chip": version, **spec}
+
 
 def num_tpu_chips() -> int:
     """Chips visible to this host (parity: accelerator.py chip count —

@@ -1,0 +1,68 @@
+"""CLI parser smoke tests: build the full argparse tree and run every
+subcommand's ``--help`` without a cluster, so a parser regression (a
+renamed flag, a subcommand dropped from the tree or from _DISPATCH)
+fails in CI before anyone hits it at a terminal.
+"""
+
+import argparse
+import io
+
+import pytest
+
+from ray_tpu.scripts.cli import _DISPATCH, build_parser
+
+
+def _subcommands(parser):
+    """Top-level subcommand names + their parsers."""
+    for action in parser._actions:
+        if isinstance(action, argparse._SubParsersAction):
+            return dict(action.choices)
+    raise AssertionError("CLI has no subparsers")
+
+
+def test_every_subcommand_is_dispatchable():
+    subs = _subcommands(build_parser())
+    assert set(subs) == set(_DISPATCH), (
+        "parser tree and _DISPATCH disagree")
+    assert "profile" in subs  # the device-plane capture command
+
+
+def test_top_level_help_mentions_profile(capsys):
+    with pytest.raises(SystemExit) as ei:
+        build_parser().parse_args(["--help"])
+    assert ei.value.code == 0
+    assert "profile" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("cmd", sorted(_DISPATCH))
+def test_subcommand_help_exits_zero(cmd, capsys):
+    with pytest.raises(SystemExit) as ei:
+        build_parser().parse_args([cmd, "--help"])
+    assert ei.value.code == 0
+    assert capsys.readouterr().out  # rendered some usage text
+
+
+@pytest.mark.parametrize("argv", [
+    ["job", "submit", "--help"],
+    ["job", "status", "--help"],
+    ["serve", "deploy", "--help"],
+    ["serve", "status", "--help"],
+])
+def test_nested_subcommand_help(argv, capsys):
+    with pytest.raises(SystemExit) as ei:
+        build_parser().parse_args(argv)
+    assert ei.value.code == 0
+
+
+def test_profile_parser_defaults():
+    args = build_parser().parse_args(["profile"])
+    assert args.cmd == "profile"
+    assert args.duration == pytest.approx(2.0)
+    args = build_parser().parse_args(["profile", "--duration", "7.5"])
+    assert args.duration == pytest.approx(7.5)
+
+
+def test_unknown_command_exits_nonzero(capsys):
+    with pytest.raises(SystemExit) as ei:
+        build_parser().parse_args(["definitely-not-a-command"])
+    assert ei.value.code != 0

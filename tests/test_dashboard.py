@@ -111,3 +111,34 @@ def test_actor_drilldown_and_metrics_history(dash):
     assert point is not None, "sampler never saw the finished tasks"
     assert point["total"]["CPU"] == 2.0
     assert 0.0 <= point["used"]["CPU"] <= 2.0
+
+
+def test_sampler_is_daemon_and_stops_on_server_close():
+    """Regression: the metrics-history sampler must be a daemon thread
+    that every close path actually joins — a live sampler after
+    server_close() kept test runs and `raytpu up` teardowns hanging."""
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    d = start_dashboard()
+    try:
+        sampler = d._server._sampler
+        assert sampler is not None and sampler.is_alive()
+        assert sampler.daemon
+    finally:
+        d.stop()
+        ray_tpu.shutdown()
+    assert not sampler.is_alive()
+    assert d._server._sampler is None
+
+    # A bare server_close (no stop_sampler call first) also takes the
+    # sampler down.
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    d = start_dashboard()
+    try:
+        sampler = d._server._sampler
+        assert sampler.is_alive()
+        d._server.shutdown()
+        d._server.server_close()
+        assert not sampler.is_alive()
+    finally:
+        d.stop()
+        ray_tpu.shutdown()

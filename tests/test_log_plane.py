@@ -151,3 +151,94 @@ def test_logs_rest_and_cli(rt):
         assert rc == 0 and "worker-" in out.getvalue()
     finally:
         dash.stop()
+
+
+def test_log_file_truncated_between_polls(tmp_path):
+    """A log file rotated/truncated mid-tail must not wedge the
+    monitor: the offset resets, the readable suffix is published, and
+    the stream is flagged truncated."""
+    from ray_tpu.util.log_monitor import LogBuffer, LogMonitor
+
+    buf = LogBuffer()
+    published = []
+
+    def publish(file, lines, truncated):
+        published.append((file, lines, truncated))
+        buf.ingest("head", file, lines, truncated=truncated)
+
+    mon = LogMonitor(str(tmp_path), publish, period_s=3600)
+    try:
+        path = tmp_path / "worker-a.out"
+        path.write_text("one\ntwo\n")
+        mon.scan_once()
+        assert published[-1] == ("worker-a.out", ["one", "two"], False)
+        assert not buf.was_truncated()
+
+        # Rotation: the file shrinks below the saved offset.
+        path.write_text("new\n")
+        mon.scan_once()
+        assert published[-1] == ("worker-a.out", ["new"], True)
+        assert buf.was_truncated()
+        assert buf.was_truncated(node="head", file="worker-a.out")
+        assert not buf.was_truncated(file="worker-b.out")
+
+        # The tail keeps flowing (and is no longer marked truncated).
+        with path.open("a") as f:
+            f.write("after\n")
+        mon.scan_once()
+        assert published[-1] == ("worker-a.out", ["after"], False)
+    finally:
+        mon.stop()
+
+
+def test_truncation_with_no_complete_line_is_not_lost(tmp_path):
+    """Shrink to a partial line: the flag must survive until the next
+    complete-line publish instead of silently vanishing."""
+    from ray_tpu.util.log_monitor import LogMonitor
+
+    published = []
+    mon = LogMonitor(str(tmp_path), lambda f, ls, t:
+                     published.append((f, ls, t)), period_s=3600)
+    try:
+        path = tmp_path / "worker-b.out"
+        path.write_text("aaaa\nbbbb\n")
+        mon.scan_once()
+        path.write_text("cc")  # shrunk, and no newline yet
+        mon.scan_once()
+        assert published[-1][2] is False  # nothing new published yet
+        with path.open("a") as f:
+            f.write("dd\n")
+        mon.scan_once()
+        assert published[-1] == ("worker-b.out", ["ccdd"], True)
+    finally:
+        mon.stop()
+
+
+def test_logs_rest_truncated_flag(rt):
+    """/api/v0/logs carries the stream-level truncated flag end to
+    end (and keeps serving rows, not a 500)."""
+    import json
+
+    from ray_tpu.dashboard import DashboardHead
+
+    rt.ingest_logs("head", "worker-t.out", ["before"])
+    dash = DashboardHead(port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"{dash.address}/api/v0/logs?file=worker-t.out") as r:
+            payload = json.load(r)
+        assert payload["truncated"] is False
+        rt.ingest_logs("head", "worker-t.out", ["suffix"],
+                       truncated=True)
+        with urllib.request.urlopen(
+                f"{dash.address}/api/v0/logs?file=worker-t.out") as r:
+            payload = json.load(r)
+        assert payload["truncated"] is True
+        assert [row["line"] for row in payload["result"]] \
+            == ["before", "suffix"]
+        # Other streams stay unflagged.
+        with urllib.request.urlopen(
+                f"{dash.address}/api/v0/logs?file=worker-other.out") as r:
+            assert json.load(r)["truncated"] is False
+    finally:
+        dash.stop()

@@ -21,7 +21,7 @@ from ray_tpu.models import llama
 from ray_tpu.serve.llm_engine import EngineConfig, LLMEngine, llama_adapter
 from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
 from ray_tpu.parallel import MeshSpec
-from ray_tpu.util import metrics, tracing
+from ray_tpu.util import metrics, tracing, xprof
 
 CFG = llama.LlamaConfig(
     vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
@@ -42,6 +42,7 @@ def _load_check_metrics():
 def rt():
     ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
     tracing.clear()
+    xprof.clear()
     yield
     tracing.disable_tracing()
     serve.shutdown()
@@ -161,17 +162,49 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
     assert (spans["train.compute"]["parent_id"]
             == spans["train.step"]["span_id"])
 
+    # Device plane: every named jitted program registered its XLA cost
+    # numbers, and the roofline join against the span walls above
+    # produced utilization rows.
+    progs = xprof.programs()
+    assert {"train.step", "serve.prefill", "serve.decode"} <= set(progs)
+    rl = xprof.roofline()
+    assert "train.step" in rl and "serve.decode" in rl
+    assert rl["train.step"]["wall_s_per_step"] > 0
+    assert 0 < rl["train.step"]["flops_utilization"]
+
     # One merged timeline: task events and library spans from every
-    # plane in a single chrome-trace dump.
+    # plane in a single chrome-trace dump — now including one row per
+    # device with the joined program events.
     out = tmp_path / "timeline.json"
     ray_tpu.timeline(str(out))
     events = json.loads(out.read_text())
     pids = {e["pid"] for e in events if e.get("ph") == "X"}
     assert {"serve", "llm", "data", "train"} <= pids, pids
+    device_events = [e for e in events
+                     if str(e.get("pid", "")).startswith("device:")
+                     and e.get("ph") == "X"]
+    assert device_events, sorted(pids)
+    assert {e["cat"] for e in device_events} == {"xla"}
+    assert {"train.step", "serve.decode"} \
+        <= {e["name"] for e in device_events}
 
     # One registry: every plane's families in a single scrape, with the
     # request/step observations actually recorded.
     text = metrics.export_prometheus()
+    assert 'raytpu_xla_program_flops{program="train.step"}' in text
+    assert 'raytpu_xla_program_flops{program="serve.decode"}' in text
+    assert 'raytpu_xla_program_bytes_accessed{program="serve.prefill"}' \
+        in text
+    assert _sample_value(
+        text, 'raytpu_xla_compile_seconds_total{program="train.step"}') > 0
+    assert 'raytpu_xla_roofline_flops_utilization{program="train.step"}' \
+        in text
+    assert 'raytpu_xla_roofline_hbm_utilization{program="serve.decode"}' \
+        in text
+    # CPU devices report no memory_stats: the HBM gauges stay ABSENT
+    # (declared families, zero samples) rather than exporting zeros.
+    assert not [l for l in text.splitlines()
+                if l.startswith("raytpu_device_hbm_bytes_in_use{")]
     assert _sample_value(text, "raytpu_serve_ttft_seconds_count") >= 1
     assert _sample_value(text, "raytpu_serve_tpot_seconds_count") >= 1
     assert "raytpu_serve_router_requests_total{" in text
