@@ -2515,6 +2515,11 @@ class LocalRuntime:
                 from ray_tpu.util import metrics as _metrics
 
                 _metrics.merge_remote(worker_key, snap)
+            reqev_rows = rep.pop("request_events", None)
+            if reqev_rows:
+                from ray_tpu.serve import request_events as _request_events
+
+                _request_events.merge_remote(worker_key, reqev_rows)
         if which in ("both", "add"):
             for b in rep.get("ref_add") or ():
                 self.refs.add_borrow(worker_key, ObjectID(b))

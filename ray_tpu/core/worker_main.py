@@ -692,6 +692,16 @@ class _WorkerServer:
                 if snap:
                     rep["metrics"] = snap
                     self._metrics_ship_t = now
+            # Request-lifecycle rows (serve/request_events) federate
+            # the same way — sys.modules guard: a worker that never
+            # imported the serve stack must not load it for telemetry.
+            reqev = sys.modules.get("ray_tpu.serve.request_events")
+            if reqev is not None and \
+                    now - getattr(self, "_reqev_ship_t", 0.0) >= 1.0:
+                rows = reqev.snapshot_rows(local_only=True)
+                if rows:
+                    rep["request_events"] = rows
+                    self._reqev_ship_t = now
             return rep
         finally:
             with self._busy_lock:

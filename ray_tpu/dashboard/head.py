@@ -12,6 +12,11 @@ metrics):
   GET /api/v0/objects
   GET /api/v0/nodes
   GET /api/v0/placement_groups
+  GET /api/v0/requests           serving requests from every LLM
+                                 engine's lifecycle ring
+                                 (state.list_requests; ?limit=)
+  GET /api/v0/requests/summarize request counts by lifecycle state and
+                                 terminal cause
   GET /api/v0/tasks/summarize
   GET /api/v0/actors/detail      ?id= one actor + its task attempts
                                  (parity: the React client's actor
@@ -92,6 +97,10 @@ class _Handler(BaseHTTPRequestHandler):
                     "available": api.available_resources(),
                     "nodes": _state.list_nodes(limit=limit),
                 })
+            elif url.path == "/api/v0/requests":
+                self._json({"result": _state.list_requests(limit=limit)})
+            elif url.path == "/api/v0/requests/summarize":
+                self._json({"result": _state.summarize_requests()})
             elif url.path == "/api/v0/tasks":
                 self._json({"result": _state.list_tasks(limit=limit)})
             elif url.path == "/api/v0/tasks/summarize":

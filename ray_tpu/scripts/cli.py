@@ -80,6 +80,9 @@ _LIST_ROUTES = {
     "nodes": ("/api/v0/nodes", ["node_id", "state"]),
     "placement-groups": ("/api/v0/placement_groups",
                          ["placement_group_id", "strategy", "state"]),
+    "requests": ("/api/v0/requests",
+                 ["request_id", "engine", "state", "prompt_tokens",
+                  "generated_tokens", "slot", "terminal_cause"]),
 }
 
 
@@ -147,8 +150,15 @@ def cmd_logs(args, out) -> int:
     return 0
 
 
+_SUMMARY_ROUTES = {
+    "tasks": "/api/v0/tasks/summarize",
+    "requests": "/api/v0/requests/summarize",
+}
+
+
 def cmd_summary(args, out) -> int:
-    payload = _get_json(_address(args), "/api/v0/tasks/summarize")["result"]
+    entity = getattr(args, "entity", None) or "tasks"
+    payload = _get_json(_address(args), _SUMMARY_ROUTES[entity])["result"]
     print(json.dumps(payload, indent=2), file=out)
     return 0
 
@@ -315,7 +325,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ray_tpu",
         description="ray_tpu cluster CLI (see `<cmd> -h`)",
-        epilog="commands: status, list, summary, up, logs, timeline, "
+        epilog="commands: status, list (tasks/actors/objects/nodes/"
+               "placement-groups/requests/jobs), summary (tasks | "
+               "requests), up, logs, timeline, "
                "profile (on-demand jax.profiler capture on every "
                "worker), memory, job, serve, start",
     )
@@ -331,7 +343,11 @@ def build_parser() -> argparse.ArgumentParser:
     lp.add_argument("entity", choices=sorted(_LIST_ROUTES) + ["jobs"])
     lp.add_argument("--limit", type=int, default=100)
 
-    sub.add_parser("summary", help="task summary by function and state")
+    sp = sub.add_parser(
+        "summary", help="entity summary: tasks (by function and state) "
+                        "or requests (by lifecycle state and cause)")
+    sp.add_argument("entity", nargs="?", default="tasks",
+                    choices=sorted(_SUMMARY_ROUTES))
 
     upp = sub.add_parser(
         "up", help="launch a cluster from a YAML config (head here, "

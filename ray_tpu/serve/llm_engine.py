@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.serve import request_events as _reqev
 from ray_tpu.util import tracing
 
 log = logging.getLogger(__name__)
@@ -93,12 +94,51 @@ def _telemetry():
                 "time.  Climbing age with stable depth = stalled "
                 "admission, not load.",
             ),
+            "itl": metrics.Histogram(
+                "raytpu_serve_request_itl_seconds",
+                "Worst client-observed inter-token gap within a "
+                "finished request (the hiccup a streaming reader "
+                "actually sees; mean gap is TPOT).",
+                boundaries=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                            0.1, 0.25, 1.0, 5.0],
+            ),
+            "slo": metrics.Counter(
+                "raytpu_serve_request_slo_total",
+                "Terminal requests by SLO outcome: met only when the "
+                "request FINISHED inside every bound of "
+                "EngineConfig.slo (no slo config = every finish is "
+                "met); failed/cancelled always miss.",
+                tag_keys=("outcome",),
+            ),
+            "terminal": metrics.Counter(
+                "raytpu_serve_request_terminal_total",
+                "Requests reaching a terminal state, by state "
+                "(FINISHED / FAILED / CANCELLED).",
+                tag_keys=("state",),
+            ),
+            "goodput": metrics.Gauge(
+                "raytpu_serve_goodput_ratio",
+                "Tokens from SLO-met requests over all tokens of "
+                "terminal requests — goodput vs raw throughput.",
+            ),
         }
     else:
         reg = metrics.registry()
         for m in _TELEMETRY.values():
             reg.register(m)
     return _TELEMETRY
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """Per-request latency objectives; a None bound is unconstrained.
+    A request is SLO-met only when it FINISHED inside every set bound —
+    failed and cancelled requests always miss, which is what makes the
+    goodput gauge honest under churn."""
+
+    ttft_s: Optional[float] = None
+    tpot_s: Optional[float] = None
+    e2e_s: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,6 +163,9 @@ class EngineConfig:
     # chunks — a long prompt never stalls running streams for its full
     # prefill (0 = always one-shot).
     prefill_chunk: int = 0
+    # Latency objectives driving the SLO met/missed counters and the
+    # goodput gauge (None = every finished request counts as met).
+    slo: Optional[SLO] = None
 
     def buckets(self) -> List[int]:
         out, b = [], self.min_prefill_bucket
@@ -256,6 +299,12 @@ class Request:
     # prefill in the request's span tree.
     trace_ctx: Optional[Dict[str, str]] = None
     admitted_at: Optional[float] = None
+    # End-to-end id labeling the ring, spans, and log lines (minted at
+    # the serve router, or locally when submitted straight to the
+    # engine); incremental inter-token-gap tracking rides _emit.
+    request_id: str = ""
+    last_token_at: Optional[float] = None
+    max_itl_s: float = 0.0
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -270,9 +319,22 @@ _DONE = object()
 class CompletionStream:
     """Client view of one request: iterate tokens as they generate."""
 
-    def __init__(self, req: Request):
+    def __init__(self, req: Request, engine: "Optional[LLMEngine]" = None):
         self._req = req
+        self._engine = engine
         self._done = threading.Event()
+
+    @property
+    def request_id(self) -> str:
+        return self._req.request_id
+
+    def cancel(self) -> None:
+        """Ask the engine to cancel this request (idempotent; a no-op
+        once the request is terminal).  The stream still ends with its
+        normal _DONE marker — tokens emitted before the cancel took
+        effect stay delivered."""
+        if self._engine is not None:
+            self._engine.cancel(self._req.request_id)
 
     def __iter__(self):
         while not self._done.is_set():
@@ -335,13 +397,17 @@ class LLMServer:
         )
 
     def __call__(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        # Explicit payload id > the id the replica installed from
+        # request metadata (the router-minted one) > engine-local mint.
         stream = self.engine.submit(
             payload["tokens"],
             max_new_tokens=payload.get("max_new_tokens"),
             temperature=payload.get("temperature", 0.0),
+            request_id=payload.get("request_id"),
         )
         tokens = stream.result()
-        return {"tokens": tokens, "metrics": stream.metrics}
+        return {"tokens": tokens, "metrics": stream.metrics,
+                "request_id": stream.request_id}
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
@@ -349,6 +415,9 @@ class LLMServer:
     def check_health(self) -> None:
         if self.engine._stopped.is_set():
             raise RuntimeError("engine stopped")
+
+
+_ENGINE_IDS = itertools.count()
 
 
 class LLMEngine:
@@ -438,6 +507,21 @@ class LLMEngine:
         self._steps = 0
         self._tokens_out = 0
         self._tm = _telemetry()
+        # Request-lifecycle ring (util/state.list_requests, dashboard
+        # /api/v0/requests, timeline request rows all read it).  The
+        # engine holds the only strong ref; the module registry is weak.
+        self._engine_id = f"engine-{next(_ENGINE_IDS)}"
+        self._ring = _reqev.RequestEventBuffer(self._engine_id)
+        _reqev.register(self._ring)
+        # Cancellation handoff: client threads drop ids here; the
+        # engine loop resolves them against its registries between
+        # dispatches (the loop owns all slot/page state).
+        self._cancel_lock = threading.Lock()
+        self._cancels: set = set()
+        # Goodput accounting: tokens from SLO-met requests vs all
+        # tokens of terminal requests.
+        self._good_tokens = 0
+        self._terminal_tokens = 0
         self._step_walls: deque = deque(maxlen=64)  # recent s/step
         self._step_wall_hw = 0.0  # watermark mirrored to the gauge
         self._xprof_recorded: set = set()  # programs already registered
@@ -579,7 +663,8 @@ class LLMEngine:
     # -- client API --------------------------------------------------------
 
     def submit(self, prompt: List[int], *, max_new_tokens: Optional[int] = None,
-               temperature: float = 0.0) -> CompletionStream:
+               temperature: float = 0.0,
+               request_id: Optional[str] = None) -> CompletionStream:
         if self._stopped.is_set():
             raise RuntimeError("engine is stopped (shut down or crashed)")
         if len(prompt) == 0:
@@ -598,6 +683,10 @@ class LLMEngine:
             trace_ctx=(tracing.capture_context()
                        if tracing.is_enabled() else None),
         )
+        # Explicit id > the ambient one the serve replica installed
+        # (router-minted, riding request metadata) > local mint.
+        req.request_id = (request_id or _reqev.get_request_id()
+                          or f"{self._engine_id}-r{req.req_id}")
         if self._paged:
             # Reject requests the page pool can NEVER satisfy — they
             # would otherwise wedge admission head-of-line forever.
@@ -609,20 +698,44 @@ class LLMEngine:
                     f"{self.config.page_size}) but the pool has only "
                     f"{self._num_pages}"
                 )
+        self._ring.record(req.request_id, _reqev.QUEUED,
+                          prompt_tokens=len(req.prompt))
+        log.debug("request %s queued (%d prompt tokens, max_new=%d)",
+                  req.request_id, len(req.prompt), req.max_new_tokens)
         self._waiting.put(req)
         self._work.set()
-        return CompletionStream(req)
+        return CompletionStream(req, self)
+
+    def cancel(self, request_id: str) -> None:
+        """Cancel a request by id.  Idempotent; unknown or already
+        terminal ids are a no-op.  Resolution happens on the engine
+        loop (which owns slot/page state): the request reaches
+        CANCELLED, its slot and pages are released, and its stream ends
+        normally with the tokens generated so far."""
+        if self._stopped.is_set():
+            return
+        with self._cancel_lock:
+            self._cancels.add(request_id)
+        self._work.set()
 
     def generate(self, prompt: List[int], **kw) -> List[int]:
         return self.submit(prompt, **kw).result()
 
+    @property
+    def engine_id(self) -> str:
+        """Stable name of this engine's request ring (the ``engine``
+        key on state.list_requests rows)."""
+        return self._engine_id
+
     def stats(self) -> Dict[str, Any]:
         return {
+            "engine": self._engine_id,
             "active_slots": self.config.max_slots - len(self._free_slots),
             "prefilling": len(getattr(self, "_prefilling", ())),
             "waiting": self._waiting.qsize(),
             "steps": self._steps,
             "tokens_out": self._tokens_out,
+            "requests": self._ring.counts_by_state(),
         }
 
     def shutdown(self):
@@ -759,6 +872,10 @@ class LLMEngine:
             self._temps[slot] = req.temperature
             if req.admitted_at is None:
                 req.admitted_at = now
+            self._ring.record(
+                req.request_id, _reqev.PREFILLING, slot=slot,
+                num_pages=(len(self._slot_pages.get(slot, []))
+                           if self._paged else None))
             # The pending first token counts against the budget until
             # the prefill entry is processed.
             self._inflight_tokens[slot] = \
@@ -835,6 +952,9 @@ class LLMEngine:
                     self._backlog.insert(0, req)
                     break
                 req.admitted_at = time.monotonic()
+                self._ring.record(
+                    req.request_id, _reqev.PREFILLING, slot=slot,
+                    num_pages=len(self._slot_pages.get(slot, [])))
                 self._prefilling.append({"req": req, "slot": slot,
                                          "pos": 0})
         while self._free_slots:
@@ -896,38 +1016,98 @@ class LLMEngine:
     def _emit(self, req: Request, slot: int, tok: int):
         """Record one generated token; finish/free the slot if done."""
         self._slot_req.setdefault(slot, req)
+        now = time.monotonic()
+        if req.last_token_at is not None:
+            req.max_itl_s = max(req.max_itl_s, now - req.last_token_at)
+        req.last_token_at = now
         req.tokens.append(tok)
         req.stream.put(tok)
         self._tokens_out += 1
+        self._ring.update(req.request_id,
+                          generated_tokens=len(req.tokens))
+        eos = self.config.eos_id is not None and tok == self.config.eos_id
         done = (
-            (self.config.eos_id is not None and tok == self.config.eos_id)
+            eos
             or len(req.tokens) >= req.max_new_tokens
             or len(req.prompt) + len(req.tokens) >= self.config.max_seq_len
         )
         if done:
-            req.finished_at = time.monotonic()
-            self._observe_request(req)
+            cause = ("eos" if eos
+                     else "max_new_tokens"
+                     if len(req.tokens) >= req.max_new_tokens
+                     else "max_seq_len")
+            self._release_slot(slot)
+            req.finished_at = now
+            self._observe_request(req, state=_reqev.FINISHED, cause=cause)
             req.stream.put(_DONE)
-            del self._slot_req[slot]
-            self._free_slots.append(slot)
-            self._state_dirty = True
-            if self._paged:
-                self._free_pages.extend(self._slot_pages.pop(slot, []))
-                self._bt[slot] = self._num_pages
-                self._lens[slot] = 0
 
-    def _observe_request(self, req: Request) -> None:
-        """Request-completion telemetry: TTFT/TPOT histograms, and the
-        request's span tree (queue wait → prefill → decode) when
-        tracing is on.  Spans are recorded retroactively from the
-        monotonic stamps the engine loop takes anyway, so the decode
-        hot path itself carries no tracing code."""
-        if req.ttft_s is not None:
-            self._tm["ttft"].observe(req.ttft_s)
-        if (req.first_token_at is not None and len(req.tokens) > 1):
-            self._tm["tpot"].observe(
-                (req.finished_at - req.first_token_at)
-                / (len(req.tokens) - 1))
+    def _release_slot(self, slot: int) -> None:
+        """Return a slot (and, paged, its pages) to the free pool —
+        shared by the finish, cancel, and failure paths so terminal
+        accounting can never leak capacity."""
+        self._slot_req.pop(slot, None)
+        self._free_slots.append(slot)
+        self._state_dirty = True
+        if self._paged:
+            self._free_pages.extend(self._slot_pages.pop(slot, []))
+            self._bt[slot] = self._num_pages
+            self._lens[slot] = 0
+
+    def _slo_met(self, req: Request) -> bool:
+        """Did a FINISHED request meet every configured bound?  (No slo
+        config = trivially met; callers gate on the terminal state.)"""
+        slo = self.config.slo
+        if slo is None:
+            return True
+        if slo.ttft_s is not None and (
+                req.ttft_s is None or req.ttft_s > slo.ttft_s):
+            return False
+        if slo.tpot_s is not None:
+            if req.first_token_at is None or len(req.tokens) < 2:
+                return False
+            tpot = ((req.finished_at - req.first_token_at)
+                    / (len(req.tokens) - 1))
+            if tpot > slo.tpot_s:
+                return False
+        if slo.e2e_s is not None and (
+                req.finished_at - req.submitted_at) > slo.e2e_s:
+            return False
+        return True
+
+    def _observe_request(self, req: Request, *,
+                         state: str = _reqev.FINISHED,
+                         cause: Optional[str] = None) -> None:
+        """Terminal-state accounting for EVERY outcome — ring verdict,
+        SLO/goodput/terminal counters for all three terminal states,
+        latency histograms only for FINISHED (a cancelled request has
+        no honest TTFT), and the request's span tree (queue wait →
+        prefill → decode) when tracing is on.  Spans are recorded
+        retroactively from the monotonic stamps the engine loop takes
+        anyway, so the decode hot path itself carries no tracing
+        code."""
+        self._ring.record(req.request_id, state,
+                          generated_tokens=len(req.tokens),
+                          terminal_cause=cause)
+        finished = state == _reqev.FINISHED
+        met = finished and self._slo_met(req)
+        self._tm["terminal"].inc(tags={"state": state})
+        self._tm["slo"].inc(tags={"outcome": "met" if met else "missed"})
+        self._terminal_tokens += len(req.tokens)
+        if met:
+            self._good_tokens += len(req.tokens)
+        if self._terminal_tokens:
+            self._tm["goodput"].set(
+                self._good_tokens / self._terminal_tokens)
+        log.debug("request %s %s (cause=%s, %d tokens)",
+                  req.request_id, state, cause, len(req.tokens))
+        if finished:
+            if req.ttft_s is not None:
+                self._tm["ttft"].observe(req.ttft_s)
+            if (req.first_token_at is not None and len(req.tokens) > 1):
+                self._tm["tpot"].observe(
+                    (req.finished_at - req.first_token_at)
+                    / (len(req.tokens) - 1))
+                self._tm["itl"].observe(req.max_itl_s)
         if not tracing.is_enabled():
             return
         # Monotonic stamps → wall clock for the trace view.
@@ -935,12 +1115,16 @@ class LLMEngine:
         root = tracing.record_span(
             "llm.request", req.submitted_at + off, req.finished_at + off,
             ctx=req.trace_ctx,
-            attributes={"req_id": req.req_id,
+            attributes={"request_id": req.request_id,
+                        "state": state,
+                        "terminal_cause": cause,
                         "prompt_len": len(req.prompt),
                         "num_tokens": len(req.tokens)},
         )
         ctx = {"trace_id": root["trace_id"], "span_id": root["span_id"]}
-        admitted = req.admitted_at or req.submitted_at
+        # A never-admitted terminal (cancelled/failed in queue) spends
+        # its whole life in queue_wait.
+        admitted = req.admitted_at or req.finished_at
         tracing.record_span("llm.queue_wait", req.submitted_at + off,
                             admitted + off, ctx=ctx)
         if req.first_token_at is not None:
@@ -1173,7 +1357,13 @@ class LLMEngine:
                         self._inflight_tokens[slot] = left
                     else:
                         self._inflight_tokens.pop(slot, None)
+                    if req.finished_at is not None:
+                        # Cancelled while its prefill was in flight:
+                        # the slot is already freed (and may even be
+                        # re-owned) — emitting would re-register it.
+                        continue
                     req.first_token_at = now
+                    self._ring.record(req.request_id, _reqev.DECODING)
                     self._emit(req, slot, int(toks[i]))
                 continue
             for slot, req in participants:
@@ -1189,6 +1379,57 @@ class LLMEngine:
                     self._emit(req, slot, int(toks[k, slot]))
                     if self._slot_req.get(slot) is not req:
                         break  # finished mid-chunk
+
+    def _process_cancels(self) -> None:
+        """Resolve pending cancellations against every registry the
+        loop owns.  A cancelled request releases its slot/pages and
+        reaches CANCELLED through the same `_observe_request` path as
+        every other terminal — its stream ends with the normal _DONE
+        marker.  Unknown ids (already terminal, or never this
+        engine's) are dropped silently: cancel is idempotent."""
+        with self._cancel_lock:
+            if not self._cancels:
+                return
+            pending = set(self._cancels)
+            self._cancels.clear()
+
+        def _finish_cancel(req: Request, slot: Optional[int]) -> None:
+            if slot is not None:
+                self._release_slot(slot)
+            req.finished_at = time.monotonic()
+            self._observe_request(req, state=_reqev.CANCELLED,
+                                  cause="cancelled")
+            req.stream.put(_DONE)
+
+        for slot, req in list(self._slot_req.items()):
+            if req.request_id in pending:
+                pending.discard(req.request_id)
+                _finish_cancel(req, slot)
+        if self._paged:
+            for st in list(self._prefilling):
+                if st["req"].request_id in pending:
+                    pending.discard(st["req"].request_id)
+                    self._prefilling.remove(st)
+                    _finish_cancel(st["req"], st["slot"])
+            for req in list(self._backlog):
+                if req.request_id in pending:
+                    pending.discard(req.request_id)
+                    self._backlog.remove(req)
+                    _finish_cancel(req, None)
+        if pending:
+            kept: List[Request] = []
+            while True:
+                try:
+                    req = self._waiting.get_nowait()
+                except queue.Empty:
+                    break
+                if req.request_id in pending:
+                    pending.discard(req.request_id)
+                    _finish_cancel(req, None)
+                else:
+                    kept.append(req)
+            for req in kept:
+                self._waiting.put(req)
 
     # Dispatched-but-unemitted entries: enough to keep the device and
     # the fetch pipe full; budget gating bounds per-slot run-ahead.
@@ -1219,12 +1460,26 @@ class LLMEngine:
                     failing.append(self._waiting.get_nowait())
                 except queue.Empty:
                     break
+            seen = set()
             for req in failing:
+                if id(req) in seen:
+                    continue  # _admitting can overlap _slot_req
+                seen.add(id(req))
+                try:
+                    # FAILED terminal accounting (ring + counters +
+                    # spans) — best-effort: the crash itself must win.
+                    if req.finished_at is None:
+                        req.finished_at = time.monotonic()
+                    self._observe_request(req, state=_reqev.FAILED,
+                                          cause=repr(e))
+                except Exception:
+                    pass
                 req.stream.put(err)
             raise
 
     def _loop_body(self):
         while not self._stopped.is_set():
+            self._process_cancels()
             backlog = self._paged and (self._backlog or self._prefilling)
             if (not self._slot_req and self._waiting.empty()
                     and not backlog and self._unprocessed == 0):

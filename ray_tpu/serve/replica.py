@@ -111,6 +111,7 @@ class ReplicaActor:
         from ray_tpu.core import api
         from ray_tpu.core.object_ref import ObjectRef
         from ray_tpu.serve import multiplex as _mux
+        from ray_tpu.serve import request_events as _reqev
 
         # Upstream DeploymentResponses arrive as refs nested inside the
         # args tuple — resolve them here (parity: the reference resolves
@@ -130,12 +131,20 @@ class ReplicaActor:
         mux_token = _mux._set_model_id(
             (metadata or {}).get("multiplexed_model_id", "")
         )
+        # The router-minted request id becomes ambient context for the
+        # user callable (same token pattern as the mux model id) —
+        # LLMEngine.submit and any downstream handle call inherit it.
+        rid_token = _reqev.set_request_id(
+            (metadata or {}).get("request_id", "")
+        )
         try:
             with tracing.span(
                     "serve.replica",
                     attributes={"deployment": self.deployment_name,
                                 "replica": self.replica_id,
-                                "method": method_name}):
+                                "method": method_name,
+                                "request_id":
+                                    (metadata or {}).get("request_id")}):
                 result = self._target(method_name)(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     import asyncio
@@ -143,6 +152,7 @@ class ReplicaActor:
                     result = asyncio.run(result)
                 return result
         finally:
+            _reqev.reset_request_id(rid_token)
             _mux._reset_model_id(mux_token)
             self._tm["latency"].observe(
                 time.perf_counter() - t0,
@@ -159,6 +169,7 @@ class ReplicaActor:
         reference's replica is natively asyncio, replica.py:494)."""
         from ray_tpu.core.object_ref import ObjectRef
         from ray_tpu.serve import multiplex as _mux
+        from ray_tpu.serve import request_events as _reqev
 
         # List comp, not genexp: a generator expression containing
         # ``await`` is an async generator, which tuple() rejects.
@@ -177,6 +188,9 @@ class ReplicaActor:
         mux_token = _mux._set_model_id(
             (metadata or {}).get("multiplexed_model_id", "")
         )
+        rid_token = _reqev.set_request_id(
+            (metadata or {}).get("request_id", "")
+        )
         try:
             # Metrics only on the async plane: a span context manager
             # around an await would leak its thread-local ctx across
@@ -192,15 +206,23 @@ class ReplicaActor:
             if inspect.iscoroutinefunction(fn):
                 return await target(*args, **kwargs)
             import asyncio
+            import contextvars
             import functools
 
             loop = asyncio.get_running_loop()
+            # copy_context(): run_in_executor does not carry
+            # contextvars to the worker thread — the request id (and
+            # mux model id) must follow the sync target there.
             result = await loop.run_in_executor(
-                None, functools.partial(target, *args, **kwargs))
+                None,
+                functools.partial(contextvars.copy_context().run,
+                                  functools.partial(target, *args,
+                                                    **kwargs)))
             if inspect.iscoroutine(result):
                 result = await result
             return result
         finally:
+            _reqev.reset_request_id(rid_token)
             _mux._reset_model_id(mux_token)
             self._tm["latency"].observe(
                 time.perf_counter() - t0,

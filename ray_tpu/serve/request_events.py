@@ -1,0 +1,317 @@
+"""Request-lifecycle event ring for the serving plane.
+
+Mirrors the task-event design in ``core/events.py`` one level up the
+stack: where the task ring answers "what did this *task* do", this ring
+answers "why was this *request* slow" — the one axis the reference's
+state API (tasks/actors/objects, SURVEY §2.2) does not cover and an
+LLM serving stack cannot live without.  Every ``LLMEngine`` owns a
+bounded ring recording each request's state machine
+
+    QUEUED → PREFILLING → DECODING → FINISHED | FAILED | CANCELLED
+
+with wall-clock timestamps, token counts, slot/page assignment and the
+terminal cause.  ``util/state.list_requests`` / ``summarize_requests``,
+the dashboard's ``/api/v0/requests`` routes, ``raytpu list requests``
+and the request rows in ``ray_tpu.timeline()`` all read from here.
+
+Rings register into a process-local weak registry (one entry per live
+engine); engines inside worker processes piggyback their rows on task
+replies (see ``worker_main._run_op``) exactly like metric snapshots, so
+the driver's state API sees every process's requests under a ``proc``
+key — absolute last-write-wins snapshots, same federation contract as
+``util/metrics.merge_remote``.
+
+The request id is minted once at the serve router and rides request
+metadata → a context variable (set by the replica) → ``LLMEngine.submit``
+so spans, log lines and this ring all agree on the name of a request.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import dataclasses
+import threading
+import time
+import uuid
+import weakref
+from typing import Any, Dict, List, Optional
+
+# Request state vocabulary (the serving analogue of common.proto's
+# TaskStatus in core/events.py).
+QUEUED = "QUEUED"
+PREFILLING = "PREFILLING"
+DECODING = "DECODING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+
+TERMINAL_STATES = (FINISHED, FAILED, CANCELLED)
+
+# Phase labels for the timeline rows: the span covering [state, next
+# state) is named after what the engine was doing IN that state.
+_PHASE_NAME = {QUEUED: "queued", PREFILLING: "prefill", DECODING: "decode"}
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One request's lifecycle (the serving analogue of TaskAttempt)."""
+
+    request_id: str
+    engine: str
+    state_ts: Dict[str, float] = dataclasses.field(default_factory=dict)
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+    # Slot/page assignment: None until admitted; num_pages stays None on
+    # the non-paged (slot-cache) engine — absent, not zero.
+    slot: Optional[int] = None
+    num_pages: Optional[int] = None
+    terminal_cause: Optional[str] = None
+
+    @property
+    def state(self) -> str:
+        """Latest state reached (insertion order = record order)."""
+        return next(reversed(self.state_ts)) if self.state_ts else "NIL"
+
+    def is_terminal(self) -> bool:
+        return any(s in self.state_ts for s in TERMINAL_STATES)
+
+    # -- derived token-latency views (wall clock, from the state stamps)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if QUEUED in self.state_ts and DECODING in self.state_ts:
+            return self.state_ts[DECODING] - self.state_ts[QUEUED]
+        return None
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean per-token latency after the first token (terminal only)."""
+        end = next((self.state_ts[s] for s in TERMINAL_STATES
+                    if s in self.state_ts), None)
+        if (end is None or DECODING not in self.state_ts
+                or self.generated_tokens < 2):
+            return None
+        return (end - self.state_ts[DECODING]) / (self.generated_tokens - 1)
+
+    @property
+    def e2e_s(self) -> Optional[float]:
+        end = next((self.state_ts[s] for s in TERMINAL_STATES
+                    if s in self.state_ts), None)
+        if end is None or QUEUED not in self.state_ts:
+            return None
+        return end - self.state_ts[QUEUED]
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["state"] = self.state
+        d["ttft_s"] = self.ttft_s
+        d["tpot_s"] = self.tpot_s
+        d["e2e_s"] = self.e2e_s
+        return d
+
+
+class RequestEventBuffer:
+    """Bounded per-engine ring; oldest *terminal* records are dropped
+    first when over capacity (same eviction rule as TaskEventBuffer —
+    live requests are the ones an operator is debugging)."""
+
+    def __init__(self, engine: str, max_requests: int = 4096):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._max = max_requests
+        self._records: "collections.OrderedDict[str, RequestRecord]" = \
+            collections.OrderedDict()
+        self.num_dropped = 0
+
+    def record(self, request_id: str, state: str, *,
+               prompt_tokens: Optional[int] = None,
+               generated_tokens: Optional[int] = None,
+               slot: Optional[int] = None,
+               num_pages: Optional[int] = None,
+               terminal_cause: Optional[str] = None) -> None:
+        now = time.time()
+        with self._lock:
+            rec = self._records.get(request_id)
+            if rec is None:
+                rec = RequestRecord(request_id=request_id,
+                                    engine=self.engine)
+                self._records[request_id] = rec
+                if len(self._records) > self._max:
+                    self._evict_locked()
+            if state in TERMINAL_STATES and rec.is_terminal():
+                return  # first terminal verdict wins
+            # First-entry wins: a state is ENTERED once; re-records (the
+            # incremental-prefill path re-announces PREFILLING at its
+            # final chunk) keep the original stamp, so phase timestamps
+            # stay monotone in record order.
+            rec.state_ts.setdefault(state, now)
+            if prompt_tokens is not None:
+                rec.prompt_tokens = prompt_tokens
+            if generated_tokens is not None:
+                rec.generated_tokens = generated_tokens
+            if slot is not None:
+                rec.slot = slot
+            if num_pages is not None:
+                rec.num_pages = num_pages
+            if terminal_cause is not None:
+                rec.terminal_cause = terminal_cause
+
+    def update(self, request_id: str, *,
+               generated_tokens: Optional[int] = None) -> None:
+        """Touch live counters without a state transition (per-token)."""
+        with self._lock:
+            rec = self._records.get(request_id)
+            if rec is not None and generated_tokens is not None:
+                rec.generated_tokens = generated_tokens
+
+    def _evict_locked(self) -> None:
+        for key, rec in self._records.items():
+            if rec.is_terminal():
+                del self._records[key]
+                self.num_dropped += 1
+                return
+        self._records.popitem(last=False)
+        self.num_dropped += 1
+
+    def snapshot(self) -> List[RequestRecord]:
+        with self._lock:
+            return [dataclasses.replace(r, state_ts=dict(r.state_ts))
+                    for r in self._records.values()]
+
+    def counts_by_state(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for rec in self.snapshot():
+            out[rec.state] = out.get(rec.state, 0) + 1
+        return out
+
+
+# -- process-local registry + cross-process federation ----------------------
+
+_registry_lock = threading.Lock()
+# engine id → buffer; weak so a ring lives exactly as long as its engine
+# (the engine holds the strong ref) and dead engines drop out of listings.
+_buffers: "weakref.WeakValueDictionary[str, RequestEventBuffer]" = \
+    weakref.WeakValueDictionary()
+# proc key → [row dict, ...] — absolute snapshots shipped on task
+# replies by worker processes (see util/metrics._remote_snapshots).
+_remote_lock = threading.Lock()
+_remote_rows: Dict[str, List[Dict[str, Any]]] = {}
+
+
+def register(buffer: RequestEventBuffer) -> None:
+    with _registry_lock:
+        _buffers[buffer.engine] = buffer
+
+
+def buffers() -> List[RequestEventBuffer]:
+    with _registry_lock:
+        return list(_buffers.values())
+
+
+def merge_remote(proc: str, rows: List[Dict[str, Any]]) -> None:
+    """Store a worker process's request rows (driver-side half of the
+    reply piggyback).  Rows are absolute state: last-write-wins."""
+    with _remote_lock:
+        _remote_rows[proc] = rows
+
+
+def clear_remote() -> None:
+    with _remote_lock:
+        _remote_rows.clear()
+
+
+def clear() -> None:
+    """Drop every registered ring and remote snapshot (tests)."""
+    with _registry_lock:
+        _buffers.clear()
+    clear_remote()
+
+
+def snapshot_rows(local_only: bool = False) -> List[Dict[str, Any]]:
+    """Every known request as a plain dict row: local rings first (proc
+    "driver"), then federated worker snapshots under their proc key."""
+    rows: List[Dict[str, Any]] = []
+    for buf in buffers():
+        for rec in buf.snapshot():
+            d = rec.to_dict()
+            d["proc"] = "driver"
+            rows.append(d)
+    if not local_only:
+        with _remote_lock:
+            remote = sorted(_remote_rows.items())
+        for proc, shipped in remote:
+            for d in shipped:
+                d = dict(d)
+                d["proc"] = proc
+                rows.append(d)
+    return rows
+
+
+# -- request-id propagation -------------------------------------------------
+
+_current_request_id: contextvars.ContextVar = contextvars.ContextVar(
+    "raytpu_serve_request_id", default="")
+
+
+def new_request_id() -> str:
+    """Mint the id a request carries end to end (router → replica →
+    engine → ring/spans/logs)."""
+    return f"req-{uuid.uuid4().hex[:16]}"
+
+
+def set_request_id(request_id: str):
+    """Install the current request id; returns a reset token."""
+    return _current_request_id.set(request_id)
+
+
+def reset_request_id(token) -> None:
+    _current_request_id.reset(token)
+
+
+def get_request_id() -> str:
+    return _current_request_id.get()
+
+
+# -- timeline ---------------------------------------------------------------
+
+def chrome_events() -> List[Dict[str, Any]]:
+    """Request rows for the merged chrome-trace timeline: one process
+    row per engine (``llmreq:<engine>``), one thread row per slot
+    (unadmitted requests land on a ``queue`` row), one complete event
+    per lifecycle phase.  Mergeable with the task/span/device rows in
+    ``util/state.timeline``."""
+    out: List[Dict[str, Any]] = []
+    seen_rows = set()
+    now = time.time()
+    for row in snapshot_rows():
+        ts_items = list(row.get("state_ts", {}).items())
+        if not ts_items:
+            continue
+        pid = f"llmreq:{row.get('engine', '?')}"
+        if pid not in seen_rows:
+            seen_rows.add(pid)
+            out.append({"ph": "M", "pid": pid, "name": "process_name",
+                        "args": {"name": pid}})
+        slot = row.get("slot")
+        tid = "queue" if slot is None else f"slot {slot}"
+        for i, (st, t0) in enumerate(ts_items):
+            if st in TERMINAL_STATES:
+                continue
+            t1 = ts_items[i + 1][1] if i + 1 < len(ts_items) else now
+            out.append({
+                "ph": "X",
+                "name": _PHASE_NAME.get(st, st.lower()),
+                "cat": "request",
+                "pid": pid,
+                "tid": tid,
+                "ts": t0 * 1e6,
+                "dur": max(0.0, t1 - t0) * 1e6,
+                "args": {
+                    "request_id": row["request_id"],
+                    "state": row.get("state"),
+                    "terminal_cause": row.get("terminal_cause"),
+                    "generated_tokens": row.get("generated_tokens"),
+                },
+            })
+    return out

@@ -135,18 +135,28 @@ class Router:
         replica; if everything is excluded we wait for the controller's
         replacement broadcast)."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        # Mint the end-to-end request id HERE (or inherit one from an
+        # upstream hop): it rides request metadata to the replica,
+        # which installs it as ambient context for the user callable —
+        # LLMEngine.submit, spans, and log lines all pick it up.
+        from ray_tpu.serve import request_events as _reqev
+
+        request_id = (_reqev.get_request_id()
+                      or _reqev.new_request_id())
         # The request's root span: replica selection (with its queue
         # wait) and the submit happen inside it, so the replica's task
         # span — and everything the user code spawns — parent here.
         with tracing.span(
                 "serve.request",
                 attributes={"deployment": self.deployment_name,
-                            "method": method_name}):
+                            "method": method_name,
+                            "request_id": request_id}):
             with tracing.span("serve.queue_wait"):
                 chosen = self._select_replica(deadline, timeout, exclude,
                                               model_id)
-            metadata = ({"multiplexed_model_id": model_id}
-                        if model_id else None)
+            metadata = {"request_id": request_id}
+            if model_id:
+                metadata["multiplexed_model_id"] = model_id
             entry = (chosen.handle.handle_request_async if chosen.is_async
                      else chosen.handle.handle_request)
             ref = entry.remote(method_name, args, kwargs, metadata)

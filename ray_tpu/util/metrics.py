@@ -249,12 +249,32 @@ def clear_remote() -> None:
 def _internal_samples() -> List[Tuple[str, str, str, _TagTuple, float]]:
     """(name, type, help, tags, value) computed from live runtime state
     at scrape time — the reference's gauge-callback pattern."""
+    import sys
+
     from ray_tpu.core import api
 
-    if not api.is_initialized():
-        return []
-    rt = api.runtime()
     out: List[Tuple[str, str, str, _TagTuple, float]] = []
+
+    # Request-lifecycle plane: counts by state over every known ring
+    # (local + federated).  Guarded by sys.modules — scraping must not
+    # force the serve stack into processes that never imported it —
+    # and computed BEFORE the runtime check: an engine driven directly
+    # (no init) still has requests worth exporting.
+    reqev = sys.modules.get("ray_tpu.serve.request_events")
+    if reqev is not None:
+        req_states: Dict[str, int] = {}
+        for row in reqev.snapshot_rows():
+            st = row.get("state") or "NIL"
+            req_states[st] = req_states.get(st, 0) + 1
+        for st, n in sorted(req_states.items()):
+            out.append(("raytpu_serve_requests", "gauge",
+                        "Current number of serving requests by "
+                        "lifecycle state.",
+                        (("State", st),), float(n)))
+
+    if not api.is_initialized():
+        return out
+    rt = api.runtime()
 
     by_state: Dict[str, int] = {}
     for a in rt.events.snapshot():

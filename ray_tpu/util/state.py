@@ -80,6 +80,41 @@ def list_placement_groups(filters: Optional[List[Filter]] = None, *,
     return _apply_filters(rows, filters, limit)
 
 
+def list_requests(filters: Optional[List[Filter]] = None, *,
+                  limit: int = 100,
+                  detail: bool = False) -> List[Dict[str, Any]]:
+    """Serving requests from every known LLM engine's lifecycle ring —
+    local rings plus the snapshots worker processes piggyback on task
+    replies (the serving analogue of `ray list tasks`).  Works without
+    an initialized runtime: an engine driven directly still shows up."""
+    from ray_tpu.serve import request_events
+
+    rows = request_events.snapshot_rows()
+    if not detail:
+        keep = ("request_id", "engine", "state", "prompt_tokens",
+                "generated_tokens", "slot", "terminal_cause", "proc")
+        rows = [{k: r.get(k) for k in keep} for r in rows]
+    return _apply_filters(rows, filters, limit)
+
+
+def summarize_requests() -> Dict[str, Any]:
+    """Request counts by lifecycle state and terminal cause (parity
+    shape: `ray summary tasks`, one level up the stack)."""
+    from ray_tpu.serve import request_events
+
+    rows = request_events.snapshot_rows()
+    by_state: Dict[str, int] = {}
+    by_cause: Dict[str, int] = {}
+    for r in rows:
+        st = r.get("state") or "NIL"
+        by_state[st] = by_state.get(st, 0) + 1
+        cause = r.get("terminal_cause")
+        if cause is not None:
+            by_cause[cause] = by_cause.get(cause, 0) + 1
+    return {"total": len(rows), "by_state": by_state,
+            "by_terminal_cause": by_cause}
+
+
 def summarize_tasks() -> Dict[str, Dict[str, int]]:
     """Per-function-name counts by state (parity: `ray summary tasks`)."""
     out: Dict[str, Dict[str, int]] = {}
@@ -118,14 +153,24 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict[str, Any]]]:
     timeline`, python/ray/_private/state.py:434 chrome_tracing_dump),
     merged with the tracer's finished spans so serve/data/train library
     phases land in the same Perfetto view as the tasks they ran, plus
-    the device plane's per-device program rows (util/xprof).
+    the device plane's per-device program rows (util/xprof) and the
+    serving plane's request-lifecycle rows (serve/request_events — one
+    row per engine slot, lifecycle phases as spans).
+    Events are sorted by ``ts`` (metadata rows first) so the output is
+    deterministic for a given state.
     Returns the event list, or writes it to ``filename`` if given."""
     from ray_tpu.core.events import spans_to_chrome_events
+    from ray_tpu.serve import request_events
     from ray_tpu.util import tracing, xprof
 
     events = (_runtime().events.chrome_tracing_dump()
               + spans_to_chrome_events(tracing.finished_spans())
-              + xprof.device_timeline_events())
+              + xprof.device_timeline_events()
+              + request_events.chrome_events())
+    # Deterministic order: "M" metadata rows (no ts) lead, then
+    # everything else by timestamp; Python's sort is stable so
+    # same-instant events keep their plane order.
+    events.sort(key=lambda e: ("ts" in e, e.get("ts", 0.0)))
     if filename is None:
         return events
     import json

@@ -12,11 +12,18 @@ any metric family violates the naming contract:
     or two live Metric instances registered under one name (a plane
     silently shadowing another plane's series);
   * histogram shape — ``histogram`` families expose exactly their
-    ``_bucket``/``_sum``/``_count`` sample names.
+    ``_bucket``/``_sum``/``_count`` sample names;
+  * label consistency — every sample of a family carries the same
+    label-key set (``le`` and the federation-injected ``proc`` aside),
+    so aggregation across a family can never silently group apart;
+  * required families — callers may pass ``require=`` (CLI:
+    ``--require a,b,c``) to fail when an expected family is absent —
+    how CI pins the ``raytpu_serve_request_*`` plane.
 
 Usage:
     python scripts/check_metrics.py            # scrape in-process
     python scripts/check_metrics.py FILE       # check a saved scrape
+    python scripts/check_metrics.py --require raytpu_serve_ttft_seconds
 Exit status 0 = clean, 1 = violations (listed on stderr).
 
 The tier-1 telemetry test invokes ``check_exposition()`` directly, so
@@ -27,7 +34,7 @@ from __future__ import annotations
 
 import re
 import sys
-from typing import Dict, List
+from typing import Dict, List, Sequence
 
 METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -37,12 +44,22 @@ SAMPLE_LINE_RE = re.compile(
 LABEL_PAIR_RE = re.compile(r'([^=,{]+)="((?:[^"\\]|\\.)*)"')
 
 
-def check_exposition(text: str) -> List[str]:
-    """Return a list of violations (empty = clean)."""
+# Label keys excluded from the per-family consistency check: ``le``
+# exists only on histogram _bucket samples (never _sum/_count), and
+# ``proc`` is injected at export time onto federated worker copies of
+# series the driver also emits bare.
+CONSISTENCY_EXEMPT_LABELS = frozenset({"le", "proc"})
+
+
+def check_exposition(text: str,
+                     require: Sequence[str] = ()) -> List[str]:
+    """Return a list of violations (empty = clean).  ``require`` names
+    families that must be present in the exposition."""
     problems: List[str] = []
     families: Dict[str, str] = {}  # family -> type
     sample_names: Dict[str, set] = {}  # family -> sample suffix names
     seen_series: set = set()  # (sample name, sorted label pairs)
+    label_sets: Dict[str, Dict[frozenset, int]] = {}  # fam -> keyset -> line
 
     for lineno, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -90,6 +107,10 @@ def check_exposition(text: str) -> List[str]:
         else:
             sample_names.setdefault(fam, set()).add(sname[len(fam):])
         pairs = LABEL_PAIR_RE.findall(labels or "")
+        if fam is not None:
+            keyset = frozenset(k for k, _v in pairs
+                               if k not in CONSISTENCY_EXEMPT_LABELS)
+            label_sets.setdefault(fam, {}).setdefault(keyset, lineno)
         for lname, _v in pairs:
             if not LABEL_NAME_RE.match(lname):
                 problems.append(
@@ -114,6 +135,18 @@ def check_exposition(text: str) -> List[str]:
             problems.append(
                 f"family {fam!r}: {typ} exposes suffixed samples "
                 f"{sorted(suffixes - {''})}")
+    for fam, keysets in label_sets.items():
+        if len(keysets) > 1:
+            shapes = sorted("{" + ",".join(sorted(ks)) + "}"
+                            for ks in keysets)
+            problems.append(
+                f"family {fam!r}: inconsistent label sets across "
+                f"samples: {shapes} (first seen at lines "
+                f"{sorted(keysets.values())})")
+    for fam in require:
+        if fam not in families:
+            problems.append(
+                f"required family {fam!r} absent from the exposition")
     return problems
 
 
@@ -128,13 +161,20 @@ def check_registry() -> List[str]:
 
 
 def main(argv: List[str]) -> int:
-    if len(argv) > 1:
-        text = open(argv[1]).read()
-        problems = check_exposition(text)
+    require: List[str] = []
+    args = list(argv[1:])
+    if "--require" in args:
+        i = args.index("--require")
+        require = [f for f in args[i + 1].split(",") if f]
+        del args[i:i + 2]
+    if args:
+        text = open(args[0]).read()
+        problems = check_exposition(text, require=require)
     else:
         from ray_tpu.util import metrics
 
-        problems = check_exposition(metrics.export_prometheus())
+        problems = check_exposition(metrics.export_prometheus(),
+                                    require=require)
         problems += check_registry()
     for p in problems:
         print(f"check_metrics: {p}", file=sys.stderr)

@@ -136,6 +136,43 @@ def test_list_nodes_and_pgs(rt):
     assert any(r["state"] == "CREATED" for r in rows)
 
 
+def test_list_pgs_filters_and_limit(rt):
+    from ray_tpu.util import placement_group
+
+    for strategy in ("PACK", "SPREAD"):
+        pg = placement_group([{"CPU": 1}], strategy=strategy)
+        ray_tpu.get(pg.ready())
+
+    spread = state.list_placement_groups(
+        filters=[("strategy", "=", "SPREAD")])
+    assert spread and all(r["strategy"] == "SPREAD" for r in spread)
+    packed = state.list_placement_groups(
+        filters=[("strategy", "!=", "SPREAD")])
+    assert packed and all(r["strategy"] == "PACK" for r in packed)
+    assert len(state.list_placement_groups(limit=1)) == 1
+    with pytest.raises(ValueError):
+        state.list_placement_groups(filters=[("strategy", ">", "PACK")])
+
+
+def test_list_objects_filters_and_limit(rt):
+    import numpy as np
+
+    small = ray_tpu.put({"a": 1})
+    big = ray_tpu.put(np.zeros(1 << 20, dtype=np.uint8))
+    shm = state.list_objects(
+        filters=[("tier", "=", "SHARED_MEMORY")], limit=1000)
+    assert any(r["object_id"] == big.id.hex() for r in shm)
+    assert all(r["tier"] == "SHARED_MEMORY" for r in shm)
+    inproc = state.list_objects(
+        filters=[("tier", "!=", "SHARED_MEMORY")], limit=1000)
+    assert any(r["object_id"] == small.id.hex() for r in inproc)
+    assert all(r["tier"] != "SHARED_MEMORY" for r in inproc)
+    assert len(state.list_objects(limit=1)) == 1
+    with pytest.raises(ValueError):
+        state.list_objects(filters=[("tier", ">", "SPILLED")])
+    del small, big
+
+
 def test_summarize_tasks(rt):
     @ray_tpu.remote
     def g():
@@ -162,6 +199,27 @@ def test_timeline_chrome_trace(rt, tmp_path):
         assert e["args"]["state"] == "FINISHED"
     # Metadata rows name the nodes.
     assert any(e.get("ph") == "M" for e in events)
+    # Deterministic merge order: timestamped events globally sorted,
+    # metadata (no-ts) rows leading — the same state must always dump
+    # the same Perfetto-ready trace.
+    ts = [e["ts"] for e in events if "ts" in e]
+    assert ts == sorted(ts)
+    seen_ts = False
+    for e in events:
+        if "ts" in e:
+            seen_ts = True
+        else:
+            assert not seen_ts, "metadata row after a timestamped event"
+    # Byte-identical across dumps of an idle runtime.
+    path2 = tmp_path / "trace2.json"
+    ray_tpu.timeline(str(path2))
+    assert ([
+        (e.get("pid"), e.get("tid"), e.get("name"))
+        for e in json.loads(path2.read_text()) if e.get("ph") == "X"
+    ] == [
+        (e.get("pid"), e.get("tid"), e.get("name"))
+        for e in events if e.get("ph") == "X"
+    ])
 
 
 def test_event_ring_bounded(rt):

@@ -207,6 +207,14 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
                 if l.startswith("raytpu_device_hbm_bytes_in_use{")]
     assert _sample_value(text, "raytpu_serve_ttft_seconds_count") >= 1
     assert _sample_value(text, "raytpu_serve_tpot_seconds_count") >= 1
+    # Request-lifecycle plane: the engine request above reached FINISHED,
+    # so the SLO/terminal/ITL families must all be live in the scrape.
+    assert _sample_value(
+        text, "raytpu_serve_request_itl_seconds_count") >= 1
+    assert _sample_value(
+        text, 'raytpu_serve_request_terminal_total{state="FINISHED"}') >= 1
+    assert _sample_value(
+        text, 'raytpu_serve_request_slo_total{outcome="met"}') >= 1
     assert "raytpu_serve_router_requests_total{" in text
     assert "raytpu_serve_request_latency_seconds_bucket{" in text
     assert "raytpu_data_op_tasks_total{" in text
@@ -245,3 +253,35 @@ def test_check_metrics_flags_bad_names():
                for p in problems)
     assert any("raytpu_bad.name" in p for p in problems)
     assert any("duplicate family" in p for p in problems)
+
+
+def test_check_metrics_label_consistency_and_require():
+    cm = _load_check_metrics()
+    # One family, two label-key shapes -> flagged; `le` (histogram
+    # buckets) and `proc` (federation) never count against a family.
+    mixed = (
+        "# HELP raytpu_serve_requests x\n"
+        "# TYPE raytpu_serve_requests gauge\n"
+        'raytpu_serve_requests{State="FINISHED"} 1\n'
+        "raytpu_serve_requests 2\n"
+    )
+    problems = cm.check_exposition(mixed)
+    assert any("inconsistent label sets" in p
+               and "raytpu_serve_requests" in p for p in problems)
+    clean = (
+        "# HELP raytpu_serve_ttft_seconds x\n"
+        "# TYPE raytpu_serve_ttft_seconds histogram\n"
+        'raytpu_serve_ttft_seconds_bucket{le="1"} 1\n'
+        'raytpu_serve_ttft_seconds_bucket{le="+Inf"} 1\n'
+        "raytpu_serve_ttft_seconds_sum 0.5\n"
+        "raytpu_serve_ttft_seconds_count 1\n"
+        'raytpu_serve_ttft_seconds_count{proc="worker-1"} 1\n'
+    )
+    assert cm.check_exposition(clean) == []
+    # --require fails when an expected family is missing, passes when
+    # present.
+    assert any("required family" in p and "raytpu_absent_total" in p
+               for p in cm.check_exposition(
+                   clean, require=["raytpu_absent_total"]))
+    assert not any("required family" in p for p in cm.check_exposition(
+        clean, require=["raytpu_serve_ttft_seconds"]))
