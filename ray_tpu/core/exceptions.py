@@ -54,6 +54,31 @@ class ActorUnavailableError(ActorError):
     pass
 
 
+class PreemptedError(RayTpuError):
+    """The replica serving this request was preempted (drain, SIGTERM,
+    maintenance event) before the request finished.  Carries the
+    continuation payload — everything a surviving replica needs to
+    resume generation with one re-prefill and no token loss:
+
+        {"prompt": [...], "tokens": [... generated so far ...],
+         "temperature": float, "request_id": str}
+
+    The serve router treats this as retriable; it is NOT a failure of
+    the request itself."""
+
+    def __init__(self, reason: str = "replica preempted",
+                 continuation: Optional[dict] = None):
+        self.reason = reason
+        self.continuation = continuation or {}
+        generated = len(self.continuation.get("tokens", ()))
+        super().__init__(
+            f"{reason} (continuation: {generated} generated tokens)"
+        )
+
+    def __reduce__(self):
+        return (type(self), (self.reason, self.continuation))
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

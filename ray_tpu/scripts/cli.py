@@ -82,7 +82,8 @@ _LIST_ROUTES = {
                          ["placement_group_id", "strategy", "state"]),
     "requests": ("/api/v0/requests",
                  ["request_id", "engine", "state", "prompt_tokens",
-                  "generated_tokens", "slot", "terminal_cause"]),
+                  "generated_tokens", "slot", "attempt",
+                  "terminal_cause"]),
 }
 
 

@@ -12,9 +12,11 @@ and broadcast routing tables over long-poll.
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time
+import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import api
@@ -23,10 +25,41 @@ from ray_tpu.serve.deployment import DeploymentInfo
 from ray_tpu.serve.long_poll import LongPollHost
 from ray_tpu.serve.replica import ReplicaActor
 
+log = logging.getLogger(__name__)
+
 CONTROLLER_NAME = "serve::controller"
 ROUTES_KEY = "routes"
 
 RECONCILE_PERIOD_S = 0.05
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Controller metric singletons (re-registered on refetch — see
+    llm_engine._telemetry for the registry-clear rationale)."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "drains": metrics.Counter(
+                "raytpu_serve_replica_drains_total",
+                "Replica drains begun (preemption notices, SIGTERM, "
+                "drain_replica RPCs), by deployment.",
+                tag_keys=("deployment",),
+            ),
+            "reconcile_errors": metrics.Counter(
+                "raytpu_serve_reconcile_errors_total",
+                "Exceptions swallowed by the controller reconcile "
+                "loop — nonzero means the control plane is limping.",
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
 
 
 def replica_set_key(app_name: str, deployment_name: str) -> str:
@@ -38,9 +71,17 @@ class _Replica:
         self.replica_id = replica_id
         self.handle = handle
         self.creation_ref = creation_ref
-        self.state = "STARTING"  # STARTING | RUNNING | STOPPING
+        # STARTING | RUNNING | DRAINING | STOPPING.  DRAINING = alive
+        # and still routable (it finishes what it has, rejects new
+        # work) while a replacement starts; it leaves the broadcast
+        # table only once RUNNING capacity is back at target.
+        self.state = "STARTING"
         self.health_ref = None
         self.last_health_check = time.monotonic()
+        # Drain bookkeeping: retirement waits for in-flight work to
+        # settle (ongoing_ref polls the replica) up to drain_deadline.
+        self.drain_deadline = None
+        self.ongoing_ref = None
 
 
 class _DeploymentState:
@@ -135,6 +176,8 @@ class ServeController:
         self._deployments: Dict[Tuple[str, str], _DeploymentState] = {}
         self._routes: Dict[str, Tuple[str, str]] = {}  # prefix -> (app, ingress)
         self._app_ingress: Dict[str, str] = {}
+        self._tm = _telemetry()
+        self._reconcile_errors_seen: set = set()
         self._shutdown = threading.Event()
         threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"
@@ -201,6 +244,45 @@ class ServeController:
             if st is not None:
                 st.record_metric(replica_id, ongoing, ts)
 
+    def drain_replica(self, app_name: str, deployment_name: str,
+                      replica_id: str,
+                      grace_s: Optional[float] = None) -> bool:
+        """Deliver a preemption notice to one replica (the node-daemon
+        maintenance-event path): flip it to DRAINING and send the drain
+        RPC.  A replacement starts on the next reconcile pass while the
+        draining replica stays in the route table.  Returns False for
+        unknown or non-RUNNING replicas."""
+        with self._lock:
+            st = self._deployments.get((app_name, deployment_name))
+            if st is None:
+                raise ValueError(
+                    f"no deployment {deployment_name!r} in app "
+                    f"{app_name!r}")
+            r = st.replicas.get(replica_id)
+            if r is None:
+                return False
+            return self._mark_draining(st, r, grace_s=grace_s)
+
+    def _mark_draining(self, st: _DeploymentState, r: _Replica, *,
+                       grace_s: Optional[float] = None,
+                       notify: bool = True) -> bool:
+        if r.state != "RUNNING":
+            return False
+        r.state = "DRAINING"
+        grace = (grace_s if grace_s is not None
+                 else st.config.graceful_shutdown_timeout_s)
+        # After the engine's grace expires it evicts what's left, so
+        # in-flight work settles shortly after; the margin only bounds
+        # a wedged replica.
+        r.drain_deadline = time.monotonic() + grace + 30.0
+        self._tm["drains"].inc(tags={"deployment": st.info.name})
+        if notify:
+            try:
+                r.handle.drain.remote(grace)
+            except Exception:
+                r.state = "STOPPING"  # can't even reach it — replace
+        return True
+
     def status(self) -> Dict[str, Any]:
         with self._lock:
             out: Dict[str, Any] = {"applications": {}}
@@ -256,7 +338,21 @@ class ServeController:
             try:
                 self._reconcile_once()
             except Exception:
-                pass
+                # A wedged reconcile loop must be visible, not silent:
+                # count every swallowed error and log the traceback the
+                # first time each distinct error appears (distinct =
+                # the final exception line, so a repeating failure
+                # doesn't flood the log at 20 Hz).
+                self._tm["reconcile_errors"].inc()
+                tb = traceback.format_exc()
+                key = tb.strip().splitlines()[-1]
+                if key not in self._reconcile_errors_seen:
+                    self._reconcile_errors_seen.add(key)
+                    log.error(
+                        "serve reconcile loop error (repeats of this "
+                        "error are counted in "
+                        "raytpu_serve_reconcile_errors_total but not "
+                        "re-logged):\n%s", tb)
 
     def _reconcile_once(self):
         now = time.monotonic()
@@ -288,11 +384,17 @@ class ServeController:
     def _check_health(self, st: _DeploymentState, now: float):
         rt = api.runtime()
         for r in st.replicas.values():
-            if r.state != "RUNNING":
+            if r.state not in ("RUNNING", "DRAINING"):
                 continue
             if r.health_ref is not None and rt.store.contains(r.health_ref.id):
                 try:
-                    api.get(r.health_ref)
+                    verdict = api.get(r.health_ref)
+                    if verdict == "DRAINING" and r.state == "RUNNING":
+                        # Self-reported preemption notice (SIGTERM /
+                        # node maintenance): the replica already began
+                        # draining itself, so track it without sending
+                        # another drain RPC.
+                        self._mark_draining(st, r, notify=False)
                 except Exception:
                     r.state = "STOPPING"  # unhealthy → replace
                 r.health_ref = None
@@ -304,8 +406,21 @@ class ServeController:
 
     def _scale(self, st: _DeploymentState) -> bool:
         changed = False
-        # Stop replicas marked STOPPING, and excess RUNNING ones.
         running = [r for r in st.replicas.values() if r.state == "RUNNING"]
+        # Retire draining replicas only once RUNNING capacity is back
+        # at target AND their in-flight requests have settled: until
+        # then they stay in the broadcast table, so a drain never dips
+        # routable capacity, and killing the replica can't seal
+        # ActorDiedError into a live stream.  The broadcast that drops
+        # them is the same one that announces their replacement.
+        if st.deleting or len(running) >= st.target_replicas:
+            for r in st.replicas.values():
+                if r.state != "DRAINING":
+                    continue
+                if st.deleting or self._drain_settled(r):
+                    r.state = "STOPPING"
+                    changed = True
+        # Stop replicas marked STOPPING, and excess RUNNING ones.
         excess = len(running) + sum(
             1 for r in st.replicas.values() if r.state == "STARTING"
         ) - st.target_replicas
@@ -330,6 +445,28 @@ class ServeController:
                for r in st.replicas.values()):
             changed = True
         return changed
+
+    def _drain_settled(self, r: _Replica) -> bool:
+        """True once a DRAINING replica has no in-flight requests, or
+        its drain deadline passed (a wedged drain must not pin the
+        replica forever).  Polled without blocking the reconcile loop:
+        one outstanding num_ongoing_requests RPC at a time."""
+        if (r.drain_deadline is not None
+                and time.monotonic() >= r.drain_deadline):
+            return True
+        if r.ongoing_ref is None:
+            try:
+                r.ongoing_ref = r.handle.num_ongoing_requests.remote()
+            except Exception:
+                return True  # unreachable — nothing left to protect
+            return False
+        if not api.runtime().store.contains(r.ongoing_ref.id):
+            return False
+        ref, r.ongoing_ref = r.ongoing_ref, None
+        try:
+            return api.get(ref) == 0
+        except Exception:
+            return True
 
     def _start_replica(self, st: _DeploymentState):
         idx = st.next_replica_idx
@@ -378,7 +515,10 @@ class ServeController:
                     or _inspect.isasyncgenfunction(call))
         table = []
         for r in st.replicas.values():
-            if r.state == "RUNNING":
+            # DRAINING replicas stay routable (they finish in-flight
+            # work and bounce new requests with PreemptedError, which
+            # the router retries) until _scale retires them.
+            if r.state in ("RUNNING", "DRAINING"):
                 r._announced = True
                 table.append(
                     (r.replica_id, r.handle, st.config.max_ongoing_requests,

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.core.exceptions import PreemptedError
 from ray_tpu.serve import request_events as _reqev
 from ray_tpu.util import tracing
 
@@ -409,6 +410,27 @@ class LLMServer:
         return {"tokens": tokens, "metrics": stream.metrics,
                 "request_id": stream.request_id}
 
+    def stream(self, payload: Dict[str, Any]):
+        """Streaming entry (serve data plane, ``stream=True`` handles):
+        yields tokens as the engine generates them.  A preemption
+        surfaces as PreemptedError AFTER every already-generated token
+        has been yielded, so the router's failover knows the exact
+        delivered prefix."""
+        stream = self.engine.submit(
+            payload["tokens"],
+            max_new_tokens=payload.get("max_new_tokens"),
+            temperature=payload.get("temperature", 0.0),
+            request_id=payload.get("request_id"),
+        )
+        for tok in stream:
+            yield tok
+
+    def drain(self, grace_s: float = 5.0) -> int:
+        """Preemption notice: drain the engine (stop admitting, evict
+        long requests with continuations).  Called by the replica's
+        drain path."""
+        return self.engine.drain(grace_s)
+
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
 
@@ -503,6 +525,12 @@ class LLMEngine:
         self._inflight_tokens: Dict[int, int] = {}  # slot → undelivered
         self._req_counter = itertools.count()
         self._stopped = threading.Event()
+        # Preemption-aware drain (see drain()): _draining stops
+        # admission, _drain_evict tells the loop to preempt whatever is
+        # still in flight.  Both are one-way latches.
+        self._draining = threading.Event()
+        self._drain_evict = threading.Event()
+        self._preempted_count = 0
         self._work = threading.Event()
         self._steps = 0
         self._tokens_out = 0
@@ -667,6 +695,15 @@ class LLMEngine:
                request_id: Optional[str] = None) -> CompletionStream:
         if self._stopped.is_set():
             raise RuntimeError("engine is stopped (shut down or crashed)")
+        if self._draining.is_set():
+            # Uniform failover signal: the router resubmits elsewhere
+            # exactly like a mid-stream preemption, with an empty
+            # generated prefix.
+            raise PreemptedError(
+                "engine is draining: not admitting new requests",
+                continuation={"prompt": list(prompt), "tokens": [],
+                              "temperature": float(temperature),
+                              "request_id": request_id or ""})
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) >= self.config.max_seq_len:
@@ -718,6 +755,48 @@ class LLMEngine:
             self._cancels.add(request_id)
         self._work.set()
 
+    def drain(self, grace_s: float = 5.0) -> int:
+        """Preemption-aware drain: stop admitting, give requests
+        already in a slot ``grace_s`` to finish, then evict the
+        survivors with a PREEMPTED terminal whose PreemptedError
+        carries the continuation payload (prompt + tokens generated so
+        far + sampling state) — everything a surviving replica needs to
+        resume with one re-prefill.  Requests that never reached a slot
+        are evicted immediately (admission is the thing a drain stops).
+        Blocking; callable from any thread; idempotent.  Returns the
+        number of requests preempted so far."""
+        if self._stopped.is_set():
+            return self._preempted_count
+        self._draining.set()
+        self._work.set()
+        deadline = time.monotonic() + max(0.0, grace_s)
+        while (time.monotonic() < deadline
+               and not self._stopped.is_set()
+               and not self._drain_idle()):
+            time.sleep(0.01)
+        self._drain_evict.set()
+        self._work.set()
+        # The loop owns slot/page state; give it a bounded window to
+        # run the eviction pass.
+        evict_deadline = time.monotonic() + 5.0
+        while (time.monotonic() < evict_deadline
+               and not self._stopped.is_set()
+               and not self._drain_idle()):
+            time.sleep(0.01)
+        return self._preempted_count
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def _drain_idle(self) -> bool:
+        """No request the drain still has to account for."""
+        if self._slot_req or not self._waiting.empty() or self._admitting:
+            return False
+        if self._prefilling or (self._paged and self._backlog):
+            return False
+        return True
+
     def generate(self, prompt: List[int], **kw) -> List[int]:
         return self.submit(prompt, **kw).result()
 
@@ -759,6 +838,8 @@ class LLMEngine:
         raise ValueError(f"prompt length {n} exceeds max bucket")
 
     def _admit(self):
+        if self._draining.is_set():
+            return  # racing submits are preempted, never admitted
         if self._paged:
             return self._admit_paged()
         while self._free_slots:
@@ -1431,6 +1512,50 @@ class LLMEngine:
             for req in kept:
                 self._waiting.put(req)
 
+    def _preempt_request(self, req: Request,
+                         slot: Optional[int]) -> None:
+        """Evict one request with a PREEMPTED terminal.  Its stream
+        ends by raising PreemptedError carrying the continuation
+        payload, so the consumer knows exactly which generated prefix
+        it already holds."""
+        if slot is not None:
+            self._release_slot(slot)
+        req.finished_at = time.monotonic()
+        self._observe_request(req, state=_reqev.PREEMPTED,
+                              cause="preempted")
+        self._preempted_count += 1
+        req.stream.put(PreemptedError(
+            "replica draining: request evicted",
+            continuation={"prompt": list(req.prompt),
+                          "tokens": list(req.tokens),
+                          "temperature": req.temperature,
+                          "request_id": req.request_id}))
+
+    def _process_drain(self) -> None:
+        """Loop-side half of drain(): while draining, requests that
+        never reached a slot are preempted immediately (admission has
+        stopped, they can only rot); once the grace window expires
+        (_drain_evict), everything still in a slot goes too."""
+        if not self._draining.is_set():
+            return
+        while True:
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                break
+            self._preempt_request(req, None)
+        if self._paged:
+            for req in list(self._backlog):
+                self._backlog.remove(req)
+                self._preempt_request(req, None)
+        if not self._drain_evict.is_set():
+            return
+        for st in list(self._prefilling):
+            self._prefilling.remove(st)
+            self._preempt_request(st["req"], st["slot"])
+        for slot, req in list(self._slot_req.items()):
+            self._preempt_request(req, slot)
+
     # Dispatched-but-unemitted entries: enough to keep the device and
     # the fetch pipe full; budget gating bounds per-slot run-ahead.
     _PIPELINE_DEPTH = 6
@@ -1480,6 +1605,7 @@ class LLMEngine:
     def _loop_body(self):
         while not self._stopped.is_set():
             self._process_cancels()
+            self._process_drain()
             backlog = self._paged and (self._backlog or self._prefilling)
             if (not self._slot_req and self._waiting.empty()
                     and not backlog and self._unprocessed == 0):

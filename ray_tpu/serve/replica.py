@@ -13,6 +13,8 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ray_tpu.core.actor import method
+from ray_tpu.core.exceptions import PreemptedError
 from ray_tpu.serve.deployment import _HandlePlaceholder
 from ray_tpu.util import tracing
 
@@ -86,12 +88,45 @@ class ReplicaActor:
             self._callable = func_or_class
         if user_config is not None:
             self.reconfigure(user_config)
+        # Preemption-aware drain: once flipped the replica rejects new
+        # data-plane requests with PreemptedError (the router retries
+        # them on a surviving replica) and reports DRAINING from
+        # check_health so the controller starts a replacement.
+        self._draining = False
+        self._install_sigterm_drain()
         self._metrics_stop = threading.Event()
         if metrics_interval_s > 0:
             threading.Thread(
                 target=self._push_metrics_loop, args=(metrics_interval_s,),
                 daemon=True, name=f"metrics-{replica_id}",
             ).start()
+
+    def _install_sigterm_drain(self) -> None:
+        """Best-effort preemption notice: a SIGTERM (cloud preemption
+        warning) drains the replica instead of letting it die hot with
+        every stream attached.  Only installable from a process main
+        thread (process-mode replicas); thread-mode replicas get the
+        same behavior through the controller's drain_replica RPC."""
+        import signal
+
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _on_sigterm(signum, frame):
+                threading.Thread(target=self.drain, daemon=True,
+                                 name=f"drain-{self.replica_id}").start()
+                if callable(prev):
+                    prev(signum, frame)
+
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):
+            pass
+
+    def _reject_if_draining(self) -> None:
+        if self._draining:
+            raise PreemptedError(
+                f"replica {self.replica_id} is draining: not accepting "
+                f"new requests")
 
     # -- data plane --------------------------------------------------------
 
@@ -113,6 +148,7 @@ class ReplicaActor:
         from ray_tpu.serve import multiplex as _mux
         from ray_tpu.serve import request_events as _reqev
 
+        self._reject_if_draining()
         # Upstream DeploymentResponses arrive as refs nested inside the
         # args tuple — resolve them here (parity: the reference resolves
         # response args before invoking the user method).
@@ -171,6 +207,7 @@ class ReplicaActor:
         from ray_tpu.serve import multiplex as _mux
         from ray_tpu.serve import request_events as _reqev
 
+        self._reject_if_draining()
         # List comp, not genexp: a generator expression containing
         # ``await`` is an async generator, which tuple() rejects.
         args = tuple(
@@ -231,7 +268,79 @@ class ReplicaActor:
                 self._ongoing -= 1
                 self._tm["ongoing"].set(self._ongoing, tags=self._tags)
 
+    @method(num_returns="streaming")
+    def handle_request_streaming(self, method_name: str, args: tuple,
+                                 kwargs: dict, metadata: dict = None):
+        """Streaming data plane: the user target returns an iterable
+        (e.g. ``LLMServer.stream``) and each item rides back as one
+        stream element.  A replica death or preemption seals the error
+        AFTER every already-yielded item, so the consumer-side failover
+        (handle.DeploymentResponseGenerator) resumes from exactly the
+        delivered prefix."""
+        from ray_tpu.core import api
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.serve import multiplex as _mux
+        from ray_tpu.serve import request_events as _reqev
+        from ray_tpu.utils.test_utils import fail_point
+
+        self._reject_if_draining()
+        fail_point("replica.stream")
+        args = tuple(
+            api.get(a) if isinstance(a, ObjectRef) else a for a in args
+        )
+        kwargs = {
+            k: api.get(v) if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+        t0 = time.perf_counter()
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+            self._tm["ongoing"].set(self._ongoing, tags=self._tags)
+        mux_token = _mux._set_model_id(
+            (metadata or {}).get("multiplexed_model_id", "")
+        )
+        rid_token = _reqev.set_request_id(
+            (metadata or {}).get("request_id", "")
+        )
+        try:
+            with tracing.span(
+                    "serve.replica",
+                    attributes={"deployment": self.deployment_name,
+                                "replica": self.replica_id,
+                                "method": method_name,
+                                "streaming": True,
+                                "request_id":
+                                    (metadata or {}).get("request_id")}):
+                for item in self._target(method_name)(*args, **kwargs):
+                    yield item
+        finally:
+            _reqev.reset_request_id(rid_token)
+            _mux._reset_model_id(mux_token)
+            self._tm["latency"].observe(
+                time.perf_counter() - t0,
+                tags={"deployment": self.deployment_name})
+            with self._lock:
+                self._ongoing -= 1
+                self._tm["ongoing"].set(self._ongoing, tags=self._tags)
+
     # -- control plane -----------------------------------------------------
+
+    def drain(self, grace_s: float = 5.0) -> str:
+        """Preemption notice (controller drain_replica RPC, SIGTERM, or
+        a node-daemon maintenance event): stop accepting new requests
+        and hand the notice down to the user callable's ``drain`` hook
+        when it has one (LLMServer drains its engine — short requests
+        finish, long ones are evicted with continuations).  Idempotent;
+        returns the DRAINING health state."""
+        with self._lock:
+            already = self._draining
+            self._draining = True
+        if not already:
+            fn = getattr(self._callable, "drain", None)
+            if fn is not None:
+                fn(grace_s)
+        return "DRAINING"
 
     def get_metadata(self) -> Dict[str, Any]:
         with self._lock:
@@ -254,7 +363,12 @@ class ReplicaActor:
             )
         fn(user_config)
 
-    def check_health(self) -> bool:
+    def check_health(self):
+        """True = healthy; the string "DRAINING" = alive but draining
+        (the controller starts a replacement without tearing this
+        replica out of the route table first); raises = unhealthy."""
+        if self._draining:
+            return "DRAINING"
         fn = getattr(self._callable, "check_health", None)
         if fn is not None:
             fn()  # raises on unhealthy (parity: serve health-check contract)

@@ -8,9 +8,12 @@ LLM serving stack cannot live without.  Every ``LLMEngine`` owns a
 bounded ring recording each request's state machine
 
     QUEUED → PREFILLING → DECODING → FINISHED | FAILED | CANCELLED
+                                   | PREEMPTED (drained attempt)
 
 with wall-clock timestamps, token counts, slot/page assignment and the
-terminal cause.  ``util/state.list_requests`` / ``summarize_requests``,
+terminal cause.  Serve routers keep their own ring per deployment with
+the router-side view — QUEUED → RETRYING (per failed attempt, with an
+attempt counter + history) → FINISHED | FAILED.  ``util/state.list_requests`` / ``summarize_requests``,
 the dashboard's ``/api/v0/requests`` routes, ``raytpu list requests``
 and the request rows in ``ray_tpu.timeline()`` all read from here.
 
@@ -38,19 +41,27 @@ import weakref
 from typing import Any, Dict, List, Optional
 
 # Request state vocabulary (the serving analogue of common.proto's
-# TaskStatus in core/events.py).
+# TaskStatus in core/events.py).  RETRYING is a router-side state: the
+# request's current attempt died (replica preempted or killed) and a
+# new attempt is being enqueued on a surviving replica.  PREEMPTED is
+# the engine-side terminal for a drained request — the *attempt* ended
+# there, the request itself continues elsewhere, so it is deliberately
+# distinct from FAILED.
 QUEUED = "QUEUED"
 PREFILLING = "PREFILLING"
 DECODING = "DECODING"
+RETRYING = "RETRYING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
 CANCELLED = "CANCELLED"
+PREEMPTED = "PREEMPTED"
 
-TERMINAL_STATES = (FINISHED, FAILED, CANCELLED)
+TERMINAL_STATES = (FINISHED, FAILED, CANCELLED, PREEMPTED)
 
 # Phase labels for the timeline rows: the span covering [state, next
 # state) is named after what the engine was doing IN that state.
-_PHASE_NAME = {QUEUED: "queued", PREFILLING: "prefill", DECODING: "decode"}
+_PHASE_NAME = {QUEUED: "queued", PREFILLING: "prefill", DECODING: "decode",
+               RETRYING: "retrying"}
 
 
 @dataclasses.dataclass
@@ -67,6 +78,12 @@ class RequestRecord:
     slot: Optional[int] = None
     num_pages: Optional[int] = None
     terminal_cause: Optional[str] = None
+    # Failover bookkeeping (router rings): attempt is the current
+    # 0-based attempt number; attempts accumulates one row per retry
+    # with the replica it left and why — the "attempt history" shown by
+    # ``raytpu list requests --detail``.
+    attempt: int = 0
+    attempts: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     @property
     def state(self) -> str:
@@ -129,7 +146,9 @@ class RequestEventBuffer:
                generated_tokens: Optional[int] = None,
                slot: Optional[int] = None,
                num_pages: Optional[int] = None,
-               terminal_cause: Optional[str] = None) -> None:
+               terminal_cause: Optional[str] = None,
+               attempt: Optional[int] = None,
+               attempt_info: Optional[Dict[str, Any]] = None) -> None:
         now = time.time()
         with self._lock:
             rec = self._records.get(request_id)
@@ -143,9 +162,15 @@ class RequestEventBuffer:
                 return  # first terminal verdict wins
             # First-entry wins: a state is ENTERED once; re-records (the
             # incremental-prefill path re-announces PREFILLING at its
-            # final chunk) keep the original stamp, so phase timestamps
-            # stay monotone in record order.
+            # final chunk, the failover path re-announces RETRYING per
+            # attempt) keep the original stamp, so phase timestamps
+            # stay monotone in record order.  Retry history rides the
+            # attempt counter + attempts log instead of state_ts.
             rec.state_ts.setdefault(state, now)
+            if attempt is not None:
+                rec.attempt = attempt
+            if attempt_info is not None:
+                rec.attempts.append(dict(attempt_info, ts=now))
             if prompt_tokens is not None:
                 rec.prompt_tokens = prompt_tokens
             if generated_tokens is not None:
@@ -176,7 +201,9 @@ class RequestEventBuffer:
 
     def snapshot(self) -> List[RequestRecord]:
         with self._lock:
-            return [dataclasses.replace(r, state_ts=dict(r.state_ts))
+            return [dataclasses.replace(r, state_ts=dict(r.state_ts),
+                                        attempts=[dict(a)
+                                                  for a in r.attempts])
                     for r in self._records.values()]
 
     def counts_by_state(self) -> Dict[str, int]:

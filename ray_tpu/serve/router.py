@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import api
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.serve import request_events as _reqev
 from ray_tpu.util import tracing
 
 _TELEMETRY = None
@@ -38,6 +39,12 @@ def _telemetry():
             "inflight": metrics.Gauge(
                 "raytpu_serve_router_inflight",
                 "Requests assigned but not yet completed, by deployment.",
+                tag_keys=("deployment",),
+            ),
+            "retries": metrics.Counter(
+                "raytpu_serve_request_retries_total",
+                "In-flight request attempts re-enqueued on a surviving "
+                "replica after a death or preemption, by deployment.",
                 tag_keys=("deployment",),
             ),
         }
@@ -76,6 +83,13 @@ class Router:
         self._stopped = threading.Event()
         self._client = None
         self._tm = _telemetry()
+        # Router-side request ring: the failover view (QUEUED →
+        # RETRYING per failed attempt → terminal) of every request this
+        # router owns, federated into state.list_requests next to the
+        # engine-side rings.  The router holds the strong ref.
+        self._ring = _reqev.RequestEventBuffer(
+            f"router:{app_name}/{deployment_name}")
+        _reqev.register(self._ring)
         self._subscribe()
         threading.Thread(
             target=self._reaper_loop, daemon=True,
@@ -127,21 +141,21 @@ class Router:
     def assign(self, method_name: str, args: tuple, kwargs: dict,
                timeout: Optional[float] = None,
                exclude: Optional[set] = None,
-               model_id: str = "") -> Tuple[ObjectRef, str]:
+               model_id: str = "",
+               request_id: Optional[str] = None) -> Tuple[ObjectRef, str]:
         """Pick a replica (power of two choices on in-flight counts,
         respecting max_ongoing_requests backpressure) and submit.
         ``exclude``: replica ids observed dead by the caller — never
         re-picked (ids are unique forever, so this can't starve a healthy
         replica; if everything is excluded we wait for the controller's
-        replacement broadcast)."""
+        replacement broadcast).  ``request_id``: pass the same id on a
+        retry so every attempt shares one identity end to end."""
         deadline = None if timeout is None else time.monotonic() + timeout
         # Mint the end-to-end request id HERE (or inherit one from an
         # upstream hop): it rides request metadata to the replica,
         # which installs it as ambient context for the user callable —
         # LLMEngine.submit, spans, and log lines all pick it up.
-        from ray_tpu.serve import request_events as _reqev
-
-        request_id = (_reqev.get_request_id()
+        request_id = (request_id or _reqev.get_request_id()
                       or _reqev.new_request_id())
         # The request's root span: replica selection (with its queue
         # wait) and the submit happen inside it, so the replica's task
@@ -168,6 +182,76 @@ class Router:
                 len(self._outstanding),
                 tags={"deployment": self.deployment_name})
         return ref, chosen.replica_id
+
+    def assign_streaming(self, method_name: str, args: tuple, kwargs: dict,
+                         timeout: Optional[float] = None,
+                         exclude: Optional[set] = None,
+                         model_id: str = "",
+                         request_id: Optional[str] = None):
+        """Streaming assignment: dispatch handle_request_streaming on
+        the chosen replica and return (ObjectRefGenerator, replica_id,
+        request_id).  Streaming in-flight accounting is caller-driven —
+        call finish_streaming(replica_id, ...) when the stream ends,
+        since the reaper has no single completion ref to poll."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        request_id = (request_id or _reqev.get_request_id()
+                      or _reqev.new_request_id())
+        with tracing.span(
+                "serve.request",
+                attributes={"deployment": self.deployment_name,
+                            "method": method_name,
+                            "streaming": True,
+                            "request_id": request_id}):
+            with tracing.span("serve.queue_wait"):
+                chosen = self._select_replica(deadline, timeout, exclude,
+                                              model_id)
+            metadata = {"request_id": request_id}
+            if model_id:
+                metadata["multiplexed_model_id"] = model_id
+            gen = chosen.handle.handle_request_streaming.remote(
+                method_name, args, kwargs, metadata
+            )
+        self._tm["requests"].inc(
+            tags={"deployment": self.deployment_name})
+        return gen, chosen.replica_id, request_id
+
+    def finish_streaming(self, replica_id: str, *,
+                         died: bool = False) -> None:
+        """End-of-stream bookkeeping for assign_streaming: release the
+        in-flight slot; ``died`` evicts the replica (and every
+        outstanding entry attributed to it) without waiting for the
+        controller's next broadcast."""
+        with self._cv:
+            info = self._replicas.get(replica_id)
+            if info is not None and info.inflight > 0:
+                info.inflight -= 1
+            if died:
+                self._evict_replica_locked(replica_id)
+            self._cv.notify_all()
+
+    # -- failover ring ------------------------------------------------------
+
+    def note_queued(self, request_id: str, prompt_tokens: int = 0) -> None:
+        self._ring.record(request_id, _reqev.QUEUED,
+                          prompt_tokens=prompt_tokens)
+
+    def note_retry(self, request_id: str, attempt: int, replica_id: str,
+                   reason: str) -> None:
+        """One failed attempt: RETRYING transition + attempt history +
+        the retries counter."""
+        self._ring.record(request_id, _reqev.RETRYING, attempt=attempt,
+                          attempt_info={"attempt": attempt,
+                                        "replica": replica_id,
+                                        "reason": reason})
+        self._tm["retries"].inc(
+            tags={"deployment": self.deployment_name})
+
+    def note_terminal(self, request_id: str, state: str,
+                      cause: Optional[str] = None,
+                      generated_tokens: Optional[int] = None) -> None:
+        self._ring.record(request_id, state,
+                          generated_tokens=generated_tokens,
+                          terminal_cause=cause)
 
     def _select_replica(self, deadline, timeout, exclude, model_id):
         with self._cv:
@@ -214,12 +298,32 @@ class Router:
                 self._cv.wait(0.05 if remaining is None else min(remaining, 0.05))
         return chosen
 
+    def _evict_replica_locked(self, replica_id: Optional[str]) -> None:
+        """Drop a dead replica from the local table AND release every
+        outstanding entry still attributed to it.  A dead actor seals
+        ActorDiedError on all of its queued refs at once; popping only
+        the ref that happened to complete first would leave the rest
+        charged to a replica that no longer exists — the inflight gauge
+        (and any future broadcast re-adding the same id) would leak.
+        Caller holds self._cv."""
+        if replica_id is None:
+            return
+        self._replicas.pop(replica_id, None)
+        orphaned = [ref for ref, rid in self._outstanding.items()
+                    if rid == replica_id]
+        for ref in orphaned:
+            del self._outstanding[ref]
+        self._tm["inflight"].set(
+            len(self._outstanding),
+            tags={"deployment": self.deployment_name})
+
     def _reaper_loop(self):
         """Decrement in-flight counts as results land (parity: the
         completion callbacks the reference attaches to assignments).
         A result carrying ActorDiedError evicts the replica from the
         local table immediately — faster than waiting for the
-        controller's next broadcast."""
+        controller's next broadcast — and releases every outstanding
+        entry attributed to the dead replica in the same pass."""
         from ray_tpu.core.exceptions import ActorDiedError
 
         rt = api.runtime()
@@ -234,12 +338,14 @@ class Router:
             with self._cv:
                 for ref in done:
                     replica_id = self._outstanding.pop(ref, None)
+                    if replica_id is None:
+                        continue  # released by an earlier eviction
                     info = self._replicas.get(replica_id)
                     if info is not None and info.inflight > 0:
                         info.inflight -= 1
                     err = rt.store.peek_error(ref.id)
                     if isinstance(err, ActorDiedError):
-                        self._replicas.pop(replica_id, None)
+                        self._evict_replica_locked(replica_id)
                 self._tm["inflight"].set(
                     len(self._outstanding),
                     tags={"deployment": self.deployment_name})

@@ -1,0 +1,319 @@
+"""Serve-plane fault tolerance: preemption-aware draining and
+mid-stream LLM failover with continuation replay.
+
+Two scenarios, both driven through the public handle API against real
+replica actors:
+
+- Chaos: a replica is hard-killed (SIGKILL semantics — the actor is
+  marked dead and the interrupt is delivered into its running request
+  threads) while >= 8 streaming completions are mid-decode.  Every
+  stream must finish with the exact token sequence of an unkilled
+  greedy run: the failover resumes from prompt + delivered prefix on a
+  surviving replica, so no token is lost, duplicated, or changed.
+
+- Plain drain: a replica receives a preemption notice through the
+  controller.  In-flight requests finish on the draining replica
+  (zero retries), the replacement replica joins the route table before
+  the draining one leaves it (no capacity dip), and the drain counter
+  moves.
+
+Both are deterministic: seeded victim choice, greedy (temperature=0)
+decoding, bounded waits everywhere.
+"""
+
+import dataclasses
+import re
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core import api
+from ray_tpu.models import llama
+from ray_tpu.serve import request_events
+from ray_tpu.serve.llm_engine import EngineConfig, LLMServer, llama_adapter
+from ray_tpu.utils.test_utils import ReplicaKiller
+
+CFG = llama.LlamaConfig(
+    vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    mlp_dim=64, max_seq_len=128, remat=False,
+)
+
+APP = "llmft"
+DEP = "LLMServer"
+ROUTER_RING = f"router:{APP}/{DEP}"
+
+# 12 new tokens keeps every resumed continuation's re-prefill (prompt
+# + delivered prefix <= 15 tokens) inside the 16-token prefill bucket,
+# the one the recompute oracle is exact against for this tiny config.
+N_STREAMS = 8
+N_NEW = 12
+PROMPTS = [[i + 1, i + 2, i + 3] for i in range(N_STREAMS)]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def references(params):
+    """Oracle token sequences: greedy decoding by full-prefix recompute."""
+    out = []
+    for prompt in PROMPTS:
+        toks = list(prompt)
+        gen = []
+        for _ in range(N_NEW):
+            logits = llama.forward(params, jnp.asarray([toks]), CFG)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            gen.append(nxt)
+            toks.append(nxt)
+        out.append(gen)
+    return out
+
+
+def _slow_adapter_factory(cfg):
+    """llama adapter with a throttled decode step, so a 12-token stream
+    spans a comfortably observable window (~0.4 s) and the kill / drain
+    reliably lands mid-decode.  The sleep rides a jax.debug.callback:
+    decode_slots is traced under jit, so a bare time.sleep would only
+    fire at trace time."""
+    base = llama_adapter(cfg)
+
+    def slow_decode(*args, **kwargs):
+        jax.debug.callback(lambda: time.sleep(0.03), ordered=True)
+        return base.decode_slots(*args, **kwargs)
+
+    return dataclasses.replace(base, decode_slots=slow_decode)
+
+
+@pytest.fixture
+def llm_app(params):
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    serve.start()
+    app = serve.deployment(num_replicas=2, max_ongoing_requests=8)(
+        LLMServer
+    ).bind(
+        CFG,
+        # decode_chunk=1: one dispatch per token, so emission is smooth
+        # (one token per throttled step) and a kill mid-decode lands
+        # with a few tokens delivered, not a whole chunk.
+        EngineConfig(max_slots=8, max_seq_len=128, min_prefill_bucket=16,
+                     decode_chunk=1),
+        lambda: params,
+        adapter_factory=_slow_adapter_factory,
+    )
+    handle = serve.run(app, name=APP, route_prefix=None)
+    yield handle
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _metric_value(family: str, deployment: str) -> float:
+    from ray_tpu.util import metrics
+
+    total = 0.0
+    pat = re.compile(
+        rf'^{family}{{[^}}]*deployment="{deployment}"[^}}]*}} (\S+)$')
+    for line in metrics.export_prometheus().splitlines():
+        m = pat.match(line)
+        if m:
+            total += float(m.group(1))
+    return total
+
+
+def _router():
+    from ray_tpu.serve.handle import _routers
+
+    return _routers[(APP, DEP)]
+
+
+def _start_streams(handle):
+    """Launch N_STREAMS streaming completions with consumer threads;
+    returns (gens, outs, errs, threads)."""
+    shandle = handle.options(stream=True)
+    gens = [
+        shandle.remote({"tokens": PROMPTS[i], "max_new_tokens": N_NEW,
+                        "temperature": 0.0})
+        for i in range(N_STREAMS)
+    ]
+    outs = [[] for _ in range(N_STREAMS)]
+    errs = [None] * N_STREAMS
+
+    def consume(i):
+        try:
+            for tok in gens[i]:
+                outs[i].append(tok)
+        except BaseException as e:  # recorded, asserted on below
+            errs[i] = e
+
+    threads = [threading.Thread(target=consume, args=(i,), daemon=True)
+               for i in range(N_STREAMS)]
+    for t in threads:
+        t.start()
+    return gens, outs, errs, threads
+
+
+def _wait_all_decoding(outs, min_tokens=2, timeout_s=180.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if all(len(o) >= min_tokens for o in outs):
+            return
+        time.sleep(0.005)
+    raise TimeoutError(
+        f"streams never reached {min_tokens} tokens: "
+        f"{[len(o) for o in outs]}")
+
+
+def test_midstream_kill_failover_exact_tokens(llm_app, references):
+    """Hard-kill one replica while every stream is mid-decode: all
+    streams finish with the oracle token sequence, no FAILED terminal,
+    RETRYING recorded with an attempt count, retries counter moved."""
+    retries_before = _metric_value(
+        "raytpu_serve_request_retries_total", DEP)
+    gens, outs, errs, threads = _start_streams(llm_app)
+    _wait_all_decoding(outs)
+
+    killer = ReplicaKiller(api.runtime(), seed=0)
+    assert killer.kill_one() is not None
+
+    for t in threads:
+        t.join(timeout=180)
+    assert not any(t.is_alive() for t in threads), \
+        f"streams hung after kill: {[len(o) for o in outs]}"
+    assert errs == [None] * N_STREAMS, f"streams failed: {errs}"
+    assert outs == references  # exact continuation: no loss/dup/change
+
+    rows = [r for r in request_events.snapshot_rows()
+            if r["engine"] == ROUTER_RING]
+    by_id = {r["request_id"]: r for r in rows}
+    assert {g.request_id for g in gens} <= set(by_id)
+    ours = [by_id[g.request_id] for g in gens]
+    assert all(r["state"] == "FINISHED" for r in ours)
+    retried = [r for r in ours if r["attempt"] >= 1]
+    assert retried, "kill landed mid-decode but no attempt was retried"
+    for r in retried:
+        assert "RETRYING" in r["state_ts"]
+        assert r["attempts"] and r["attempts"][0]["replica"]
+    assert _metric_value(
+        "raytpu_serve_request_retries_total", DEP) > retries_before
+
+
+def test_plain_drain_zero_retries_no_capacity_dip(llm_app, references):
+    """Preemption notice through the controller: short in-flight
+    requests finish on the draining replica, the route table never dips
+    below target while the replacement spins up, and the drained
+    replica is eventually rotated out."""
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    router = None
+    retries_before = None
+    gens, outs, errs, threads = _start_streams(llm_app)
+    _wait_all_decoding(outs)
+    router = _router()
+    retries_before = _metric_value(
+        "raytpu_serve_request_retries_total", DEP)
+    drains_before = _metric_value(
+        "raytpu_serve_replica_drains_total", DEP)
+
+    with router._lock:
+        table_before = sorted(router._replicas)
+    assert len(table_before) == 2
+    victim = table_before[0]
+
+    controller = api.get_actor(CONTROLLER_NAME)
+    assert api.get(controller.drain_replica.remote(APP, DEP, victim,
+                                                   30.0))
+
+    # Watch the route table while the drain plays out: the victim must
+    # not leave before a replacement is routable (no capacity dip).
+    min_size = len(table_before)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        with router._lock:
+            ids = sorted(router._replicas)
+        min_size = min(min_size, len(ids))
+        if victim not in ids and len(ids) >= 2:
+            break
+        time.sleep(0.002)
+    with router._lock:
+        ids = sorted(router._replicas)
+    assert victim not in ids, "drained replica never left the table"
+    assert min_size >= 2, "route table dipped below target during drain"
+
+    for t in threads:
+        t.join(timeout=180)
+    assert not any(t.is_alive() for t in threads)
+    assert errs == [None] * N_STREAMS, f"streams failed: {errs}"
+    assert outs == references
+
+    # In-flight work finished inside the grace window: zero retries.
+    assert _metric_value(
+        "raytpu_serve_request_retries_total", DEP) == retries_before
+    assert _metric_value(
+        "raytpu_serve_replica_drains_total", DEP) >= drains_before + 1
+
+    rows = [r for r in request_events.snapshot_rows()
+            if r["engine"] == ROUTER_RING]
+    by_id = {r["request_id"]: r for r in rows}
+    for g in gens:
+        assert by_id[g.request_id]["state"] == "FINISHED"
+        assert by_id[g.request_id]["attempt"] == 0
+
+
+def test_draining_replica_bounces_new_requests_with_retry(llm_app,
+                                                          references):
+    """A request that lands on a draining replica is bounced with
+    PreemptedError and transparently retried on a survivor — the
+    caller just sees the right tokens."""
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    # Prime the router table.
+    out = llm_app.remote(
+        {"tokens": PROMPTS[0], "max_new_tokens": 4, "temperature": 0.0}
+    ).result(timeout_s=180)
+    assert out["tokens"] == references[0][:4]
+
+    router = _router()
+    with router._lock:
+        table = sorted(router._replicas)
+    assert len(table) == 2
+
+    controller = api.get_actor(CONTROLLER_NAME)
+    # Drain BOTH current replicas: any new request must be bounced at
+    # least once before a fresh replica picks it up.
+    for rid in table:
+        api.get(controller.drain_replica.remote(APP, DEP, rid, 5.0))
+
+    gen = llm_app.options(stream=True, max_retries=8).remote(
+        {"tokens": PROMPTS[1], "max_new_tokens": 8, "temperature": 0.0})
+    assert gen.result(timeout_s=180) == references[1][:8]
+
+
+def test_fail_point_env_gated(monkeypatch):
+    """fail_point(): unarmed is a no-op, an armed point fires exactly
+    its budgeted count as a retriable PreemptedError, and re-arming
+    with a new spec resets the table."""
+    from ray_tpu.core.exceptions import PreemptedError
+    from ray_tpu.utils import test_utils as tu
+
+    monkeypatch.delenv("RAYTPU_FAILPOINTS", raising=False)
+    tu.fail_point("replica.stream")  # unarmed: no-op
+
+    monkeypatch.setenv("RAYTPU_FAILPOINTS", "replica.stream:2")
+    for _ in range(2):
+        with pytest.raises(tu.FailPointError) as ei:
+            tu.fail_point("replica.stream")
+        assert ei.value.point == "replica.stream"
+        assert isinstance(ei.value, PreemptedError)  # handle retries it
+    tu.fail_point("replica.stream")  # budget spent: no-op
+    tu.fail_point("other.point")     # unarmed name: no-op
+
+    monkeypatch.setenv("RAYTPU_FAILPOINTS", "other.point")
+    tu.fail_point("replica.stream")  # new spec disarmed this point
+    with pytest.raises(tu.FailPointError):
+        tu.fail_point("other.point")

@@ -222,9 +222,15 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
     assert _sample_value(text, "raytpu_train_steps_total") == 2
     assert _sample_value(text, "raytpu_train_compile_seconds_total") > 0
 
-    # The smoke check passes over the full live exposition.
+    # The smoke check passes over the full live exposition, and the
+    # fault-tolerance families are pinned: a serve session must always
+    # export the retry/drain counters (even at zero) so dashboards and
+    # alerts never silently lose them.
     cm = _load_check_metrics()
-    assert cm.check_exposition(text) == []
+    assert cm.check_exposition(
+        text,
+        require=["raytpu_serve_request_retries_total",
+                 "raytpu_serve_replica_drains_total"]) == []
     assert cm.check_registry() == []
 
 

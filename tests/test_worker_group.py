@@ -128,6 +128,59 @@ def test_failure_config_retries_from_checkpoint(rt):
     assert steps.count(0) == 1 and steps.count(2) == 1
 
 
+def test_failure_config_survives_real_worker_death(rt):
+    """FailureConfig under REAL worker death — the worker actor is
+    hard-killed mid-step (SIGKILL semantics), not an in-loop raise: the
+    whole-run retry restarts from the latest rank-0 checkpoint and the
+    failed attempt's reports stay in the accumulated history."""
+    import os
+    import tempfile
+    import threading
+
+    from ray_tpu.core import api
+    from ray_tpu.utils.test_utils import kill_actor_hard
+
+    marker = os.path.join(tempfile.mkdtemp(), "wedged")
+
+    def loop():
+        start = rtrain.get_checkpoint() or 0
+        for step in range(start, 5):
+            if step == 3 and start == 0:
+                open(marker, "w").close()
+                while True:  # wedged: only actor death frees this step
+                    time.sleep(0.01)
+            rtrain.report({"step": step}, checkpoint=step + 1)
+        return "done"
+
+    def killer():
+        deadline = time.monotonic() + 120
+        while not os.path.exists(marker):
+            if time.monotonic() > deadline:
+                return
+            time.sleep(0.01)
+        runtime = api.runtime()
+        with runtime._lock:
+            victims = [a for a, s in runtime._actors.items()
+                       if not s.dead and s.cls.__name__ == "_TrainWorker"]
+        for actor_id in victims:
+            kill_actor_hard(runtime, actor_id)
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    trainer = rtrain.DataParallelTrainer(
+        loop, num_workers=1,
+        failure_config=rtrain.FailureConfig(max_failures=1),
+    )
+    out = trainer.fit()
+    t.join(timeout=120)
+    assert out.error is None
+    assert out.worker_returns == ["done"]
+    # Attempt 1 reported 0,1,2 then died wedged at 3; attempt 2 resumed
+    # from checkpoint 3 — every step exactly once, none lost or redone.
+    steps = [r["metrics"]["step"] for r in out.metrics_history]
+    assert steps == [0, 1, 2, 3, 4]
+
+
 def test_failure_budget_exhausted(rt):
     def loop():
         raise ValueError("always broken")
