@@ -83,6 +83,21 @@ BENCH_2B_CFG = llama.LlamaConfig(
     loss_chunk=512,
 )
 
+# Mixed-length prompt ladders: the serving knee measured on REALISTIC
+# traffic instead of the single prompt_len=128 point — with ragged
+# batching on, prefill chunks and decode rows share one token-budgeted
+# device step, so TTFT at the knee should hold as prompts diversify.
+# Weights are per-REQUEST sampling probabilities.
+PROMPT_MIXES = {
+    # interactive chat: short prompts, tight TTFT expectations
+    "short_chat": {"lens": (32, 64, 128), "weights": (0.5, 0.3, 0.2)},
+    # retrieval-augmented: mostly long stuffed contexts
+    "long_rag": {"lens": (512, 1024, 1536), "weights": (0.3, 0.5, 0.2)},
+    # bimodal: chat traffic with occasional huge pastes — the mix that
+    # head-of-line-blocks a two-program (prefill|decode) engine
+    "bursty": {"lens": (32, 64, 1536), "weights": (0.55, 0.3, 0.15)},
+}
+
 # bf16 peak per chip, for MFU reporting
 PEAK_FLOPS = {
     "v5e": 197e12,
@@ -149,7 +164,10 @@ def _measure(cfg, devices, *, steps: int, batch: int = None,
 def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
                      gen: int = 32, slots: int = 64,
                      arrival_rate: float = 40.0,
-                     params=None, adapter_factory=None) -> dict:
+                     params=None, adapter_factory=None,
+                     prompt_mix: dict = None, mix_name: str = None,
+                     ragged: bool = False,
+                     prefill_chunk: int = 0) -> dict:
     """Continuous-batching engine (paged KV cache), measured two ways
     (harness shape: the reference's serve microbenchmark,
     python/ray/serve/benchmarks/microbenchmark.py):
@@ -158,6 +176,12 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
       serving-latency methodology — TTFT at an offered load, not after
       a burst drains a queue);
     * BURST: all requests at once — the max-throughput number.
+
+    ``prompt_mix`` draws per-request prompt lengths from a weighted
+    distribution (PROMPT_MIXES) instead of the fixed ``prompt_len``;
+    ``ragged`` serves through the unified token-budget step
+    (EngineConfig.ragged_batching) with ``prefill_chunk``-token prompt
+    slices, so long prompts never head-of-line-block running decodes.
     """
     from ray_tpu.serve.llm_engine import (
         EngineConfig,
@@ -168,16 +192,25 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
     if params is None:
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
     make_adapter = adapter_factory or llama_paged_adapter
+    rng = np.random.default_rng(1)
+    if prompt_mix is not None:
+        lens = rng.choice(np.asarray(prompt_mix["lens"]), n_requests,
+                          p=np.asarray(prompt_mix["weights"], np.float64)
+                          / np.sum(prompt_mix["weights"]))
+    else:
+        lens = np.full(n_requests, prompt_len)
+    max_seq = min(cfg.max_seq_len,
+                  max(512, int(64 * np.ceil((lens.max() + gen + 1) / 64))))
     eng = LLMEngine(
         params, make_adapter(cfg),
-        EngineConfig(max_slots=slots,
-                     max_seq_len=min(512, cfg.max_seq_len),
+        EngineConfig(max_slots=slots, max_seq_len=max_seq,
                      decode_chunk=8,
-                     max_new_tokens_default=gen, page_size=64),
+                     max_new_tokens_default=gen, page_size=64,
+                     ragged_batching=ragged,
+                     prefill_chunk=prefill_chunk),
     )
-    rng = np.random.default_rng(1)
-    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
-               for _ in range(n_requests)]
+    prompts = [rng.integers(0, cfg.vocab_size, int(n)).tolist()
+               for n in lens]
     # Warm every compiled variant the run will hit off the clock:
     # prefill batch sizes k ∈ {1, 2, 4, 8} (open-loop trickle admits
     # small groups; burst admits full ones) and every ladder chunk.
@@ -312,7 +345,7 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
         "offered_req_s": None, "req_per_s": None,
         "decode_tokens_per_s": None, "ttft_p50_ms": None,
         "ttft_p95_ms": None}
-    return {
+    out = {
         "arrival_rate_req_s": head["offered_req_s"],
         "req_per_s": head["req_per_s"],
         "decode_tokens_per_s": head["decode_tokens_per_s"],
@@ -323,13 +356,53 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
         "saturated": saturated,
         "burst_req_per_s": round(n_requests / burst_dt, 2),
         "burst_decode_tokens_per_s": round(n_requests * gen / burst_dt, 1),
-        "prompt_len": prompt_len,
+        "prompt_len": int(np.median(lens)),
         "gen": gen,
         "slots": slots,
+        "batching": "ragged" if ragged else "interleaved",
         "kv": "int8" if getattr(cfg, "kv_int8", False) else "bf16",
         "decode_kernel": ("fused" if getattr(cfg, "fused_decode", False)
                           else "unfused"),
     }
+    if prompt_mix is not None:
+        # The sampled distribution travels WITH the knee it produced:
+        # a mixed-ladder TTFT is meaningless without knowing how long
+        # the prompts actually were.
+        out["prompt_mix"] = {
+            "name": mix_name,
+            "lens": [int(x) for x in prompt_mix["lens"]],
+            "weights": [round(float(w), 4) for w in prompt_mix["weights"]],
+            "sampled_p50": int(np.percentile(lens, 50)),
+            "sampled_p95": int(np.percentile(lens, 95)),
+            "sampled_max": int(lens.max()),
+        }
+    return out
+
+
+def _measure_serving_mixed(cfg, *, n_requests: int = 48,
+                           gen: int = 32, slots: int = 32,
+                           arrival_rate: float = 8.0,
+                           ragged: bool = True,
+                           params=None, adapter_factory=None) -> dict:
+    """The mixed-length ladder: one full knee ladder per PROMPT_MIX,
+    served ragged (token-budget step, 256-token prefill slices) so the
+    per-mix knees are comparable — the acceptance bar is that TTFT p95
+    at the knee holds as the mix shifts from short_chat to long_rag."""
+    if params is None:
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    out = {"batching": "ragged" if ragged else "interleaved",
+           "mixes": {}}
+    for name, mix in PROMPT_MIXES.items():
+        try:
+            out["mixes"][name] = _measure_serving(
+                cfg, n_requests=n_requests, gen=gen, slots=slots,
+                arrival_rate=arrival_rate, params=params,
+                adapter_factory=adapter_factory, prompt_mix=mix,
+                mix_name=name, ragged=ragged,
+                prefill_chunk=256 if ragged else 0)
+        except Exception as e:  # one collapsed mix must not eat the rest
+            out["mixes"][name] = {"error": repr(e)[:120]}
+    return out
 
 
 def _measure_8b(peak_flops: float) -> dict:
@@ -573,6 +646,22 @@ def main():
                 n_requests=64, slots=32, arrival_rate=12.0)
         except Exception as e:
             extra["serving_1b"] = {"error": repr(e)[:120]}
+        # The MIXED-length ladders (short-chat / long-RAG / bursty),
+        # served through the ragged token-budget step: the knee under
+        # realistic traffic, where the old two-program engine's TTFT
+        # p95 exploded as soon as long prompts entered the mix.
+        try:
+            extra["serving_mixed"] = _measure_serving_mixed(
+                dataclasses.replace(cfg, max_seq_len=2048),
+                n_requests=64, slots=48, arrival_rate=16.0)
+        except Exception as e:
+            extra["serving_mixed"] = {"error": repr(e)[:120]}
+        try:
+            extra["serving_1b_mixed"] = _measure_serving_mixed(
+                dataclasses.replace(BENCH_1B_CFG, max_seq_len=2048),
+                n_requests=48, slots=32, arrival_rate=6.0)
+        except Exception as e:
+            extra["serving_1b_mixed"] = {"error": repr(e)[:120]}
         # BASELINE.json config-matrix: Pallas SSD kernel vs the
         # associative_scan/einsum path, measured on-chip.  Runs BEFORE
         # the 8B leg: after 8+ GB of weights churn through HBM the
@@ -597,15 +686,18 @@ def main():
         "extra": extra,
     }
     # The record survives two independent ways: BENCH_OUT.json on disk
-    # AND the final stdout line.  Driver wrappers have truncated the
-    # stdout capture mid-JSON before (BENCH_r05's "parsed": null);
-    # scripts/gen_perf_tables.py knows how to recover the last complete
-    # JSON line from such a wrapper, and the file copy makes even that
-    # unnecessary when the filesystem comes home.
-    blob = json.dumps(result)
+    # AND the final stdout line.  The driver wrapper parses that LAST
+    # line into BENCH_r0N.json's ``parsed`` — BENCH_r05 shipped
+    # parsed:null because its bounded stdout tail cut the line
+    # mid-object.  So the line is COMPACT (no separator padding; ~25%
+    # smaller, and the mixed ladders grow the record further), printed
+    # last, and flushed; scripts/gen_perf_tables.py can still recover
+    # the last complete JSON line from a wrapper, and the file copy
+    # makes even that unnecessary when the filesystem comes home.
+    blob = json.dumps(result, separators=(",", ":"))
     with open("BENCH_OUT.json", "w") as f:
         f.write(blob + "\n")
-    print(blob)
+    print(blob, flush=True)
 
 
 if __name__ == "__main__":

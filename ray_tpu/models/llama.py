@@ -1190,6 +1190,118 @@ def decode_slots_paged_fused(
     return logits.astype(jnp.float32), new_cache, new_len
 
 
+def ragged_step_paged(
+    params: Params,
+    tokens: jax.Array,       # [T] flat ragged token buffer
+    tok_pos: jax.Array,      # [T] absolute position of each token
+    row_slot: jax.Array,     # [R] slot of each packed row
+    row_start: jax.Array,    # [R] tokens already pooled for the row
+    row_len: jax.Array,      # [R] fresh tokens this step (0 = padding)
+    row_off: jax.Array,      # [R] row's offset into the flat buffer
+    block_tables: jax.Array,
+    cfg: LlamaConfig,
+    cache: Dict[str, jax.Array],
+    *,
+    max_row_tokens: Optional[int] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One unified serving step over a ragged batch mixing prefill
+    chunks (row_len > 1) and decode rows (row_len == 1).
+
+    Replaces the separate prefill_chunk_paged + decode_slots_paged
+    passes: every packed token attends to its slot's pooled past plus
+    the causal prefix of its own row, and all fresh k/v lands in the
+    pools through ONE aliased append after the layer scan (same
+    deferred-append design as decode_slots_paged — pools strictly
+    read-only inside the scan).  Unlike prefill_chunk_paged this path
+    supports int8 KV pools: the ragged append kernel carries the
+    grow-only scale policy per multi-token page.
+
+    Returns (logits [R, V] float32 at each row's LAST fresh token,
+    new_cache).  Padding rows (row_len == 0) return garbage logits —
+    callers mask by row_len.  Length bookkeeping stays host-side."""
+    if cfg.tensor_parallel:
+        raise NotImplementedError(
+            "ragged_step_paged does not shard over tensor_parallel "
+            "yet — use the prefill/decode pipeline for tp serving")
+    from ray_tpu.ops.ragged_paged_attention import (
+        fused_ragged_layer,
+        ragged_paged_append,
+        ragged_paged_append_quantized,
+        ragged_paged_attention,
+    )
+
+    quantized = "k_scale" in cache
+    T = tokens.shape[0]
+    sin, cos = rope_table(cfg, tok_pos[None])      # [1, T, hd//2]
+    sin1, cos1 = sin[0], cos[0]                    # [T, hd//2]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)   # [T, D]
+
+    if cfg.fused_decode:
+        layer_fn = partial(
+            fused_ragged_layer,
+            eps=cfg.norm_eps, n_heads=cfg.n_heads,
+            n_kv_heads=cfg.n_kv_heads, soft_cap=cfg.logits_soft_cap,
+            k_scales=cache.get("k_scale"),
+            v_scales=cache.get("v_scale"),
+            max_row_tokens=max_row_tokens)
+
+        def body(carry, layer):
+            x, li = carry
+            x, k1, v1 = layer_fn(x, layer, cache["k"], cache["v"], li,
+                                 row_slot, row_start, row_len, row_off,
+                                 block_tables, sin1, cos1)
+            return (x, li + 1), (k1, v1)
+    else:
+        def body(carry, layer):
+            x, li = carry
+            layer = _deq_layer(layer, cfg.dtype)
+            normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+            q, k, v = _qkv(normed[None], layer, cfg, sin, cos)
+            q, k1, v1 = q[0], k[0], v[0]           # [T, H/KVH, hd]
+            out = ragged_paged_attention(
+                q, k1, v1, cache["k"], cache["v"], li,
+                row_slot, row_start, row_len, row_off, block_tables,
+                soft_cap=cfg.logits_soft_cap,
+                k_scales=cache.get("k_scale"),
+                v_scales=cache.get("v_scale"),
+                max_row_tokens=max_row_tokens)     # [T, H, hd] f32
+            # Round the f32 flash output to cfg.dtype BEFORE the
+            # o-proj — the same cast point as the prefill/decode
+            # paths, which is what keeps greedy argmax bit-identical
+            # across the pipelines under bf16.
+            out = jnp.einsum("thk,hkd->td", out.astype(cfg.dtype),
+                             layer["attn"]["wo"].astype(cfg.dtype))
+            h = x + out.astype(x.dtype)
+            h = h + _mlp_block(rms_norm(h, layer["ln_mlp"],
+                                        cfg.norm_eps)[None],
+                               layer, cfg)[0]
+            return (h, li + 1), (k1, v1)
+
+    (x, _), (k_news, v_news) = lax.scan(
+        body, (x, jnp.int32(0)), params["layers"])
+    # k_news/v_news [L, T, KVH, hd] — one in-place append, all layers.
+    if quantized:
+        k_pool, v_pool, k_sc, v_sc = ragged_paged_append_quantized(
+            cache["k"], cache["v"], cache["k_scale"], cache["v_scale"],
+            k_news, v_news, row_slot, row_start, row_len, row_off,
+            block_tables, max_row_tokens=max_row_tokens)
+        new_cache = {"k": k_pool, "v": v_pool, "k_scale": k_sc,
+                     "v_scale": v_sc}
+    else:
+        k_pool, v_pool = ragged_paged_append(
+            cache["k"], cache["v"], k_news, v_news,
+            row_slot, row_start, row_len, row_off, block_tables,
+            max_row_tokens=max_row_tokens)
+        new_cache = {"k": k_pool, "v": v_pool}
+    # logits at each row's last fresh token
+    last = jnp.clip(row_off + jnp.maximum(row_len, 1) - 1, 0, T - 1)
+    x = rms_norm(x[last], params["final_norm"], cfg.norm_eps)
+    head = (params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    logits = _head_matmul(x, head, cfg)
+    return logits.astype(jnp.float32), new_cache
+
+
 def decode_step(
     params: Params,
     tokens: jax.Array,

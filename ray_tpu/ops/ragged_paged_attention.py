@@ -1,0 +1,1052 @@
+"""Ragged paged attention — one kernel, one batch for mixed
+prefill + decode.
+
+Serving used to run TWO device programs per engine loop iteration:
+bucketed/chunked prefill and per-slot paged decode.  A long prompt
+therefore head-of-line-blocked every running stream for at least a
+chunk (the 1B ladder showed TTFT p95 exploding to 50s under prefill
+pressure).  Following "Ragged Paged Attention: A High-Performance and
+Flexible LLM Inference Kernel for TPU" (PAPERS.md), this module serves
+BOTH phases from a single ragged token batch:
+
+    tokens   [T]            one flat buffer of up to ``token_budget``
+                            tokens packed from R rows
+    rows     (slot, start_pos, num_tokens, buffer_offset) x R
+                            decode rows have num_tokens == 1, prefill
+                            rows carry a chunk of their prompt
+
+and computes, per layer, causal attention of every packed token
+against the shared KV page pool (int8 or bf16) PLUS the intra-row
+causal self attention among the row's own fresh tokens — the part of
+the context that is not in the pool yet.  The fresh K/V rides out and
+ONE aliased append per step writes every layer's new rows into the
+pages (``ragged_paged_append*``), preserving the deferred-append
+contract of models/llama.decode_slots_paged: pools are STRICTLY
+read-only inside the layer scan (in-loop pool mutation made XLA clone
+the multi-GB pools), and the append kernels alias in place.
+
+Kernel shape (mirrors ops/paged_attention.py's idioms):
+
+  * ``pltpu.PrefetchScalarGridSpec`` carries the row metadata, block
+    tables and (int8) page scales on the scalar-prefetch channel so
+    BlockSpec index maps can chase pages;
+  * grid (R, maxp + 1): for row r, cells 0..maxp-1 stream the row's
+    live pages (clamped index maps repeat the last live page so Mosaic
+    elides the dead DMAs), cell maxp is the SELF phase — intra-row
+    causal attention against the fresh k/v buffer — which also
+    finalizes the online softmax and writes the output rows;
+  * each row reads its tokens through a static window [w, w + Cq) of
+    the flat buffer with w aligned down to the sublane (8); masks do
+    the raggedness, so rows can start at any offset;
+  * flash state (m, l, acc) lives in VMEM scratch, per q-head.
+
+``fused_ragged_layer`` folds the PR-2 per-layer decode megakernel
+(ops/fused_decode.py) over the ragged batch: the same phase-indexed
+1-D grid (qkv tiles | attention cells | o-proj | MLP), with the
+attention phase iterating (row, page) cells instead of (slot, page) —
+so the fused path serves ragged batches too.
+
+Interpret-mode (CPU) numerics are tier-1 tested against the unfused
+paged reference for fp32 / int8-weight / int8-KV
+(tests/test_ragged_paged_attention.py); per-pattern tile tuning on
+hardware is expected follow-up, as for ops/fused_decode.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.ops.paged_attention import NEG_INF, _interpret_mode
+
+
+def _round8(n: int) -> int:
+    return max(8, -(-n // 8) * 8)
+
+
+def window_size(T: int, max_row_tokens: Optional[int]) -> int:
+    """Static q-window width: wide enough to hold any row's tokens
+    starting at any (8-aligned-down) buffer offset."""
+    cap = T if max_row_tokens is None else min(max_row_tokens, T)
+    return min(_round8(T), _round8(cap) + 8)
+
+
+# --------------------------------------------------------------------------
+# pure-jax reference (per layer) — the oracle for the Pallas kernel and
+# the documentation of the semantics
+# --------------------------------------------------------------------------
+
+
+def ragged_attention_reference(
+    q: jax.Array,            # [T, H, D]  RoPE'd queries, flat buffer
+    k_new: jax.Array,        # [T, KVH, D] this step's keys (RoPE'd)
+    v_new: jax.Array,        # [T, KVH, D]
+    k_pages: jax.Array,      # [KVH, P, page, D] one layer's pool
+    v_pages: jax.Array,
+    row_slot: jax.Array,     # [R] int32
+    row_start: jax.Array,    # [R] absolute position of the row's first
+                             #     fresh token (== tokens already pooled)
+    row_len: jax.Array,      # [R] fresh tokens this step (0 = padding)
+    row_off: jax.Array,      # [R] offset of the row in the flat buffer
+    block_tables: jax.Array,  # [slots, maxp]
+    *,
+    soft_cap: Optional[float] = None,
+    k_scales: Optional[jax.Array] = None,   # [P, KVH, 1] (int8 pools)
+    v_scales: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Dense gather reference: for each row, attention of its fresh
+    tokens over (pooled past) + (intra-row causal fresh), f32 out
+    [T, H, D].  Buffer rows not covered by any row come back zero."""
+    T, H, D = q.shape
+    KVH, P, page, _ = k_pages.shape
+    maxp = block_tables.shape[1]
+    R = int(row_slot.shape[0])
+    group = H // KVH
+    out = jnp.zeros((T, H, D), jnp.float32)
+    kf = k_pages.astype(jnp.float32)
+    vf = v_pages.astype(jnp.float32)
+    if k_scales is not None:
+        kf = k_pages.astype(jnp.float32) * k_scales.transpose(1, 0, 2)[
+            :, :, None, :]
+        vf = v_pages.astype(jnp.float32) * v_scales.transpose(1, 0, 2)[
+            :, :, None, :]
+    for r in range(R):
+        slot, start, nt, off = (row_slot[r], row_start[r], row_len[r],
+                                row_off[r])
+        pages = jnp.clip(block_tables[slot], 0, P - 1)     # [maxp]
+        kc = kf[:, pages].transpose(1, 2, 0, 3).reshape(
+            maxp * page, KVH, D)                           # [ctx, KVH, D]
+        vc = vf[:, pages].transpose(1, 2, 0, 3).reshape(
+            maxp * page, KVH, D)
+        # fresh rows of THIS row, gathered from the flat buffer
+        ti = jnp.arange(T)
+        trel = ti - off
+        in_row = (trel >= 0) & (trel < nt)
+        ctx = maxp * page
+        kpos = jnp.arange(ctx)
+        qs = q.astype(jnp.float32)
+        kx = jnp.repeat(kc, group, axis=1)                 # [ctx, H, D]
+        vx = jnp.repeat(vc, group, axis=1)
+        s_pool = jnp.einsum("thd,khd->thk", qs, kx) * (D ** -0.5)
+        knf = jnp.repeat(k_new.astype(jnp.float32), group, axis=1)
+        vnf = jnp.repeat(v_new.astype(jnp.float32), group, axis=1)
+        s_self = jnp.einsum("thd,uhd->thu", qs, knf) * (D ** -0.5)
+        if soft_cap is not None:
+            s_pool = soft_cap * jnp.tanh(s_pool / soft_cap)
+            s_self = soft_cap * jnp.tanh(s_self / soft_cap)
+        m_pool = in_row[:, None, None] & (kpos < start)[None, None, :]
+        urel = ti - off
+        key_in_row = (urel >= 0) & (urel < nt)
+        m_self = (in_row[:, None, None] & key_in_row[None, None, :]
+                  & (urel[None, None, :] <= trel[:, None, None]))
+        s = jnp.concatenate(
+            [jnp.where(m_pool, s_pool, NEG_INF),
+             jnp.where(m_self, s_self, NEG_INF)], axis=-1)
+        p = jax.nn.softmax(s, axis=-1)
+        o = (jnp.einsum("thk,khd->thd", p[..., :ctx], vx)
+             + jnp.einsum("thu,uhd->thd", p[..., ctx:], vnf))
+        out = jnp.where(in_row[:, None, None], o, out)
+    return out
+
+
+def ragged_append_reference(
+    k_pages: jax.Array,      # [KVH, P, page, D]
+    v_pages: jax.Array,
+    k_new: jax.Array,        # [T, KVH, D]
+    v_new: jax.Array,
+    row_slot, row_start, row_len, row_off,
+    block_tables: jax.Array,
+):
+    """Scatter reference for the append: one layer, bf16/f32 pools."""
+    T = k_new.shape[0]
+    KVH, P, page, D = k_pages.shape
+    maxp = block_tables.shape[1]
+    R = int(row_slot.shape[0])
+    for r in range(R):
+        slot, start, nt, off = (row_slot[r], row_start[r], row_len[r],
+                                row_off[r])
+        ti = jnp.arange(T)
+        trel = ti - off
+        in_row = (trel >= 0) & (trel < nt)
+        pos = start + trel
+        pid = jnp.take(jnp.clip(block_tables[slot], 0, P - 1),
+                       jnp.clip(pos // page, 0, maxp - 1))
+        pid = jnp.where(in_row, pid, P - 1)   # scratch page for pads
+        offp = jnp.where(in_row, pos % page, 0)
+        k_pages = k_pages.at[:, pid, offp].set(
+            jnp.where(in_row[None, :, None],
+                      k_new.transpose(1, 0, 2).astype(k_pages.dtype),
+                      k_pages[:, pid, offp]))
+        v_pages = v_pages.at[:, pid, offp].set(
+            jnp.where(in_row[None, :, None],
+                      v_new.transpose(1, 0, 2).astype(v_pages.dtype),
+                      v_pages[:, pid, offp]))
+    return k_pages, v_pages
+
+
+# --------------------------------------------------------------------------
+# the ragged attention kernel
+# --------------------------------------------------------------------------
+
+
+def _ragged_kernel(*refs, T: int, Cq: int, H: int, KVH: int, qpg: int,
+                   hd: int, page: int, Pt: int, maxp: int, scale: float,
+                   soft_cap: Optional[float], quantized: bool):
+    if quantized:
+        slot_r, start_r, len_r, off_r, bt_r, ly_r, ks_r, vs_r = refs[:8]
+        n_pre = 8
+    else:
+        slot_r, start_r, len_r, off_r, bt_r, ly_r = refs[:6]
+        ks_r = vs_r = None
+        n_pre = 6
+    q_ref, kn_ref, vn_ref, kp_ref, vp_ref = refs[n_pre:n_pre + 5]
+    out_ref = refs[n_pre + 5]
+    m_s, l_s, acc_s = refs[n_pre + 6:]
+
+    r = pl.program_id(0)
+    pc = pl.program_id(1)
+    start = start_r[r]
+    nt = len_r[r]
+    off = off_r[r]
+    w = jnp.minimum((off // 8) * 8, T - Cq)
+    w = pl.multiple_of(w, 8)
+
+    def capped(s):
+        if soft_cap is not None:
+            return soft_cap * jnp.tanh(s / soft_cap)
+        return s
+
+    @pl.when((r == 0) & (pc == 0))
+    def _init_out():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(pc == 0)
+    def _init_state():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    ti = lax.broadcasted_iota(jnp.int32, (Cq, 1), 0)
+    trel = w + ti - off                    # row-relative token index
+    valid_q = (trel >= 0) & (trel < nt)    # [Cq, 1]
+
+    def flash_update(h, s, v, vscale):
+        """Masked online-softmax update of head h's state; rows whose
+        scores are fully NEG_INF must leave the state untouched (the
+        window overlaps NEIGHBOR rows' tokens)."""
+        upd = valid_q
+        m_prev = m_s[h]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_new = jnp.where(upd, m_new, m_prev)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_s[h] + jnp.sum(p, axis=-1, keepdims=True)
+        pv = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        if vscale is not None:
+            pv = pv * vscale
+        a_new = acc_s[h] * corr + pv
+        l_s[h] = jnp.where(upd, l_new, l_s[h])
+        acc_s[h] = jnp.where(upd, a_new, acc_s[h])
+        m_s[h] = m_new
+        return l_new, a_new
+
+    # ---- pool cells: one live page of the row's PAST per cell --------
+    @pl.when((pc < maxp) & (pc * page < start) & (nt > 0))
+    def _pool_cell():
+        s_idx = slot_r[r]
+        last = jnp.maximum(start - 1, 0) // page
+        pid = jnp.minimum(bt_r[s_idx, jnp.minimum(pc, last)], Pt - 1)
+        kpos = pc * page + lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        mask = valid_q & (kpos < start)
+        for h in range(H):
+            kvh = h // qpg
+            qh = q_ref[pl.ds(w, Cq), h, :].astype(jnp.float32)
+            k = kp_ref[0, kvh, 0].astype(jnp.float32)
+            s = lax.dot_general(qh, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            if quantized:
+                s = s * ks_r[pid, kvh]
+            s = jnp.where(mask, capped(s), NEG_INF)
+            flash_update(h, s,
+                         vp_ref[0, kvh, 0].astype(jnp.float32),
+                         vs_r[pid, kvh] if quantized else None)
+
+    # ---- self cell: intra-row causal attention + finalize ------------
+    @pl.when((pc == maxp) & (nt > 0))
+    def _self_cell():
+        kj = lax.broadcasted_iota(jnp.int32, (1, Cq), 1)
+        krel = w + kj - off
+        mask = (valid_q & (krel >= 0) & (krel < nt) & (krel <= trel))
+        for h in range(H):
+            kvh = h // qpg
+            qh = q_ref[pl.ds(w, Cq), h, :].astype(jnp.float32)
+            kw = kn_ref[pl.ds(w, Cq), kvh, :].astype(jnp.float32)
+            s = lax.dot_general(qh, kw, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask, capped(s), NEG_INF)
+            vw = vn_ref[pl.ds(w, Cq), kvh, :].astype(jnp.float32)
+            l_new, a_new = flash_update(h, s, vw, None)
+            o = a_new / jnp.maximum(l_new, 1e-30)
+            cur = out_ref[pl.ds(w, Cq), h, :].astype(jnp.float32)
+            out_ref[pl.ds(w, Cq), h, :] = jnp.where(
+                valid_q, o, cur).astype(out_ref.dtype)
+
+
+def ragged_paged_attention(
+    q: jax.Array,            # [T, H, D]
+    k_new: jax.Array,        # [T, KVH, D]
+    v_new: jax.Array,
+    k_pools: jax.Array,      # [L, KVH, P, page, D] (P includes scratch)
+    v_pools: jax.Array,
+    layer: jax.Array,
+    row_slot: jax.Array,     # [R]
+    row_start: jax.Array,
+    row_len: jax.Array,
+    row_off: jax.Array,
+    block_tables: jax.Array,  # [slots, maxp]
+    *,
+    soft_cap: Optional[float] = None,
+    k_scales: Optional[jax.Array] = None,   # [L, P, KVH, 1]
+    v_scales: Optional[jax.Array] = None,
+    max_row_tokens: Optional[int] = None,
+) -> jax.Array:
+    """Causal attention of a ragged token batch against the page pool
+    of ONE layer (selected via scalar-prefetched ``layer``), f32 out
+    [T, H, D].  Pools are read-only; append the fresh K/V afterwards
+    with ragged_paged_append*.  Rows must occupy DISTINCT slots (the
+    engine packs at most one row per slot per step)."""
+    T, H, hd = q.shape
+    L, KVH, Pt, page, _ = k_pools.shape
+    maxp = block_tables.shape[1]
+    R = row_slot.shape[0]
+    qpg = H // KVH
+    quantized = k_scales is not None
+    T_p = _round8(T)
+    if T_p != T:
+        padw = T_p - T
+        q = jnp.pad(q, ((0, padw), (0, 0), (0, 0)))
+        k_new = jnp.pad(k_new, ((0, padw), (0, 0), (0, 0)))
+        v_new = jnp.pad(v_new, ((0, padw), (0, 0), (0, 0)))
+    Cq = window_size(T_p, max_row_tokens)
+
+    def const_map(r, pc, *pf):
+        return (0, 0, 0)
+
+    def pool_map(r, pc, slot_p, start_p, len_p, off_p, bt, ly, *sc):
+        s = slot_p[r]
+        last = jnp.maximum(start_p[r] - 1, 0) // page
+        pe = jnp.minimum(jnp.minimum(pc, maxp - 1), last)
+        pid = jnp.minimum(bt[s, pe], Pt - 1)
+        # padding rows (len 0) read the scratch page — garbage-tolerant
+        return (ly[0], 0, jnp.where(len_p[r] > 0, pid, Pt - 1), 0, 0)
+
+    ly = jnp.asarray(layer, jnp.int32).reshape(1)
+    prefetch = [row_slot.astype(jnp.int32), row_start.astype(jnp.int32),
+                row_len.astype(jnp.int32), row_off.astype(jnp.int32),
+                block_tables.astype(jnp.int32), ly]
+    if quantized:
+        ly_s = jnp.asarray(layer, jnp.int32)
+        prefetch += [k_scales[ly_s, :, :, 0], v_scales[ly_s, :, :, 0]]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(prefetch),
+        grid=(R, maxp + 1),
+        in_specs=[
+            pl.BlockSpec((T_p, H, hd), const_map),
+            pl.BlockSpec((T_p, KVH, hd), const_map),
+            pl.BlockSpec((T_p, KVH, hd), const_map),
+            pl.BlockSpec((1, KVH, 1, page, hd), pool_map),
+            pl.BlockSpec((1, KVH, 1, page, hd), pool_map),
+        ],
+        out_specs=pl.BlockSpec((T_p, H, hd), const_map),
+        scratch_shapes=[
+            pltpu.VMEM((H, Cq, 1), jnp.float32),
+            pltpu.VMEM((H, Cq, 1), jnp.float32),
+            pltpu.VMEM((H, Cq, hd), jnp.float32),
+        ],
+    )
+    kern = functools.partial(
+        _ragged_kernel, T=T_p, Cq=Cq, H=H, KVH=KVH, qpg=qpg, hd=hd,
+        page=page, Pt=Pt, maxp=maxp, scale=hd ** -0.5,
+        soft_cap=soft_cap, quantized=quantized)
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T_p, H, hd), jnp.float32),
+        interpret=_interpret_mode(),
+    )(*prefetch, q, k_new, v_new, k_pools, v_pools)
+    return out[:T]
+
+
+# --------------------------------------------------------------------------
+# ragged append — all layers at once, in place
+# --------------------------------------------------------------------------
+
+
+def _pages_per_row(max_row_tokens: int, page: int) -> int:
+    """Static bound on pages one row's fresh tokens can touch."""
+    return (max_row_tokens + page - 2) // page + 1
+
+
+def _ragged_append_kernel(*refs, T: int, Cq: int, KVH: int, page: int,
+                          Pt: int, maxp: int, quantized: bool):
+    if quantized:
+        slot_r, start_r, len_r, off_r, bt_r = refs[:5]
+        (kn_ref, vn_ref, kp_ref, vp_ref, ks_ref, vs_ref,
+         kp_out, vp_out, ks_out, vs_out) = refs[5:]
+    else:
+        slot_r, start_r, len_r, off_r, bt_r = refs[:5]
+        kn_ref, vn_ref, kp_ref, vp_ref, kp_out, vp_out = refs[5:]
+
+    r = pl.program_id(0)
+    j = pl.program_id(2)
+    start = start_r[r]
+    nt = len_r[r]
+    off = off_r[r]
+    w = jnp.minimum((off // 8) * 8, T - Cq)
+    w = pl.multiple_of(w, 8)
+
+    sp = start // page
+    pg = sp + j
+    base = pg * page
+    live = (base < start + nt) & (nt > 0)
+    rows_i = lax.broadcasted_iota(jnp.int32, (page, 1), 0)
+    tpage = base + rows_i - start          # token index landing here
+    mask_w = (tpage >= 0) & (tpage < nt) & live          # [page, 1]
+    cols = lax.broadcasted_iota(jnp.int32, (1, Cq), 1)
+    krel = w + cols - off                  # window col → token index
+    # one-hot gather: page row i takes window col c with token tpage[i]
+    oh = ((tpage == krel) & (krel >= 0) & (krel < nt)
+          & live).astype(jnp.float32)      # [page, Cq]
+
+    for h in range(KVH):
+        kw = kn_ref[0, pl.ds(w, Cq), h, :].astype(jnp.float32)
+        vw = vn_ref[0, pl.ds(w, Cq), h, :].astype(jnp.float32)
+        newk = lax.dot_general(oh, kw, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        newv = lax.dot_general(oh, vw, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        curk = kp_ref[0, h, 0]
+        curv = vp_ref[0, h, 0]
+        if not quantized:
+            kp_out[0, h, 0] = jnp.where(
+                mask_w, newk, curk.astype(jnp.float32)).astype(
+                    kp_out.dtype)
+            vp_out[0, h, 0] = jnp.where(
+                mask_w, newv, curv.astype(jnp.float32)).astype(
+                    vp_out.dtype)
+            continue
+        # int8 pools: grow-only per-page-per-kv-head scale.  A page the
+        # row writes from offset 0 this step is FRESH (reset); a page
+        # extended past existing rows keeps old int8 values bit-stable
+        # unless the scale must grow (no cumulative requant error).
+        wrote = jnp.max(mask_w.astype(jnp.float32), axis=(0, 1),
+                        keepdims=True)                     # [1, 1]
+        fresh = (base >= start)
+        for (new, cur, sc_in, sc_out) in (
+                (newk, curk, ks_ref, ks_out),
+                (newv, curv, vs_ref, vs_out)):
+            s_old = sc_in[0, 0, h:h + 1, 0:1].astype(jnp.float32)
+            amax = jnp.max(jnp.where(mask_w, jnp.abs(new), 0.0),
+                           axis=(0, 1), keepdims=True)
+            needed = jnp.maximum(amax / 127.0, 1e-8)
+            grown = jnp.where(fresh, needed,
+                              jnp.maximum(s_old, needed))
+            s_new = jnp.where(wrote > 0.0, grown,
+                              jnp.maximum(s_old, 1e-8))
+            factor = jnp.where(fresh & (wrote > 0.0), 0.0,
+                               jnp.where(s_new > s_old,
+                                         s_old / s_new, 1.0))
+            requant = jnp.round(cur.astype(jnp.float32) * factor)
+            row_q = jnp.clip(jnp.round(new / s_new), -127, 127)
+            outp = jnp.where(mask_w, row_q, requant)
+            if new is newk:
+                kp_out[0, h, 0] = jnp.clip(outp, -127, 127).astype(
+                    kp_out.dtype)
+            else:
+                vp_out[0, h, 0] = jnp.clip(outp, -127, 127).astype(
+                    vp_out.dtype)
+            sc_out[0, 0, h:h + 1, 0:1] = jnp.where(
+                wrote > 0.0, s_new, s_old).astype(sc_out.dtype)
+
+
+def _append_maps(page: int, Pt: int, maxp: int, NPR: int):
+    def pool_map(r, l, j, slot_p, start_p, len_p, off_p, bt, *sc):
+        s = slot_p[r]
+        start = start_p[r]
+        nt = len_p[r]
+        pg = start // page + j
+        lastp = (start + jnp.maximum(nt, 1) - 1) // page
+        pe = jnp.minimum(jnp.minimum(pg, lastp), maxp - 1)
+        pid = jnp.minimum(bt[s, pe], Pt - 1)
+        # DEAD cells (padding rows, or j past the row's last touched
+        # page) must write the scratch page, never a live one: their
+        # aliased copy-through reads a stale input block (the previous
+        # cell's write is not visible through the alias) and would
+        # clobber a fresh append.  Scratch is garbage-tolerant.
+        live = (nt > 0) & (pg <= lastp)
+        return (l, 0, jnp.where(live, pid, Pt - 1), 0, 0)
+
+    def scale_map(r, l, j, slot_p, start_p, len_p, off_p, bt, *sc):
+        _, _, pid, _, _ = pool_map(r, l, j, slot_p, start_p, len_p,
+                                   off_p, bt)
+        return (l, pid, 0, 0)
+
+    new_map = lambda r, l, j, *pf: (l, 0, 0, 0)
+    return pool_map, scale_map, new_map
+
+
+def ragged_paged_append(
+    k_pools: jax.Array,      # [L, KVH, P, page, D]
+    v_pools: jax.Array,
+    k_new: jax.Array,        # [L, T, KVH, D]
+    v_new: jax.Array,
+    row_slot, row_start, row_len, row_off,
+    block_tables: jax.Array,
+    *,
+    max_row_tokens: Optional[int] = None,
+):
+    """In-place append of every row's fresh tokens into its pages, all
+    layers at once (aliased pools — same contract as paged_append)."""
+    L, KVH, Pt, page, D = k_pools.shape
+    T = k_new.shape[1]
+    R = row_slot.shape[0]
+    maxp = block_tables.shape[1]
+    T_p = _round8(T)
+    if T_p != T:
+        k_new = jnp.pad(k_new, ((0, 0), (0, T_p - T), (0, 0), (0, 0)))
+        v_new = jnp.pad(v_new, ((0, 0), (0, T_p - T), (0, 0), (0, 0)))
+    Cq = window_size(T_p, max_row_tokens)
+    NPR = _pages_per_row(Cq, page)
+    pool_map, _scale_map, new_map = _append_maps(page, Pt, maxp, NPR)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(R, L, NPR),
+        in_specs=[
+            pl.BlockSpec((1, T_p, KVH, D), new_map),
+            pl.BlockSpec((1, T_p, KVH, D), new_map),
+            pl.BlockSpec((1, KVH, 1, page, D), pool_map),
+            pl.BlockSpec((1, KVH, 1, page, D), pool_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, KVH, 1, page, D), pool_map),
+            pl.BlockSpec((1, KVH, 1, page, D), pool_map),
+        ],
+    )
+    kern = functools.partial(
+        _ragged_append_kernel, T=T_p, Cq=Cq, KVH=KVH, page=page, Pt=Pt,
+        maxp=maxp, quantized=False)
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_pools.shape, k_pools.dtype),
+            jax.ShapeDtypeStruct(v_pools.shape, v_pools.dtype),
+        ],
+        # prefetch: slot=0 start=1 len=2 off=3 bt=4, then kn=5 vn=6
+        # k_pools=7 v_pools=8
+        input_output_aliases={7: 0, 8: 1},
+        interpret=_interpret_mode(),
+    )(row_slot.astype(jnp.int32), row_start.astype(jnp.int32),
+      row_len.astype(jnp.int32), row_off.astype(jnp.int32),
+      block_tables.astype(jnp.int32), k_new, v_new, k_pools, v_pools)
+
+
+def ragged_paged_append_quantized(
+    k_pools: jax.Array,      # int8 [L, KVH, P, page, D]
+    v_pools: jax.Array,
+    k_scales: jax.Array,     # f32 [L, P, KVH, 1] page-major
+    v_scales: jax.Array,
+    k_new: jax.Array,        # [L, T, KVH, D] bf16/f32
+    v_new: jax.Array,
+    row_slot, row_start, row_len, row_off,
+    block_tables: jax.Array,
+    *,
+    max_row_tokens: Optional[int] = None,
+):
+    """int8 ragged append: pages covered from their offset 0 this step
+    re-quantize fresh; extended pages grow their scale only when a new
+    row's absmax demands it (existing int8 values stay bit-stable
+    otherwise — the paged_append_quantized policy, per multi-token
+    page)."""
+    L, KVH, Pt, page, D = k_pools.shape
+    T = k_new.shape[1]
+    R = row_slot.shape[0]
+    maxp = block_tables.shape[1]
+    T_p = _round8(T)
+    if T_p != T:
+        k_new = jnp.pad(k_new, ((0, 0), (0, T_p - T), (0, 0), (0, 0)))
+        v_new = jnp.pad(v_new, ((0, 0), (0, T_p - T), (0, 0), (0, 0)))
+    Cq = window_size(T_p, max_row_tokens)
+    NPR = _pages_per_row(Cq, page)
+    pool_map, scale_map, new_map = _append_maps(page, Pt, maxp, NPR)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(R, L, NPR),
+        in_specs=[
+            pl.BlockSpec((1, T_p, KVH, D), new_map),
+            pl.BlockSpec((1, T_p, KVH, D), new_map),
+            pl.BlockSpec((1, KVH, 1, page, D), pool_map),
+            pl.BlockSpec((1, KVH, 1, page, D), pool_map),
+            pl.BlockSpec((1, 1, KVH, 1), scale_map),
+            pl.BlockSpec((1, 1, KVH, 1), scale_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, KVH, 1, page, D), pool_map),
+            pl.BlockSpec((1, KVH, 1, page, D), pool_map),
+            pl.BlockSpec((1, 1, KVH, 1), scale_map),
+            pl.BlockSpec((1, 1, KVH, 1), scale_map),
+        ],
+    )
+    kern = functools.partial(
+        _ragged_append_kernel, T=T_p, Cq=Cq, KVH=KVH, page=page, Pt=Pt,
+        maxp=maxp, quantized=True)
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_pools.shape, k_pools.dtype),
+            jax.ShapeDtypeStruct(v_pools.shape, v_pools.dtype),
+            jax.ShapeDtypeStruct(k_scales.shape, k_scales.dtype),
+            jax.ShapeDtypeStruct(v_scales.shape, v_scales.dtype),
+        ],
+        # prefetch 0-4, kn=5 vn=6 kp=7 vp=8 ks=9 vs=10
+        input_output_aliases={7: 0, 8: 1, 9: 2, 10: 3},
+        interpret=_interpret_mode(),
+    )(row_slot.astype(jnp.int32), row_start.astype(jnp.int32),
+      row_len.astype(jnp.int32), row_off.astype(jnp.int32),
+      block_tables.astype(jnp.int32), k_new, v_new, k_pools, v_pools,
+      k_scales, v_scales)
+
+
+# --------------------------------------------------------------------------
+# fused megakernel over the ragged batch (PR-2 fold)
+# --------------------------------------------------------------------------
+
+
+def _fused_ragged_kernel(*refs, T: int, Cq: int, D: int, H: int,
+                         KVH: int, qpg: int, hd: int, page: int,
+                         Pt: int, maxp: int, R: int, M: int, tq: int,
+                         to: int, tm: int, eps: float, scale: float,
+                         soft_cap: Optional[float], quantized: bool,
+                         dot_dt):
+    n_pre = 8 if quantized else 6
+    if quantized:
+        (slot_r, start_r, len_r, off_r, bt_r, _ly_r,
+         ks_r, vs_r) = refs[:8]
+    else:
+        slot_r, start_r, len_r, off_r, bt_r, _ly_r = refs[:6]
+        ks_r = vs_r = None
+    (x_ref, xt_ref, ln_a_ref, ln_m_ref, sin_ref, cos_ref,
+     wqkv_ref, sqkv_ref, kp_ref, vp_ref, wo_ref, so_ref,
+     wg_g_ref, wg_u_ref, sg_g_ref, sg_u_ref, wd_ref, sd_ref,
+     xo_ref, kn_ref, vn_ref,
+     xn_s, qkv_s, qs, m_s, l_s, acc_s, ao_s, h_s, y_s) = refs[n_pre:]
+
+    half = hd // 2
+    Tq = ((H + 2 * KVH) * hd) // tq
+    To = D // to
+    Tm = M // tm
+    cells = maxp + 1
+    S1 = Tq
+    S2 = S1 + R * cells
+    S3 = S2 + To
+    S4 = S3 + Tm
+    t = pl.program_id(0)
+
+    def head_slice(hq: int):
+        base = hq * hd
+        j, off = divmod(base, tq)
+        return qkv_s[j][:, off:off + hd]
+
+    def rope(xh):
+        x1, x2 = xh[:, :half], xh[:, half:]
+        sn = sin_ref[...].astype(jnp.float32)
+        cs = cos_ref[...].astype(jnp.float32)
+        return jnp.concatenate([x1 * cs - x2 * sn, x2 * cs + x1 * sn],
+                               axis=-1)
+
+    def capped(s):
+        if soft_cap is not None:
+            return soft_cap * jnp.tanh(s / soft_cap)
+        return s
+
+    # ---- phase 0: RMSNorm + qkv tiles (identical to fused_decode) ----
+    @pl.when(t == 0)
+    def _norm_in():
+        x32 = x_ref[...].astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        xn_s[...] = (x32 * lax.rsqrt(var + eps)
+                     * ln_a_ref[...].astype(jnp.float32))
+
+    @pl.when(t < S1)
+    def _qkv_tile():
+        wm = wqkv_ref[...].astype(dot_dt)
+        res = lax.dot_general(
+            xn_s[...].astype(dot_dt), wm, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        qkv_s[t] = res * sqkv_ref[...].astype(jnp.float32)
+
+    # ---- phase 1 start: RoPE + per-token flash state init ------------
+    @pl.when(t == S1)
+    def _attn_setup():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+        ao_s[...] = jnp.zeros_like(ao_s)
+        for h in range(H):
+            qs[h] = rope(head_slice(h))
+        for h in range(KVH):
+            lo, hi = h * hd, (h + 1) * hd
+            kn_ref[:, lo:hi] = rope(head_slice(H + h)).astype(
+                kn_ref.dtype)
+            vn_ref[:, lo:hi] = head_slice(H + KVH + h).astype(
+                vn_ref.dtype)
+
+    # ---- phase 1: ragged attention, one (row, page/self) per cell ----
+    in_attn = (t >= S1) & (t < S2)
+    ci = jnp.clip(t - S1, 0, R * cells - 1)
+    r = ci // cells
+    pc = ci % cells
+    start = start_r[r]
+    nt = len_r[r]
+    off = off_r[r]
+    w = jnp.minimum((off // 8) * 8, T - Cq)
+    w = pl.multiple_of(w, 8)
+    ti = lax.broadcasted_iota(jnp.int32, (Cq, 1), 0)
+    trel = w + ti - off
+    valid_q = (trel >= 0) & (trel < nt)
+
+    def flash_update(h, s, v, vscale):
+        upd = valid_q
+        m_prev = m_s[h, pl.ds(w, Cq)]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_new = jnp.where(upd, m_new, m_prev)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_prev = l_s[h, pl.ds(w, Cq)]
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        if vscale is not None:
+            pv = pv * vscale
+        a_prev = acc_s[h, pl.ds(w, Cq)]
+        a_new = a_prev * corr + pv
+        m_s[h, pl.ds(w, Cq)] = m_new
+        l_s[h, pl.ds(w, Cq)] = jnp.where(upd, l_new, l_prev)
+        acc_s[h, pl.ds(w, Cq)] = jnp.where(upd, a_new, a_prev)
+        return l_new, a_new
+
+    @pl.when(in_attn & (pc < maxp) & (pc * page < start) & (nt > 0))
+    def _pool_cell():
+        s_idx = slot_r[r]
+        last = jnp.maximum(start - 1, 0) // page
+        pid = jnp.minimum(bt_r[s_idx, jnp.minimum(pc, last)], Pt - 1)
+        kpos = pc * page + lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        mask = valid_q & (kpos < start)
+        for h in range(H):
+            kvh = h // qpg
+            qh = qs[h, pl.ds(w, Cq)]
+            k = kp_ref[0, kvh, 0].astype(jnp.float32)
+            s = lax.dot_general(qh, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            if quantized:
+                s = s * ks_r[pid, kvh]
+            s = jnp.where(mask, capped(s), NEG_INF)
+            flash_update(h, s, vp_ref[0, kvh, 0].astype(jnp.float32),
+                         vs_r[pid, kvh] if quantized else None)
+
+    @pl.when(in_attn & (pc == maxp) & (nt > 0))
+    def _self_cell():
+        kj = lax.broadcasted_iota(jnp.int32, (1, Cq), 1)
+        krel = w + kj - off
+        mask = (valid_q & (krel >= 0) & (krel < nt) & (krel <= trel))
+        for h in range(H):
+            kvh = h // qpg
+            lo, hi = kvh * hd, (kvh + 1) * hd
+            qh = qs[h, pl.ds(w, Cq)]
+            kw = kn_ref[pl.ds(w, Cq), lo:hi].astype(jnp.float32)
+            s = lax.dot_general(qh, kw, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask, capped(s), NEG_INF)
+            vw = vn_ref[pl.ds(w, Cq), lo:hi].astype(jnp.float32)
+            l_new, a_new = flash_update(h, s, vw, None)
+            o = a_new / jnp.maximum(l_new, 1e-30)
+            hlo = h * hd
+            cur = ao_s[pl.ds(w, Cq), hlo:hlo + hd]
+            ao_s[pl.ds(w, Cq), hlo:hlo + hd] = jnp.where(
+                valid_q, o, cur)
+
+    # ---- phase 2: o-proj tiles + residual add ------------------------
+    @pl.when((t >= S2) & (t < S3))
+    def _oproj_tile():
+        wm = wo_ref[...].astype(dot_dt)
+        o = lax.dot_general(
+            ao_s[...].astype(dot_dt), wm, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o = o * so_ref[...].astype(jnp.float32)
+        h_s[t - S2] = xt_ref[...].astype(jnp.float32) + o
+
+    # ---- phase 3: second norm + fused gate/up/down -------------------
+    @pl.when(t == S3)
+    def _mlp_norm():
+        ss = jnp.zeros((T, 1), jnp.float32)
+        for j in range(To):
+            hj = h_s[j]
+            ss = ss + jnp.sum(hj * hj, axis=-1, keepdims=True)
+        rr = lax.rsqrt(ss / D + eps)
+        for j in range(To):
+            sl = slice(j * to, (j + 1) * to)
+            xn_s[:, sl] = h_s[j] * rr * ln_m_ref[:, sl].astype(
+                jnp.float32)
+        y_s[...] = jnp.zeros_like(y_s)
+
+    @pl.when(t >= S3)
+    def _mlp_tile():
+        hn = xn_s[...].astype(dot_dt)
+        g = lax.dot_general(
+            hn, wg_g_ref[...].astype(dot_dt), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        g = g * sg_g_ref[...].astype(jnp.float32)
+        u = lax.dot_general(
+            hn, wg_u_ref[...].astype(dot_dt), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        u = u * sg_u_ref[...].astype(jnp.float32)
+        act = (g * jax.nn.sigmoid(g)) * u
+        y_s[...] += lax.dot_general(
+            act.astype(dot_dt), wd_ref[...].astype(dot_dt),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == S4 - 1)
+    def _final():
+        sdv = sd_ref[...].astype(jnp.float32)
+        for j in range(To):
+            sl = slice(j * to, (j + 1) * to)
+            xo_ref[:, sl] = (h_s[j] + y_s[:, sl] * sdv[:, sl]).astype(
+                xo_ref.dtype)
+
+
+def fused_ragged_layer(
+    x: jax.Array,            # [T, D] residual stream of the flat batch
+    layer,
+    k_pools: jax.Array,
+    v_pools: jax.Array,
+    layer_idx: jax.Array,
+    row_slot, row_start, row_len, row_off,
+    block_tables: jax.Array,
+    sin: jax.Array,          # [T, hd // 2] per-token rope rows
+    cos: jax.Array,
+    *,
+    eps: float,
+    n_heads: int,
+    n_kv_heads: int,
+    soft_cap: Optional[float] = None,
+    k_scales: Optional[jax.Array] = None,
+    v_scales: Optional[jax.Array] = None,
+    max_row_tokens: Optional[int] = None,
+    tile_qkv: int = 256,
+    tile_out: int = 256,
+    tile_mlp: int = 128,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The PR-2 per-layer decode megakernel folded over a ragged
+    batch: one pallas_call runs RMSNorm -> qkv -> RoPE -> ragged paged
+    attention (pool pages + intra-row self phase) -> o-proj -> MLP for
+    every packed token.  Pools read-only; fresh k/v rows ([T, KVH*hd])
+    ride out for the post-scan ragged append."""
+    from ray_tpu.ops.fused_decode import (
+        _assemble_gateup,
+        _assemble_qkv,
+        _pick_tile,
+        _qdict,
+        _weight_pair,
+    )
+
+    T, D = x.shape
+    H, KVH = n_heads, n_kv_heads
+    hd = D // H
+    L, KVH_p, Pt, page, _ = k_pools.shape
+    assert KVH_p == KVH, (KVH_p, KVH)
+    maxp = block_tables.shape[1]
+    R = row_slot.shape[0]
+    M = (layer["mlp"]["w_down"]["q"].shape[0] if _qdict(
+        layer["mlp"]["w_down"]) else layer["mlp"]["w_down"].shape[0])
+    qpg = H // KVH
+    quantized = k_scales is not None
+    dt = x.dtype
+    Cw = (H + 2 * KVH) * hd
+
+    wqkv, sqkv = _assemble_qkv(layer["attn"], H, KVH, hd, dt)
+    wg, sg = _assemble_gateup(layer["mlp"], dt)
+    wo_leaf = layer["attn"]["wo"]
+    if _qdict(wo_leaf):
+        wo = wo_leaf["q"].reshape(H * hd, D)
+        so = wo_leaf["scale"].reshape(1, D).astype(jnp.float32)
+    else:
+        wo = wo_leaf.reshape(H * hd, D)
+        so = jnp.ones((1, D), jnp.float32)
+    wd, sd = _weight_pair(layer["mlp"]["w_down"])
+    ln_a = layer["ln_attn"].reshape(1, D).astype(jnp.float32)
+    ln_m = layer["ln_mlp"].reshape(1, D).astype(jnp.float32)
+
+    T_p = _round8(T)
+    if T_p != T:
+        pad = T_p - T
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        sin = jnp.pad(sin, ((0, pad), (0, 0)))
+        cos = jnp.pad(cos, ((0, pad), (0, 0)))
+    Cq = window_size(T_p, max_row_tokens)
+
+    tq = _pick_tile(Cw, tile_qkv, multiple=hd)
+    to = _pick_tile(D, tile_out, multiple=128 if D % 128 == 0 else 1)
+    tm = _pick_tile(M, tile_mlp, multiple=128 if M % 128 == 0 else 1)
+    Tq, To, Tm = Cw // tq, D // to, M // tm
+    cells = maxp + 1
+    S1 = Tq
+    S2 = S1 + R * cells
+    S3 = S2 + To
+    S4 = S3 + Tm
+
+    def clip(v, n):
+        return jnp.clip(v, 0, n - 1)
+
+    def const2(t, *pf):
+        return (0, 0)
+
+    def pool_map(t, slot_p, start_p, len_p, off_p, bt, ly, *sc):
+        ci = clip(t - S1, R * cells)
+        r = ci // cells
+        pc = jnp.minimum(ci % cells, maxp - 1)
+        s = slot_p[r]
+        last = jnp.maximum(start_p[r] - 1, 0) // page
+        pe = jnp.minimum(pc, last)
+        pid = jnp.minimum(bt[s, pe], Pt - 1)
+        return (ly[0], 0, jnp.where(len_p[r] > 0, pid, Pt - 1), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((T_p, D), const2),                        # x (norm)
+        pl.BlockSpec((T_p, to),
+                     lambda t, *pf: (0, clip(t - S2, To))),    # x (resid)
+        pl.BlockSpec((1, D), const2),                          # ln_attn
+        pl.BlockSpec((1, D), const2),                          # ln_mlp
+        pl.BlockSpec((T_p, hd // 2), const2),                  # sin
+        pl.BlockSpec((T_p, hd // 2), const2),                  # cos
+        pl.BlockSpec((D, tq), lambda t, *pf: (0, clip(t, Tq))),
+        pl.BlockSpec((1, tq), lambda t, *pf: (0, clip(t, Tq))),
+        pl.BlockSpec((1, KVH, 1, page, hd), pool_map),         # k pages
+        pl.BlockSpec((1, KVH, 1, page, hd), pool_map),         # v pages
+        pl.BlockSpec((H * hd, to),
+                     lambda t, *pf: (0, clip(t - S2, To))),    # wo
+        pl.BlockSpec((1, to),
+                     lambda t, *pf: (0, clip(t - S2, To))),    # so
+        pl.BlockSpec((D, tm),
+                     lambda t, *pf: (0, clip(t - S3, Tm))),    # w gate
+        pl.BlockSpec((D, tm),
+                     lambda t, *pf: (0, M // tm + clip(t - S3, Tm))),
+        pl.BlockSpec((1, tm),
+                     lambda t, *pf: (0, clip(t - S3, Tm))),    # s gate
+        pl.BlockSpec((1, tm),
+                     lambda t, *pf: (0, M // tm + clip(t - S3, Tm))),
+        pl.BlockSpec((tm, D),
+                     lambda t, *pf: (clip(t - S3, Tm), 0)),    # w_down
+        pl.BlockSpec((1, D), const2),                          # sd
+    ]
+    out_specs = [
+        pl.BlockSpec((T_p, D), const2),
+        pl.BlockSpec((T_p, KVH * hd), const2),
+        pl.BlockSpec((T_p, KVH * hd), const2),
+    ]
+    scratch = [
+        pltpu.VMEM((T_p, D), jnp.float32),                 # xn_s
+        pltpu.VMEM((Tq, T_p, tq), jnp.float32),            # qkv_s
+        pltpu.VMEM((H, T_p, hd), jnp.float32),             # qs
+        pltpu.VMEM((H, T_p, 1), jnp.float32),              # m_s
+        pltpu.VMEM((H, T_p, 1), jnp.float32),              # l_s
+        pltpu.VMEM((H, T_p, hd), jnp.float32),             # acc_s
+        pltpu.VMEM((T_p, H * hd), jnp.float32),            # ao_s
+        pltpu.VMEM((To, T_p, to), jnp.float32),            # h_s
+        pltpu.VMEM((T_p, D), jnp.float32),                 # y_s
+    ]
+    ly_s = jnp.asarray(layer_idx, jnp.int32)
+    prefetch = [row_slot.astype(jnp.int32), row_start.astype(jnp.int32),
+                row_len.astype(jnp.int32), row_off.astype(jnp.int32),
+                block_tables.astype(jnp.int32), ly_s.reshape(1)]
+    if quantized:
+        prefetch += [k_scales[ly_s, :, :, 0], v_scales[ly_s, :, :, 0]]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(prefetch),
+        grid=(S4,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    kern = functools.partial(
+        _fused_ragged_kernel, T=T_p, Cq=Cq, D=D, H=H, KVH=KVH, qpg=qpg,
+        hd=hd, page=page, Pt=Pt, maxp=maxp, R=R, M=M, tq=tq, to=to,
+        tm=tm, eps=eps, scale=hd ** -0.5, soft_cap=soft_cap,
+        quantized=quantized, dot_dt=dt)
+    x_out, k_new, v_new = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((T_p, D), dt),
+            jax.ShapeDtypeStruct((T_p, KVH * hd), dt),
+            jax.ShapeDtypeStruct((T_p, KVH * hd), dt),
+        ],
+        interpret=_interpret_mode(),
+    )(*prefetch, x, x, ln_a, ln_m, sin.astype(jnp.float32),
+      cos.astype(jnp.float32), wqkv, sqkv, k_pools, v_pools, wo, so,
+      wg, wg, sg, sg, wd, sd)
+    return (x_out[:T], k_new[:T].reshape(T, KVH, hd),
+            v_new[:T].reshape(T, KVH, hd))
+
+
+# --------------------------------------------------------------------------
+# host-side packing helper
+# --------------------------------------------------------------------------
+
+
+def pack_ragged_batch(rows, token_budget: int, max_slots: int):
+    """Host-side packer: ``rows`` is a list of dicts with keys
+    ``slot``, ``start``, ``tokens`` (list[int] for prefill chunks, or
+    None for decode rows whose token lives on device).  Returns numpy
+    arrays sized (token_budget, max_slots):
+
+        host_toks, decode_mask, tok_slot, tok_pos  [T]
+        row_slot, row_start, row_len, row_off      [R]
+
+    Padding rows get len 0 / slot 0; padding tokens get pos 0."""
+    T, R = token_budget, max_slots
+    host_toks = np.zeros(T, np.int32)
+    decode_mask = np.zeros(T, bool)
+    tok_slot = np.zeros(T, np.int32)
+    tok_pos = np.zeros(T, np.int32)
+    row_slot = np.zeros(R, np.int32)
+    row_start = np.zeros(R, np.int32)
+    row_len = np.zeros(R, np.int32)
+    row_off = np.zeros(R, np.int32)
+    cursor = 0
+    for i, row in enumerate(rows):
+        toks = row.get("tokens")
+        n = 1 if toks is None else len(toks)
+        assert cursor + n <= T and i < R, "packer overflow"
+        row_slot[i] = row["slot"]
+        row_start[i] = row["start"]
+        row_len[i] = n
+        row_off[i] = cursor
+        tok_slot[cursor:cursor + n] = row["slot"]
+        tok_pos[cursor:cursor + n] = row["start"] + np.arange(n)
+        if toks is None:
+            decode_mask[cursor] = True
+        else:
+            host_toks[cursor:cursor + n] = np.asarray(toks, np.int32)
+        cursor += n
+    return (host_toks, decode_mask, tok_slot, tok_pos,
+            row_slot, row_start, row_len, row_off)

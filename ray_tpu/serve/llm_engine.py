@@ -122,6 +122,14 @@ def _telemetry():
                 "Tokens from SLO-met requests over all tokens of "
                 "terminal requests — goodput vs raw throughput.",
             ),
+            "step_tokens": metrics.Counter(
+                "raytpu_serve_step_tokens_total",
+                "Tokens dispatched to the device, split by phase "
+                "(prefill vs decode).  Attributes step wall time: a "
+                "rising prefill share explains decode-stream TPOT "
+                "regressions without any per-request change.",
+                tag_keys=("phase",),
+            ),
         }
     else:
         reg = metrics.registry()
@@ -167,6 +175,16 @@ class EngineConfig:
     # Latency objectives driving the SLO met/missed counters and the
     # goodput gauge (None = every finished request counts as met).
     slo: Optional[SLO] = None
+    # Ragged batching (paged mode): one unified device step per
+    # dispatch mixing decode rows (1 token per active slot) with
+    # prefill chunks from the admission queue, packed up to
+    # token_budget tokens (ops/ragged_paged_attention.py).  Replaces
+    # the prefill-vs-decode interleave — a long prompt streams in
+    # budget-sized chunks beside live decode rows instead of stalling
+    # them.  token_budget=0 sizes it max_slots + max(prefill_chunk,
+    # page_size).
+    ragged_batching: bool = False
+    token_budget: int = 0
 
     def buckets(self) -> List[int]:
         out, b = [], self.min_prefill_bucket
@@ -235,6 +253,12 @@ class PagedEngineAdapter:
     # chunk_lens[K], pages_rows[K,maxp], cache) -> (logits[K,V], cache)
     # — enables EngineConfig.prefill_chunk.
     prefill_chunk: Optional[Callable[..., Tuple[jax.Array, Any]]] = None
+    # Unified ragged step: ragged_step(params, tokens[T], tok_pos[T],
+    # row_slot[R], row_start[R], row_len[R], row_off[R], block_tables,
+    # cache) -> (logits[R,V], cache).  One device program serving a
+    # mixed batch of decode rows (len 1) and prefill chunks — enables
+    # EngineConfig.ragged_batching.
+    ragged_step: Optional[Callable[..., Tuple[jax.Array, Any]]] = None
     # Tensor-parallel serving (LLMEngine(mesh=...)): shard_params
     # places params on the mesh (pass HOST arrays for big models — the
     # transfer shards directly, never materializing an unsharded copy
@@ -267,6 +291,11 @@ def llama_paged_adapter(cfg) -> PagedEngineAdapter:
         cache:
             llama.prefill_chunk_paged(params, tokens, start, chunk_lens,
                                       pages_rows, cfg, cache),
+        ragged_step=lambda params, tokens, tok_pos, row_slot, row_start,
+        row_len, row_off, bt, cache:
+            llama.ragged_step_paged(params, tokens, tok_pos, row_slot,
+                                    row_start, row_len, row_off, bt, cfg,
+                                    cache),
         shard_params=lambda params, mesh:
             llama.shard_params_for_serving(params, cfg, mesh),
         cache_shardings=lambda mesh: llama.paged_cache_shardings(
@@ -479,13 +508,18 @@ class LLMEngine:
                 self._cache = adapter.init_cache(self._num_pages, page)
             if (isinstance(self._cache, dict)
                     and "k_scale" in self._cache
-                    and config.prefill_chunk > 0):
+                    and config.prefill_chunk > 0
+                    and not config.ragged_batching):
+                # The ragged path appends through a page-granular
+                # one-hot gather that CAN grow page scales, so int8 KV
+                # + chunked prompts is only a restriction of the legacy
+                # interleave.
                 raise ValueError(
                     "kv_int8 pools do not support chunked prefill "
                     "(per-token page scatters cannot grow page scales "
                     "on the gather path) — set "
-                    "EngineConfig.prefill_chunk=0 or serve with bf16 "
-                    "KV")
+                    "EngineConfig.prefill_chunk=0, enable "
+                    "ragged_batching, or serve with bf16 KV")
             self._free_pages = list(range(self._num_pages))
             self._slot_pages: Dict[int, List[int]] = {}
             # Unallocated block-table entries hold the OOB sentinel
@@ -552,6 +586,7 @@ class LLMEngine:
         self._terminal_tokens = 0
         self._step_walls: deque = deque(maxlen=64)  # recent s/step
         self._step_wall_hw = 0.0  # watermark mirrored to the gauge
+        self._stall_events = 0  # steps past STALL_FACTOR x median
         self._xprof_recorded: set = set()  # programs already registered
 
         slots = config.max_slots
@@ -637,6 +672,52 @@ class LLMEngine:
             self._prefill_chunk_fn = prefill_chunk_fn
         else:
             self._prefill_chunk_fn = None
+        # Ragged batching: ONE jitted program per scheduler step, fed a
+        # packed token buffer of decode rows + prefill chunks.  Static
+        # (T, R) = (token_budget, max_slots) → a single compile serves
+        # every mix.
+        self._ragged = bool(config.ragged_batching)
+        if self._ragged:
+            if not self._paged or adapter.ragged_step is None:
+                raise ValueError(
+                    "EngineConfig.ragged_batching requires a "
+                    "PagedEngineAdapter with ragged_step")
+            if mesh is not None:
+                raise ValueError(
+                    "ragged_batching does not support mesh-sharded "
+                    "serving yet — drop mesh= or ragged_batching")
+            self._token_budget = config.token_budget or (
+                config.max_slots
+                + max(config.prefill_chunk, config.page_size))
+            if self._token_budget < config.max_slots + 1:
+                raise ValueError(
+                    "token_budget must leave room for a prefill chunk "
+                    f"beside {config.max_slots} decode rows")
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def ragged_step_fn(params, cache, host_toks, decode_mask,
+                               tok_slot, tok_pos, row_slot, row_start,
+                               row_len, row_off, temps, seed, cur,
+                               scatter_ids, bt):
+                # Decode rows read their token from the device-resident
+                # cur (no host round trip — same pipelining contract as
+                # decode_paged_fn); prefill rows carry host tokens.
+                toks = jnp.where(decode_mask, cur[tok_slot], host_toks)
+                logits, cache = adapter.ragged_step(
+                    params, toks, tok_pos, row_slot, row_start, row_len,
+                    row_off, bt, cache)
+                sampled = _sample(logits, temps,
+                                  jax.random.key(seed[0]))
+                # Mid-chunk prefill rows and padding rows carry OOB
+                # scatter ids: their sample is meaningless and must not
+                # clobber a live slot's cur.
+                cur = cur.at[scatter_ids].set(sampled, mode="drop")
+                return cache, sampled, cur
+
+            self._ragged_step_fn = ragged_step_fn
+        else:
+            self._ragged_step_fn = None
+            self._token_budget = 0
         # Requests mid-incremental-prefill: [{req, slot, pos}].
         self._prefilling: List[Dict[str, Any]] = []
         # Requests whose admission prefill is being dispatched — a
@@ -814,6 +895,7 @@ class LLMEngine:
             "waiting": self._waiting.qsize(),
             "steps": self._steps,
             "tokens_out": self._tokens_out,
+            "stall_events": self._stall_events,
             "requests": self._ring.counts_by_state(),
         }
 
@@ -840,6 +922,8 @@ class LLMEngine:
     def _admit(self):
         if self._draining.is_set():
             return  # racing submits are preempted, never admitted
+        if self._ragged:
+            return self._admit_ragged()
         if self._paged:
             return self._admit_paged()
         while self._free_slots:
@@ -921,6 +1005,10 @@ class LLMEngine:
         program; host arrays ride the dispatch (no separate uploads).
         Callers set self._admitting first: a crash inside the dispatch
         must still fail these not-yet-registered requests."""
+        # Padding rows are real device work, so they count as
+        # dispatched prefill tokens (phase attribution, not goodput).
+        self._tm["step_tokens"].inc(int(np.sum(true_lens)),
+                                    tags={"phase": "prefill"})
         if self._prefill_batched_fn is not None:
             self._cache, toks_dev, self._cur_dev = \
                 self._instrumented_dispatch(
@@ -1093,6 +1181,133 @@ class LLMEngine:
                                          self._scatter_ids(slot_ids,
                                                            len(batch)))
             self._finish_admit(batch, toks_dev, slot_ids)
+
+    def _admit_ragged(self):
+        """Ragged admission: EVERY request (short or long) claims its
+        slot + pages up front and joins the incremental-prefill track;
+        the unified step packs its prompt in budget-sized chunks
+        beside live decode rows, so there is no separate one-shot
+        prefill program to head-of-line-block behind."""
+        while self._free_slots:
+            if self._backlog:
+                req = self._backlog.pop(0)
+            else:
+                try:
+                    req = self._waiting.get_nowait()
+                except queue.Empty:
+                    return
+            slot = self._alloc_slot_pages(req)
+            if slot is None:
+                self._backlog.insert(0, req)
+                return
+            req.admitted_at = time.monotonic()
+            self._ring.record(
+                req.request_id, _reqev.PREFILLING, slot=slot,
+                num_pages=len(self._slot_pages.get(slot, [])))
+            self._prefilling.append({"req": req, "slot": slot,
+                                     "pos": 0})
+            self._state_dirty = True  # bt rows changed
+
+    def _dispatch_ragged_step(self) -> bool:
+        """Pack and dispatch ONE unified ragged step: first a decode
+        row (one token) for every active slot with budget left, then
+        prefill chunks from the incremental track until token_budget
+        is full.  Decode rows are never displaced by prompt tokens —
+        that priority IS the no-stall guarantee chunked prefill only
+        approximates.  Returns False when nothing fit (every slot
+        budget-capped by in-flight tokens, no prompt tokens pending)."""
+        from ray_tpu.ops.ragged_paged_attention import pack_ragged_batch
+
+        T, R = self._token_budget, self.config.max_slots
+        budget = T
+        rows: List[Dict[str, Any]] = []
+        parts: List[Tuple[str, Request, int, int]] = []
+        scatter = np.full((R,), R, np.int32)  # OOB = sample dropped
+        temps = np.zeros((R,), np.float32)
+        n_decode = n_prefill = 0
+        for slot in sorted(self._slot_req):
+            if budget <= 0 or len(rows) >= R:
+                break
+            req = self._slot_req[slot]
+            rem = min(
+                req.max_new_tokens - len(req.tokens),
+                self.config.max_seq_len - len(req.prompt)
+                - len(req.tokens),
+            ) - self._inflight_tokens.get(slot, 0)
+            if rem <= 0:
+                continue  # budget fully covered by in-flight steps
+            i = len(rows)
+            rows.append({"slot": slot, "start": int(self._lens[slot]),
+                         "tokens": None})
+            parts.append(("decode", req, slot, i))
+            scatter[i] = slot
+            temps[i] = req.temperature
+            budget -= 1
+            n_decode += 1
+        finishing = []
+        for st in self._prefilling:
+            if budget <= 0 or len(rows) >= R:
+                break
+            req, slot, pos = st["req"], st["slot"], st["pos"]
+            chunk = req.prompt[pos:pos + budget]
+            if not chunk:
+                continue
+            is_last = pos + len(chunk) >= len(req.prompt)
+            i = len(rows)
+            rows.append({"slot": slot, "start": pos,
+                         "tokens": [int(t) for t in chunk]})
+            temps[i] = req.temperature
+            if is_last:
+                # The final chunk's sample is the request's first
+                # token; mid-chunk rows keep the OOB scatter id.
+                parts.append(("first", req, slot, i))
+                scatter[i] = slot
+                finishing.append(st)
+            st["pos"] = pos + len(chunk)
+            budget -= len(chunk)
+            n_prefill += len(chunk)
+        if not rows:
+            return False
+        (host_toks, decode_mask, tok_slot, tok_pos, row_slot,
+         row_start, row_len, row_off) = pack_ragged_batch(rows, T, R)
+        self._refresh_state_args()
+        self._cache, toks_dev, self._cur_dev = \
+            self._instrumented_dispatch(
+                "serve.ragged", self._ragged_step_fn,
+                (self._params, self._cache, host_toks, decode_mask,
+                 tok_slot, tok_pos, row_slot, row_start, row_len,
+                 row_off, temps, self._next_seed(), self._cur_dev,
+                 scatter, self._bt_arg),
+                span_name="llm.ragged", steps_attr="tokens",
+            )
+        now = time.monotonic()
+        for kind, req, slot, _i in parts:
+            if kind == "decode":
+                self._lens[slot] += 1  # mirror advances at dispatch
+            self._inflight_tokens[slot] = \
+                self._inflight_tokens.get(slot, 0) + 1
+        for st in finishing:
+            self._prefilling.remove(st)
+            req, slot = st["req"], st["slot"]
+            self._lens[slot] = len(req.prompt)
+            self._slot_req[slot] = req
+            self._temps[slot] = req.temperature
+            if req.admitted_at is None:
+                req.admitted_at = now
+        self._state_dirty = True
+        self._steps += 1
+        self._tm["step_tokens"].inc(n_decode, tags={"phase": "decode"})
+        self._tm["step_tokens"].inc(n_prefill,
+                                    tags={"phase": "prefill"})
+        if n_decode:
+            self._tm["batch_size"].observe(n_decode)
+        self._tm["queue_depth"].set(self._waiting.qsize()
+                                    + len(self._backlog))
+        self._tm["queue_age"].set(self._admission_queue_age())
+        self._unprocessed += 1
+        self._fetchq.put(("ragged", toks_dev, 1, list(parts),
+                          time.monotonic()))
+        return True
 
     def _emit(self, req: Request, slot: int, tok: int):
         """Record one generated token; finish/free the slot if done."""
@@ -1278,6 +1493,8 @@ class LLMEngine:
             self._next_seed(), self._cur_dev, scatter,
         )
         st["pos"] = pos + len(chunk)
+        self._tm["step_tokens"].inc(len(chunk),
+                                    tags={"phase": "prefill"})
         if is_last:
             self._prefilling.pop(0)
             self._lens[slot] = len(req.prompt)
@@ -1339,6 +1556,7 @@ class LLMEngine:
                 "%.1f ms (x%.1f, chunk=%d, active=%d)",
                 per_step * 1e3, median * 1e3, per_step / median,
                 chunk, len(self._slot_req))
+            self._stall_events += 1
             return True
         return False
 
@@ -1371,6 +1589,8 @@ class LLMEngine:
                     span_name="llm.decode", steps_attr="tokens",
                 )
         self._steps += chunk
+        self._tm["step_tokens"].inc(chunk * len(self._slot_req),
+                                    tags={"phase": "decode"})
         self._tm["batch_size"].observe(len(self._slot_req))
         self._tm["queue_depth"].set(
             self._waiting.qsize()
@@ -1431,6 +1651,30 @@ class LLMEngine:
                 self._note_step_time(now - t_disp, chunk)
             if kind == "pfchunk":
                 continue  # completion marker only (pipeline gating)
+            if kind == "ragged":
+                # One unified step: toks is the [R] row-sample vector;
+                # participants carry (kind, req, slot, row) for decode
+                # rows and final prefill chunks (mid-chunk rows have
+                # nothing to emit).  Wall time feeds the same stall
+                # watermark as decode — a ragged step IS a decode step
+                # for every running stream in it.
+                self._note_step_time(now - t_disp, 1)
+                for rkind, req, slot, i in participants:
+                    left = self._inflight_tokens.get(slot, 0) - 1
+                    if left > 0:
+                        self._inflight_tokens[slot] = left
+                    else:
+                        self._inflight_tokens.pop(slot, None)
+                    if req.finished_at is not None:
+                        continue  # cancelled/preempted while in flight
+                    if rkind == "first":
+                        req.first_token_at = now
+                        self._ring.record(req.request_id,
+                                          _reqev.DECODING)
+                        self._emit(req, slot, int(toks[i]))
+                    elif self._slot_req.get(slot) is req:
+                        self._emit(req, slot, int(toks[i]))
+                continue
             if kind == "prefill":
                 for i, (req, slot) in enumerate(participants):
                     left = self._inflight_tokens.get(slot, 0) - 1
@@ -1615,18 +1859,25 @@ class LLMEngine:
             self._process_fetched(block=False)
             self._admit()
             dispatched = False
-            if (self._prefilling
-                    and self._unprocessed < self._PIPELINE_DEPTH):
-                # One incremental-prefill chunk per iteration rides the
-                # device queue BETWEEN decode chunks: running streams
-                # stall at most one chunk per long-prompt segment.
-                self._dispatch_prefill_chunk()
-                dispatched = True
-            if self._slot_req and self._unprocessed < self._PIPELINE_DEPTH:
-                chunk = self._chunk_size()
-                if chunk > 0:
-                    self._dispatch_decode(chunk)
+            if self._ragged:
+                if ((self._slot_req or self._prefilling)
+                        and self._unprocessed < self._PIPELINE_DEPTH):
+                    dispatched = self._dispatch_ragged_step()
+            else:
+                if (self._prefilling
+                        and self._unprocessed < self._PIPELINE_DEPTH):
+                    # One incremental-prefill chunk per iteration rides
+                    # the device queue BETWEEN decode chunks: running
+                    # streams stall at most one chunk per long-prompt
+                    # segment.
+                    self._dispatch_prefill_chunk()
                     dispatched = True
+                if (self._slot_req
+                        and self._unprocessed < self._PIPELINE_DEPTH):
+                    chunk = self._chunk_size()
+                    if chunk > 0:
+                        self._dispatch_decode(chunk)
+                        dispatched = True
             if not dispatched and self._unprocessed > 0:
                 # Nothing to dispatch — wait for the fetcher.
                 self._process_fetched(block=True)

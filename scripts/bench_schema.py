@@ -24,7 +24,18 @@ Checked:
         decode_tokens_per_s, ttft_p50_ms, ttft_p95_ms) are numbers at
         a knee and null when saturated;
       - each ladder rung has numeric offered_req_s / completion /
-        ttft_p50_ms / ttft_p95_ms.
+        ttft_p50_ms / ttft_p95_ms;
+  * mixed-ladder blocks (extra.serving_mixed, extra.serving_1b_mixed —
+    any extra.*serving*mixed* object that is not {"error": ...}):
+      - batching is "ragged" or "interleaved", mixes is a non-empty
+        object;
+      - every mix is a full serving block (all the rules above,
+        including knee/saturated exclusivity) AND carries its
+        prompt_mix — the sampled prompt-length distribution (lens /
+        weights / sampled_p50 / sampled_p95 / sampled_max) without
+        which a per-mix knee TTFT is uninterpretable;
+      - prompt_mix weights are non-negative and sum to 1 over lens of
+        the same length.
 
 Usage:
     python scripts/bench_schema.py BENCH_OUT.json
@@ -52,6 +63,35 @@ RUNG_REQUIRED = ("offered_req_s", "completion", "ttft_p50_ms",
 
 def _num(v: Any) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_prompt_mix(name: str, pm: Any, problems: List[str]) -> None:
+    if not isinstance(pm, dict):
+        problems.append(f"{name}: prompt_mix is not an object")
+        return
+    lens = pm.get("lens")
+    weights = pm.get("weights")
+    if (not isinstance(lens, list) or not lens
+            or not all(_num(x) for x in lens)):
+        problems.append(f"{name}: prompt_mix.lens must be a non-empty "
+                        f"list of numbers, got {lens!r}")
+    if (not isinstance(weights, list)
+            or not all(_num(w) and w >= 0 for w in weights)):
+        problems.append(f"{name}: prompt_mix.weights must be a list of "
+                        f"non-negative numbers, got {weights!r}")
+    elif isinstance(lens, list) and len(weights) != len(lens):
+        problems.append(
+            f"{name}: prompt_mix has {len(lens)} lens but "
+            f"{len(weights)} weights")
+    elif weights and abs(sum(weights) - 1.0) > 1e-3:
+        problems.append(
+            f"{name}: prompt_mix.weights sum to {sum(weights):.4f}, "
+            f"not 1")
+    for k in ("sampled_p50", "sampled_p95", "sampled_max"):
+        if not _num(pm.get(k)):
+            problems.append(
+                f"{name}: prompt_mix.{k} missing or non-numeric: "
+                f"{pm.get(k)!r}")
 
 
 def _check_serving(name: str, d: Any, problems: List[str]) -> None:
@@ -104,6 +144,35 @@ def _check_serving(name: str, d: Any, problems: List[str]) -> None:
                         problems.append(
                             f"{name}: ladder[{i}].{k} missing or "
                             f"non-numeric: {rung.get(k)!r}")
+    if "prompt_mix" in d:
+        _check_prompt_mix(name, d["prompt_mix"], problems)
+
+
+def _check_mixed(name: str, d: Any, problems: List[str]) -> None:
+    """A mixed-length ladder block: one serving record per prompt mix,
+    each carrying the distribution that produced its knee."""
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    if "error" in d:
+        return
+    if d.get("batching") not in ("ragged", "interleaved"):
+        problems.append(
+            f"{name}: batching must be 'ragged' or 'interleaved', got "
+            f"{d.get('batching')!r}")
+    mixes = d.get("mixes")
+    if not isinstance(mixes, dict) or not mixes:
+        problems.append(f"{name}: mixes must be a non-empty object")
+        return
+    for mix, block in mixes.items():
+        sub = f"{name}.mixes[{mix}]"
+        _check_serving(sub, block, problems)
+        if (isinstance(block, dict) and "error" not in block
+                and "prompt_mix" not in block):
+            problems.append(
+                f"{sub}: missing prompt_mix — a per-mix knee TTFT "
+                f"without its prompt-length distribution is "
+                f"uninterpretable")
 
 
 def validate_record(rec: Any) -> List[str]:
@@ -129,6 +198,9 @@ def validate_record(rec: Any) -> List[str]:
     if isinstance(b8, dict) and b8.get("serving_int8") is not None:
         _check_serving("extra.llama_8b.serving_int8",
                        b8["serving_int8"], problems)
+    for key, block in extra.items():
+        if "serving" in key and "mixed" in key and block is not None:
+            _check_mixed(f"extra.{key}", block, problems)
     return problems
 
 

@@ -1,0 +1,224 @@
+"""Ragged-batching engine mode: one unified device step packs decode
+rows and prefill chunks into a single token-budgeted ragged batch
+(EngineConfig.ragged_batching; ops/ragged_paged_attention.py).
+
+Correctness oracle is the model's own ``forward`` (full-prefix
+recompute), in fp32 so greedy argmax is exact across program
+boundaries — bf16 greedy equality between DIFFERENT jitted programs is
+not a contract (XLA keeps excess precision under fusion, and tiny-model
+bf16 logit ties then round differently; both roundings are valid).
+
+The no-stall test is the PR's acceptance teeth: a long prompt admitted
+through prefill_chunk rides the same ragged steps as in-flight decode
+rows (decode packs FIRST, so prompt tokens can never displace it), and
+the PR-2 stall telemetry watermark must stay clean.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama
+from ray_tpu.serve.llm_engine import (
+    EngineConfig,
+    LLMEngine,
+    llama_adapter,
+    llama_paged_adapter,
+)
+
+CFG = llama.LlamaConfig(
+    vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    mlp_dim=64, max_seq_len=128, remat=False, dtype=jnp.float32,
+    param_dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.key(0), CFG)
+
+
+def greedy_reference(params, prompt, n_tokens):
+    toks = list(prompt)
+    out = []
+    for _ in range(n_tokens):
+        logits = llama.forward(params, jnp.asarray([toks]), CFG)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def _engine(params, **kw):
+    cfg = dict(max_slots=4, max_seq_len=128, min_prefill_bucket=16,
+               page_size=16, ragged_batching=True, token_budget=36)
+    cfg.update(kw)
+    return LLMEngine(params, llama_paged_adapter(CFG), EngineConfig(**cfg))
+
+
+def _phase_totals():
+    from ray_tpu.serve.llm_engine import _telemetry
+
+    out = {}
+    for _name, tags, value in _telemetry()["step_tokens"]._samples():
+        out[dict(tags).get("phase")] = value
+    return out
+
+
+def test_ragged_greedy_matches_oracle(params):
+    eng = _engine(params)
+    try:
+        prompts = [[i + 1, i + 2, i + 3] for i in range(6)]  # > max_slots
+        wants = [greedy_reference(params, p, 6) for p in prompts]
+        streams = [eng.submit(p, max_new_tokens=6, temperature=0.0)
+                   for p in prompts]
+        assert [s.result(timeout_s=120) for s in streams] == wants
+        for s in streams:
+            assert s.metrics["ttft_s"] is not None
+            assert s.metrics["num_tokens"] == 6
+    finally:
+        eng.shutdown()
+
+
+def test_ragged_chunked_prefill_matches_oracle(params):
+    """Prompts longer than the chunk arrive over several ragged steps
+    (mid-prompt chunks produce no token) and must still decode exactly."""
+    eng = _engine(params, prefill_chunk=16)
+    try:
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, 127, size=n).tolist()
+                   for n in (40, 3, 23)]
+        wants = [greedy_reference(params, p, 6) for p in prompts]
+        streams = [eng.submit(p, max_new_tokens=6, temperature=0.0)
+                   for p in prompts]
+        assert [s.result(timeout_s=120) for s in streams] == wants
+    finally:
+        eng.shutdown()
+
+
+def test_long_prefill_never_stalls_decode(params):
+    """The acceptance criterion: while a 96-token prompt trickles in
+    via prefill_chunk, an in-flight decode stream keeps emitting every
+    step — decode rows pack FIRST, so the prompt's chunks ride the
+    decode steps instead of displacing them."""
+    rng = np.random.default_rng(1)
+    eng = _engine(params, prefill_chunk=16)
+    try:
+        short = eng.submit([1, 5, 9], max_new_tokens=24, temperature=0.0)
+        # Let the short stream reach steady-state decode first.
+        it = iter(short)
+        next(it)
+        long_prompt = rng.integers(1, 127, size=96).tolist()
+        longs = eng.submit(long_prompt, max_new_tokens=4, temperature=0.0)
+        got_short = short.result(timeout_s=120)
+        got_long = longs.result(timeout_s=120)
+        assert got_short == greedy_reference(params, [1, 5, 9], 24)
+        assert got_long == greedy_reference(params, long_prompt, 4)
+        # The runs genuinely overlapped on the device…
+        assert longs._req.first_token_at < short._req.finished_at
+        # …and the long prompt's 6 chunks consumed (almost) no steps of
+        # their own: the short stream alone needs 24 (prefill + 23
+        # decode rows).  A scheduler that parked decode behind the
+        # prefill would serialize all 6 chunk steps on top (≥ 33).
+        assert eng.stats()["steps"] <= 28
+        # The decode stream never gapped by more than one step: its
+        # worst inter-token latency stays at step scale, nowhere near a
+        # monolithic 96-token prefill program.
+        assert short._req.max_itl_s < 1.0
+        # PR-2 stall telemetry: no ragged step ballooned past the
+        # stall factor — chunking bounds every step by token_budget.
+        assert eng.stats()["stall_events"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_ragged_step_token_phase_attribution(params):
+    """Per-phase token accounting: each ragged step attributes its
+    packed tokens to prefill vs decode, so goodput regressions are
+    attributable.  Prefill counts every prompt token exactly once;
+    decode counts every post-first generated token."""
+    before = _phase_totals()
+    eng = _engine(params, prefill_chunk=16)
+    try:
+        prompts = [[1, 5, 9, 2, 7], list(range(1, 41))]
+        streams = [eng.submit(p, max_new_tokens=5, temperature=0.0)
+                   for p in prompts]
+        for s in streams:
+            assert len(s.result(timeout_s=120)) == 5
+    finally:
+        eng.shutdown()
+    after = _phase_totals()
+    d_prefill = after.get("prefill", 0) - before.get("prefill", 0)
+    d_decode = after.get("decode", 0) - before.get("decode", 0)
+    assert d_prefill == sum(len(p) for p in prompts)
+    # first token of each request comes off its final prefill chunk
+    assert d_decode == sum(5 - 1 for _ in prompts)
+
+    # The family is pinned in the exposition contract.
+    import importlib.util
+    import pathlib
+
+    from ray_tpu.util import metrics
+
+    path = (pathlib.Path(__file__).resolve().parent.parent
+            / "scripts" / "check_metrics.py")
+    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    cm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cm)
+    assert cm.check_exposition(
+        metrics.export_prometheus(),
+        require=["raytpu_serve_step_tokens_total"]) == []
+
+
+def test_ragged_unlocks_int8_kv_with_chunked_prefill(params):
+    """kv_int8 + prefill_chunk is rejected on the legacy path (chunk
+    boundaries re-quantize mid-prompt) but supported ragged: the append
+    kernel's grow-only per-page scales make chunk boundaries bit-stable."""
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=64, max_seq_len=128, remat=False, dtype=jnp.float32,
+        param_dtype=jnp.float32, kv_int8=True)
+    with pytest.raises(ValueError, match="ragged_batching"):
+        LLMEngine(params, llama_paged_adapter(cfg), EngineConfig(
+            max_slots=2, max_seq_len=128, page_size=16, prefill_chunk=16))
+    eng = LLMEngine(params, llama_paged_adapter(cfg), EngineConfig(
+        max_slots=2, max_seq_len=128, page_size=16, prefill_chunk=16,
+        ragged_batching=True))
+    try:
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(1, 127, size=40).tolist()
+        out = eng.generate(prompt, max_new_tokens=5, temperature=0.0)
+        assert len(out) == 5
+    finally:
+        eng.shutdown()
+
+
+def test_ragged_requires_paged_adapter_and_sane_budget(params):
+    with pytest.raises(ValueError, match="ragged"):
+        LLMEngine(params, llama_adapter(CFG), EngineConfig(
+            max_slots=2, max_seq_len=128, ragged_batching=True))
+    with pytest.raises(ValueError, match="token_budget"):
+        LLMEngine(params, llama_paged_adapter(CFG), EngineConfig(
+            max_slots=4, max_seq_len=128, page_size=16,
+            ragged_batching=True, token_budget=4))
+
+
+def test_ragged_streaming_and_temperature(params):
+    """Sampling still flows through the same ragged step (temps ride
+    the dispatch), and streamed tokens arrive incrementally."""
+    eng = _engine(params)
+    try:
+        stream = eng.submit([3, 1, 4], max_new_tokens=5, temperature=0.0)
+        seen = []
+        t0 = time.monotonic()
+        for tok in stream:
+            seen.append(tok)
+            assert time.monotonic() - t0 < 120
+        assert seen == greedy_reference(params, [3, 1, 4], 5)
+        hot = eng.generate([3, 1, 4], max_new_tokens=16, temperature=1.5)
+        assert len(hot) == 16
+    finally:
+        eng.shutdown()

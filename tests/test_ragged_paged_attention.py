@@ -1,0 +1,331 @@
+"""Ragged paged attention: one kernel, one batch for mixed
+prefill+decode (PAPERS.md "Ragged Paged Attention"; ROADMAP item #1).
+
+Three layers of parity, all interpret-mode on CPU:
+
+  * kernel vs dense-gather reference (fp32 and int8 pools, with and
+    without the max_row_tokens VMEM cap);
+  * the in-place append kernels vs their scatter references;
+  * llama.ragged_step_paged end-to-end against the existing
+    prefill_slot_paged + decode_slots_paged pipeline — same pages,
+    same tokens, greedy-argmax-identical — across fp32, int8-KV,
+    fused-megakernel, and int8-weight (w8a16) configs.
+
+Everything here is fp32/argmax-exact by construction; bf16 configs are
+exercised through the engine suite, where greedy equality is NOT a
+contract (XLA keeps excess precision under jit, so bf16 logit ties may
+round differently between fused programs — both roundings are valid).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama
+from ray_tpu.ops import ragged_paged_attention as rpa
+
+
+def _mixed_rows(T=48, R=4):
+    """One decode row, one mid-prompt prefill chunk, one fresh prefill,
+    one padding row — the shapes a real engine step packs."""
+    return (np.asarray([2, 0, 3, 0], np.int32),    # slot
+            np.asarray([19, 0, 7, 0], np.int32),   # start
+            np.asarray([1, 11, 13, 0], np.int32),  # len (0 = padding)
+            np.asarray([0, 1, 12, 0], np.int32))   # off
+
+
+def _pools(rng, L, KVH, Pt, page, D, int8=False):
+    k = rng.standard_normal((L, KVH, Pt, page, D)).astype(np.float32)
+    v = rng.standard_normal((L, KVH, Pt, page, D)).astype(np.float32)
+    if not int8:
+        return jnp.asarray(k), jnp.asarray(v), None, None
+    ks = np.abs(k).max(axis=(1, 3, 4), initial=1e-6) / 127.0
+    vs = np.abs(v).max(axis=(1, 3, 4), initial=1e-6) / 127.0
+    kq = np.round(k / ks[:, None, :, None, None]).astype(np.int8)
+    vq = np.round(v / vs[:, None, :, None, None]).astype(np.int8)
+    return (jnp.asarray(kq), jnp.asarray(vq),
+            jnp.asarray(np.repeat(ks[:, :, None, None], KVH, axis=2)),
+            jnp.asarray(np.repeat(vs[:, :, None, None], KVH, axis=2)))
+
+
+@pytest.mark.parametrize("mrt", [None, 16])
+@pytest.mark.parametrize("int8", [False, True])
+def test_kernel_matches_reference(mrt, int8):
+    rng = np.random.default_rng(0)
+    L, KVH, Pt, page, D, H = 2, 2, 17, 16, 8, 4
+    T, _R = 48, 4
+    kp, vp, ks, vs = _pools(rng, L, KVH, Pt, page, D, int8=int8)
+    # Shuffled physical pages: the block-table indirection must be
+    # honored (page Pt-1 is the scratch page and stays out of tables).
+    bt = rng.permutation(Pt - 1)[:16].reshape(4, 4).astype(np.int32)
+    rs, rst, rl, ro = _mixed_rows(T)
+    q = rng.standard_normal((T, H, D)).astype(np.float32)
+    kn = rng.standard_normal((T, KVH, D)).astype(np.float32)
+    vn = rng.standard_normal((T, KVH, D)).astype(np.float32)
+    for layer in (0, 1):
+        kl = (kp[layer].astype(jnp.float32) if not int8 else kp[layer])
+        vl = (vp[layer].astype(jnp.float32) if not int8 else vp[layer])
+        ref = rpa.ragged_attention_reference(
+            q, kn, vn, kl, vl, rs, rst, rl, ro, bt,
+            k_scales=None if ks is None else ks[layer],
+            v_scales=None if vs is None else vs[layer])
+        got = rpa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kn), jnp.asarray(vn), kp, vp,
+            layer, jnp.asarray(rs), jnp.asarray(rst), jnp.asarray(rl),
+            jnp.asarray(ro), jnp.asarray(bt), k_scales=ks, v_scales=vs,
+            max_row_tokens=mrt)
+        mask = np.zeros(T, bool)
+        for r in range(4):
+            mask[ro[r]:ro[r] + rl[r]] = rl[r] > 0
+        np.testing.assert_allclose(np.asarray(got)[mask],
+                                   np.asarray(ref)[mask],
+                                   atol=2e-5, rtol=2e-5)
+        # Buffer rows no row covers are zero, never garbage.
+        assert not np.any(np.asarray(got)[~mask])
+
+
+def test_kernel_soft_cap():
+    rng = np.random.default_rng(1)
+    L, KVH, Pt, page, D, H, T = 1, 1, 9, 16, 8, 2, 16
+    kp, vp, _, _ = _pools(rng, L, KVH, Pt, page, D)
+    bt = np.arange(8, dtype=np.int32).reshape(2, 4)
+    rs = np.asarray([1, 0], np.int32)
+    rst = np.asarray([33, 0], np.int32)
+    rl = np.asarray([1, 0], np.int32)
+    ro = np.asarray([0, 0], np.int32)
+    q = rng.standard_normal((T, H, D)).astype(np.float32) * 4
+    kn = rng.standard_normal((T, KVH, D)).astype(np.float32)
+    vn = rng.standard_normal((T, KVH, D)).astype(np.float32)
+    ref = rpa.ragged_attention_reference(
+        q, kn, vn, kp[0], vp[0], rs, rst, rl, ro, bt, soft_cap=20.0)
+    got = rpa.ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(kn), jnp.asarray(vn), kp, vp, 0,
+        jnp.asarray(rs), jnp.asarray(rst), jnp.asarray(rl),
+        jnp.asarray(ro), jnp.asarray(bt), soft_cap=20.0)
+    np.testing.assert_allclose(np.asarray(got)[0], np.asarray(ref)[0],
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_append_matches_reference():
+    rng = np.random.default_rng(2)
+    L, KVH, Pt, page, D, T = 2, 2, 17, 16, 8, 48
+    kp, vp, _, _ = _pools(rng, L, KVH, Pt, page, D)
+    bt = rng.permutation(Pt - 1)[:16].reshape(4, 4).astype(np.int32)
+    rs, rst, rl, ro = _mixed_rows(T)
+    kn = rng.standard_normal((L, T, KVH, D)).astype(np.float32)
+    vn = rng.standard_normal((L, T, KVH, D)).astype(np.float32)
+    want_k, want_v = kp, vp
+    for layer in range(L):
+        wk, wv = rpa.ragged_append_reference(
+            want_k[layer], want_v[layer], kn[layer], vn[layer],
+            rs, rst, rl, ro, bt)
+        want_k = want_k.at[layer].set(wk)
+        want_v = want_v.at[layer].set(wv)
+    got_k, got_v = rpa.ragged_paged_append(
+        kp, vp, jnp.asarray(kn), jnp.asarray(vn), jnp.asarray(rs),
+        jnp.asarray(rst), jnp.asarray(rl), jnp.asarray(ro),
+        jnp.asarray(bt))
+    # The scratch page (Pt-1) is garbage-tolerant; everything else must
+    # match the scatter reference exactly.
+    np.testing.assert_array_equal(np.asarray(got_k)[:, :, :-1],
+                                  np.asarray(want_k)[:, :, :-1])
+    np.testing.assert_array_equal(np.asarray(got_v)[:, :, :-1],
+                                  np.asarray(want_v)[:, :, :-1])
+
+
+def test_append_quantized_grow_only_scales():
+    """Fresh tokens land dequant-close; a page extended by a small-
+    magnitude row keeps its scale (existing int8 stays bit-stable)."""
+    rng = np.random.default_rng(3)
+    L, KVH, Pt, page, D, T = 1, 1, 5, 16, 8, 16
+    kq = np.zeros((L, KVH, Pt, page, D), np.int8)
+    vq = np.zeros((L, KVH, Pt, page, D), np.int8)
+    ks = np.full((L, Pt, KVH, 1), 0.05, np.float32)
+    vs = np.full((L, Pt, KVH, 1), 0.05, np.float32)
+    # page 0 holds 8 tokens of slot 0 already, quantized at scale 0.05
+    kq[0, :, 0, :8] = rng.integers(-100, 100, (KVH, 8, D))
+    bt = np.full((1, 2), Pt, np.int32)
+    bt[0, :2] = [0, 1]
+    rs = np.asarray([0], np.int32)
+    rst = np.asarray([8], np.int32)
+    rl = np.asarray([4], np.int32)
+    ro = np.asarray([0], np.int32)
+    kn = (rng.standard_normal((L, T, KVH, D)) * 0.01).astype(np.float32)
+    vn = (rng.standard_normal((L, T, KVH, D)) * 0.01).astype(np.float32)
+    gk, gv, gks, gvs = rpa.ragged_paged_append_quantized(
+        jnp.asarray(kq), jnp.asarray(vq), jnp.asarray(ks),
+        jnp.asarray(vs), jnp.asarray(kn), jnp.asarray(vn),
+        jnp.asarray(rs), jnp.asarray(rst), jnp.asarray(rl),
+        jnp.asarray(ro), jnp.asarray(bt))
+    # grow-only: the small appended row must not shrink page 0's scale
+    assert float(gks[0, 0, 0, 0]) == pytest.approx(0.05)
+    # pre-existing int8 values are untouched
+    np.testing.assert_array_equal(np.asarray(gk)[0, :, 0, :8], kq[0, :, 0, :8])
+    # the fresh tokens dequantize back within one quant step
+    deq = np.asarray(gk, np.float32)[0, :, 0, 8:12] \
+        * float(gks[0, 0, 0, 0])
+    np.testing.assert_allclose(deq, kn[0, :4].transpose(1, 0, 2),
+                               atol=float(gks[0, 0, 0, 0]))
+    del gv, gvs
+
+
+def test_pack_ragged_batch_contract():
+    rows = [
+        dict(slot=2, start=19, tokens=None),          # decode
+        dict(slot=0, start=0, tokens=[5, 6, 7]),      # prefill chunk
+        dict(slot=3, start=16, tokens=[9, 9]),        # later chunk
+    ]
+    (htoks, dmask, tslot, tpos, rslot, rstart, rlen, roff
+     ) = rpa.pack_ragged_batch(rows, token_budget=8, max_slots=4)
+    assert list(rlen) == [1, 3, 2, 0]
+    assert list(roff) == [0, 1, 4, 0]
+    assert list(rslot) == [2, 0, 3, 0]
+    assert list(rstart) == [19, 0, 16, 0]
+    # decode rows read from the device cur; prefill rows from the host
+    assert list(dmask[:6]) == [True, False, False, False, False, False]
+    assert list(tslot[:1]) == [2]
+    assert list(htoks[1:6]) == [5, 6, 7, 9, 9]
+    # absolute positions: decode at start, chunks start+i
+    assert list(tpos[:6]) == [19, 0, 1, 2, 16, 17]
+    # over-budget / over-slots packing is a scheduler bug, not a clamp
+    with pytest.raises(AssertionError):
+        rpa.pack_ragged_batch(
+            [dict(slot=0, start=0, tokens=list(range(9)))],
+            token_budget=8, max_slots=4)
+    with pytest.raises(AssertionError):
+        rpa.pack_ragged_batch(
+            [dict(slot=s, start=0, tokens=None) for s in range(5)],
+            token_budget=8, max_slots=4)
+
+
+def test_window_size_caps_vmem_window():
+    # uncapped: the whole (padded) buffer
+    assert rpa.window_size(48, None) == 48
+    # capped: rounded row bound + the 8-row alignment slack
+    assert rpa.window_size(256, 16) == 24
+    # cap can never exceed the buffer itself
+    assert rpa.window_size(16, 64) == 16
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: ragged_step_paged vs the prefill+decode pipeline
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_oracle(params, cfg, prompts, bt, num_pages, page,
+                     decode_steps):
+    """The existing two-program pipeline: per-slot prefill, then lockstep
+    decode — the numbers the ragged step must reproduce."""
+    cache = llama.init_paged_cache(cfg, num_pages, page)
+    firsts = []
+    for s, p in enumerate(prompts):
+        S = ((len(p) + page - 1) // page) * page
+        toks = np.zeros(S, np.int32)
+        toks[:len(p)] = p
+        lg, cache = llama.prefill_slot_paged(
+            params, jnp.asarray(toks), jnp.asarray(len(p)),
+            jnp.asarray(bt[s, :S // page]), cfg, cache)
+        firsts.append(int(jnp.argmax(lg)))
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    cur = np.asarray(firsts, np.int32)
+    outs = [[c] for c in cur]
+    for _ in range(decode_steps):
+        lg, cache, lens = llama.decode_slots_paged(
+            params, jnp.asarray(cur), jnp.ones(len(prompts), bool),
+            jnp.asarray(bt), jnp.asarray(lens), cfg, cache)
+        cur = np.asarray(jnp.argmax(lg, -1)).astype(np.int32)
+        for s in range(len(prompts)):
+            outs[s].append(int(cur[s]))
+    return outs
+
+
+def _ragged_run(params, cfg, prompts, bt, num_pages, page, decode_steps):
+    """Same tokens through ragged steps: step 1 packs slot 0's whole
+    prompt next to slot 1's first chunk; step 2 MIXES slot 0's first
+    decode with slot 1's closing chunk; then both decode."""
+    cache = llama.init_paged_cache(cfg, num_pages, page)
+    T, R = 48, 4
+    outs = [[], []]
+
+    def step(rows):
+        nonlocal cache
+        (htoks, _dm, _ts, tpos, rslot, rstart, rlen, roff
+         ) = rpa.pack_ragged_batch(rows, T, R)
+        lg, cache2 = llama.ragged_step_paged(
+            params, jnp.asarray(htoks), jnp.asarray(tpos),
+            jnp.asarray(rslot), jnp.asarray(rstart), jnp.asarray(rlen),
+            jnp.asarray(roff), jnp.asarray(bt), cfg, cache,
+            max_row_tokens=32)
+        cache = cache2
+        return np.asarray(jnp.argmax(lg, -1))
+
+    p0, p1 = prompts
+    arg = step([dict(slot=0, start=0, tokens=list(p0)),
+                dict(slot=1, start=0, tokens=list(p1[:16]))])
+    outs[0].append(int(arg[0]))
+    arg = step([dict(slot=0, start=len(p0), tokens=[outs[0][-1]]),
+                dict(slot=1, start=16, tokens=list(p1[16:]))])
+    outs[0].append(int(arg[0]))
+    outs[1].append(int(arg[1]))
+    lens = np.asarray([len(p0) + 1, len(p1)])
+    for _ in range(decode_steps - 1):
+        arg = step([
+            dict(slot=0, start=int(lens[0]), tokens=[outs[0][-1]]),
+            dict(slot=1, start=int(lens[1]), tokens=[outs[1][-1]])])
+        lens += 1
+        outs[0].append(int(arg[0]))
+        outs[1].append(int(arg[1]))
+    return outs
+
+
+@pytest.mark.parametrize("kv_int8,fused", [
+    (False, False),
+    # The single-axis variants add ~30s of compile for paths the
+    # corners already cross — keep them for `-m slow` sweeps only.
+    pytest.param(True, False, marks=pytest.mark.slow),
+    pytest.param(False, True, marks=pytest.mark.slow),
+    (True, True)])
+def test_ragged_step_matches_pipeline(kv_int8, fused):
+    cfg = llama.LlamaConfig(
+        vocab_size=211, dim=128, n_layers=2, n_heads=2, n_kv_heads=1,
+        mlp_dim=256, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32, kv_int8=kv_int8, fused_decode=fused)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 211, 13), rng.integers(1, 211, 29)]
+    page, num_pages, maxp = 16, 16, 4
+    bt = np.full((2, maxp), num_pages, np.int32)   # OOB sentinel
+    bt[0, :2] = [0, 1]
+    bt[1, :3] = [2, 3, 4]
+    want = _pipeline_oracle(params, cfg, prompts, bt, num_pages, page,
+                            decode_steps=3)
+    got = _ragged_run(params, cfg, prompts, bt, num_pages, page,
+                      decode_steps=3)
+    # slot 1's first token arrives one ragged step later by packing
+    assert got[0] == want[0][:len(got[0])]
+    assert got[1] == want[1][:len(got[1])]
+
+
+def test_ragged_step_matches_pipeline_int8_weights():
+    """w8a16: both paths dequantize per layer inside their scans
+    (llama._deq_layer), so greedy tokens must agree exactly."""
+    from ray_tpu.models import quant
+
+    cfg = llama.LlamaConfig(
+        vocab_size=211, dim=128, n_layers=2, n_heads=2, n_kv_heads=1,
+        mlp_dim=256, max_seq_len=256, dtype=jnp.float32,
+        param_dtype=jnp.float32)
+    params = quant.init_quantized_llama(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, 211, 13), rng.integers(1, 211, 29)]
+    page, num_pages, maxp = 16, 16, 4
+    bt = np.full((2, maxp), num_pages, np.int32)
+    bt[0, :2] = [0, 1]
+    bt[1, :3] = [2, 3, 4]
+    want = _pipeline_oracle(params, cfg, prompts, bt, num_pages, page,
+                            decode_steps=2)
+    got = _ragged_run(params, cfg, prompts, bt, num_pages, page,
+                      decode_steps=2)
+    assert got[0] == want[0][:len(got[0])]
+    assert got[1] == want[1][:len(got[1])]

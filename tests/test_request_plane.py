@@ -230,6 +230,7 @@ def test_request_plane_e2e(params):
             "raytpu_serve_request_terminal_total",
             "raytpu_serve_goodput_ratio",
             "raytpu_serve_requests",
+            "raytpu_serve_step_tokens_total",
         ]) == []
 
         # -- timeline: request rows, slot threads, globally ts-sorted -

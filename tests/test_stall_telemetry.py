@@ -33,6 +33,7 @@ def _shim(paged=True):
     ns = types.SimpleNamespace()
     ns._step_walls = deque(maxlen=64)
     ns._step_wall_hw = 0.0
+    ns._stall_events = 0
     ns._tm = {"step_wall": _Gauge(), "queue_age": _Gauge()}
     ns._slot_req = {}
     ns._waiting = queue.Queue()

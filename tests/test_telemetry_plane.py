@@ -230,7 +230,8 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
     assert cm.check_exposition(
         text,
         require=["raytpu_serve_request_retries_total",
-                 "raytpu_serve_replica_drains_total"]) == []
+                 "raytpu_serve_replica_drains_total",
+                 "raytpu_serve_step_tokens_total"]) == []
     assert cm.check_registry() == []
 
 
