@@ -96,6 +96,16 @@ PROMPT_MIXES = {
     # bimodal: chat traffic with occasional huge pastes — the mix that
     # head-of-line-blocks a two-program (prefill|decode) engine
     "bursty": {"lens": (32, 64, 1536), "weights": (0.55, 0.3, 0.15)},
+    # Zipfian multi-tenant conversations: popular tenants share a
+    # page-aligned system-prompt + history prefix (Zipf(alpha) over
+    # tenants picks whose), suffixes are fresh per request, and a
+    # private_frac slice belongs to one-off tenants (always-cold
+    # baseline).  Serves with EngineConfig.prefix_cache — the mix the
+    # radix-tree prefix cache exists for; the record grows a "prefix"
+    # block (hit ratio, TTFT-by-hit-depth vs cold).
+    "zipf_chat": {"lens": (192, 256, 320), "weights": (0.3, 0.4, 0.3),
+                  "zipf": {"tenants": 8, "alpha": 1.1,
+                           "shared_frac": 0.6, "private_frac": 0.25}},
 }
 
 # bf16 peak per chip, for MFU reporting
@@ -201,16 +211,60 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
         lens = np.full(n_requests, prompt_len)
     max_seq = min(cfg.max_seq_len,
                   max(512, int(64 * np.ceil((lens.max() + gen + 1) / 64))))
+    zipf = (prompt_mix or {}).get("zipf")
     eng = LLMEngine(
         params, make_adapter(cfg),
         EngineConfig(max_slots=slots, max_seq_len=max_seq,
                      decode_chunk=8,
                      max_new_tokens_default=gen, page_size=64,
                      ragged_batching=ragged,
-                     prefill_chunk=prefill_chunk),
+                     prefill_chunk=prefill_chunk,
+                     prefix_cache=bool(zipf) and ragged),
     )
-    prompts = [rng.integers(0, cfg.vocab_size, int(n)).tolist()
-               for n in lens]
+    if zipf is not None:
+        # Zipfian multi-tenant prompts: rank-k tenant drawn with
+        # p(k) ∝ 1/k^alpha shares a fixed page-aligned prefix;
+        # private_frac of requests belong to one-off tenants (the
+        # honest cold-prefill baseline inside the same run).  Suffixes
+        # are always fresh, and make_prompts() is re-invoked per
+        # ladder rung so a rung never replays the previous rung's
+        # exact prompts as trivial full-prompt hits.
+        tenants = int(zipf.get("tenants", 8))
+        alpha = float(zipf.get("alpha", 1.1))
+        shared_frac = float(zipf.get("shared_frac", 0.6))
+        private_frac = float(zipf.get("private_frac", 0.25))
+        pz = np.arange(1, tenants + 1, dtype=np.float64) ** -alpha
+        pz /= pz.sum()
+        tenant_prefix = [
+            rng.integers(0, cfg.vocab_size,
+                         int(64 * max(1, round(
+                             int(max(prompt_mix["lens"])) * shared_frac
+                             / 64)))).tolist()
+            for _ in range(tenants)]
+
+        def make_prompts():
+            out = []
+            for n in lens:
+                n = int(n)
+                if rng.random() < private_frac:
+                    out.append(rng.integers(0, cfg.vocab_size,
+                                            n).tolist())
+                    continue
+                pre = tenant_prefix[int(rng.choice(tenants, p=pz))]
+                shared = min(len(pre) // 64 * 64, (n - 1) // 64 * 64)
+                out.append(pre[:shared]
+                           + rng.integers(0, cfg.vocab_size,
+                                          n - shared).tolist())
+            return out
+
+        prompts = make_prompts()
+    else:
+        make_prompts = None
+        prompts = [rng.integers(0, cfg.vocab_size, int(n)).tolist()
+                   for n in lens]
+    # TTFT-by-hit-depth accounting (zipf mixes): (hit_tokens,
+    # prompt_tokens, ttft_s) per open-loop request, across all rungs.
+    prefix_samples = []
     # Warm every compiled variant the run will hit off the clock:
     # prefill batch sizes k ∈ {1, 2, 4, 8} (open-loop trickle admits
     # small groups; burst admits full ones) and every ladder chunk.
@@ -228,6 +282,8 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
                             int(q * len(sorted_vals)))] * 1e3, 1)
 
     def open_loop_point(rate: float, n: int) -> dict:
+        if make_prompts is not None:
+            prompts[:] = make_prompts()  # fresh suffixes per rung
         t0 = time.perf_counter()
         streams = []
         for i in range(n):
@@ -240,6 +296,10 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
                                       temperature=0.0))
         outs = [s.result(timeout_s=600) for s in streams]
         dt = time.perf_counter() - t0
+        if zipf is not None:
+            prefix_samples.extend(
+                (s._req.prefix_hit, len(s._req.prompt), s._req.ttft_s)
+                for s in streams)
         ttfts = sorted(s._req.ttft_s for s in streams
                        if s._req.ttft_s is not None)
         assert all(len(o) == gen for o in outs)
@@ -333,6 +393,7 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
     for s in streams_b:
         s.result(timeout_s=600)
     burst_dt = time.perf_counter() - t0
+    eng_stats = eng.stats()
     eng.shutdown()
     # Headline open-loop numbers are AT THE KNEE (highest offered load
     # still completing ≥99%), so TTFT never conflates service with
@@ -375,6 +436,39 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
             "sampled_p50": int(np.percentile(lens, 50)),
             "sampled_p95": int(np.percentile(lens, 95)),
             "sampled_max": int(lens.max()),
+        }
+        if zipf is not None:
+            out["prompt_mix"]["zipf"] = dict(zipf)
+    if zipf is not None:
+        # Prefix-cache effectiveness over every open-loop request:
+        # hit ratio, and TTFT split cold (hit = 0) vs deep-hit
+        # (≥ 50% of the prompt served from cache) — the
+        # TTFT-by-hit-depth comparison the cache is judged on.
+        def _ms(vals, f):
+            vals = [v for v in vals if v is not None]
+            return None if not vals else round(float(f(vals)) * 1e3, 1)
+
+        cold = [t for h, _p, t in prefix_samples if h == 0]
+        deep = [t for h, p, t in prefix_samples
+                if p > 0 and h >= 0.5 * p]
+        tot_prompt = sum(p for _h, p, _t in prefix_samples)
+        eng_prefix = eng_stats.get("prefix", {})
+        out["prefix"] = {
+            "requests": len(prefix_samples),
+            "hit_ratio": (round(sum(1 for h, _p, _t in prefix_samples
+                                    if h > 0)
+                                / max(1, len(prefix_samples)), 3)),
+            "hit_token_ratio": round(
+                sum(h for h, _p, _t in prefix_samples)
+                / max(1, tot_prompt), 3),
+            "cold_requests": len(cold),
+            "hit50_requests": len(deep),
+            "ttft_mean_cold_ms": _ms(cold, np.mean),
+            "ttft_mean_hit50_ms": _ms(deep, np.mean),
+            "ttft_p50_cold_ms": _ms(cold, np.median),
+            "ttft_p50_hit50_ms": _ms(deep, np.median),
+            "cached_pages": int(eng_prefix.get("cached_pages", 0)),
+            "evicted_pages": int(eng_prefix.get("evicted_pages", 0)),
         }
     return out
 

@@ -853,6 +853,22 @@ def init_paged_cache(cfg: LlamaConfig, num_pages: int,
             "v": jnp.zeros(shape, cfg.dtype)}
 
 
+def copy_page_paged(cache: Dict[str, jax.Array], src: jax.Array,
+                    dst: jax.Array) -> Dict[str, jax.Array]:
+    """Duplicate ONE physical page src → dst across every layer: k/v
+    (page axis 2) and, for int8 pools, the per-page scales (page axis
+    1).  The prefix cache's copy-on-write split — the only KV write
+    that may target a shared page (the last-token re-run of an exact
+    full-prompt hit) goes to the copy, never the cached original."""
+    out = dict(cache)
+    for key in ("k", "v"):
+        out[key] = cache[key].at[:, :, dst].set(cache[key][:, :, src])
+    for key in ("k_scale", "v_scale"):
+        if key in cache:
+            out[key] = cache[key].at[:, dst].set(cache[key][:, src])
+    return out
+
+
 def prefill_slot_paged(
     params: Params,
     tokens: jax.Array,

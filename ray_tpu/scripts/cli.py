@@ -82,7 +82,7 @@ _LIST_ROUTES = {
                          ["placement_group_id", "strategy", "state"]),
     "requests": ("/api/v0/requests",
                  ["request_id", "engine", "state", "prompt_tokens",
-                  "generated_tokens", "slot", "attempt",
+                  "generated_tokens", "slot", "attempt", "prefix_hit",
                   "terminal_cause"]),
 }
 

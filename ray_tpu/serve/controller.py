@@ -82,6 +82,11 @@ class _Replica:
         # settle (ongoing_ref polls the replica) up to drain_deadline.
         self.drain_deadline = None
         self.ongoing_ref = None
+        # Latest prefix-cache routing summary the replica pushed
+        # ({"page": …, "hashes": […]}), re-broadcast on the route
+        # table so routers can prefer the replica holding the longest
+        # cached prefix.  None = no cache / nothing cached yet.
+        self.prefix_summary = None
 
 
 class _DeploymentState:
@@ -243,6 +248,22 @@ class ServeController:
             st = self._deployments.get((app_name, deployment_name))
             if st is not None:
                 st.record_metric(replica_id, ongoing, ts)
+
+    def record_prefix_summary(self, app_name: str, deployment_name: str,
+                              replica_id: str, summary) -> None:
+        """Replica push: its engine's prefix-cache routing summary
+        changed.  Stored on the replica record and re-broadcast so
+        every router's table row carries the fresh summary (the same
+        long-poll channel that delivers membership changes)."""
+        with self._lock:
+            st = self._deployments.get((app_name, deployment_name))
+            if st is None:
+                return
+            r = st.replicas.get(replica_id)
+            if r is None or r.prefix_summary == summary:
+                return
+            r.prefix_summary = summary
+            self._broadcast(st)
 
     def drain_replica(self, app_name: str, deployment_name: str,
                       replica_id: str,
@@ -522,7 +543,7 @@ class ServeController:
                 r._announced = True
                 table.append(
                     (r.replica_id, r.handle, st.config.max_ongoing_requests,
-                     is_async)
+                     is_async, r.prefix_summary)
                 )
         self._host.notify_changed(
             replica_set_key(st.app_name, st.info.name), table

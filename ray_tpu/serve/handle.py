@@ -206,7 +206,15 @@ class DeploymentResponseGenerator:
         """Args for a resumed attempt.  Returns (args, skip): LLM dict
         payloads get prompt+prefix spliced in (skip 0); anything else
         replays verbatim and skips the delivered prefix.  args=None
-        means the continuation has nothing left to generate."""
+        means the continuation has nothing left to generate.
+
+        Prefix-resumed failover: the spliced payload re-enters the
+        router's cache-aware selection (assign_streaming matches its
+        ``tokens`` against replica prefix summaries), so with
+        EngineConfig.prefix_cache the retry lands on a survivor
+        holding the shared prefix and re-prefills only the cold tail —
+        the replay's full re-prefill collapses to the uncached suffix
+        plus the delivered tokens."""
         if not self._delivered:
             return self._args, 0
         first = self._args[0] if self._args else None

@@ -130,6 +130,45 @@ def _telemetry():
                 "regressions without any per-request change.",
                 tag_keys=("phase",),
             ),
+            "kv_pages_free": metrics.Gauge(
+                "raytpu_serve_kv_pages_free",
+                "Free pages in the paged KV pool (neither slot-mapped "
+                "nor held by the prefix cache).",
+            ),
+            "kv_pages_cached": metrics.Gauge(
+                "raytpu_serve_kv_pages_cached",
+                "Pages owned by the prefix cache (0 when the cache is "
+                "disabled).  free + cached + slot-owned = pool.",
+            ),
+            "prefix_requests": metrics.Counter(
+                "raytpu_serve_prefix_requests_total",
+                "Admitted requests by prefix-cache outcome (hit = at "
+                "least one full page reused).",
+                tag_keys=("outcome",),
+            ),
+            "prefix_hit_ratio": metrics.Gauge(
+                "raytpu_serve_prefix_hit_ratio",
+                "Cumulative prompt tokens served from the prefix cache "
+                "over all prompt tokens admitted (token-weighted hit "
+                "ratio).",
+            ),
+            "prefix_hit_depth": metrics.Histogram(
+                "raytpu_serve_prefix_hit_depth_tokens",
+                "Per-request prefix-cache hit depth in tokens (0 = "
+                "cold prefill) — joins with TTFT for "
+                "TTFT-by-hit-depth.",
+                boundaries=[1, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                            4096],
+            ),
+            "prefix_cached_pages": metrics.Gauge(
+                "raytpu_serve_prefix_cached_pages",
+                "Pages currently held by the radix-tree prefix index.",
+            ),
+            "prefix_evicted": metrics.Counter(
+                "raytpu_serve_prefix_evicted_pages_total",
+                "Cache pages evicted (refcount-0 LRU) under admission "
+                "pressure.",
+            ),
         }
     else:
         reg = metrics.registry()
@@ -185,6 +224,17 @@ class EngineConfig:
     # page_size).
     ragged_batching: bool = False
     token_budget: int = 0
+    # Radix-tree prefix cache over the page pool
+    # (serve/prefix_index.py): finished requests donate their full KV
+    # pages to a refcounted trie; admission matches the longest cached
+    # prefix and schedules the ragged prefill from the hit depth
+    # instead of token 0.  Requires ragged_batching (prefill-from-
+    # offset rides the per-row `start` descriptor of the unified
+    # step).  Shared pages are copy-on-write: the only write that can
+    # land in one — the last-token re-run of an exact full-prompt hit
+    # — splits the page first.  Eviction is refcount-0 LRU, driven by
+    # admission pressure so cached pages never starve new requests.
+    prefix_cache: bool = False
 
     def buckets(self) -> List[int]:
         out, b = [], self.min_prefill_bucket
@@ -259,6 +309,11 @@ class PagedEngineAdapter:
     # mixed batch of decode rows (len 1) and prefill chunks — enables
     # EngineConfig.ragged_batching.
     ragged_step: Optional[Callable[..., Tuple[jax.Array, Any]]] = None
+    # COW split for the prefix cache: copy_page(cache, src, dst) ->
+    # cache duplicates ONE physical page (all layers, k+v and any
+    # per-page quantization scales) so a writer can diverge from a
+    # shared page — enables EngineConfig.prefix_cache.
+    copy_page: Optional[Callable[..., Any]] = None
     # Tensor-parallel serving (LLMEngine(mesh=...)): shard_params
     # places params on the mesh (pass HOST arrays for big models — the
     # transfer shards directly, never materializing an unsharded copy
@@ -296,6 +351,7 @@ def llama_paged_adapter(cfg) -> PagedEngineAdapter:
             llama.ragged_step_paged(params, tokens, tok_pos, row_slot,
                                     row_start, row_len, row_off, bt, cfg,
                                     cache),
+        copy_page=llama.copy_page_paged,
         shard_params=lambda params, mesh:
             llama.shard_params_for_serving(params, cfg, mesh),
         cache_shardings=lambda mesh: llama.paged_cache_shardings(
@@ -335,6 +391,10 @@ class Request:
     request_id: str = ""
     last_token_at: Optional[float] = None
     max_itl_s: float = 0.0
+    # Prompt tokens served from the prefix cache (0 = cold prefill);
+    # stamped at admission, mirrored to the request ring so
+    # TTFT-by-hit-depth is observable downstream.
+    prefix_hit: int = 0
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -463,6 +523,12 @@ class LLMServer:
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
 
+    def prefix_summary(self) -> Optional[Dict[str, Any]]:
+        """Prefix-cache routing summary (None when the cache is off).
+        The hosting ReplicaActor polls this and pushes changes to the
+        controller for cache-aware routing."""
+        return self.engine.prefix_summary()
+
     def check_health(self) -> None:
         if self.engine._stopped.is_set():
             raise RuntimeError("engine stopped")
@@ -530,7 +596,29 @@ class LLMEngine:
                                self._num_pages, np.int32)
             self._lens = np.zeros((config.max_slots,), np.int32)
             self._backlog: List[Request] = []  # admitted-but-no-pages
+            # Radix-tree prefix cache (EngineConfig.prefix_cache):
+            # finished requests donate full pages to the trie; slots
+            # borrow them at admission (_slot_borrowed tracks which
+            # block-table entries are cache-owned so release never
+            # returns them to the free list).
+            self._prefix = None
+            self._slot_borrowed: Dict[int, List[int]] = {}
+            if config.prefix_cache:
+                if not config.ragged_batching:
+                    raise ValueError(
+                        "prefix_cache requires ragged_batching=True "
+                        "(prefill-from-offset rides the ragged step's "
+                        "per-row start descriptor)")
+                from ray_tpu.serve.prefix_index import PrefixIndex
+                self._prefix = PrefixIndex(page)
+            self._prefix_hit_tokens = 0
+            self._prefix_prompt_tokens = 0
         else:
+            if config.prefix_cache:
+                raise ValueError(
+                    "prefix_cache requires the paged adapter "
+                    "(PagedEngineAdapter) — the cache indexes KV pages")
+            self._prefix = None
             self._cache = adapter.init_cache(config.max_slots,
                                              config.max_seq_len)
         self._waiting: "queue.Queue[Request]" = queue.Queue()
@@ -569,6 +657,7 @@ class LLMEngine:
         self._steps = 0
         self._tokens_out = 0
         self._tm = _telemetry()
+        self._update_page_gauges()
         # Request-lifecycle ring (util/state.list_requests, dashboard
         # /api/v0/requests, timeline request rows all read it).  The
         # engine holds the only strong ref; the module registry is weak.
@@ -715,6 +804,18 @@ class LLMEngine:
                 return cache, sampled, cur
 
             self._ragged_step_fn = ragged_step_fn
+
+            if self._prefix is not None:
+                if adapter.copy_page is None:
+                    raise ValueError(
+                        "prefix_cache requires an adapter with "
+                        "copy_page (the COW split of a shared page)")
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def copy_page_fn(cache, src, dst):
+                    return adapter.copy_page(cache, src, dst)
+
+                self._copy_page_fn = copy_page_fn
         else:
             self._ragged_step_fn = None
             self._token_budget = 0
@@ -888,7 +989,7 @@ class LLMEngine:
         return self._engine_id
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "engine": self._engine_id,
             "active_slots": self.config.max_slots - len(self._free_slots),
             "prefilling": len(getattr(self, "_prefilling", ())),
@@ -898,6 +999,26 @@ class LLMEngine:
             "stall_events": self._stall_events,
             "requests": self._ring.counts_by_state(),
         }
+        if self._paged:
+            out["kv_pages_free"] = len(self._free_pages)
+            out["kv_pages_cached"] = (self._prefix.cached_pages
+                                      if self._prefix else 0)
+        if self._prefix is not None:
+            pstats = self._prefix.stats()
+            pstats["hit_tokens"] = self._prefix_hit_tokens
+            pstats["prompt_tokens"] = self._prefix_prompt_tokens
+            out["prefix"] = pstats
+        return out
+
+    def prefix_summary(self, max_entries: int = 256) -> Optional[dict]:
+        """Compact routing summary of the prefix cache ({"page": …,
+        "hashes": [chained CRC32 path hashes]}), or None when the
+        cache is off.  Replicas push it to the controller, which
+        re-broadcasts it on the route table so routers can prefer the
+        replica holding the longest cached prefix."""
+        if self._prefix is None:
+            return None
+        return self._prefix.summary(max_entries)
 
     def shutdown(self):
         self._stopped.set()
@@ -1065,7 +1186,17 @@ class LLMEngine:
         pool can't cover it."""
         if need is None:
             need = self._pages_needed(req)
-        if not self._free_slots or len(self._free_pages) < need:
+        if not self._free_slots:
+            return None
+        if len(self._free_pages) < need and self._prefix is not None:
+            # Admission pressure evicts refcount-0 LRU cache pages
+            # BEFORE the request queues: the cache borrows idle pool
+            # capacity, it never competes with admission for it.
+            freed = self._prefix.evict(need - len(self._free_pages))
+            if freed:
+                self._free_pages.extend(freed)
+                self._tm["prefix_evicted"].inc(len(freed))
+        if len(self._free_pages) < need:
             return None
         slot = self._free_slots.pop()
         pages = [self._free_pages.pop() for _ in range(need)]
@@ -1073,7 +1204,73 @@ class LLMEngine:
         row = np.full((self._maxp,), self._num_pages, np.int32)
         row[: len(pages)] = pages
         self._bt[slot] = row
+        self._update_page_gauges()
         return slot
+
+    def _admit_slot_for(self, req: Request) -> Optional[Tuple[int, int]]:
+        """Claim a slot + pages, borrowing the longest cached prefix
+        when the prefix cache is on.  Returns (slot, start) — the
+        ragged prefill resumes at ``start`` instead of 0 — or None
+        under slot/page pressure (every borrowed ref released).
+
+        Only FULL pages are cached and prefill resumes at the hit
+        boundary, so shared pages are never written — except an exact
+        full-prompt hit, where the mandatory last-token re-run (the
+        sample needs its logits) lands inside the deepest shared page.
+        That page is COW-split into a fresh page before scheduling."""
+        if self._prefix is None:
+            slot = self._alloc_slot_pages(req)
+            return None if slot is None else (slot, 0)
+        page = self.config.page_size
+        hit_pages = self._prefix.acquire(req.prompt)
+        d = len(hit_pages)
+        start = hit = d * page
+        cow = d > 0 and hit >= len(req.prompt)
+        if cow:
+            start = len(req.prompt) - 1
+        need_total = self._pages_needed(req)
+        slot = self._alloc_slot_pages(
+            req, need=need_total - d + (1 if cow else 0))
+        if slot is None:
+            self._prefix.release(hit_pages)
+            self._update_page_gauges()
+            return None
+        fresh = self._slot_pages[slot]
+        if cow:
+            src, dst = hit_pages[-1], fresh[0]
+            self._cache = self._copy_page_fn(
+                self._cache, np.int32(src), np.int32(dst))
+            self._prefix.release([src])
+            borrowed = hit_pages[:-1]
+            pages = borrowed + [dst] + fresh[1:]
+        else:
+            borrowed = hit_pages
+            pages = borrowed + fresh
+        self._slot_pages[slot] = pages
+        self._slot_borrowed[slot] = borrowed
+        row = np.full((self._maxp,), self._num_pages, np.int32)
+        row[: len(pages)] = pages
+        self._bt[slot] = row
+        req.prefix_hit = start
+        self._prefix_hit_tokens += start
+        self._prefix_prompt_tokens += len(req.prompt)
+        self._tm["prefix_requests"].inc(
+            tags={"outcome": "hit" if start else "miss"})
+        self._tm["prefix_hit_depth"].observe(start)
+        if self._prefix_prompt_tokens:
+            self._tm["prefix_hit_ratio"].set(
+                self._prefix_hit_tokens / self._prefix_prompt_tokens)
+        self._update_page_gauges()
+        return slot, start
+
+    def _update_page_gauges(self) -> None:
+        if not self._paged:
+            return
+        self._tm["kv_pages_free"].set(len(self._free_pages))
+        cached = self._prefix.cached_pages if self._prefix else 0
+        self._tm["kv_pages_cached"].set(cached)
+        if self._prefix is not None:
+            self._tm["prefix_cached_pages"].set(cached)
 
     def _pages_needed(self, req: Request) -> int:
         """Pages covering max(prefill bucket, prompt+max_new)."""
@@ -1196,16 +1393,18 @@ class LLMEngine:
                     req = self._waiting.get_nowait()
                 except queue.Empty:
                     return
-            slot = self._alloc_slot_pages(req)
-            if slot is None:
+            got = self._admit_slot_for(req)
+            if got is None:
                 self._backlog.insert(0, req)
                 return
+            slot, start = got
             req.admitted_at = time.monotonic()
             self._ring.record(
                 req.request_id, _reqev.PREFILLING, slot=slot,
-                num_pages=len(self._slot_pages.get(slot, [])))
+                num_pages=len(self._slot_pages.get(slot, [])),
+                prefix_hit=req.prefix_hit)
             self._prefilling.append({"req": req, "slot": slot,
-                                     "pos": 0})
+                                     "pos": start})
             self._state_dirty = True  # bt rows changed
 
     def _dispatch_ragged_step(self) -> bool:
@@ -1332,22 +1531,49 @@ class LLMEngine:
                      else "max_new_tokens"
                      if len(req.tokens) >= req.max_new_tokens
                      else "max_seq_len")
-            self._release_slot(slot)
+            # KV is written for prompt + generated minus the last
+            # sampled token (it was never fed back) — exactly the
+            # prefix a future request can resume from.
+            seq = req.prompt + req.tokens
+            self._release_slot(slot, cache_tokens=seq[:len(seq) - 1])
             req.finished_at = now
             self._observe_request(req, state=_reqev.FINISHED, cause=cause)
             req.stream.put(_DONE)
 
-    def _release_slot(self, slot: int) -> None:
+    def _release_slot(self, slot: int, *,
+                      cache_tokens: Optional[List[int]] = None) -> None:
         """Return a slot (and, paged, its pages) to the free pool —
         shared by the finish, cancel, and failure paths so terminal
-        accounting can never leak capacity."""
+        accounting can never leak capacity.
+
+        With the prefix cache on: borrowed pages go back to the index
+        (refcount -1, never the free list), and — on the FINISH path
+        only (``cache_tokens`` = the KV-written token sequence) — the
+        slot's full pages are offered to the trie; pages the trie
+        adopts stay cached, the rest are freed.  Cancel/preempt/crash
+        paths pass no cache_tokens: their tail pages may be partially
+        written, so nothing is donated."""
         self._slot_req.pop(slot, None)
         self._free_slots.append(slot)
         self._state_dirty = True
         if self._paged:
-            self._free_pages.extend(self._slot_pages.pop(slot, []))
+            pages = self._slot_pages.pop(slot, [])
+            if self._prefix is not None:
+                borrowed = self._slot_borrowed.pop(slot, [])
+                self._prefix.release(borrowed)
+                adopted: set = set()
+                if cache_tokens is not None and not self._draining.is_set():
+                    full = len(cache_tokens) // self.config.page_size
+                    adopted = self._prefix.insert(cache_tokens,
+                                                  pages[:full])
+                owned = pages[len(borrowed):]
+                self._free_pages.extend(p for p in owned
+                                        if p not in adopted)
+            else:
+                self._free_pages.extend(pages)
             self._bt[slot] = self._num_pages
             self._lens[slot] = 0
+            self._update_page_gauges()
 
     def _slo_met(self, req: Request) -> bool:
         """Did a FINISHED request meet every configured bound?  (No slo

@@ -95,9 +95,23 @@ class ReplicaActor:
         self._draining = False
         self._install_sigterm_drain()
         self._metrics_stop = threading.Event()
-        if metrics_interval_s > 0:
+        # Prefix-cache routing: a callable exposing prefix_summary()
+        # (LLMServer over a prefix-cached engine) gets the push loop
+        # even without an autoscaling metrics interval — the summary
+        # rides the same thread, pushed only on change.
+        self._last_prefix_summary = None
+        _summary_fn = getattr(self._callable, "prefix_summary", None)
+        try:
+            # None at probe time = the cache is off for good (the flag
+            # is construction-time config), so stay off the push path.
+            self._pushes_summary = (callable(_summary_fn)
+                                    and _summary_fn() is not None)
+        except Exception:
+            self._pushes_summary = False
+        if metrics_interval_s > 0 or self._pushes_summary:
             threading.Thread(
-                target=self._push_metrics_loop, args=(metrics_interval_s,),
+                target=self._push_metrics_loop,
+                args=(metrics_interval_s or 0.25,),
                 daemon=True, name=f"metrics-{replica_id}",
             ).start()
 
@@ -396,5 +410,17 @@ class ReplicaActor:
                     self.app_name, self.deployment_name, self.replica_id,
                     self.num_ongoing_requests(), time.monotonic(),
                 )
+                if self._pushes_summary:
+                    try:
+                        summary = self._callable.prefix_summary()
+                    except Exception:
+                        summary = None
+                    if (summary is not None
+                            and summary != self._last_prefix_summary):
+                        self._last_prefix_summary = summary
+                        controller.record_prefix_summary.remote(
+                            self.app_name, self.deployment_name,
+                            self.replica_id, summary,
+                        )
             except Exception:
                 return  # controller gone — cluster is shutting down

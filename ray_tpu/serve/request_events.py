@@ -84,6 +84,10 @@ class RequestRecord:
     # ``raytpu list requests --detail``.
     attempt: int = 0
     attempts: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # Prompt tokens served from the engine's prefix cache at admission
+    # (0 = cold prefill, or the cache is off) — joins with ttft_s for
+    # TTFT-by-hit-depth.
+    prefix_hit: int = 0
 
     @property
     def state(self) -> str:
@@ -148,7 +152,8 @@ class RequestEventBuffer:
                num_pages: Optional[int] = None,
                terminal_cause: Optional[str] = None,
                attempt: Optional[int] = None,
-               attempt_info: Optional[Dict[str, Any]] = None) -> None:
+               attempt_info: Optional[Dict[str, Any]] = None,
+               prefix_hit: Optional[int] = None) -> None:
         now = time.time()
         with self._lock:
             rec = self._records.get(request_id)
@@ -181,6 +186,8 @@ class RequestEventBuffer:
                 rec.num_pages = num_pages
             if terminal_cause is not None:
                 rec.terminal_cause = terminal_cause
+            if prefix_hit is not None:
+                rec.prefix_hit = prefix_hit
 
     def update(self, request_id: str, *,
                generated_tokens: Optional[int] = None) -> None:

@@ -47,6 +47,14 @@ def _telemetry():
                 "replica after a death or preemption, by deployment.",
                 tag_keys=("deployment",),
             ),
+            "prefix_routed": metrics.Counter(
+                "raytpu_serve_router_prefix_routed_total",
+                "Assignments where cache-aware routing picked the "
+                "replica claiming the longest cached prefix of the "
+                "prompt (vs falling back to least-loaded), by "
+                "deployment.",
+                tag_keys=("deployment",),
+            ),
         }
     else:
         reg = metrics.registry()
@@ -57,12 +65,27 @@ def _telemetry():
 
 class _ReplicaInfo:
     def __init__(self, replica_id: str, handle, max_ongoing: int,
-                 is_async: bool = False):
+                 is_async: bool = False, prefix_summary=None):
         self.replica_id = replica_id
         self.handle = handle
         self.max_ongoing = max_ongoing
         self.is_async = is_async
         self.inflight = 0
+        # Prefix-cache routing summary the replica last published
+        # through the controller broadcast ({"page", "hashes"}), or
+        # None.  A routing HINT only — the engine re-matches exactly.
+        self.prefix_summary = prefix_summary
+
+
+def _payload_tokens(args: tuple) -> Optional[List[int]]:
+    """Prompt tokens of an LLM data-plane payload ({"tokens": [...]})
+    — what cache-aware routing matches against replica summaries.
+    None for non-LLM deployments (any other payload shape)."""
+    if args and isinstance(args[0], dict):
+        toks = args[0].get("tokens")
+        if isinstance(toks, (list, tuple)) and toks:
+            return list(toks)
+    return None
 
 
 class Router:
@@ -112,20 +135,23 @@ class Router:
 
     def _update_replicas(self, table: List[Tuple[str, Any, int]]) -> None:
         """table: [(replica_id, actor_handle, max_ongoing_requests,
-        is_async)]"""
+        is_async, prefix_summary)]"""
         with self._cv:
             fresh: Dict[str, _ReplicaInfo] = {}
             for row in table:
                 replica_id, handle, max_ongoing = row[:3]
                 is_async = bool(row[3]) if len(row) > 3 else False
+                summary = row[4] if len(row) > 4 else None
                 old = self._replicas.get(replica_id)
                 if old is not None:
                     old.max_ongoing = max_ongoing
                     old.is_async = is_async
+                    old.prefix_summary = summary
                     fresh[replica_id] = old
                 else:
                     fresh[replica_id] = _ReplicaInfo(
-                        replica_id, handle, max_ongoing, is_async
+                        replica_id, handle, max_ongoing, is_async,
+                        summary
                     )
             self._replicas = fresh
             # Drop affinity entries pointing at replicas that left the
@@ -167,7 +193,8 @@ class Router:
                             "request_id": request_id}):
             with tracing.span("serve.queue_wait"):
                 chosen = self._select_replica(deadline, timeout, exclude,
-                                              model_id)
+                                              model_id,
+                                              tokens=_payload_tokens(args))
             metadata = {"request_id": request_id}
             if model_id:
                 metadata["multiplexed_model_id"] = model_id
@@ -204,7 +231,8 @@ class Router:
                             "request_id": request_id}):
             with tracing.span("serve.queue_wait"):
                 chosen = self._select_replica(deadline, timeout, exclude,
-                                              model_id)
+                                              model_id,
+                                              tokens=_payload_tokens(args))
             metadata = {"request_id": request_id}
             if model_id:
                 metadata["multiplexed_model_id"] = model_id
@@ -253,7 +281,10 @@ class Router:
                           generated_tokens=generated_tokens,
                           terminal_cause=cause)
 
-    def _select_replica(self, deadline, timeout, exclude, model_id):
+    def _select_replica(self, deadline, timeout, exclude, model_id,
+                        tokens=None):
+        from ray_tpu.serve.prefix_index import match_depth
+
         with self._cv:
             while True:
                 candidates = [
@@ -273,6 +304,24 @@ class Router:
                             # Refresh recency so bounded eviction drops
                             # cold models, not hot ones.
                             self._model_affinity.pop(model_id, None)
+                    if chosen is None and tokens is not None:
+                        # Cache-aware arm: prefer the replica claiming
+                        # the longest cached prefix of this prompt
+                        # (hit depth in tokens; ties break on load).
+                        # Considers ALL candidates, not a p2c sample —
+                        # the summary match is local and cheap, and a
+                        # sampled pair would miss the holder half the
+                        # time at 4+ replicas.
+                        best_depth = 0
+                        for r in candidates:
+                            depth = match_depth(tokens, r.prefix_summary)
+                            if depth > best_depth or (
+                                    depth == best_depth and depth > 0
+                                    and r.inflight < chosen.inflight):
+                                chosen, best_depth = r, depth
+                        if chosen is not None:
+                            self._tm["prefix_routed"].inc(
+                                tags={"deployment": self.deployment_name})
                     if chosen is None:
                         if len(candidates) > 2:
                             candidates = random.sample(candidates, 2)

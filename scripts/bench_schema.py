@@ -35,7 +35,11 @@ Checked:
         weights / sampled_p50 / sampled_p95 / sampled_max) without
         which a per-mix knee TTFT is uninterpretable;
       - prompt_mix weights are non-negative and sum to 1 over lens of
-        the same length.
+        the same length;
+  * prefix-cache blocks (a serving block's ``prefix``, reported by the
+    zipf_chat mix): hit ratios in [0, 1], cold/hit50 request counts,
+    and TTFT-by-hit-depth fields that are numeric or honestly null
+    (null only when that depth class saw no requests).
 
 Usage:
     python scripts/bench_schema.py BENCH_OUT.json
@@ -94,6 +98,46 @@ def _check_prompt_mix(name: str, pm: Any, problems: List[str]) -> None:
                 f"{pm.get(k)!r}")
 
 
+PREFIX_REQUIRED = ("requests", "hit_ratio", "hit_token_ratio",
+                   "cold_requests", "hit50_requests", "cached_pages",
+                   "evicted_pages")
+PREFIX_TTFT_KEYS = ("ttft_mean_cold_ms", "ttft_mean_hit50_ms",
+                    "ttft_p50_cold_ms", "ttft_p50_hit50_ms")
+
+
+def _check_prefix(name: str, px: Any, problems: List[str]) -> None:
+    """The prefix-cache block a zipf mix reports: hit ratios in [0, 1],
+    TTFT-by-hit-depth numbers present (null only when that depth class
+    had no requests — absent-not-zero, so a run with no cold requests
+    can't fake an infinite speedup)."""
+    if not isinstance(px, dict):
+        problems.append(f"{name}: prefix is not an object")
+        return
+    for k in PREFIX_REQUIRED:
+        if not _num(px.get(k)):
+            problems.append(f"{name}: prefix.{k} missing or "
+                            f"non-numeric: {px.get(k)!r}")
+    for k in ("hit_ratio", "hit_token_ratio"):
+        v = px.get(k)
+        if _num(v) and not (0.0 <= v <= 1.0):
+            problems.append(f"{name}: prefix.{k}={v!r} outside [0, 1]")
+    for k in PREFIX_TTFT_KEYS:
+        v = px.get(k)
+        if v is not None and not _num(v):
+            problems.append(f"{name}: prefix.{k}={v!r} is neither a "
+                            f"number nor null")
+    if (_num(px.get("cold_requests")) and px["cold_requests"] > 0
+            and px.get("ttft_mean_cold_ms") is None):
+        problems.append(f"{name}: prefix has cold_requests="
+                        f"{px['cold_requests']} but null "
+                        f"ttft_mean_cold_ms")
+    if (_num(px.get("hit50_requests")) and px["hit50_requests"] > 0
+            and px.get("ttft_mean_hit50_ms") is None):
+        problems.append(f"{name}: prefix has hit50_requests="
+                        f"{px['hit50_requests']} but null "
+                        f"ttft_mean_hit50_ms")
+
+
 def _check_serving(name: str, d: Any, problems: List[str]) -> None:
     if not isinstance(d, dict):
         problems.append(f"{name}: not an object")
@@ -146,6 +190,8 @@ def _check_serving(name: str, d: Any, problems: List[str]) -> None:
                             f"non-numeric: {rung.get(k)!r}")
     if "prompt_mix" in d:
         _check_prompt_mix(name, d["prompt_mix"], problems)
+    if "prefix" in d:
+        _check_prefix(name, d["prefix"], problems)
 
 
 def _check_mixed(name: str, d: Any, problems: List[str]) -> None:

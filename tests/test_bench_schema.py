@@ -171,6 +171,56 @@ def test_mixed_block_requires_batching_and_mixes(schema):
     assert any("non-empty object" in p for p in probs)
 
 
+def _prefix_block():
+    return {"requests": 96, "hit_ratio": 0.61, "hit_token_ratio": 0.45,
+            "cold_requests": 30, "hit50_requests": 40,
+            "ttft_mean_cold_ms": 82.0, "ttft_mean_hit50_ms": 31.0,
+            "ttft_p50_cold_ms": 78.0, "ttft_p50_hit50_ms": 29.0,
+            "cached_pages": 120, "evicted_pages": 14}
+
+
+def test_prefix_block_valid(schema):
+    rec = _mixed_record()
+    mixes = rec["extra"]["serving_mixed"]["mixes"]
+    mixes["zipf_chat"] = _mix_block()
+    mixes["zipf_chat"]["prefix"] = _prefix_block()
+    assert schema.validate_record(rec) == []
+
+
+def test_prefix_block_ratio_bounds_and_required_keys(schema):
+    rec = _mixed_record()
+    mixes = rec["extra"]["serving_mixed"]["mixes"]
+    mixes["zipf_chat"] = _mix_block()
+    px = _prefix_block()
+    px["hit_ratio"] = 1.4
+    del px["cached_pages"]
+    mixes["zipf_chat"]["prefix"] = px
+    probs = schema.validate_record(rec)
+    assert any("hit_ratio=1.4" in p and "outside [0, 1]" in p
+               for p in probs)
+    assert any("prefix.cached_pages" in p for p in probs)
+
+
+def test_prefix_block_ttft_null_only_when_class_empty(schema):
+    """A cold TTFT may be null ONLY when there were no cold requests —
+    otherwise a run could fake an unbeatable cache by dropping its
+    baseline."""
+    rec = _mixed_record()
+    mixes = rec["extra"]["serving_mixed"]["mixes"]
+    mixes["zipf_chat"] = _mix_block()
+    px = _prefix_block()
+    px["ttft_mean_cold_ms"] = None  # but cold_requests = 30
+    mixes["zipf_chat"]["prefix"] = px
+    probs = schema.validate_record(rec)
+    assert any("null" in p and "ttft_mean_cold_ms" in p for p in probs)
+    px["cold_requests"] = 0  # empty class: null is now honest
+    assert schema.validate_record(rec) == []
+    px["ttft_mean_hit50_ms"] = "fast"
+    probs = schema.validate_record(rec)
+    assert any("ttft_mean_hit50_ms" in p and "neither" in p
+               for p in probs)
+
+
 def test_mixed_error_leg_is_valid(schema):
     rec = _record()
     rec["extra"]["serving_1b_mixed"] = {"error": "RESOURCE_EXHAUSTED"}

@@ -231,6 +231,8 @@ def test_request_plane_e2e(params):
             "raytpu_serve_goodput_ratio",
             "raytpu_serve_requests",
             "raytpu_serve_step_tokens_total",
+            "raytpu_serve_kv_pages_free",
+            "raytpu_serve_kv_pages_cached",
         ]) == []
 
         # -- timeline: request rows, slot threads, globally ts-sorted -
