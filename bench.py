@@ -584,6 +584,85 @@ def _measure_8b(peak_flops: float) -> dict:
     return out
 
 
+def _measure_serving_multihost(cfg, *, shard_counts=(1, 2, 4),
+                               n_requests: int = 16, gen: int = 16,
+                               prompt_len: int = 32,
+                               params=None) -> dict:
+    """Multi-host tensor-parallel serving ladder: one engine per rung,
+    weights sharded over a ``dcn_tp x tp`` serving mesh (shard count =
+    hosts in the shard group; on CPU, contiguous virtual-device groups
+    stand in for the host boundary).  Every multi-shard rung runs the
+    DCN ablation — exact bf16-fallback collectives vs the int8
+    quantized allreduce (EQuARX-style per-chunk scales) — recording
+    greedy burst throughput plus the same per-decode-step
+    bytes-on-wire accounting the serve telemetry counters use, so the
+    record shows the >= 3x DCN reduction directly."""
+    from ray_tpu.parallel.collectives import allreduce_wire_bytes
+    from ray_tpu.parallel.mesh import create_serving_mesh
+    from ray_tpu.serve.llm_engine import (
+        EngineConfig,
+        LLMEngine,
+        llama_paged_adapter,
+    )
+
+    devs = jax.devices()
+    if params is None:
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+    chunk = 32  # divides dim -> no pad waste in the quantized wire
+    ladder = []
+    for shards in shard_counts:
+        # KV pools shard along heads over the COMBINED (dcn_tp, tp)
+        # axis, so the whole group size must divide n_kv_heads.
+        tp = max(1, min(len(devs) // shards, cfg.n_kv_heads // shards))
+        if shards * tp > len(devs) or cfg.n_kv_heads % (shards * tp):
+            continue
+        for mode in (("bf16",) if shards == 1 else ("bf16", "int8")):
+            cfg2 = dataclasses.replace(
+                cfg, tensor_parallel=True,
+                dcn_quantized_allreduce=(mode == "int8"),
+                dcn_allreduce_chunk=chunk)
+            eng = LLMEngine(
+                params, llama_paged_adapter(cfg2),
+                EngineConfig(max_slots=n_requests,
+                             max_seq_len=max(128, prompt_len + gen + 16),
+                             decode_chunk=8, page_size=16,
+                             max_new_tokens_default=gen),
+                mesh=create_serving_mesh(shards, tp),
+            )
+            try:
+                # Warm the compiled variants off the clock.
+                eng.submit(prompts[0],
+                           max_new_tokens=gen).result(timeout_s=600)
+                t0 = time.perf_counter()
+                streams = [eng.submit(p, max_new_tokens=gen,
+                                      temperature=0.0)
+                           for p in prompts]
+                n_tokens = sum(
+                    len(s.result(timeout_s=600)) for s in streams)
+                dt = time.perf_counter() - t0
+                coll = (eng._coll_bytes_fn(1) if eng._coll_bytes_fn
+                        else {"ici": 0, "dcn": 0})
+            finally:
+                eng.shutdown()
+            fp32_dcn = 2 * cfg.n_layers * allreduce_wire_bytes(
+                cfg.dim, axis_size=shards, quantized=False)
+            ladder.append({
+                "shards": shards,
+                "tp": tp,
+                "dcn_collective": mode,
+                "toks_per_s": round(n_tokens / dt, 1),
+                "ici_bytes_per_step": int(coll["ici"]),
+                "dcn_bytes_per_step": int(coll["dcn"]),
+                "dcn_bytes_ratio_vs_fp32": (
+                    round(fp32_dcn / coll["dcn"], 2)
+                    if coll["dcn"] else None),
+            })
+    return {"ladder": ladder}
+
+
 def _measure_ssd(B=4, S=4096, H=8, P=64, N=128, chunk=128,
                  iters=64) -> dict:
     """Fused Pallas SSD kernel vs the einsum+associative_scan path
@@ -771,6 +850,18 @@ def main():
             extra["llama_8b"] = _measure_8b(peak)
         except Exception as e:
             extra["llama_8b"] = {"error": repr(e)[:200]}
+
+    # Multi-host serving ladder: shard-group replicas on a hybrid
+    # dcn_tp x tp mesh, quantized-vs-exact DCN ablation with
+    # bytes-on-wire in the record.  Runs on CPU too (virtual devices
+    # emulate the host groups), so every record carries the ladder.
+    try:
+        extra["serving_multihost"] = _measure_serving_multihost(
+            dataclasses.replace(cfg, max_seq_len=512))
+    except Exception as e:
+        # No ", "/": " — the final stdout line must stay compact.
+        extra["serving_multihost"] = {
+            "error": repr(e).replace(": ", ":").replace(", ", ",")[:120]}
 
     result = {
         "metric": f"llama_{cfg.num_params()/1e6:.0f}M_train_tokens_per_sec_per_chip",

@@ -28,6 +28,7 @@ import itertools
 import threading
 import time
 import queue as _queue
+import re as _re
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core import events as _ev
@@ -56,6 +57,13 @@ from ray_tpu.utils.ids import (
 )
 
 _tracing_mod = None
+
+# Gloo emits one "[Gloo] Rank N is connected to M peer ranks ..." line
+# per rank per rendezvous — O(ranks^2) console spam on multi-process
+# CPU dryruns.  Matched lines are kept in the LogBuffer but skipped by
+# the driver echo (ingest_logs).
+_GLOO_CONNECT_RE = _re.compile(
+    r"\[Gloo\]\s+Rank\s+\d+\s+is\s+connected\s+to\s+\d+\s+peer\s+ranks")
 
 
 def _tracing():
@@ -3516,6 +3524,13 @@ class LocalRuntime:
             tag = file.rsplit(".", 1)[0]
             where = f"{tag}" if node == "head" else f"{tag}, node={node[:8]}"
             for ln in lines:
+                # Gloo's per-rank connection chatter ("[Gloo] Rank N is
+                # connected to M peer ranks...") floods the driver
+                # console quadratically on multi-process dryruns; keep
+                # it out of the echo only — LogBuffer retains every
+                # line for `raytpu logs`.
+                if _GLOO_CONNECT_RE.search(ln):
+                    continue
                 print(f"({where}) {ln}", flush=True)
 
     def shutdown(self):

@@ -15,6 +15,9 @@ metrics):
   GET /api/v0/requests           serving requests from every LLM
                                  engine's lifecycle ring
                                  (state.list_requests; ?limit=)
+  GET /api/v0/replicas           serve replicas with shard-group mesh
+                                 shape and membership
+                                 (state.list_replicas; ?limit=)
   GET /api/v0/requests/summarize request counts by lifecycle state and
                                  terminal cause
   GET /api/v0/tasks/summarize
@@ -99,6 +102,8 @@ class _Handler(BaseHTTPRequestHandler):
                 })
             elif url.path == "/api/v0/requests":
                 self._json({"result": _state.list_requests(limit=limit)})
+            elif url.path == "/api/v0/replicas":
+                self._json({"result": _state.list_replicas(limit=limit)})
             elif url.path == "/api/v0/requests/summarize":
                 self._json({"result": _state.summarize_requests()})
             elif url.path == "/api/v0/tasks":

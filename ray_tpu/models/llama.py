@@ -63,6 +63,15 @@ class LlamaConfig:
     # (heads are embarrassingly parallel), and the engine shards
     # params/KV over the same axis — see serve/llm_engine.py mesh=.
     tensor_parallel: bool = False
+    # Multi-host shard-group serving (ambient mesh carries a dcn_tp
+    # axis > 1): the per-layer decode allreduce splits into an ICI
+    # psum over "tp" plus a DCN leg over "dcn_tp".  True = int8
+    # quantized DCN allreduce with per-chunk absmax scales
+    # (parallel/collectives.quantized_allreduce, EQuARX-style);
+    # False = exact psum (the bf16-wire fallback — byte-identical
+    # greedy decode on the CPU test backend).
+    dcn_quantized_allreduce: bool = True
+    dcn_allreduce_chunk: int = 256
     # Llama-3.1-style RoPE frequency scaling, as a hashable tuple
     # (factor, low_freq_factor, high_freq_factor, original_max_pos) —
     # None for unscaled RoPE (Llama-3.0 and earlier).
@@ -772,10 +781,15 @@ def shard_params_for_serving(params: Params, cfg: LlamaConfig, mesh,
     rules = dict(_SERVING_RULES)
     if axis != "tp":
         rules = {k: (axis if v == "tp" else v) for k, v in rules.items()}
+    # Multi-host shard groups: a serving mesh carrying a dcn_tp axis
+    # shards the same rule table over (dcn_tp, tp) — the mechanical
+    # _DCN_EXPANSION in parallel/sharding.spec_for, driven by the
+    # mesh's axis names.
+    mesh_axes = frozenset(mesh.axis_names) if axis == "tp" else None
     logical = logical_axes(cfg)
 
     def place(axes, leaf):
-        spec = spec_for(axes, rules)
+        spec = spec_for(axes, rules, mesh_axes=mesh_axes)
         entries = list(spec) + [None] * (len(axes) - len(spec))
         if _is_qdict(leaf):
             q = jax.device_put(leaf["q"], NamedSharding(mesh, P(*entries)))
@@ -805,6 +819,10 @@ def paged_cache_shardings(mesh, axis: str = "tp",
     serving exists to avoid."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if axis == "tp" and mesh.shape.get("dcn_tp", 1) > 1:
+        # Shard-group replica: KV heads split across the whole group
+        # (cross-daemon × in-host), matching the weight expansion.
+        axis = ("dcn_tp", "tp")
     sh = NamedSharding(mesh, P(None, axis, None, None, None))
     out = {"k": sh, "v": sh}
     if kv_int8:
@@ -812,6 +830,125 @@ def paged_cache_shardings(mesh, axis: str = "tp",
         out["k_scale"] = ssh
         out["v_scale"] = ssh
     return out
+
+
+def _serving_hybrid_mesh():
+    """The ambient mesh when it carries a populated ``dcn_tp`` axis —
+    i.e. this decode program belongs to a multi-host shard-group
+    replica — else None (flat single-host tp, or no mesh at all)."""
+    from ray_tpu.ops.ring_attention import _ambient_mesh
+
+    try:
+        mesh = _ambient_mesh()
+    except Exception:
+        return None
+    if mesh.shape.get("dcn_tp", 1) == 1:
+        return None
+    return mesh
+
+
+def _dcn_row_matmul(eq: str, x, w, *, x_spec, w_spec, mesh,
+                    cfg: "LlamaConfig"):
+    """Row-parallel matmul with the per-layer collective split of a
+    shard-group replica: each device contracts its shard, the partial
+    sums psum over "tp" (ICI, exact) and then allreduce over "dcn_tp"
+    — int8-quantized per cfg.dcn_quantized_allreduce (the DCN leg is
+    the bandwidth roofline; EQuARX-style quantization buys back ~4x),
+    exact psum under the bf16 fallback.  Under GSPMD alone both legs
+    would fuse into one unquantized allreduce — taking the projection
+    into shard_map is what makes the DCN leg controllable."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.collectives import dcn_allreduce
+    from ray_tpu.parallel.mesh import shard_map_unchecked
+
+    def body(xs, ws):
+        part = jnp.einsum(eq, xs, ws)
+        part = lax.psum(part, "tp")
+        return dcn_allreduce(part, "dcn_tp",
+                             quantized=cfg.dcn_quantized_allreduce,
+                             chunk=cfg.dcn_allreduce_chunk)
+
+    mapped = shard_map_unchecked(body, mesh=mesh,
+                                 in_specs=(x_spec, w_spec), out_specs=P())
+    return mapped(x, w)
+
+
+def _mlp_block_dcn(x, layer, cfg: "LlamaConfig", mesh):
+    """_mlp_block with the down projection's reduce split into
+    ICI psum + (quantized) DCN allreduce — the gate/up column-parallel
+    matmuls need no collective and stay under GSPMD."""
+    from jax.sharding import PartitionSpec as P
+
+    m = layer["mlp"]
+    dt = cfg.dtype
+    if "w_gateup" in m:
+        gu = jnp.einsum("bsd,dm->bsm", x, m["w_gateup"].astype(dt))
+        gate, up = jnp.split(gu, 2, axis=-1)
+    else:
+        gate = jnp.einsum("bsd,dm->bsm", x, m["w_gate"].astype(dt))
+        up = jnp.einsum("bsd,dm->bsm", x, m["w_up"].astype(dt))
+    act = jax.nn.silu(gate) * up
+    return _dcn_row_matmul(
+        "bsm,md->bsd", act, m["w_down"].astype(dt),
+        x_spec=P(None, None, ("dcn_tp", "tp")),
+        w_spec=P(("dcn_tp", "tp"), None), mesh=mesh, cfg=cfg)
+
+
+def decode_collective_bytes(cfg: "LlamaConfig", mesh,
+                            rows: int) -> Dict[str, int]:
+    """Analytic bytes-on-wire ONE decode step of ``rows`` active slots
+    puts on each link class, per device: 2 allreduces of [rows, dim]
+    activations per layer (attention o-proj + MLP down-proj).  The ICI
+    leg is an exact psum over "tp"; the DCN leg follows the engine's
+    quantization mode.  Analytic by design so the CPU emulation, the
+    multichip dryrun and real DCN all report the same accounting —
+    this feeds raytpu_serve_collective_bytes_total and the
+    MULTICHIP/bench records."""
+    from ray_tpu.parallel.collectives import allreduce_wire_bytes
+
+    tp = mesh.shape.get("tp", 1)
+    dcn = mesh.shape.get("dcn_tp", 1)
+    elems = int(rows) * cfg.dim
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    n_reduces = cfg.n_layers * 2
+    return {
+        "ici": n_reduces * allreduce_wire_bytes(
+            elems, axis_size=tp, quantized=False, itemsize=itemsize),
+        "dcn": n_reduces * allreduce_wire_bytes(
+            elems, axis_size=dcn,
+            quantized=cfg.dcn_quantized_allreduce, itemsize=itemsize,
+            chunk=cfg.dcn_allreduce_chunk),
+    }
+
+
+def serving_collective_probes(cfg: "LlamaConfig", mesh):
+    """Zero-arg jitted probes, one per populated link class, each
+    running a single decode-shaped collective ([1, dim] activations) —
+    the engine times these at startup to observe
+    raytpu_serve_collective_seconds with measured wall time (the
+    per-step collective cost inside the fused decode program is not
+    separately observable from the host)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.collectives import dcn_allreduce
+    from ray_tpu.parallel.mesh import shard_map_unchecked
+
+    x = jnp.zeros((1, cfg.dim), cfg.dtype)
+    probes = {}
+    if mesh.shape.get("tp", 1) > 1:
+        ici = jax.jit(shard_map_unchecked(
+            lambda v: lax.psum(v, "tp"), mesh=mesh,
+            in_specs=P(), out_specs=P()))
+        probes["ici"] = (lambda f=ici: jax.block_until_ready(f(x)))
+    if mesh.shape.get("dcn_tp", 1) > 1:
+        dcn = jax.jit(shard_map_unchecked(
+            lambda v: dcn_allreduce(
+                v, "dcn_tp", quantized=cfg.dcn_quantized_allreduce,
+                chunk=cfg.dcn_allreduce_chunk),
+            mesh=mesh, in_specs=P(), out_specs=P()))
+        probes["dcn"] = (lambda f=dcn: jax.block_until_ready(f(x)))
+    return probes
 
 
 # --- paged inference (block-table KV cache) --------------------------------
@@ -1070,16 +1207,20 @@ def decode_slots_paged(
     )
 
     quantized = "k_scale" in cache
-    attn_fn = (paged_decode_attention_partial_tp if cfg.tensor_parallel
-               else paged_decode_attention_partial)
+    # Multi-host shard group: heads/KV shard over (dcn_tp, tp) and the
+    # per-layer reduces split into ICI psum + (quantized) DCN legs.
+    hybrid = _serving_hybrid_mesh() if cfg.tensor_parallel else None
+    tp_axis = ("dcn_tp", "tp") if hybrid is not None else "tp"
+    attn_fn = (partial(paged_decode_attention_partial_tp, axis=tp_axis)
+               if cfg.tensor_parallel else paged_decode_attention_partial)
     if quantized:
         attn_fn = partial(attn_fn, k_scales=cache["k_scale"],
                           v_scales=cache["v_scale"])
-        append_fn = (paged_append_quantized_tp if cfg.tensor_parallel
-                     else paged_append_quantized)
+        append_fn = (partial(paged_append_quantized_tp, axis=tp_axis)
+                     if cfg.tensor_parallel else paged_append_quantized)
     else:
-        append_fn = (paged_append_tp if cfg.tensor_parallel
-                     else paged_append)
+        append_fn = (partial(paged_append_tp, axis=tp_axis)
+                     if cfg.tensor_parallel else paged_append)
 
     page = cache["k"].shape[3]
     new_len = jnp.where(active, lengths + 1, lengths)
@@ -1111,6 +1252,20 @@ def decode_slots_paged(
         )
         out = combine_with_self(q[:, 0], k1, v1, acc, m, l,
                                 soft_cap=cfg.logits_soft_cap)
+        if hybrid is not None:
+            from jax.sharding import PartitionSpec as P
+
+            out = _dcn_row_matmul(
+                "bhk,hkd->bd", out,
+                layer["attn"]["wo"].astype(cfg.dtype),
+                x_spec=P(None, ("dcn_tp", "tp"), None),
+                w_spec=P(("dcn_tp", "tp"), None, None),
+                mesh=hybrid, cfg=cfg)[:, None]
+            h = x + out
+            h = h + _mlp_block_dcn(
+                rms_norm(h, layer["ln_mlp"], cfg.norm_eps), layer, cfg,
+                hybrid)
+            return (h, li + 1), (k1, v1)
         out = jnp.einsum("bhk,hkd->bd", out,
                          layer["attn"]["wo"].astype(cfg.dtype))[:, None]
         h = x + out

@@ -42,6 +42,17 @@ NEG_INF = -1e30
 _MIN_QPG = 8  # sublane floor: pad the per-kv-head q group to 8 rows
 
 
+def _tp_axis_size(mesh, axis) -> int:
+    """Total shard count over ``axis``, which is one mesh axis name or
+    a tuple of them (the hybrid serving case, ("dcn_tp", "tp"))."""
+    if isinstance(axis, str):
+        return mesh.shape.get(axis, 1)
+    size = 1
+    for a in axis:
+        size *= mesh.shape.get(a, 1)
+    return size
+
+
 def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
             m_scr, l_scr, acc_scr, *, page: int, scale: float,
             soft_cap: Optional[float], kvh: int, qpg_p: int):
@@ -575,7 +586,7 @@ def paged_append_tp(k_pools, v_pools, k_new, v_new, pids, offs, *,
         mesh = _ambient_mesh()
     except Exception:
         mesh = None
-    if mesh is None or mesh.shape.get(axis, 1) == 1:
+    if mesh is None or _tp_axis_size(mesh, axis) == 1:
         return paged_append(k_pools, v_pools, k_new, v_new, pids, offs)
     from jax.sharding import PartitionSpec as P
 
@@ -602,7 +613,7 @@ def paged_append_quantized_tp(k_pools, v_pools, k_scales, v_scales,
         mesh = _ambient_mesh()
     except Exception:
         mesh = None
-    if mesh is None or mesh.shape.get(axis, 1) == 1:
+    if mesh is None or _tp_axis_size(mesh, axis) == 1:
         return paged_append_quantized(k_pools, v_pools, k_scales,
                                       v_scales, k_new, v_new, pids, offs)
     from jax.sharding import PartitionSpec as P
@@ -636,7 +647,7 @@ def paged_decode_attention_partial_tp(
         mesh = _ambient_mesh()
     except Exception:
         mesh = None
-    if mesh is None or mesh.shape.get(axis, 1) == 1:
+    if mesh is None or _tp_axis_size(mesh, axis) == 1:
         return paged_decode_attention_partial(
             q, k_pools, v_pools, layer, block_table, lengths,
             soft_cap=soft_cap, k_scales=k_scales, v_scales=v_scales)
@@ -731,7 +742,7 @@ def paged_decode_attention_tp(
         mesh = _ambient_mesh()
     except Exception:
         mesh = None
-    if mesh is None or mesh.shape.get(axis, 1) == 1:
+    if mesh is None or _tp_axis_size(mesh, axis) == 1:
         return paged_decode_attention(q, k_pages, v_pages, block_table,
                                       lengths, soft_cap=soft_cap)
     from jax.sharding import PartitionSpec as P

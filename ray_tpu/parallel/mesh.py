@@ -38,7 +38,15 @@ AXIS_ORDER: Tuple[str, ...] = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 # (tp/sp/ep) stay strictly within a slice's ICI.  Present in a mesh
 # only when a hybrid spec asks for them, so flat single-slice meshes
 # keep their canonical six axes.
-DCN_AXIS_ORDER: Tuple[str, ...] = ("dcn_pp", "dcn_dp", "dcn_fsdp")
+#
+# dcn_tp is the deliberate serving-plane exception to "model axes stay
+# in-slice": a multi-host shard-group replica tensor-parallels its
+# weights across node daemons, and the per-layer decode allreduce
+# crosses DCN int8-quantized (parallel/collectives.dcn_allreduce,
+# EQuARX-style) so the cross-host leg stays off the network roofline.
+# It sits LAST so existing hybrid train meshes keep their leading
+# (dcn_pp, dcn_dp, dcn_fsdp) axis positions.
+DCN_AXIS_ORDER: Tuple[str, ...] = ("dcn_pp", "dcn_dp", "dcn_fsdp", "dcn_tp")
 
 # Axes over which a replica of the model parameters is complete.  Data is
 # split over these; params are replicated (dp) or sharded-and-gathered (fsdp).
@@ -69,6 +77,7 @@ class MeshSpec:
     dcn_pp: int = 1
     dcn_dp: int = 1
     dcn_fsdp: int = 1
+    dcn_tp: int = 1
 
     @property
     def hybrid(self) -> bool:
@@ -202,6 +211,38 @@ def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
 
         return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                    check_rep=False)
+
+
+def create_serving_mesh(shards: int, tp: int, *,
+                        devices: Optional[Sequence[jax.Device]] = None
+                        ) -> Mesh:
+    """Mesh for a multi-host tensor-parallel serving replica: ``shards``
+    shard-group members along ``dcn_tp`` (one per node daemon, grouped
+    by ``process_index`` in a real jax.distributed world, contiguous
+    chunks on the virtual-CPU test backend) × ``tp`` chips of ICI
+    inside each.  Weights shard over (dcn_tp, tp); per-layer decode
+    allreduces split into an ICI psum over ``tp`` plus a quantized DCN
+    leg over ``dcn_tp``.  Extra devices beyond ``shards * tp`` are left
+    out rather than absorbed — a serving replica owns exactly its
+    shard-group's chips."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    need = shards * tp
+    if len(devs) < need:
+        raise ValueError(
+            f"serving mesh wants {shards}x{tp}={need} devices, have "
+            f"{len(devs)}")
+    devs = _order_devices_for_ici(devs)[:need]
+    return create_mesh(MeshSpec(dp=1, tp=tp, dcn_tp=shards), devices=devs)
+
+
+def serving_mesh_shape(mesh: Mesh) -> str:
+    """Human/CLI form of a serving mesh's layout ("dcn_tp=2 x tp=4"),
+    the mesh-shape column `raytpu list replicas` prints."""
+    parts = []
+    for a in ("dcn_tp", "tp"):
+        if mesh.shape.get(a, 1) >= 1:
+            parts.append(f"{a}={mesh.shape.get(a, 1)}")
+    return " x ".join(parts)
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:

@@ -43,7 +43,12 @@ DEFAULT_RULES: Rules = {
 # the matching in-slice axis expands to (dcn pair, axis) MECHANICALLY
 # at spec time — rule tables stay written in the flat six-axis
 # vocabulary and bare spec_for() calls keep their historical meaning.
-_DCN_EXPANSION = {"dp": "dcn_dp", "fsdp": "dcn_fsdp", "pp": "dcn_pp"}
+# "tp" → "dcn_tp" serves the multi-host serving meshes
+# (mesh.create_serving_mesh): a shard-group replica's weights shard
+# over both the cross-daemon and the in-host tensor axes from the same
+# serving rule table.
+_DCN_EXPANSION = {"dp": "dcn_dp", "fsdp": "dcn_fsdp", "pp": "dcn_pp",
+                  "tp": "dcn_tp"}
 
 
 def spec_for(logical_axes: Sequence[Optional[str]],

@@ -84,6 +84,9 @@ _LIST_ROUTES = {
                  ["request_id", "engine", "state", "prompt_tokens",
                   "generated_tokens", "slot", "attempt", "prefix_hit",
                   "terminal_cause"]),
+    "replicas": ("/api/v0/replicas",
+                 ["app", "deployment", "replica_id", "state",
+                  "shard_group", "mesh_shape", "members"]),
 }
 
 

@@ -40,6 +40,39 @@ class AutoscalingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ShardGroupConfig:
+    """One replica = ``size`` engine processes forming a single logical
+    tensor-parallel shard group (the multi-host serving unit): weights
+    shard over ``tensor_parallel`` ways inside each host (ICI) and over
+    the ``size`` group members across hosts (DCN).  The controller
+    allocates members through one placement group, the router addresses
+    the group's rank 0, and ANY member death is whole-replica failure
+    (the drain/failover path treats the group as one unit)."""
+
+    size: int = 2
+    # In-host tensor-parallel ways per member ("tp" mesh axis).
+    tensor_parallel: int = 1
+    # DCN leg of the per-layer decode allreduces: "int8" (EQuARX-style
+    # quantized, per-chunk scales) or "bf16" (exact-psum fallback).
+    dcn_collective: str = "int8"
+    # Per-member bundle resources for the group's placement group.
+    bundle_resources: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"CPU": 1})
+    placement_strategy: str = "PACK"
+
+    def __post_init__(self):
+        if self.size < 2:
+            raise ValueError("shard_group.size must be >= 2 (a size-1 "
+                             "group is just a plain replica)")
+        if self.tensor_parallel < 1:
+            raise ValueError("shard_group.tensor_parallel must be >= 1")
+        if self.dcn_collective not in ("int8", "bf16"):
+            raise ValueError(
+                f"shard_group.dcn_collective must be 'int8' or 'bf16', "
+                f"got {self.dcn_collective!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class DeploymentConfig:
     """Per-deployment knobs (parity: ray serve/config.py DeploymentConfig)."""
 
@@ -52,6 +85,8 @@ class DeploymentConfig:
     graceful_shutdown_timeout_s: float = 5.0
     # Resources for each replica actor (parity: ray_actor_options).
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Multi-host tensor-parallel replicas (None = plain single-process).
+    shard_group: Optional[ShardGroupConfig] = None
 
     def __post_init__(self):
         if self.num_replicas < 0:

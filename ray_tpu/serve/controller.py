@@ -54,6 +54,13 @@ def _telemetry():
                 "Exceptions swallowed by the controller reconcile "
                 "loop — nonzero means the control plane is limping.",
             ),
+            "shard_members": metrics.Gauge(
+                "raytpu_serve_shard_group_members",
+                "Member processes of a multi-host shard-group replica "
+                "(rank 0 + shard members; 0 once the group is torn "
+                "down), by deployment and replica.",
+                tag_keys=("deployment", "replica"),
+            ),
         }
     else:
         reg = metrics.registry()
@@ -87,6 +94,14 @@ class _Replica:
         # table so routers can prefer the replica holding the longest
         # cached prefix.  None = no cache / nothing cached yet.
         self.prefix_summary = None
+        # Multi-host shard group (config.shard_group): rank 0 IS this
+        # replica's handle (the streaming endpoint the router
+        # addresses); members holds the rank >= 1 ShardMemberActor
+        # handles whose death fails the whole group.
+        self.members: List[Tuple[int, Any]] = []
+        self.pg = None
+        self.mesh_shape = ""
+        self.member_ping_refs = None
 
 
 class _DeploymentState:
@@ -265,6 +280,38 @@ class ServeController:
             r.prefix_summary = summary
             self._broadcast(st)
 
+    def list_replicas(self) -> List[Dict[str, Any]]:
+        """Replica inventory for `raytpu list replicas` (util/state.py):
+        one row per replica, deterministic order (app, deployment,
+        replica id).  Shard-group replicas carry their mesh shape
+        ("dcn_tp=S x tp=T") and group membership (rank:actor pairs,
+        rank 0 = the replica actor itself)."""
+        rows: List[Dict[str, Any]] = []
+        with self._lock:
+            for (app, dep), st in sorted(self._deployments.items()):
+                for rid in sorted(st.replicas):
+                    r = st.replicas[rid]
+                    sg = st.config.shard_group
+                    membership = ""
+                    if sg is not None:
+                        # hex[8:16]: the leading 4 bytes are the job id,
+                        # identical for every actor — show the
+                        # distinguishing slice.
+                        parts = [f"0:{r.handle._actor_id.hex()[8:16]}"]
+                        parts += [f"{rank}:{m._actor_id.hex()[8:16]}"
+                                  for rank, m in r.members]
+                        membership = ",".join(parts)
+                    rows.append({
+                        "app": app,
+                        "deployment": dep,
+                        "replica_id": rid,
+                        "state": r.state,
+                        "shard_group": sg.size if sg is not None else 0,
+                        "mesh_shape": r.mesh_shape,
+                        "members": membership,
+                    })
+        return rows
+
     def drain_replica(self, app_name: str, deployment_name: str,
                       replica_id: str,
                       grace_s: Optional[float] = None) -> bool:
@@ -424,6 +471,44 @@ class ServeController:
                   >= st.config.health_check_period_s):
                 r.last_health_check = now
                 r.health_ref = r.handle.check_health.remote()
+                if r.members:
+                    r.member_ping_refs = [
+                        (rank, m.ping.remote()) for rank, m in r.members
+                    ]
+            if r.member_ping_refs and r.state in ("RUNNING", "DRAINING"):
+                self._check_shard_members(st, r, rt)
+
+    def _check_shard_members(self, st: _DeploymentState, r: _Replica, rt):
+        """Resolve outstanding shard-member pings.  ANY member death is
+        whole-replica failure: the group's mesh spans every member, so
+        a lost member means lost collectives — rank 0 is hard-killed
+        (sealing ActorDiedError into its live streams exactly as the
+        lost link would on real hardware, which is what routes every
+        in-flight request through the router's failover/replay path)
+        and the group is replaced as one unit."""
+        pending = []
+        dead = False
+        for rank, ref in r.member_ping_refs:
+            if not rt.store.contains(ref.id):
+                pending.append((rank, ref))
+                continue
+            try:
+                api.get(ref)
+            except Exception:
+                dead = True
+        r.member_ping_refs = pending
+        if dead:
+            from ray_tpu.utils.test_utils import kill_actor_hard
+
+            log.warning(
+                "shard group %s lost a member — failing the whole "
+                "replica", r.replica_id)
+            try:
+                kill_actor_hard(rt, r.handle._actor_id)
+            except Exception:
+                pass
+            r.state = "STOPPING"
+            r.member_ping_refs = None
 
     def _scale(self, st: _DeploymentState) -> bool:
         changed = False
@@ -500,17 +585,66 @@ class ServeController:
             cfg.autoscaling_config.metrics_interval_s
             if cfg.autoscaling_config else 0.0
         )
+        sg = cfg.shard_group
+        members: List[Tuple[int, Any]] = []
+        pg = None
+        shard_kwarg = {}
+        if sg is not None:
+            # One placement group gang-reserves the whole group (one
+            # bundle per member — on TPU each bundle is one host's
+            # chips, ICI_CONTIGUOUS keeps the group on one slice
+            # block); members rank 1..size-1 are ShardMemberActors,
+            # rank 0 is the ReplicaActor itself so the router's
+            # broadcast table naturally addresses the group's rank 0.
+            from ray_tpu.core.placement_group import (
+                PlacementGroupSchedulingStrategy,
+                placement_group,
+            )
+            from ray_tpu.serve.replica import ShardMemberActor
+
+            pg = placement_group(
+                [dict(sg.bundle_resources) for _ in range(sg.size)],
+                strategy=sg.placement_strategy,
+                name=f"sg::{replica_id}",
+            )
+            member_cls = api.remote(ShardMemberActor)
+            for rank in range(1, sg.size):
+                m = member_cls.options(
+                    num_cpus=0.1,
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=pg,
+                        placement_group_bundle_index=rank,
+                    ),
+                ).remote(replica_id, rank, sg.size)
+                members.append((rank, m))
+            opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=0,
+            )
+            shard_kwarg = {"shard_group": {
+                "group_id": replica_id,
+                "rank": 0,
+                "size": sg.size,
+                "tensor_parallel": sg.tensor_parallel,
+                "dcn_collective": sg.dcn_collective,
+                "member_ids": [m._actor_id.hex() for _, m in members],
+            }}
         actor_cls = api.remote(ReplicaActor)
         handle = actor_cls.options(
             max_concurrency=cfg.max_ongoing_requests + 4, **opts
         ).remote(
             st.app_name, st.info.name, replica_id, st.info.func_or_class,
             st.info.init_args, st.info.init_kwargs, cfg.user_config,
-            metrics_interval,
+            metrics_interval, **shard_kwarg,
         )
-        st.replicas[replica_id] = _Replica(
-            replica_id, handle, handle._creation_ref
-        )
+        r = _Replica(replica_id, handle, handle._creation_ref)
+        r.members = members
+        r.pg = pg
+        if sg is not None:
+            r.mesh_shape = f"dcn_tp={sg.size} x tp={sg.tensor_parallel}"
+            self._tm["shard_members"].set(
+                sg.size, tags={"deployment": st.info.name,
+                               "replica": replica_id})
+        st.replicas[replica_id] = r
 
     def _stop_replica(self, st: _DeploymentState, r: _Replica):
         try:
@@ -520,6 +654,24 @@ class ServeController:
             api.kill(r.handle, no_restart=True)
         except Exception:
             pass
+        # Shard group: tear down the whole gang — surviving members
+        # and the placement-group reservation go with rank 0.
+        for _rank, m in r.members:
+            try:
+                api.kill(m, no_restart=True)
+            except Exception:
+                pass
+        if r.pg is not None:
+            from ray_tpu.core.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(r.pg)
+            except Exception:
+                pass
+        if r.members or r.pg is not None:
+            self._tm["shard_members"].set(
+                0, tags={"deployment": st.info.name,
+                         "replica": r.replica_id})
         st.replicas.pop(r.replica_id, None)
         st.metrics.pop(r.replica_id, None)
 

@@ -12,7 +12,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.config import (
+    AutoscalingConfig,
+    DeploymentConfig,
+    ShardGroupConfig,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,13 +64,26 @@ def deployment(
     health_check_period_s: float = 1.0,
     graceful_shutdown_timeout_s: float = 5.0,
     ray_actor_options: Optional[Dict[str, Any]] = None,
+    shard_group: Optional[Any] = None,
 ) -> Any:
-    """``@serve.deployment`` (parity: ray serve/api.py deployment:...)."""
+    """``@serve.deployment`` (parity: ray serve/api.py deployment:...).
+
+    ``shard_group``: a ShardGroupConfig (or kwargs dict) making each
+    replica a multi-host tensor-parallel shard group of engine
+    processes instead of one actor (serve/shard_group.py)."""
     if isinstance(autoscaling_config, dict):
         autoscaling_config = AutoscalingConfig(**autoscaling_config)
+    if isinstance(shard_group, dict):
+        shard_group = ShardGroupConfig(**shard_group)
     if num_replicas is not None and autoscaling_config is not None:
         raise ValueError(
             "num_replicas and autoscaling_config are mutually exclusive"
+        )
+    if shard_group is not None and autoscaling_config is not None:
+        raise ValueError(
+            "shard_group deployments do not autoscale yet — each "
+            "scale step allocates a whole placement group; set "
+            "num_replicas explicitly"
         )
 
     def wrap(target: Callable) -> Deployment:
@@ -78,6 +95,7 @@ def deployment(
             health_check_period_s=health_check_period_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=dict(ray_actor_options or {}),
+            shard_group=shard_group,
         )
         return Deployment(target, name or target.__name__, cfg)
 

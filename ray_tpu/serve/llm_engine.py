@@ -169,6 +169,27 @@ def _telemetry():
                 "Cache pages evicted (refcount-0 LRU) under admission "
                 "pressure.",
             ),
+            "collective_bytes": metrics.Counter(
+                "raytpu_serve_collective_bytes_total",
+                "Bytes one shard puts on the wire for decode-step "
+                "allreduces, by link class (ici = in-host exact psum, "
+                "dcn = cross-daemon leg, int8-quantized unless the "
+                "bf16 fallback is configured).  Analytic accounting "
+                "(parallel.collectives.allreduce_wire_bytes) so CPU "
+                "emulation and real DCN report the same number.",
+                tag_keys=("link",),
+            ),
+            "collective_seconds": metrics.Histogram(
+                "raytpu_serve_collective_seconds",
+                "Measured wall time of one decode-shaped collective "
+                "per link class, observed from startup calibration "
+                "probes (the per-step collective inside the fused "
+                "decode program is not separately observable from the "
+                "host).",
+                boundaries=[1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+                            1e-3, 2.5e-3, 5e-3, 1e-2, 5e-2, 0.25, 1.0],
+                tag_keys=("link",),
+            ),
         }
     else:
         reg = metrics.registry()
@@ -324,6 +345,17 @@ class PagedEngineAdapter:
     # cfg.tensor_parallel + paged_decode_attention_tp).
     shard_params: Optional[Callable[[Any, Any], Any]] = None
     cache_shardings: Optional[Callable[[Any], Any]] = None
+    # Multi-host shard groups: collective_step_bytes(mesh, rows) ->
+    # {"ici": bytes, "dcn": bytes} — analytic per-device wire bytes of
+    # ONE decode step over ``rows`` active slots, feeding
+    # raytpu_serve_collective_bytes_total.  collective_probes(mesh) ->
+    # {link: zero-arg callable} running one decode-shaped collective;
+    # the engine times them at startup for
+    # raytpu_serve_collective_seconds.
+    collective_step_bytes: Optional[
+        Callable[[Any, int], Dict[str, int]]] = None
+    collective_probes: Optional[
+        Callable[[Any], Dict[str, Callable]]] = None
 
 
 def llama_paged_adapter(cfg) -> PagedEngineAdapter:
@@ -356,6 +388,10 @@ def llama_paged_adapter(cfg) -> PagedEngineAdapter:
             llama.shard_params_for_serving(params, cfg, mesh),
         cache_shardings=lambda mesh: llama.paged_cache_shardings(
             mesh, kv_int8=cfg.kv_int8),
+        collective_step_bytes=lambda mesh, rows:
+            llama.decode_collective_bytes(cfg, mesh, rows),
+        collective_probes=lambda mesh:
+            llama.serving_collective_probes(cfg, mesh),
     )
 
 
@@ -481,9 +517,30 @@ class LLMServer:
     def __init__(self, model_cfg: Any, engine_cfg: EngineConfig,
                  param_loader: Callable[[], Any], *, adapter_factory:
                  Callable[[Any], EngineAdapter] = None):
-        make_adapter = adapter_factory or llama_adapter
+        # Rank 0 of a shard group (serve/shard_group.py) hosts the
+        # engine over a hybrid DCN×ICI serving mesh: weights
+        # tensor-parallel over tp (in host) × dcn_tp (across group
+        # members), KV pools sharded along heads, decode's DCN
+        # allreduce legs int8-quantized unless the group configured
+        # the bf16 fallback.
+        from ray_tpu.serve.shard_group import current_shard_group
+
+        sg = current_shard_group()
+        mesh = None
+        if sg is not None:
+            import dataclasses as _dc
+
+            from ray_tpu.parallel.mesh import create_serving_mesh
+
+            model_cfg = _dc.replace(
+                model_cfg, tensor_parallel=True,
+                dcn_quantized_allreduce=sg.quantized)
+            mesh = create_serving_mesh(sg.size, sg.tensor_parallel)
+        make_adapter = adapter_factory or (
+            llama_paged_adapter if mesh is not None else llama_adapter)
         self.engine = LLMEngine(
-            param_loader(), make_adapter(model_cfg), engine_cfg
+            param_loader(), make_adapter(model_cfg), engine_cfg,
+            mesh=mesh,
         )
 
     def __call__(self, payload: Dict[str, Any]) -> Dict[str, Any]:
@@ -657,6 +714,16 @@ class LLMEngine:
         self._steps = 0
         self._tokens_out = 0
         self._tm = _telemetry()
+        # Multi-host shard groups: per-step collective byte accounting
+        # + one-time timed calibration probes (see PagedEngineAdapter).
+        self._coll_bytes_fn = None
+        if (mesh is not None and self._paged
+                and adapter.collective_step_bytes is not None):
+            self._coll_bytes_fn = partial(
+                adapter.collective_step_bytes, mesh)
+        if (mesh is not None and self._paged
+                and adapter.collective_probes is not None):
+            self._calibrate_collectives(adapter.collective_probes(mesh))
         self._update_page_gauges()
         # Request-lifecycle ring (util/state.list_requests, dashboard
         # /api/v0/requests, timeline request rows all read it).  The
@@ -1263,6 +1330,31 @@ class LLMEngine:
         self._update_page_gauges()
         return slot, start
 
+    def _calibrate_collectives(self, probes: Dict[str, Callable]) -> None:
+        """Time one decode-shaped collective per populated link class
+        and observe raytpu_serve_collective_seconds with MEASURED wall
+        time.  Runs once at engine construction: the first call
+        compiles (untimed), the next three are timed — honest
+        measurement rather than fabricated per-step attribution."""
+        for link, probe in sorted(probes.items()):
+            probe()  # compile
+            for _ in range(3):
+                t0 = time.perf_counter()
+                probe()
+                self._tm["collective_seconds"].observe(
+                    time.perf_counter() - t0, tags={"link": link})
+
+    def _count_collective_bytes(self, rows: int, steps: int = 1) -> None:
+        """Per-dispatch analytic wire accounting for a decode of
+        ``rows`` active slots × ``steps`` device steps."""
+        if self._coll_bytes_fn is None or rows <= 0:
+            return
+        per_step = self._coll_bytes_fn(rows)
+        for link, nbytes in per_step.items():
+            if nbytes:
+                self._tm["collective_bytes"].inc(
+                    nbytes * steps, tags={"link": link})
+
     def _update_page_gauges(self) -> None:
         if not self._paged:
             return
@@ -1498,6 +1590,7 @@ class LLMEngine:
         self._tm["step_tokens"].inc(n_decode, tags={"phase": "decode"})
         self._tm["step_tokens"].inc(n_prefill,
                                     tags={"phase": "prefill"})
+        self._count_collective_bytes(n_decode)
         if n_decode:
             self._tm["batch_size"].observe(n_decode)
         self._tm["queue_depth"].set(self._waiting.qsize()
@@ -1817,6 +1910,7 @@ class LLMEngine:
         self._steps += chunk
         self._tm["step_tokens"].inc(chunk * len(self._slot_req),
                                     tags={"phase": "decode"})
+        self._count_collective_bytes(len(self._slot_req), steps=chunk)
         self._tm["batch_size"].observe(len(self._slot_req))
         self._tm["queue_depth"].set(
             self._waiting.qsize()

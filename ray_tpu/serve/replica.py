@@ -67,7 +67,8 @@ class ReplicaActor:
 
     def __init__(self, app_name: str, deployment_name: str, replica_id: str,
                  func_or_class: Any, init_args: tuple, init_kwargs: dict,
-                 user_config: Any, metrics_interval_s: float = 0.0):
+                 user_config: Any, metrics_interval_s: float = 0.0,
+                 shard_group: Optional[dict] = None):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self.replica_id = replica_id
@@ -78,6 +79,17 @@ class ReplicaActor:
         self._tags = {"deployment": deployment_name, "replica": replica_id}
         init_args = _resolve_placeholders(init_args)
         init_kwargs = _resolve_placeholders(init_kwargs)
+        if shard_group is not None:
+            # Rank 0 of a multi-host shard group: install the ambient
+            # context BEFORE the user callable constructs, so an
+            # engine-hosting callable builds its hybrid serving mesh
+            # (serve/shard_group.py; LLMServer reads it).
+            from ray_tpu.serve.shard_group import (
+                ShardGroupContext,
+                set_shard_group,
+            )
+
+            set_shard_group(ShardGroupContext(**shard_group))
         if inspect.isclass(func_or_class):
             self._callable = func_or_class(*init_args, **init_kwargs)
         else:
@@ -424,3 +436,28 @@ class ReplicaActor:
                         )
             except Exception:
                 return  # controller gone — cluster is shutting down
+
+
+class ShardMemberActor:
+    """Rank >= 1 of a multi-host shard-group replica.
+
+    Holds one placement-group bundle (one host's worth of chips) and
+    answers health pings; its DEATH is the group's failure signal —
+    the controller treats any member loss as whole-replica failure and
+    routes the group through the PR-5 drain/failover path.  On real
+    multi-host TPU this process additionally joins the group's
+    jax.distributed runtime so rank 0's hybrid mesh spans its chips;
+    on the CPU test backend the mesh lives over rank 0's virtual
+    devices and this actor is purely the membership/fault unit."""
+
+    def __init__(self, group_id: str, rank: int, size: int):
+        self.group_id = group_id
+        self.rank = rank
+        self.size = size
+
+    def ping(self) -> str:
+        return f"{self.group_id}/{self.rank}"
+
+    def get_metadata(self) -> Dict[str, Any]:
+        return {"group_id": self.group_id, "rank": self.rank,
+                "size": self.size}

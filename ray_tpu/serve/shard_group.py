@@ -1,0 +1,57 @@
+"""Shard-group ambient context — how a replica's user callable learns
+it is rank 0 of a multi-host tensor-parallel group.
+
+The controller starts one ReplicaActor (rank 0, the streaming
+endpoint the router addresses) plus ``size - 1`` ShardMemberActor
+processes through a placement group.  Rank 0's ReplicaActor installs a
+:class:`ShardGroupContext` BEFORE constructing the user callable;
+engine-hosting callables (serve.llm_engine.LLMServer) read it via
+:func:`current_shard_group` and build their serving mesh
+(parallel.mesh.create_serving_mesh) accordingly — ``dcn_tp`` spanning
+the group members, ``tp`` the in-host chips.
+
+On the CPU test backend the hybrid mesh lives over virtual devices
+inside rank 0's process (contiguous device groups emulate the host
+boundary) while the other members are real actors whose death fails
+the whole group; on real multi-host TPU the members each hold a slice
+of the same jax.distributed runtime and the mesh spans processes —
+the context carries everything both layouts need.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardGroupContext:
+    """What one member of a shard group knows about its group."""
+
+    group_id: str            # controller-minted, == replica_id
+    rank: int                # this process's rank; 0 hosts the engine
+    size: int                # number of member processes
+    tensor_parallel: int     # in-host tp ways per member
+    dcn_collective: str      # "int8" | "bf16"
+    member_ids: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def quantized(self) -> bool:
+        return self.dcn_collective == "int8"
+
+
+_LOCAL = threading.local()
+
+
+def set_shard_group(ctx: Optional[ShardGroupContext]) -> None:
+    """Install (or clear, with None) the ambient shard-group context.
+    Called by ReplicaActor before constructing the user callable, in
+    the thread that runs the constructor."""
+    _LOCAL.ctx = ctx
+
+
+def current_shard_group() -> Optional[ShardGroupContext]:
+    """The ambient context, or None outside any shard group (plain
+    single-process replicas — the common case)."""
+    return getattr(_LOCAL, "ctx", None)

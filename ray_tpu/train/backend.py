@@ -82,6 +82,17 @@ def _init_jax_distributed(addr: str, num_processes: int, process_id: int,
 
     if platform:
         jax.config.update("jax_platforms", platform)
+    if platform == "cpu":
+        # Cross-process computations on the CPU backend need a real
+        # collectives implementation behind the PjRt client (jax's
+        # default is "none", which refuses multiprocess programs).
+        # Gloo over TCP is the CPU stand-in for the ICI/DCN fabric.
+        # config.update (not env) so it also lands when the worker
+        # process inherited an already-imported jax from its parent.
+        jax.config.update(
+            "jax_cpu_collectives_implementation",
+            os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION",
+                           "gloo"))
     kwargs: Dict[str, Any] = {}
     if local_device_ids is not None:
         kwargs["local_device_ids"] = local_device_ids

@@ -98,6 +98,30 @@ def list_requests(filters: Optional[List[Filter]] = None, *,
     return _apply_filters(rows, filters, limit)
 
 
+def list_replicas(filters: Optional[List[Filter]] = None, *,
+                  limit: int = 100,
+                  detail: bool = False) -> List[Dict[str, Any]]:
+    """Serve replicas from the controller's inventory (parity shape:
+    `serve status`, flattened to one row per replica like
+    `raytpu list requests`).  Shard-group replicas carry their hybrid
+    mesh shape ("dcn_tp=S x tp=T") and group membership
+    ("rank:actor,..." with rank 0 the routed replica actor).  Empty
+    list when no serve controller is running."""
+    from ray_tpu.core import api
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    try:
+        controller = api.get_actor(CONTROLLER_NAME)
+        rows = api.get(controller.list_replicas.remote())
+    except Exception:
+        return []
+    if not detail:
+        keep = ("app", "deployment", "replica_id", "state",
+                "shard_group", "mesh_shape", "members")
+        rows = [{k: r.get(k) for k in keep} for r in rows]
+    return _apply_filters(rows, filters, limit)
+
+
 def summarize_requests() -> Dict[str, Any]:
     """Request counts by lifecycle state and terminal cause (parity
     shape: `ray summary tasks`, one level up the stack)."""

@@ -194,6 +194,78 @@ def _check_serving(name: str, d: Any, problems: List[str]) -> None:
         _check_prefix(name, d["prefix"], problems)
 
 
+MULTIHOST_RUNG_REQUIRED = ("shards", "tp", "dcn_collective",
+                           "toks_per_s", "ici_bytes_per_step",
+                           "dcn_bytes_per_step",
+                           "dcn_bytes_ratio_vs_fp32")
+
+
+def _check_multihost(name: str, d: Any, problems: List[str]) -> None:
+    """The multi-host serving ladder: shard-count rungs over a
+    dcn_tp x tp mesh, each with its DCN-collective mode and the
+    per-decode-step bytes-on-wire.  Every int8 rung must carry the
+    quantization win — >= 3x under the fp32 accounting — or the record
+    is claiming a multihost speedup it never measured."""
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    if "error" in d:  # bench leg failed; the record says so — valid
+        return
+    ladder = d.get("ladder")
+    if not isinstance(ladder, list) or not ladder:
+        problems.append(f"{name}: ladder must be a non-empty list")
+        return
+    for i, rung in enumerate(ladder):
+        sub = f"{name}.ladder[{i}]"
+        if not isinstance(rung, dict):
+            problems.append(f"{sub}: not an object")
+            continue
+        for k in MULTIHOST_RUNG_REQUIRED:
+            if k not in rung:
+                problems.append(f"{sub}: missing required key {k!r}")
+        for k in ("shards", "tp"):
+            if k in rung and not (_num(rung[k]) and rung[k] >= 1):
+                problems.append(f"{sub}: {k}={rung.get(k)!r} must be a "
+                                f"number >= 1")
+        if ("dcn_collective" in rung
+                and rung["dcn_collective"] not in ("int8", "bf16")):
+            problems.append(
+                f"{sub}: dcn_collective must be 'int8' or 'bf16', got "
+                f"{rung.get('dcn_collective')!r}")
+        if "toks_per_s" in rung and not (_num(rung["toks_per_s"])
+                                         and rung["toks_per_s"] > 0):
+            problems.append(f"{sub}: toks_per_s="
+                            f"{rung.get('toks_per_s')!r} must be > 0")
+        for k in ("ici_bytes_per_step", "dcn_bytes_per_step"):
+            if k in rung and not (_num(rung[k]) and rung[k] >= 0):
+                problems.append(f"{sub}: {k}={rung.get(k)!r} must be a "
+                                f"number >= 0")
+        shards = rung.get("shards")
+        if _num(shards) and shards > 1 and not (
+                _num(rung.get("dcn_bytes_per_step"))
+                and rung["dcn_bytes_per_step"] > 0):
+            problems.append(
+                f"{sub}: shards={shards} but dcn_bytes_per_step="
+                f"{rung.get('dcn_bytes_per_step')!r} — a multi-shard "
+                f"rung puts bytes on the DCN")
+        ratio = rung.get("dcn_bytes_ratio_vs_fp32")
+        if ratio is not None and not _num(ratio):
+            problems.append(f"{sub}: dcn_bytes_ratio_vs_fp32={ratio!r} "
+                            f"is neither a number nor null")
+        if (rung.get("dcn_collective") == "int8" and
+                not (_num(ratio) and ratio >= 3.0)):
+            problems.append(
+                f"{sub}: int8 rung must show >= 3x DCN reduction, got "
+                f"dcn_bytes_ratio_vs_fp32={ratio!r}")
+    modes = {r.get("dcn_collective") for r in ladder
+             if isinstance(r, dict)
+             and _num(r.get("shards")) and r["shards"] > 1}
+    if modes and not {"int8", "bf16"} <= modes:
+        problems.append(
+            f"{name}: multi-shard rungs must run the int8-vs-bf16 "
+            f"ablation, found only {sorted(modes)}")
+
+
 def _check_mixed(name: str, d: Any, problems: List[str]) -> None:
     """A mixed-length ladder block: one serving record per prompt mix,
     each carrying the distribution that produced its knee."""
@@ -247,6 +319,9 @@ def validate_record(rec: Any) -> List[str]:
     for key, block in extra.items():
         if "serving" in key and "mixed" in key and block is not None:
             _check_mixed(f"extra.{key}", block, problems)
+    if extra.get("serving_multihost") is not None:
+        _check_multihost("extra.serving_multihost",
+                         extra["serving_multihost"], problems)
     return problems
 
 

@@ -231,6 +231,73 @@ def test_mixed_error_leg_is_valid(schema):
     assert schema.validate_record(rec) == []
 
 
+def _multihost_rung(shards=2, tp=2, mode="int8", dcn=1152,
+                    ratio=3.55):
+    return {"shards": shards, "tp": tp, "dcn_collective": mode,
+            "toks_per_s": 120.0, "ici_bytes_per_step": 4096,
+            "dcn_bytes_per_step": dcn,
+            "dcn_bytes_ratio_vs_fp32": ratio}
+
+
+def _multihost_block():
+    return {"ladder": [
+        _multihost_rung(shards=1, tp=4, mode="bf16", dcn=0, ratio=None),
+        _multihost_rung(mode="bf16", dcn=4096, ratio=1.0),
+        _multihost_rung(mode="int8"),
+    ]}
+
+
+def test_multihost_block_valid(schema):
+    rec = _record()
+    rec["extra"]["serving_multihost"] = _multihost_block()
+    assert schema.validate_record(rec) == []
+    rec["extra"]["serving_multihost"] = {"error": "RESOURCE_EXHAUSTED"}
+    assert schema.validate_record(rec) == []
+
+
+def test_multihost_rung_required_keys_and_bounds(schema):
+    rec = _record()
+    mh = _multihost_block()
+    del mh["ladder"][2]["dcn_bytes_per_step"]
+    mh["ladder"][1]["toks_per_s"] = 0
+    rec["extra"]["serving_multihost"] = mh
+    probs = schema.validate_record(rec)
+    assert any("dcn_bytes_per_step" in p for p in probs)
+    assert any("toks_per_s" in p for p in probs)
+
+
+def test_multihost_int8_rung_must_show_3x(schema):
+    """The quantization claim is load-bearing: an int8 rung whose
+    recorded ratio is under 3x (or missing) fails validation."""
+    rec = _record()
+    mh = _multihost_block()
+    mh["ladder"][2]["dcn_bytes_ratio_vs_fp32"] = 2.3
+    rec["extra"]["serving_multihost"] = mh
+    assert any(">= 3x" in p for p in schema.validate_record(rec))
+    mh["ladder"][2]["dcn_bytes_ratio_vs_fp32"] = None
+    assert any(">= 3x" in p for p in schema.validate_record(rec))
+
+
+def test_multihost_multi_shard_rungs_need_ablation(schema):
+    """Multi-shard rungs with only one DCN mode recorded — the
+    quantized-vs-exact ablation never ran — are flagged."""
+    rec = _record()
+    mh = _multihost_block()
+    mh["ladder"] = [r for r in mh["ladder"]
+                    if r["dcn_collective"] == "int8" or r["shards"] == 1]
+    rec["extra"]["serving_multihost"] = mh
+    assert any("ablation" in p for p in schema.validate_record(rec))
+
+
+def test_multihost_multi_shard_rung_puts_bytes_on_dcn(schema):
+    rec = _record()
+    mh = _multihost_block()
+    mh["ladder"][2]["dcn_bytes_per_step"] = 0
+    rec["extra"]["serving_multihost"] = mh
+    probs = schema.validate_record(rec)
+    assert any("puts bytes on the DCN" in p for p in probs)
+
+
 def test_bench_out_if_present(schema):
     """Whatever BENCH_OUT.json the last bench run left behind must
     satisfy the schema (skips when no run has happened here)."""
@@ -253,6 +320,8 @@ def test_bench_main_emits_file_and_stdout_line(schema, tmp_path,
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
     monkeypatch.setattr(bench, "_measure", lambda *a, **k: 1000.0)
+    monkeypatch.setattr(bench, "_measure_serving_multihost",
+                        lambda *a, **k: _multihost_block())
     monkeypatch.chdir(tmp_path)
     bench.main()
     lines = capsys.readouterr().out.strip().splitlines()

@@ -233,6 +233,11 @@ def test_request_plane_e2e(params):
             "raytpu_serve_step_tokens_total",
             "raytpu_serve_kv_pages_free",
             "raytpu_serve_kv_pages_cached",
+            # Multi-host serving plane: the engine declares the
+            # per-link collective families even off-mesh, so the
+            # scrape never silently loses them.
+            "raytpu_serve_collective_bytes_total",
+            "raytpu_serve_collective_seconds",
         ]) == []
 
         # -- timeline: request rows, slot threads, globally ts-sorted -

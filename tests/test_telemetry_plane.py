@@ -231,7 +231,12 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
         text,
         require=["raytpu_serve_request_retries_total",
                  "raytpu_serve_replica_drains_total",
-                 "raytpu_serve_step_tokens_total"]) == []
+                 "raytpu_serve_step_tokens_total",
+                 # Multi-host serving plane: per-link collective
+                 # traffic + the shard-group membership gauge.
+                 "raytpu_serve_collective_bytes_total",
+                 "raytpu_serve_collective_seconds",
+                 "raytpu_serve_shard_group_members"]) == []
     assert cm.check_registry() == []
 
 
