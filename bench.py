@@ -118,7 +118,7 @@ PEAK_FLOPS = {
 }
 
 
-def _make_trainer(cfg, devices, optimizer=None):
+def _make_trainer(cfg, devices, optimizer=None, trainer_config=None):
     return JaxTrainer(
         init_params=lambda r: llama.init_params(r, cfg),
         loss_fn=lambda p, b: llama.loss_fn(p, b, cfg),
@@ -130,14 +130,22 @@ def _make_trainer(cfg, devices, optimizer=None):
             mesh_spec=MeshSpec(dp=1, fsdp=len(devices)), devices=devices
         ),
         run_config=RunConfig(report_every=1_000_000),
+        trainer_config=trainer_config,
     )
 
 
 def _measure(cfg, devices, *, steps: int, batch: int = None,
-             warmup: int = 2, optimizer=None) -> float:
-    """Tokens/sec of the jitted train step (post-warmup)."""
+             warmup: int = 2, optimizer=None, trainer_config=None,
+             extras: dict = None) -> float:
+    """Tokens/sec of the jitted train step (post-warmup).
+
+    ``extras`` (when a dict) receives the live trainer, so callers can
+    read the trained state's actual shardings afterwards (the 8B ZeRO
+    rung reports opt-state bytes/param straight from the arrays)."""
     batch = batch or BATCH
-    trainer = _make_trainer(cfg, devices, optimizer)
+    trainer = _make_trainer(cfg, devices, optimizer, trainer_config)
+    if extras is not None:
+        extras["trainer"] = trainer
     rng = np.random.default_rng(0)
 
     def batches():
@@ -499,19 +507,101 @@ def _measure_serving_mixed(cfg, *, n_requests: int = 48,
     return out
 
 
+def _measure_8b_train(peak_flops: float) -> dict:
+    """The MEASURED full-8B AdamW rung (no extrapolation, ever): all 32
+    layers, 128k vocab, bf16 master + int8 Adam states ZeRO-sharded
+    over the data axes (train/zero.py), gradient-accumulation
+    microbatching so activations fit.  On hardware without enough
+    aggregate HBM the rung reports a LOUD structured error with the
+    memory math — never a scaled number."""
+    from ray_tpu.train import TrainerConfig, adamw8bit
+    from ray_tpu.train import zero as zero_mod
+
+    devs = jax.devices()
+    n = len(devs)
+    cfg8t = llama.LlamaConfig(
+        vocab_size=128_256, dim=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, mlp_dim=14336, max_seq_len=SEQ,
+        param_dtype=jnp.bfloat16, remat_policy="full", loss_chunk=512,
+    )
+    n_params = cfg8t.num_params()
+    try:
+        hbm = (devs[0].memory_stats() or {}).get("bytes_limit")
+    except Exception:
+        hbm = None
+    if not hbm:
+        hbm = 16 * 2**30  # v5e-class floor when the backend won't say
+    # Per-chip plan, everything 1/n-sharded (params+grads over fsdp,
+    # int8 states over the zero axes): 2 B/param params + 2 B/param
+    # grad accumulator + ~2.1 B/param int8 states, plus ~2 GiB of
+    # transients (gathered layer weights, remat activations, CE chunk).
+    overhead = 2 * 2**30
+    need = int(6.1 * n_params / n) + overhead
+    if need > 0.92 * hbm:
+        per_chip_ok = 0.92 * hbm - overhead
+        min_chips = int(np.ceil(6.1 * n_params / max(per_chip_ok, 1)))
+        return {
+            "error": (f"full-8B AdamW needs ~{need / 2**30:.1f} GiB/chip "
+                      f"on {n} chip(s) of {hbm / 2**30:.0f} GiB HBM; "
+                      f"ZeRO-sharded it fits from {min_chips} chips"),
+            "zero_sharding": True,
+            "dp_shards": n,
+            "est_bytes_per_chip": need,
+            "hbm_bytes": int(hbm),
+            "min_chips": min_chips,
+        }
+    grad_accum = 4
+    batch = grad_accum * n
+    extras: dict = {}
+    tps = _measure(
+        cfg8t, devs, steps=3, batch=batch,
+        optimizer=adamw8bit(1e-4, warmup_steps=10, shard_update=True),
+        trainer_config=TrainerConfig(zero_sharding=True,
+                                     grad_accum=grad_accum),
+        extras=extras,
+    )
+    trainer = extras["trainer"]
+    bytes_ = zero_mod.opt_state_bytes(trainer.state.opt_state)
+    ds = zero_mod.dp_shards(trainer.mesh)
+    tps_chip = tps / n
+    hbm_peak = None
+    try:
+        peaks = [(d.memory_stats() or {}).get("peak_bytes_in_use")
+                 for d in devs]
+        peaks = [p for p in peaks if p]
+        hbm_peak = max(peaks) if peaks else None
+    except Exception:
+        pass
+    return {
+        "params_b": round(n_params / 1e9, 2),
+        "measured": True,
+        "tokens_per_sec_per_chip": round(tps_chip, 1),
+        "mfu": round(tps_chip * 6 * n_params / peak_flops, 4),
+        "zero_sharding": True,
+        "dp_shards": ds,
+        "grad_accum": grad_accum,
+        "batch": batch,
+        "seq": SEQ,
+        "optimizer": "adamw8bit (int8 states, ZeRO-sharded)",
+        "opt_state_bytes_per_param": round(
+            bytes_["per_device"] / n_params, 4),
+        "opt_state_bytes_per_device": bytes_["per_device"],
+        "hbm_peak_gb": (round(hbm_peak / 2**30, 2)
+                        if hbm_peak else None),
+    }
+
+
 def _measure_8b(peak_flops: float) -> dict:
-    """North-star #3: the 8B story on ONE v5e chip.
+    """North-star #3: the 8B story.
 
     * SERVING (measured): int8 weight-only quantized 8B (≈8.3 GB)
       fits 16 GB HBM next to a paged bf16 KV cache; decode tok/s and
       TTFT measured through the real engine.
-    * TRAIN (extrapolated): a depth-truncated 8B-dim model's measured
-      step time, scaled linearly in layer count — per-layer cost is
-      depth-independent, so tokens/sec/chip_full ≈ measured × (meas
-      layers + head share) / (32 + head share).  Full-8B bf16 training
-      does NOT fit one 16 GB v5e (AdamW states alone ≈ 48 GB); the
-      extrapolation is the honest per-chip number a v5p-class part
-      (95 GB HBM) would realize, modulo its higher peak FLOPs.
+    * TRAIN (measured): full 32-layer AdamW with int8 Adam states
+      ZeRO-sharded over the data axes (_measure_8b_train) — the rung
+      that replaced the retired depth-truncated extrapolation; when
+      the hardware can't hold it, the record says so in an error block
+      with the memory math instead of scaling a smaller measurement.
     """
     from ray_tpu.models import quant
 
@@ -543,44 +633,13 @@ def _measure_8b(peak_flops: float) -> dict:
     out["serving_int8"] = serving
     del qparams, serving
 
-    # Train extrapolation: 4 of 32 layers at full 8B width, bf16 +
-    # remat + chunked CE, batch 1 × seq 2048.
-    meas_layers = 4
-    # 32k vocab for the measurement (the 8B vocab's AdamW states alone
-    # would not fit next to 4 full-width layers on 16 GB); the head
-    # share of the extrapolation is rescaled to the real 128k vocab.
-    cfg_trunc = llama.LlamaConfig(
-        vocab_size=32_768, dim=4096, n_heads=32, n_kv_heads=8,
-        mlp_dim=14336, max_seq_len=2048, n_layers=meas_layers,
-        param_dtype=jnp.bfloat16, remat_policy="full", loss_chunk=512,
-    )
+    # Full-8B measured train rung (ZeRO-sharded int8 Adam states).
     try:
-        tps_trunc = _measure(cfg_trunc, jax.devices(), steps=3, batch=1)
-        # Embed+head flops are depth-independent; layers scale with
-        # depth, the head share with vocab.
-        flops_layer = 6 * (cfg_trunc.num_params()
-                           - 2 * cfg_trunc.vocab_size * cfg_trunc.dim) \
-            / meas_layers
-        flops_fixed = 6 * 2 * cfg_trunc.vocab_size * cfg_trunc.dim
-        t_per_tok = 1.0 / tps_trunc
-        t_fixed = t_per_tok * flops_fixed / (flops_fixed
-                                             + meas_layers * flops_layer)
-        t_layer = (t_per_tok - t_fixed) / meas_layers
-        t_full = t_fixed * (cfg8.vocab_size / cfg_trunc.vocab_size) \
-            + 32 * t_layer
-        tps_full = 1.0 / t_full
-        out["train_extrapolated"] = {
-            "measured_layers": meas_layers,
-            "measured_tokens_per_sec_per_chip": round(tps_trunc, 1),
-            "extrapolated_full_tokens_per_sec_per_chip": round(tps_full, 1),
-            "extrapolated_mfu": round(
-                tps_full * 6 * cfg8.num_params() / peak_flops, 4),
-            "note": ("full-8B AdamW states need ~48 GB — runs on "
-                     "v5p-class HBM; number is this chip's per-layer "
-                     "cost scaled to 32 layers"),
-        }
+        out["train"] = _measure_8b_train(peak_flops)
     except Exception as e:
-        out["train_extrapolated"] = {"error": repr(e)[:120]}
+        out["train"] = {"error": repr(e).replace(": ", ":")
+                        .replace(", ", ",")[:160],
+                        "zero_sharding": True}
     return out
 
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_tpu.parallel.collectives import axis_size
 from ray_tpu.parallel.mesh import shard_map_unchecked
 
 from ray_tpu.ops.flash_attention import (
@@ -48,7 +49,7 @@ NEG_INF = -1e30
 
 
 def _rotate(x, axis_name: str):
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     return lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
 
 
@@ -65,7 +66,7 @@ def _merge(o_a, lse_a, o_b, lse_b):
 
 def _ring_fwd_local(q, k, v, *, axis_name, block_q, block_kv):
     """Per-device fwd. q/k/v [B,H,Sl,D] (local chunks) → (o, lse)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
 
@@ -89,7 +90,7 @@ def _ring_fwd_local(q, k, v, *, axis_name, block_q, block_kv):
 
 def _ring_bwd_local(q, k, v, o, lse, do, *, axis_name, block_q, block_kv):
     """Per-device bwd ring pass → (dq, dk, dv) for the local chunks."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
     H = q.shape[1]

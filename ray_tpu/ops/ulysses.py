@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_tpu.parallel.collectives import axis_size
 from ray_tpu.parallel.mesh import shard_map_unchecked
 
 
@@ -57,7 +58,7 @@ def ulysses_attention_local(
     """
     from ray_tpu.ops.attention import dot_product_attention
 
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     H = q.shape[2]
     KVH = k.shape[2]
     if H % n:

@@ -55,7 +55,7 @@ def reducescatter(x: jax.Array, axis: AxisName, *, scatter_axis: int = 0,
     out = lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_axis,
                            tiled=True)
     if op == "mean":
-        out = out / lax.axis_size(axis)
+        out = out / axis_size(axis)
     return out
 
 
@@ -78,7 +78,7 @@ def permute(x: jax.Array, axis: AxisName, perm: Sequence[tuple]) -> jax.Array:
 
 def shift(x: jax.Array, axis: AxisName, offset: int = 1) -> jax.Array:
     """Ring shift by ``offset`` (the ring-attention building block)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
@@ -94,7 +94,18 @@ def axis_index(axis: AxisName) -> jax.Array:
 
 
 def axis_size(axis: AxisName) -> int:
-    return lax.axis_size(axis)
+    """Concrete size of a named mesh axis inside shard_map.  Falls back
+    to ``core.axis_frame`` (which returns the concrete int the
+    enclosing shard_map bound) on jax builds without ``lax.axis_size``."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    from jax import core
+
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    n = 1
+    for name in names:
+        n *= core.axis_frame(name)
+    return n
 
 
 # --- quantized DCN collectives ---------------------------------------------
@@ -174,6 +185,28 @@ def allreduce_wire_bytes(n_elements: int, *, axis_size: int,
         return n_elements * itemsize * peers
     n_chunks = -(-n_elements // chunk)
     return (n_chunks * chunk * 1 + n_chunks * 4) * peers
+
+
+def reducescatter_wire_bytes(n_elements: int, *, axis_size: int,
+                             itemsize: int = 4) -> int:
+    """Bytes one member puts on the link per reduce-scatter of
+    ``n_elements``: each member ends with n/k elements, exchanging its
+    k-1 foreign shards.  Same accounting family as
+    ``allreduce_wire_bytes`` (per-member payload, analytic), which is
+    what makes the ZeRO dryrun's RS-vs-AR comparison apples-to-apples:
+    reduce-scatter + all-gather each cost (n/k)*(k-1) where the
+    all-reduce costs n*(k-1)."""
+    if axis_size <= 1 or n_elements <= 0:
+        return 0
+    return (n_elements // axis_size) * itemsize * (axis_size - 1)
+
+
+def allgather_wire_bytes(n_elements: int, *, axis_size: int,
+                         itemsize: int = 4) -> int:
+    """Bytes one member puts on the link per all-gather producing
+    ``n_elements``: it sends its n/k shard to the k-1 peers."""
+    return reducescatter_wire_bytes(n_elements, axis_size=axis_size,
+                                    itemsize=itemsize)
 
 
 class CollectiveGroup:

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu.parallel.collectives import axis_size
 from ray_tpu.parallel.mesh import shard_map_unchecked
 
 
@@ -52,7 +53,7 @@ def stage_param_sharding(mesh: Mesh, params: Any, axis: str = "pp") -> Any:
 
 
 def _shift_next(x: jax.Array, axis_name: str) -> jax.Array:
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     return lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
 
 

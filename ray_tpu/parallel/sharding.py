@@ -37,6 +37,9 @@ DEFAULT_RULES: Rules = {
     "layers": None,       # used by scan-stacked params; pp handles stages
     # state-space models
     "state": None,
+    # ZeRO weight-update sharding (train/zero.py): the axes optimizer
+    # state and the fused update shard over.
+    "zero": ("dp", "fsdp"),
 }
 
 # Hybrid DCN×ICI meshes: when the target mesh carries a dcn_* axis,
@@ -128,22 +131,50 @@ def constrain(x: jax.Array, logical_axes: Sequence[Optional[str]],
     single-device (e.g. unit tests, one-chip serving).
 
     Under ``with mesh:`` (the trainer's idiom) only the *physical*
-    thread-resources mesh is populated — jax.sharding.get_abstract_mesh()
-    stays empty — so a bare-PartitionSpec constraint would either raise
-    or be dropped; bind the spec to the concrete mesh instead."""
-    abstract = jax.sharding.get_abstract_mesh()
-    if not abstract.empty:
-        spec = spec_for(logical_axes, rules,
-                        mesh_axes=frozenset(abstract.axis_names))
-        return jax.lax.with_sharding_constraint(x, spec)
-    from jax._src import mesh as _mesh_lib
-
-    physical = _mesh_lib.thread_resources.env.physical_mesh
-    if physical.empty:
+    thread-resources mesh is populated — the abstract mesh stays empty —
+    so a bare-PartitionSpec constraint would either raise or be
+    dropped; bind the spec to the concrete mesh instead.
+    ``current_mesh`` resolves either kind (with a fallback for jax
+    builds without ``jax.sharding.get_abstract_mesh``)."""
+    mesh = current_mesh()
+    if mesh is None:
         return x
     spec = spec_for(logical_axes, rules,
-                    mesh_axes=frozenset(physical.axis_names))
-    return jax.lax.with_sharding_constraint(x, NamedSharding(physical, spec))
+                    mesh_axes=frozenset(mesh.axis_names))
+    if isinstance(mesh, Mesh):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def current_mesh():
+    """The mesh enclosing the current trace — the abstract mesh when one
+    is set, else the thread-resources physical mesh (the trainer's
+    ``with mesh:`` idiom), else None.  Lets traced code adapt its
+    sharding constraints to whatever mesh it is being partitioned for
+    (see train/optim8.py's ZeRO block constraints)."""
+    from jax._src import mesh as _mesh_lib
+
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None) \
+        or getattr(_mesh_lib, "get_abstract_mesh", None)
+    if get_abstract is not None:
+        abstract = get_abstract()
+        # Older jax returns the raw context value — ``()`` when no
+        # abstract mesh is set — instead of an empty AbstractMesh.
+        if getattr(abstract, "empty", True) is False:
+            return abstract
+    physical = _mesh_lib.thread_resources.env.physical_mesh
+    return None if physical.empty else physical
+
+
+def constrain_to_spec(x: jax.Array, spec: P) -> jax.Array:
+    """with_sharding_constraint against the current mesh (abstract or
+    physical); no-op outside any mesh context."""
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    if isinstance(mesh, Mesh):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 def shard_tree(mesh: Mesh, tree: Any, logical_tree: Any,

@@ -13,7 +13,14 @@ from ray_tpu.train.session import (
     report,
 )
 from ray_tpu.train.step import compile_train_step, make_train_step
-from ray_tpu.train.trainer import JaxTrainer, Result, RunConfig, ScalingConfig
+from ray_tpu.train.trainer import (
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+    TrainerConfig,
+)
+from ray_tpu.train import zero
 from ray_tpu.train.backend import JaxBackendConfig, JaxDistributedBackend
 from ray_tpu.train.worker_group import (
     BackendExecutor,
@@ -37,7 +44,9 @@ __all__ = [
     "TrainContext",
     "TrainOutput",
     "TrainState",
+    "TrainerConfig",
     "WorkerGroup",
+    "zero",
     "compile_train_step",
     "create_train_state",
     "adamw8bit",

@@ -46,9 +46,39 @@ class ScaleByAdam8State(NamedTuple):
     nu: Any
 
 
+def _constrain_blocks(x: jax.Array, dim: int = 0) -> jax.Array:
+    """Pin the block dim of an int8-Adam buffer to the ZeRO shard axes
+    of whatever mesh encloses the trace (train/zero.py's layout), so
+    the partitioner keeps the blockwise update local to each shard
+    instead of gathering state — the reduce-scatter → local-update →
+    all-gather pattern of arXiv 2004.13336.  No-op outside a mesh or
+    when the block count doesn't divide the shard axes."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.sharding import constrain_to_spec, current_mesh
+    from ray_tpu.train import zero as zero_mod
+
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    ax = zero_mod.shardable_prefix(
+        x.shape[dim], zero_mod.zero_axes(mesh), mesh)
+    if not ax:
+        return x
+    entries = [None] * x.ndim
+    entries[dim] = ax[0] if len(ax) == 1 else ax
+    return constrain_to_spec(x, P(*entries))
+
+
 def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.95,
-                      eps: float = 1e-8) -> optax.GradientTransformation:
-    """Adam moment tracking with int8 block-quantized mu/nu."""
+                      eps: float = 1e-8, *, shard_update: bool = False
+                      ) -> optax.GradientTransformation:
+    """Adam moment tracking with int8 block-quantized mu/nu.
+
+    ``shard_update=True`` adds ZeRO sharding constraints on the block
+    dim of every buffer entering/leaving the fused update (grads in
+    block space, the segment-stacked m/v, and their replacements), for
+    use with ``TrainerConfig(zero_sharding=True)``."""
 
     def init(params):
         q0 = lambda p: _quantize(jnp.zeros(p.shape, jnp.float32))
@@ -72,6 +102,8 @@ def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.95,
             nb = mq[0].shape[0]
             pad = nb * BLOCK - math.prod(shape)
             gb = jnp.pad(g.reshape(-1), (0, pad)).reshape(nb, BLOCK)
+            if shard_update:
+                gb = _constrain_blocks(gb)
             nseg = min(16, nb)
             segp = (-nb) % nseg
             def seg(args):
@@ -111,7 +143,13 @@ def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.95,
 
             args = tuple(segify(a) for a in
                          (gb, mq[0], mq[1], nq[0], nq[1]))
+            if shard_update:
+                args = tuple(_constrain_blocks(a, dim=1) for a in args)
             out, mq2, ms2, nq2, ns2 = jax.lax.map(seg, args)
+            if shard_update:
+                mq2, ms2, nq2, ns2 = (
+                    _constrain_blocks(a, dim=1)
+                    for a in (mq2, ms2, nq2, ns2))
             out = out.reshape(-1)[: math.prod(shape)].reshape(shape)
 
             def unseg(x):
@@ -143,9 +181,11 @@ def adamw8bit(
     grad_clip: float = 1.0,
     warmup_steps: int = 100,
     total_steps: Optional[int] = None,
+    shard_update: bool = False,
 ) -> optax.GradientTransformation:
     """AdamW with 8-bit states + the same schedule/clipping wrapping as
-    train.default_optimizer."""
+    train.default_optimizer.  ``shard_update=True`` enables the ZeRO
+    block-dim sharding constraints (see scale_by_adam8bit)."""
     if total_steps:
         schedule = optax.warmup_cosine_decay_schedule(
             0.0, learning_rate, warmup_steps,
@@ -156,7 +196,8 @@ def adamw8bit(
     parts = []
     if grad_clip:
         parts.append(optax.clip_by_global_norm(grad_clip))
-    parts.append(scale_by_adam8bit(b1=b1, b2=b2, eps=eps))
+    parts.append(scale_by_adam8bit(b1=b1, b2=b2, eps=eps,
+                                   shard_update=shard_update))
     if weight_decay:
         parts.append(optax.add_decayed_weights(weight_decay))
     parts.append(optax.scale_by_learning_rate(schedule))

@@ -77,7 +77,17 @@ def state_shardings(
     state: TrainState,
     params_axes: Any,
     rules: Optional[Rules] = None,
+    *,
+    zero: bool = False,
 ) -> TrainState:
+    """Shardings for a whole TrainState.  ``zero=True`` switches to the
+    ZeRO layout (train/zero.py): optimizer state — including optim8's
+    int8 (q, scale) blockwise leaves, which the mirror-structure check
+    below can only replicate — shards over the data axes."""
+    if zero:
+        from ray_tpu.train.zero import zero_state_shardings
+
+        return zero_state_shardings(mesh, state, params_axes, rules)
     axes = state_logical_axes(state, params_axes)
     return jax.tree.map(
         lambda a: tree_shardings(mesh, a, rules),

@@ -88,17 +88,58 @@ LossFn = Callable[[Any, Dict[str, jax.Array]], Tuple[jax.Array, Dict[str, jax.Ar
 def make_train_step(
     loss_fn: LossFn,
     tx: optax.GradientTransformation,
+    *,
+    grad_accum: int = 1,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict[str, jax.Array]]]:
-    """Returns step(state, batch) -> (state, metrics). Pure; jit outside."""
+    """Returns step(state, batch) -> (state, metrics). Pure; jit outside.
+
+    ``grad_accum > 1`` scans the batch as that many microbatches along
+    the leading dim, accumulating grads before the single optimizer
+    update — same math (mean-of-means for equal microbatches), 1/k the
+    activation memory, which is what lets a full-8B step fit.
+    """
+
+    def _grads(state, batch):
+        if grad_accum <= 1:
+            return jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch)
+
+        def split(x):
+            if x.shape[0] % grad_accum:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by "
+                    f"grad_accum={grad_accum}")
+            return x.reshape(grad_accum, x.shape[0] // grad_accum,
+                             *x.shape[1:])
+
+        micro = jax.tree.map(split, batch)
+
+        def body(carry, mb):
+            loss_sum, gsum = carry
+            (l, a), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, mb)
+            return (loss_sum + l.astype(jnp.float32),
+                    jax.tree.map(jnp.add, gsum, g)), a
+
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype),
+                             state.params)
+        (loss_sum, gsum), auxs = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros), micro)
+        loss = loss_sum / grad_accum
+        grads = jax.tree.map(lambda g: g / grad_accum, gsum)
+        aux = jax.tree.map(lambda x: x[-1], auxs)
+        return (loss, aux), grads
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch
-        )
+        (loss, aux), grads = _grads(state, batch)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
-        metrics = {"loss": loss, "grad_norm": gnorm, "step": state.step, **aux}
+        # Canonical keys win over aux duplicates: under grad_accum the
+        # aux rides from the last microbatch only, while ``loss`` is
+        # the mean over all of them.
+        metrics = {**aux, "loss": loss, "grad_norm": gnorm,
+                   "step": state.step}
         return (
             TrainState(state.step + 1, new_params, new_opt_state),
             metrics,
@@ -115,13 +156,23 @@ def compile_train_step(
     params_axes: Any,
     batch_axes: Dict[str, Tuple[Optional[str], ...]],
     rules: Optional[Rules] = None,
+    *,
+    zero_sharding: bool = False,
+    grad_accum: int = 1,
 ):
     """Jit the step with explicit in/out shardings over ``mesh``.
 
+    ``zero_sharding=True`` pins the optimizer state to the ZeRO layout
+    (train/zero.py) in BOTH in_ and out_shardings — the state stays
+    donation-safe (matched layouts), and forcing the update's outputs
+    sharded is what makes GSPMD reduce-scatter the grads instead of
+    all-reducing them.
+
     Returns (jitted_step, state_shardings_tree, batch_shardings_tree).
     """
-    step = make_train_step(loss_fn, tx)
-    st_sh = state_shardings(mesh, state, params_axes, rules)
+    step = make_train_step(loss_fn, tx, grad_accum=grad_accum)
+    st_sh = state_shardings(mesh, state, params_axes, rules,
+                            zero=zero_sharding)
     batch_sh = {k: tree_shardings(mesh, v, rules) for k, v in batch_axes.items()}
     jitted = jax.jit(
         step,

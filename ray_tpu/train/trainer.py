@@ -63,6 +63,20 @@ def _telemetry():
                 "raytpu_train_checkpoints_total",
                 "Checkpoints written by the trainer.",
             ),
+            "opt_bytes": metrics.Gauge(
+                "raytpu_train_opt_state_bytes",
+                "Optimizer-state footprint from the arrays' shardings: "
+                "scope=global across the mesh, scope=per_device resident "
+                "on one device (~global/dp under ZeRO sharding).",
+                tag_keys=("scope",),
+            ),
+            "hbm_headroom": metrics.Gauge(
+                "raytpu_train_hbm_headroom_bytes",
+                "Per-device HBM left above the peak watermark "
+                "(bytes_limit - peak_bytes_in_use), sampled on report "
+                "steps; absent on backends without memory_stats (CPU).",
+                tag_keys=("device",),
+            ),
         }
     else:
         reg = metrics.registry()
@@ -77,6 +91,20 @@ class ScalingConfig:
 
     mesh_spec: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     devices: Optional[list] = None  # default: all
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerConfig:
+    """Step-program options.
+
+    ``zero_sharding`` shards the optimizer state (and the weight
+    update) across the data axes, ZeRO-style — grads reduce-scatter,
+    each replica updates 1/dp of the blocks, params all-gather back
+    (train/zero.py).  ``grad_accum`` scans each batch as that many
+    microbatches before the single update (train/step.py)."""
+
+    zero_sharding: bool = False
+    grad_accum: int = 1
 
 
 @dataclasses.dataclass
@@ -111,6 +139,7 @@ class JaxTrainer:
         optimizer=None,
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
+        trainer_config: Optional[TrainerConfig] = None,
         rules: Optional[Rules] = None,
         seed: int = 0,
     ):
@@ -121,6 +150,7 @@ class JaxTrainer:
         self.tx = optimizer or default_optimizer()
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        self.trainer_config = trainer_config or TrainerConfig()
         self.rules = rules
         self.seed = seed
 
@@ -143,6 +173,8 @@ class JaxTrainer:
             self._step_fn, self._state_sh, self._batch_sh = compile_train_step(
                 self.mesh, self.loss_fn, self.tx, abstract, self.params_axes,
                 self.batch_axes, self.rules,
+                zero_sharding=self.trainer_config.zero_sharding,
+                grad_accum=self.trainer_config.grad_accum,
             )
             # Init params *directly sharded* — no host-memory full copy, so
             # 70B-scale states can initialize on the mesh.
@@ -151,6 +183,29 @@ class JaxTrainer:
                 out_shardings=self._state_sh,
             )
             self._state = init(rng)
+        self._emit_memory_gauges()
+
+    def _emit_memory_gauges(self):
+        """Opt-state footprint from the live arrays' shardings, plus
+        per-device HBM headroom (absent-not-zero on CPU backends)."""
+        from ray_tpu.train import zero as zero_mod
+
+        tm = _telemetry()
+        b = zero_mod.opt_state_bytes(self._state.opt_state)
+        tm["opt_bytes"].set(b["global"], tags={"scope": "global"})
+        tm["opt_bytes"].set(b["per_device"], tags={"scope": "per_device"})
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                return
+            if not stats or "bytes_limit" not in stats:
+                continue
+            peak = stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use", 0))
+            tm["hbm_headroom"].set(
+                stats["bytes_limit"] - peak,
+                tags={"device": f"{d.platform}:{d.id}"})
 
     @property
     def state(self) -> TrainState:
@@ -223,6 +278,7 @@ class JaxTrainer:
                             # Shared device-plane sampler (TPU/GPU HBM
                             # watermarks; absent on CPU backends).
                             xprof.sample_device_memory()
+                            self._emit_memory_gauges()
                             if report:
                                 report(m)
                         if ckpt and rc.checkpoint_every \

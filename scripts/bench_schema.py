@@ -39,7 +39,13 @@ Checked:
   * prefix-cache blocks (a serving block's ``prefix``, reported by the
     zipf_chat mix): hit ratios in [0, 1], cold/hit50 request counts,
     and TTFT-by-hit-depth fields that are numeric or honestly null
-    (null only when that depth class saw no requests).
+    (null only when that depth class saw no requests);
+  * the full-8B train rung (extra.llama_8b.train): must be MEASURED
+    (measured=true, numeric mfu/toks in (0, 1]/(0, inf)), carry
+    zero_sharding=true + dp_shards, and satisfy the memory claim
+    opt_state_bytes_per_param <= 2.5/dp_shards.  A lingering
+    ``train_extrapolated`` key anywhere under llama_8b is a violation:
+    that path is retired.
 
 Usage:
     python scripts/bench_schema.py BENCH_OUT.json
@@ -266,6 +272,59 @@ def _check_multihost(name: str, d: Any, problems: List[str]) -> None:
             f"ablation, found only {sorted(modes)}")
 
 
+ZERO_TRAIN_REQUIRED = ("params_b", "measured", "tokens_per_sec_per_chip",
+                       "mfu", "zero_sharding", "dp_shards", "grad_accum",
+                       "optimizer", "opt_state_bytes_per_param")
+
+
+def _check_zero(name: str, d: Any, problems: List[str]) -> None:
+    """The full-8B train rung: MEASURED end-to-end with ZeRO-sharded
+    optimizer state, never extrapolated from a layer subset.  The
+    memory claim is load-bearing — int8 Adam states cost ~2 B/param,
+    so a rung sharded ``dp_shards`` ways must report
+    opt_state_bytes_per_param <= 2.5/dp_shards or it never actually
+    sharded the state it says it did."""
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    if "error" in d:  # bench leg infeasible/failed; the record says so
+        return
+    for k in ZERO_TRAIN_REQUIRED:
+        if k not in d:
+            problems.append(f"{name}: missing required key {k!r}")
+    if "measured" in d and d["measured"] is not True:
+        problems.append(
+            f"{name}: measured={d['measured']!r} — the extrapolated 8B "
+            f"train path is retired; only measured rungs may land")
+    if "zero_sharding" in d and d["zero_sharding"] is not True:
+        problems.append(
+            f"{name}: zero_sharding={d['zero_sharding']!r} — the full-8B "
+            f"rung only fits with the optimizer state sharded")
+    shards = d.get("dp_shards")
+    if "dp_shards" in d and not (_num(shards) and shards >= 1):
+        problems.append(f"{name}: dp_shards={shards!r} must be a "
+                        f"number >= 1")
+    for k in ("tokens_per_sec_per_chip", "mfu"):
+        if k in d and not (_num(d[k]) and d[k] > 0):
+            problems.append(f"{name}: {k}={d.get(k)!r} must be a "
+                            f"number > 0")
+    mfu = d.get("mfu")
+    if _num(mfu) and mfu > 1.0:
+        problems.append(f"{name}: mfu={mfu!r} > 1 — not a fraction of "
+                        f"peak; this is not a measurement")
+    bpp = d.get("opt_state_bytes_per_param")
+    if "opt_state_bytes_per_param" in d and not (_num(bpp) and bpp > 0):
+        problems.append(f"{name}: opt_state_bytes_per_param={bpp!r} "
+                        f"must be a number > 0")
+    elif _num(bpp) and _num(shards) and shards >= 1 \
+            and bpp > 2.5 / shards + 1e-9:
+        problems.append(
+            f"{name}: opt_state_bytes_per_param={bpp:.4f} exceeds "
+            f"2.5/dp_shards={2.5 / shards:.4f} — int8 Adam states "
+            f"sharded {int(shards)} ways cost ~2/dp_shards B/param; "
+            f"this rung kept replicated state")
+
+
 def _check_mixed(name: str, d: Any, problems: List[str]) -> None:
     """A mixed-length ladder block: one serving record per prompt mix,
     each carrying the distribution that produced its knee."""
@@ -316,6 +375,20 @@ def validate_record(rec: Any) -> List[str]:
     if isinstance(b8, dict) and b8.get("serving_int8") is not None:
         _check_serving("extra.llama_8b.serving_int8",
                        b8["serving_int8"], problems)
+    if isinstance(b8, dict):
+        if "train_extrapolated" in b8:
+            problems.append(
+                "extra.llama_8b.train_extrapolated: the extrapolated "
+                "8B train path is retired — re-run bench.py for the "
+                "measured ZeRO-sharded 'train' rung")
+        if "error" not in b8:
+            if "train" not in b8:
+                problems.append(
+                    "extra.llama_8b: missing the measured 'train' rung "
+                    "(full-8B AdamW, ZeRO-sharded)")
+            else:
+                _check_zero("extra.llama_8b.train", b8["train"],
+                            problems)
     for key, block in extra.items():
         if "serving" in key and "mixed" in key and block is not None:
             _check_mixed(f"extra.{key}", block, problems)

@@ -332,6 +332,122 @@ def test_bench_main_emits_file_and_stdout_line(schema, tmp_path,
     assert schema.validate_record(rec) == []
 
 
+# --- the measured full-8B ZeRO train rung ----------------------------------
+
+
+def _zero_train(shards=4):
+    return {"params_b": 8.03, "measured": True,
+            "tokens_per_sec_per_chip": 520.0, "mfu": 0.31,
+            "zero_sharding": True, "dp_shards": shards, "grad_accum": 4,
+            "batch": 4 * shards, "seq": 2048,
+            "optimizer": "adamw8bit (int8 states, ZeRO-sharded)",
+            "opt_state_bytes_per_param": 2.03 / shards,
+            "opt_state_bytes_per_device": 4_075_000_000 // shards,
+            "hbm_peak_gb": 11.2}
+
+
+def _rec_8b(train):
+    rec = _record()
+    rec["extra"]["llama_8b"] = {"params_b": 8.03, "train": train}
+    return rec
+
+
+def test_zero_train_rung_valid(schema):
+    assert schema.validate_record(_rec_8b(_zero_train())) == []
+
+
+def test_zero_train_error_rung_valid(schema):
+    err = {"error": "full-8B AdamW needs ~51.7 GiB/chip on 1 chip(s)",
+           "zero_sharding": True, "dp_shards": 1, "min_chips": 4}
+    assert schema.validate_record(_rec_8b(err)) == []
+    rec = _record()
+    rec["extra"]["llama_8b"] = {"error": "RESOURCE_EXHAUSTED"}
+    assert schema.validate_record(rec) == []
+
+
+def test_extrapolated_8b_train_is_retired(schema):
+    """A lingering train_extrapolated block — the pre-ZeRO path that
+    modeled 32 layers from a 4-layer run — fails validation outright."""
+    rec = _rec_8b(_zero_train())
+    rec["extra"]["llama_8b"]["train_extrapolated"] = {
+        "extrapolated_mfu": 0.45}
+    probs = schema.validate_record(rec)
+    assert any("train_extrapolated" in p and "retired" in p
+               for p in probs)
+
+
+def test_llama_8b_without_train_rung_is_flagged(schema):
+    rec = _record()
+    rec["extra"]["llama_8b"] = {"params_b": 8.03}
+    probs = schema.validate_record(rec)
+    assert any("missing the measured 'train' rung" in p for p in probs)
+
+
+def test_zero_train_must_be_measured_and_sharded(schema):
+    tr = _zero_train()
+    tr["measured"] = False
+    probs = schema.validate_record(_rec_8b(tr))
+    assert any("measured=False" in p and "retired" in p for p in probs)
+    tr = _zero_train()
+    tr["zero_sharding"] = False
+    probs = schema.validate_record(_rec_8b(tr))
+    assert any("zero_sharding=False" in p for p in probs)
+
+
+def test_zero_train_memory_claim_is_checked(schema):
+    """opt_state_bytes_per_param must shrink with dp_shards: a rung
+    claiming 4-way sharding while reporting ~2 B/param kept its state
+    replicated and fails."""
+    tr = _zero_train(shards=4)
+    tr["opt_state_bytes_per_param"] = 2.03  # replicated footprint
+    probs = schema.validate_record(_rec_8b(tr))
+    assert any("exceeds" in p and "2.5/dp_shards" in p for p in probs)
+    tr["opt_state_bytes_per_param"] = 0.6  # <= 2.5/4
+    assert schema.validate_record(_rec_8b(tr)) == []
+
+
+def test_zero_train_mfu_bounds(schema):
+    tr = _zero_train()
+    tr["mfu"] = 1.7
+    probs = schema.validate_record(_rec_8b(tr))
+    assert any("mfu=1.7" in p for p in probs)
+    tr["mfu"] = None
+    probs = schema.validate_record(_rec_8b(tr))
+    assert any("mfu=None" in p for p in probs)
+
+
+def test_tables_refuse_extrapolated_8b_record(tables):
+    rec = _record()
+    rec["extra"]["llama_8b"] = {
+        "train_extrapolated": {"extrapolated_mfu": 0.45}}
+    with pytest.raises(SystemExit, match="retired"):
+        tables.render(rec)
+
+
+def test_tables_refuse_8b_record_without_train_rung(tables):
+    rec = _record()
+    rec["extra"]["llama_8b"] = {"params_b": 8.03}
+    with pytest.raises(SystemExit, match="no measured 'train' rung"):
+        tables.render(rec)
+
+
+def test_tables_render_measured_8b_train_row(tables):
+    block = tables.render(_rec_8b(_zero_train()))
+    row = next(l for l in block.splitlines()
+               if "Llama-3-8B" in l and "MEASURED" in l)
+    assert "ZeRO-sharded 4x" in row
+    assert "0.5k" in row and "0.31" in row
+
+
+def test_tables_render_infeasible_8b_train_row(tables):
+    """An honest infeasibility record (too few chips even sharded)
+    renders an empty row that says why, instead of vanishing."""
+    block = tables.render(_rec_8b(
+        {"error": "needs ~51.7 GiB/chip", "zero_sharding": True}))
+    row = next(l for l in block.splitlines() if "Llama-3-8B" in l)
+    assert "infeasible" in row and "| — | — |" in row
+
+
 # --- gen_perf_tables damaged-record recovery -------------------------------
 
 

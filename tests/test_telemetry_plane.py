@@ -221,6 +221,16 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
     assert _sample_value(text, "raytpu_data_output_rows_total") == 64
     assert _sample_value(text, "raytpu_train_steps_total") == 2
     assert _sample_value(text, "raytpu_train_compile_seconds_total") > 0
+    # Memory plane: opt-state footprint is derived from the arrays'
+    # shardings so it exports real bytes even on CPU; the HBM-headroom
+    # gauge follows the absent-not-zero rule (declared family, zero
+    # samples on backends without memory_stats).
+    assert _sample_value(
+        text, 'raytpu_train_opt_state_bytes{scope="global"}') > 0
+    assert _sample_value(
+        text, 'raytpu_train_opt_state_bytes{scope="per_device"}') > 0
+    assert not [l for l in text.splitlines()
+                if l.startswith("raytpu_train_hbm_headroom_bytes{")]
 
     # The smoke check passes over the full live exposition, and the
     # fault-tolerance families are pinned: a serve session must always
@@ -236,7 +246,11 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
                  # traffic + the shard-group membership gauge.
                  "raytpu_serve_collective_bytes_total",
                  "raytpu_serve_collective_seconds",
-                 "raytpu_serve_shard_group_members"]) == []
+                 "raytpu_serve_shard_group_members",
+                 # ZeRO memory plane: opt-state footprint + per-device
+                 # HBM headroom (the latter absent-not-zero on CPU).
+                 "raytpu_train_opt_state_bytes",
+                 "raytpu_train_hbm_headroom_bytes"]) == []
     assert cm.check_registry() == []
 
 

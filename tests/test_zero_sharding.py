@@ -1,0 +1,241 @@
+"""ZeRO-style weight-update sharding (train/zero.py + TrainerConfig).
+
+The contract of arXiv 2004.13336 as this repo implements it: flipping
+``TrainerConfig(zero_sharding=True)`` must change WHERE the optimizer
+state lives (1/dp of it per replica) without changing WHAT the update
+computes — parity with the replicated layout on the same data, for the
+fp32 default optimizer AND the int8 blockwise one.  The dp-sharded
+state must also survive a checkpoint round-trip and the PR-5 worker
+failure harness (steps exactly-once across a real actor death)."""
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_tpu.models import llama
+from ray_tpu.models.llama import LLAMA_TINY
+from ray_tpu.parallel import MeshSpec
+from ray_tpu.train import (
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    TrainerConfig,
+    adamw8bit,
+    default_optimizer,
+    zero,
+)
+
+CFG = LLAMA_TINY
+
+
+def _batches(batch=8, seq=32, seed=0):
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {"tokens": rng.integers(0, CFG.vocab_size,
+                                      (batch, seq)).astype(np.int32)}
+
+
+def _trainer(optimizer, *, zero_sharding, mesh=None, devices=None,
+             grad_accum=1, **run_kwargs):
+    if mesh is None:
+        # Pure-dp mesh on half the virtual devices: the layout under
+        # test is the dp shard, not tp/fsdp.
+        mesh = MeshSpec(dp=4)
+        devices = jax.devices("cpu")[:4]
+    return JaxTrainer(
+        init_params=lambda r: llama.init_params(r, CFG),
+        loss_fn=lambda p, b: llama.loss_fn(p, b, CFG),
+        params_axes=llama.logical_axes(CFG),
+        batch_axes={"tokens": ("batch", None)},
+        optimizer=optimizer,
+        scaling_config=ScalingConfig(mesh_spec=mesh, devices=devices),
+        run_config=RunConfig(report_every=1, **run_kwargs),
+        trainer_config=TrainerConfig(zero_sharding=zero_sharding,
+                                     grad_accum=grad_accum),
+    )
+
+
+def _fit_losses(trainer, *, steps=20, seed=1):
+    res = trainer.fit(_batches(seed=seed), num_steps=steps)
+    assert res.error is None
+    return (np.array([m["loss"] for m in res.metrics_history]),
+            np.array([m["grad_norm"] for m in res.metrics_history]))
+
+
+def test_fp32_parity_and_per_replica_bytes(cpu_devices):
+    """Same seed, same data: the sharded update matches the replicated
+    one step for step, while each replica holds ~1/dp of the state."""
+    base = _trainer(default_optimizer(1e-3, warmup_steps=5),
+                    zero_sharding=False)
+    shrd = _trainer(default_optimizer(1e-3, warmup_steps=5),
+                    zero_sharding=True)
+    bl, bg = _fit_losses(base, steps=20)
+    sl, sg = _fit_losses(shrd, steps=20)
+    np.testing.assert_allclose(sl, bl, rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(sg, bg, rtol=5e-3, atol=1e-5)
+
+    nd = zero.dp_shards(shrd.mesh)
+    assert nd == 4
+    b_base = zero.opt_state_bytes(base.state.opt_state)
+    b_shrd = zero.opt_state_bytes(shrd.state.opt_state)
+    assert b_base["per_device"] == b_base["global"]
+    # Tiny leaves (norms, scalars) stay replicated, so allow slack over
+    # the ideal global/dp — but the footprint must be well under half.
+    assert b_shrd["per_device"] < b_base["per_device"] / 2
+    assert b_shrd["per_device"] < b_base["per_device"] / nd * 1.5
+    assert b_shrd["global"] == b_base["global"]
+
+
+def test_int8_parity_and_block_sharding(cpu_devices):
+    base = _trainer(adamw8bit(1e-3, warmup_steps=5),
+                    zero_sharding=False)
+    shrd = _trainer(adamw8bit(1e-3, warmup_steps=5, shard_update=True),
+                    zero_sharding=True)
+    bl, _ = _fit_losses(base, steps=20)
+    sl, _ = _fit_losses(shrd, steps=20)
+    np.testing.assert_allclose(sl, bl, rtol=1e-3, atol=1e-5)
+
+    b_base = zero.opt_state_bytes(base.state.opt_state)
+    b_shrd = zero.opt_state_bytes(shrd.state.opt_state)
+    assert b_shrd["per_device"] < b_base["per_device"] / 2
+    # The big mirrors really carry the dp axis on their block dim.
+    zaxes = set(zero.zero_axes(shrd.mesh))
+    assert zaxes == {"dp"}
+    sharded_leaves = [
+        l for l in jax.tree.leaves(shrd.state.opt_state)
+        if hasattr(l, "sharding")
+        and zaxes & {a for e in l.sharding.spec for a in
+                     ((e,) if isinstance(e, str) else tuple(e or ()))}]
+    assert sharded_leaves, "no opt-state leaf sharded over dp"
+
+
+def test_grad_accum_matches_single_batch(cpu_devices):
+    """grad_accum=k over the same total batch is the same update."""
+    base = _trainer(default_optimizer(1e-3, warmup_steps=5),
+                    zero_sharding=True)
+    accu = _trainer(default_optimizer(1e-3, warmup_steps=5),
+                    zero_sharding=True, grad_accum=2)
+    bl, _ = _fit_losses(base, steps=10)
+    al, _ = _fit_losses(accu, steps=10)
+    np.testing.assert_allclose(al, bl, rtol=1e-3, atol=1e-5)
+
+
+def test_checkpoint_roundtrip_of_sharded_opt_state(cpu_devices,
+                                                   tmp_path):
+    """dp-sharded optimizer state round-trips through orbax: exact leaf
+    equality, shardings preserved, and training continues after."""
+    t1 = _trainer(adamw8bit(1e-3, warmup_steps=5, shard_update=True),
+                  zero_sharding=True, storage_path=str(tmp_path))
+    res = t1.fit(_batches(), num_steps=5)
+    assert res.error is None
+
+    t2 = _trainer(adamw8bit(1e-3, warmup_steps=5, shard_update=True),
+                  zero_sharding=True)
+    step = t2.restore(str(tmp_path) + "/run")
+    assert step == 5
+
+    l1 = jax.tree.leaves(t1.state.opt_state)
+    l2 = jax.tree.leaves(t2.state.opt_state)
+    assert len(l1) == len(l2)
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(jax.device_get(a),
+                                      jax.device_get(b))
+        assert a.sharding.spec == b.sharding.spec, (a.sharding,
+                                                    b.sharding)
+    assert (zero.opt_state_bytes(t2.state.opt_state)["per_device"]
+            == zero.opt_state_bytes(t1.state.opt_state)["per_device"])
+    res2 = t2.fit(_batches(seed=2), num_steps=3)
+    assert res2.error is None
+
+
+def test_zero_resume_survives_real_worker_death(rt_zero):
+    """The PR-5 failure harness over the SHARDED path: a worker running
+    a zero-sharded JaxTrainer is hard-killed mid-run; the retry resumes
+    from the dp-sharded checkpoint and every step lands exactly once."""
+    from ray_tpu import train as rtrain
+    from ray_tpu.core import api
+    from ray_tpu.utils.test_utils import kill_actor_hard
+
+    tmp = tempfile.mkdtemp()
+    marker = os.path.join(tmp, "wedged")
+    store = os.path.join(tmp, "ckpt")
+
+    def loop():
+        first = rtrain.get_checkpoint() is None
+        trainer = _trainer(
+            adamw8bit(1e-3, warmup_steps=5, shard_update=True),
+            zero_sharding=True, mesh=MeshSpec(dp=2),
+            devices=jax.devices("cpu")[:2],
+            storage_path=store, checkpoint_every=1)
+        start = 0
+        if not first:
+            start = trainer.restore(store + "/run")
+
+        def data():
+            gen = _batches()
+            while True:
+                step = int(jax.device_get(trainer.state.step))
+                if step == 3 and first:
+                    # Wait for the step-3 save to commit (orbax renames
+                    # the tmp dir on commit), then wedge: only actor
+                    # death frees this step.
+                    deadline = time.monotonic() + 60
+                    while (not os.path.isdir(f"{store}/run/3")
+                           and time.monotonic() < deadline):
+                        time.sleep(0.01)
+                    open(marker, "w").close()
+                    while True:
+                        time.sleep(0.01)
+                yield next(gen)
+
+        res = trainer.fit(
+            data(), num_steps=5 - start,
+            report=lambda m: rtrain.report(
+                {"step": int(m["step"])},
+                checkpoint=int(m["step"]) + 1))
+        assert res.error is None
+        return "done"
+
+    def killer():
+        deadline = time.monotonic() + 300
+        while not os.path.exists(marker):
+            if time.monotonic() > deadline:
+                return
+            time.sleep(0.01)
+        runtime = api.runtime()
+        with runtime._lock:
+            victims = [a for a, s in runtime._actors.items()
+                       if not s.dead and s.cls.__name__ == "_TrainWorker"]
+        for actor_id in victims:
+            kill_actor_hard(runtime, actor_id)
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    trainer = rtrain.DataParallelTrainer(
+        loop, num_workers=1,
+        failure_config=rtrain.FailureConfig(max_failures=1),
+    )
+    out = trainer.fit()
+    t.join(timeout=120)
+    assert out.error is None
+    assert out.worker_returns == ["done"]
+    # Attempt 1 reported 0,1,2 then wedged fetching the batch for step
+    # 3; attempt 2 resumed from the dp-sharded step-3 checkpoint —
+    # every step exactly once, none lost or redone.
+    steps = [r["metrics"]["step"] for r in out.metrics_history]
+    assert steps == [0, 1, 2, 3, 4]
+
+
+@pytest.fixture
+def rt_zero():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
