@@ -402,6 +402,18 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
         s.result(timeout_s=600)
     burst_dt = time.perf_counter() - t0
     eng_stats = eng.stats()
+    # Migrated-vs-recomputed prefix cost (zipf mixes): ship this run's
+    # hot cached prefixes to a cold engine over the kv_transfer int8
+    # wire and time it, against the same run's MEASURED cold-prefill
+    # cost (cold requests' TTFT per prompt token).  Needs the warm
+    # engine alive, so it runs before shutdown.
+    mig_probe = None
+    if zipf is not None:
+        try:
+            mig_probe = _probe_prefix_migration(
+                eng, cfg, params, make_adapter, max_seq)
+        except Exception as e:
+            mig_probe = {"error": repr(e)[:120]}
     eng.shutdown()
     # Headline open-loop numbers are AT THE KNEE (highest offered load
     # still completing ≥99%), so TTFT never conflates service with
@@ -478,7 +490,250 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
             "cached_pages": int(eng_prefix.get("cached_pages", 0)),
             "evicted_pages": int(eng_prefix.get("evicted_pages", 0)),
         }
+        if mig_probe is not None and "error" not in mig_probe:
+            # Per-page costs: transfer side measured by the probe,
+            # recompute side from the run's own cold requests (64 =
+            # the engine's page_size above).  Null only when a side
+            # measured nothing — no pages moved / no cold requests.
+            cold_tok = sum(p for h, p, t in prefix_samples
+                           if h == 0 and t is not None)
+            cold_s = sum(t for h, p, t in prefix_samples
+                         if h == 0 and t is not None)
+            pages = mig_probe["migrated_pages"]
+            mig_probe["migrate_s_per_page"] = (
+                round(mig_probe["seconds"] / pages, 6) if pages
+                else None)
+            mig_probe["recompute_s_per_page"] = (
+                round(cold_s / cold_tok * 64, 6) if cold_tok else None)
+            m_pp = mig_probe["migrate_s_per_page"]
+            r_pp = mig_probe["recompute_s_per_page"]
+            mig_probe["migrate_vs_recompute"] = (
+                round(r_pp / m_pp, 2) if m_pp and r_pp else None)
+        if mig_probe is not None:
+            out["prefix"]["migration"] = mig_probe
     return out
+
+
+def _probe_prefix_migration(eng, cfg, params, make_adapter, max_seq):
+    """Ship the warm engine's hot cached prefixes to a COLD engine over
+    the kv_transfer int8 page wire (export_hot_prefixes -> ingest) and
+    time it — the transfer half of the migrated-vs-recomputed prefix
+    cost the zipf_chat record carries.  The timing includes the cold
+    engine's one-time ingest compile, so the reported per-page cost is
+    conservative (a steady-state pull is cheaper than this number)."""
+    from ray_tpu.serve.llm_engine import EngineConfig, LLMEngine
+
+    cold = LLMEngine(
+        params, make_adapter(cfg),
+        EngineConfig(max_slots=4, max_seq_len=max_seq, decode_chunk=8,
+                     page_size=64, ragged_batching=True,
+                     prefix_cache=True))
+    try:
+        t0 = time.perf_counter()
+        transfers = eng.export_hot_prefixes(max_pages=512, mode="int8")
+        pages = sum(cold.migration_ingest(t) for t in transfers)
+        dt = time.perf_counter() - t0
+    finally:
+        cold.shutdown()
+    return {"migrated_pages": int(pages),
+            "wire_bytes": int(sum(int(t.get("wire_bytes", 0))
+                                  for t in transfers)),
+            "seconds": round(dt, 4)}
+
+
+def _measure_serving_disagg(cfg, *, n_requests: int = 10, gen: int = 24,
+                            lens=(512, 1024, 1536),
+                            weights=(0.3, 0.5, 0.2),
+                            arrival_rate: float = 2.0,
+                            handoff_after_tokens: int = 2,
+                            slots: int = 8,
+                            params=None, adapter_factory=None) -> dict:
+    """long_rag disaggregation on/off ablation, direct two-engine drive.
+
+    OFF (unified): one engine serves the mix — long prefills and
+    running decodes share the token-budget step, so a 1536-token
+    prefill stretches every concurrent stream's inter-token latency.
+    ON (disagg): a prefill engine serves the prompt plus the first
+    ``handoff_after_tokens`` tokens, the finished pages migrate to a
+    decode engine through the kv_transfer plane (lease -> int8 export
+    -> ingest -> release, the same verbs the serve-path handoff uses),
+    and decode resumes there against a prefix hit — the decode engine
+    never runs a long prefill, which is the ITL separation this
+    ablation measures.  TTFT is the prefill engine's (the client holds
+    its first token before any page moves); a failed transfer falls
+    back to serving the remainder on the prefill engine (the serve
+    path's recompute fallback) and is counted in migration.failed.
+    The serve-path handoff itself (router, MIGRATING ring state,
+    SIGKILL fallback) is tier-1-tested in tests/test_disagg_serving.py.
+    """
+    import threading
+
+    from ray_tpu.serve.llm_engine import (
+        EngineConfig,
+        LLMEngine,
+        llama_paged_adapter,
+    )
+
+    if params is None:
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    make_adapter = adapter_factory or llama_paged_adapter
+    rng = np.random.default_rng(7)
+    req_lens = rng.choice(np.asarray(lens), n_requests,
+                          p=np.asarray(weights, np.float64)
+                          / np.sum(weights))
+    prompts = [rng.integers(0, cfg.vocab_size, int(n)).tolist()
+               for n in req_lens]
+    max_seq = min(cfg.max_seq_len,
+                  max(256, int(64 * np.ceil((int(req_lens.max())
+                                             + gen + 1) / 64))))
+
+    def make_engine():
+        return LLMEngine(
+            params, make_adapter(cfg),
+            EngineConfig(max_slots=slots, max_seq_len=max_seq,
+                         decode_chunk=4, page_size=64,
+                         max_new_tokens_default=gen,
+                         ragged_batching=True, prefill_chunk=256,
+                         prefix_cache=True))
+
+    def pct_ms(vals, q):
+        vals = sorted(v for v in vals if v is not None)
+        if not vals:
+            return None
+        return round(vals[min(len(vals) - 1,
+                              int(q * len(vals)))] * 1e3, 2)
+
+    def leg_stats(ttfts, itls, decode_tokens, dt):
+        return {"ttft_p50_ms": pct_ms(ttfts, 0.50),
+                "ttft_p95_ms": pct_ms(ttfts, 0.95),
+                "itl_p50_ms": pct_ms(itls, 0.50),
+                "itl_p95_ms": pct_ms(itls, 0.95),
+                "decode_tokens_per_s": round(decode_tokens / dt, 1)}
+
+    # Off-the-clock warm prompt: NOT one of the timed prompts, so the
+    # prefix cache never hands the unified leg a free hit.
+    warm_prompt = rng.integers(0, cfg.vocab_size,
+                               int(min(lens))).tolist()
+
+    # --- OFF: unified engine -----------------------------------------
+    uni = make_engine()
+    try:
+        uni.submit(warm_prompt, max_new_tokens=gen,
+                   temperature=0.0).result(timeout_s=600)
+        t0 = time.perf_counter()
+        streams = []
+        for i, p in enumerate(prompts):
+            delay = t0 + i / arrival_rate - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            streams.append(uni.submit(p, max_new_tokens=gen,
+                                      temperature=0.0))
+        outs = [s.result(timeout_s=600) for s in streams]
+        dt_u = time.perf_counter() - t0
+        ttfts_u = [s._req.ttft_s for s in streams]
+        itls_u = [(s._req.finished_at - s._req.first_token_at)
+                  / (len(o) - 1)
+                  for s, o in zip(streams, outs) if len(o) > 1]
+        toks_u = sum(len(o) for o in outs)
+    finally:
+        uni.shutdown()
+
+    # --- ON: prefill engine -> page migration -> decode engine -------
+    pre = make_engine()
+    dec = make_engine()
+    mig_lock = threading.Lock()
+    mig = {"pages": 0, "wire_bytes": 0, "seconds": 0.0, "failed": 0}
+    results = [None] * n_requests
+
+    def run_one(prompt):
+        s = pre.submit(prompt, max_new_tokens=handoff_after_tokens,
+                       temperature=0.0)
+        first = s.result(timeout_s=600)
+        ttft = s._req.ttft_s
+        seq = list(prompt) + list(first)
+        lease = None
+        transfer = None
+        moved = 0
+        t1 = time.perf_counter()
+        try:
+            lease = pre.migration_lease(seq)
+            if lease is not None:
+                transfer = pre.migration_export(lease["lease_id"],
+                                                mode="int8")
+                moved = dec.migration_ingest(transfer)
+        except Exception:
+            moved = 0
+        finally:
+            if lease is not None:
+                pre.migration_release(lease["lease_id"])
+        dt_m = time.perf_counter() - t1
+        if moved:
+            with mig_lock:
+                mig["pages"] += moved
+                mig["wire_bytes"] += int(transfer.get("wire_bytes", 0))
+                mig["seconds"] += dt_m
+            eng2 = dec
+        else:
+            with mig_lock:
+                mig["failed"] += 1
+            eng2 = pre
+        s2 = eng2.submit(seq,
+                         max_new_tokens=gen - handoff_after_tokens,
+                         temperature=0.0)
+        rest = s2.result(timeout_s=600)
+        gap = s2._req.first_token_at - s._req.finished_at
+        itl = ((s2._req.finished_at - s2._req.first_token_at)
+               / (len(rest) - 1)) if len(rest) > 1 else None
+        return ttft, itl, len(first) + len(rest), gap
+
+    try:
+        run_one(warm_prompt)  # compiles prefill/transfer/resume paths
+        mig.update(pages=0, wire_bytes=0, seconds=0.0, failed=0)
+
+        def worker(i, p):
+            results[i] = run_one(p)
+
+        t0 = time.perf_counter()
+        threads = []
+        for i, p in enumerate(prompts):
+            delay = t0 + i / arrival_rate - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=worker, args=(i, p),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=600)
+        dt_d = time.perf_counter() - t0
+    finally:
+        pre.shutdown()
+        dec.shutdown()
+
+    done = [r for r in results if r is not None]
+    unified = leg_stats(ttfts_u, itls_u, toks_u, dt_u)
+    disagg = leg_stats([r[0] for r in done],
+                       [r[1] for r in done], sum(r[2] for r in done),
+                       dt_d)
+    disagg["handoff_gap_p50_ms"] = pct_ms([r[3] for r in done], 0.50)
+    disagg["migration"] = {"pages": int(mig["pages"]),
+                           "wire_bytes": int(mig["wire_bytes"]),
+                           "seconds": round(mig["seconds"], 4),
+                           "failed": int(mig["failed"])}
+    ratio = None
+    if unified["itl_p95_ms"] and disagg["itl_p95_ms"]:
+        ratio = round(unified["itl_p95_ms"] / disagg["itl_p95_ms"], 2)
+    return {
+        "mix": {"name": "long_rag", "lens": [int(x) for x in lens],
+                "weights": [round(float(w), 4) for w in weights]},
+        "n_requests": n_requests,
+        "gen": gen,
+        "handoff_after_tokens": handoff_after_tokens,
+        "transfer": "int8",
+        "unified": unified,
+        "disagg": disagg,
+        "itl_p95_ratio": ratio,
+    }
 
 
 def _measure_serving_mixed(cfg, *, n_requests: int = 48,
@@ -920,6 +1175,20 @@ def main():
     except Exception as e:
         # No ", "/": " — the final stdout line must stay compact.
         extra["serving_multihost"] = {
+            "error": repr(e).replace(": ", ":").replace(", ", ",")[:120]}
+
+    # Disaggregated prefill/decode ablation on the long-RAG mix:
+    # unified vs prefill -> kv_transfer -> decode, direct two-engine
+    # drive (the serve-path handoff is tier-1-tested).  Runs on CPU
+    # too with scaled prompt lengths, so every record carries it.
+    try:
+        extra["serving_disagg"] = _measure_serving_disagg(
+            dataclasses.replace(cfg, max_seq_len=2048),
+            **({} if on_tpu else
+               {"lens": (96, 160, 224), "n_requests": 8, "gen": 16,
+                "arrival_rate": 4.0}))
+    except Exception as e:
+        extra["serving_disagg"] = {
             "error": repr(e).replace(": ", ":").replace(", ", ",")[:120]}
 
     result = {

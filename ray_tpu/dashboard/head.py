@@ -15,8 +15,9 @@ metrics):
   GET /api/v0/requests           serving requests from every LLM
                                  engine's lifecycle ring
                                  (state.list_requests; ?limit=)
-  GET /api/v0/replicas           serve replicas with shard-group mesh
-                                 shape and membership
+  GET /api/v0/replicas           serve replicas with disagg role
+                                 (prefill|decode|unified), shard-group
+                                 mesh shape and membership
                                  (state.list_replicas; ?limit=)
   GET /api/v0/requests/summarize request counts by lifecycle state and
                                  terminal cause

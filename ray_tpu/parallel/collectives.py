@@ -209,6 +209,22 @@ def allgather_wire_bytes(n_elements: int, *, axis_size: int,
                                     itemsize=itemsize)
 
 
+def page_transfer_wire_bytes(n_pages: int, elements_per_page: int, *,
+                             quantized: bool, itemsize: int = 4,
+                             scales_per_page: int = 1) -> int:
+    """Bytes a KV page migration (serve/kv_transfer) puts on the wire
+    for one pool tensor: point-to-point, so no peer multiplier.
+    Quantized ships 1 int8 byte per element plus one f32 scale per
+    (page, scale column); exact ships the storage bytes.  Analytic for
+    the same reason `allreduce_wire_bytes` is: CPU emulation and a real
+    DCN fabric must report identical accounting."""
+    if n_pages <= 0:
+        return 0
+    if quantized:
+        return n_pages * (elements_per_page * 1 + scales_per_page * 4)
+    return n_pages * elements_per_page * itemsize
+
+
 class CollectiveGroup:
     """Named-group API surface (reference: init_collective_group
     collective.py:120 / create_collective_group :151).

@@ -85,7 +85,7 @@ _LIST_ROUTES = {
                   "generated_tokens", "slot", "attempt", "prefix_hit",
                   "terminal_cause"]),
     "replicas": ("/api/v0/replicas",
-                 ["app", "deployment", "replica_id", "state",
+                 ["app", "deployment", "replica_id", "state", "role",
                   "shard_group", "mesh_shape", "members"]),
 }
 

@@ -73,6 +73,42 @@ class ShardGroupConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DisaggConfig:
+    """Disaggregated prefill/decode serving: the controller assigns
+    ``prefill_replicas`` of the deployment's replicas the ``prefill``
+    role and the rest ``decode``.  The router sends fresh requests to a
+    prefill replica; after ``handoff_after_tokens`` generated tokens the
+    prefill replica migrates the request's KV pages to a decode replica
+    (serve/kv_transfer) and the stream resumes there.  Any transfer
+    failure falls back to the PR-5 continuation replay — local
+    recompute, never a stall."""
+
+    # How many replicas get the prefill role (rest are decode).
+    prefill_replicas: int = 1
+    # Page payload wire format: "int8" (per-page quantized, PR-9 style
+    # scales) or "exact" (raw dtype bytes).
+    transfer: str = "int8"
+    # Tokens the prefill replica generates before handing off (>= 1 so
+    # the finished prompt's pages land in the prefix trie first).
+    handoff_after_tokens: int = 1
+    # Budget for one lease+export+ingest round trip before falling back
+    # to local recompute.
+    migration_timeout_s: float = 5.0
+
+    def __post_init__(self):
+        if self.prefill_replicas < 1:
+            raise ValueError("disagg.prefill_replicas must be >= 1")
+        if self.transfer not in ("int8", "exact"):
+            raise ValueError(
+                f"disagg.transfer must be 'int8' or 'exact', "
+                f"got {self.transfer!r}")
+        if self.handoff_after_tokens < 1:
+            raise ValueError("disagg.handoff_after_tokens must be >= 1")
+        if self.migration_timeout_s <= 0:
+            raise ValueError("disagg.migration_timeout_s must be positive")
+
+
+@dataclasses.dataclass(frozen=True)
 class DeploymentConfig:
     """Per-deployment knobs (parity: ray serve/config.py DeploymentConfig)."""
 
@@ -87,12 +123,24 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # Multi-host tensor-parallel replicas (None = plain single-process).
     shard_group: Optional[ShardGroupConfig] = None
+    # Disaggregated prefill/decode roles (None = every replica unified).
+    disagg: Optional[DisaggConfig] = None
 
     def __post_init__(self):
         if self.num_replicas < 0:
             raise ValueError("num_replicas must be >= 0")
         if self.max_ongoing_requests < 1:
             raise ValueError("max_ongoing_requests must be >= 1")
+        if self.disagg is not None:
+            if self.autoscaling_config is not None:
+                raise ValueError(
+                    "disagg does not compose with autoscaling_config yet "
+                    "(role census needs a fixed replica target)")
+            if self.num_replicas <= self.disagg.prefill_replicas:
+                raise ValueError(
+                    f"disagg needs num_replicas > prefill_replicas so at "
+                    f"least one decode replica exists, got "
+                    f"{self.num_replicas} <= {self.disagg.prefill_replicas}")
 
     def initial_target_replicas(self) -> int:
         if self.autoscaling_config is not None:

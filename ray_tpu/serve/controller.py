@@ -102,6 +102,10 @@ class _Replica:
         self.pg = None
         self.mesh_shape = ""
         self.member_ping_refs = None
+        # Disaggregated serving role (config.disagg): "prefill" |
+        # "decode" | "unified".  Assigned at start by live-role census
+        # so a killed prefill replica's replacement is prefill again.
+        self.role = "unified"
 
 
 class _DeploymentState:
@@ -309,8 +313,38 @@ class ServeController:
                         "shard_group": sg.size if sg is not None else 0,
                         "mesh_shape": r.mesh_shape,
                         "members": membership,
+                        "role": r.role,
                     })
         return rows
+
+    def migration_targets(self, app_name: str, deployment_name: str,
+                          role: Optional[str] = "decode",
+                          exclude: Optional[List[str]] = None,
+                          with_summary: bool = False) -> List[Tuple]:
+        """RUNNING replicas of one deployment, for the KV-migration
+        plane: a prefill replica asks here for its decode handoff
+        target, a cold replica for warm peers to pull prefixes from.
+        Deterministic (sorted by replica id).  Rows are
+        ``(replica_id, handle)`` — plus the replica's latest prefix
+        summary when ``with_summary`` (prefix migration picks the
+        warmest peer by published hash count)."""
+        excluded = set(exclude or ())
+        out: List[Tuple] = []
+        with self._lock:
+            st = self._deployments.get((app_name, deployment_name))
+            if st is None:
+                return []
+            for rid in sorted(st.replicas):
+                r = st.replicas[rid]
+                if r.state != "RUNNING" or rid in excluded:
+                    continue
+                if role is not None and r.role != role:
+                    continue
+                if with_summary:
+                    out.append((rid, r.handle, r.prefix_summary))
+                else:
+                    out.append((rid, r.handle))
+        return out
 
     def drain_replica(self, app_name: str, deployment_name: str,
                       replica_id: str,
@@ -628,17 +662,42 @@ class ServeController:
                 "dcn_collective": sg.dcn_collective,
                 "member_ids": [m._actor_id.hex() for _, m in members],
             }}
+        disagg_kwarg = {}
+        role = "unified"
+        dis = cfg.disagg
+        if dis is not None:
+            # Role by CENSUS of live prefill replicas, not by replica
+            # index: a killed prefill replica's replacement takes the
+            # prefill role again, so the split stays at target across
+            # failovers.  (DRAINING replicas are not counted — their
+            # replacement inherits the role immediately.)
+            live_prefill = sum(
+                1 for rep in st.replicas.values()
+                if rep.role == "prefill"
+                and rep.state in ("STARTING", "RUNNING"))
+            role = ("prefill" if live_prefill < dis.prefill_replicas
+                    else "decode")
+            disagg_kwarg = {"disagg": {
+                "role": role,
+                "transfer": dis.transfer,
+                "handoff_after_tokens": dis.handoff_after_tokens,
+                "migration_timeout_s": dis.migration_timeout_s,
+                "app_name": st.app_name,
+                "deployment_name": st.info.name,
+                "replica_id": replica_id,
+            }}
         actor_cls = api.remote(ReplicaActor)
         handle = actor_cls.options(
             max_concurrency=cfg.max_ongoing_requests + 4, **opts
         ).remote(
             st.app_name, st.info.name, replica_id, st.info.func_or_class,
             st.info.init_args, st.info.init_kwargs, cfg.user_config,
-            metrics_interval, **shard_kwarg,
+            metrics_interval, **shard_kwarg, **disagg_kwarg,
         )
         r = _Replica(replica_id, handle, handle._creation_ref)
         r.members = members
         r.pg = pg
+        r.role = role
         if sg is not None:
             r.mesh_shape = f"dcn_tp={sg.size} x tp={sg.tensor_parallel}"
             self._tm["shard_members"].set(
@@ -695,7 +754,7 @@ class ServeController:
                 r._announced = True
                 table.append(
                     (r.replica_id, r.handle, st.config.max_ongoing_requests,
-                     is_async, r.prefix_summary)
+                     is_async, r.prefix_summary, r.role)
                 )
         self._host.notify_changed(
             replica_set_key(st.app_name, st.info.name), table

@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ray_tpu.serve.config import (
     AutoscalingConfig,
     DeploymentConfig,
+    DisaggConfig,
     ShardGroupConfig,
 )
 
@@ -65,16 +66,23 @@ def deployment(
     graceful_shutdown_timeout_s: float = 5.0,
     ray_actor_options: Optional[Dict[str, Any]] = None,
     shard_group: Optional[Any] = None,
+    disagg: Optional[Any] = None,
 ) -> Any:
     """``@serve.deployment`` (parity: ray serve/api.py deployment:...).
 
     ``shard_group``: a ShardGroupConfig (or kwargs dict) making each
     replica a multi-host tensor-parallel shard group of engine
-    processes instead of one actor (serve/shard_group.py)."""
+    processes instead of one actor (serve/shard_group.py).
+
+    ``disagg``: a DisaggConfig (or kwargs dict) splitting the replica
+    set into prefill and decode roles with cross-replica KV page
+    migration (serve/kv_transfer.py)."""
     if isinstance(autoscaling_config, dict):
         autoscaling_config = AutoscalingConfig(**autoscaling_config)
     if isinstance(shard_group, dict):
         shard_group = ShardGroupConfig(**shard_group)
+    if isinstance(disagg, dict):
+        disagg = DisaggConfig(**disagg)
     if num_replicas is not None and autoscaling_config is not None:
         raise ValueError(
             "num_replicas and autoscaling_config are mutually exclusive"
@@ -96,6 +104,7 @@ def deployment(
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=dict(ray_actor_options or {}),
             shard_group=shard_group,
+            disagg=disagg,
         )
         return Deployment(target, name or target.__name__, cfg)
 

@@ -36,6 +36,22 @@ def _is_death(err: BaseException) -> bool:
             and not isinstance(getattr(err, "cause", None), Exception))
 
 
+def _migration_handoff(err: BaseException):
+    """The MigrationHandoff inside an attempt's outcome, if any —
+    raised directly (local engine) or riding a TaskError from the
+    replica.  A handoff is a SUCCESSFUL prefill attempt whose KV pages
+    landed on a decode replica; the stream resumes there."""
+    from ray_tpu.core.exceptions import TaskError
+    from ray_tpu.serve.kv_transfer import MigrationHandoff
+
+    if isinstance(err, MigrationHandoff):
+        return err
+    if (isinstance(err, TaskError)
+            and isinstance(getattr(err, "cause", None), MigrationHandoff)):
+        return err.cause
+    return None
+
+
 def _is_retriable(err: BaseException) -> bool:
     """Safe to re-enqueue the request on a surviving replica: the
     replica died (the work is lost, not duplicated) or it preempted the
@@ -176,6 +192,13 @@ class DeploymentResponseGenerator:
         self.request_id = _reqev.get_request_id() or _reqev.new_request_id()
         self._delivered: List[Any] = []
         self._iter = None
+        # Disaggregated-serving handoff state: once a prefill replica
+        # migrates this stream's KV pages, resumed attempts carry
+        # ``_disagg_resumed`` (so prefill replicas serve them instead
+        # of handing off again) and prefer the decode replica the
+        # pages landed on.
+        self._migrated = False
+        self._prefer_replica: Optional[str] = None
 
     @property
     def delivered(self) -> List[Any]:
@@ -229,6 +252,8 @@ class DeploymentResponseGenerator:
                     return None, 0
                 payload["max_new_tokens"] = remaining
             payload["request_id"] = self.request_id
+            if self._migrated:
+                payload["_disagg_resumed"] = True
             return (payload,) + self._args[1:], 0
         return self._args, len(self._delivered)
 
@@ -254,7 +279,8 @@ class DeploymentResponseGenerator:
             gen, replica_id, _ = self._router.assign_streaming(
                 self._method_name, call_args, self._kwargs,
                 timeout=assign_timeout, exclude=dead,
-                model_id=self._model_id, request_id=self.request_id)
+                model_id=self._model_id, request_id=self.request_id,
+                prefer_replica=self._prefer_replica)
             try:
                 for ref in gen:
                     item = api.get(ref)
@@ -272,6 +298,23 @@ class DeploymentResponseGenerator:
             except Exception as err:
                 died = _is_death(err)
                 self._router.finish_streaming(replica_id, died=died)
+                handoff = _migration_handoff(err)
+                if handoff is not None and (
+                        deadline is None or time.monotonic() < deadline):
+                    # Planned prefill→decode handoff, not a failure:
+                    # resume immediately (no backoff — the pages are
+                    # already waiting on the target) and do not charge
+                    # the retry budget.  If the target died in the
+                    # meantime, the next attempt's continuation replay
+                    # recomputes locally like any other failover.
+                    attempt += 1
+                    self._migrated = True
+                    self._prefer_replica = (handoff.target_replica_id
+                                            or None)
+                    self._router.note_migrating(
+                        self.request_id, attempt, replica_id,
+                        handoff.target_replica_id)
+                    continue
                 budget_left = (
                     _is_retriable(err)
                     and attempt < self._max_retries

@@ -1,0 +1,288 @@
+"""Cross-replica KV page-migration plane (disaggregated serving).
+
+Long prefills and decode steps contend for the same chips inside one
+token-budget step; disaggregation gives each phase its own replicas and
+streams finished KV pages between them.  This module is the transfer
+plane those roles ride on:
+
+  * **Wire format** — `encode_pages`/`decode_payload` serialize gathered
+    KV pages (k/v ``[L, KVH, N, page, D]`` plus per-page scales for int8
+    pools) either exactly or through the PR-9 style per-page int8
+    quantization (absmax/127 scales, floored 1e-8).  Bytes-on-wire are
+    accounted analytically (`parallel.collectives.page_transfer_wire_bytes`)
+    so CPU emulation and a real DCN fabric report the same number.
+  * **Content identity** — every transfer carries the chained-CRC32 path
+    hashes (`prefix_index.prefix_hashes`) of its token prefix; the
+    destination recomputes them before touching its pool, so both sides
+    agree on exactly which prefix a page holds.
+  * **Roles** — `DisaggContext` is the ambient per-replica role
+    (installed by the ReplicaActor, same pattern as
+    `serve/shard_group.py`); `MigrationHandoff` is the control-flow
+    signal a prefill replica raises once pages have landed on a decode
+    replica (a PreemptedError subclass, so the PR-5 failover machinery
+    transports it and local recompute remains the universal fallback).
+
+The engine-side verbs (lease → export → ingest → release) live on
+`LLMEngine` — they must run on the engine loop thread because the cache
+is donated between jitted dispatches.  The protocol invariant the tests
+pin: pages under a migration lease are eviction-proof
+(`prefix_index` skips them), and every lease is released on ALL paths —
+success, failure, and cancel — so the pool accounting
+free ∪ cached ∪ slot-owned (∪ leased ⊆ cached) always holds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.core.exceptions import PreemptedError
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Migration/disagg metric singletons.  Merged into the engine's
+    telemetry dict (`llm_engine._telemetry`) so the families register at
+    engine construction and `check_metrics --require` sees them at zero
+    before any migration happens."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "mig_pages": metrics.Counter(
+                "raytpu_serve_kv_migration_pages_total",
+                "KV pages moved between replica pools, by direction "
+                "(out = exported under a migration lease, in = "
+                "ingested into the local pool).",
+                tag_keys=("direction",),
+            ),
+            "mig_bytes": metrics.Counter(
+                "raytpu_serve_kv_migration_bytes_total",
+                "Bytes-on-wire of KV page payloads (int8 page bytes + "
+                "f32 per-page scales when quantized, raw dtype bytes "
+                "when exact), by direction.  Analytic accounting "
+                "(parallel.collectives.page_transfer_wire_bytes) so "
+                "CPU emulation and real DCN report the same number.",
+                tag_keys=("direction",),
+            ),
+            "mig_seconds": metrics.Histogram(
+                "raytpu_serve_kv_migration_seconds",
+                "Wall time of one migration verb on the engine loop "
+                "(export = lease gather + host pull + encode; ingest "
+                "= decode + scatter + trie insert).",
+                boundaries=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                            0.1, 0.25, 0.5, 1.0, 2.5, 5.0],
+                tag_keys=("op",),
+            ),
+            "disagg_handoffs": metrics.Counter(
+                "raytpu_serve_disagg_handoffs_total",
+                "Prefill-to-decode stream handoffs by outcome "
+                "(migrated = pages landed and the stream resumed on "
+                "the decode replica; failed = transfer aborted and "
+                "the continuation replay recomputed locally; local = "
+                "no decode target, served unified).",
+                tag_keys=("outcome",),
+            ),
+            "disagg_requests": metrics.Counter(
+                "raytpu_serve_disagg_requests_total",
+                "Streamed requests entering a disaggregated "
+                "deployment, by the serving replica's role.",
+                tag_keys=("role",),
+            ),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
+
+
+# -- ambient per-replica role (serve/shard_group.py pattern) ----------------
+
+@dataclasses.dataclass(frozen=True)
+class DisaggContext:
+    """The replica's disaggregation role plus everything its LLMServer
+    needs to run the handoff protocol.  Installed by the hosting
+    ReplicaActor before the user callable constructs."""
+
+    role: str = "unified"  # "prefill" | "decode" | "unified"
+    transfer: str = "int8"  # page payload wire format ("int8"|"exact")
+    handoff_after_tokens: int = 1
+    migration_timeout_s: float = 5.0
+    app_name: str = ""
+    deployment_name: str = ""
+    replica_id: str = ""
+
+
+_LOCAL = threading.local()
+
+
+def set_disagg(ctx: Optional[DisaggContext]) -> None:
+    _LOCAL.ctx = ctx
+
+
+def current_disagg() -> Optional[DisaggContext]:
+    """The installing replica's DisaggContext, or None outside a
+    disaggregated deployment."""
+    return getattr(_LOCAL, "ctx", None)
+
+
+class MigrationHandoff(PreemptedError):
+    """The prefill replica finished its share of the request AND its KV
+    pages landed on ``target_replica_id`` — the client generator should
+    resume there (prefix-cache hit covers everything migrated) instead
+    of recomputing.  Subclasses PreemptedError so the PR-5 failover
+    path treats it as retriable with zero new machinery; if the target
+    also fails, continuation replay still recomputes locally."""
+
+    def __init__(self, reason: str = "stream handed off",
+                 continuation: Optional[dict] = None,
+                 target_replica_id: str = ""):
+        self.target_replica_id = target_replica_id
+        super().__init__(reason, continuation)
+
+    def __reduce__(self):
+        return (type(self),
+                (self.reason, self.continuation, self.target_replica_id))
+
+
+# -- page payload codec -----------------------------------------------------
+
+def quantize_page_payload(pages: np.ndarray):
+    """``[L, KVH, N, page, D]`` float pages → (int8 pages,
+    ``[L, KVH, N]`` f32 per-page absmax scales) — the host-side mirror
+    of the int8 KV pool's write-side quant (models/llama.py
+    ``_quant_pages``): scale = absmax/127 floored at 1e-8."""
+    a = np.max(np.abs(pages.astype(np.float32)), axis=(3, 4))
+    scale = np.maximum(a / 127.0, 1e-8).astype(np.float32)
+    q = np.clip(np.rint(pages.astype(np.float32)
+                        / scale[..., None, None]), -127, 127)
+    return q.astype(np.int8), scale
+
+
+def dequantize_page_payload(q: np.ndarray, scale: np.ndarray,
+                            dtype: Any) -> np.ndarray:
+    """Inverse of `quantize_page_payload` (into the pool's dtype)."""
+    return (q.astype(np.float32) * scale[..., None, None]).astype(dtype)
+
+
+def encode_pages(gathered: Dict[str, np.ndarray], *,
+                 tokens: Sequence[int], page_size: int,
+                 mode: str = "int8") -> Dict[str, Any]:
+    """Build one transfer dict from host-gathered pages.
+
+    ``gathered``: "k"/"v" ``[L, KVH, N, page, D]`` in the source pool's
+    storage dtype; int8 pools also carry "k_scale"/"v_scale" in the
+    pool's page-major layout ``[L, N, KVH, 1]`` (converted here to the
+    canonical ``[L, KVH, N]``).  ``mode`` "exact" ships the storage
+    bytes as-is; "int8" quantizes float payloads per page (an int8
+    source is already quantized — no second quantization)."""
+    if mode not in ("int8", "exact"):
+        raise ValueError(f"transfer mode must be 'int8' or 'exact', "
+                         f"got {mode!r}")
+    from ray_tpu.parallel.collectives import page_transfer_wire_bytes
+    from ray_tpu.serve.prefix_index import prefix_hashes
+
+    k, v = np.asarray(gathered["k"]), np.asarray(gathered["v"])
+    L, KVH, N, page, D = k.shape
+    if page != page_size or N * page_size != len(tokens):
+        raise ValueError(
+            f"payload shape {k.shape} does not cover {len(tokens)} "
+            f"tokens at page_size={page_size}")
+    out: Dict[str, Any] = {
+        "version": 1,
+        "page_size": page_size,
+        "tokens": [int(t) for t in tokens],
+        "hashes": prefix_hashes(tokens, page_size),
+        "src_dtype": str(k.dtype),
+    }
+    if "k_scale" in gathered:
+        # int8 source pool: payload is already quantized; reshape the
+        # page-major scale columns [L, N, KVH, 1] → canonical [L, KVH, N].
+        def canon(s):
+            return np.ascontiguousarray(
+                np.squeeze(np.asarray(s), -1).transpose(0, 2, 1)
+            ).astype(np.float32)
+
+        out.update(mode="int8", k=k, v=v,
+                   k_scale=canon(gathered["k_scale"]),
+                   v_scale=canon(gathered["v_scale"]))
+    elif mode == "int8":
+        qk, sk = quantize_page_payload(k)
+        qv, sv = quantize_page_payload(v)
+        out.update(mode="int8", k=qk, v=qv, k_scale=sk, v_scale=sv)
+    else:
+        out.update(mode="exact", k=k, v=v)
+    elements = L * KVH * page * D
+    quantized = out["mode"] == "int8"
+    out["wire_bytes"] = 2 * page_transfer_wire_bytes(
+        N, elements, quantized=quantized,
+        itemsize=k.dtype.itemsize, scales_per_page=L * KVH)
+    return out
+
+
+def decode_payload(transfer: Dict[str, Any],
+                   pool_quantized: bool, pool_dtype: Any,
+                   start_page: int = 0,
+                   end_page: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Transfer dict → arrays in the DESTINATION pool's storage layout,
+    sliced to pages ``[start_page, end_page)`` (the destination skips
+    depths it already caches).  Handles every source×dest combination:
+    exact float ↔ float pools pass through, int8 payloads dequantize
+    into float pools, float payloads quantize into int8 pools, and
+    int8 → int8 ships raw bytes + scales with no requantization."""
+    sl = slice(start_page, end_page)
+    k = np.asarray(transfer["k"])[:, :, sl]
+    v = np.asarray(transfer["v"])[:, :, sl]
+    quant_payload = transfer["mode"] == "int8"
+    if quant_payload:
+        ks = np.asarray(transfer["k_scale"])[:, :, sl]
+        vs = np.asarray(transfer["v_scale"])[:, :, sl]
+    if pool_quantized:
+        if not quant_payload:
+            k, ks = quantize_page_payload(k)
+            v, vs = quantize_page_payload(v)
+        # canonical [L, KVH, n] scales → pool page-major [L, n, KVH, 1]
+        def pool_scale(s):
+            return np.ascontiguousarray(
+                s.transpose(0, 2, 1))[..., None].astype(np.float32)
+
+        return {"k": k.astype(np.int8), "v": v.astype(np.int8),
+                "k_scale": pool_scale(ks), "v_scale": pool_scale(vs)}
+    if quant_payload:
+        return {"k": dequantize_page_payload(k, ks, pool_dtype),
+                "v": dequantize_page_payload(v, vs, pool_dtype)}
+    return {"k": k.astype(pool_dtype), "v": v.astype(pool_dtype)}
+
+
+def transfer_num_pages(transfer: Dict[str, Any]) -> int:
+    return int(np.asarray(transfer["k"]).shape[2])
+
+
+def verify_transfer(transfer: Dict[str, Any]) -> List[int]:
+    """Recompute the chained-CRC32 path hashes over the transfer's
+    tokens and check them against the sender's — content identity is
+    established BEFORE any page touches the local pool.  Returns the
+    verified hash chain."""
+    from ray_tpu.serve.prefix_index import prefix_hashes
+
+    page = int(transfer["page_size"])
+    tokens = transfer["tokens"]
+    expect = prefix_hashes(tokens, page)
+    got = [int(h) for h in transfer["hashes"]]
+    if got != expect:
+        raise ValueError(
+            f"kv transfer content-identity mismatch: sender hashes "
+            f"{got[:4]}... != recomputed {expect[:4]}... "
+            f"({len(tokens)} tokens, page={page})")
+    n = transfer_num_pages(transfer)
+    if n != len(tokens) // page or n != len(expect):
+        raise ValueError(
+            f"kv transfer page count {n} does not match "
+            f"{len(tokens)} tokens at page={page}")
+    return expect

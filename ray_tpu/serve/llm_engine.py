@@ -28,7 +28,7 @@ import threading
 import time
 from collections import deque
 from functools import partial
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -195,7 +195,14 @@ def _telemetry():
         reg = metrics.registry()
         for m in _TELEMETRY.values():
             reg.register(m)
-    return _TELEMETRY
+    # The migration/disagg families (serve/kv_transfer) register with
+    # the engine so `check_metrics --require` sees them at zero before
+    # any page ever moves.
+    from ray_tpu.serve import kv_transfer as _kvt
+
+    out = dict(_TELEMETRY)
+    out.update(_kvt._telemetry())
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -536,6 +543,26 @@ class LLMServer:
                 model_cfg, tensor_parallel=True,
                 dcn_quantized_allreduce=sg.quantized)
             mesh = create_serving_mesh(sg.size, sg.tensor_parallel)
+        # Disaggregated prefill/decode role (serve/kv_transfer),
+        # installed by the hosting ReplicaActor the same way the shard
+        # group is.  Roles need the prefix trie: migrated pages are
+        # identified and resumed through its chained path hashes.
+        from ray_tpu.serve.kv_transfer import current_disagg
+
+        self._disagg = current_disagg()
+        if (self._disagg is not None
+                and self._disagg.role != "unified"
+                and not engine_cfg.prefix_cache):
+            raise ValueError(
+                "disaggregated serving roles require "
+                "EngineConfig.prefix_cache=True (KV migration is keyed "
+                "by the prefix trie's chained path hashes)")
+        # Replica-local mirrors of the disagg counters: replicas run as
+        # separate actor processes, so tests and the state API read
+        # these through disagg_stats() instead of scraping the
+        # replica's own Prometheus registry.
+        self._handoff_counts = {"migrated": 0, "failed": 0, "local": 0}
+        self._disagg_requests = 0
         make_adapter = adapter_factory or (
             llama_paged_adapter if mesh is not None else llama_adapter)
         self.engine = LLMEngine(
@@ -561,7 +588,26 @@ class LLMServer:
         yields tokens as the engine generates them.  A preemption
         surfaces as PreemptedError AFTER every already-generated token
         has been yielded, so the router's failover knows the exact
-        delivered prefix."""
+        delivered prefix.
+
+        On a prefill-role replica a fresh request runs the handoff
+        protocol instead: prefill + the first handoff_after_tokens
+        tokens here, migrate the KV pages to a decode replica, then
+        raise MigrationHandoff so the client generator resumes the
+        stream there (the migrated prefix is a cache hit — no
+        recompute).  ANY transfer failure degrades to a plain
+        PreemptedError: the PR-5 continuation replay recomputes
+        locally, the stream never stalls."""
+        dis = self._disagg
+        if dis is not None and dis.role != "unified":
+            from ray_tpu.serve.kv_transfer import _telemetry as _kvt_tm
+
+            _kvt_tm()["disagg_requests"].inc(tags={"role": dis.role})
+            self._disagg_requests += 1
+            if (dis.role == "prefill"
+                    and not payload.get("_disagg_resumed")):
+                yield from self._stream_prefill_handoff(payload)
+                return
         stream = self.engine.submit(
             payload["tokens"],
             max_new_tokens=payload.get("max_new_tokens"),
@@ -570,6 +616,167 @@ class LLMServer:
         )
         for tok in stream:
             yield tok
+
+    def _pick_decode_target(self):
+        """(replica_id, handle) of one RUNNING decode-role replica of
+        this deployment, or None (controller gone, none running, …) —
+        checked BEFORE the truncated local submit so a missing target
+        degrades to unified serving, not a wasted handoff."""
+        from ray_tpu.core import api
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        dis = self._disagg
+        try:
+            controller = api.get_actor(CONTROLLER_NAME)
+            rows = api.get(controller.migration_targets.remote(
+                dis.app_name, dis.deployment_name, role="decode",
+                exclude=[dis.replica_id]), timeout=2.0)
+        except Exception:
+            return None
+        return rows[0] if rows else None
+
+    def _stream_prefill_handoff(self, payload: Dict[str, Any]):
+        from ray_tpu.core import api
+        from ray_tpu.serve import kv_transfer as _kvt
+
+        dis = self._disagg
+        tm = _kvt._telemetry()
+        requested = payload.get("max_new_tokens")
+        if requested is None:
+            requested = self.engine.config.max_new_tokens_default
+        target = self._pick_decode_target()
+        if target is None or requested <= dis.handoff_after_tokens:
+            # No decode replica (yet) or nothing left to hand off:
+            # serve unified locally rather than stall.
+            tm["disagg_handoffs"].inc(tags={"outcome": "local"})
+            self._handoff_counts["local"] += 1
+            stream = self.engine.submit(
+                payload["tokens"],
+                max_new_tokens=requested,
+                temperature=payload.get("temperature", 0.0),
+                request_id=payload.get("request_id"),
+            )
+            for tok in stream:
+                yield tok
+            return
+        # Phase 1: prefill + first tokens locally.  The request
+        # FINISHES here, so its prompt pages land in the prefix trie
+        # (the finish path donates full pages) — exactly what the
+        # lease below pins and exports.
+        stream = self.engine.submit(
+            payload["tokens"],
+            max_new_tokens=dis.handoff_after_tokens,
+            temperature=payload.get("temperature", 0.0),
+            request_id=payload.get("request_id"),
+        )
+        delivered: List[int] = []
+        for tok in stream:
+            delivered.append(tok)
+            yield tok
+        # Phase 2: migrate the request's cached pages to the target.
+        target_id, handle = target
+        seq = list(payload["tokens"]) + [int(t) for t in delivered]
+        mig_tokens = seq[:max(len(seq) - 1, 0)]
+        budget = dis.migration_timeout_s
+        migrated = False
+        try:
+            lease = self.engine.migration_lease(mig_tokens,
+                                                timeout_s=budget)
+            if lease is not None:
+                try:
+                    transfer = self.engine.migration_export(
+                        lease["lease_id"], mode=dis.transfer,
+                        timeout_s=budget)
+                    ref = handle.handle_request.remote(
+                        "ingest_kv_transfer", (transfer,), {}, None)
+                    api.get(ref, timeout=budget)
+                    migrated = True
+                finally:
+                    self.engine.migration_release(lease["lease_id"],
+                                                  timeout_s=budget)
+        except Exception as e:
+            log.warning("kv migration to %s failed (%r): falling back "
+                        "to local recompute", target_id, e)
+        continuation = {"prompt": list(payload["tokens"]),
+                        "tokens": list(delivered),
+                        "temperature": payload.get("temperature", 0.0),
+                        "request_id": payload.get("request_id")}
+        if migrated:
+            tm["disagg_handoffs"].inc(tags={"outcome": "migrated"})
+            self._handoff_counts["migrated"] += 1
+            raise _kvt.MigrationHandoff(
+                "prefill finished: KV pages migrated, resume on the "
+                "decode replica", continuation=continuation,
+                target_replica_id=target_id)
+        tm["disagg_handoffs"].inc(tags={"outcome": "failed"})
+        self._handoff_counts["failed"] += 1
+        raise PreemptedError(
+            "kv migration failed: resume via local recompute",
+            continuation=continuation)
+
+    def disagg_stats(self) -> Dict[str, Any]:
+        """Replica-local disaggregation counters (role, requests
+        entering under a role, handoff outcomes, migration traffic) —
+        the RPC-readable mirror of the raytpu_serve_disagg_* and
+        raytpu_serve_kv_migration_* families."""
+        dis = self._disagg
+        return {
+            "role": dis.role if dis is not None else "unified",
+            "requests": self._disagg_requests,
+            "handoffs": dict(self._handoff_counts),
+            "kv_migration": self.engine.stats().get("kv_migration", {}),
+        }
+
+    def ingest_kv_transfer(self, transfer: Dict[str, Any]) -> int:
+        """Replica-to-replica RPC target: land one migration transfer
+        in this engine's pool.  Returns pages ingested."""
+        return self.engine.migration_ingest(transfer)
+
+    def export_hot_prefixes(self, max_pages: int = 256,
+                            mode: str = "int8") -> List[Dict[str, Any]]:
+        """Replica-to-replica RPC target: serialize this engine's hot
+        cached prefixes (prefix migration, source side)."""
+        return self.engine.export_hot_prefixes(max_pages=max_pages,
+                                               mode=mode)
+
+    def pull_prefix_cache(self, max_pages: int = 256) -> int:
+        """Prefix migration, destination side: pull hot prefixes from
+        the warmest peer replica (longest published prefix summary)
+        into the local pool instead of recomputing them.  Returns pages
+        ingested; 0 when there is no peer or nothing to pull."""
+        from ray_tpu.core import api
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        dis = self._disagg
+        if dis is None or self.engine._prefix is None:
+            return 0
+        try:
+            controller = api.get_actor(CONTROLLER_NAME)
+            rows = api.get(controller.migration_targets.remote(
+                dis.app_name, dis.deployment_name, role=None,
+                exclude=[dis.replica_id], with_summary=True),
+                timeout=2.0)
+        except Exception:
+            return 0
+        rows = [r for r in rows if r[2]]  # peers with a summary
+        if not rows:
+            return 0
+        # Warmest peer = most published path hashes.
+        rows.sort(key=lambda r: (-len(r[2].get("hashes", ())), r[0]))
+        _, handle, _ = rows[0]
+        try:
+            transfers = api.get(handle.handle_request.remote(
+                "export_hot_prefixes", (max_pages, dis.transfer),
+                {}, None), timeout=dis.migration_timeout_s)
+        except Exception:
+            return 0
+        total = 0
+        for transfer in transfers:
+            try:
+                total += self.engine.migration_ingest(transfer)
+            except Exception as e:
+                log.warning("prefix-migration ingest failed: %r", e)
+        return total
 
     def drain(self, grace_s: float = 5.0) -> int:
         """Preemption notice: drain the engine (stop admitting, evict
@@ -678,6 +885,16 @@ class LLMEngine:
             self._prefix = None
             self._cache = adapter.init_cache(config.max_slots,
                                              config.max_seq_len)
+        # KV page-migration plane (serve/kv_transfer): clients enqueue
+        # lease/export/ingest ops here and the LOOP thread services
+        # them (_process_migrations) — the cache is donated between
+        # jitted dispatches, so only the loop may touch it.
+        self._mig_lock = threading.Lock()
+        self._mig_ops: List[Dict[str, Any]] = []
+        self._mig_leases: Dict[str, Dict[str, Any]] = {}
+        self._mig_lease_ids = itertools.count(1)
+        self._mig_counts = {"pages_out": 0, "pages_in": 0,
+                            "bytes_out": 0, "bytes_in": 0}
         self._waiting: "queue.Queue[Request]" = queue.Queue()
         self._slot_req: Dict[int, Request] = {}
         self._free_slots = list(range(config.max_slots))
@@ -883,6 +1100,36 @@ class LLMEngine:
                     return adapter.copy_page(cache, src, dst)
 
                 self._copy_page_fn = copy_page_fn
+
+                # Migration gather/scatter (serve/kv_transfer).  Page
+                # ids are padded to a power of two (fill = the OOB
+                # scratch page) to bound recompiles; the gather's
+                # padding rows are sliced off on the host, the
+                # scatter's padding rows write zeros into the scratch
+                # page, where nothing can read them.
+                @jax.jit
+                def mig_gather_fn(cache, ids):
+                    out = {"k": cache["k"][:, :, ids],
+                           "v": cache["v"][:, :, ids]}
+                    if "k_scale" in cache:
+                        out["k_scale"] = cache["k_scale"][:, ids]
+                        out["v_scale"] = cache["v_scale"][:, ids]
+                    return out
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def mig_scatter_fn(cache, ids, payload):
+                    out = dict(cache)
+                    for key in ("k", "v"):
+                        out[key] = cache[key].at[:, :, ids].set(
+                            payload[key])
+                    for key in ("k_scale", "v_scale"):
+                        if key in cache:
+                            out[key] = cache[key].at[:, ids].set(
+                                payload[key])
+                    return out
+
+                self._mig_gather_fn = mig_gather_fn
+                self._mig_scatter_fn = mig_scatter_fn
         else:
             self._ragged_step_fn = None
             self._token_budget = 0
@@ -1075,6 +1322,7 @@ class LLMEngine:
             pstats["hit_tokens"] = self._prefix_hit_tokens
             pstats["prompt_tokens"] = self._prefix_prompt_tokens
             out["prefix"] = pstats
+            out["kv_migration"] = dict(self._mig_counts)
         return out
 
     def prefix_summary(self, max_entries: int = 256) -> Optional[dict]:
@@ -2120,6 +2368,241 @@ class LLMEngine:
         for slot, req in list(self._slot_req.items()):
             self._preempt_request(req, slot)
 
+    # -- KV page migration (serve/kv_transfer) ------------------------------
+
+    def _migration_op(self, kind: str, timeout_s: float, **kw) -> Any:
+        """Enqueue one migration verb for the LOOP thread and wait for
+        its result (the cache is donated between jitted dispatches, so
+        only the loop may gather/scatter it — the same ownership rule
+        the cancel queue follows).  Re-raises whatever the verb raised
+        over there."""
+        if not self._paged or self._prefix is None:
+            raise RuntimeError(
+                "KV migration requires the paged engine with "
+                "EngineConfig.prefix_cache=True (transfers are keyed "
+                "by the prefix trie's chained path hashes)")
+        if self._stopped.is_set():
+            raise RuntimeError("engine stopped")
+        op: Dict[str, Any] = {"kind": kind, "done": threading.Event(),
+                              "result": None, "error": None, **kw}
+        with self._mig_lock:
+            self._mig_ops.append(op)
+        self._work.set()
+        if not op["done"].wait(timeout_s):
+            raise TimeoutError(
+                f"migration op {kind!r} not serviced within {timeout_s}s")
+        if op["error"] is not None:
+            raise op["error"]
+        return op["result"]
+
+    def migration_lease(self, tokens: Sequence[int], *,
+                        timeout_s: float = 30.0) -> Optional[dict]:
+        """Pin the longest cached full-page prefix of ``tokens`` under
+        an eviction-proof migration lease.  Returns ``{"lease_id",
+        "pages", "tokens"}`` (tokens truncated to the leased depth), or
+        None when not even one full page is cached.  The caller owns
+        the lease and MUST ``migration_release`` it on every path —
+        success, failure, and cancel."""
+        return self._migration_op("lease", timeout_s,
+                                  tokens=[int(t) for t in tokens])
+
+    def migration_export(self, lease_id: str, *, mode: str = "int8",
+                         timeout_s: float = 30.0) -> dict:
+        """Serialize a leased page run into one transfer dict (the
+        kv_transfer.encode_pages wire format: payload + per-page int8
+        scales + chained path hashes + analytic wire bytes)."""
+        return self._migration_op("export", timeout_s,
+                                  lease_id=lease_id, mode=mode)
+
+    def migration_release(self, lease_id: str, *,
+                          timeout_s: float = 30.0) -> bool:
+        """Drop a migration lease.  Idempotent — unknown ids return
+        False, because failure cleanup must never raise over a lease
+        that already went away."""
+        return self._migration_op("release", timeout_s,
+                                  lease_id=lease_id)
+
+    def migration_ingest(self, transfer: dict, *,
+                         timeout_s: float = 30.0) -> int:
+        """Ingest one transfer into the local pool + prefix trie:
+        verify content identity (chained CRC32 over the tokens), skip
+        depths already cached, scatter the payload into freshly
+        allocated pages, and insert them into the trie.  Truncates to
+        the free-page budget so the ingested prefix stays contiguous
+        from the root.  Returns the number of pages ingested."""
+        return self._migration_op("ingest", timeout_s, transfer=transfer)
+
+    def export_hot_prefixes(self, *, max_pages: int = 256,
+                            mode: str = "int8",
+                            timeout_s: float = 60.0) -> List[dict]:
+        """Prefix migration, source side: lease + export + release each
+        hot cached path (recency order, deduped) — a cold or newly
+        scaled replica ingests the returned transfers instead of
+        recomputing its cache."""
+        return self._migration_op("hot_prefixes", timeout_s,
+                                  max_pages=max_pages, mode=mode)
+
+    def _process_migrations(self) -> None:
+        if self._prefix is None:
+            return
+        with self._mig_lock:
+            if not self._mig_ops:
+                return
+            ops, self._mig_ops = self._mig_ops, []
+        handlers = {"lease": self._mig_do_lease,
+                    "export": self._mig_do_export,
+                    "release": self._mig_do_release,
+                    "ingest": self._mig_do_ingest,
+                    "hot_prefixes": self._mig_do_hot_prefixes}
+        for op in ops:
+            try:
+                op["result"] = handlers[op["kind"]](op)
+            except Exception as e:  # re-raised at the waiter; loop lives
+                op["error"] = e
+            op["done"].set()
+
+    @staticmethod
+    def _mig_pad_ids(pages: Sequence[int], fill: int) -> np.ndarray:
+        """Pad a page-id run to the next power of two (bounds the jit
+        compile count) with ``fill`` — the OOB scratch page, a valid
+        index whose contents nothing reads."""
+        n = max(1, len(pages))
+        padded = 1 << (n - 1).bit_length()
+        return np.asarray(list(pages) + [fill] * (padded - len(pages)),
+                          np.int32)
+
+    def _mig_do_lease(self, op: dict) -> Optional[dict]:
+        page = self.config.page_size
+        pages = self._prefix.lease_acquire(op["tokens"])
+        if not pages:
+            return None
+        lease_id = f"mig-{self._engine_id}-{next(self._mig_lease_ids)}"
+        self._mig_leases[lease_id] = {
+            "pages": list(pages),
+            "tokens": op["tokens"][:len(pages) * page]}
+        return {"lease_id": lease_id, "pages": list(pages),
+                "tokens": list(self._mig_leases[lease_id]["tokens"])}
+
+    def _mig_do_export(self, op: dict) -> dict:
+        from ray_tpu.serve import kv_transfer as _kvt
+
+        lease = self._mig_leases.get(op["lease_id"])
+        if lease is None:
+            raise KeyError(f"unknown migration lease {op['lease_id']!r}")
+        t0 = time.monotonic()
+        pages = lease["pages"]
+        ids = self._mig_pad_ids(pages, self._num_pages)
+        gathered = jax.device_get(self._mig_gather_fn(self._cache, ids))
+        n = len(pages)
+        gathered = {k: (v[:, :, :n] if k in ("k", "v") else v[:, :n])
+                    for k, v in gathered.items()}
+        transfer = _kvt.encode_pages(
+            gathered, tokens=lease["tokens"],
+            page_size=self.config.page_size, mode=op["mode"])
+        self._mig_counts["pages_out"] += n
+        self._mig_counts["bytes_out"] += transfer["wire_bytes"]
+        self._tm["mig_pages"].inc(n, tags={"direction": "out"})
+        self._tm["mig_bytes"].inc(transfer["wire_bytes"],
+                                  tags={"direction": "out"})
+        self._tm["mig_seconds"].observe(time.monotonic() - t0,
+                                        tags={"op": "export"})
+        return transfer
+
+    def _mig_do_release(self, op: dict) -> bool:
+        lease = self._mig_leases.pop(op["lease_id"], None)
+        if lease is None:
+            return False
+        self._prefix.lease_release(lease["pages"])
+        return True
+
+    def _mig_do_ingest(self, op: dict) -> int:
+        from ray_tpu.serve import kv_transfer as _kvt
+
+        transfer = op["transfer"]
+        page = self.config.page_size
+        if int(transfer["page_size"]) != page:
+            raise ValueError(
+                f"transfer page_size {transfer['page_size']} != local "
+                f"pool page_size {page}")
+        _kvt.verify_transfer(transfer)
+        t0 = time.monotonic()
+        tokens = [int(t) for t in transfer["tokens"]]
+        n_full = len(tokens) // page
+        # Depths the trie already holds keep their local pages; the
+        # borrow is returned immediately (nothing else runs between —
+        # the loop thread owns both the trie writes and eviction).
+        hit = self._prefix.acquire(tokens)
+        if hit:
+            self._prefix.release(hit)
+        have = len(hit)
+        need = n_full - have
+        if need <= 0:
+            return 0
+        if len(self._free_pages) < need:
+            freed = self._prefix.evict(need - len(self._free_pages))
+            self._free_pages.extend(freed)
+            if freed:
+                self._tm["prefix_evicted"].inc(len(freed))
+        # Truncate (never reorder): the ingested prefix must stay
+        # contiguous from the root or the hashes stop meaning "path".
+        need = min(need, len(self._free_pages))
+        if need <= 0:
+            return 0
+        dst = [self._free_pages.pop() for _ in range(need)]
+        quantized = (isinstance(self._cache, dict)
+                     and "k_scale" in self._cache)
+        payload = _kvt.decode_payload(
+            transfer, quantized, self._cache["k"].dtype,
+            start_page=have, end_page=have + need)
+        ids = self._mig_pad_ids(dst, self._num_pages)
+        pad = len(ids) - need
+        dev = {}
+        for key in ("k", "v"):
+            arr = payload[key]
+            if pad:
+                arr = np.concatenate(
+                    [arr, np.zeros((arr.shape[0], arr.shape[1], pad)
+                                   + arr.shape[3:], arr.dtype)], axis=2)
+            dev[key] = arr
+        if quantized:
+            for key in ("k_scale", "v_scale"):
+                arr = payload[key]
+                if pad:
+                    arr = np.concatenate(
+                        [arr, np.zeros((arr.shape[0], pad)
+                                       + arr.shape[2:], arr.dtype)],
+                        axis=1)
+                dev[key] = arr
+        self._cache = self._mig_scatter_fn(self._cache, ids, dev)
+        adopted = self._prefix.insert(tokens[:(have + need) * page],
+                                      hit + dst)
+        for p in dst:
+            if p not in adopted:  # lost a race with a local insert
+                self._free_pages.append(p)
+        n_in = sum(1 for p in dst if p in adopted)
+        wire = int(transfer.get("wire_bytes", 0))
+        self._mig_counts["pages_in"] += n_in
+        self._mig_counts["bytes_in"] += wire
+        self._tm["mig_pages"].inc(n_in, tags={"direction": "in"})
+        self._tm["mig_bytes"].inc(wire, tags={"direction": "in"})
+        self._tm["mig_seconds"].observe(time.monotonic() - t0,
+                                        tags={"op": "ingest"})
+        self._update_page_gauges()
+        return n_in
+
+    def _mig_do_hot_prefixes(self, op: dict) -> List[dict]:
+        out: List[dict] = []
+        for path in self._prefix.hot_paths(op["max_pages"]):
+            lease = self._mig_do_lease({"tokens": path["tokens"]})
+            if lease is None:
+                continue
+            try:
+                out.append(self._mig_do_export(
+                    {"lease_id": lease["lease_id"], "mode": op["mode"]}))
+            finally:
+                self._mig_do_release({"lease_id": lease["lease_id"]})
+        return out
+
     # Dispatched-but-unemitted entries: enough to keep the device and
     # the fetch pipe full; budget gating bounds per-slot run-ahead.
     _PIPELINE_DEPTH = 6
@@ -2137,6 +2620,13 @@ class LLMEngine:
         except BaseException as e:  # engine crash — fail every client
             self._stopped.set()
             self._fetchq.put(None)  # release the fetcher thread too
+            with self._mig_lock:  # release migration-op waiters too
+                mig_ops, self._mig_ops = self._mig_ops, []
+            for op in mig_ops:
+                op["error"] = RuntimeError(
+                    f"engine crashed before migration op "
+                    f"{op['kind']!r} ran: {e!r}")
+                op["done"].set()
             err = RuntimeError(f"LLM engine loop crashed: {e!r}")
             err.__cause__ = e
             failing = list(self._slot_req.values())
@@ -2170,6 +2660,7 @@ class LLMEngine:
         while not self._stopped.is_set():
             self._process_cancels()
             self._process_drain()
+            self._process_migrations()
             backlog = self._paged and (self._backlog or self._prefilling)
             if (not self._slot_req and self._waiting.empty()
                     and not backlog and self._unprocessed == 0):

@@ -93,7 +93,7 @@ def match_depth(tokens: Sequence[int], summary: Optional[dict]) -> int:
 
 class _Node:
     __slots__ = ("chunk", "page", "hash", "parent", "children", "refs",
-                 "last_used")
+                 "leases", "last_used")
 
     def __init__(self, chunk: Tuple[int, ...], page: int, h: int,
                  parent: Optional["_Node"]):
@@ -103,6 +103,7 @@ class _Node:
         self.parent = parent
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.refs = 0  # live borrowers (slots), NOT the cache's hold
+        self.leases = 0  # in-flight migration leases (kv_transfer)
         self.last_used = 0
 
 
@@ -185,6 +186,44 @@ class PrefixIndex:
                 node.refs -= 1
                 node.last_used = stamp
 
+    # -- migration leases --------------------------------------------------
+
+    def lease_acquire(self, tokens: Sequence[int]) -> List[int]:
+        """Pin the longest cached full-page prefix of ``tokens`` under a
+        migration lease (kv_transfer export).  Like ``acquire`` but on a
+        separate counter: leases pin pages against eviction without
+        looking like slot borrowers, so the free ∪ cached ∪ slot-owned
+        pool invariant keeps holding (leased pages stay cached).  Caller
+        must ``lease_release`` exactly these pages — including on
+        cancel/failure paths."""
+        with self._lock:
+            nodes = self._match_locked(tokens)
+            stamp = next(self._clock)
+            for node in nodes:
+                node.leases += 1
+                node.last_used = stamp
+            return [node.page for node in nodes]
+
+    def lease_release(self, pages: Sequence[int]) -> None:
+        """Drop a migration lease (one per page).  Leased pages cannot
+        be evicted, so an unknown page here is a lease-accounting bug —
+        raise, don't mask."""
+        with self._lock:
+            stamp = next(self._clock)
+            for p in pages:
+                node = self._by_page.get(p)
+                if node is None or node.leases <= 0:
+                    raise RuntimeError(
+                        f"prefix cache: lease release of page {p} not "
+                        f"leased (lease underflow)")
+                node.leases -= 1
+                node.last_used = stamp
+
+    def leased_pages(self) -> Set[int]:
+        """Pages currently pinned by at least one migration lease."""
+        with self._lock:
+            return {p for p, n in self._by_page.items() if n.leases > 0}
+
     # -- population --------------------------------------------------------
 
     def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> Set[int]:
@@ -232,7 +271,11 @@ class PrefixIndex:
             while len(freed) < n:
                 victim: Optional[_Node] = None
                 for node in self._by_page.values():
-                    if node.refs == 0 and not node.children:
+                    # leases pin against eviction exactly like borrows:
+                    # an in-flight migration export must never watch its
+                    # source pages get recycled under it.
+                    if (node.refs == 0 and node.leases == 0
+                            and not node.children):
                         if victim is None or node.last_used < victim.last_used:
                             victim = node
                 if victim is None:
@@ -257,6 +300,43 @@ class PrefixIndex:
             return {"page": self.page_size,
                     "hashes": [n.hash for n in nodes]}
 
+    def hot_paths(self, max_pages: int = 256) -> List[dict]:
+        """Recency-ordered root-to-node paths for prefix migration: each
+        entry is ``{"tokens", "pages", "hashes"}`` for one full cached
+        path (deepest hot node first), deduplicated so a path that is a
+        prefix of an earlier (hotter) one is skipped.  Bounded by the
+        total page count across returned paths."""
+        with self._lock:
+            nodes = sorted(self._by_page.values(),
+                           key=lambda n: -n.last_used)
+        out: List[dict] = []
+        covered: Set[int] = set()
+        budget = max_pages
+        for node in nodes:
+            if node.page in covered:
+                continue
+            path: List[_Node] = []
+            cur: Optional[_Node] = node
+            while cur is not None:
+                path.append(cur)
+                cur = cur.parent
+            path.reverse()
+            if len(path) > budget:
+                continue
+            tokens: List[int] = []
+            for p in path:
+                tokens.extend(p.chunk)
+            out.append({
+                "tokens": tokens,
+                "pages": [p.page for p in path],
+                "hashes": [p.hash for p in path],
+            })
+            covered.update(p.page for p in path)
+            budget -= len(path)
+            if budget <= 0:
+                break
+        return out
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -265,4 +345,6 @@ class PrefixIndex:
                 "inserted_pages": self.inserted_total,
                 "borrowed_refs": sum(n.refs
                                      for n in self._by_page.values()),
+                "leased_pages": sum(1 for n in self._by_page.values()
+                                    if n.leases > 0),
             }

@@ -68,7 +68,8 @@ class ReplicaActor:
     def __init__(self, app_name: str, deployment_name: str, replica_id: str,
                  func_or_class: Any, init_args: tuple, init_kwargs: dict,
                  user_config: Any, metrics_interval_s: float = 0.0,
-                 shard_group: Optional[dict] = None):
+                 shard_group: Optional[dict] = None,
+                 disagg: Optional[dict] = None):
         self.app_name = app_name
         self.deployment_name = deployment_name
         self.replica_id = replica_id
@@ -90,6 +91,14 @@ class ReplicaActor:
             )
 
             set_shard_group(ShardGroupContext(**shard_group))
+        if disagg is not None:
+            # Disaggregated prefill/decode role (config.disagg):
+            # install the ambient context BEFORE the user callable
+            # constructs, same pattern as the shard group — LLMServer
+            # reads it to run the KV-migration handoff protocol.
+            from ray_tpu.serve.kv_transfer import DisaggContext, set_disagg
+
+            set_disagg(DisaggContext(**disagg))
         if inspect.isclass(func_or_class):
             self._callable = func_or_class(*init_args, **init_kwargs)
         else:

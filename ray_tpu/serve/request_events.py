@@ -51,6 +51,12 @@ QUEUED = "QUEUED"
 PREFILLING = "PREFILLING"
 DECODING = "DECODING"
 RETRYING = "RETRYING"
+# MIGRATING is the disaggregated-serving sibling of RETRYING (also
+# router-side, also non-terminal): the prefill attempt finished, its KV
+# pages landed on a decode replica, and the stream is being resumed
+# there (serve/kv_transfer MigrationHandoff) — a planned handoff, not a
+# failure.
+MIGRATING = "MIGRATING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
 CANCELLED = "CANCELLED"
@@ -61,7 +67,7 @@ TERMINAL_STATES = (FINISHED, FAILED, CANCELLED, PREEMPTED)
 # Phase labels for the timeline rows: the span covering [state, next
 # state) is named after what the engine was doing IN that state.
 _PHASE_NAME = {QUEUED: "queued", PREFILLING: "prefill", DECODING: "decode",
-               RETRYING: "retrying"}
+               RETRYING: "retrying", MIGRATING: "migrating"}
 
 
 @dataclasses.dataclass

@@ -65,7 +65,8 @@ def _telemetry():
 
 class _ReplicaInfo:
     def __init__(self, replica_id: str, handle, max_ongoing: int,
-                 is_async: bool = False, prefix_summary=None):
+                 is_async: bool = False, prefix_summary=None,
+                 role: str = "unified"):
         self.replica_id = replica_id
         self.handle = handle
         self.max_ongoing = max_ongoing
@@ -75,6 +76,10 @@ class _ReplicaInfo:
         # through the controller broadcast ({"page", "hashes"}), or
         # None.  A routing HINT only — the engine re-matches exactly.
         self.prefix_summary = prefix_summary
+        # Disaggregated serving role ("prefill"|"decode"|"unified"):
+        # fresh LLM streams prefer prefill replicas; migrated streams
+        # resume on their handoff target (prefer_replica).
+        self.role = role
 
 
 def _payload_tokens(args: tuple) -> Optional[List[int]]:
@@ -135,23 +140,25 @@ class Router:
 
     def _update_replicas(self, table: List[Tuple[str, Any, int]]) -> None:
         """table: [(replica_id, actor_handle, max_ongoing_requests,
-        is_async, prefix_summary)]"""
+        is_async, prefix_summary, role)]"""
         with self._cv:
             fresh: Dict[str, _ReplicaInfo] = {}
             for row in table:
                 replica_id, handle, max_ongoing = row[:3]
                 is_async = bool(row[3]) if len(row) > 3 else False
                 summary = row[4] if len(row) > 4 else None
+                role = row[5] if len(row) > 5 else "unified"
                 old = self._replicas.get(replica_id)
                 if old is not None:
                     old.max_ongoing = max_ongoing
                     old.is_async = is_async
                     old.prefix_summary = summary
+                    old.role = role
                     fresh[replica_id] = old
                 else:
                     fresh[replica_id] = _ReplicaInfo(
                         replica_id, handle, max_ongoing, is_async,
-                        summary
+                        summary, role
                     )
             self._replicas = fresh
             # Drop affinity entries pointing at replicas that left the
@@ -214,12 +221,16 @@ class Router:
                          timeout: Optional[float] = None,
                          exclude: Optional[set] = None,
                          model_id: str = "",
-                         request_id: Optional[str] = None):
+                         request_id: Optional[str] = None,
+                         prefer_replica: Optional[str] = None):
         """Streaming assignment: dispatch handle_request_streaming on
         the chosen replica and return (ObjectRefGenerator, replica_id,
         request_id).  Streaming in-flight accounting is caller-driven —
         call finish_streaming(replica_id, ...) when the stream ends,
-        since the reaper has no single completion ref to poll."""
+        since the reaper has no single completion ref to poll.
+        ``prefer_replica``: route here if it is a live candidate (a
+        migrated stream resumes on the replica its KV pages landed on);
+        falls back to normal selection when it is gone."""
         deadline = None if timeout is None else time.monotonic() + timeout
         request_id = (request_id or _reqev.get_request_id()
                       or _reqev.new_request_id())
@@ -232,7 +243,8 @@ class Router:
             with tracing.span("serve.queue_wait"):
                 chosen = self._select_replica(deadline, timeout, exclude,
                                               model_id,
-                                              tokens=_payload_tokens(args))
+                                              tokens=_payload_tokens(args),
+                                              prefer_replica=prefer_replica)
             metadata = {"request_id": request_id}
             if model_id:
                 metadata["multiplexed_model_id"] = model_id
@@ -274,6 +286,17 @@ class Router:
         self._tm["retries"].inc(
             tags={"deployment": self.deployment_name})
 
+    def note_migrating(self, request_id: str, attempt: int,
+                       replica_id: str, target: str) -> None:
+        """One planned prefill→decode handoff (serve/kv_transfer):
+        MIGRATING transition + attempt history.  Not a retry — the
+        attempt SUCCEEDED and its pages moved — so the retries counter
+        stays untouched."""
+        self._ring.record(request_id, _reqev.MIGRATING, attempt=attempt,
+                          attempt_info={"attempt": attempt,
+                                        "replica": replica_id,
+                                        "reason": f"migrated:{target}"})
+
     def note_terminal(self, request_id: str, state: str,
                       cause: Optional[str] = None,
                       generated_tokens: Optional[int] = None) -> None:
@@ -282,7 +305,7 @@ class Router:
                           terminal_cause=cause)
 
     def _select_replica(self, deadline, timeout, exclude, model_id,
-                        tokens=None):
+                        tokens=None, prefer_replica=None):
         from ray_tpu.serve.prefix_index import match_depth
 
         with self._cv:
@@ -294,7 +317,25 @@ class Router:
                 ]
                 if candidates:
                     chosen = None
-                    if model_id:
+                    if prefer_replica is not None:
+                        # Migrated stream: its KV pages live on exactly
+                        # one replica — go there if it is still a live
+                        # candidate (else normal selection; the replay
+                        # fallback recomputes, never stalls).
+                        chosen = next(
+                            (r for r in candidates
+                             if r.replica_id == prefer_replica), None)
+                    if chosen is None and tokens is not None:
+                        # Disaggregated deployment: fresh LLM payloads
+                        # prefer a prefill-role replica.  Soft filter —
+                        # when no prefill replica is a candidate (all
+                        # dead/saturated), any replica serves the
+                        # request unified rather than blocking.
+                        prefill = [r for r in candidates
+                                   if r.role == "prefill"]
+                        if prefill:
+                            candidates = prefill
+                    if chosen is None and model_id:
                         # Sticky multiplexed routing: prefer the replica
                         # that already holds this model, if it has slack.
                         sticky = self._model_affinity.get(model_id)

@@ -116,7 +116,7 @@ def list_replicas(filters: Optional[List[Filter]] = None, *,
     except Exception:
         return []
     if not detail:
-        keep = ("app", "deployment", "replica_id", "state",
+        keep = ("app", "deployment", "replica_id", "state", "role",
                 "shard_group", "mesh_shape", "members")
         rows = [{k: r.get(k) for k in keep} for r in rows]
     return _apply_filters(rows, filters, limit)

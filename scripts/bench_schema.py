@@ -39,7 +39,14 @@ Checked:
   * prefix-cache blocks (a serving block's ``prefix``, reported by the
     zipf_chat mix): hit ratios in [0, 1], cold/hit50 request counts,
     and TTFT-by-hit-depth fields that are numeric or honestly null
-    (null only when that depth class saw no requests);
+    (null only when that depth class saw no requests); the optional
+    ``prefix.migration`` field (migrated-vs-recomputed prefix cost)
+    follows the same absent-not-zero rule — per-page costs null only
+    when that side measured nothing;
+  * the disaggregation ablation (extra.serving_disagg): both legs
+    carry TTFT + decode-ITL percentiles, and the disagg leg's
+    migration block must show pages actually moved with bytes on the
+    wire — a zero-page "disagg" leg measured unified serving twice;
   * the full-8B train rung (extra.llama_8b.train): must be MEASURED
     (measured=true, numeric mfu/toks in (0, 1]/(0, inf)), carry
     zero_sharding=true + dp_shards, and satisfy the memory claim
@@ -142,6 +149,47 @@ def _check_prefix(name: str, px: Any, problems: List[str]) -> None:
         problems.append(f"{name}: prefix has hit50_requests="
                         f"{px['hit50_requests']} but null "
                         f"ttft_mean_hit50_ms")
+    if "migration" in px:
+        _check_prefix_migration(name, px["migration"], problems)
+
+
+PREFIX_MIGRATION_REQUIRED = ("migrated_pages", "wire_bytes", "seconds")
+
+
+def _check_prefix_migration(name: str, mg: Any,
+                            problems: List[str]) -> None:
+    """The migrated-vs-recomputed prefix-cost field (zipf_chat): this
+    run's hot trie shipped to a cold engine over the int8 page wire,
+    against the same run's measured cold-prefill cost.  Per-page costs
+    may be null ONLY when their side measured nothing (no pages moved
+    / no cold requests) — the same absent-not-zero rule as the TTFT
+    depth classes, so a record can't fake an infinite migration win by
+    dropping its baseline."""
+    if not isinstance(mg, dict):
+        problems.append(f"{name}: prefix.migration is not an object")
+        return
+    if "error" in mg:  # probe failed; the record says so — valid
+        return
+    for k in PREFIX_MIGRATION_REQUIRED:
+        if not (_num(mg.get(k)) and mg[k] >= 0):
+            problems.append(f"{name}: prefix.migration.{k} missing or "
+                            f"not a number >= 0: {mg.get(k)!r}")
+    for k in ("migrate_s_per_page", "recompute_s_per_page",
+              "migrate_vs_recompute"):
+        v = mg.get(k, None)
+        if v is not None and not _num(v):
+            problems.append(f"{name}: prefix.migration.{k}={v!r} is "
+                            f"neither a number nor null")
+    pages = mg.get("migrated_pages")
+    if (_num(pages) and pages > 0
+            and mg.get("migrate_s_per_page") is None):
+        problems.append(f"{name}: prefix.migration has migrated_pages="
+                        f"{pages} but null migrate_s_per_page")
+    if (_num(pages) and pages > 0
+            and not (_num(mg.get("wire_bytes"))
+                     and mg["wire_bytes"] > 0)):
+        problems.append(f"{name}: prefix.migration moved {pages} pages "
+                        f"but put no bytes on the wire")
 
 
 def _check_serving(name: str, d: Any, problems: List[str]) -> None:
@@ -272,6 +320,94 @@ def _check_multihost(name: str, d: Any, problems: List[str]) -> None:
             f"ablation, found only {sorted(modes)}")
 
 
+DISAGG_LEG_REQUIRED = ("ttft_p50_ms", "ttft_p95_ms", "itl_p50_ms",
+                       "itl_p95_ms", "decode_tokens_per_s")
+DISAGG_MIG_REQUIRED = ("pages", "wire_bytes", "seconds", "failed")
+
+
+def _check_disagg(name: str, d: Any, problems: List[str]) -> None:
+    """The long_rag disaggregation on/off ablation: one unified engine
+    vs prefill -> kv_transfer page migration -> decode.  Both legs must
+    carry TTFT and decode-ITL percentiles (the two latencies the
+    role split exists to separate), and the disagg leg must have
+    actually moved pages over the wire — a 'disagg' record whose
+    migration block shows zero pages measured unified serving twice."""
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    if "error" in d:  # bench leg failed; the record says so — valid
+        return
+    for k in ("mix", "unified", "disagg", "n_requests", "gen",
+              "handoff_after_tokens", "transfer"):
+        if k not in d:
+            problems.append(f"{name}: missing required key {k!r}")
+    if "transfer" in d and d["transfer"] not in ("int8", "exact"):
+        problems.append(f"{name}: transfer must be 'int8' or 'exact', "
+                        f"got {d.get('transfer')!r}")
+    mix = d.get("mix")
+    if mix is not None:
+        if not isinstance(mix, dict):
+            problems.append(f"{name}: mix is not an object")
+        else:
+            if not isinstance(mix.get("name"), str):
+                problems.append(f"{name}: mix.name missing or "
+                                f"non-string: {mix.get('name')!r}")
+            lens = mix.get("lens")
+            weights = mix.get("weights")
+            if (not isinstance(lens, list) or not lens
+                    or not all(_num(x) for x in lens)):
+                problems.append(f"{name}: mix.lens must be a non-empty "
+                                f"list of numbers, got {lens!r}")
+            if (not isinstance(weights, list)
+                    or not all(_num(w) and w >= 0 for w in weights)):
+                problems.append(f"{name}: mix.weights must be a list "
+                                f"of non-negative numbers, got "
+                                f"{weights!r}")
+            elif isinstance(lens, list) and len(weights) != len(lens):
+                problems.append(f"{name}: mix has {len(lens)} lens but "
+                                f"{len(weights)} weights")
+            elif weights and abs(sum(weights) - 1.0) > 1e-3:
+                problems.append(f"{name}: mix.weights sum to "
+                                f"{sum(weights):.4f}, not 1")
+    for leg in ("unified", "disagg"):
+        block = d.get(leg)
+        if block is None:
+            continue
+        if not isinstance(block, dict):
+            problems.append(f"{name}.{leg}: not an object")
+            continue
+        for k in DISAGG_LEG_REQUIRED:
+            if not _num(block.get(k)):
+                problems.append(f"{name}.{leg}.{k} missing or "
+                                f"non-numeric: {block.get(k)!r}")
+    dis = d.get("disagg")
+    if isinstance(dis, dict):
+        mg = dis.get("migration")
+        if not isinstance(mg, dict):
+            problems.append(f"{name}.disagg: missing migration block")
+        else:
+            for k in DISAGG_MIG_REQUIRED:
+                if not (_num(mg.get(k)) and mg[k] >= 0):
+                    problems.append(
+                        f"{name}.disagg.migration.{k} missing or not a "
+                        f"number >= 0: {mg.get(k)!r}")
+            if _num(mg.get("pages")) and mg["pages"] == 0:
+                problems.append(
+                    f"{name}.disagg.migration.pages=0 — a disagg leg "
+                    f"that never moved a page measured unified serving "
+                    f"twice")
+            if (_num(mg.get("pages")) and mg["pages"] > 0
+                    and not (_num(mg.get("wire_bytes"))
+                             and mg["wire_bytes"] > 0)):
+                problems.append(
+                    f"{name}.disagg.migration: pages={mg['pages']} put "
+                    f"no bytes on the wire")
+    ratio = d.get("itl_p95_ratio", None)
+    if ratio is not None and not _num(ratio):
+        problems.append(f"{name}: itl_p95_ratio={ratio!r} is neither "
+                        f"a number nor null")
+
+
 ZERO_TRAIN_REQUIRED = ("params_b", "measured", "tokens_per_sec_per_chip",
                        "mfu", "zero_sharding", "dp_shards", "grad_accum",
                        "optimizer", "opt_state_bytes_per_param")
@@ -395,6 +531,9 @@ def validate_record(rec: Any) -> List[str]:
     if extra.get("serving_multihost") is not None:
         _check_multihost("extra.serving_multihost",
                          extra["serving_multihost"], problems)
+    if extra.get("serving_disagg") is not None:
+        _check_disagg("extra.serving_disagg", extra["serving_disagg"],
+                      problems)
     return problems
 
 

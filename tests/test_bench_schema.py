@@ -298,6 +298,100 @@ def test_multihost_multi_shard_rung_puts_bytes_on_dcn(schema):
     assert any("puts bytes on the DCN" in p for p in probs)
 
 
+# --- disaggregated prefill/decode ablation ---------------------------------
+
+
+def _disagg_leg():
+    return {"ttft_p50_ms": 120.0, "ttft_p95_ms": 310.0,
+            "itl_p50_ms": 18.0, "itl_p95_ms": 42.0,
+            "decode_tokens_per_s": 410.0}
+
+
+def _disagg_block():
+    dis = dict(_disagg_leg(), handoff_gap_p50_ms=12.0,
+               migration={"pages": 84, "wire_bytes": 1376256,
+                          "seconds": 0.41, "failed": 0})
+    return {"mix": {"name": "long_rag", "lens": [512, 1024, 1536],
+                    "weights": [0.3, 0.5, 0.2]},
+            "n_requests": 10, "gen": 24, "handoff_after_tokens": 2,
+            "transfer": "int8", "unified": _disagg_leg(),
+            "disagg": dis, "itl_p95_ratio": 1.35}
+
+
+def test_disagg_block_valid(schema):
+    rec = _record()
+    rec["extra"]["serving_disagg"] = _disagg_block()
+    assert schema.validate_record(rec) == []
+    rec["extra"]["serving_disagg"] = {"error": "RESOURCE_EXHAUSTED"}
+    assert schema.validate_record(rec) == []
+
+
+def test_disagg_required_keys_and_legs(schema):
+    rec = _record()
+    blk = _disagg_block()
+    del blk["unified"]["itl_p95_ms"]
+    blk["transfer"] = "bf16"
+    rec["extra"]["serving_disagg"] = blk
+    probs = schema.validate_record(rec)
+    assert any("unified.itl_p95_ms" in p for p in probs)
+    assert any("transfer must be 'int8' or 'exact'" in p for p in probs)
+
+
+def test_disagg_leg_must_move_pages(schema):
+    """A 'disagg' ablation whose migration block shows no pages on the
+    wire never disaggregated anything — flagged, as are pages without
+    bytes and a missing migration block entirely."""
+    rec = _record()
+    blk = _disagg_block()
+    rec["extra"]["serving_disagg"] = blk
+    blk["disagg"]["migration"]["pages"] = 0
+    probs = schema.validate_record(rec)
+    assert any("measured unified serving twice" in p for p in probs)
+    blk["disagg"]["migration"] = {"pages": 5, "wire_bytes": 0,
+                                  "seconds": 0.1, "failed": 0}
+    probs = schema.validate_record(rec)
+    assert any("no bytes on the wire" in p for p in probs)
+    del blk["disagg"]["migration"]
+    probs = schema.validate_record(rec)
+    assert any("missing migration block" in p for p in probs)
+
+
+def test_disagg_mix_distribution_checked(schema):
+    rec = _record()
+    blk = _disagg_block()
+    blk["mix"]["weights"] = [0.3, 0.5]
+    rec["extra"]["serving_disagg"] = blk
+    assert any("3 lens but 2 weights" in p
+               for p in schema.validate_record(rec))
+    blk["mix"]["weights"] = [0.3, 0.5, 0.1]
+    assert any("sum to 0.9" in p for p in schema.validate_record(rec))
+
+
+def test_prefix_migration_cost_field(schema):
+    """The migrated-vs-recomputed field in the zipf_chat prefix block:
+    valid when complete, per-page cost null only when nothing moved,
+    and an honest probe error passes through."""
+    rec = _mixed_record()
+    mixes = rec["extra"]["serving_mixed"]["mixes"]
+    mixes["zipf_chat"] = _mix_block()
+    px = _prefix_block()
+    px["migration"] = {"migrated_pages": 120, "wire_bytes": 1966080,
+                       "seconds": 0.8, "migrate_s_per_page": 0.0067,
+                       "recompute_s_per_page": 0.021,
+                       "migrate_vs_recompute": 3.13}
+    mixes["zipf_chat"]["prefix"] = px
+    assert schema.validate_record(rec) == []
+    px["migration"]["migrate_s_per_page"] = None  # but pages moved
+    probs = schema.validate_record(rec)
+    assert any("null migrate_s_per_page" in p for p in probs)
+    px["migration"] = {"migrated_pages": 120, "wire_bytes": 0,
+                       "seconds": 0.8, "migrate_s_per_page": 0.0067}
+    probs = schema.validate_record(rec)
+    assert any("no bytes on the wire" in p for p in probs)
+    px["migration"] = {"error": "cold engine OOM"}
+    assert schema.validate_record(rec) == []
+
+
 def test_bench_out_if_present(schema):
     """Whatever BENCH_OUT.json the last bench run left behind must
     satisfy the schema (skips when no run has happened here)."""
@@ -322,6 +416,8 @@ def test_bench_main_emits_file_and_stdout_line(schema, tmp_path,
     monkeypatch.setattr(bench, "_measure", lambda *a, **k: 1000.0)
     monkeypatch.setattr(bench, "_measure_serving_multihost",
                         lambda *a, **k: _multihost_block())
+    monkeypatch.setattr(bench, "_measure_serving_disagg",
+                        lambda *a, **k: _disagg_block())
     monkeypatch.chdir(tmp_path)
     bench.main()
     lines = capsys.readouterr().out.strip().splitlines()

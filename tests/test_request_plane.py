@@ -238,6 +238,14 @@ def test_request_plane_e2e(params):
             # scrape never silently loses them.
             "raytpu_serve_collective_bytes_total",
             "raytpu_serve_collective_seconds",
+            # Disaggregated serving plane: declared at engine
+            # construction so the scrape pins them even when no
+            # migration ever runs.
+            "raytpu_serve_kv_migration_pages_total",
+            "raytpu_serve_kv_migration_bytes_total",
+            "raytpu_serve_kv_migration_seconds",
+            "raytpu_serve_disagg_handoffs_total",
+            "raytpu_serve_disagg_requests_total",
         ]) == []
 
         # -- timeline: request rows, slot threads, globally ts-sorted -

@@ -169,10 +169,11 @@ def test_shard_group_bf16_byte_identical(mh_app, references):
     assert rows1 == rows2, "list_replicas is not deterministic"
     assert rows1, "no replica rows"
     r = rows1[0]
-    assert set(r) == {"app", "deployment", "replica_id", "state",
+    assert set(r) == {"app", "deployment", "replica_id", "state", "role",
                       "shard_group", "mesh_shape", "members"}
     assert r["app"] == APP
     assert r["state"] == "RUNNING"
+    assert r["role"] == "unified"  # no DisaggConfig on this deployment
     assert r["shard_group"] == 2
     assert r["mesh_shape"] == "dcn_tp=2 x tp=2"
     # rank 0 + one member, each rank:actor — ids distinct.

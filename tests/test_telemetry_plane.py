@@ -250,7 +250,15 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
                  # ZeRO memory plane: opt-state footprint + per-device
                  # HBM headroom (the latter absent-not-zero on CPU).
                  "raytpu_train_opt_state_bytes",
-                 "raytpu_train_hbm_headroom_bytes"]) == []
+                 "raytpu_train_hbm_headroom_bytes",
+                 # Disaggregated serving plane: KV page-migration
+                 # traffic + handoff outcomes, declared at engine
+                 # construction even when no migration ever runs.
+                 "raytpu_serve_kv_migration_pages_total",
+                 "raytpu_serve_kv_migration_bytes_total",
+                 "raytpu_serve_kv_migration_seconds",
+                 "raytpu_serve_disagg_handoffs_total",
+                 "raytpu_serve_disagg_requests_total"]) == []
     assert cm.check_registry() == []
 
 
