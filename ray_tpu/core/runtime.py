@@ -3558,6 +3558,13 @@ class LocalRuntime:
             agent.shutdown_daemon()
         if self.worker_pool is not None:
             self.worker_pool.shutdown()
+        # Drop the federated per-process metric snapshots (they ride
+        # worker replies, see apply_ref_batches): those processes are
+        # gone, so their series would otherwise show up as stale
+        # samples in the NEXT cluster's /metrics scrape forever.
+        from ray_tpu.util import metrics as _metrics
+
+        _metrics.clear_remote()
         if self._log_monitor is not None:
             # AFTER the pool: stop()'s final sweep then sees everything
             # the dying workers flushed.

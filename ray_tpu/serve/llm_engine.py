@@ -563,6 +563,9 @@ class LLMServer:
         # replica's own Prometheus registry.
         self._handoff_counts = {"migrated": 0, "failed": 0, "local": 0}
         self._disagg_requests = 0
+        # Round-robin fallback for handoff-target spreading when a
+        # payload carries no request id to hash.
+        self._handoff_rr = itertools.count()
         make_adapter = adapter_factory or (
             llama_paged_adapter if mesh is not None else llama_adapter)
         self.engine = LLMEngine(
@@ -617,11 +620,20 @@ class LLMServer:
         for tok in stream:
             yield tok
 
-    def _pick_decode_target(self):
+    def _pick_decode_target(self, request_id: Optional[str] = None):
         """(replica_id, handle) of one RUNNING decode-role replica of
         this deployment, or None (controller gone, none running, …) —
         checked BEFORE the truncated local submit so a missing target
-        degrades to unified serving, not a wasted handoff."""
+        degrades to unified serving, not a wasted handoff.
+
+        Spread, don't hot-spot: the controller's target list is sorted
+        by replica id, so always taking rows[0] would funnel every
+        handoff from every prefill replica to the single lowest-id
+        decode replica.  Hash the request id across the candidates
+        (stable per request, so a retried handoff re-picks the same
+        target); payloads without an id round-robin instead."""
+        import zlib
+
         from ray_tpu.core import api
         from ray_tpu.serve.controller import CONTROLLER_NAME
 
@@ -633,7 +645,13 @@ class LLMServer:
                 exclude=[dis.replica_id]), timeout=2.0)
         except Exception:
             return None
-        return rows[0] if rows else None
+        if not rows:
+            return None
+        if request_id:
+            idx = zlib.crc32(str(request_id).encode()) % len(rows)
+        else:
+            idx = next(self._handoff_rr) % len(rows)
+        return rows[idx]
 
     def _stream_prefill_handoff(self, payload: Dict[str, Any]):
         from ray_tpu.core import api
@@ -644,7 +662,7 @@ class LLMServer:
         requested = payload.get("max_new_tokens")
         if requested is None:
             requested = self.engine.config.max_new_tokens_default
-        target = self._pick_decode_target()
+        target = self._pick_decode_target(payload.get("request_id"))
         if target is None or requested <= dis.handoff_after_tokens:
             # No decode replica (yet) or nothing left to hand off:
             # serve unified locally rather than stall.
@@ -673,6 +691,20 @@ class LLMServer:
         for tok in stream:
             delivered.append(tok)
             yield tok
+        # The stream may have finished NATURALLY inside phase 1 (eos or
+        # the max_seq_len cap within the first handoff_after_tokens
+        # tokens).  Handing off anyway would resume it on the decode
+        # replica and generate past the finish — outputs must stay
+        # byte-identical to unified serving, so end the stream here.
+        eos_id = self.engine.config.eos_id
+        if (len(delivered) < dis.handoff_after_tokens
+                or (eos_id is not None and delivered
+                    and int(delivered[-1]) == eos_id)
+                or (len(payload["tokens"]) + len(delivered)
+                    >= self.engine.config.max_seq_len)):
+            tm["disagg_handoffs"].inc(tags={"outcome": "local"})
+            self._handoff_counts["local"] += 1
+            return
         # Phase 2: migrate the request's cached pages to the target.
         target_id, handle = target
         seq = list(payload["tokens"]) + [int(t) for t in delivered]
@@ -2384,13 +2416,28 @@ class LLMEngine:
         if self._stopped.is_set():
             raise RuntimeError("engine stopped")
         op: Dict[str, Any] = {"kind": kind, "done": threading.Event(),
-                              "result": None, "error": None, **kw}
+                              "result": None, "error": None,
+                              "abandoned": False, **kw}
         with self._mig_lock:
             self._mig_ops.append(op)
         self._work.set()
         if not op["done"].wait(timeout_s):
-            raise TimeoutError(
-                f"migration op {kind!r} not serviced within {timeout_s}s")
+            with self._mig_lock:
+                if not op["done"].is_set():
+                    # Still queued: pull it so the loop never runs it.
+                    # Already in flight: flag it abandoned — the loop
+                    # auto-releases a lease nobody will ever own (a
+                    # leaked lease pins its pages against eviction
+                    # forever) and drops the unread result.
+                    try:
+                        self._mig_ops.remove(op)
+                    except ValueError:
+                        op["abandoned"] = True
+                    raise TimeoutError(
+                        f"migration op {kind!r} not serviced within "
+                        f"{timeout_s}s")
+            # done was set between the wait() expiry and taking the
+            # lock: the op completed, its result is usable.
         if op["error"] is not None:
             raise op["error"]
         return op["result"]
@@ -2459,7 +2506,20 @@ class LLMEngine:
                 op["result"] = handlers[op["kind"]](op)
             except Exception as e:  # re-raised at the waiter; loop lives
                 op["error"] = e
-            op["done"].set()
+            with self._mig_lock:
+                # A waiter that timed out mid-service marked the op
+                # abandoned: nobody will read the result, so a lease
+                # acquired here would leak (eviction-pinned pages with
+                # no owner to release them) — drop it on the spot.  The
+                # lock orders this against the waiter's flag write: if
+                # the waiter loses the race, it sees done set and uses
+                # the result normally.
+                if (op["abandoned"] and op["kind"] == "lease"
+                        and op.get("result") is not None):
+                    self._mig_do_release(
+                        {"lease_id": op["result"]["lease_id"]})
+                    op["result"] = None
+                op["done"].set()
 
     @staticmethod
     def _mig_pad_ids(pages: Sequence[int], fill: int) -> np.ndarray:
@@ -2528,58 +2588,65 @@ class LLMEngine:
         t0 = time.monotonic()
         tokens = [int(t) for t in transfer["tokens"]]
         n_full = len(tokens) // page
-        # Depths the trie already holds keep their local pages; the
-        # borrow is returned immediately (nothing else runs between —
-        # the loop thread owns both the trie writes and eviction).
+        # Depths the trie already holds keep their local pages.  The
+        # borrow stays held across the eviction AND the insert below:
+        # evict() reclaims any refcount-0 page, so releasing the hit
+        # pages first would let it free pages the insert is about to
+        # re-adopt — the same page simultaneously on _free_pages and in
+        # the trie, i.e. silent KV corruption.
         hit = self._prefix.acquire(tokens)
-        if hit:
-            self._prefix.release(hit)
-        have = len(hit)
-        need = n_full - have
-        if need <= 0:
-            return 0
-        if len(self._free_pages) < need:
-            freed = self._prefix.evict(need - len(self._free_pages))
-            self._free_pages.extend(freed)
-            if freed:
-                self._tm["prefix_evicted"].inc(len(freed))
-        # Truncate (never reorder): the ingested prefix must stay
-        # contiguous from the root or the hashes stop meaning "path".
-        need = min(need, len(self._free_pages))
-        if need <= 0:
-            return 0
-        dst = [self._free_pages.pop() for _ in range(need)]
-        quantized = (isinstance(self._cache, dict)
-                     and "k_scale" in self._cache)
-        payload = _kvt.decode_payload(
-            transfer, quantized, self._cache["k"].dtype,
-            start_page=have, end_page=have + need)
-        ids = self._mig_pad_ids(dst, self._num_pages)
-        pad = len(ids) - need
-        dev = {}
-        for key in ("k", "v"):
-            arr = payload[key]
-            if pad:
-                arr = np.concatenate(
-                    [arr, np.zeros((arr.shape[0], arr.shape[1], pad)
-                                   + arr.shape[3:], arr.dtype)], axis=2)
-            dev[key] = arr
-        if quantized:
-            for key in ("k_scale", "v_scale"):
+        try:
+            have = len(hit)
+            need = n_full - have
+            if need <= 0:
+                return 0
+            if len(self._free_pages) < need:
+                freed = self._prefix.evict(need - len(self._free_pages))
+                self._free_pages.extend(freed)
+                if freed:
+                    self._tm["prefix_evicted"].inc(len(freed))
+            # Truncate (never reorder): the ingested prefix must stay
+            # contiguous from the root or the hashes stop meaning
+            # "path".
+            need = min(need, len(self._free_pages))
+            if need <= 0:
+                return 0
+            dst = [self._free_pages.pop() for _ in range(need)]
+            quantized = (isinstance(self._cache, dict)
+                         and "k_scale" in self._cache)
+            payload = _kvt.decode_payload(
+                transfer, quantized, self._cache["k"].dtype,
+                start_page=have, end_page=have + need)
+            ids = self._mig_pad_ids(dst, self._num_pages)
+            pad = len(ids) - need
+            dev = {}
+            for key in ("k", "v"):
                 arr = payload[key]
                 if pad:
                     arr = np.concatenate(
-                        [arr, np.zeros((arr.shape[0], pad)
-                                       + arr.shape[2:], arr.dtype)],
-                        axis=1)
+                        [arr, np.zeros((arr.shape[0], arr.shape[1], pad)
+                                       + arr.shape[3:], arr.dtype)],
+                        axis=2)
                 dev[key] = arr
-        self._cache = self._mig_scatter_fn(self._cache, ids, dev)
-        adopted = self._prefix.insert(tokens[:(have + need) * page],
-                                      hit + dst)
-        for p in dst:
-            if p not in adopted:  # lost a race with a local insert
-                self._free_pages.append(p)
-        n_in = sum(1 for p in dst if p in adopted)
+            if quantized:
+                for key in ("k_scale", "v_scale"):
+                    arr = payload[key]
+                    if pad:
+                        arr = np.concatenate(
+                            [arr, np.zeros((arr.shape[0], pad)
+                                           + arr.shape[2:], arr.dtype)],
+                            axis=1)
+                    dev[key] = arr
+            self._cache = self._mig_scatter_fn(self._cache, ids, dev)
+            adopted = self._prefix.insert(tokens[:(have + need) * page],
+                                          hit + dst)
+            for p in dst:
+                if p not in adopted:  # lost a race with a local insert
+                    self._free_pages.append(p)
+            n_in = sum(1 for p in dst if p in adopted)
+        finally:
+            if hit:
+                self._prefix.release(hit)
         wire = int(transfer.get("wire_bytes", 0))
         self._mig_counts["pages_in"] += n_in
         self._mig_counts["bytes_in"] += wire
@@ -2692,3 +2759,12 @@ class LLMEngine:
             if not dispatched and self._unprocessed > 0:
                 # Nothing to dispatch — wait for the fetcher.
                 self._process_fetched(block=True)
+        # Clean stop: drain queued migration ops exactly like the crash
+        # path does, so their waiters get an immediate "engine stopped"
+        # instead of hanging until their timeout expires.
+        with self._mig_lock:
+            mig_ops, self._mig_ops = self._mig_ops, []
+        for op in mig_ops:
+            op["error"] = RuntimeError(
+                f"engine stopped before migration op {op['kind']!r} ran")
+            op["done"].set()

@@ -234,6 +234,12 @@ class Router:
         deadline = None if timeout is None else time.monotonic() + timeout
         request_id = (request_id or _reqev.get_request_id()
                       or _reqev.new_request_id())
+        # A migrated stream is past its prefill: whether or not its
+        # preferred target is still alive, it must not be steered back
+        # into the prefill pool by the role filter.
+        resumed = (prefer_replica is not None
+                   or bool(args and isinstance(args[0], dict)
+                           and args[0].get("_disagg_resumed")))
         with tracing.span(
                 "serve.request",
                 attributes={"deployment": self.deployment_name,
@@ -244,7 +250,8 @@ class Router:
                 chosen = self._select_replica(deadline, timeout, exclude,
                                               model_id,
                                               tokens=_payload_tokens(args),
-                                              prefer_replica=prefer_replica)
+                                              prefer_replica=prefer_replica,
+                                              resumed=resumed)
             metadata = {"request_id": request_id}
             if model_id:
                 metadata["multiplexed_model_id"] = model_id
@@ -305,7 +312,8 @@ class Router:
                           terminal_cause=cause)
 
     def _select_replica(self, deadline, timeout, exclude, model_id,
-                        tokens=None, prefer_replica=None):
+                        tokens=None, prefer_replica=None,
+                        resumed=False):
         from ray_tpu.serve.prefix_index import match_depth
 
         with self._cv:
@@ -325,12 +333,17 @@ class Router:
                         chosen = next(
                             (r for r in candidates
                              if r.replica_id == prefer_replica), None)
-                    if chosen is None and tokens is not None:
+                    if (chosen is None and tokens is not None
+                            and not resumed):
                         # Disaggregated deployment: fresh LLM payloads
                         # prefer a prefill-role replica.  Soft filter —
                         # when no prefill replica is a candidate (all
                         # dead/saturated), any replica serves the
-                        # request unified rather than blocking.
+                        # request unified rather than blocking.  Resumed
+                        # (migrated) streams skip it: if their handoff
+                        # target died, cache-aware selection over every
+                        # candidate should run — steering them back to a
+                        # prefill replica would skew the role split.
                         prefill = [r for r in candidates
                                    if r.role == "prefill"]
                         if prefill:
