@@ -736,6 +736,112 @@ def _measure_serving_disagg(cfg, *, n_requests: int = 10, gen: int = 24,
     }
 
 
+def _measure_serving_adapters(cfg, *, n_adapters: int = 6,
+                              pool_adapters: int = 4,
+                              n_requests: int = 24, gen: int = 16,
+                              prompt_len: int = 96,
+                              zipf_alpha: float = 1.1,
+                              arrival_rate: float = 8.0,
+                              slots: int = 8, rank: int = 4,
+                              params=None) -> dict:
+    """zipf_adapters: multi-tenant LoRA multiplexing vs single-model.
+
+    Requests draw their adapter id from a Zipf popularity curve over
+    ``n_adapters`` tenants while the paged pool only holds
+    ``pool_adapters`` of them — the head tenants stay resident (pool
+    hits) and the tail churns through the refcount-0 LRU (misses +
+    evictions), which is the steady state a multiplexed deployment
+    runs in.  The single-model leg serves the SAME prompts through the
+    same engine shape without LoRA; ``throughput_degradation`` =
+    multiplexed tokens/s over single-model tokens/s, the price of the
+    segmented gathered-einsum delta plus adapter load churn."""
+    import dataclasses as _dc
+
+    from ray_tpu.ops import segmented_lora as _sl
+    from ray_tpu.serve.llm_engine import (
+        EngineConfig,
+        LLMEngine,
+        llama_paged_adapter,
+    )
+
+    if params is None:
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(11)
+    ranks = np.arange(1, n_adapters + 1, dtype=np.float64)
+    pz = ranks ** -zipf_alpha
+    pz /= pz.sum()
+    draws = rng.choice(n_adapters, n_requests, p=pz)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+    max_seq = min(cfg.max_seq_len,
+                  max(128, int(64 * np.ceil((prompt_len + gen + 1)
+                                            / 64))))
+    lora = _sl.LoRAConfig(rank=rank, alpha=2.0 * rank)
+    page_elems = 8192
+    pp = -(-_sl.adapter_elems(cfg, lora) // page_elems)
+
+    def run_leg(model_cfg, ids):
+        ecfg = EngineConfig(
+            max_slots=slots, max_seq_len=max_seq, page_size=32,
+            decode_chunk=4, ragged_batching=True, prefill_chunk=64,
+            max_new_tokens_default=gen,
+            adapter_pool_pages=(pool_adapters * pp if ids else 0),
+            adapter_page_elems=page_elems)
+        eng = LLMEngine(params, llama_paged_adapter(model_cfg), ecfg)
+        try:
+            # Warm compile off the clock (the LoRA program too).
+            eng.submit(prompts[0][: prompt_len // 2],
+                       max_new_tokens=gen, temperature=0.0,
+                       adapter_id=(ids[0] if ids else "")
+                       ).result(timeout_s=600)
+            t0 = time.perf_counter()
+            streams = []
+            for i, p in enumerate(prompts):
+                delay = t0 + i / arrival_rate - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                streams.append(eng.submit(
+                    p, max_new_tokens=gen, temperature=0.0,
+                    adapter_id=(ids[i] if ids else "")))
+            outs = [s.result(timeout_s=600) for s in streams]
+            dt = time.perf_counter() - t0
+            ttfts = sorted(s._req.ttft_s for s in streams)
+            leg = {
+                "tokens_per_s": round(sum(len(o) for o in outs) / dt,
+                                      1),
+                "ttft_p50_ms": round(
+                    ttfts[len(ttfts) // 2] * 1e3, 2),
+                "ttft_p95_ms": round(
+                    ttfts[min(len(ttfts) - 1,
+                              int(0.95 * len(ttfts)))] * 1e3, 2),
+            }
+            pool = (eng.stats() or {}).get("adapters")
+            if pool is not None:
+                leg["pool"] = {k: pool[k] for k in
+                               ("pool_pages", "resident", "hits",
+                                "misses", "evictions", "hit_ratio")}
+            return leg
+        finally:
+            eng.shutdown()
+
+    single = run_leg(cfg, None)
+    ids = [f"tenant-{d}" for d in draws]
+    multi = run_leg(_dc.replace(cfg, lora=lora), ids)
+    degr = None
+    if single["tokens_per_s"]:
+        degr = round(multi["tokens_per_s"] / single["tokens_per_s"], 3)
+    return {
+        "mix": {"name": "zipf_adapters", "n_adapters": n_adapters,
+                "zipf_alpha": zipf_alpha,
+                "pool_adapters": pool_adapters, "rank": rank},
+        "n_requests": n_requests,
+        "gen": gen,
+        "single_model": single,
+        "multi": multi,
+        "throughput_degradation": degr,
+    }
+
+
 def _measure_serving_mixed(cfg, *, n_requests: int = 48,
                            gen: int = 32, slots: int = 32,
                            arrival_rate: float = 8.0,
@@ -1189,6 +1295,21 @@ def main():
                 "arrival_rate": 4.0}))
     except Exception as e:
         extra["serving_disagg"] = {
+            "error": repr(e).replace(": ", ":").replace(", ", ",")[:120]}
+
+    # Multi-tenant LoRA multiplexing: Zipf adapter popularity through
+    # the paged adapter pool vs the same traffic single-model — pool
+    # hit ratio and the segmented-matmul throughput price.  Runs on
+    # CPU too with scaled counts, so every record carries it.
+    try:
+        extra["serving_adapters"] = _measure_serving_adapters(
+            dataclasses.replace(cfg, max_seq_len=512),
+            **({} if on_tpu else
+               {"n_adapters": 5, "pool_adapters": 3, "n_requests": 12,
+                "gen": 8, "prompt_len": 48, "arrival_rate": 6.0,
+                "slots": 4, "rank": 2}))
+    except Exception as e:
+        extra["serving_adapters"] = {
             "error": repr(e).replace(": ", ":").replace(", ", ",")[:120]}
 
     result = {

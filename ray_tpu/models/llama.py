@@ -88,6 +88,11 @@ class LlamaConfig:
     # small batches.  Falls back to the unfused path under
     # tensor_parallel (the fused kernel is single-shard).
     fused_decode: bool = False
+    # Multi-tenant LoRA multiplexing: an ops.segmented_lora.LoRAConfig
+    # enables the per-row segmented adapter path in ragged_step_paged
+    # (serve/adapter_pool.py holds the paged factors).  None = base
+    # model only — the serving programs are structurally unchanged.
+    lora: Optional[Any] = None
 
     @property
     def head_dim(self) -> int:
@@ -1374,6 +1379,7 @@ def ragged_step_paged(
     cache: Dict[str, jax.Array],
     *,
     max_row_tokens: Optional[int] = None,
+    lora=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One unified serving step over a ragged batch mixing prefill
     chunks (row_len > 1) and decode rows (row_len == 1).
@@ -1389,7 +1395,16 @@ def ragged_step_paged(
 
     Returns (logits [R, V] float32 at each row's LAST fresh token,
     new_cache).  Padding rows (row_len == 0) return garbage logits —
-    callers mask by row_len.  Length bookkeeping stays host-side."""
+    callers mask by row_len.  Length bookkeeping stays host-side.
+
+    ``lora`` is an optional ``(stacks, tok_adapter, scale)`` triple
+    (ops/segmented_lora): per-token segmented LoRA deltas are added at
+    every targeted projection — qkv PRE-RoPE, where the base
+    projections land.  Rows whose ``tok_adapter`` index gathers the
+    pool's zero scratch page see exact-zero deltas, keeping base-model
+    rows byte-identical to this function with ``lora=None``.  The
+    segmented path always runs unfused (like tensor_parallel, the
+    fused megakernel has no per-token weight gather)."""
     if cfg.tensor_parallel:
         raise NotImplementedError(
             "ragged_step_paged does not shard over tensor_parallel "
@@ -1407,7 +1422,8 @@ def ragged_step_paged(
     sin1, cos1 = sin[0], cos[0]                    # [T, hd//2]
     x = params["tok_embed"][tokens].astype(cfg.dtype)   # [T, D]
 
-    if cfg.fused_decode:
+    xs = params["layers"]
+    if cfg.fused_decode and lora is None:
         layer_fn = partial(
             fused_ragged_layer,
             eps=cfg.norm_eps, n_heads=cfg.n_heads,
@@ -1422,7 +1438,7 @@ def ragged_step_paged(
                                  row_slot, row_start, row_len, row_off,
                                  block_tables, sin1, cos1)
             return (x, li + 1), (k1, v1)
-    else:
+    elif lora is None:
         def body(carry, layer):
             x, li = carry
             layer = _deq_layer(layer, cfg.dtype)
@@ -1447,9 +1463,96 @@ def ragged_step_paged(
                                         cfg.norm_eps)[None],
                                layer, cfg)[0]
             return (h, li + 1), (k1, v1)
+    else:
+        # Segmented LoRA body: the base body's exact op sequence (same
+        # einsums, same cast points) with per-token adapter deltas
+        # added at each targeted projection.  A delta that gathers the
+        # scratch page is exactly 0.0, and x + 0.0 is exact in every
+        # IEEE dtype — null rows stay bit-identical to the base body.
+        from ray_tpu.ops.segmented_lora import segmented_lora_delta
+        stacks, tok_adapter, lora_scale = lora
+        H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        xs = (params["layers"], stacks)
+
+        def body(carry, layer_and_stk):
+            x, li = carry
+            layer, stk = layer_and_stk
+            layer = _deq_layer(layer, cfg.dtype)
+            dt = cfg.dtype
+
+            def delta(name, inp):
+                if name not in stk:
+                    return None
+                return segmented_lora_delta(
+                    inp, stk[name]["a"], stk[name]["b"], tok_adapter,
+                    lora_scale, dt)
+
+            normed = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+            a = layer["attn"]
+            x1 = normed[None]
+            dqkv = delta("qkv", normed)            # joint pre-RoPE delta
+            if "wqkv" in a:
+                qkv = jnp.einsum("bsd,dc->bsc", x1, a["wqkv"].astype(dt))
+                if dqkv is not None:
+                    qkv = qkv + dqkv[None]
+                q, k, v = jnp.split(qkv, [H * hd, (H + KVH) * hd],
+                                    axis=-1)
+                q = q.reshape(1, T, H, hd)
+                k = k.reshape(1, T, KVH, hd)
+                v = v.reshape(1, T, KVH, hd)
+            else:
+                q = jnp.einsum("bsd,dhk->bshk", x1, a["wq"].astype(dt))
+                k = jnp.einsum("bsd,dhk->bshk", x1, a["wk"].astype(dt))
+                v = jnp.einsum("bsd,dhk->bshk", x1, a["wv"].astype(dt))
+                if dqkv is not None:
+                    dq, dk, dv = jnp.split(dqkv, [H * hd, (H + KVH) * hd],
+                                           axis=-1)
+                    q = q + dq.reshape(1, T, H, hd)
+                    k = k + dk.reshape(1, T, KVH, hd)
+                    v = v + dv.reshape(1, T, KVH, hd)
+            q = apply_rope(q, sin, cos)
+            k = apply_rope(k, sin, cos)
+            q, k1, v1 = q[0], k[0], v[0]           # [T, H/KVH, hd]
+            out = ragged_paged_attention(
+                q, k1, v1, cache["k"], cache["v"], li,
+                row_slot, row_start, row_len, row_off, block_tables,
+                soft_cap=cfg.logits_soft_cap,
+                k_scales=cache.get("k_scale"),
+                v_scales=cache.get("v_scale"),
+                max_row_tokens=max_row_tokens)     # [T, H, hd] f32
+            attn_f = out.astype(dt)                # base body's cast point
+            o = jnp.einsum("thk,hkd->td", attn_f, a["wo"].astype(dt))
+            do = delta("o", attn_f.reshape(T, H * hd))
+            if do is not None:
+                o = o + do
+            h = x + o.astype(x.dtype)
+            xm = rms_norm(h, layer["ln_mlp"], cfg.norm_eps)
+            m = layer["mlp"]
+            xm1 = xm[None]
+            if "w_gateup" in m:
+                gu = jnp.einsum("bsd,dm->bsm", xm1,
+                                m["w_gateup"].astype(dt))
+                gate, up = jnp.split(gu, 2, axis=-1)
+            else:
+                gate = jnp.einsum("bsd,dm->bsm", xm1,
+                                  m["w_gate"].astype(dt))
+                up = jnp.einsum("bsd,dm->bsm", xm1, m["w_up"].astype(dt))
+            dg = delta("gate", xm)
+            du = delta("up", xm)
+            if dg is not None:
+                gate = gate + dg[None]
+            if du is not None:
+                up = up + du[None]
+            act = jax.nn.silu(gate) * up
+            down = jnp.einsum("bsm,md->bsd", act, m["w_down"].astype(dt))
+            dd = delta("down", act[0])
+            if dd is not None:
+                down = down + dd[None]
+            h = h + down[0]
+            return (h, li + 1), (k1, v1)
 
     (x, _), (k_news, v_news) = lax.scan(
-        body, (x, jnp.int32(0)), params["layers"])
+        body, (x, jnp.int32(0)), xs)
     # k_news/v_news [L, T, KVH, hd] — one in-place append, all layers.
     if quantized:
         k_pool, v_pool, k_sc, v_sc = ragged_paged_append_quantized(

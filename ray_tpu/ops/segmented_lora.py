@@ -1,0 +1,182 @@
+"""Segmented (multi-adapter) LoRA matmul for the ragged serving step.
+
+One unified ragged batch can carry rows that belong to *different*
+fine-tuned adapters (multi-tenant multiplexing, ROADMAP item 4): each
+packed token carries an adapter index into a small per-step gather set,
+and every LoRA-targeted projection adds ``y += (x @ A[idx]) @ B[idx] *
+scale`` with per-token A/B factors gathered from the paged adapter
+pool (serve/adapter_pool.py).
+
+Index 0 of the gather set is the NULL adapter: its pages are the
+pool's scratch page, which is all zeros by construction and never
+written, so base-model rows (``adapter_id == ""``) see an exact-zero
+delta — adding 0.0 is exact in every IEEE dtype, which is what keeps
+mixed batches byte-identical to adapter-off serving on the "" rows
+(the same discipline as the ragged step's padding rows).
+
+This is the gathered-einsum formulation: gather [T, d_in, r] /
+[T, r, d_out] operand stacks per token and contract with two einsums.
+It is row-independent (each token only reads its own A/B rows), which
+is what makes the segmented batch byte-identical to a sequential
+per-request oracle on the CPU test backend.  A Pallas grouped-matmul
+kernel that tiles tokens by adapter segment is the TPU-side upgrade
+path; the einsum fallback is the portable reference it must match.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Projection targets, in flattening order.  "qkv" is one joint factor
+# pair over the concatenated q/k/v output axis (the same concatenation
+# quant.fuse_for_decode uses for its fused wqkv operand), applied
+# PRE-RoPE where the base projections land.
+TARGETS = ("qkv", "o", "gate", "up", "down")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    """Shape/scale contract every adapter in a pool shares — fixed rank
+    and target set is what makes adapters a fixed number of pool pages
+    (the paged allocator never fragments)."""
+
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Tuple[str, ...] = TARGETS
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+    def __post_init__(self):
+        bad = [t for t in self.targets if t not in TARGETS]
+        if bad:
+            raise ValueError(f"unknown LoRA targets {bad!r} "
+                             f"(want a subset of {TARGETS})")
+
+
+def target_shapes(cfg: Any, lora: LoRAConfig) -> Dict[str, Tuple[int, int]]:
+    """target -> (d_in, d_out) of the projection the factors bracket."""
+    d, m = cfg.dim, cfg.mlp_dim
+    H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    shapes = {
+        "qkv": (d, (H + 2 * KVH) * hd),
+        "o": (H * hd, d),
+        "gate": (d, m),
+        "up": (d, m),
+        "down": (m, d),
+    }
+    return {t: shapes[t] for t in lora.targets}
+
+
+def adapter_elems(cfg: Any, lora: LoRAConfig) -> int:
+    """f32 element count of one flattened adapter (all layers)."""
+    r = lora.rank
+    per_layer = sum(din * r + r * dout
+                    for din, dout in target_shapes(cfg, lora).values())
+    return cfg.n_layers * per_layer
+
+
+def init_adapter_params(rng: jax.Array, cfg: Any,
+                        lora: LoRAConfig) -> Dict[str, Any]:
+    """Random adapter factors {target: {"a": [L, d_in, r], "b": [L, r,
+    d_out]}}.  Both factors are non-zero (unlike the training-time
+    B=0 convention) so distinct adapters produce distinct outputs —
+    this is the serving-side test/bench artifact, not an initializer
+    for fine-tuning runs."""
+    L, r = cfg.n_layers, lora.rank
+    out: Dict[str, Any] = {}
+    for i, (t, (din, dout)) in enumerate(target_shapes(cfg, lora).items()):
+        ka, kb = jax.random.split(jax.random.fold_in(rng, i))
+        out[t] = {
+            "a": (jax.random.normal(ka, (L, din, r), jnp.float32)
+                  * (din ** -0.5)),
+            "b": (jax.random.normal(kb, (L, r, dout), jnp.float32)
+                  * (r ** -0.5)),
+        }
+    return out
+
+
+def default_adapter_loader(cfg: Any, lora: LoRAConfig):
+    """adapter_id -> adapter params, derived deterministically from the
+    id (crc32 -> PRNG key).  Every replica that loads the same id gets
+    byte-identical factors — which is what lets failover re-resolve an
+    adapter on a survivor and keep the stream byte-identical without
+    any weight shipping.  Real deployments swap in a checkpoint
+    loader with the same signature."""
+
+    def load(adapter_id: str) -> Dict[str, Any]:
+        seed = zlib.crc32(adapter_id.encode("utf-8"))
+        return init_adapter_params(jax.random.key(seed), cfg, lora)
+
+    return load
+
+
+def flatten_adapter(adapter: Dict[str, Any], cfg: Any,
+                    lora: LoRAConfig) -> np.ndarray:
+    """One C-order f32 vector [adapter_elems]: per target, A then B."""
+    parts = []
+    for t, (din, dout) in target_shapes(cfg, lora).items():
+        a = np.asarray(adapter[t]["a"], np.float32)
+        b = np.asarray(adapter[t]["b"], np.float32)
+        want_a = (cfg.n_layers, din, lora.rank)
+        want_b = (cfg.n_layers, lora.rank, dout)
+        if a.shape != want_a or b.shape != want_b:
+            raise ValueError(
+                f"adapter target {t!r}: got a{a.shape}/b{b.shape}, "
+                f"want a{want_a}/b{want_b}")
+        parts.append(a.ravel())
+        parts.append(b.ravel())
+    return np.concatenate(parts)
+
+
+def gather_adapter_stacks(flat: jax.Array, cfg: Any,
+                          lora: LoRAConfig) -> Dict[str, Any]:
+    """Unflatten gathered pool rows [K, >= adapter_elems] into scan-able
+    per-target stacks {target: {"a": [L, K, d_in, r], "b": [L, K, r,
+    d_out]}} — leading layer axis so a ``lax.scan`` over the model's
+    layer stack slices the adapter factors alongside the weights."""
+    K = flat.shape[0]
+    L, r = cfg.n_layers, lora.rank
+    out: Dict[str, Any] = {}
+    off = 0
+    for t, (din, dout) in target_shapes(cfg, lora).items():
+        na, nb = L * din * r, L * r * dout
+        a = flat[:, off:off + na].reshape(K, L, din, r)
+        b = flat[:, off + na:off + na + nb].reshape(K, L, r, dout)
+        out[t] = {"a": jnp.moveaxis(a, 1, 0), "b": jnp.moveaxis(b, 1, 0)}
+        off += na + nb
+    return out
+
+
+def gather_adapter_flat(pool: Any, page_table: jax.Array) -> jax.Array:
+    """Gather each batch adapter's pages from the device pool and lay
+    them out flat: [K, pages_per_adapter * page_elems] f32.  ``pool``
+    is either the f32 page array [P+1, page_elems] or the int8 dict
+    {"q": [P+1, page_elems] int8, "scale": [P+1, 1] f32} (per-page
+    absmax, models/quant.py discipline); the scratch page dequantizes
+    to exact zeros either way (q == 0)."""
+    if isinstance(pool, dict):
+        pages = (pool["q"][page_table].astype(jnp.float32)
+                 * pool["scale"][page_table])
+    else:
+        pages = pool[page_table]
+    return pages.reshape(page_table.shape[0], -1)
+
+
+def segmented_lora_delta(x: jax.Array, a: jax.Array, b: jax.Array,
+                         idx: jax.Array, scale: float,
+                         dtype: Any) -> jax.Array:
+    """``(x @ A[idx]) @ B[idx] * scale`` per token, in the compute
+    dtype.  x [T, d_in], a [K, d_in, r], b [K, r, d_out], idx [T] ->
+    [T, d_out].  Null rows (idx -> scratch zeros) return exact 0."""
+    at = a.astype(dtype)[idx]                       # [T, d_in, r]
+    bt = b.astype(dtype)[idx]                       # [T, r, d_out]
+    h = jnp.einsum("td,tdr->tr", x.astype(dtype), at)
+    return jnp.einsum("tr,tro->to", h, bt) * jnp.asarray(scale, dtype)

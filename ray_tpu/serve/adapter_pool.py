@@ -1,0 +1,336 @@
+"""Paged pool of LoRA adapter weights for multi-tenant serving.
+
+Thousands of fine-tuned variants cannot each be a resident model; they
+CAN each be a few pages of LoRA factors.  This pool gives adapter
+weights the same allocator discipline as the KV cache's paged pool:
+
+  * fixed-size pages in one device array ``[num_pages + 1, page_elems]``
+    (f32, or int8+per-page scale via the models/quant.py discipline);
+    every adapter occupies exactly ``pages_per_adapter`` pages (fixed
+    rank/targets per pool — see ops/segmented_lora.LoRAConfig), so the
+    allocator never fragments;
+  * borrow refcounts while any in-flight row uses an adapter, with
+    refcount-0 LRU eviction under pressure and raise-on-underflow
+    release — the PrefixIndex refcount contract (a double-release is a
+    bug to surface, never mask);
+  * load-once dedup by content hash: two adapter ids whose flattened
+    factors are byte-identical share one page set (one upload, one
+    eviction unit);
+  * the LAST page index is the never-written all-zeros SCRATCH page:
+    the null adapter (``adapter_id == ""``) and unused page-table rows
+    gather exact zeros, which is what keeps base-model rows
+    byte-identical to adapter-off serving.
+
+The engine loop is the only caller of acquire/release/page_table;
+``summary()``/``stats()`` are read from replica push threads, so all
+state sits behind one lock.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.ops import segmented_lora as _sl
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """Adapter-pool metric singletons, merged into the engine's
+    telemetry dict (llm_engine._telemetry) so every family registers at
+    engine construction and `check_metrics --require` sees them at zero
+    before any adapter is ever loaded."""
+    global _TELEMETRY
+    from ray_tpu.util import metrics
+
+    if _TELEMETRY is None:
+        _TELEMETRY = {
+            "adapter_pool_pages": metrics.Gauge(
+                "raytpu_serve_adapter_pool_pages",
+                "Fixed-size pages in the LoRA adapter pool (scratch "
+                "page excluded)."),
+            "adapter_resident": metrics.Gauge(
+                "raytpu_serve_adapter_resident",
+                "Adapter ids currently resident (backed by loaded "
+                "pages; content-deduped ids each count once)."),
+            "adapter_hits": metrics.Counter(
+                "raytpu_serve_adapter_hits_total",
+                "Adapter acquisitions served from resident pages "
+                "(same id, or a content-hash dedup against another "
+                "id's pages)."),
+            "adapter_misses": metrics.Counter(
+                "raytpu_serve_adapter_misses_total",
+                "Adapter acquisitions that uploaded pages (first "
+                "load, or a re-load after eviction)."),
+            "adapter_evictions": metrics.Counter(
+                "raytpu_serve_adapter_evictions_total",
+                "Adapter page-sets evicted (refcount-0 LRU under "
+                "pool pressure)."),
+        }
+    else:
+        reg = metrics.registry()
+        for m in _TELEMETRY.values():
+            reg.register(m)
+    return _TELEMETRY
+
+
+class AdapterPoolPressure(RuntimeError):
+    """Transient: every resident adapter is borrowed by an in-flight
+    row, so nothing is evictable right now.  Callers back off and
+    retry once borrows release (the engine re-queues the request)."""
+
+
+class _Block:
+    """One loaded (content-unique) adapter: its page set + borrows."""
+
+    __slots__ = ("pages", "refs", "last_used", "ids")
+
+    def __init__(self, pages: List[int]):
+        self.pages = pages
+        self.refs = 0
+        self.last_used = 0
+        self.ids: Set[str] = set()
+
+
+class AdapterPool:
+    def __init__(self, model_cfg: Any, lora_cfg: _sl.LoRAConfig, *,
+                 num_pages: int = 0, page_elems: int = 8192,
+                 max_batch_adapters: int = 8, int8: bool = False,
+                 loader: Optional[Callable[[str], Any]] = None):
+        if page_elems <= 0:
+            raise ValueError(f"page_elems must be positive, got {page_elems}")
+        self.model_cfg = model_cfg
+        self.lora_cfg = lora_cfg
+        self.page_elems = int(page_elems)
+        self.elems = _sl.adapter_elems(model_cfg, lora_cfg)
+        self.pages_per_adapter = -(-self.elems // self.page_elems)
+        if num_pages <= 0:
+            # Auto-size: room for 4 resident adapters — enough that the
+            # tiny test configs exercise hits before eviction kicks in.
+            num_pages = 4 * self.pages_per_adapter
+        if num_pages < self.pages_per_adapter:
+            raise ValueError(
+                f"adapter pool of {num_pages} pages cannot hold one "
+                f"adapter ({self.pages_per_adapter} pages of "
+                f"{self.page_elems} elems for {self.elems} elems)")
+        self.num_pages = int(num_pages)
+        self.max_batch_adapters = int(max_batch_adapters)
+        self.int8 = bool(int8)
+        self._loader = loader or _sl.default_adapter_loader(
+            model_cfg, lora_cfg)
+
+        # Scratch page = index num_pages: zero-initialized, never
+        # written (upload pads land on real pages only).
+        if self.int8:
+            self._device: Any = {
+                "q": jnp.zeros((self.num_pages + 1, self.page_elems),
+                               jnp.int8),
+                "scale": jnp.ones((self.num_pages + 1, 1), jnp.float32),
+            }
+        else:
+            self._device = jnp.zeros((self.num_pages + 1, self.page_elems),
+                                     jnp.float32)
+        self._scatter = jax.jit(
+            lambda pool, ids, payload: pool.at[ids].set(payload),
+            donate_argnums=(0,))
+        self._scatter_q = jax.jit(
+            lambda q, s, ids, qp, sp: (q.at[ids].set(qp),
+                                       s.at[ids].set(sp)),
+            donate_argnums=(0, 1))
+
+        self._entries: Dict[str, str] = {}      # adapter_id -> content hash
+        self._blocks: Dict[str, _Block] = {}    # content hash -> block
+        self._free: List[int] = list(range(self.num_pages))
+        self._clock = itertools.count(1)
+        self._lock = threading.Lock()
+        self.hits_total = 0
+        self.misses_total = 0
+        self.evictions_total = 0
+        self._tm = _telemetry()
+        self._tm["adapter_pool_pages"].set(self.num_pages)
+        self._tm["adapter_resident"].set(0)
+
+    # -- load / borrow -----------------------------------------------------
+
+    def _load_flat(self, adapter_id: str) -> np.ndarray:
+        flat = self._loader(adapter_id)
+        if not isinstance(flat, np.ndarray) or flat.ndim != 1:
+            flat = _sl.flatten_adapter(flat, self.model_cfg, self.lora_cfg)
+        flat = np.asarray(flat, np.float32)
+        if flat.shape != (self.elems,):
+            raise ValueError(
+                f"adapter {adapter_id!r}: loader produced {flat.shape}, "
+                f"want ({self.elems},)")
+        return flat
+
+    def _set_resident_gauge_locked(self) -> None:
+        self._tm["adapter_resident"].set(
+            len({i for b in self._blocks.values() for i in b.ids}))
+
+    def _evict_one_locked(self) -> bool:
+        victim_h, victim = None, None
+        for h, block in self._blocks.items():
+            if block.refs == 0 and (
+                    victim is None or block.last_used < victim.last_used):
+                victim_h, victim = h, block
+        if victim is None:
+            return False
+        del self._blocks[victim_h]
+        self._free.extend(victim.pages)
+        self.evictions_total += 1
+        self._tm["adapter_evictions"].inc()
+        self._set_resident_gauge_locked()
+        return True
+
+    def _upload_locked(self, pages: List[int], flat: np.ndarray) -> None:
+        pp, pe = self.pages_per_adapter, self.page_elems
+        payload = np.zeros((pp, pe), np.float32)
+        payload.reshape(-1)[:self.elems] = flat
+        ids = jnp.asarray(np.asarray(pages, np.int32))
+        if self.int8:
+            # Per-PAGE absmax via quant.quantize_tensor: pages become
+            # the output-channel axis by transposing the payload.
+            from ray_tpu.models.quant import quantize_tensor
+            qd = quantize_tensor(jnp.asarray(payload.T))
+            q, s = self._scatter_q(
+                self._device["q"], self._device["scale"], ids,
+                qd["q"].T, qd["scale"].reshape(-1, 1))
+            self._device = {"q": q, "scale": s}
+        else:
+            self._device = self._scatter(self._device, ids,
+                                         jnp.asarray(payload))
+
+    def acquire(self, adapter_id: str) -> None:
+        """Pin ``adapter_id``'s pages (loading them if absent) for one
+        in-flight row.  Caller must ``release`` exactly once.  Raises
+        AdapterPoolPressure when nothing is evictable — transient,
+        retry after borrows drain."""
+        if not adapter_id:
+            return  # null adapter: scratch page, nothing to pin
+        with self._lock:
+            h = self._entries.get(adapter_id)
+            flat = None
+            if h is None:
+                flat = self._load_flat(adapter_id)
+                h = hashlib.sha1(flat.tobytes()).hexdigest()
+                self._entries[adapter_id] = h
+            block = self._blocks.get(h)
+            stamp = next(self._clock)
+            if block is not None:
+                block.refs += 1
+                block.last_used = stamp
+                block.ids.add(adapter_id)
+                self.hits_total += 1
+                self._tm["adapter_hits"].inc()
+                self._set_resident_gauge_locked()
+                return
+            if flat is None:  # known hash, pages evicted: re-load
+                flat = self._load_flat(adapter_id)
+            while len(self._free) < self.pages_per_adapter:
+                if not self._evict_one_locked():
+                    raise AdapterPoolPressure(
+                        f"adapter pool: {adapter_id!r} needs "
+                        f"{self.pages_per_adapter} pages, "
+                        f"{len(self._free)} free and every resident "
+                        f"adapter is borrowed")
+            pages = [self._free.pop() for _ in
+                     range(self.pages_per_adapter)]
+            self._upload_locked(pages, flat)
+            block = _Block(pages)
+            block.refs = 1
+            block.last_used = stamp
+            block.ids.add(adapter_id)
+            self._blocks[h] = block
+            self.misses_total += 1
+            self._tm["adapter_misses"].inc()
+            self._set_resident_gauge_locked()
+
+    def release(self, adapter_id: str) -> None:
+        """Unpin one borrow.  An unknown or unborrowed id is a
+        double-free bug — raise, don't mask (PrefixIndex contract)."""
+        if not adapter_id:
+            return
+        with self._lock:
+            h = self._entries.get(adapter_id)
+            block = self._blocks.get(h) if h is not None else None
+            if block is None or block.refs <= 0:
+                raise RuntimeError(
+                    f"adapter pool: release of {adapter_id!r} not "
+                    f"borrowed (refcount underflow)")
+            block.refs -= 1
+            block.last_used = next(self._clock)
+
+    def refcount(self, adapter_id: str) -> int:
+        with self._lock:
+            h = self._entries.get(adapter_id)
+            block = self._blocks.get(h) if h is not None else None
+            return -1 if block is None else block.refs
+
+    # -- batch gather plan -------------------------------------------------
+
+    @property
+    def device_pool(self) -> Any:
+        return self._device
+
+    def page_table(self, batch_ids: Sequence[str]) -> np.ndarray:
+        """[max_batch_adapters, pages_per_adapter] int32 gather plan:
+        row 0 and every unused row point at the scratch page (exact
+        zeros); row 1+j holds batch_ids[j]'s pages.  Every id must be
+        resident (borrowed by the rows that reference it)."""
+        K, pp = self.max_batch_adapters, self.pages_per_adapter
+        if len(batch_ids) > K - 1:
+            raise ValueError(
+                f"{len(batch_ids)} adapters in one batch, pool allows "
+                f"{K - 1} (max_batch_adapters={K} incl. the null row)")
+        table = np.full((K, pp), self.num_pages, np.int32)  # scratch
+        with self._lock:
+            for j, aid in enumerate(batch_ids):
+                h = self._entries.get(aid)
+                block = self._blocks.get(h) if h is not None else None
+                if block is None:
+                    raise RuntimeError(
+                        f"adapter pool: {aid!r} not resident at "
+                        f"page_table time (borrow-before-batch bug)")
+                table[1 + j] = block.pages
+        return table
+
+    # -- read-side surfaces ------------------------------------------------
+
+    def resident_ids(self) -> List[str]:
+        with self._lock:
+            out: Set[str] = set()
+            for block in self._blocks.values():
+                out |= block.ids
+            return sorted(out)
+
+    def summary(self) -> dict:
+        """Compact cross-process view for adapter-affinity routing,
+        published on the controller broadcast table exactly like the
+        prefix cache's summary()."""
+        return {"adapters": self.resident_ids()}
+
+    def stats(self) -> dict:
+        with self._lock:
+            resident = sorted(
+                {i for b in self._blocks.values() for i in b.ids})
+            looked = self.hits_total + self.misses_total
+            return {
+                "pool_pages": self.num_pages,
+                "pages_free": len(self._free),
+                "pages_per_adapter": self.pages_per_adapter,
+                "resident": len(resident),
+                "resident_ids": resident,
+                "hits": self.hits_total,
+                "misses": self.misses_total,
+                "evictions": self.evictions_total,
+                "hit_ratio": (self.hits_total / looked) if looked else 0.0,
+                "borrowed_refs": sum(b.refs
+                                     for b in self._blocks.values()),
+            }

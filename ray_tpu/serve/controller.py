@@ -94,6 +94,11 @@ class _Replica:
         # table so routers can prefer the replica holding the longest
         # cached prefix.  None = no cache / nothing cached yet.
         self.prefix_summary = None
+        # Latest resident-adapter routing summary the replica pushed
+        # ({"adapters": [ids…]}), re-broadcast the same way so routers
+        # can prefer the replica already holding a request's LoRA
+        # adapter.  None = multiplexing off / nothing resident yet.
+        self.adapter_summary = None
         # Multi-host shard group (config.shard_group): rank 0 IS this
         # replica's handle (the streaming endpoint the router
         # addresses); members holds the rank >= 1 ShardMemberActor
@@ -282,6 +287,22 @@ class ServeController:
             if r is None or r.prefix_summary == summary:
                 return
             r.prefix_summary = summary
+            self._broadcast(st)
+
+    def record_adapter_summary(self, app_name: str, deployment_name: str,
+                               replica_id: str, summary) -> None:
+        """Replica push: its engine's resident-adapter set changed.
+        Same store-and-rebroadcast contract as record_prefix_summary —
+        routers read the summary off their table row for
+        adapter-affinity routing."""
+        with self._lock:
+            st = self._deployments.get((app_name, deployment_name))
+            if st is None:
+                return
+            r = st.replicas.get(replica_id)
+            if r is None or r.adapter_summary == summary:
+                return
+            r.adapter_summary = summary
             self._broadcast(st)
 
     def list_replicas(self) -> List[Dict[str, Any]]:
@@ -754,7 +775,7 @@ class ServeController:
                 r._announced = True
                 table.append(
                     (r.replica_id, r.handle, st.config.max_ongoing_requests,
-                     is_async, r.prefix_summary, r.role)
+                     is_async, r.prefix_summary, r.role, r.adapter_summary)
                 )
         self._host.notify_changed(
             replica_set_key(st.app_name, st.info.name), table

@@ -260,9 +260,12 @@ class DeploymentResponseGenerator:
     def _run(self):
         deadline = (None if self._total_timeout_s is None
                     else time.monotonic() + self._total_timeout_s)
-        prompt = (len(self._args[0].get("tokens", ()))
-                  if self._args and isinstance(self._args[0], dict) else 0)
-        self._router.note_queued(self.request_id, prompt_tokens=prompt)
+        first = (self._args[0]
+                 if self._args and isinstance(self._args[0], dict)
+                 else {})
+        self._router.note_queued(
+            self.request_id, prompt_tokens=len(first.get("tokens", ())),
+            adapter_id=first.get("adapter_id", ""))
         attempt = 0
         dead: set = set()
         rng = random.Random(self.request_id)

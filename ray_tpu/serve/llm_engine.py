@@ -200,8 +200,14 @@ def _telemetry():
     # any page ever moves.
     from ray_tpu.serve import kv_transfer as _kvt
 
+    # The adapter-pool families (serve/adapter_pool) merge the same way
+    # so `check_metrics --require` pins them at zero even on engines
+    # that never load an adapter.
+    from ray_tpu.serve import adapter_pool as _apool
+
     out = dict(_TELEMETRY)
     out.update(_kvt._telemetry())
+    out.update(_apool._telemetry())
     return out
 
 
@@ -263,6 +269,18 @@ class EngineConfig:
     # — splits the page first.  Eviction is refcount-0 LRU, driven by
     # admission pressure so cached pages never starve new requests.
     prefix_cache: bool = False
+    # Multi-tenant LoRA multiplexing (serve/adapter_pool.py): sizing of
+    # the paged adapter-weight pool backing requests that carry an
+    # adapter_id.  Only consulted when the model config enables LoRA
+    # (LlamaConfig(lora=...) routes llama_paged_adapter to build a
+    # pool + segmented ragged step).  adapter_pool_pages=0 auto-sizes
+    # (room for 4 resident adapters); max_batch_adapters bounds the
+    # DISTINCT adapters one ragged step can gather (incl. the null
+    # row); adapter_int8 stores pool pages int8 with per-page scales.
+    adapter_pool_pages: int = 0
+    adapter_page_elems: int = 8192
+    max_batch_adapters: int = 8
+    adapter_int8: bool = False
 
     def buckets(self) -> List[int]:
         out, b = [], self.min_prefill_bucket
@@ -363,12 +381,54 @@ class PagedEngineAdapter:
         Callable[[Any, int], Dict[str, int]]] = None
     collective_probes: Optional[
         Callable[[Any], Dict[str, Callable]]] = None
+    # Multi-tenant LoRA multiplexing: ragged_step_lora(params, tokens,
+    # tok_pos, row_slot, row_start, row_len, row_off, block_tables,
+    # cache, pool, page_table, tok_adapter) -> (logits[R,V], cache) —
+    # the unified step with per-token segmented adapter deltas
+    # (ops/segmented_lora) gathered from the paged pool.
+    # make_adapter_pool(EngineConfig) builds the pool the engine owns
+    # (serve/adapter_pool.AdapterPool); both set iff the model config
+    # enables LoRA.
+    ragged_step_lora: Optional[Callable[..., Tuple[jax.Array, Any]]] = None
+    make_adapter_pool: Optional[Callable[[Any], Any]] = None
 
 
-def llama_paged_adapter(cfg) -> PagedEngineAdapter:
+def llama_paged_adapter(cfg, lora_loader=None) -> PagedEngineAdapter:
+    """``lora_loader`` (adapter_id -> factor pytree / flat vector)
+    feeds the adapter pool when cfg.lora is set; None uses the
+    deterministic seeded loader (segmented_lora.default_adapter_loader),
+    which every replica resolves identically — the property adapter
+    failover relies on."""
     from ray_tpu.models import llama
 
+    lora_fields: Dict[str, Any] = {}
+    if getattr(cfg, "lora", None) is not None:
+        from ray_tpu.ops import segmented_lora as _sl
+        from ray_tpu.serve.adapter_pool import AdapterPool
+
+        def ragged_step_lora(params, tokens, tok_pos, row_slot, row_start,
+                             row_len, row_off, bt, cache, pool, page_table,
+                             tok_adapter):
+            flat = _sl.gather_adapter_flat(pool, page_table)
+            stacks = _sl.gather_adapter_stacks(flat, cfg, cfg.lora)
+            return llama.ragged_step_paged(
+                params, tokens, tok_pos, row_slot, row_start, row_len,
+                row_off, bt, cfg, cache,
+                lora=(stacks, tok_adapter, cfg.lora.scale))
+
+        lora_fields = {
+            "ragged_step_lora": ragged_step_lora,
+            "make_adapter_pool": lambda ecfg: AdapterPool(
+                cfg, cfg.lora,
+                num_pages=ecfg.adapter_pool_pages,
+                page_elems=ecfg.adapter_page_elems,
+                max_batch_adapters=ecfg.max_batch_adapters,
+                int8=ecfg.adapter_int8,
+                loader=lora_loader),
+        }
+
     return PagedEngineAdapter(
+        **lora_fields,
         init_cache=lambda num_pages, page: llama.init_paged_cache(
             cfg, num_pages, page
         ),
@@ -438,6 +498,10 @@ class Request:
     # stamped at admission, mirrored to the request ring so
     # TTFT-by-hit-depth is observable downstream.
     prefix_hit: int = 0
+    # Multi-tenant multiplexing: the LoRA adapter this request decodes
+    # under ("" = base model).  Rides the ring rows and the per-row
+    # descriptor of the ragged step.
+    adapter_id: str = ""
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -573,6 +637,17 @@ class LLMServer:
             mesh=mesh,
         )
 
+    @staticmethod
+    def _adapter_id(payload: Dict[str, Any]) -> str:
+        """The request's LoRA adapter id: explicit payload key > the
+        multiplexed model id the replica installed from request
+        metadata (handle.options(multiplexed_model_id=...) -> router
+        metadata -> serve/multiplex contextvar) > "" (base model)."""
+        from ray_tpu.serve import multiplex as _mux
+
+        return (payload.get("adapter_id")
+                or _mux.get_multiplexed_model_id() or "")
+
     def __call__(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         # Explicit payload id > the id the replica installed from
         # request metadata (the router-minted one) > engine-local mint.
@@ -581,6 +656,7 @@ class LLMServer:
             max_new_tokens=payload.get("max_new_tokens"),
             temperature=payload.get("temperature", 0.0),
             request_id=payload.get("request_id"),
+            adapter_id=self._adapter_id(payload),
         )
         tokens = stream.result()
         return {"tokens": tokens, "metrics": stream.metrics,
@@ -616,6 +692,7 @@ class LLMServer:
             max_new_tokens=payload.get("max_new_tokens"),
             temperature=payload.get("temperature", 0.0),
             request_id=payload.get("request_id"),
+            adapter_id=self._adapter_id(payload),
         )
         for tok in stream:
             yield tok
@@ -673,6 +750,7 @@ class LLMServer:
                 max_new_tokens=requested,
                 temperature=payload.get("temperature", 0.0),
                 request_id=payload.get("request_id"),
+                adapter_id=self._adapter_id(payload),
             )
             for tok in stream:
                 yield tok
@@ -686,6 +764,7 @@ class LLMServer:
             max_new_tokens=dis.handoff_after_tokens,
             temperature=payload.get("temperature", 0.0),
             request_id=payload.get("request_id"),
+            adapter_id=self._adapter_id(payload),
         )
         delivered: List[int] = []
         for tok in stream:
@@ -732,7 +811,8 @@ class LLMServer:
         continuation = {"prompt": list(payload["tokens"]),
                         "tokens": list(delivered),
                         "temperature": payload.get("temperature", 0.0),
-                        "request_id": payload.get("request_id")}
+                        "request_id": payload.get("request_id"),
+                        "adapter_id": self._adapter_id(payload)}
         if migrated:
             tm["disagg_handoffs"].inc(tags={"outcome": "migrated"})
             self._handoff_counts["migrated"] += 1
@@ -824,6 +904,13 @@ class LLMServer:
         The hosting ReplicaActor polls this and pushes changes to the
         controller for cache-aware routing."""
         return self.engine.prefix_summary()
+
+    def adapter_summary(self) -> Optional[Dict[str, Any]]:
+        """Resident-adapter routing summary (None when LoRA
+        multiplexing is off).  The hosting ReplicaActor polls this and
+        pushes changes to the controller for adapter-affinity
+        routing — the same path prefix_summary rides."""
+        return self.engine.adapter_summary()
 
     def check_health(self) -> None:
         if self.engine._stopped.is_set():
@@ -1121,6 +1208,43 @@ class LLMEngine:
 
             self._ragged_step_fn = ragged_step_fn
 
+            # Multi-tenant LoRA multiplexing: the engine owns the paged
+            # adapter pool and a LoRA variant of the ragged program
+            # (pool + gather plan + per-token adapter index as extra
+            # args).  The pool array is NOT donated — the host manager
+            # mutates it on loads, not the step.  Batches with no
+            # adapter rows keep dispatching the base program above, so
+            # adapter-off traffic pays zero overhead.
+            if adapter.make_adapter_pool is not None:
+                if adapter.ragged_step_lora is None:
+                    raise ValueError(
+                        "adapter exposes make_adapter_pool without "
+                        "ragged_step_lora")
+                self._adapters = adapter.make_adapter_pool(config)
+
+                @partial(jax.jit, donate_argnums=(1,))
+                def ragged_step_lora_fn(params, cache, host_toks,
+                                        decode_mask, tok_slot, tok_pos,
+                                        row_slot, row_start, row_len,
+                                        row_off, temps, seed, cur,
+                                        scatter_ids, bt, pool,
+                                        page_table, tok_adapter):
+                    toks = jnp.where(decode_mask, cur[tok_slot],
+                                     host_toks)
+                    logits, cache = adapter.ragged_step_lora(
+                        params, toks, tok_pos, row_slot, row_start,
+                        row_len, row_off, bt, cache, pool, page_table,
+                        tok_adapter)
+                    sampled = _sample(logits, temps,
+                                      jax.random.key(seed[0]))
+                    cur = cur.at[scatter_ids].set(sampled, mode="drop")
+                    return cache, sampled, cur
+
+                self._ragged_step_lora_fn = ragged_step_lora_fn
+            else:
+                self._adapters = None
+                self._ragged_step_lora_fn = None
+
             if self._prefix is not None:
                 if adapter.copy_page is None:
                     raise ValueError(
@@ -1163,8 +1287,17 @@ class LLMEngine:
                 self._mig_gather_fn = mig_gather_fn
                 self._mig_scatter_fn = mig_scatter_fn
         else:
+            if getattr(adapter, "make_adapter_pool", None) is not None:
+                raise ValueError(
+                    "LoRA multiplexing requires ragged_batching — the "
+                    "segmented adapter matmul rides the unified step")
+            self._adapters = None
+            self._ragged_step_lora_fn = None
             self._ragged_step_fn = None
             self._token_budget = 0
+        # Adapter borrow per slot ("" = base model): released with the
+        # slot on every terminal path.
+        self._slot_adapter: Dict[int, str] = {}
         # Requests mid-incremental-prefill: [{req, slot, pos}].
         self._prefilling: List[Dict[str, Any]] = []
         # Requests whose admission prefill is being dispatched — a
@@ -1220,7 +1353,8 @@ class LLMEngine:
 
     def submit(self, prompt: List[int], *, max_new_tokens: Optional[int] = None,
                temperature: float = 0.0,
-               request_id: Optional[str] = None) -> CompletionStream:
+               request_id: Optional[str] = None,
+               adapter_id: str = "") -> CompletionStream:
         if self._stopped.is_set():
             raise RuntimeError("engine is stopped (shut down or crashed)")
         if self._draining.is_set():
@@ -1231,7 +1365,13 @@ class LLMEngine:
                 "engine is draining: not admitting new requests",
                 continuation={"prompt": list(prompt), "tokens": [],
                               "temperature": float(temperature),
-                              "request_id": request_id or ""})
+                              "request_id": request_id or "",
+                              "adapter_id": adapter_id})
+        if adapter_id and self._adapters is None:
+            raise ValueError(
+                f"request carries adapter_id {adapter_id!r} but this "
+                "engine has no adapter pool (model config without "
+                "lora=, or non-ragged serving)")
         if len(prompt) == 0:
             raise ValueError("empty prompt")
         if len(prompt) >= self.config.max_seq_len:
@@ -1247,6 +1387,7 @@ class LLMEngine:
             req_id=next(self._req_counter),
             trace_ctx=(tracing.capture_context()
                        if tracing.is_enabled() else None),
+            adapter_id=adapter_id,
         )
         # Explicit id > the ambient one the serve replica installed
         # (router-minted, riding request metadata) > local mint.
@@ -1264,7 +1405,8 @@ class LLMEngine:
                     f"{self._num_pages}"
                 )
         self._ring.record(req.request_id, _reqev.QUEUED,
-                          prompt_tokens=len(req.prompt))
+                          prompt_tokens=len(req.prompt),
+                          adapter_id=req.adapter_id)
         log.debug("request %s queued (%d prompt tokens, max_new=%d)",
                   req.request_id, len(req.prompt), req.max_new_tokens)
         self._waiting.put(req)
@@ -1355,6 +1497,8 @@ class LLMEngine:
             pstats["prompt_tokens"] = self._prefix_prompt_tokens
             out["prefix"] = pstats
             out["kv_migration"] = dict(self._mig_counts)
+        if self._adapters is not None:
+            out["adapters"] = self._adapters.stats()
         return out
 
     def prefix_summary(self, max_entries: int = 256) -> Optional[dict]:
@@ -1366,6 +1510,15 @@ class LLMEngine:
         if self._prefix is None:
             return None
         return self._prefix.summary(max_entries)
+
+    def adapter_summary(self) -> Optional[dict]:
+        """Compact routing summary of the adapter pool ({"adapters":
+        [resident ids]}), or None when LoRA multiplexing is off.
+        Published over the controller broadcast table exactly like
+        prefix_summary, feeding the router's adapter-affinity arm."""
+        if self._adapters is None:
+            return None
+        return self._adapters.summary()
 
     def shutdown(self):
         self._stopped.set()
@@ -1757,6 +1910,8 @@ class LLMEngine:
         the unified step packs its prompt in budget-sized chunks
         beside live decode rows, so there is no separate one-shot
         prefill program to head-of-line-block behind."""
+        from ray_tpu.serve.adapter_pool import AdapterPoolPressure
+
         while self._free_slots:
             if self._backlog:
                 req = self._backlog.pop(0)
@@ -1765,11 +1920,34 @@ class LLMEngine:
                     req = self._waiting.get_nowait()
                 except queue.Empty:
                     return
+            if req.adapter_id and self._adapters is not None:
+                # Borrow the adapter's pages for the slot's lifetime.
+                # Pressure (nothing evictable: every resident adapter
+                # is borrowed) is transient — back off like page
+                # pressure.  A loader error is terminal for the
+                # request, never the engine.
+                try:
+                    self._adapters.acquire(req.adapter_id)
+                except AdapterPoolPressure:
+                    self._backlog.insert(0, req)
+                    return
+                except Exception as e:
+                    req.finished_at = time.monotonic()
+                    self._observe_request(
+                        req, state=_reqev.FAILED,
+                        cause=f"adapter load failed: {e!r}")
+                    req.stream.put(RuntimeError(
+                        f"adapter {req.adapter_id!r} load failed: {e!r}"))
+                    continue
             got = self._admit_slot_for(req)
             if got is None:
+                if req.adapter_id and self._adapters is not None:
+                    self._adapters.release(req.adapter_id)
                 self._backlog.insert(0, req)
                 return
             slot, start = got
+            if req.adapter_id:
+                self._slot_adapter[slot] = req.adapter_id
             req.admitted_at = time.monotonic()
             self._ring.record(
                 req.request_id, _reqev.PREFILLING, slot=slot,
@@ -1796,6 +1974,23 @@ class LLMEngine:
         scatter = np.full((R,), R, np.int32)  # OOB = sample dropped
         temps = np.zeros((R,), np.float32)
         n_decode = n_prefill = 0
+        # Per-step adapter gather set: distinct adapter ids -> index
+        # 1..K-1 (0 is the null adapter).  A row whose adapter would
+        # overflow the set simply waits for the next step.
+        step_adapters: Dict[str, int] = {}
+
+        def _adapter_idx(req: Request) -> Optional[int]:
+            if not req.adapter_id or self._adapters is None:
+                return 0
+            idx = step_adapters.get(req.adapter_id)
+            if idx is None:
+                if (len(step_adapters)
+                        >= self.config.max_batch_adapters - 1):
+                    return None  # gather set full this step
+                idx = len(step_adapters) + 1
+                step_adapters[req.adapter_id] = idx
+            return idx
+
         for slot in sorted(self._slot_req):
             if budget <= 0 or len(rows) >= R:
                 break
@@ -1807,9 +2002,12 @@ class LLMEngine:
             ) - self._inflight_tokens.get(slot, 0)
             if rem <= 0:
                 continue  # budget fully covered by in-flight steps
+            ai = _adapter_idx(req)
+            if ai is None:
+                continue
             i = len(rows)
             rows.append({"slot": slot, "start": int(self._lens[slot]),
-                         "tokens": None})
+                         "tokens": None, "adapter": ai})
             parts.append(("decode", req, slot, i))
             scatter[i] = slot
             temps[i] = req.temperature
@@ -1823,10 +2021,14 @@ class LLMEngine:
             chunk = req.prompt[pos:pos + budget]
             if not chunk:
                 continue
+            ai = _adapter_idx(req)
+            if ai is None:
+                continue
             is_last = pos + len(chunk) >= len(req.prompt)
             i = len(rows)
             rows.append({"slot": slot, "start": pos,
-                         "tokens": [int(t) for t in chunk]})
+                         "tokens": [int(t) for t in chunk],
+                         "adapter": ai})
             temps[i] = req.temperature
             if is_last:
                 # The final chunk's sample is the request's first
@@ -1839,18 +2041,38 @@ class LLMEngine:
             n_prefill += len(chunk)
         if not rows:
             return False
-        (host_toks, decode_mask, tok_slot, tok_pos, row_slot,
-         row_start, row_len, row_off) = pack_ragged_batch(rows, T, R)
         self._refresh_state_args()
-        self._cache, toks_dev, self._cur_dev = \
-            self._instrumented_dispatch(
-                "serve.ragged", self._ragged_step_fn,
-                (self._params, self._cache, host_toks, decode_mask,
-                 tok_slot, tok_pos, row_slot, row_start, row_len,
-                 row_off, temps, self._next_seed(), self._cur_dev,
-                 scatter, self._bt_arg),
-                span_name="llm.ragged", steps_attr="tokens",
-            )
+        if step_adapters:
+            # LoRA variant: same program + the pool, the step's page
+            # gather plan, and the per-token adapter index.  Batches
+            # with no adapter rows never reach here — they stay on the
+            # untouched base program below (zero overhead, bit-equal).
+            (host_toks, decode_mask, tok_slot, tok_pos, row_slot,
+             row_start, row_len, row_off, tok_adapter) = \
+                pack_ragged_batch(rows, T, R, with_adapters=True)
+            page_table = self._adapters.page_table(list(step_adapters))
+            self._cache, toks_dev, self._cur_dev = \
+                self._instrumented_dispatch(
+                    "serve.ragged", self._ragged_step_lora_fn,
+                    (self._params, self._cache, host_toks, decode_mask,
+                     tok_slot, tok_pos, row_slot, row_start, row_len,
+                     row_off, temps, self._next_seed(), self._cur_dev,
+                     scatter, self._bt_arg, self._adapters.device_pool,
+                     page_table, tok_adapter),
+                    span_name="llm.ragged", steps_attr="tokens",
+                )
+        else:
+            (host_toks, decode_mask, tok_slot, tok_pos, row_slot,
+             row_start, row_len, row_off) = pack_ragged_batch(rows, T, R)
+            self._cache, toks_dev, self._cur_dev = \
+                self._instrumented_dispatch(
+                    "serve.ragged", self._ragged_step_fn,
+                    (self._params, self._cache, host_toks, decode_mask,
+                     tok_slot, tok_pos, row_slot, row_start, row_len,
+                     row_off, temps, self._next_seed(), self._cur_dev,
+                     scatter, self._bt_arg),
+                    span_name="llm.ragged", steps_attr="tokens",
+                )
         now = time.monotonic()
         for kind, req, slot, _i in parts:
             if kind == "decode":
@@ -1927,6 +2149,9 @@ class LLMEngine:
         paths pass no cache_tokens: their tail pages may be partially
         written, so nothing is donated."""
         self._slot_req.pop(slot, None)
+        aid = self._slot_adapter.pop(slot, "")
+        if aid and self._adapters is not None:
+            self._adapters.release(aid)
         self._free_slots.append(slot)
         self._state_dirty = True
         if self._paged:
@@ -2373,7 +2598,8 @@ class LLMEngine:
             continuation={"prompt": list(req.prompt),
                           "tokens": list(req.tokens),
                           "temperature": req.temperature,
-                          "request_id": req.request_id}))
+                          "request_id": req.request_id,
+                          "adapter_id": req.adapter_id}))
 
     def _process_drain(self) -> None:
         """Loop-side half of drain(): while draining, requests that

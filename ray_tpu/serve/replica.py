@@ -129,7 +129,18 @@ class ReplicaActor:
                                     and _summary_fn() is not None)
         except Exception:
             self._pushes_summary = False
-        if metrics_interval_s > 0 or self._pushes_summary:
+        # LoRA multiplexing rides the same push thread: a callable
+        # exposing adapter_summary() publishes its resident-adapter set
+        # for adapter-affinity routing, pushed only on change.
+        self._last_adapter_summary = None
+        _adapter_fn = getattr(self._callable, "adapter_summary", None)
+        try:
+            self._pushes_adapters = (callable(_adapter_fn)
+                                     and _adapter_fn() is not None)
+        except Exception:
+            self._pushes_adapters = False
+        if (metrics_interval_s > 0 or self._pushes_summary
+                or self._pushes_adapters):
             threading.Thread(
                 target=self._push_metrics_loop,
                 args=(metrics_interval_s or 0.25,),
@@ -442,6 +453,18 @@ class ReplicaActor:
                         controller.record_prefix_summary.remote(
                             self.app_name, self.deployment_name,
                             self.replica_id, summary,
+                        )
+                if self._pushes_adapters:
+                    try:
+                        asum = self._callable.adapter_summary()
+                    except Exception:
+                        asum = None
+                    if (asum is not None
+                            and asum != self._last_adapter_summary):
+                        self._last_adapter_summary = asum
+                        controller.record_adapter_summary.remote(
+                            self.app_name, self.deployment_name,
+                            self.replica_id, asum,
                         )
             except Exception:
                 return  # controller gone — cluster is shutting down

@@ -94,6 +94,9 @@ class RequestRecord:
     # (0 = cold prefill, or the cache is off) — joins with ttft_s for
     # TTFT-by-hit-depth.
     prefix_hit: int = 0
+    # LoRA adapter the request decodes under ("" = base model) — the
+    # multi-tenant attribution key for `raytpu list requests`.
+    adapter_id: str = ""
 
     @property
     def state(self) -> str:
@@ -159,7 +162,8 @@ class RequestEventBuffer:
                terminal_cause: Optional[str] = None,
                attempt: Optional[int] = None,
                attempt_info: Optional[Dict[str, Any]] = None,
-               prefix_hit: Optional[int] = None) -> None:
+               prefix_hit: Optional[int] = None,
+               adapter_id: Optional[str] = None) -> None:
         now = time.time()
         with self._lock:
             rec = self._records.get(request_id)
@@ -194,6 +198,8 @@ class RequestEventBuffer:
                 rec.terminal_cause = terminal_cause
             if prefix_hit is not None:
                 rec.prefix_hit = prefix_hit
+            if adapter_id is not None:
+                rec.adapter_id = adapter_id
 
     def update(self, request_id: str, *,
                generated_tokens: Optional[int] = None) -> None:

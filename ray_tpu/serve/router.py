@@ -55,6 +55,13 @@ def _telemetry():
                 "deployment.",
                 tag_keys=("deployment",),
             ),
+            "adapter_routed": metrics.Counter(
+                "raytpu_serve_router_adapter_routed_total",
+                "Assignments where adapter-affinity routing picked a "
+                "replica already holding the request's LoRA adapter "
+                "resident, by deployment.",
+                tag_keys=("deployment",),
+            ),
         }
     else:
         reg = metrics.registry()
@@ -66,7 +73,7 @@ def _telemetry():
 class _ReplicaInfo:
     def __init__(self, replica_id: str, handle, max_ongoing: int,
                  is_async: bool = False, prefix_summary=None,
-                 role: str = "unified"):
+                 role: str = "unified", adapter_summary=None):
         self.replica_id = replica_id
         self.handle = handle
         self.max_ongoing = max_ongoing
@@ -80,6 +87,9 @@ class _ReplicaInfo:
         # fresh LLM streams prefer prefill replicas; migrated streams
         # resume on their handoff target (prefer_replica).
         self.role = role
+        # Resident-adapter summary ({"adapters": [ids…]}) for LoRA
+        # multiplexing.  Also a hint: the engine pool reloads on miss.
+        self.adapter_summary = adapter_summary
 
 
 def _payload_tokens(args: tuple) -> Optional[List[int]]:
@@ -140,7 +150,7 @@ class Router:
 
     def _update_replicas(self, table: List[Tuple[str, Any, int]]) -> None:
         """table: [(replica_id, actor_handle, max_ongoing_requests,
-        is_async, prefix_summary, role)]"""
+        is_async, prefix_summary, role, adapter_summary)]"""
         with self._cv:
             fresh: Dict[str, _ReplicaInfo] = {}
             for row in table:
@@ -148,17 +158,19 @@ class Router:
                 is_async = bool(row[3]) if len(row) > 3 else False
                 summary = row[4] if len(row) > 4 else None
                 role = row[5] if len(row) > 5 else "unified"
+                adapters = row[6] if len(row) > 6 else None
                 old = self._replicas.get(replica_id)
                 if old is not None:
                     old.max_ongoing = max_ongoing
                     old.is_async = is_async
                     old.prefix_summary = summary
                     old.role = role
+                    old.adapter_summary = adapters
                     fresh[replica_id] = old
                 else:
                     fresh[replica_id] = _ReplicaInfo(
                         replica_id, handle, max_ongoing, is_async,
-                        summary, role
+                        summary, role, adapters
                     )
             self._replicas = fresh
             # Drop affinity entries pointing at replicas that left the
@@ -278,9 +290,11 @@ class Router:
 
     # -- failover ring ------------------------------------------------------
 
-    def note_queued(self, request_id: str, prompt_tokens: int = 0) -> None:
+    def note_queued(self, request_id: str, prompt_tokens: int = 0,
+                    adapter_id: str = "") -> None:
         self._ring.record(request_id, _reqev.QUEUED,
-                          prompt_tokens=prompt_tokens)
+                          prompt_tokens=prompt_tokens,
+                          adapter_id=adapter_id)
 
     def note_retry(self, request_id: str, attempt: int, replica_id: str,
                    reason: str) -> None:
@@ -358,6 +372,27 @@ class Router:
                             # Refresh recency so bounded eviction drops
                             # cold models, not hot ones.
                             self._model_affinity.pop(model_id, None)
+                    if chosen is None and model_id:
+                        # Adapter-resident arm: a replica whose pushed
+                        # summary already lists this adapter skips the
+                        # load/upload miss path entirely.  Load-bounded:
+                        # only take the resident replica while it is
+                        # within 2 in-flight requests of the lightest
+                        # candidate, so one hot adapter can't turn
+                        # affinity into a hotspot (the p2c arm below
+                        # still spreads the overflow).
+                        floor = min(r.inflight for r in candidates)
+                        resident = [
+                            r for r in candidates
+                            if model_id in (r.adapter_summary or {})
+                            .get("adapters", ())
+                            and r.inflight <= floor + 2
+                        ]
+                        if resident:
+                            chosen = min(resident,
+                                         key=lambda r: r.inflight)
+                            self._tm["adapter_routed"].inc(
+                                tags={"deployment": self.deployment_name})
                     if chosen is None and tokens is not None:
                         # Cache-aware arm: prefer the replica claiming
                         # the longest cached prefix of this prompt
@@ -412,6 +447,14 @@ class Router:
         if replica_id is None:
             return
         self._replicas.pop(replica_id, None)
+        # Purge sticky multiplexing affinity pointing at the dead
+        # replica NOW — the next request for those adapters must
+        # re-resolve on a survivor, not wait for the controller's
+        # rebroadcast to prune ghosts.
+        self._model_affinity = {
+            m: rid for m, rid in self._model_affinity.items()
+            if rid != replica_id
+        }
         orphaned = [ref for ref, rid in self._outstanding.items()
                     if rid == replica_id]
         for ref in orphaned:

@@ -47,6 +47,10 @@ Checked:
     carry TTFT + decode-ITL percentiles, and the disagg leg's
     migration block must show pages actually moved with bytes on the
     wire — a zero-page "disagg" leg measured unified serving twice;
+  * the LoRA multiplexing ablation (extra.serving_adapters): Zipf
+    adapter traffic vs the same prompts single-model — the multi leg
+    carries its pool counters with hit_ratio a fraction in [0, 1],
+    and throughput_degradation exists iff both legs actually ran;
   * the full-8B train rung (extra.llama_8b.train): must be MEASURED
     (measured=true, numeric mfu/toks in (0, 1]/(0, inf)), carry
     zero_sharding=true + dp_shards, and satisfy the memory claim
@@ -408,6 +412,81 @@ def _check_disagg(name: str, d: Any, problems: List[str]) -> None:
                         f"a number nor null")
 
 
+ADAPTER_LEG_REQUIRED = ("tokens_per_s", "ttft_p50_ms", "ttft_p95_ms")
+
+
+def _check_adapters(name: str, d: Any, problems: List[str]) -> None:
+    """The zipf_adapters multiplexing ablation: Zipf adapter traffic
+    through the paged LoRA pool vs the same prompts single-model.
+    The multi leg must carry the pool counters (hit_ratio a fraction),
+    and the record must price the multiplexing — a degradation ratio
+    exists iff both legs actually ran."""
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    if "error" in d:  # bench leg failed; the record says so — valid
+        return
+    for k in ("mix", "n_requests", "gen", "single_model", "multi",
+              "throughput_degradation"):
+        if k not in d:
+            problems.append(f"{name}: missing required key {k!r}")
+    mix = d.get("mix")
+    if mix is not None:
+        if not isinstance(mix, dict):
+            problems.append(f"{name}: mix is not an object")
+        else:
+            if mix.get("name") != "zipf_adapters":
+                problems.append(f"{name}: mix.name must be "
+                                f"'zipf_adapters', got "
+                                f"{mix.get('name')!r}")
+            for k in ("n_adapters", "zipf_alpha", "pool_adapters"):
+                if not (_num(mix.get(k)) and mix[k] > 0):
+                    problems.append(f"{name}: mix.{k}={mix.get(k)!r} "
+                                    f"must be a number > 0")
+    ran = True
+    for leg in ("single_model", "multi"):
+        block = d.get(leg)
+        if block is None:
+            ran = False
+            continue
+        if not isinstance(block, dict):
+            problems.append(f"{name}.{leg}: not an object")
+            ran = False
+            continue
+        for k in ADAPTER_LEG_REQUIRED:
+            if not (_num(block.get(k)) and block[k] > 0):
+                problems.append(f"{name}.{leg}.{k} missing or not a "
+                                f"number > 0: {block.get(k)!r}")
+    multi = d.get("multi")
+    if isinstance(multi, dict):
+        pool = multi.get("pool")
+        if not isinstance(pool, dict):
+            problems.append(f"{name}.multi: missing pool block — a "
+                            f"multiplexed leg without its pool "
+                            f"counters measured nothing multi-tenant")
+        else:
+            for k in ("pool_pages", "resident", "hits", "misses",
+                      "evictions"):
+                if not (_num(pool.get(k)) and pool[k] >= 0):
+                    problems.append(
+                        f"{name}.multi.pool.{k} missing or not a "
+                        f"number >= 0: {pool.get(k)!r}")
+            hr = pool.get("hit_ratio")
+            if not (_num(hr) and 0.0 <= hr <= 1.0):
+                problems.append(
+                    f"{name}.multi.pool.hit_ratio={hr!r} must be a "
+                    f"fraction in [0, 1]")
+    degr = d.get("throughput_degradation", None)
+    if ran and not (_num(degr) and degr > 0):
+        problems.append(
+            f"{name}: throughput_degradation={degr!r} — both legs ran "
+            f"but the record never priced the multiplexing")
+    if not ran and degr is not None:
+        problems.append(
+            f"{name}: throughput_degradation={degr!r} without both "
+            f"legs — a ratio over a leg that never ran")
+
+
 ZERO_TRAIN_REQUIRED = ("params_b", "measured", "tokens_per_sec_per_chip",
                        "mfu", "zero_sharding", "dp_shards", "grad_accum",
                        "optimizer", "opt_state_bytes_per_param")
@@ -534,6 +613,9 @@ def validate_record(rec: Any) -> List[str]:
     if extra.get("serving_disagg") is not None:
         _check_disagg("extra.serving_disagg", extra["serving_disagg"],
                       problems)
+    if extra.get("serving_adapters") is not None:
+        _check_adapters("extra.serving_adapters",
+                        extra["serving_adapters"], problems)
     return problems
 
 

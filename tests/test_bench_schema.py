@@ -367,6 +367,60 @@ def test_disagg_mix_distribution_checked(schema):
     assert any("sum to 0.9" in p for p in schema.validate_record(rec))
 
 
+# --- LoRA multiplexing ablation --------------------------------------------
+
+
+def _adapters_block():
+    return {"mix": {"name": "zipf_adapters", "n_adapters": 6,
+                    "zipf_alpha": 1.1, "pool_adapters": 4, "rank": 4},
+            "n_requests": 24, "gen": 16,
+            "single_model": {"tokens_per_s": 420.0,
+                             "ttft_p50_ms": 35.0, "ttft_p95_ms": 80.0},
+            "multi": {"tokens_per_s": 365.0, "ttft_p50_ms": 41.0,
+                      "ttft_p95_ms": 96.0,
+                      "pool": {"pool_pages": 8, "resident": 4,
+                               "hits": 17, "misses": 7,
+                               "evictions": 3, "hit_ratio": 0.708}},
+            "throughput_degradation": 0.869}
+
+
+def test_adapters_block_valid(schema):
+    rec = _record()
+    rec["extra"]["serving_adapters"] = _adapters_block()
+    assert schema.validate_record(rec) == []
+    rec["extra"]["serving_adapters"] = {"error": "RESOURCE_EXHAUSTED"}
+    assert schema.validate_record(rec) == []
+
+
+def test_adapters_hit_ratio_is_a_fraction(schema):
+    rec = _record()
+    blk = _adapters_block()
+    rec["extra"]["serving_adapters"] = blk
+    blk["multi"]["pool"]["hit_ratio"] = 1.7
+    probs = schema.validate_record(rec)
+    assert any("hit_ratio" in p and "[0, 1]" in p for p in probs)
+    del blk["multi"]["pool"]
+    probs = schema.validate_record(rec)
+    assert any("missing pool block" in p for p in probs)
+
+
+def test_adapters_degradation_iff_both_legs_ran(schema):
+    """throughput_degradation must exist when both legs ran and must
+    NOT exist when one didn't — a ratio over a missing leg is
+    fabricated."""
+    rec = _record()
+    blk = _adapters_block()
+    rec["extra"]["serving_adapters"] = blk
+    blk["throughput_degradation"] = None
+    probs = schema.validate_record(rec)
+    assert any("never priced the multiplexing" in p for p in probs)
+    blk = _adapters_block()
+    del blk["single_model"]
+    rec["extra"]["serving_adapters"] = blk
+    probs = schema.validate_record(rec)
+    assert any("a ratio over a leg that never ran" in p for p in probs)
+
+
 def test_prefix_migration_cost_field(schema):
     """The migrated-vs-recomputed field in the zipf_chat prefix block:
     valid when complete, per-page cost null only when nothing moved,

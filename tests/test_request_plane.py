@@ -246,6 +246,14 @@ def test_request_plane_e2e(params):
             "raytpu_serve_kv_migration_seconds",
             "raytpu_serve_disagg_handoffs_total",
             "raytpu_serve_disagg_requests_total",
+            # LoRA multiplexing plane: the paged adapter pool's
+            # families are declared with the engine telemetry even
+            # when no adapter is ever loaded.
+            "raytpu_serve_adapter_pool_pages",
+            "raytpu_serve_adapter_resident",
+            "raytpu_serve_adapter_hits_total",
+            "raytpu_serve_adapter_misses_total",
+            "raytpu_serve_adapter_evictions_total",
         ]) == []
 
         # -- timeline: request rows, slot threads, globally ts-sorted -

@@ -78,3 +78,90 @@ def test_multiplexed_validation():
     with pytest.raises(ValueError):
         serve.multiplexed(max_num_models_per_replica=0)(lambda s, m: m)
     assert serve.get_multiplexed_model_id() == ""
+
+
+# -- adapter-affinity routing (LoRA multiplexing) ---------------------------
+#
+# Router-internal unit tests: a bare Router (no runtime) with a
+# hand-built table exercises the adapter-resident selection arm and the
+# death-time affinity purge without spinning up replicas.
+
+
+def _bare_router():
+    import threading
+
+    from ray_tpu.serve import router as router_mod
+
+    r = router_mod.Router.__new__(router_mod.Router)
+    r.app_name, r.deployment_name = "app", "dep"
+    r._lock = threading.Lock()
+    r._cv = threading.Condition(r._lock)
+    r._replicas = {}
+    r._outstanding = {}
+    r._model_affinity = {}
+    r._tm = router_mod._telemetry()
+    return r
+
+
+def _info(rid, **kw):
+    from ray_tpu.serve.router import _ReplicaInfo
+
+    info = _ReplicaInfo(rid, handle=object(), max_ongoing=8, **kw)
+    return info
+
+
+def test_adapter_summary_rides_routing_table():
+    r = _bare_router()
+    r._update_replicas([
+        ("r1", object(), 8, False, None, "unified",
+         {"adapters": ["tenant-a"]}),
+        ("r2", object(), 8, False, None, "unified"),  # pre-adapter row
+    ])
+    assert r._replicas["r1"].adapter_summary == {"adapters": ["tenant-a"]}
+    assert r._replicas["r2"].adapter_summary is None
+    # An update on a KNOWN replica refreshes the summary in place.
+    r._update_replicas([
+        ("r1", object(), 8, False, None, "unified",
+         {"adapters": ["tenant-a", "tenant-b"]}),
+    ])
+    assert r._replicas["r1"].adapter_summary == {
+        "adapters": ["tenant-a", "tenant-b"]}
+
+
+def test_adapter_affinity_prefers_resident_replica():
+    r = _bare_router()
+    r._replicas = {
+        "cold": _info("cold"),
+        "warm": _info("warm", adapter_summary={"adapters": ["tenant-a"]}),
+    }
+    r._replicas["warm"].inflight = 1  # slightly busier, within bound
+    chosen = r._select_replica(None, None, None, "tenant-a")
+    assert chosen.replica_id == "warm"
+    # Load bound: once the resident replica is > 2 in-flight above the
+    # lightest candidate, affinity yields to load balancing.
+    r._replicas["warm"].inflight = 4
+    r._replicas["cold"].inflight = 0
+    r._model_affinity.clear()  # drop the stickiness the pick above set
+    chosen = r._select_replica(None, None, None, "tenant-a")
+    assert chosen.replica_id == "cold"
+
+
+def test_replica_death_evicts_adapter_affinity():
+    """The satellite's teeth: a killed replica's affinity entries are
+    purged from the router table in the same eviction pass that drops
+    the replica, so the next request for those adapters re-resolves on
+    a survivor instead of chasing a ghost."""
+    r = _bare_router()
+    r._replicas = {
+        "dead": _info("dead",
+                      adapter_summary={"adapters": ["tenant-a"]}),
+        "alive": _info("alive"),
+    }
+    r._model_affinity = {"tenant-a": "dead", "tenant-b": "alive"}
+    with r._cv:
+        r._evict_replica_locked("dead")
+    assert "dead" not in r._replicas
+    assert r._model_affinity == {"tenant-b": "alive"}
+    chosen = r._select_replica(None, None, None, "tenant-a")
+    assert chosen.replica_id == "alive"
+    assert r._model_affinity["tenant-a"] == "alive"

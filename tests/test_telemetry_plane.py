@@ -258,7 +258,15 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
                  "raytpu_serve_kv_migration_bytes_total",
                  "raytpu_serve_kv_migration_seconds",
                  "raytpu_serve_disagg_handoffs_total",
-                 "raytpu_serve_disagg_requests_total"]) == []
+                 "raytpu_serve_disagg_requests_total",
+                 # LoRA multiplexing plane: adapter-pool occupancy and
+                 # hit/miss/eviction counters, declared with the engine
+                 # telemetry even when no adapter is ever loaded.
+                 "raytpu_serve_adapter_pool_pages",
+                 "raytpu_serve_adapter_resident",
+                 "raytpu_serve_adapter_hits_total",
+                 "raytpu_serve_adapter_misses_total",
+                 "raytpu_serve_adapter_evictions_total"]) == []
     assert cm.check_registry() == []
 
 
