@@ -842,6 +842,189 @@ def _measure_serving_adapters(cfg, *, n_adapters: int = 6,
     }
 
 
+def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
+                           gen: int = 10, prefix_len: int = 8,
+                           tail_len: int = 4, max_replicas: int = 3,
+                           slots: int = 4, decode_sleep_s: float = 0.02,
+                           params=None) -> dict:
+    """SLO-driven autoscaling under chaos: a full serve-plane run
+    (controller, autoscaled LLMServer deployment, router) against
+    ramped zipf_chat arrival with the replica killer active.
+
+    The goodput leg, not a throughput leg: decode is throttled so
+    requests live long enough for the reconciler's pressure signals
+    (admission-queue age, ongoing count) to see the ramp.  Asserts by
+    schema (bench_schema._check_chaos): the run must show at least one
+    scale-up, at least one drain-based scale-down after the ramp ends,
+    and at least one replica killed mid-traffic — otherwise the leg
+    measured a static fleet on a sunny day.  Sheds (admission-control
+    refusals once the queue is over the SLO budget) are counted
+    separately from goodput: nothing ran, so nothing failed."""
+    import re as _re
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core import api
+    from ray_tpu.core.exceptions import ShedError
+    from ray_tpu.serve.llm_engine import (
+        EngineConfig,
+        LLMServer,
+        llama_paged_adapter,
+    )
+    from ray_tpu.util import metrics as _metrics
+    from ray_tpu.utils.test_utils import ReplicaKiller
+
+    if params is None:
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+
+    def slow_adapter_factory(c):
+        # Paged + ragged (prefix_cache needs both); the throttle rides
+        # the ragged step — a bare sleep would only fire at trace time.
+        base = llama_paged_adapter(c)
+
+        def slow_step(*a, **k):
+            jax.debug.callback(lambda: time.sleep(decode_sleep_s),
+                               ordered=True)
+            return base.ragged_step(*a, **k)
+
+        return dataclasses.replace(base, ragged_step=slow_step)
+
+    def metric(family, tag_re=""):
+        tot = 0.0
+        pat = _re.compile(rf'^{family}{{[^}}]*{tag_re}[^}}]*}} (\S+)$')
+        for line in _metrics.export_prometheus().splitlines():
+            m = pat.match(line)
+            if m:
+                tot += float(m.group(1))
+        return tot
+
+    # zipf_chat arrival: a few hot shared prefixes (zipf popularity)
+    # with unique tails, so prefix-affinity routing and the scale-up
+    # warm start both have something to work with.
+    rng = np.random.default_rng(11)
+    prefixes = [rng.integers(1, cfg.vocab_size,
+                             prefix_len).tolist() for _ in range(4)]
+    zipf_w = np.array([1.0 / (i + 1) ** 1.1 for i in range(4)])
+    zipf_w /= zipf_w.sum()
+
+    def make_prompt():
+        pre = prefixes[int(rng.choice(4, p=zipf_w))]
+        return pre + rng.integers(1, cfg.vocab_size, tail_len).tolist()
+
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+    serve.start()
+    counts = {"completed": 0, "shed": 0, "failed": 0}
+    lock = threading.Lock()
+    max_groups = 0
+    kills = 0
+    try:
+        ups0 = metric("raytpu_serve_autoscale_decisions_total",
+                      'direction="up"')
+        downs0 = metric("raytpu_serve_autoscale_decisions_total",
+                        'direction="down"')
+        drains0 = metric("raytpu_serve_replica_drains_total")
+        app = serve.deployment(
+            max_ongoing_requests=slots,
+            autoscaling_config=dict(
+                min_replicas=1, max_replicas=max_replicas,
+                target_ongoing_requests=2.0, metrics_interval_s=0.05,
+                look_back_period_s=0.5, upscale_delay_s=0.1,
+                downscale_delay_s=0.3, target_queue_age_s=0.3,
+                target_goodput=0.5),
+        )(LLMServer).bind(
+            cfg,
+            EngineConfig(max_slots=slots,
+                         max_seq_len=max(64, prefix_len + tail_len
+                                         + gen + 16),
+                         min_prefill_bucket=16, decode_chunk=1,
+                         page_size=16, ragged_batching=True,
+                         prefix_cache=True, shed_queue_age_s=3.0),
+            lambda: params,
+            adapter_factory=slow_adapter_factory,
+        )
+        handle = serve.run(app, name="chaos", route_prefix=None)
+        shandle = handle.options(stream=True, max_retries=8)
+
+        def run_one():
+            try:
+                shandle.remote({"tokens": make_prompt(),
+                                "max_new_tokens": gen,
+                                "temperature": 0.0}).result(timeout_s=300)
+                with lock:
+                    counts["completed"] += 1
+            except ShedError:
+                with lock:
+                    counts["shed"] += 1
+            except Exception:
+                with lock:
+                    counts["failed"] += 1
+
+        # Warm the compiled paths off the clock.
+        handle.remote({"tokens": make_prompt(), "max_new_tokens": 2,
+                       "temperature": 0.0}).result(timeout_s=300)
+
+        killer = ReplicaKiller(api.runtime(), seed=0)
+        threads = []
+        # Ramp: each wave doubles down on the queue before the last
+        # one drains, so admission-queue age climbs and the reconciler
+        # scales the group count up mid-traffic.
+        for wave in range(n_waves):
+            for _ in range(wave_size):
+                th = threading.Thread(target=run_one, daemon=True)
+                th.start()
+                threads.append(th)
+            time.sleep(0.4)
+            max_groups = max(max_groups, int(metric(
+                "raytpu_serve_autoscale_actual_groups")))
+            # Chaos arm: once capacity scaled beyond one group, kill a
+            # replica out from under the live waves (survivors absorb
+            # the continuation replays).
+            if kills == 0 and len(killer.victims()) >= 2:
+                if killer.kill_one() is not None:
+                    kills += 1
+        for th in threads:
+            th.join(timeout=300)
+        # Ramp over: wait for the policy to drain the extra groups
+        # back down (downscale_delay + drain settle).
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            max_groups = max(max_groups, int(metric(
+                "raytpu_serve_autoscale_actual_groups")))
+            if (metric("raytpu_serve_autoscale_decisions_total",
+                       'direction="down"') > downs0
+                    and metric("raytpu_serve_autoscale_actual_groups")
+                    <= 1):
+                break
+            time.sleep(0.1)
+        ups = metric("raytpu_serve_autoscale_decisions_total",
+                     'direction="up"') - ups0
+        downs = metric("raytpu_serve_autoscale_decisions_total",
+                       'direction="down"') - downs0
+        drains = metric("raytpu_serve_replica_drains_total") - drains0
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+    offered = n_waves * wave_size
+    return {
+        "mix": "zipf_chat",
+        "offered": offered,
+        "completed": counts["completed"],
+        "shed": counts["shed"],
+        "failed": counts["failed"],
+        "shed_fraction": round(counts["shed"] / offered, 4),
+        "goodput_ratio": round(
+            counts["completed"] / max(1, offered - counts["shed"]), 4),
+        "scale_ups": int(ups),
+        "scale_downs": int(downs),
+        "drain_retirements": int(drains),
+        "kills": kills,
+        "max_groups": max_groups,
+        "max_replicas": max_replicas,
+        "gen": gen,
+    }
+
+
 def _measure_serving_mixed(cfg, *, n_requests: int = 48,
                            gen: int = 32, slots: int = 32,
                            arrival_rate: float = 8.0,
@@ -1310,6 +1493,24 @@ def main():
                 "slots": 4, "rank": 2}))
     except Exception as e:
         extra["serving_adapters"] = {
+            "error": repr(e).replace(": ", ":").replace(", ", ",")[:120]}
+
+    # SLO-driven autoscaling chaos: full serve-plane run (controller +
+    # autoscaled deployment + replica killer) under ramped zipf_chat
+    # arrival — goodput ratio, shed fraction, scale events, kills
+    # survived.  Runs on CPU too (control-plane behavior, not model
+    # throughput), so every record carries it.
+    try:
+        chaos_cfg = (dataclasses.replace(cfg, max_seq_len=128) if on_tpu
+                     else llama.LlamaConfig(
+                         vocab_size=128, dim=32, n_layers=2, n_heads=4,
+                         n_kv_heads=2, mlp_dim=64, max_seq_len=128,
+                         remat=False))
+        extra["serving_chaos"] = _measure_serving_chaos(
+            chaos_cfg,
+            **({} if on_tpu else {"n_waves": 3, "wave_size": 8}))
+    except Exception as e:
+        extra["serving_chaos"] = {
             "error": repr(e).replace(": ", ":").replace(", ", ",")[:120]}
 
     result = {

@@ -79,6 +79,25 @@ class PreemptedError(RayTpuError):
         return (type(self), (self.reason, self.continuation))
 
 
+class ShedError(RayTpuError):
+    """The serving engine refused to ADMIT this request: its admission
+    queue is already older than the SLO budget, so queuing the request
+    could only produce a guaranteed-late answer or a silent client
+    timeout.  Clean backpressure, not a failure of the request — no
+    work was started, so the caller may retry immediately (ideally
+    after easing off).  The serve handle does NOT transparently retry
+    it: shedding that gets re-enqueued sheds nothing."""
+
+    def __init__(self, reason: str = "request shed: admission queue over "
+                 "SLO budget", queue_age_s: float = 0.0):
+        self.reason = reason
+        self.queue_age_s = float(queue_age_s)
+        super().__init__(f"{reason} (queue age {self.queue_age_s:.3f}s)")
+
+    def __reduce__(self):
+        return (type(self), (self.reason, self.queue_age_s))
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 

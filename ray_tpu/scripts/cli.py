@@ -86,7 +86,8 @@ _LIST_ROUTES = {
                   "adapter_id", "terminal_cause"]),
     "replicas": ("/api/v0/replicas",
                  ["app", "deployment", "replica_id", "state", "role",
-                  "shard_group", "mesh_shape", "members"]),
+                  "shard_group", "mesh_shape", "members",
+                  "target_groups", "actual_groups", "autoscale"]),
 }
 
 

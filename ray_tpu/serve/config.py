@@ -28,6 +28,18 @@ class AutoscalingConfig:
     # A scale decision must hold for this long before it is applied.
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 2.0
+    # SLO-pressure scale-up (None = ongoing-count policy only).  The
+    # replicas push their engine's admission-queue age and goodput
+    # ratio next to the ongoing count; when the worst reported queue
+    # age exceeds target_queue_age_s, or the worst reported goodput
+    # drops below target_goodput, the controller forces at least one
+    # step up from the current target (and refuses to scale down) even
+    # if the averaged ongoing count alone would not.  Queue age is the
+    # leading signal — it climbs before latency SLOs blow — and
+    # goodput is the trailing guard against scaling down a fleet that
+    # is already missing its objectives.
+    target_queue_age_s: Optional[float] = None
+    target_goodput: Optional[float] = None
 
     def __post_init__(self):
         if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
@@ -37,6 +49,12 @@ class AutoscalingConfig:
             )
         if self.target_ongoing_requests <= 0:
             raise ValueError("target_ongoing_requests must be positive")
+        if (self.target_queue_age_s is not None
+                and self.target_queue_age_s <= 0):
+            raise ValueError("target_queue_age_s must be positive")
+        if (self.target_goodput is not None
+                and not 0.0 < self.target_goodput <= 1.0):
+            raise ValueError("target_goodput must be in (0, 1]")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -61,6 +61,26 @@ def _telemetry():
                 "down), by deployment and replica.",
                 tag_keys=("deployment", "replica"),
             ),
+            "autoscale_decisions": metrics.Counter(
+                "raytpu_serve_autoscale_decisions_total",
+                "Applied autoscaling decisions, by deployment and "
+                "direction (up = capacity added; down = retirement "
+                "through the DRAINING path).",
+                tag_keys=("deployment", "direction"),
+            ),
+            "autoscale_target": metrics.Gauge(
+                "raytpu_serve_autoscale_target_groups",
+                "Shard groups (replicas) the reconciler is currently "
+                "driving the deployment toward.",
+                tag_keys=("deployment",),
+            ),
+            "autoscale_actual": metrics.Gauge(
+                "raytpu_serve_autoscale_actual_groups",
+                "Shard groups (replicas) currently RUNNING, by "
+                "deployment — lags the target while replicas start "
+                "or drain.",
+                tag_keys=("deployment",),
+            ),
         }
     else:
         reg = metrics.registry()
@@ -111,6 +131,11 @@ class _Replica:
         # "decode" | "unified".  Assigned at start by live-role census
         # so a killed prefill replica's replacement is prefill again.
         self.role = "unified"
+        # Ongoing-request count carried on the last broadcast row for
+        # this replica — metric pushes rebroadcast only when the live
+        # count moved a whole request away from it (live-load routing
+        # without a 20 Hz broadcast storm).
+        self.bcast_ongoing = 0.0
 
 
 class _DeploymentState:
@@ -124,9 +149,14 @@ class _DeploymentState:
         self.replicas: Dict[str, _Replica] = {}
         self.next_replica_idx = 0
         self.deleting = False
-        # autoscaling bookkeeping
-        self.metrics: Dict[str, Tuple[float, float]] = {}  # id -> (ts, ongoing)
+        # autoscaling bookkeeping: id -> (ts, ongoing, queue_age, goodput)
+        self.metrics: Dict[str, Tuple[float, float, float,
+                                      Optional[float]]] = {}
         self._scale_intent: Optional[Tuple[int, float]] = None
+        # Last APPLIED scale decision ({direction, from, to, reason,
+        # ts}) — surfaced on list_replicas rows for `raytpu list
+        # replicas`.  None until the policy first moves the target.
+        self.last_decision: Optional[Dict[str, Any]] = None
 
     @property
     def config(self) -> DeploymentConfig:
@@ -162,38 +192,78 @@ class _DeploymentState:
 
     # -- autoscaling -------------------------------------------------------
 
-    def record_metric(self, replica_id: str, ongoing: float, ts: float):
-        self.metrics[replica_id] = (ts, ongoing)
+    def record_metric(self, replica_id: str, ongoing: float, ts: float,
+                      queue_age: float = 0.0,
+                      goodput: Optional[float] = None):
+        self.metrics[replica_id] = (ts, ongoing, queue_age, goodput)
 
-    def autoscale(self, now: float) -> None:
+    def autoscale(self, now: float) -> Optional[Dict[str, Any]]:
+        """One reconciliation pass of the scaling policy.  Three
+        signals, pushed by the replicas: the averaged ongoing-request
+        count (the sizing signal — desired = ceil(total/target)), the
+        worst admission-queue age (leading SLO pressure: it climbs
+        before any latency bound blows), and the worst goodput ratio
+        (trailing guard: a fleet already missing its objectives must
+        not shrink).  SLO pressure forces at least one step up from
+        the current target and vetoes any scale-down this pass.
+        Returns the applied decision dict, or None."""
         cfg = self.config.autoscaling_config
         if cfg is None or self.deleting:
-            return
+            return None
         running = [r for r in self.replicas.values() if r.state == "RUNNING"]
         if not running:
-            return
+            return None
         cutoff = now - cfg.look_back_period_s
         total = 0.0
+        worst_age = 0.0
+        worst_goodput: Optional[float] = None
         for r in running:
             m = self.metrics.get(r.replica_id)
             if m is not None and m[0] >= cutoff:
                 total += m[1]
+                if len(m) > 2 and m[2]:
+                    worst_age = max(worst_age, m[2])
+                if len(m) > 3 and m[3] is not None:
+                    worst_goodput = (m[3] if worst_goodput is None
+                                     else min(worst_goodput, m[3]))
         desired = math.ceil(total / cfg.target_ongoing_requests)
-        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        reason = "ongoing"
+        pressure = False
+        if (cfg.target_queue_age_s is not None
+                and worst_age > cfg.target_queue_age_s):
+            pressure, reason = True, "queue_age"
+        elif (cfg.target_goodput is not None
+              and worst_goodput is not None
+              and worst_goodput < cfg.target_goodput):
+            pressure, reason = True, "goodput"
         current = self.target_replicas
+        if pressure:
+            desired = max(desired, current + 1)
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        if pressure and desired < current:
+            desired = current
         if desired == current:
             self._scale_intent = None
-            return
+            return None
         delay = (cfg.upscale_delay_s if desired > current
                  else cfg.downscale_delay_s)
         if self._scale_intent is None or (
             (self._scale_intent[0] > current) != (desired > current)
         ):
             self._scale_intent = (desired, now)
-            return
+            return None
         if now - self._scale_intent[1] >= delay:
             self.target_replicas = desired
             self._scale_intent = None
+            self.last_decision = {
+                "direction": "up" if desired > current else "down",
+                "from": current,
+                "to": desired,
+                "reason": reason,
+                "ts": time.time(),
+            }
+            return self.last_decision
+        return None
 
 
 class ServeController:
@@ -267,11 +337,22 @@ class ServeController:
 
     def record_autoscaling_metric(self, app_name: str, deployment_name: str,
                                   replica_id: str, ongoing: float,
-                                  ts: float) -> None:
+                                  ts: float, queue_age: float = 0.0,
+                                  goodput: Optional[float] = None) -> None:
         with self._lock:
             st = self._deployments.get((app_name, deployment_name))
-            if st is not None:
-                st.record_metric(replica_id, ongoing, ts)
+            if st is None:
+                return
+            st.record_metric(replica_id, ongoing, ts, queue_age, goodput)
+            # Live-load routing: broadcast rows carry each replica's
+            # last-pushed ongoing count, so rebroadcast when the count
+            # moved a whole request away from the broadcast one —
+            # routers' p2c arm tracks real load without the controller
+            # re-notifying every push.
+            r = st.replicas.get(replica_id)
+            if (r is not None and r.state in ("RUNNING", "DRAINING")
+                    and abs(ongoing - r.bcast_ongoing) >= 1.0):
+                self._broadcast(st)
 
     def record_prefix_summary(self, app_name: str, deployment_name: str,
                               replica_id: str, summary) -> None:
@@ -314,6 +395,13 @@ class ServeController:
         rows: List[Dict[str, Any]] = []
         with self._lock:
             for (app, dep), st in sorted(self._deployments.items()):
+                actual = sum(1 for r in st.replicas.values()
+                             if r.state == "RUNNING")
+                last = st.last_decision
+                autoscale = (
+                    f"{last['direction']} {last['from']}->{last['to']} "
+                    f"({last['reason']})" if last is not None else ""
+                )
                 for rid in sorted(st.replicas):
                     r = st.replicas[rid]
                     sg = st.config.shard_group
@@ -335,20 +423,26 @@ class ServeController:
                         "mesh_shape": r.mesh_shape,
                         "members": membership,
                         "role": r.role,
+                        "target_groups": st.target_replicas,
+                        "actual_groups": actual,
+                        "autoscale": autoscale,
                     })
         return rows
 
     def migration_targets(self, app_name: str, deployment_name: str,
                           role: Optional[str] = "decode",
                           exclude: Optional[List[str]] = None,
-                          with_summary: bool = False) -> List[Tuple]:
+                          with_summary: bool = False,
+                          with_load: bool = False) -> List[Tuple]:
         """RUNNING replicas of one deployment, for the KV-migration
         plane: a prefill replica asks here for its decode handoff
         target, a cold replica for warm peers to pull prefixes from.
         Deterministic (sorted by replica id).  Rows are
         ``(replica_id, handle)`` — plus the replica's latest prefix
         summary when ``with_summary`` (prefix migration picks the
-        warmest peer by published hash count)."""
+        warmest peer by published hash count), or its last-pushed
+        ongoing-request count when ``with_load`` (prefill→decode
+        handoff picks the least-loaded decode replica)."""
         excluded = set(exclude or ())
         out: List[Tuple] = []
         with self._lock:
@@ -363,6 +457,10 @@ class ServeController:
                     continue
                 if with_summary:
                     out.append((rid, r.handle, r.prefix_summary))
+                elif with_load:
+                    m = st.metrics.get(rid)
+                    out.append((rid, r.handle,
+                                float(m[1]) if m is not None else 0.0))
                 else:
                     out.append((rid, r.handle))
         return out
@@ -404,6 +502,10 @@ class ServeController:
                 r.handle.drain.remote(grace)
             except Exception:
                 r.state = "STOPPING"  # can't even reach it — replace
+        # Routers read the draining flag off their table row (they
+        # deprioritise draining replicas for NEW requests while keeping
+        # them routable for retries) — tell them now, not at retirement.
+        self._broadcast(st)
         return True
 
     def status(self) -> Dict[str, Any]:
@@ -483,7 +585,20 @@ class ServeController:
             states = list(self._deployments.items())
         for key, st in states:
             with self._lock:
-                st.autoscale(now)
+                decision = st.autoscale(now)
+                if decision is not None:
+                    self._tm["autoscale_decisions"].inc(
+                        tags={"deployment": st.info.name,
+                              "direction": decision["direction"]})
+                if (st.config.autoscaling_config is not None
+                        and not st.deleting):
+                    self._tm["autoscale_target"].set(
+                        st.target_replicas,
+                        tags={"deployment": st.info.name})
+                    self._tm["autoscale_actual"].set(
+                        sum(1 for r in st.replicas.values()
+                            if r.state == "RUNNING"),
+                        tags={"deployment": st.info.name})
                 self._check_started(st)
                 self._check_health(st, now)
                 changed = self._scale(st)
@@ -501,8 +616,37 @@ class ServeController:
                 try:
                     api.get(r.creation_ref)
                     r.state = "RUNNING"
+                    self._maybe_warm_start(st, r)
                 except Exception:
                     r.state = "STOPPING"  # constructor failed → replace
+
+    def _maybe_warm_start(self, st: _DeploymentState, r: _Replica) -> None:
+        """A freshly RUNNING replica of an autoscaled deployment starts
+        with a cold prefix cache — every request it absorbs pays full
+        prefill until the cache warms, exactly when the fleet is under
+        the pressure that triggered the scale-up.  Kick off a one-shot
+        pull_prefix_cache against the warmest surviving peer
+        (kv_transfer's cold-start path) so the new capacity is useful
+        immediately.  Fire-and-forget: a non-LLM callable ignores the
+        method, a failed pull just means a cold start."""
+        if st.config.autoscaling_config is None:
+            return
+        warm = any(
+            p.prefix_summary for p in st.replicas.values()
+            if p is not r and p.state in ("RUNNING", "DRAINING")
+        )
+        if not warm:
+            return
+        try:
+            r.handle.handle_request.remote(
+                "pull_prefix_cache", (),
+                {"app_name": st.app_name,
+                 "deployment_name": st.info.name,
+                 "replica_id": r.replica_id},
+                None,
+            )
+        except Exception:
+            pass
 
     def _check_health(self, st: _DeploymentState, now: float):
         rt = api.runtime()
@@ -585,10 +729,21 @@ class ServeController:
         excess = len(running) + sum(
             1 for r in st.replicas.values() if r.state == "STARTING"
         ) - st.target_replicas
+        auto_down = (st.config.autoscaling_config is not None
+                     and not st.deleting)
         for r in sorted(running, key=lambda r: r.replica_id, reverse=True):
             if excess <= 0:
                 break
-            r.state = "STOPPING"
+            if auto_down:
+                # Policy scale-down retires through the DRAINING path:
+                # the replica finishes its in-flight streams (zero
+                # router retries) and leaves the broadcast table only
+                # once it has settled, so routable capacity never dips
+                # below the new target mid-decision.
+                if self._mark_draining(st, r):
+                    changed = True
+            else:
+                r.state = "STOPPING"
             excess -= 1
         for r in list(st.replicas.values()):
             if r.state == "STOPPING":
@@ -773,9 +928,13 @@ class ServeController:
             # the router retries) until _scale retires them.
             if r.state in ("RUNNING", "DRAINING"):
                 r._announced = True
+                m = st.metrics.get(r.replica_id)
+                ongoing = float(m[1]) if m is not None else 0.0
+                r.bcast_ongoing = ongoing
                 table.append(
                     (r.replica_id, r.handle, st.config.max_ongoing_requests,
-                     is_async, r.prefix_summary, r.role, r.adapter_summary)
+                     is_async, r.prefix_summary, r.role, r.adapter_summary,
+                     ongoing, r.state == "DRAINING")
                 )
         self._host.notify_changed(
             replica_set_key(st.app_name, st.info.name), table

@@ -87,12 +87,6 @@ def deployment(
         raise ValueError(
             "num_replicas and autoscaling_config are mutually exclusive"
         )
-    if shard_group is not None and autoscaling_config is not None:
-        raise ValueError(
-            "shard_group deployments do not autoscale yet — each "
-            "scale step allocates a whole placement group; set "
-            "num_replicas explicitly"
-        )
 
     def wrap(target: Callable) -> Deployment:
         cfg = DeploymentConfig(

@@ -52,6 +52,22 @@ def _migration_handoff(err: BaseException):
     return None
 
 
+def _shed_error(err: BaseException):
+    """The ShedError inside an attempt's outcome, if any — raised
+    directly (local engine) or riding a TaskError from the replica.
+    A shed is clean admission-control backpressure: no attempt ran, so
+    the handle fails fast with the unwrapped error instead of burning
+    its retry budget re-enqueueing onto the same overloaded queue."""
+    from ray_tpu.core.exceptions import ShedError, TaskError
+
+    if isinstance(err, ShedError):
+        return err
+    if (isinstance(err, TaskError)
+            and isinstance(getattr(err, "cause", None), ShedError)):
+        return err.cause
+    return None
+
+
 def _is_retriable(err: BaseException) -> bool:
     """Safe to re-enqueue the request on a surviving replica: the
     replica died (the work is lost, not duplicated) or it preempted the
@@ -301,6 +317,16 @@ class DeploymentResponseGenerator:
             except Exception as err:
                 died = _is_death(err)
                 self._router.finish_streaming(replica_id, died=died)
+                shed = _shed_error(err)
+                if shed is not None:
+                    # Admission-control shed: terminal immediately —
+                    # SHED in the ring (distinct from FAILED: nothing
+                    # ran), the unwrapped error to the caller so it can
+                    # retry on its own schedule.
+                    self._router.note_terminal(
+                        self.request_id, _reqev.SHED, cause="ShedError",
+                        generated_tokens=len(self._delivered))
+                    raise shed from None
                 handoff = _migration_handoff(err)
                 if handoff is not None and (
                         deadline is None or time.monotonic() < deadline):

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_tpu.core.exceptions import PreemptedError
+from ray_tpu.core.exceptions import PreemptedError, ShedError
 from ray_tpu.serve import request_events as _reqev
 from ray_tpu.util import tracing
 
@@ -114,8 +114,15 @@ def _telemetry():
             "terminal": metrics.Counter(
                 "raytpu_serve_request_terminal_total",
                 "Requests reaching a terminal state, by state "
-                "(FINISHED / FAILED / CANCELLED).",
+                "(FINISHED / FAILED / CANCELLED / SHED).",
                 tag_keys=("state",),
+            ),
+            "shed": metrics.Counter(
+                "raytpu_serve_shed_total",
+                "Requests refused at admission because the queue was "
+                "already older than the SLO budget "
+                "(EngineConfig.shed_queue_age_s) — clean fast-fail "
+                "backpressure instead of a guaranteed-late answer.",
             ),
             "goodput": metrics.Gauge(
                 "raytpu_serve_goodput_ratio",
@@ -248,6 +255,13 @@ class EngineConfig:
     # Latency objectives driving the SLO met/missed counters and the
     # goodput gauge (None = every finished request counts as met).
     slo: Optional[SLO] = None
+    # Overload shedding: refuse (ShedError) new submissions while the
+    # oldest unadmitted request has already waited longer than this —
+    # a request queued behind it could only produce a guaranteed-late
+    # answer, so fail fast and immediately-retriable instead of
+    # timing the client out.  The natural setting is the e2e SLO
+    # budget (slo.e2e_s).  None = never shed.
+    shed_queue_age_s: Optional[float] = None
     # Ragged batching (paged mode): one unified device step per
     # dispatch mixing decode rows (1 token per active slot) with
     # prefill chunks from the admission queue, packed up to
@@ -703,12 +717,14 @@ class LLMServer:
         checked BEFORE the truncated local submit so a missing target
         degrades to unified serving, not a wasted handoff.
 
-        Spread, don't hot-spot: the controller's target list is sorted
-        by replica id, so always taking rows[0] would funnel every
-        handoff from every prefill replica to the single lowest-id
-        decode replica.  Hash the request id across the candidates
-        (stable per request, so a retried handoff re-picks the same
-        target); payloads without an id round-robin instead."""
+        Least-loaded first: the controller returns each candidate's
+        last-pushed num_ongoing_requests next to its handle, so
+        handoffs chase live decode capacity instead of hashing blindly
+        across a fleet whose load the census order knows nothing
+        about.  The request-id hash only breaks ties between
+        equally-loaded candidates (deterministic per request, so
+        concurrent retries of one handoff agree); payloads without an
+        id round-robin the tie instead."""
         import zlib
 
         from ray_tpu.core import api
@@ -719,16 +735,18 @@ class LLMServer:
             controller = api.get_actor(CONTROLLER_NAME)
             rows = api.get(controller.migration_targets.remote(
                 dis.app_name, dis.deployment_name, role="decode",
-                exclude=[dis.replica_id]), timeout=2.0)
+                exclude=[dis.replica_id], with_load=True), timeout=2.0)
         except Exception:
             return None
         if not rows:
             return None
+        low = min(row[2] for row in rows)
+        best = [row for row in rows if row[2] <= low]
         if request_id:
-            idx = zlib.crc32(str(request_id).encode()) % len(rows)
+            idx = zlib.crc32(str(request_id).encode()) % len(best)
         else:
-            idx = next(self._handoff_rr) % len(rows)
-        return rows[idx]
+            idx = next(self._handoff_rr) % len(best)
+        return best[idx][0], best[idx][1]
 
     def _stream_prefill_handoff(self, payload: Dict[str, Any]):
         from ray_tpu.core import api
@@ -851,22 +869,43 @@ class LLMServer:
         return self.engine.export_hot_prefixes(max_pages=max_pages,
                                                mode=mode)
 
-    def pull_prefix_cache(self, max_pages: int = 256) -> int:
+    def pull_prefix_cache(self, max_pages: int = 256, *,
+                          app_name: Optional[str] = None,
+                          deployment_name: Optional[str] = None,
+                          replica_id: Optional[str] = None,
+                          transfer: Optional[str] = None,
+                          timeout_s: Optional[float] = None) -> int:
         """Prefix migration, destination side: pull hot prefixes from
         the warmest peer replica (longest published prefix summary)
         into the local pool instead of recomputing them.  Returns pages
-        ingested; 0 when there is no peer or nothing to pull."""
+        ingested; 0 when there is no peer or nothing to pull.
+
+        Identity normally comes from the ambient disagg context; the
+        explicit keyword identity is the autoscaler's warm-start path —
+        the controller knows who the new replica is and calls this on
+        it right after it reaches RUNNING, so a scaled-up group starts
+        with the fleet's hot prefixes instead of a cold trie."""
         from ray_tpu.core import api
         from ray_tpu.serve.controller import CONTROLLER_NAME
 
         dis = self._disagg
-        if dis is None or self.engine._prefix is None:
+        if dis is not None:
+            app_name = app_name or dis.app_name
+            deployment_name = deployment_name or dis.deployment_name
+            replica_id = replica_id or dis.replica_id
+            transfer = transfer or dis.transfer
+            if timeout_s is None:
+                timeout_s = dis.migration_timeout_s
+        transfer = transfer or "int8"
+        timeout_s = 5.0 if timeout_s is None else timeout_s
+        if (self.engine._prefix is None
+                or not (app_name and deployment_name and replica_id)):
             return 0
         try:
             controller = api.get_actor(CONTROLLER_NAME)
             rows = api.get(controller.migration_targets.remote(
-                dis.app_name, dis.deployment_name, role=None,
-                exclude=[dis.replica_id], with_summary=True),
+                app_name, deployment_name, role=None,
+                exclude=[replica_id], with_summary=True),
                 timeout=2.0)
         except Exception:
             return 0
@@ -878,8 +917,8 @@ class LLMServer:
         _, handle, _ = rows[0]
         try:
             transfers = api.get(handle.handle_request.remote(
-                "export_hot_prefixes", (max_pages, dis.transfer),
-                {}, None), timeout=dis.migration_timeout_s)
+                "export_hot_prefixes", (max_pages, transfer),
+                {}, None), timeout=timeout_s)
         except Exception:
             return 0
         total = 0
@@ -898,6 +937,16 @@ class LLMServer:
 
     def stats(self) -> Dict[str, Any]:
         return self.engine.stats()
+
+    def pressure(self) -> Dict[str, Any]:
+        """SLO-pressure signals for the autoscaling policy, polled by
+        the hosting ReplicaActor's metrics push loop next to
+        num_ongoing_requests: the engine's admission-queue age (the
+        leading overload signal) and cumulative goodput ratio (the
+        trailing guard; None until a request reaches a terminal
+        state)."""
+        return {"queue_age_s": self.engine.admission_queue_age(),
+                "goodput": self.engine.goodput_ratio()}
 
     def prefix_summary(self) -> Optional[Dict[str, Any]]:
         """Prefix-cache routing summary (None when the cache is off).
@@ -1367,6 +1416,25 @@ class LLMEngine:
                               "temperature": float(temperature),
                               "request_id": request_id or "",
                               "adapter_id": adapter_id})
+        shed_after = self.config.shed_queue_age_s
+        if shed_after is not None:
+            age = self._admission_queue_age()
+            if age > shed_after:
+                # Admission control: a request queued now waits behind
+                # work that is ALREADY over the SLO budget.  Record the
+                # SHED terminal (no attempt ever runs, so this is the
+                # request's whole story in this engine's ring) and fail
+                # fast — goodput accounting is untouched: shed requests
+                # produced zero tokens and protect the admitted ones.
+                rid = (request_id or _reqev.get_request_id()
+                       or f"{self._engine_id}-r{next(self._req_counter)}")
+                self._ring.record(rid, _reqev.SHED,
+                                  prompt_tokens=len(prompt),
+                                  terminal_cause="ShedError",
+                                  adapter_id=adapter_id)
+                self._tm["shed"].inc()
+                self._tm["terminal"].inc(tags={"state": _reqev.SHED})
+                raise ShedError(queue_age_s=age)
         if adapter_id and self._adapters is None:
             raise ValueError(
                 f"request carries adapter_id {adapter_id!r} but this "
@@ -1500,6 +1568,22 @@ class LLMEngine:
         if self._adapters is not None:
             out["adapters"] = self._adapters.stats()
         return out
+
+    def admission_queue_age(self) -> float:
+        """Public face of the admission-queue-age gauge: seconds the
+        oldest still-unadmitted request has waited (0.0 when nothing
+        waits).  The leading overload signal — it climbs before any
+        latency SLO blows — pushed to the controller for SLO-pressure
+        autoscaling."""
+        return self._admission_queue_age()
+
+    def goodput_ratio(self) -> Optional[float]:
+        """Cumulative goodput ratio (tokens from SLO-met requests over
+        all terminal tokens — the raytpu_serve_goodput_ratio gauge),
+        or None before any request reached a terminal state."""
+        if not self._terminal_tokens:
+            return None
+        return self._good_tokens / self._terminal_tokens
 
     def prefix_summary(self, max_entries: int = 256) -> Optional[dict]:
         """Compact routing summary of the prefix cache ({"page": …,

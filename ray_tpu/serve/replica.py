@@ -139,6 +139,11 @@ class ReplicaActor:
                                      and _adapter_fn() is not None)
         except Exception:
             self._pushes_adapters = False
+        # SLO pressure signals for the autoscaler: a callable exposing
+        # pressure() (LLMServer) reports its admission-queue age and
+        # goodput ratio with every metrics push.
+        _pressure_fn = getattr(self._callable, "pressure", None)
+        self._pressure_fn = _pressure_fn if callable(_pressure_fn) else None
         if (metrics_interval_s > 0 or self._pushes_summary
                 or self._pushes_adapters):
             threading.Thread(
@@ -438,9 +443,18 @@ class ReplicaActor:
         while not self._metrics_stop.wait(interval_s):
             try:
                 controller = api.get_actor(CONTROLLER_NAME)
+                qage, goodput = 0.0, None
+                if self._pressure_fn is not None:
+                    try:
+                        p = self._pressure_fn()
+                        qage = float(p.get("queue_age_s") or 0.0)
+                        goodput = p.get("goodput")
+                    except Exception:
+                        pass
                 controller.record_autoscaling_metric.remote(
                     self.app_name, self.deployment_name, self.replica_id,
                     self.num_ongoing_requests(), time.monotonic(),
+                    qage, goodput,
                 )
                 if self._pushes_summary:
                     try:

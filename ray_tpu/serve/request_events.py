@@ -9,6 +9,7 @@ bounded ring recording each request's state machine
 
     QUEUED → PREFILLING → DECODING → FINISHED | FAILED | CANCELLED
                                    | PREEMPTED (drained attempt)
+    SHED (refused at admission: queue age over the SLO budget)
 
 with wall-clock timestamps, token counts, slot/page assignment and the
 terminal cause.  Serve routers keep their own ring per deployment with
@@ -61,8 +62,14 @@ FINISHED = "FINISHED"
 FAILED = "FAILED"
 CANCELLED = "CANCELLED"
 PREEMPTED = "PREEMPTED"
+# SHED is the admission-control terminal: the engine refused to queue
+# the request because its admission queue was already older than the
+# SLO budget (EngineConfig.shed_queue_age_s).  Deliberately distinct
+# from FAILED — no attempt ever ran, no work was lost, and the caller
+# saw an immediate clean backpressure error instead of a timeout.
+SHED = "SHED"
 
-TERMINAL_STATES = (FINISHED, FAILED, CANCELLED, PREEMPTED)
+TERMINAL_STATES = (FINISHED, FAILED, CANCELLED, PREEMPTED, SHED)
 
 # Phase labels for the timeline rows: the span covering [state, next
 # state) is named after what the engine was doing IN that state.

@@ -73,7 +73,8 @@ def _telemetry():
 class _ReplicaInfo:
     def __init__(self, replica_id: str, handle, max_ongoing: int,
                  is_async: bool = False, prefix_summary=None,
-                 role: str = "unified", adapter_summary=None):
+                 role: str = "unified", adapter_summary=None,
+                 reported_ongoing: float = 0.0, draining: bool = False):
         self.replica_id = replica_id
         self.handle = handle
         self.max_ongoing = max_ongoing
@@ -90,6 +91,34 @@ class _ReplicaInfo:
         # Resident-adapter summary ({"adapters": [ids…]}) for LoRA
         # multiplexing.  Also a hint: the engine pool reloads on miss.
         self.adapter_summary = adapter_summary
+        # Ongoing-request count the replica last pushed through the
+        # controller (broadcast row 7) — the cross-router load signal.
+        self.reported_ongoing = reported_ongoing
+        # Broadcast row 8: the controller marked this replica DRAINING
+        # (policy scale-down or preemption notice).  Still routable —
+        # retries and migrated streams may land here — but fresh
+        # requests prefer non-draining peers so the drain settles.
+        self.draining = draining
+
+    def live_load(self) -> float:
+        """Load signal for every routing arm: the larger of this
+        router's own in-flight count (which sees its assignments a push
+        interval before the controller does) and the replica's
+        controller-reported ongoing count (which sees OTHER routers'
+        assignments this router never will)."""
+        return max(float(self.inflight), self.reported_ongoing)
+
+
+def _load_bounded(candidates: List["_ReplicaInfo"],
+                  slack: float = 2.0) -> List["_ReplicaInfo"]:
+    """Candidates within ``slack`` requests of the lightest one's live
+    load — the single imbalance bound both affinity arms (adapter
+    residency and prefix cache) select within.  Affinity outside the
+    bound is a hotspot, not a win: a replica more than ``slack``
+    requests above the floor serves a cache hit slower than a warm-miss
+    on an idle peer, so the overflow falls through to the p2c arm."""
+    floor = min(r.live_load() for r in candidates)
+    return [r for r in candidates if r.live_load() <= floor + slack]
 
 
 def _payload_tokens(args: tuple) -> Optional[List[int]]:
@@ -150,7 +179,8 @@ class Router:
 
     def _update_replicas(self, table: List[Tuple[str, Any, int]]) -> None:
         """table: [(replica_id, actor_handle, max_ongoing_requests,
-        is_async, prefix_summary, role, adapter_summary)]"""
+        is_async, prefix_summary, role, adapter_summary,
+        reported_ongoing, draining)]"""
         with self._cv:
             fresh: Dict[str, _ReplicaInfo] = {}
             for row in table:
@@ -159,6 +189,8 @@ class Router:
                 summary = row[4] if len(row) > 4 else None
                 role = row[5] if len(row) > 5 else "unified"
                 adapters = row[6] if len(row) > 6 else None
+                ongoing = float(row[7]) if len(row) > 7 else 0.0
+                draining = bool(row[8]) if len(row) > 8 else False
                 old = self._replicas.get(replica_id)
                 if old is not None:
                     old.max_ongoing = max_ongoing
@@ -166,11 +198,13 @@ class Router:
                     old.prefix_summary = summary
                     old.role = role
                     old.adapter_summary = adapters
+                    old.reported_ongoing = ongoing
+                    old.draining = draining
                     fresh[replica_id] = old
                 else:
                     fresh[replica_id] = _ReplicaInfo(
                         replica_id, handle, max_ongoing, is_async,
-                        summary, role, adapters
+                        summary, role, adapters, ongoing, draining
                     )
             self._replicas = fresh
             # Drop affinity entries pointing at replicas that left the
@@ -347,6 +381,17 @@ class Router:
                         chosen = next(
                             (r for r in candidates
                              if r.replica_id == prefer_replica), None)
+                    if chosen is None:
+                        # Draining replicas (policy scale-down,
+                        # preemption notice) stay candidates of last
+                        # resort: fresh requests prefer non-draining
+                        # peers so the drain settles, but when every
+                        # peer is saturated or gone a draining replica
+                        # beats a queue-wait (it bounces with
+                        # PreemptedError and the retry lands right).
+                        live = [r for r in candidates if not r.draining]
+                        if live:
+                            candidates = live
                     if (chosen is None and tokens is not None
                             and not resumed):
                         # Disaggregated deployment: fresh LLM payloads
@@ -375,38 +420,40 @@ class Router:
                     if chosen is None and model_id:
                         # Adapter-resident arm: a replica whose pushed
                         # summary already lists this adapter skips the
-                        # load/upload miss path entirely.  Load-bounded:
-                        # only take the resident replica while it is
-                        # within 2 in-flight requests of the lightest
-                        # candidate, so one hot adapter can't turn
-                        # affinity into a hotspot (the p2c arm below
-                        # still spreads the overflow).
-                        floor = min(r.inflight for r in candidates)
+                        # load/upload miss path entirely.  Selection
+                        # runs inside the shared _load_bounded set, so
+                        # one hot adapter can't turn affinity into a
+                        # hotspot (the p2c arm below spreads the
+                        # overflow).
                         resident = [
-                            r for r in candidates
+                            r for r in _load_bounded(candidates)
                             if model_id in (r.adapter_summary or {})
                             .get("adapters", ())
-                            and r.inflight <= floor + 2
                         ]
                         if resident:
                             chosen = min(resident,
-                                         key=lambda r: r.inflight)
+                                         key=_ReplicaInfo.live_load)
                             self._tm["adapter_routed"].inc(
                                 tags={"deployment": self.deployment_name})
                     if chosen is None and tokens is not None:
                         # Cache-aware arm: prefer the replica claiming
                         # the longest cached prefix of this prompt
                         # (hit depth in tokens; ties break on load).
-                        # Considers ALL candidates, not a p2c sample —
-                        # the summary match is local and cheap, and a
-                        # sampled pair would miss the holder half the
-                        # time at 4+ replicas.
+                        # Scans the whole _load_bounded set, not a p2c
+                        # sample — the summary match is local and
+                        # cheap, and a sampled pair would miss the
+                        # holder half the time at 4+ replicas.  The
+                        # bound is the same one the adapter arm uses:
+                        # a deep cached prefix on an overloaded replica
+                        # is slower end-to-end than a recompute on an
+                        # idle one.
                         best_depth = 0
-                        for r in candidates:
+                        for r in _load_bounded(candidates):
                             depth = match_depth(tokens, r.prefix_summary)
                             if depth > best_depth or (
                                     depth == best_depth and depth > 0
-                                    and r.inflight < chosen.inflight):
+                                    and r.live_load()
+                                    < chosen.live_load()):
                                 chosen, best_depth = r, depth
                         if chosen is not None:
                             self._tm["prefix_routed"].inc(
@@ -414,7 +461,7 @@ class Router:
                     if chosen is None:
                         if len(candidates) > 2:
                             candidates = random.sample(candidates, 2)
-                        chosen = min(candidates, key=lambda r: r.inflight)
+                        chosen = min(candidates, key=_ReplicaInfo.live_load)
                     if model_id:
                         self._model_affinity[model_id] = chosen.replica_id
                         if len(self._model_affinity) > 4096:

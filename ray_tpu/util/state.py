@@ -117,7 +117,8 @@ def list_replicas(filters: Optional[List[Filter]] = None, *,
         return []
     if not detail:
         keep = ("app", "deployment", "replica_id", "state", "role",
-                "shard_group", "mesh_shape", "members")
+                "shard_group", "mesh_shape", "members",
+                "target_groups", "actual_groups", "autoscale")
         rows = [{k: r.get(k) for k in keep} for r in rows]
     return _apply_filters(rows, filters, limit)
 

@@ -51,6 +51,10 @@ Checked:
     adapter traffic vs the same prompts single-model — the multi leg
     carries its pool counters with hit_ratio a fraction in [0, 1],
     and throughput_degradation exists iff both legs actually ran;
+  * the autoscaling chaos leg (extra.serving_chaos): goodput_ratio
+    and shed_fraction are fractions in [0, 1], the run shows >= 1
+    scale-up, >= 1 drain-based scale-down and >= 1 replica kill, and
+    completed + shed <= offered;
   * the full-8B train rung (extra.llama_8b.train): must be MEASURED
     (measured=true, numeric mfu/toks in (0, 1]/(0, inf)), carry
     zero_sharding=true + dp_shards, and satisfy the memory claim
@@ -540,6 +544,59 @@ def _check_zero(name: str, d: Any, problems: List[str]) -> None:
             f"this rung kept replicated state")
 
 
+CHAOS_REQUIRED = ("mix", "offered", "completed", "shed",
+                  "shed_fraction", "goodput_ratio", "scale_ups",
+                  "scale_downs", "kills")
+
+
+def _check_chaos(name: str, d: Any, problems: List[str]) -> None:
+    """The autoscaling chaos leg (extra.serving_chaos): ramped+bursty
+    zipf_chat arrival against an autoscaled deployment with the
+    replica killer active.  The record must show the policy actually
+    exercised — at least one scale-up, at least one drain-based
+    scale-down, and at least one replica killed — or the 'chaos' leg
+    measured a static fleet on a sunny day.  Goodput and shed fraction
+    are fractions in [0, 1]; sheds are not goodput failures (nothing
+    ran), so completed + shed <= offered."""
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    if "error" in d:  # bench leg failed; the record says so — valid
+        return
+    for k in CHAOS_REQUIRED:
+        if k not in d:
+            problems.append(f"{name}: missing required key {k!r}")
+    for k in ("offered", "completed", "shed", "kills",
+              "scale_ups", "scale_downs"):
+        if k in d and not (_num(d[k]) and d[k] >= 0):
+            problems.append(f"{name}: {k}={d.get(k)!r} must be a "
+                            f"number >= 0")
+    for k in ("goodput_ratio", "shed_fraction"):
+        v = d.get(k)
+        if k in d and not (_num(v) and 0.0 <= v <= 1.0):
+            problems.append(f"{name}: {k}={v!r} must be a fraction "
+                            f"in [0, 1]")
+    if _num(d.get("scale_ups")) and d["scale_ups"] < 1:
+        problems.append(
+            f"{name}: scale_ups={d['scale_ups']!r} — a chaos leg whose "
+            f"load never forced a scale-up tested a static fleet")
+    if _num(d.get("scale_downs")) and d["scale_downs"] < 1:
+        problems.append(
+            f"{name}: scale_downs={d['scale_downs']!r} — the ramp-down "
+            f"must drive at least one drain-based scale-down or the "
+            f"drain path went unexercised")
+    if _num(d.get("kills")) and d["kills"] < 1:
+        problems.append(
+            f"{name}: kills={d['kills']!r} — a chaos leg with no "
+            f"replica killed measured ordinary serving")
+    if (_num(d.get("offered")) and _num(d.get("completed"))
+            and _num(d.get("shed"))
+            and d["completed"] + d["shed"] > d["offered"] + 1e-9):
+        problems.append(
+            f"{name}: completed={d['completed']} + shed={d['shed']} "
+            f"exceeds offered={d['offered']}")
+
+
 def _check_mixed(name: str, d: Any, problems: List[str]) -> None:
     """A mixed-length ladder block: one serving record per prompt mix,
     each carrying the distribution that produced its knee."""
@@ -616,6 +673,9 @@ def validate_record(rec: Any) -> List[str]:
     if extra.get("serving_adapters") is not None:
         _check_adapters("extra.serving_adapters",
                         extra["serving_adapters"], problems)
+    if extra.get("serving_chaos") is not None:
+        _check_chaos("extra.serving_chaos", extra["serving_chaos"],
+                     problems)
     return problems
 
 

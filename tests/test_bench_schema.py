@@ -446,6 +446,62 @@ def test_prefix_migration_cost_field(schema):
     assert schema.validate_record(rec) == []
 
 
+# --- autoscaling chaos leg --------------------------------------------------
+
+
+def _chaos_block():
+    return {"mix": "zipf_chat", "offered": 24, "completed": 21,
+            "shed": 3, "failed": 0, "shed_fraction": 0.125,
+            "goodput_ratio": 1.0, "scale_ups": 1, "scale_downs": 1,
+            "drain_retirements": 2, "kills": 1, "max_groups": 3,
+            "max_replicas": 3, "gen": 10}
+
+
+def test_chaos_block_valid(schema):
+    rec = _record()
+    rec["extra"]["serving_chaos"] = _chaos_block()
+    assert schema.validate_record(rec) == []
+    rec["extra"]["serving_chaos"] = {"error": "RESOURCE_EXHAUSTED"}
+    assert schema.validate_record(rec) == []
+
+
+def test_chaos_required_keys_and_fractions(schema):
+    rec = _record()
+    blk = _chaos_block()
+    del blk["kills"]
+    blk["goodput_ratio"] = 1.3
+    rec["extra"]["serving_chaos"] = blk
+    probs = schema.validate_record(rec)
+    assert any("missing required key 'kills'" in p for p in probs)
+    assert any("goodput_ratio=1.3" in p and "[0, 1]" in p for p in probs)
+
+
+def test_chaos_leg_must_exercise_the_policy(schema):
+    """A chaos record showing no scale-up, no scale-down, or no kill
+    measured a static fleet on a sunny day — each is flagged."""
+    rec = _record()
+    blk = _chaos_block()
+    blk["scale_ups"] = 0
+    blk["scale_downs"] = 0
+    blk["kills"] = 0
+    rec["extra"]["serving_chaos"] = blk
+    probs = schema.validate_record(rec)
+    assert any("scale_ups=0" in p and "static fleet" in p for p in probs)
+    assert any("scale_downs=0" in p and "drain" in p for p in probs)
+    assert any("kills=0" in p for p in probs)
+
+
+def test_chaos_sheds_are_not_completions(schema):
+    """completed + shed must not exceed offered: a leg double-counting
+    shed requests as completions is cooking its goodput."""
+    rec = _record()
+    blk = _chaos_block()
+    blk["completed"] = 23  # 23 + 3 > 24 offered
+    rec["extra"]["serving_chaos"] = blk
+    probs = schema.validate_record(rec)
+    assert any("exceeds offered=24" in p for p in probs)
+
+
 def test_bench_out_if_present(schema):
     """Whatever BENCH_OUT.json the last bench run left behind must
     satisfy the schema (skips when no run has happened here)."""
@@ -472,6 +528,8 @@ def test_bench_main_emits_file_and_stdout_line(schema, tmp_path,
                         lambda *a, **k: _multihost_block())
     monkeypatch.setattr(bench, "_measure_serving_disagg",
                         lambda *a, **k: _disagg_block())
+    monkeypatch.setattr(bench, "_measure_serving_chaos",
+                        lambda *a, **k: _chaos_block())
     monkeypatch.chdir(tmp_path)
     bench.main()
     lines = capsys.readouterr().out.strip().splitlines()

@@ -379,7 +379,9 @@ def test_disagg_streams_byte_identical_to_unified_oracle(params):
         assert rows1 == rows2, "list_replicas is not deterministic"
         assert set(rows1[0]) == {"app", "deployment", "replica_id",
                                  "state", "role", "shard_group",
-                                 "mesh_shape", "members"}
+                                 "mesh_shape", "members",
+                                 "target_groups", "actual_groups",
+                                 "autoscale"}
         assert sorted(r["role"] for r in rows1) == ["decode", "prefill"]
         from ray_tpu.scripts import cli
         assert "role" in cli._LIST_ROUTES["replicas"][1]

@@ -170,9 +170,13 @@ def test_shard_group_bf16_byte_identical(mh_app, references):
     assert rows1, "no replica rows"
     r = rows1[0]
     assert set(r) == {"app", "deployment", "replica_id", "state", "role",
-                      "shard_group", "mesh_shape", "members"}
+                      "shard_group", "mesh_shape", "members",
+                      "target_groups", "actual_groups", "autoscale"}
     assert r["app"] == APP
     assert r["state"] == "RUNNING"
+    # Fixed-size deployment: target==actual and no autoscale decision.
+    assert r["target_groups"] == r["actual_groups"] == 1
+    assert r["autoscale"] == ""
     assert r["role"] == "unified"  # no DisaggConfig on this deployment
     assert r["shard_group"] == 2
     assert r["mesh_shape"] == "dcn_tp=2 x tp=2"

@@ -266,7 +266,15 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
                  "raytpu_serve_adapter_resident",
                  "raytpu_serve_adapter_hits_total",
                  "raytpu_serve_adapter_misses_total",
-                 "raytpu_serve_adapter_evictions_total"]) == []
+                 "raytpu_serve_adapter_evictions_total",
+                 # Autoscaling plane: decision counter, target/actual
+                 # group gauges (controller), and the admission-control
+                 # shed counter (engine), all declared even when the
+                 # policy never fires and nothing is ever shed.
+                 "raytpu_serve_autoscale_decisions_total",
+                 "raytpu_serve_autoscale_target_groups",
+                 "raytpu_serve_autoscale_actual_groups",
+                 "raytpu_serve_shed_total"]) == []
     assert cm.check_registry() == []
 
 
