@@ -220,6 +220,7 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
     max_seq = min(cfg.max_seq_len,
                   max(512, int(64 * np.ceil((lens.max() + gen + 1) / 64))))
     zipf = (prompt_mix or {}).get("zipf")
+    leg_t0 = time.time()  # waterfall-attribution window for this leg
     eng = LLMEngine(
         params, make_adapter(cfg),
         EngineConfig(max_slots=slots, max_seq_len=max_seq,
@@ -445,6 +446,17 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
         "decode_kernel": ("fused" if getattr(cfg, "fused_decode", False)
                           else "unfused"),
     }
+    # Per-request waterfall aggregate over this leg's requests: mean
+    # component seconds + control-plane share (absent, not zero, when
+    # nothing was attributed — scripts/bench_schema.py validates).
+    try:
+        from ray_tpu.serve import latency_attribution
+
+        dispatch_overhead = latency_attribution.aggregate(since=leg_t0)
+    except Exception:
+        dispatch_overhead = None
+    if dispatch_overhead is not None:
+        out["dispatch_overhead"] = dispatch_overhead
     if prompt_mix is not None:
         # The sampled distribution travels WITH the knee it produced:
         # a mixed-ladder TTFT is meaningless without knowing how long
@@ -616,6 +628,7 @@ def _measure_serving_disagg(cfg, *, n_requests: int = 10, gen: int = 24,
                                int(min(lens))).tolist()
 
     # --- OFF: unified engine -----------------------------------------
+    leg_t0 = time.time()  # waterfall-attribution window for this leg
     uni = make_engine()
     try:
         uni.submit(warm_prompt, max_new_tokens=gen,
@@ -723,7 +736,7 @@ def _measure_serving_disagg(cfg, *, n_requests: int = 10, gen: int = 24,
     ratio = None
     if unified["itl_p95_ms"] and disagg["itl_p95_ms"]:
         ratio = round(unified["itl_p95_ms"] / disagg["itl_p95_ms"], 2)
-    return {
+    out = {
         "mix": {"name": "long_rag", "lens": [int(x) for x in lens],
                 "weights": [round(float(w), 4) for w in weights]},
         "n_requests": n_requests,
@@ -734,6 +747,15 @@ def _measure_serving_disagg(cfg, *, n_requests: int = 10, gen: int = 24,
         "disagg": disagg,
         "itl_p95_ratio": ratio,
     }
+    try:
+        from ray_tpu.serve import latency_attribution
+
+        dispatch_overhead = latency_attribution.aggregate(since=leg_t0)
+    except Exception:
+        dispatch_overhead = None
+    if dispatch_overhead is not None:
+        out["dispatch_overhead"] = dispatch_overhead
+    return out
 
 
 def _measure_serving_adapters(cfg, *, n_adapters: int = 6,

@@ -2528,6 +2528,11 @@ class LocalRuntime:
                 from ray_tpu.serve import request_events as _request_events
 
                 _request_events.merge_remote(worker_key, reqev_rows)
+            frec_events = rep.pop("flightrec", None)
+            if frec_events:
+                from ray_tpu.util import flight_recorder as _frec
+
+                _frec.ingest(worker_key, frec_events)
         if which in ("both", "add"):
             for b in rep.get("ref_add") or ():
                 self.refs.add_borrow(worker_key, ObjectID(b))

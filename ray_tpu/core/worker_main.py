@@ -702,6 +702,16 @@ class _WorkerServer:
                 if rows:
                     rep["request_events"] = rows
                     self._reqev_ship_t = now
+            # Flight-recorder events ship incrementally (ship() moves a
+            # cursor, so every event crosses exactly once); unlike the
+            # absolute snapshots above there is no cadence gate — a
+            # trigger event must reach the driver on the NEXT reply,
+            # not up to a second later.
+            frec = sys.modules.get("ray_tpu.util.flight_recorder")
+            if frec is not None:
+                evs = frec.ship()
+                if evs:
+                    rep["flightrec"] = evs
             return rep
         finally:
             with self._busy_lock:

@@ -21,6 +21,12 @@ metrics):
                                  (state.list_replicas; ?limit=)
   GET /api/v0/requests/summarize request counts by lifecycle state and
                                  terminal cause
+  GET /api/v0/requests/<id>/waterfall
+                                 one request's critical-path latency
+                                 waterfall — route/queue/compile/
+                                 device/control-plane components that
+                                 sum to its e2e wall
+                                 (serve/latency_attribution)
   GET /api/v0/tasks/summarize
   GET /api/v0/actors/detail      ?id= one actor + its task attempts
                                  (parity: the React client's actor
@@ -40,6 +46,11 @@ metrics):
                                  jax.profiler capture (driver + every
                                  pool worker), replies with the
                                  collected trace paths (util/xprof)
+  POST /api/v0/flightrec/dump    {reason?, dump_dir?} → force a
+                                 flight-recorder bundle (events from
+                                 every process + a metrics scrape),
+                                 replies with the bundle path
+                                 (util/flight_recorder)
 """
 
 from __future__ import annotations
@@ -93,6 +104,18 @@ class _Handler(BaseHTTPRequestHandler):
                     pass
                 self._send(_metrics.export_prometheus().encode(),
                            "text/plain; version=0.0.4")
+            elif (url.path.startswith("/api/v0/requests/")
+                  and url.path.endswith("/waterfall")):
+                # Before the is_initialized gate: like /metrics, the
+                # waterfall join works on a directly-driven engine.
+                rid = url.path[len("/api/v0/requests/"):
+                               -len("/waterfall")]
+                wf = _state.request_waterfall(rid)
+                if wf is None:
+                    self._json({"error": f"no terminal request {rid!r}"},
+                               404)
+                else:
+                    self._json({"result": wf})
             elif not api.is_initialized():
                 self._json({"error": "runtime not initialized"}, 503)
             elif url.path == "/api/cluster_status":
@@ -234,6 +257,19 @@ class _Handler(BaseHTTPRequestHandler):
             parts = [p for p in url.path.split("/") if p]
             if url.path == "/api/v0/profile":
                 self._profile(body)
+                return
+            if url.path == "/api/v0/flightrec/dump":
+                from ray_tpu.util import flight_recorder
+
+                path = flight_recorder.dump(
+                    reason=str(body.get("reason") or "manual"),
+                    dump_dir=body.get("dump_dir"))
+                if path is None:
+                    self._json({"error": "no dump_dir configured "
+                                "(body dump_dir / configure() / "
+                                "RAYTPU_FLIGHTREC_DIR)"}, 400)
+                else:
+                    self._json({"result": path})
                 return
             from ray_tpu.job_submission import job_manager
 

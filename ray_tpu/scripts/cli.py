@@ -193,6 +193,57 @@ def cmd_profile(args, out) -> int:
     return 0 if traces else 1
 
 
+def cmd_trace(args, out) -> int:
+    """Print one request's critical-path latency waterfall (GET
+    /api/v0/requests/<id>/waterfall): the component partition of its
+    e2e wall plus the control-plane share, joined across every ring
+    row the head can see (router + engine attempts, all processes)."""
+    from urllib.parse import quote
+
+    try:
+        payload = _get_json(
+            _address(args),
+            f"/api/v0/requests/{quote(args.request_id)}/waterfall")
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            print(f"no terminal request {args.request_id!r}", file=out)
+            return 1
+        raise
+    wf = payload["result"]
+    print(f"request {wf['request_id']}  state={wf['state']}  "
+          f"e2e={wf['e2e_s']:.6f}s  attempts={wf['attempts']}  "
+          f"procs={','.join(wf['procs'])}", file=out)
+    e2e = wf["e2e_s"] or 0.0
+    rows = [{"component": c, "seconds": f"{v:.6f}",
+             "share": f"{(v / e2e if e2e else 0.0):.1%}"}
+            for c, v in wf["components"].items()]
+    _print_table(rows, ["component", "seconds", "share"], out)
+    print(f"control_plane_share={wf['control_plane_share']:.4f}"
+          + ("  (compile excluded)" if wf.get("compile_excluded")
+             else ""), file=out)
+    return 0
+
+
+def cmd_flightrec(args, out) -> int:
+    """Flight-recorder control: `flightrec dump` forces a bundle (POST
+    /api/v0/flightrec/dump) and prints its path."""
+    payload = {"reason": args.reason}
+    if args.dump_dir:
+        payload["dump_dir"] = args.dump_dir
+    try:
+        got = _post_json(_address(args), "/api/v0/flightrec/dump",
+                         payload)
+    except urllib.error.HTTPError as e:
+        if e.code == 400:
+            print("no dump dir configured — pass --dump-dir, call "
+                  "flight_recorder.configure(dump_dir=...), or set "
+                  "RAYTPU_FLIGHTREC_DIR", file=out)
+            return 1
+        raise
+    print(got["result"], file=out)
+    return 0
+
+
 def cmd_memory(args, out) -> int:
     rows = _get_json(_address(args),
                      f"/api/v0/objects?limit={args.limit}")["result"]
@@ -334,7 +385,9 @@ def build_parser() -> argparse.ArgumentParser:
                "placement-groups/requests/jobs), summary (tasks | "
                "requests), up, logs, timeline, "
                "profile (on-demand jax.profiler capture on every "
-               "worker), memory, job, serve, start",
+               "worker), trace (one request's latency waterfall), "
+               "flightrec (dump a flight-recorder bundle), "
+               "memory, job, serve, start",
     )
     p.add_argument("--address", default=None,
                    help="dashboard address of the cluster "
@@ -377,6 +430,23 @@ def build_parser() -> argparse.ArgumentParser:
              "worker (POST /api/v0/profile)")
     pp.add_argument("--duration", type=float, default=2.0,
                     help="capture window in seconds (clamped to 60)")
+
+    trp = sub.add_parser(
+        "trace",
+        help="one request's critical-path latency waterfall "
+             "(GET /api/v0/requests/<id>/waterfall)")
+    trp.add_argument("request_id")
+
+    frp = sub.add_parser(
+        "flightrec",
+        help="flight-recorder control "
+             "(dump: force a bundle via POST /api/v0/flightrec/dump)")
+    fsub = frp.add_subparsers(dest="frec_cmd", required=True)
+    fd = fsub.add_parser("dump", help="write a bundle now")
+    fd.add_argument("--reason", default="manual")
+    fd.add_argument("--dump-dir", default="",
+                    help="bundle directory (default: the head's "
+                         "configured dir / $RAYTPU_FLIGHTREC_DIR)")
 
     mp = sub.add_parser("memory", help="object store contents")
     mp.add_argument("--limit", type=int, default=1000)
@@ -435,6 +505,8 @@ _DISPATCH = {
     "up": cmd_up,
     "timeline": cmd_timeline,
     "profile": cmd_profile,
+    "trace": cmd_trace,
+    "flightrec": cmd_flightrec,
     "memory": cmd_memory,
     "job": cmd_job,
     "serve": cmd_serve,

@@ -241,7 +241,17 @@ class _DeploymentState:
             desired = max(desired, current + 1)
         desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
         if pressure and desired < current:
+            # Scale-down vetoed while overloaded: the fleet is pinned
+            # at max_replicas under pressure — exactly the incident the
+            # flight recorder exists for.
             desired = current
+            try:
+                from ray_tpu.util import flight_recorder
+                flight_recorder.trigger("autoscale_veto",
+                                        reason_detail=reason,
+                                        replicas=current)
+            except Exception:
+                pass
         if desired == current:
             self._scale_intent = None
             return None

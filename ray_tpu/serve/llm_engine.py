@@ -212,9 +212,17 @@ def _telemetry():
     # that never load an adapter.
     from ray_tpu.serve import adapter_pool as _apool
 
+    # The waterfall-attribution + flight-recorder families merge the
+    # same way so the tier-1 --require pins see them at zero on engines
+    # that never missed an SLO.
+    from ray_tpu.serve import latency_attribution as _lat
+    from ray_tpu.util import flight_recorder as _frec
+
     out = dict(_TELEMETRY)
     out.update(_kvt._telemetry())
     out.update(_apool._telemetry())
+    out.update(_lat._telemetry())
+    out.update({f"frec_{k}": v for k, v in _frec._telemetry().items()})
     return out
 
 
@@ -1434,6 +1442,12 @@ class LLMEngine:
                                   adapter_id=adapter_id)
                 self._tm["shed"].inc()
                 self._tm["terminal"].inc(tags={"state": _reqev.SHED})
+                try:
+                    from ray_tpu.util import flight_recorder
+                    flight_recorder.trigger("shed", request_id=rid,
+                                            queue_age_s=age)
+                except Exception:
+                    pass
                 raise ShedError(queue_age_s=age)
         if adapter_id and self._adapters is None:
             raise ValueError(
@@ -1674,13 +1688,15 @@ class LLMEngine:
         return out
 
     def _instrumented_dispatch(self, name, fn, args, span_name,
-                               steps_attr=None):
+                               steps_attr=None, cost_steps=None):
         """Dispatch one jitted program; the FIRST dispatch of each
         named program also registers it in the device plane
         (util/xprof): lowered cost analysis must happen before the call
         (the program donates its cache — afterwards those buffers are
         deleted), while the timed call itself measures trace+compile
-        wall.  Later dispatches pass straight through."""
+        wall.  Later dispatches pass straight through.  ``cost_steps``
+        declares how many tokens the recorded cost covers (the
+        per-token denominator for waterfall device estimates)."""
         if name in self._xprof_recorded:
             return fn(*args)
         self._xprof_recorded.add(name)
@@ -1691,15 +1707,26 @@ class LLMEngine:
             pass
         t0 = time.time()
         out = fn(*args)
+        t1 = time.time()
         if lowered is not None:
             try:
                 from ray_tpu.util import xprof
 
                 xprof.record_compiled(
-                    name, lowered, compile_time_s=time.time() - t0,
-                    span_name=span_name, steps_attr=steps_attr)
+                    name, lowered, compile_time_s=t1 - t0,
+                    span_name=span_name, steps_attr=steps_attr,
+                    cost_steps=cost_steps, compiled_at=t1)
             except Exception:
                 pass  # device-plane attribution is best-effort
+        # The first dispatch's wall is XLA trace+compile, not a step:
+        # tag its span compile=true so the roofline wall join skips it
+        # and the victim request's waterfall excludes it (the xprof
+        # compile window above carries the same exclusion when span
+        # capture is off).
+        if tracing.is_enabled():
+            tracing.record_span(span_name, t0, t1,
+                                attributes={"compile": True,
+                                            "program": name})
         return out
 
     def _run_prefill(self, k, tokens, true_lens, slot_or_pages, temps,
@@ -1722,6 +1749,7 @@ class LLMEngine:
                      slot_or_pages, temps, self._next_seed(),
                      self._cur_dev, slot_ids),
                     span_name="llm.prefill",
+                    cost_steps=float(np.sum(true_lens)),
                 )
         else:
             self._cache, toks_dev, self._cur_dev = \
@@ -1731,6 +1759,7 @@ class LLMEngine:
                      slot_or_pages, temps, self._next_seed(),
                      self._cur_dev, slot_ids),
                     span_name="llm.prefill",
+                    cost_steps=float(np.sum(true_lens)),
                 )
         return toks_dev
 
@@ -2144,6 +2173,7 @@ class LLMEngine:
                      scatter, self._bt_arg, self._adapters.device_pool,
                      page_table, tok_adapter),
                     span_name="llm.ragged", steps_attr="tokens",
+                    cost_steps=float(T),
                 )
         else:
             (host_toks, decode_mask, tok_slot, tok_pos, row_slot,
@@ -2156,6 +2186,7 @@ class LLMEngine:
                      row_off, temps, self._next_seed(), self._cur_dev,
                      scatter, self._bt_arg),
                     span_name="llm.ragged", steps_attr="tokens",
+                    cost_steps=float(T),
                 )
         now = time.monotonic()
         for kind, req, slot, _i in parts:
@@ -2294,6 +2325,13 @@ class LLMEngine:
                           terminal_cause=cause)
         finished = state == _reqev.FINISHED
         met = finished and self._slo_met(req)
+        if finished and not met and self.config.slo is not None:
+            try:
+                from ray_tpu.util import flight_recorder
+                flight_recorder.trigger("slo_miss",
+                                        request_id=req.request_id)
+            except Exception:
+                pass
         self._tm["terminal"].inc(tags={"state": state})
         self._tm["slo"].inc(tags={"outcome": "met" if met else "missed"})
         self._terminal_tokens += len(req.tokens)
@@ -2312,6 +2350,17 @@ class LLMEngine:
                     (req.finished_at - req.first_token_at)
                     / (len(req.tokens) - 1))
                 self._tm["itl"].observe(req.max_itl_s)
+        # Waterfall attribution: partition this request's e2e wall into
+        # the raytpu_serve_request_overhead_seconds components and fold
+        # it into the control-plane-share gauge (engine-local rows —
+        # the router-inclusive join stays driver-side).
+        try:
+            from ray_tpu.serve import latency_attribution as _lat
+            row = self._ring.row(req.request_id)
+            if row is not None:
+                _lat.observe_terminal(req.request_id, rows=[row])
+        except Exception:
+            pass  # attribution is best-effort accounting
         if not tracing.is_enabled():
             return
         # Monotonic stamps → wall clock for the trace view.
@@ -2483,6 +2532,11 @@ class LLMEngine:
                      self._active_arg, self._temps_arg,
                      self._next_seed(), self._bt_arg, self._lens_arg),
                     span_name="llm.decode", steps_attr="tokens",
+                    # One decode step produces one token per active
+                    # request: a request's per-token device share is a
+                    # full step, so the denominator is steps, not
+                    # steps x slots.
+                    cost_steps=float(chunk),
                 )
             # Host mirror advances for slots active in THIS dispatch.
             for slot in self._slot_req:
@@ -2495,6 +2549,7 @@ class LLMEngine:
                      self._active_arg, self._temps_arg,
                      self._next_seed()),
                     span_name="llm.decode", steps_attr="tokens",
+                    cost_steps=float(chunk),
                 )
         self._steps += chunk
         self._tm["step_tokens"].inc(chunk * len(self._slot_req),

@@ -117,6 +117,10 @@ class RequestRecord:
 
     @property
     def ttft_s(self) -> Optional[float]:
+        """Per-ATTEMPT time to first token.  A resumed stream's survivor
+        row lacks the original admission stamp, so the cross-attempt
+        truth (TTFT measured from FIRST admission) lives in
+        ``stitch_request`` — this property stays the single-ring view."""
         if QUEUED in self.state_ts and DECODING in self.state_ts:
             return self.state_ts[DECODING] - self.state_ts[QUEUED]
         return None
@@ -207,6 +211,9 @@ class RequestEventBuffer:
                 rec.prefix_hit = prefix_hit
             if adapter_id is not None:
                 rec.adapter_id = adapter_id
+        _flightrec_event(engine=self.engine, request_id=request_id,
+                         state=state, attempt=attempt,
+                         terminal_cause=terminal_cause)
 
     def update(self, request_id: str, *,
                generated_tokens: Optional[int] = None) -> None:
@@ -225,6 +232,20 @@ class RequestEventBuffer:
         self._records.popitem(last=False)
         self.num_dropped += 1
 
+    def row(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """One request's row dict (or None) without snapshotting the
+        whole ring — the engine's per-terminal attribution path."""
+        with self._lock:
+            rec = self._records.get(request_id)
+            if rec is None:
+                return None
+            rec = dataclasses.replace(
+                rec, state_ts=dict(rec.state_ts),
+                attempts=[dict(a) for a in rec.attempts])
+        d = rec.to_dict()
+        d["proc"] = "driver"
+        return d
+
     def snapshot(self) -> List[RequestRecord]:
         with self._lock:
             return [dataclasses.replace(r, state_ts=dict(r.state_ts),
@@ -237,6 +258,82 @@ class RequestEventBuffer:
         for rec in self.snapshot():
             out[rec.state] = out.get(rec.state, 0) + 1
         return out
+
+
+def _flightrec_event(**fields) -> None:
+    """Feed one ring transition into the always-on flight recorder
+    (util/flight_recorder).  Guarded: the recorder must never be able
+    to take the request plane down with it."""
+    try:
+        from ray_tpu.util import flight_recorder
+        flight_recorder.record("ring", **fields)
+    except Exception:
+        pass
+
+
+# -- cross-attempt stitching ------------------------------------------------
+
+def stitch_request(request_id: str,
+                   rows: Optional[List[Dict[str, Any]]] = None,
+                   ) -> Optional[Dict[str, Any]]:
+    """Join every ring row carrying ``request_id`` — router + engine
+    rows, across attempts and processes — into one request-level view.
+
+    A resumed stream (RETRYING failover, MIGRATING disagg handoff)
+    re-enters DECODING on a survivor whose ring lacks the original
+    QUEUED stamp, so any single row's ``ttft_s``/``e2e_s`` measures the
+    attempt, not the request.  Here TTFT/e2e are measured from FIRST
+    admission: earliest QUEUED → earliest DECODING / latest genuine
+    terminal (PREEMPTED is attempt-terminal — the request continued
+    elsewhere — so it never ends the stitched timeline)."""
+    if rows is None:
+        rows = [r for r in snapshot_rows()
+                if r.get("request_id") == request_id]
+    if not rows:
+        return None
+
+    def min_ts(state: str) -> Optional[float]:
+        ts = [r["state_ts"][state] for r in rows
+              if state in r.get("state_ts", {})]
+        return min(ts) if ts else None
+
+    t_admitted = min_ts(QUEUED)
+    t_first_token = min_ts(DECODING)
+    genuine = (FINISHED, FAILED, CANCELLED, SHED)
+    terminals = [(r["state_ts"][s], s) for r in rows for s in genuine
+                 if s in r.get("state_ts", {})]
+    t_terminal, state = (max(terminals) if terminals else (None, None))
+    if state is None:
+        # In flight (or only attempt-terminal PREEMPTED rows so far):
+        # surface the most recently entered state across rows.
+        entered = [(ts, s) for r in rows
+                   for s, ts in r.get("state_ts", {}).items()]
+        state = max(entered)[1] if entered else "NIL"
+    router_rows = [r for r in rows
+                   if str(r.get("engine", "")).startswith("router:")]
+    # The router row's count is total tokens DELIVERED across attempts;
+    # engine rows count per-attempt generation (a replay regenerates).
+    gen_pool = router_rows or rows
+    return {
+        "request_id": request_id,
+        "state": state,
+        "t_admitted": t_admitted,
+        "t_first_token": t_first_token,
+        "t_terminal": t_terminal,
+        "ttft_s": (t_first_token - t_admitted
+                   if t_admitted is not None and t_first_token is not None
+                   else None),
+        "e2e_s": (t_terminal - t_admitted
+                  if t_admitted is not None and t_terminal is not None
+                  else None),
+        "attempts": max((int(r.get("attempt") or 0) for r in rows),
+                        default=0),
+        "prompt_tokens": max((int(r.get("prompt_tokens") or 0)
+                              for r in rows), default=0),
+        "generated_tokens": max((int(r.get("generated_tokens") or 0)
+                                 for r in gen_pool), default=0),
+        "rows": len(rows),
+    }
 
 
 # -- process-local registry + cross-process federation ----------------------

@@ -22,6 +22,10 @@ from ray_tpu.util import tracing
 
 _TELEMETRY = None
 
+# A request reaching this many attempts trips the flight recorder's
+# retry_storm trigger (attempt numbers are 0-based; 3 = 4th try).
+RETRY_STORM_ATTEMPTS = 3
+
 
 def _telemetry():
     """Router metric singletons (re-registered on refetch — see
@@ -340,6 +344,16 @@ class Router:
                                         "reason": reason})
         self._tm["retries"].inc(
             tags={"deployment": self.deployment_name})
+        if attempt >= RETRY_STORM_ATTEMPTS:
+            # One request bouncing across this many replicas is a
+            # storm, not a blip — arm the flight recorder.
+            try:
+                from ray_tpu.util import flight_recorder
+                flight_recorder.trigger(
+                    "retry_storm", request_id=request_id,
+                    attempt=attempt, deployment=self.deployment_name)
+            except Exception:
+                pass
 
     def note_migrating(self, request_id: str, attempt: int,
                        replica_id: str, target: str) -> None:

@@ -98,6 +98,17 @@ def list_requests(filters: Optional[List[Filter]] = None, *,
     return _apply_filters(rows, filters, limit)
 
 
+def request_waterfall(request_id: str) -> Optional[Dict[str, Any]]:
+    """One request's critical-path latency waterfall, joined across
+    every ring row the driver can see (router + engine attempts, local
+    and federated) — see serve/latency_attribution.  None when the
+    request is unknown or not yet terminal.  Works without an
+    initialized runtime, same contract as ``list_requests``."""
+    from ray_tpu.serve import latency_attribution
+
+    return latency_attribution.waterfall(request_id)
+
+
 def list_replicas(filters: Optional[List[Filter]] = None, *,
                   limit: int = 100,
                   detail: bool = False) -> List[Dict[str, Any]]:

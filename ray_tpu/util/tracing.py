@@ -120,6 +120,16 @@ def _finish(rec: Dict[str, Any]) -> None:
                     f.write(json.dumps(rec) + "\n")
             except OSError:
                 pass
+    # Feed the always-on flight recorder (one ring-buffer append; the
+    # recorder must never take the tracer down with it).
+    try:
+        from ray_tpu.util import flight_recorder
+        flight_recorder.record(
+            "span", name=rec.get("name"), start=rec.get("start"),
+            end=rec.get("end"),
+            request_id=(rec.get("attributes") or {}).get("request_id"))
+    except Exception:
+        pass
 
 
 @contextlib.contextmanager

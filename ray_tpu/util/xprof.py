@@ -60,6 +60,15 @@ class ProgramRecord:
     # (None = the span is one step).
     span_name: Optional[str] = None
     steps_attr: Optional[str] = None
+    # How many tokens (serving) / steps the recorded cost numbers
+    # cover — lets latency_attribution turn flops/bytes into a
+    # per-token device estimate.  None = unknown, no estimate.
+    cost_steps: Optional[float] = None
+    # Wall-clock END of the first-call trace+compile; with
+    # compile_time_s this bounds the compile window so a waterfall can
+    # exclude compilation from the victim request's attribution even
+    # when span capture is off.
+    compiled_at: Optional[float] = None
 
 
 def _telemetry():
@@ -144,6 +153,8 @@ def record_compiled(name: str, program,
                     compile_time_s: Optional[float] = None,
                     span_name: Optional[str] = None,
                     steps_attr: Optional[str] = None,
+                    cost_steps: Optional[float] = None,
+                    compiled_at: Optional[float] = None,
                     ) -> Optional[ProgramRecord]:
     """Register one named compiled program (a ``jax.stages.Lowered`` or
     ``Compiled``) in the device plane.  Extracted cost numbers land as
@@ -158,6 +169,9 @@ def record_compiled(name: str, program,
         compile_time_s=compile_time_s,
         span_name=span_name,
         steps_attr=steps_attr,
+        cost_steps=cost_steps,
+        compiled_at=(compiled_at if compiled_at is not None
+                     else (time.time() if compile_time_s else None)),
     )
     with _lock:
         _programs[name] = rec
@@ -199,6 +213,8 @@ def _program_walls() -> Dict[str, List[float]]:
         recs = by_span.get(s.get("name"))
         if not recs or s.get("end") is None:
             continue
+        if (s.get("attributes") or {}).get("compile"):
+            continue  # first-dispatch trace+compile wall, not a step
         dur = s["end"] - s["start"]
         if dur <= 0:
             continue

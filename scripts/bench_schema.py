@@ -43,6 +43,11 @@ Checked:
     ``prefix.migration`` field (migrated-vs-recomputed prefix cost)
     follows the same absent-not-zero rule — per-page costs null only
     when that side measured nothing;
+  * dispatch-overhead blocks (a serving or disagg block's
+    ``dispatch_overhead``, from serve/latency_attribution): component
+    seconds non-negative, control_plane_share a fraction in [0, 1],
+    requests >= 1 — a leg that attributed nothing omits the block
+    (absent, not zero);
   * the disaggregation ablation (extra.serving_disagg): both legs
     carry TTFT + decode-ITL percentiles, and the disagg leg's
     migration block must show pages actually moved with bytes on the
@@ -200,6 +205,41 @@ def _check_prefix_migration(name: str, mg: Any,
                         f"but put no bytes on the wire")
 
 
+def _check_dispatch_overhead(name: str, do: Any,
+                             problems: List[str]) -> None:
+    """The per-request waterfall aggregate a serving leg may carry
+    (serve/latency_attribution.aggregate): component seconds are
+    non-negative numbers, control_plane_share is a fraction in [0, 1],
+    and a leg that attributed nothing omits the block entirely —
+    absent, not zero."""
+    if not isinstance(do, dict):
+        problems.append(f"{name}: not an object")
+        return
+    reqs = do.get("requests")
+    if not (_num(reqs) and reqs >= 1):
+        problems.append(
+            f"{name}.requests missing or < 1: {reqs!r} — a leg that "
+            f"attributed no requests must omit dispatch_overhead "
+            f"(absent, not zero)")
+    comps = do.get("components")
+    if not isinstance(comps, dict) or not comps:
+        problems.append(f"{name}.components missing or empty")
+    else:
+        for k, v in comps.items():
+            if not (_num(v) and v >= 0):
+                problems.append(
+                    f"{name}.components.{k} not a number >= 0: {v!r}")
+    share = do.get("control_plane_share")
+    if not (_num(share) and 0.0 <= share <= 1.0):
+        problems.append(
+            f"{name}.control_plane_share not a fraction in [0, 1]: "
+            f"{share!r}")
+    e2e = do.get("e2e_mean_s")
+    if not (_num(e2e) and e2e >= 0):
+        problems.append(
+            f"{name}.e2e_mean_s not a number >= 0: {e2e!r}")
+
+
 def _check_serving(name: str, d: Any, problems: List[str]) -> None:
     if not isinstance(d, dict):
         problems.append(f"{name}: not an object")
@@ -254,6 +294,9 @@ def _check_serving(name: str, d: Any, problems: List[str]) -> None:
         _check_prompt_mix(name, d["prompt_mix"], problems)
     if "prefix" in d:
         _check_prefix(name, d["prefix"], problems)
+    if "dispatch_overhead" in d:
+        _check_dispatch_overhead(f"{name}.dispatch_overhead",
+                                 d["dispatch_overhead"], problems)
 
 
 MULTIHOST_RUNG_REQUIRED = ("shards", "tp", "dcn_collective",
@@ -414,6 +457,9 @@ def _check_disagg(name: str, d: Any, problems: List[str]) -> None:
     if ratio is not None and not _num(ratio):
         problems.append(f"{name}: itl_p95_ratio={ratio!r} is neither "
                         f"a number nor null")
+    if "dispatch_overhead" in d:
+        _check_dispatch_overhead(f"{name}.dispatch_overhead",
+                                 d["dispatch_overhead"], problems)
 
 
 ADAPTER_LEG_REQUIRED = ("tokens_per_s", "ttft_p50_ms", "ttft_p95_ms")

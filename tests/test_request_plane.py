@@ -254,6 +254,14 @@ def test_request_plane_e2e(params):
             "raytpu_serve_adapter_hits_total",
             "raytpu_serve_adapter_misses_total",
             "raytpu_serve_adapter_evictions_total",
+            # Latency-attribution + flight-recorder planes: declared
+            # with the engine telemetry even when no request ever
+            # misses its SLO.
+            "raytpu_serve_request_overhead_seconds",
+            "raytpu_serve_control_plane_share",
+            "raytpu_flightrec_events",
+            "raytpu_flightrec_triggers_total",
+            "raytpu_flightrec_dumps_total",
         ]) == []
 
         # -- timeline: request rows, slot threads, globally ts-sorted -

@@ -274,7 +274,17 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
                  "raytpu_serve_autoscale_decisions_total",
                  "raytpu_serve_autoscale_target_groups",
                  "raytpu_serve_autoscale_actual_groups",
-                 "raytpu_serve_shed_total"]) == []
+                 "raytpu_serve_shed_total",
+                 # Latency-attribution plane: the per-request waterfall
+                 # histogram + the control-plane-share gauge (the
+                 # ROADMAP item-6 baseline), plus the flight recorder's
+                 # families — all declared with the engine telemetry
+                 # even before anything ever triggers.
+                 "raytpu_serve_request_overhead_seconds",
+                 "raytpu_serve_control_plane_share",
+                 "raytpu_flightrec_events",
+                 "raytpu_flightrec_triggers_total",
+                 "raytpu_flightrec_dumps_total"]) == []
     assert cm.check_registry() == []
 
 
