@@ -185,7 +185,8 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
                      params=None, adapter_factory=None,
                      prompt_mix: dict = None, mix_name: str = None,
                      ragged: bool = False,
-                     prefill_chunk: int = 0) -> dict:
+                     prefill_chunk: int = 0,
+                     spec: bool = False) -> dict:
     """Continuous-batching engine (paged KV cache), measured two ways
     (harness shape: the reference's serve microbenchmark,
     python/ray/serve/benchmarks/microbenchmark.py):
@@ -228,7 +229,12 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
                      max_new_tokens_default=gen, page_size=64,
                      ragged_batching=ragged,
                      prefill_chunk=prefill_chunk,
-                     prefix_cache=bool(zipf) and ragged),
+                     prefix_cache=bool(zipf) and ragged,
+                     # Speculative legs self-draft (draft == target):
+                     # acceptance is 1.0 by construction, so the leg
+                     # isolates the MECHANICAL overhead/benefit of
+                     # k-token verify rows, not draft-model quality.
+                     spec_decode=spec and ragged),
     )
     if zipf is not None:
         # Zipfian multi-tenant prompts: rank-k tenant drawn with
@@ -446,6 +452,26 @@ def _measure_serving(cfg, *, n_requests: int = 128, prompt_len: int = 128,
         "decode_kernel": ("fused" if getattr(cfg, "fused_decode", False)
                           else "unfused"),
     }
+    # Speculative-decoding stats (absent, not zero, when the engine
+    # never completed a verify round — scripts/bench_schema.py
+    # enforces the shape).  accepted_tokens_per_step counts the bonus
+    # token, so a healthy leg sits above 1.0 accepted tokens per
+    # target step.
+    sp = eng_stats.get("spec")
+    if spec and sp and sp.get("rounds"):
+        out["spec"] = {
+            "rounds": int(sp["rounds"]),
+            "drafted_tokens": int(sp["drafted_tokens"]),
+            "accepted_tokens": int(sp["accepted_tokens"]),
+            "accept_ratio": (
+                round(sp["accepted_tokens"] / sp["drafted_tokens"], 3)
+                if sp["drafted_tokens"] else None),
+            "accepted_tokens_per_step": round(
+                (sp["accepted_tokens"] + sp["rounds"]) / sp["rounds"], 2),
+            "cooldowns": int(sp.get("cooldowns", 0)),
+            "k": int(sp["k"]),
+            "draft": "self",
+        }
     # Per-request waterfall aggregate over this leg's requests: mean
     # component seconds + control-plane share (absent, not zero, when
     # nothing was attributed — scripts/bench_schema.py validates).
@@ -1055,21 +1081,88 @@ def _measure_serving_mixed(cfg, *, n_requests: int = 48,
     """The mixed-length ladder: one full knee ladder per PROMPT_MIX,
     served ragged (token-budget step, 256-token prefill slices) so the
     per-mix knees are comparable — the acceptance bar is that TTFT p95
-    at the knee holds as the mix shifts from short_chat to long_rag."""
+    at the knee holds as the mix shifts from short_chat to long_rag.
+    Ragged mixes serve speculatively (self-draft), report per-mix
+    acceptance, and carry a burst-only spec-on/off ablation."""
     if params is None:
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
     out = {"batching": "ragged" if ragged else "interleaved",
            "mixes": {}}
+    from ray_tpu.serve.llm_engine import llama_paged_adapter
+
+    make_adapter = adapter_factory or llama_paged_adapter
     for name, mix in PROMPT_MIXES.items():
         try:
-            out["mixes"][name] = _measure_serving(
+            leg = _measure_serving(
                 cfg, n_requests=n_requests, gen=gen, slots=slots,
                 arrival_rate=arrival_rate, params=params,
                 adapter_factory=adapter_factory, prompt_mix=mix,
                 mix_name=name, ragged=ragged,
-                prefill_chunk=256 if ragged else 0)
+                prefill_chunk=256 if ragged else 0,
+                spec=ragged)
+            out["mixes"][name] = leg
         except Exception as e:  # one collapsed mix must not eat the rest
             out["mixes"][name] = {"error": repr(e)[:120]}
+            continue
+        if "spec" not in leg:
+            continue  # leg never speculated → no ablation (absent, not zero)
+        try:
+            leg["spec_ablation"] = _probe_spec_ablation(
+                cfg, params, make_adapter, mix, gen=gen)
+        except Exception as e:
+            leg["spec_ablation"] = {"error": repr(e)[:120]}
+    return out
+
+
+def _probe_spec_ablation(cfg, params, make_adapter, mix, *,
+                         n: int = 24, gen: int = 32,
+                         slots: int = 16) -> dict:
+    """Burst-only spec-on/off A/B on IDENTICAL prompts: the same mix,
+    same seed, same engine shape, toggling only EngineConfig.spec_decode
+    — so the delta is the verify-row machinery itself, not workload
+    noise.  Burst (not open-loop) because the ablation question is
+    decode-ceiling, and a full second knee ladder per mix would double
+    the leg's wall clock for no extra signal."""
+    from ray_tpu.serve.llm_engine import EngineConfig, LLMEngine
+
+    rng = np.random.default_rng(5)
+    lens = rng.choice(np.asarray(mix["lens"]), n,
+                      p=np.asarray(mix["weights"], np.float64)
+                      / np.sum(mix["weights"]))
+    prompts = [rng.integers(0, cfg.vocab_size, int(L)).tolist()
+               for L in lens]
+    max_seq = min(cfg.max_seq_len,
+                  max(512, int(64 * np.ceil((lens.max() + gen + 1) / 64))))
+    out = {}
+    for label, spec in (("on", True), ("off", False)):
+        eng = LLMEngine(
+            params, make_adapter(cfg),
+            EngineConfig(max_slots=slots, max_seq_len=max_seq,
+                         decode_chunk=8, max_new_tokens_default=gen,
+                         page_size=64, ragged_batching=True,
+                         prefill_chunk=256, spec_decode=spec))
+        # Warm the compiled variants off the clock.
+        eng.submit(prompts[0], max_new_tokens=gen,
+                   temperature=0.0).result(timeout_s=600)
+        t0 = time.perf_counter()
+        streams = [eng.submit(p, max_new_tokens=gen, temperature=0.0)
+                   for p in prompts]
+        for s in streams:
+            s.result(timeout_s=600)
+        dt = time.perf_counter() - t0
+        sp = eng.stats().get("spec")
+        eng.shutdown()
+        leg = {"decode_tokens_per_s": round(n * gen / dt, 1)}
+        if spec and sp and sp.get("rounds"):
+            leg["accept_ratio"] = (
+                round(sp["accepted_tokens"] / sp["drafted_tokens"], 3)
+                if sp["drafted_tokens"] else None)
+            leg["accepted_tokens_per_step"] = round(
+                (sp["accepted_tokens"] + sp["rounds"]) / sp["rounds"], 2)
+        out[label] = leg
+    off_tps = out["off"]["decode_tokens_per_s"]
+    out["speedup"] = (round(out["on"]["decode_tokens_per_s"] / off_tps, 2)
+                      if off_tps else None)
     return out
 
 

@@ -1380,6 +1380,7 @@ def ragged_step_paged(
     *,
     max_row_tokens: Optional[int] = None,
     lora=None,
+    logit_idx: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One unified serving step over a ragged batch mixing prefill
     chunks (row_len > 1) and decode rows (row_len == 1).
@@ -1569,11 +1570,20 @@ def ragged_step_paged(
         new_cache = {"k": k_pool, "v": v_pool}
     # logits at each row's last fresh token
     last = jnp.clip(row_off + jnp.maximum(row_len, 1) - 1, 0, T - 1)
-    x = rms_norm(x[last], params["final_norm"], cfg.norm_eps)
     head = (params["tok_embed"].T if cfg.tie_embeddings
             else params["lm_head"])
-    logits = _head_matmul(x, head, cfg)
-    return logits.astype(jnp.float32), new_cache
+    if logit_idx is None:
+        x = rms_norm(x[last], params["final_norm"], cfg.norm_eps)
+        logits = _head_matmul(x, head, cfg)
+        return logits.astype(jnp.float32), new_cache
+    # Speculative verify: logits at extra flat-buffer positions, in
+    # ONE gather + norm + head matmul with the row-wise logits so the
+    # first R rows stay bit-identical to the logit_idx=None path.
+    R = row_slot.shape[0]
+    sel = jnp.concatenate([last, jnp.clip(logit_idx, 0, T - 1)])
+    x = rms_norm(x[sel], params["final_norm"], cfg.norm_eps)
+    logits = _head_matmul(x, head, cfg).astype(jnp.float32)
+    return logits[:R], logits[R:], new_cache
 
 
 def decode_step(

@@ -83,7 +83,7 @@ _LIST_ROUTES = {
     "requests": ("/api/v0/requests",
                  ["request_id", "engine", "state", "prompt_tokens",
                   "generated_tokens", "slot", "attempt", "prefix_hit",
-                  "adapter_id", "terminal_cause"]),
+                  "adapter_id", "spec", "terminal_cause"]),
     "replicas": ("/api/v0/replicas",
                  ["app", "deployment", "replica_id", "state", "role",
                   "shard_group", "mesh_shape", "members",

@@ -60,9 +60,11 @@ COMPONENTS = ("route", "queue", "compile", "prefill_device",
 
 # Program names whose recorded per-token device cost estimates each
 # phase (first hit wins): unified engines dispatch serve.prefill /
-# serve.decode, the mixed-batch engine dispatches serve.ragged for both.
-_PREFILL_PROGRAMS = ("serve.prefill", "serve.ragged")
-_DECODE_PROGRAMS = ("serve.decode", "serve.ragged")
+# serve.decode, the mixed-batch engine dispatches serve.ragged for both
+# (serve.ragged_spec is its speculative-verify variant — same shape,
+# same per-token cost model).
+_PREFILL_PROGRAMS = ("serve.prefill", "serve.ragged", "serve.ragged_spec")
+_DECODE_PROGRAMS = ("serve.decode", "serve.ragged", "serve.ragged_spec")
 
 _agg_lock = threading.Lock()
 # (wall ts, waterfall dict) per observed terminal request — bounded;
@@ -249,9 +251,17 @@ def waterfall(request_id: str,
         comp[interlude_kind] += dur
         d_budget -= dur
 
+    # Speculative decoding emits several tokens per verify step: the
+    # device ran one step per ROUND for those, so the per-step cost
+    # multiplies generated - accepted (each round = 1 step emitting
+    # accepted_i + 1 tokens), keeping decode_device + inter_step_gap
+    # an exact partition of the decode wall under multi-token bursts.
+    spec_acc = max((int(r.get("spec_accepted") or 0) for r in eng_rows),
+                   default=0)
     per_tok_dec = _per_token_device_s(_DECODE_PROGRAMS)
     comp["decode_device"] = min(
-        per_tok_dec * max(0, st["generated_tokens"]), d_budget)
+        per_tok_dec * max(0, st["generated_tokens"] - spec_acc),
+        d_budget)
     comp["inter_step_gap"] = d_budget - comp["decode_device"]
 
     e2e = t_end - t0

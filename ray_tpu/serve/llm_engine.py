@@ -99,9 +99,32 @@ def _telemetry():
                 "raytpu_serve_request_itl_seconds",
                 "Worst client-observed inter-token gap within a "
                 "finished request (the hiccup a streaming reader "
-                "actually sees; mean gap is TPOT).",
+                "actually sees; mean gap is TPOT).  A speculative "
+                "verify round emits several tokens in one burst: the "
+                "round's wall gap is divided by the burst size so the "
+                "histogram stays an exact per-token partition.",
                 boundaries=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                             0.1, 0.25, 1.0, 5.0],
+            ),
+            "spec_rounds": metrics.Counter(
+                "raytpu_serve_spec_rounds_total",
+                "Speculative verify rounds completed (one draft+verify "
+                "cycle of up to spec_k tokens per round).",
+            ),
+            "spec_drafted": metrics.Counter(
+                "raytpu_serve_spec_drafted_tokens_total",
+                "Tokens drafted by the draft model across verify "
+                "rounds.",
+            ),
+            "spec_accepted": metrics.Counter(
+                "raytpu_serve_spec_accepted_tokens_total",
+                "Drafted tokens the target model accepted (the free "
+                "bonus token each round emits on top is not counted).",
+            ),
+            "spec_accept_ratio": metrics.Gauge(
+                "raytpu_serve_spec_accept_ratio",
+                "Cumulative accepted/drafted token ratio over this "
+                "engine's speculative verify rounds.",
             ),
             "slo": metrics.Counter(
                 "raytpu_serve_request_slo_total",
@@ -303,6 +326,28 @@ class EngineConfig:
     adapter_page_elems: int = 8192
     max_batch_adapters: int = 8
     adapter_int8: bool = False
+    # Speculative decoding (requires ragged_batching): each round the
+    # engine drafts spec_k tokens autoregressively on a small draft
+    # model (LLMEngine(draft_params=..., draft_adapter=...); omitted =
+    # self-draft with the target weights — a testing/calibration mode)
+    # and verifies all of them in ONE target step by packing them as a
+    # k-token prefill-chunk row of the ragged batch, accepting the
+    # longest matching prefix plus one free token from the target
+    # logits.  Rejection rewinds the slot's host length mirror to the
+    # accept boundary — the paged KV rollback; rejected tail positions
+    # are overwritten by later steps and never become
+    # prefix-cache-visible.  The scheduler gates speculation per round:
+    # only greedy base-model rows with no in-flight tokens speculate,
+    # never while prefill chunks contend for the token budget, and a
+    # cold acceptance EMA (< spec_cold_accept) pauses speculation for
+    # spec_cooldown_rounds dispatches before re-probing.  Draft KV
+    # lives in a second paged pool of spec_draft_pages pages (0 =
+    # full-occupancy auto-sizing) under the same allocator discipline.
+    spec_decode: bool = False
+    spec_k: int = 4
+    spec_draft_pages: int = 0
+    spec_cold_accept: float = 0.2
+    spec_cooldown_rounds: int = 32
 
     def buckets(self) -> List[int]:
         out, b = [], self.min_prefill_bucket
@@ -413,6 +458,19 @@ class PagedEngineAdapter:
     # enables LoRA.
     ragged_step_lora: Optional[Callable[..., Tuple[jax.Array, Any]]] = None
     make_adapter_pool: Optional[Callable[[Any], Any]] = None
+    # Speculative decoding: ragged_step_verify(params, tokens, tok_pos,
+    # row_slot, row_start, row_len, row_off, block_tables, cache,
+    # logit_idx) -> (logits[R,V], verify_logits[Tv,V], cache) — the
+    # unified step returning EXTRA logits at the flat-buffer positions
+    # in logit_idx (each verify row's k+1 candidate tokens), with the
+    # first R row logits bit-identical to ragged_step.  The LoRA
+    # variant threads the adapter-pool args the same way so verify
+    # rows can ride a mixed-adapter batch — enables
+    # EngineConfig.spec_decode.
+    ragged_step_verify: Optional[
+        Callable[..., Tuple[jax.Array, jax.Array, Any]]] = None
+    ragged_step_lora_verify: Optional[
+        Callable[..., Tuple[jax.Array, jax.Array, Any]]] = None
 
 
 def llama_paged_adapter(cfg, lora_loader=None) -> PagedEngineAdapter:
@@ -438,8 +496,21 @@ def llama_paged_adapter(cfg, lora_loader=None) -> PagedEngineAdapter:
                 row_off, bt, cfg, cache,
                 lora=(stacks, tok_adapter, cfg.lora.scale))
 
+        def ragged_step_lora_verify(params, tokens, tok_pos, row_slot,
+                                    row_start, row_len, row_off, bt,
+                                    cache, pool, page_table, tok_adapter,
+                                    logit_idx):
+            flat = _sl.gather_adapter_flat(pool, page_table)
+            stacks = _sl.gather_adapter_stacks(flat, cfg, cfg.lora)
+            return llama.ragged_step_paged(
+                params, tokens, tok_pos, row_slot, row_start, row_len,
+                row_off, bt, cfg, cache,
+                lora=(stacks, tok_adapter, cfg.lora.scale),
+                logit_idx=logit_idx)
+
         lora_fields = {
             "ragged_step_lora": ragged_step_lora,
+            "ragged_step_lora_verify": ragged_step_lora_verify,
             "make_adapter_pool": lambda ecfg: AdapterPool(
                 cfg, cfg.lora,
                 num_pages=ecfg.adapter_pool_pages,
@@ -472,6 +543,11 @@ def llama_paged_adapter(cfg, lora_loader=None) -> PagedEngineAdapter:
             llama.ragged_step_paged(params, tokens, tok_pos, row_slot,
                                     row_start, row_len, row_off, bt, cfg,
                                     cache),
+        ragged_step_verify=lambda params, tokens, tok_pos, row_slot,
+        row_start, row_len, row_off, bt, cache, logit_idx:
+            llama.ragged_step_paged(params, tokens, tok_pos, row_slot,
+                                    row_start, row_len, row_off, bt, cfg,
+                                    cache, logit_idx=logit_idx),
         copy_page=llama.copy_page_paged,
         shard_params=lambda params, mesh:
             llama.shard_params_for_serving(params, cfg, mesh),
@@ -524,6 +600,11 @@ class Request:
     # under ("" = base model).  Rides the ring rows and the per-row
     # descriptor of the ragged step.
     adapter_id: str = ""
+    # Speculative decoding: tokens this request drafted / had accepted
+    # across its verify rounds (0/0 = never speculated).  Mirrored to
+    # the ring as the `spec` column of `raytpu list requests`.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -609,7 +690,9 @@ class LLMServer:
 
     def __init__(self, model_cfg: Any, engine_cfg: EngineConfig,
                  param_loader: Callable[[], Any], *, adapter_factory:
-                 Callable[[Any], EngineAdapter] = None):
+                 Callable[[Any], EngineAdapter] = None,
+                 draft_param_loader: Callable[[], Any] = None,
+                 draft_model_cfg: Any = None):
         # Rank 0 of a shard group (serve/shard_group.py) hosts the
         # engine over a hybrid DCN×ICI serving mesh: weights
         # tensor-parallel over tp (in host) × dcn_tp (across group
@@ -654,9 +737,20 @@ class LLMServer:
         self._handoff_rr = itertools.count()
         make_adapter = adapter_factory or (
             llama_paged_adapter if mesh is not None else llama_adapter)
+        # Speculative decoding's draft model loads inside the replica
+        # like the target (weights never cross the object store).  No
+        # loader + spec_decode=True = the engine self-drafts.
+        draft_params = (draft_param_loader()
+                        if draft_param_loader is not None else None)
+        draft_adapter = None
+        if draft_params is not None:
+            draft_adapter = make_adapter(draft_model_cfg
+                                         if draft_model_cfg is not None
+                                         else model_cfg)
         self.engine = LLMEngine(
             param_loader(), make_adapter(model_cfg), engine_cfg,
-            mesh=mesh,
+            mesh=mesh, draft_params=draft_params,
+            draft_adapter=draft_adapter,
         )
 
     @staticmethod
@@ -981,11 +1075,16 @@ class LLMEngine:
     """Continuous-batching scheduler around jitted prefill/decode."""
 
     def __init__(self, params: Any, adapter: EngineAdapter,
-                 config: EngineConfig, *, seed: int = 0, mesh: Any = None):
+                 config: EngineConfig, *, seed: int = 0, mesh: Any = None,
+                 draft_params: Any = None,
+                 draft_adapter: Optional["PagedEngineAdapter"] = None):
         self.config = config
         self.adapter = adapter
         self._params = params
         self._paged = isinstance(adapter, PagedEngineAdapter)
+        # Speculative decoding is armed by _init_spec at the end of the
+        # ragged setup; every other mode must still see the flag.
+        self._spec_on = False
         # Tensor-parallel serving: engine state lives sharded over the
         # mesh; GSPMD partitions every program from the placements and
         # the model's decode attention runs per shard (parity: serving
@@ -1343,7 +1442,14 @@ class LLMEngine:
 
                 self._mig_gather_fn = mig_gather_fn
                 self._mig_scatter_fn = mig_scatter_fn
+            if config.spec_decode:
+                self._init_spec(draft_params, draft_adapter)
         else:
+            if config.spec_decode:
+                raise ValueError(
+                    "EngineConfig.spec_decode requires "
+                    "ragged_batching=True — verify rows are k-token "
+                    "prefill-chunk rows of the unified ragged step")
             if getattr(adapter, "make_adapter_pool", None) is not None:
                 raise ValueError(
                     "LoRA multiplexing requires ragged_batching — the "
@@ -1405,6 +1511,139 @@ class LLMEngine:
             target=self._fetch_loop, daemon=True, name="llm-fetch"
         )
         self._fetcher.start()
+
+    def _init_spec(self, draft_params: Any,
+                   draft_adapter: Optional[PagedEngineAdapter]) -> None:
+        """Build the speculative-decoding plane: a second small paged
+        pool for the draft model's KV (same allocator discipline, own
+        OOB scratch page), the draft feed/chain programs, and the
+        target verify program — the ragged step returning EXTRA logits
+        at each verify row's candidate positions.  The BASE ragged
+        program is untouched: batches without verify rows keep
+        dispatching it, so spec-off output is the byte-identical oracle
+        by construction."""
+        config, adapter = self.config, self.adapter
+        if adapter.ragged_step_verify is None:
+            raise ValueError(
+                "EngineConfig.spec_decode requires an adapter with "
+                "ragged_step_verify (the unified step with extra "
+                "verify logits)")
+        da = draft_adapter if draft_params is not None else None
+        if draft_params is None:
+            # Self-draft: draft == target weights.  Every draft is
+            # accepted, so this exercises/measures the verify path
+            # (and drives the deterministic parity tests) rather than
+            # saving device steps.
+            draft_params = self._params
+        da = da or adapter
+        if da.ragged_step is None:
+            raise ValueError(
+                "spec_decode draft adapter must provide ragged_step")
+        page = config.page_size
+        R, Td = config.max_slots, self._token_budget
+        self._draft_params = draft_params
+        self._draft_pages = (config.spec_draft_pages
+                             or config.max_slots * self._maxp)
+        self._draft_cache = da.init_cache(self._draft_pages, page)
+        self._draft_free = list(range(self._draft_pages))
+        self._draft_slot_pages: Dict[int, List[int]] = {}
+        self._draft_bt = np.full((R, self._maxp), self._draft_pages,
+                                 np.int32)
+        # Tokens of each slot's sequence already fed to the draft KV.
+        self._draft_fed: Dict[int, int] = {}
+        # A slot with a verify round in flight is fully idle (its
+        # length mirror only advances at the accept boundary, host-side
+        # at fetch); a slot whose device cur went stale after a verify
+        # round re-seeds it through a host-token decode row.
+        self._spec_inflight: set = set()
+        self._spec_stale_cur: set = set()
+        self._spec_ema = 1.0
+        self._spec_cooldown = 0
+        self._spec_rounds = 0
+        self._spec_drafted_total = 0
+        self._spec_accepted_total = 0
+        self._spec_cooldowns = 0
+        # Static width of the verify-logit gather: flat-buffer indices
+        # of every verify row's k+1 candidate tokens, padded with 0.
+        self._spec_tv = min(Td, R * (config.spec_k + 1))
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def draft_feed_fn(params, cache, host_toks, tok_pos, row_slot,
+                          row_start, row_len, row_off, bt):
+            logits, cache = da.ragged_step(
+                params, host_toks, tok_pos, row_slot, row_start,
+                row_len, row_off, bt, cache)
+            # Row logits sit at each row's LAST fed token: a row fed
+            # through its sequence end yields draft token 1 directly.
+            return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def draft_chain_fn(params, cache, prev, tok_pos, row_slot,
+                           row_start, row_len, row_off, bt):
+            # One-token rows at row_off = arange(R): the previous
+            # step's [R] argmax IS the head of the flat token buffer.
+            toks = jnp.zeros((Td,), jnp.int32).at[:R].set(prev)
+            logits, cache = da.ragged_step(
+                params, toks, tok_pos, row_slot, row_start, row_len,
+                row_off, bt, cache)
+            return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        self._draft_feed_fn = draft_feed_fn
+        self._draft_chain_fn = draft_chain_fn
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def ragged_step_spec_fn(params, cache, host_toks, decode_mask,
+                                tok_slot, tok_pos, row_slot, row_start,
+                                row_len, row_off, temps, seed, cur,
+                                scatter_ids, bt, logit_idx):
+            toks = jnp.where(decode_mask, cur[tok_slot], host_toks)
+            logits, vlogits, cache = adapter.ragged_step_verify(
+                params, toks, tok_pos, row_slot, row_start, row_len,
+                row_off, bt, cache, logit_idx)
+            sampled = _sample(logits, temps, jax.random.key(seed[0]))
+            # Per-position target argmax of every verify candidate,
+            # computed on device — the fetch carries k+1 ints per
+            # verify row instead of k+1 logit vectors.
+            ver = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            # Verify rows keep OOB scatter ids: their row sample never
+            # becomes the emitted token (the accept boundary decides).
+            cur = cur.at[scatter_ids].set(sampled, mode="drop")
+            return cache, (sampled, ver), cur
+
+        self._ragged_step_spec_fn = ragged_step_spec_fn
+        if (self._adapters is not None
+                and adapter.ragged_step_lora_verify is not None):
+            @partial(jax.jit, donate_argnums=(1,))
+            def ragged_step_spec_lora_fn(params, cache, host_toks,
+                                         decode_mask, tok_slot, tok_pos,
+                                         row_slot, row_start, row_len,
+                                         row_off, temps, seed, cur,
+                                         scatter_ids, bt, pool,
+                                         page_table, tok_adapter,
+                                         logit_idx):
+                toks = jnp.where(decode_mask, cur[tok_slot], host_toks)
+                logits, vlogits, cache = \
+                    adapter.ragged_step_lora_verify(
+                        params, toks, tok_pos, row_slot, row_start,
+                        row_len, row_off, bt, cache, pool, page_table,
+                        tok_adapter, logit_idx)
+                sampled = _sample(logits, temps,
+                                  jax.random.key(seed[0]))
+                ver = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+                cur = cur.at[scatter_ids].set(sampled, mode="drop")
+                return cache, (sampled, ver), cur
+
+            self._ragged_step_spec_lora_fn = ragged_step_spec_lora_fn
+        elif self._adapters is not None:
+            # A verify row can share a step with another slot's LoRA
+            # row, so multiplexing + speculation needs the combined
+            # program up front, not on first collision.
+            raise ValueError(
+                "spec_decode with LoRA multiplexing requires an "
+                "adapter with ragged_step_lora_verify")
+        else:
+            self._ragged_step_spec_lora_fn = None
+        self._spec_on = True
 
     # -- client API --------------------------------------------------------
 
@@ -1581,6 +1820,19 @@ class LLMEngine:
             out["kv_migration"] = dict(self._mig_counts)
         if self._adapters is not None:
             out["adapters"] = self._adapters.stats()
+        if self._spec_on:
+            out["spec"] = {
+                "rounds": self._spec_rounds,
+                "drafted_tokens": self._spec_drafted_total,
+                "accepted_tokens": self._spec_accepted_total,
+                "accept_ratio": (
+                    self._spec_accepted_total / self._spec_drafted_total
+                    if self._spec_drafted_total else None),
+                "cooldowns": self._spec_cooldowns,
+                "ema": self._spec_ema,
+                "k": self.config.spec_k,
+                "draft_pages_free": len(self._draft_free),
+            }
         return out
 
     def admission_queue_age(self) -> float:
@@ -2070,14 +2322,156 @@ class LLMEngine:
                                      "pos": start})
             self._state_dirty = True  # bt rows changed
 
+    def _draft_alloc(self, req: Request, slot: int) -> bool:
+        """Lazily claim draft-pool pages for a slot's first
+        speculative round (sized like the target allocation — the
+        draft sequence tracks the target's).  False = draft pool
+        exhausted; the slot simply plain-decodes until pages free."""
+        if slot in self._draft_slot_pages:
+            return True
+        need = self._pages_needed(req)
+        if len(self._draft_free) < need:
+            return False
+        pages = [self._draft_free.pop() for _ in range(need)]
+        self._draft_slot_pages[slot] = pages
+        row = np.full((self._maxp,), self._draft_pages, np.int32)
+        row[: len(pages)] = pages
+        self._draft_bt[slot] = row
+        self._draft_fed[slot] = 0
+        return True
+
+    def _run_draft_feed(self, feed_rows: List[Dict[str, Any]],
+                        feed_tokens: int):
+        """Dispatch one draft catch-up/draft-1 feed over the ragged
+        packer; returns the device [R] per-row argmax (row i fed
+        through its sequence end = that slot's first draft token)."""
+        from ray_tpu.ops.ragged_paged_attention import pack_ragged_batch
+
+        R, Td = self.config.max_slots, self._token_budget
+        (host_toks, _mask, _tok_slot, tok_pos, row_slot, row_start,
+         row_len, row_off) = pack_ragged_batch(feed_rows, Td, R)
+        self._draft_cache, nxt = self._instrumented_dispatch(
+            "serve.spec_draft", self._draft_feed_fn,
+            (self._draft_params, self._draft_cache, host_toks, tok_pos,
+             row_slot, row_start, row_len, row_off,
+             np.array(self._draft_bt)),
+            span_name="llm.spec_draft")
+        self._tm["step_tokens"].inc(feed_tokens,
+                                    tags={"phase": "spec_draft"})
+        return nxt
+
+    def _spec_rem(self, req: Request) -> int:
+        """Tokens the request may still emit (no in-flight charge —
+        speculation only plans on fully-idle slots)."""
+        return min(
+            req.max_new_tokens - len(req.tokens),
+            self.config.max_seq_len - len(req.prompt) - len(req.tokens),
+        )
+
+    def _spec_draft_round(self) -> Dict[int, List[int]]:
+        """Plan and run ONE draft round: pick this dispatch's
+        speculation candidates, catch the draft KV up to each
+        candidate's sequence (one ragged feed whose row logits are the
+        first drafts), chain up to spec_k - 1 single-token draft
+        steps, and return {slot: draft tokens} for every candidate
+        whose drafts are ready to verify.  The stacked draft samples
+        come back through ONE device_get — the inherent sync point of
+        drafting; the verify step itself stays pipelined."""
+        R, Td = self.config.max_slots, self._token_budget
+        k_cfg = self.config.spec_k
+        active = sorted(self._slot_req)
+        # Every active slot takes at least one token of the verify
+        # dispatch's budget; a candidate spends k_eff on top of it.
+        budget_left = Td - len(active)
+        feed_left = Td
+        # (slot, req, k_eff, seq_len) — candidate i is feed row i.
+        plan: List[Tuple[int, Request, int, int]] = []
+        feed_rows: List[Dict[str, Any]] = []
+        catchup_rows: List[Dict[str, Any]] = []
+        feed_tokens = 0
+        for slot in active:
+            req = self._slot_req[slot]
+            if (slot in self._spec_inflight
+                    or self._inflight_tokens.get(slot, 0)
+                    or req.temperature != 0.0 or req.adapter_id
+                    or req.first_token_at is None):
+                continue
+            k_eff = min(k_cfg, self._spec_rem(req) - 1, budget_left)
+            if k_eff < 1 or not self._draft_alloc(req, slot):
+                continue
+            seq = req.prompt + req.tokens
+            fed = self._draft_fed.get(slot, 0)
+            backlog = seq[fed:]
+            if (len(backlog) > feed_left
+                    or len(feed_rows) + len(catchup_rows) >= R):
+                # Can't catch up this round: feed what fits (the KV
+                # sticks across rounds) and plain-decode meanwhile.
+                # Catch-up rows pack AFTER every candidate row so
+                # candidate i stays feed row i.
+                if feed_left > 0 and len(feed_rows) + len(
+                        catchup_rows) < R:
+                    part = backlog[:feed_left]
+                    catchup_rows.append(
+                        {"slot": slot, "start": fed,
+                         "tokens": [int(t) for t in part]})
+                    self._draft_fed[slot] = fed + len(part)
+                    feed_tokens += len(part)
+                    feed_left = 0
+                continue
+            feed_rows.append({"slot": slot, "start": fed,
+                              "tokens": [int(t) for t in backlog]})
+            feed_left -= len(backlog)
+            feed_tokens += len(backlog)
+            self._draft_fed[slot] = len(seq)
+            plan.append((slot, req, k_eff, len(seq)))
+            budget_left -= k_eff
+        feed_rows += catchup_rows
+        if not feed_rows:
+            return {}
+        nxt = self._run_draft_feed(feed_rows, feed_tokens)
+        if not plan:
+            return {}
+        max_k = max(k for _s, _r, k, _n in plan)
+        outs = [nxt]
+        chain_tokens = 0
+        row_off = np.arange(R, dtype=np.int32)
+        for m in range(2, max_k + 1):
+            row_slot = np.zeros((R,), np.int32)
+            row_start = np.zeros((R,), np.int32)
+            row_len = np.zeros((R,), np.int32)
+            tok_pos = np.zeros((Td,), np.int32)
+            for i, (slot, _req, k_eff, seq_len) in enumerate(plan):
+                if k_eff < m:
+                    continue  # shorter chains idle as len-0 rows
+                row_slot[i] = slot
+                row_start[i] = tok_pos[i] = seq_len + m - 2
+                row_len[i] = 1
+                chain_tokens += 1
+            self._draft_cache, nxt = self._instrumented_dispatch(
+                "serve.spec_chain", self._draft_chain_fn,
+                (self._draft_params, self._draft_cache, outs[-1],
+                 tok_pos, row_slot, row_start, row_len, row_off,
+                 np.array(self._draft_bt)),
+                span_name="llm.spec_draft")
+            outs.append(nxt)
+        if chain_tokens:
+            self._tm["step_tokens"].inc(chain_tokens,
+                                        tags={"phase": "spec_draft"})
+        stacked = np.asarray(jax.device_get(jnp.stack(outs)))
+        return {slot: [int(stacked[m, i]) for m in range(k_eff)]
+                for i, (slot, _req, k_eff, _n) in enumerate(plan)}
+
     def _dispatch_ragged_step(self) -> bool:
         """Pack and dispatch ONE unified ragged step: first a decode
-        row (one token) for every active slot with budget left, then
-        prefill chunks from the incremental track until token_budget
-        is full.  Decode rows are never displaced by prompt tokens —
-        that priority IS the no-stall guarantee chunked prefill only
-        approximates.  Returns False when nothing fit (every slot
-        budget-capped by in-flight tokens, no prompt tokens pending)."""
+        row (one token) or a speculative verify row (the slot's true
+        last token + its k drafts) for every active slot with budget
+        left, then prefill chunks from the incremental track until
+        token_budget is full.  Decode rows are never displaced by
+        prompt tokens — that priority IS the no-stall guarantee
+        chunked prefill only approximates — and drafting never runs
+        while prefill chunks contend for the budget.  Returns False
+        when nothing fit (every slot budget-capped by in-flight
+        tokens, no prompt tokens pending)."""
         from ray_tpu.ops.ragged_paged_attention import pack_ragged_batch
 
         T, R = self._token_budget, self.config.max_slots
@@ -2086,7 +2480,18 @@ class LLMEngine:
         parts: List[Tuple[str, Request, int, int]] = []
         scatter = np.full((R,), R, np.int32)  # OOB = sample dropped
         temps = np.zeros((R,), np.float32)
-        n_decode = n_prefill = 0
+        n_decode = n_prefill = n_spec = 0
+        # Draft a speculative round only on uncontended dispatches:
+        # pending prefill chunks always win the budget over draft
+        # tokens, and a cold acceptance EMA pauses drafting outright.
+        drafts: Dict[int, List[int]] = {}
+        spec_round = (self._spec_on and bool(self._slot_req)
+                      and not self._prefilling)
+        if spec_round and self._spec_cooldown > 0:
+            self._spec_cooldown -= 1
+            spec_round = False
+        if spec_round:
+            drafts = self._spec_draft_round()
         # Per-step adapter gather set: distinct adapter ids -> index
         # 1..K-1 (0 is the null adapter).  A row whose adapter would
         # overflow the set simply waits for the next step.
@@ -2107,6 +2512,8 @@ class LLMEngine:
         for slot in sorted(self._slot_req):
             if budget <= 0 or len(rows) >= R:
                 break
+            if self._spec_on and slot in self._spec_inflight:
+                continue  # verify round in flight: slot fully idle
             req = self._slot_req[slot]
             rem = min(
                 req.max_new_tokens - len(req.tokens),
@@ -2118,9 +2525,55 @@ class LLMEngine:
             ai = _adapter_idx(req)
             if ai is None:
                 continue
+            seq_last = int(req.tokens[-1] if req.tokens
+                           else req.prompt[-1])
+            dr = drafts.get(slot)
+            if dr and budget >= len(dr) + 1 and rem > len(dr):
+                # Verify row: the slot's true last token plus its k
+                # drafts, packed as ONE k+1-token prefill-chunk row at
+                # the current KV length.  Target logits at every
+                # candidate position come back in the verify vector;
+                # the row's own sample keeps the OOB scatter (the
+                # accept boundary is resolved host-side at fetch).
+                i = len(rows)
+                rows.append({"slot": slot,
+                             "start": int(self._lens[slot]),
+                             "tokens": [seq_last] + dr, "adapter": ai})
+                parts.append(("verify", req, slot,
+                              {"drafts": dr, "row": i,
+                               "base_len": int(self._lens[slot])}))
+                budget -= len(dr) + 1
+                n_spec += len(dr) + 1
+                continue
+            if (spec_round and dr is None
+                    and self._inflight_tokens.get(slot, 0) > 0
+                    and req.temperature == 0.0 and not req.adapter_id
+                    and req.first_token_at is not None
+                    and self._spec_rem(req) >= 2
+                    and (slot in self._draft_slot_pages
+                         or len(self._draft_free)
+                         >= self._pages_needed(req))):
+                # Spec-eligible slot with steps still in flight: hold
+                # further decode rows so its pipeline drains and the
+                # NEXT round can draft for it — k accepted tokens per
+                # verify step beats depth-k pipelining of one-token
+                # steps.  Cooldown (cold acceptance) and prefill
+                # contention clear spec_round, restoring full-depth
+                # plain pipelining.
+                continue
             i = len(rows)
-            rows.append({"slot": slot, "start": int(self._lens[slot]),
-                         "tokens": None, "adapter": ai})
+            if self._spec_on and slot in self._spec_stale_cur:
+                # The device cur went stale at the last verify round
+                # (the accept boundary was resolved host-side): a
+                # host-token row computes the identical decode step
+                # and its scatter re-seeds cur.
+                rows.append({"slot": slot,
+                             "start": int(self._lens[slot]),
+                             "tokens": [seq_last], "adapter": ai})
+            else:
+                rows.append({"slot": slot,
+                             "start": int(self._lens[slot]),
+                             "tokens": None, "adapter": ai})
             parts.append(("decode", req, slot, i))
             scatter[i] = slot
             temps[i] = req.temperature
@@ -2163,35 +2616,63 @@ class LLMEngine:
             (host_toks, decode_mask, tok_slot, tok_pos, row_slot,
              row_start, row_len, row_off, tok_adapter) = \
                 pack_ragged_batch(rows, T, R, with_adapters=True)
-            page_table = self._adapters.page_table(list(step_adapters))
-            self._cache, toks_dev, self._cur_dev = \
-                self._instrumented_dispatch(
-                    "serve.ragged", self._ragged_step_lora_fn,
-                    (self._params, self._cache, host_toks, decode_mask,
-                     tok_slot, tok_pos, row_slot, row_start, row_len,
-                     row_off, temps, self._next_seed(), self._cur_dev,
-                     scatter, self._bt_arg, self._adapters.device_pool,
-                     page_table, tok_adapter),
-                    span_name="llm.ragged", steps_attr="tokens",
-                    cost_steps=float(T),
-                )
         else:
             (host_toks, decode_mask, tok_slot, tok_pos, row_slot,
              row_start, row_len, row_off) = pack_ragged_batch(rows, T, R)
-            self._cache, toks_dev, self._cur_dev = \
-                self._instrumented_dispatch(
-                    "serve.ragged", self._ragged_step_fn,
-                    (self._params, self._cache, host_toks, decode_mask,
-                     tok_slot, tok_pos, row_slot, row_start, row_len,
-                     row_off, temps, self._next_seed(), self._cur_dev,
-                     scatter, self._bt_arg),
-                    span_name="llm.ragged", steps_attr="tokens",
-                    cost_steps=float(T),
-                )
+            tok_adapter = None
+        if n_spec:
+            # Flat-buffer positions of every verify row's k+1
+            # candidate tokens (static [Tv], padded with index 0 —
+            # harmless extra gathers) + each part's offset into the
+            # returned verify vector.
+            logit_idx = np.zeros((self._spec_tv,), np.int32)
+            row_off_np = np.asarray(row_off)
+            voff = 0
+            for kind, _req, _slot, info in parts:
+                if kind != "verify":
+                    continue
+                n = len(info["drafts"]) + 1
+                off = int(row_off_np[info["row"]])
+                logit_idx[voff:voff + n] = np.arange(off, off + n)
+                info["voff"] = voff
+                voff += n
+        args = (self._params, self._cache, host_toks, decode_mask,
+                tok_slot, tok_pos, row_slot, row_start, row_len,
+                row_off, temps, self._next_seed(), self._cur_dev,
+                scatter, self._bt_arg)
+        if step_adapters:
+            page_table = self._adapters.page_table(list(step_adapters))
+            args += (self._adapters.device_pool, page_table, tok_adapter)
+            name, fn = (("serve.ragged_spec",
+                         self._ragged_step_spec_lora_fn)
+                        if n_spec else
+                        ("serve.ragged", self._ragged_step_lora_fn))
+        else:
+            name, fn = (("serve.ragged_spec", self._ragged_step_spec_fn)
+                        if n_spec else
+                        ("serve.ragged", self._ragged_step_fn))
+        if n_spec:
+            args += (logit_idx,)
+        self._cache, toks_dev, self._cur_dev = \
+            self._instrumented_dispatch(
+                name, fn, args,
+                span_name="llm.ragged", steps_attr="tokens",
+                cost_steps=float(T),
+            )
         now = time.monotonic()
-        for kind, req, slot, _i in parts:
+        for kind, req, slot, i in parts:
+            if kind == "verify":
+                # The slot idles until its accept boundary returns:
+                # lens only advances at fetch — that deferral IS the
+                # rejection rollback point.
+                self._inflight_tokens[slot] = len(i["drafts"]) + 1
+                self._spec_inflight.add(slot)
+                continue
             if kind == "decode":
                 self._lens[slot] += 1  # mirror advances at dispatch
+                if self._spec_on:
+                    # A host-token decode row's scatter re-seeded cur.
+                    self._spec_stale_cur.discard(slot)
             self._inflight_tokens[slot] = \
                 self._inflight_tokens.get(slot, 0) + 1
         for st in finishing:
@@ -2207,6 +2688,9 @@ class LLMEngine:
         self._tm["step_tokens"].inc(n_decode, tags={"phase": "decode"})
         self._tm["step_tokens"].inc(n_prefill,
                                     tags={"phase": "prefill"})
+        if n_spec:
+            self._tm["step_tokens"].inc(n_spec,
+                                        tags={"phase": "spec_verify"})
         self._count_collective_bytes(n_decode)
         if n_decode:
             self._tm["batch_size"].observe(n_decode)
@@ -2218,12 +2702,17 @@ class LLMEngine:
                           time.monotonic()))
         return True
 
-    def _emit(self, req: Request, slot: int, tok: int):
-        """Record one generated token; finish/free the slot if done."""
+    def _emit(self, req: Request, slot: int, tok: int, burst: int = 1):
+        """Record one generated token; finish/free the slot if done.
+        ``burst`` > 1 = one of several tokens emitted by a single
+        speculative verify step: the round's wall gap is split evenly
+        across the burst so the ITL histogram stays an exact per-token
+        partition of decode wall time."""
         self._slot_req.setdefault(slot, req)
         now = time.monotonic()
         if req.last_token_at is not None:
-            req.max_itl_s = max(req.max_itl_s, now - req.last_token_at)
+            gap = (now - req.last_token_at) / max(burst, 1)
+            req.max_itl_s = max(req.max_itl_s, gap)
         req.last_token_at = now
         req.tokens.append(tok)
         req.stream.put(tok)
@@ -2250,6 +2739,79 @@ class LLMEngine:
             self._observe_request(req, state=_reqev.FINISHED, cause=cause)
             req.stream.put(_DONE)
 
+    def _finish_verify(self, req: Request, slot: int,
+                       info: Dict[str, Any], ver: np.ndarray,
+                       now: float) -> None:
+        """Resolve one fetched verify round: accept the longest draft
+        prefix that matches the target argmaxes plus the free bonus
+        token the target computed past it, rewind the slot's KV write
+        offset (the host length mirror) to the accept boundary, and
+        emit the burst.
+
+        Rollback safety: the target wrote KV for all k+1 candidate
+        positions in-place, but ``_lens[slot]`` only ever advances to
+        ``base_len + 1 + j`` — every later step (and the draft feed)
+        writes from the mirror, so rejected tail positions are
+        overwritten before anything can attend to them, the grow-only
+        int8 per-page scales merely stay conservative for the
+        overwritten tail, and the finish path donates only
+        ``seq[:-1]`` pages (always inside the accepted prefix) to the
+        prefix trie — rejected positions never become cache-visible."""
+        self._spec_inflight.discard(slot)
+        # The whole k+1 charge pops at once: speculation only launches
+        # on slots with zero in-flight tokens, so the charge is
+        # exactly this round's.
+        self._inflight_tokens.pop(slot, None)
+        drafts, base_len = info["drafts"], info["base_len"]
+        k = len(drafts)
+        voff = info["voff"]
+        row_ver = [int(t) for t in ver[voff:voff + k + 1]]
+        j = 0
+        while j < k and drafts[j] == row_ver[j]:
+            j += 1
+        self._spec_rounds += 1
+        self._spec_drafted_total += k
+        self._spec_accepted_total += j
+        self._tm["spec_rounds"].inc()
+        self._tm["spec_drafted"].inc(k)
+        if j:
+            self._tm["spec_accepted"].inc(j)
+        self._tm["spec_accept_ratio"].set(
+            self._spec_accepted_total / self._spec_drafted_total)
+        self._spec_ema = 0.8 * self._spec_ema + 0.2 * (j / k)
+        if (self._spec_cooldown == 0
+                and self._spec_ema < self.config.spec_cold_accept):
+            # Acceptance ran cold: plain-decode for a while, then
+            # re-probe with a reset EMA.
+            self._spec_cooldown = self.config.spec_cooldown_rounds
+            self._spec_cooldowns += 1
+            self._spec_ema = 1.0
+        # Draft-KV rollback: the draft fed tokens seq[-1], d1..d(k-1)
+        # at positions base_len+1 .. base_len+k, of which the first
+        # min(j, k-1) drafts survive — d(k) was never fed back.
+        self._draft_fed[slot] = base_len + 1 + min(j, k - 1)
+        if req.finished_at is not None or self._slot_req.get(slot) is not req:
+            return  # cancelled/preempted while the verify was in flight
+        # Target-KV rollback happens HERE, before any emit can finish
+        # the request and donate pages: the write offset rewinds to
+        # the accept boundary.
+        self._lens[slot] = base_len + 1 + j
+        self._state_dirty = True
+        # Device cur holds the verify row's (dropped) sample, not the
+        # accept boundary — the next decode row for this slot feeds
+        # the true last token from the host and re-seeds cur.
+        self._spec_stale_cur.add(slot)
+        req.spec_drafted += k
+        req.spec_accepted += j
+        self._ring.update(req.request_id,
+                          spec_drafted=req.spec_drafted,
+                          spec_accepted=req.spec_accepted)
+        emitted = drafts[:j] + [row_ver[j]]
+        for tok in emitted:
+            self._emit(req, slot, int(tok), burst=len(emitted))
+            if req.finished_at is not None:
+                break  # EOS/limits inside the burst: drop the tail
+
     def _release_slot(self, slot: int, *,
                       cache_tokens: Optional[List[int]] = None) -> None:
         """Return a slot (and, paged, its pages) to the free pool —
@@ -2270,6 +2832,14 @@ class LLMEngine:
         self._free_slots.append(slot)
         self._state_dirty = True
         if self._paged:
+            if self._spec_on:
+                self._spec_inflight.discard(slot)
+                self._spec_stale_cur.discard(slot)
+                self._draft_fed.pop(slot, None)
+                dpages = self._draft_slot_pages.pop(slot, None)
+                if dpages:
+                    self._draft_free.extend(dpages)
+                    self._draft_bt[slot] = self._draft_pages
             pages = self._slot_pages.pop(slot, [])
             if self._prefix is not None:
                 borrowed = self._slot_borrowed.pop(slot, [])
@@ -2322,7 +2892,10 @@ class LLMEngine:
         code."""
         self._ring.record(req.request_id, state,
                           generated_tokens=len(req.tokens),
-                          terminal_cause=cause)
+                          terminal_cause=cause,
+                          spec_drafted=req.spec_drafted or None,
+                          spec_accepted=(req.spec_accepted
+                                         if req.spec_drafted else None))
         finished = state == _reqev.FINISHED
         met = finished and self._slo_met(req)
         if finished and not met and self.config.slo is not None:
@@ -2592,7 +3165,13 @@ class LLMEngine:
                 self._fetched.put(e)
                 return
             for entry, toks in zip(entries, fetched):
-                self._fetched.put((entry, np.asarray(toks)))
+                # Speculative ragged steps return (sampled, verify)
+                # as a tuple payload — keep the structure.
+                if isinstance(toks, tuple):
+                    toks = tuple(np.asarray(t) for t in toks)
+                else:
+                    toks = np.asarray(toks)
+                self._fetched.put((entry, toks))
 
     def _process_fetched(self, block: bool) -> bool:
         """Emit every fetched entry available; returns True if any was
@@ -2623,7 +3202,14 @@ class LLMEngine:
                 # watermark as decode — a ragged step IS a decode step
                 # for every running stream in it.
                 self._note_step_time(now - t_disp, 1)
+                if isinstance(toks, tuple):
+                    toks, ver = toks  # speculative step: (sampled, verify)
+                else:
+                    ver = None
                 for rkind, req, slot, i in participants:
+                    if rkind == "verify":
+                        self._finish_verify(req, slot, i, ver, now)
+                        continue
                     left = self._inflight_tokens.get(slot, 0) - 1
                     if left > 0:
                         self._inflight_tokens[slot] = left

@@ -104,6 +104,11 @@ class RequestRecord:
     # LoRA adapter the request decodes under ("" = base model) — the
     # multi-tenant attribution key for `raytpu list requests`.
     adapter_id: str = ""
+    # Speculative decoding: draft tokens proposed / accepted for this
+    # request across its verify rounds (both 0 = the request never
+    # speculated — temperature > 0, adapter traffic, or spec off).
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def state(self) -> str:
@@ -149,6 +154,10 @@ class RequestRecord:
         d["ttft_s"] = self.ttft_s
         d["tpot_s"] = self.tpot_s
         d["e2e_s"] = self.e2e_s
+        # Display form for `raytpu list requests`: accepted/drafted,
+        # blank when the request never speculated (absent, not "0/0").
+        d["spec"] = (f"{self.spec_accepted}/{self.spec_drafted}"
+                     if self.spec_drafted else "")
         return d
 
 
@@ -174,7 +183,9 @@ class RequestEventBuffer:
                attempt: Optional[int] = None,
                attempt_info: Optional[Dict[str, Any]] = None,
                prefix_hit: Optional[int] = None,
-               adapter_id: Optional[str] = None) -> None:
+               adapter_id: Optional[str] = None,
+               spec_drafted: Optional[int] = None,
+               spec_accepted: Optional[int] = None) -> None:
         now = time.time()
         with self._lock:
             rec = self._records.get(request_id)
@@ -211,17 +222,30 @@ class RequestEventBuffer:
                 rec.prefix_hit = prefix_hit
             if adapter_id is not None:
                 rec.adapter_id = adapter_id
+            if spec_drafted is not None:
+                rec.spec_drafted = spec_drafted
+            if spec_accepted is not None:
+                rec.spec_accepted = spec_accepted
         _flightrec_event(engine=self.engine, request_id=request_id,
                          state=state, attempt=attempt,
                          terminal_cause=terminal_cause)
 
     def update(self, request_id: str, *,
-               generated_tokens: Optional[int] = None) -> None:
-        """Touch live counters without a state transition (per-token)."""
+               generated_tokens: Optional[int] = None,
+               spec_drafted: Optional[int] = None,
+               spec_accepted: Optional[int] = None) -> None:
+        """Touch live counters without a state transition (per-token /
+        per-verify-round)."""
         with self._lock:
             rec = self._records.get(request_id)
-            if rec is not None and generated_tokens is not None:
+            if rec is None:
+                return
+            if generated_tokens is not None:
                 rec.generated_tokens = generated_tokens
+            if spec_drafted is not None:
+                rec.spec_drafted = spec_drafted
+            if spec_accepted is not None:
+                rec.spec_accepted = spec_accepted
 
     def _evict_locked(self) -> None:
         for key, rec in self._records.items():

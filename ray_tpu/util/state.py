@@ -93,7 +93,7 @@ def list_requests(filters: Optional[List[Filter]] = None, *,
     if not detail:
         keep = ("request_id", "engine", "state", "prompt_tokens",
                 "generated_tokens", "slot", "attempt", "prefix_hit",
-                "adapter_id", "terminal_cause", "proc")
+                "adapter_id", "spec", "terminal_cause", "proc")
         rows = [{k: r.get(k) for k in keep} for r in rows]
     return _apply_filters(rows, filters, limit)
 

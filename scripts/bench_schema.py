@@ -43,6 +43,12 @@ Checked:
     ``prefix.migration`` field (migrated-vs-recomputed prefix cost)
     follows the same absent-not-zero rule — per-page costs null only
     when that side measured nothing;
+  * speculative-decoding blocks (a serving leg's ``spec``, present
+    only when the engine completed >= 1 verify round — absent, not
+    zero): accept_ratio a fraction in [0, 1] (null only when nothing
+    was drafted), accepted <= drafted, accepted_tokens_per_step > 0;
+    the per-mix ``spec_ablation`` (burst spec-on/off A/B) exists iff
+    the mix's spec leg ran, and never without a ``spec`` block;
   * dispatch-overhead blocks (a serving or disagg block's
     ``dispatch_overhead``, from serve/latency_attribution): component
     seconds non-negative, control_plane_share a fraction in [0, 1],
@@ -205,6 +211,98 @@ def _check_prefix_migration(name: str, mg: Any,
                         f"but put no bytes on the wire")
 
 
+SPEC_REQUIRED = ("rounds", "drafted_tokens", "accepted_tokens",
+                 "accept_ratio", "accepted_tokens_per_step", "k",
+                 "draft")
+
+
+def _check_spec(name: str, d: Any, problems: List[str]) -> None:
+    """The speculative-decoding stats a serving leg may carry
+    (bench.py reads them off LLMEngine.stats()['spec']).  A leg that
+    never completed a verify round omits the block entirely — absent,
+    not zero — so rounds must be >= 1 when the block exists.  The
+    ratio is a fraction; accepted can never exceed drafted (each round
+    accepts a prefix of what it drafted); accepted_tokens_per_step
+    counts the bonus token so it is > 0 by construction."""
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    for k in SPEC_REQUIRED:
+        if k not in d:
+            problems.append(f"{name}: missing required key {k!r}")
+    rounds = d.get("rounds")
+    if "rounds" in d and not (_num(rounds) and rounds >= 1):
+        problems.append(
+            f"{name}: rounds={rounds!r} — a leg that never speculated "
+            f"must omit the spec block (absent, not zero)")
+    for k in ("drafted_tokens", "accepted_tokens"):
+        if k in d and not (_num(d[k]) and d[k] >= 0):
+            problems.append(f"{name}: {k}={d.get(k)!r} must be a "
+                            f"number >= 0")
+    drafted = d.get("drafted_tokens")
+    accepted = d.get("accepted_tokens")
+    if _num(drafted) and _num(accepted) and accepted > drafted:
+        problems.append(
+            f"{name}: accepted_tokens={accepted} > drafted_tokens="
+            f"{drafted} — a round accepts a prefix of its draft")
+    ratio = d.get("accept_ratio", None)
+    if ratio is None:
+        if _num(drafted) and drafted > 0:
+            problems.append(
+                f"{name}: accept_ratio null with drafted_tokens="
+                f"{drafted} — null is only honest when nothing was "
+                f"drafted")
+    elif not (_num(ratio) and 0.0 <= ratio <= 1.0):
+        problems.append(f"{name}: accept_ratio={ratio!r} must be a "
+                        f"fraction in [0, 1] or null")
+    tps = d.get("accepted_tokens_per_step")
+    if "accepted_tokens_per_step" in d and not (_num(tps) and tps > 0):
+        problems.append(
+            f"{name}: accepted_tokens_per_step={tps!r} must be > 0 "
+            f"(every verify round emits at least the bonus token)")
+    if "k" in d and not (_num(d["k"]) and d["k"] >= 1):
+        problems.append(f"{name}: k={d.get('k')!r} must be a "
+                        f"number >= 1")
+    if "draft" in d and not isinstance(d.get("draft"), str):
+        problems.append(f"{name}: draft={d.get('draft')!r} must name "
+                        f"the draft model (e.g. 'self')")
+
+
+def _check_spec_ablation(name: str, d: Any,
+                         problems: List[str]) -> None:
+    """The burst spec-on/off A/B a speculative mix leg carries: both
+    legs measured the same prompts, so both must report a positive
+    decode throughput, and only the ON leg may carry acceptance
+    stats."""
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    if "error" in d:  # probe failed; the record says so — valid
+        return
+    for leg in ("on", "off"):
+        block = d.get(leg)
+        if not isinstance(block, dict):
+            problems.append(f"{name}.{leg}: missing or not an object")
+            continue
+        v = block.get("decode_tokens_per_s")
+        if not (_num(v) and v > 0):
+            problems.append(f"{name}.{leg}.decode_tokens_per_s="
+                            f"{v!r} must be a number > 0")
+        ar = block.get("accept_ratio", None)
+        if leg == "off" and ar is not None:
+            problems.append(
+                f"{name}.off carries accept_ratio={ar!r} — the "
+                f"spec-off leg has no acceptance to report")
+        if leg == "on" and ar is not None \
+                and not (_num(ar) and 0.0 <= ar <= 1.0):
+            problems.append(f"{name}.on.accept_ratio={ar!r} must be "
+                            f"a fraction in [0, 1] or null")
+    speedup = d.get("speedup", None)
+    if speedup is not None and not _num(speedup):
+        problems.append(f"{name}: speedup={speedup!r} is neither a "
+                        f"number nor null")
+
+
 def _check_dispatch_overhead(name: str, do: Any,
                              problems: List[str]) -> None:
     """The per-request waterfall aggregate a serving leg may carry
@@ -294,6 +392,15 @@ def _check_serving(name: str, d: Any, problems: List[str]) -> None:
         _check_prompt_mix(name, d["prompt_mix"], problems)
     if "prefix" in d:
         _check_prefix(name, d["prefix"], problems)
+    if "spec" in d:
+        _check_spec(f"{name}.spec", d["spec"], problems)
+    if "spec_ablation" in d:
+        _check_spec_ablation(f"{name}.spec_ablation",
+                             d["spec_ablation"], problems)
+        if "spec" not in d:
+            problems.append(
+                f"{name}: spec_ablation without a spec block — an "
+                f"ablation over a leg that never speculated")
     if "dispatch_overhead" in d:
         _check_dispatch_overhead(f"{name}.dispatch_overhead",
                                  d["dispatch_overhead"], problems)
@@ -668,6 +775,14 @@ def _check_mixed(name: str, d: Any, problems: List[str]) -> None:
                 f"{sub}: missing prompt_mix — a per-mix knee TTFT "
                 f"without its prompt-length distribution is "
                 f"uninterpretable")
+        # Ablation iff spec ran: a mix leg that speculated must price
+        # the machinery (spec-on/off A/B), and a leg that never
+        # speculated cannot carry one.
+        if (isinstance(block, dict) and "error" not in block
+                and "spec" in block and "spec_ablation" not in block):
+            problems.append(
+                f"{sub}: spec block without spec_ablation — a "
+                f"speculative mix leg must carry its on/off A/B")
 
 
 def validate_record(rec: Any) -> List[str]:

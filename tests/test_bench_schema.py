@@ -231,6 +231,101 @@ def test_mixed_error_leg_is_valid(schema):
     assert schema.validate_record(rec) == []
 
 
+# --- speculative-decoding blocks -------------------------------------------
+
+
+def _spec_block():
+    return {"rounds": 40, "drafted_tokens": 160, "accepted_tokens": 150,
+            "accept_ratio": 0.938, "accepted_tokens_per_step": 4.75,
+            "cooldowns": 0, "k": 4, "draft": "self"}
+
+
+def _spec_ablation_block():
+    return {"on": {"decode_tokens_per_s": 520.0, "accept_ratio": 0.94,
+                   "accepted_tokens_per_step": 4.75},
+            "off": {"decode_tokens_per_s": 310.0},
+            "speedup": 1.68}
+
+
+def test_spec_blocks_valid(schema):
+    rec = _mixed_record()
+    mix = rec["extra"]["serving_mixed"]["mixes"]["short_chat"]
+    mix["spec"] = _spec_block()
+    mix["spec_ablation"] = _spec_ablation_block()
+    assert schema.validate_record(rec) == []
+    # A standalone serving leg may carry spec without the ablation.
+    rec2 = _record()
+    rec2["extra"]["serving"]["spec"] = _spec_block()
+    assert schema.validate_record(rec2) == []
+    # An honest probe error passes through.
+    mix["spec_ablation"] = {"error": "RESOURCE_EXHAUSTED"}
+    assert schema.validate_record(rec) == []
+
+
+def test_spec_block_absent_not_zero(schema):
+    """A leg that never completed a verify round must omit the spec
+    block entirely — rounds=0 inside one is flagged."""
+    rec = _record()
+    sp = _spec_block()
+    sp["rounds"] = 0
+    rec["extra"]["serving"]["spec"] = sp
+    probs = schema.validate_record(rec)
+    assert any("absent, not zero" in p for p in probs)
+
+
+def test_spec_ratio_bounds_and_accept_le_drafted(schema):
+    rec = _record()
+    sp = _spec_block()
+    sp["accept_ratio"] = 1.4
+    sp["accepted_tokens"] = 200  # > drafted 160
+    rec["extra"]["serving"]["spec"] = sp
+    probs = schema.validate_record(rec)
+    assert any("accept_ratio=1.4" in p and "[0, 1]" in p for p in probs)
+    assert any("accepts a prefix of its draft" in p for p in probs)
+    sp = _spec_block()
+    sp["accept_ratio"] = None  # but drafted_tokens = 160
+    rec["extra"]["serving"]["spec"] = sp
+    probs = schema.validate_record(rec)
+    assert any("null is only honest" in p for p in probs)
+
+
+def test_spec_tokens_per_step_must_be_positive(schema):
+    rec = _record()
+    sp = _spec_block()
+    sp["accepted_tokens_per_step"] = 0
+    rec["extra"]["serving"]["spec"] = sp
+    probs = schema.validate_record(rec)
+    assert any("accepted_tokens_per_step" in p and "bonus token" in p
+               for p in probs)
+
+
+def test_spec_ablation_iff_spec_ran(schema):
+    """A speculative MIX leg must carry its on/off A/B, and no leg may
+    carry an ablation without a spec block."""
+    rec = _mixed_record()
+    mix = rec["extra"]["serving_mixed"]["mixes"]["short_chat"]
+    mix["spec"] = _spec_block()  # no spec_ablation
+    probs = schema.validate_record(rec)
+    assert any("must carry its on/off A/B" in p for p in probs)
+    del mix["spec"]
+    mix["spec_ablation"] = _spec_ablation_block()
+    probs = schema.validate_record(rec)
+    assert any("a leg that never speculated" in p for p in probs)
+
+
+def test_spec_ablation_leg_shapes(schema):
+    rec = _mixed_record()
+    mix = rec["extra"]["serving_mixed"]["mixes"]["short_chat"]
+    mix["spec"] = _spec_block()
+    ab = _spec_ablation_block()
+    ab["off"]["accept_ratio"] = 0.9  # off leg has no acceptance
+    del ab["on"]["decode_tokens_per_s"]
+    mix["spec_ablation"] = ab
+    probs = schema.validate_record(rec)
+    assert any("spec-off leg has no acceptance" in p for p in probs)
+    assert any("on.decode_tokens_per_s" in p for p in probs)
+
+
 def _multihost_rung(shards=2, tp=2, mode="int8", dcn=1152,
                     ratio=3.55):
     return {"shards": shards, "tp": tp, "dcn_collective": mode,
@@ -701,6 +796,22 @@ def test_render_saturated_ladder_never_shows_a_knee(tables):
     row = next(l for l in block.splitlines() if "1.14B" in l)
     assert "saturated" in row
     assert "3.0" not in row and "247.1" not in row
+
+
+def test_render_spec_ablation_table(tables):
+    """A mixed record with a speculative mix renders the spec table;
+    a record with no spec block anywhere omits it entirely."""
+    rec = _mixed_record()
+    assert "Speculative decoding" not in tables.render(rec)
+    mix = rec["extra"]["serving_mixed"]["mixes"]["short_chat"]
+    mix["spec"] = _spec_block()
+    mix["spec_ablation"] = _spec_ablation_block()
+    block = tables.render(rec)
+    assert "Speculative decoding" in block
+    row = next(l for l in block.splitlines()
+               if l.startswith("| short_chat"))
+    assert "0.938" in row and "4.75" in row
+    assert "520.0" in row and "310.0" in row and "1.68" in row
 
 
 def test_render_fused_kernel_row_labeled(tables):

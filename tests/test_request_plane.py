@@ -262,6 +262,12 @@ def test_request_plane_e2e(params):
             "raytpu_flightrec_events",
             "raytpu_flightrec_triggers_total",
             "raytpu_flightrec_dumps_total",
+            # Speculative-decoding families: declared with the engine
+            # telemetry even when the engine never speculates.
+            "raytpu_serve_spec_rounds_total",
+            "raytpu_serve_spec_drafted_tokens_total",
+            "raytpu_serve_spec_accepted_tokens_total",
+            "raytpu_serve_spec_accept_ratio",
         ]) == []
 
         # -- timeline: request rows, slot threads, globally ts-sorted -

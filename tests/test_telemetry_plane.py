@@ -284,7 +284,13 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
                  "raytpu_serve_control_plane_share",
                  "raytpu_flightrec_events",
                  "raytpu_flightrec_triggers_total",
-                 "raytpu_flightrec_dumps_total"]) == []
+                 "raytpu_flightrec_dumps_total",
+                 # Speculative decoding: declared with the engine
+                 # telemetry even when the engine never speculates.
+                 "raytpu_serve_spec_rounds_total",
+                 "raytpu_serve_spec_drafted_tokens_total",
+                 "raytpu_serve_spec_accepted_tokens_total",
+                 "raytpu_serve_spec_accept_ratio"]) == []
     assert cm.check_registry() == []
 
 
