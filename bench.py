@@ -972,6 +972,13 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
         downs0 = metric("raytpu_serve_autoscale_decisions_total",
                         'direction="down"')
         drains0 = metric("raytpu_serve_replica_drains_total")
+        # Which signal fired each scale-up (decision `reason` tag):
+        # predictive arrival_slope vs reactive queue_age/goodput/ongoing.
+        reasons = ("arrival_slope", "queue_age", "goodput", "ongoing")
+        ups_by_reason0 = {
+            r: metric("raytpu_serve_autoscale_decisions_total",
+                      f'direction="up"[^}}]*reason="{r}"')
+            for r in reasons}
         app = serve.deployment(
             max_ongoing_requests=slots,
             autoscaling_config=dict(
@@ -979,7 +986,11 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
                 target_ongoing_requests=2.0, metrics_interval_s=0.05,
                 look_back_period_s=0.5, upscale_delay_s=0.1,
                 downscale_delay_s=0.3, target_queue_age_s=0.3,
-                target_goodput=0.5),
+                target_goodput=0.5,
+                # Predictive arm: scale on arrival-rate slope before
+                # the queue forms (serve/signals.ArrivalSignal).
+                upscale_slope_threshold=1.0,
+                arrival_half_life_s=0.5, arrival_slope_window_s=2.0),
         )(LLMServer).bind(
             cfg,
             EngineConfig(max_slots=slots,
@@ -1050,6 +1061,16 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
         downs = metric("raytpu_serve_autoscale_decisions_total",
                        'direction="down"') - downs0
         drains = metric("raytpu_serve_replica_drains_total") - drains0
+        # Absent-not-zero: only reasons that actually fired appear, so
+        # the schema can tell "predictive arm never ran" from "ran and
+        # scaled zero times" (bench_schema._check_autoscale_signals).
+        scale_up_reasons = {}
+        for r in reasons:
+            n = int(metric("raytpu_serve_autoscale_decisions_total",
+                           f'direction="up"[^}}]*reason="{r}"')
+                    - ups_by_reason0[r])
+            if n >= 1:
+                scale_up_reasons[r] = n
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
@@ -1064,6 +1085,7 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
         "goodput_ratio": round(
             counts["completed"] / max(1, offered - counts["shed"]), 4),
         "scale_ups": int(ups),
+        "scale_up_reasons": scale_up_reasons,
         "scale_downs": int(downs),
         "drain_retirements": int(drains),
         "kills": kills,

@@ -75,6 +75,12 @@ def init(
                 total.setdefault(k, v)
             labels = labels or None
         _runtime = LocalRuntime(resources=total, labels=labels)
+        # Always-on telemetry history plane: the driver samples its own
+        # registry; worker points arrive via reply piggyback
+        # (runtime.apply_ref_batches → timeseries.ingest).
+        from ray_tpu.util import timeseries
+
+        timeseries.ensure_started()
         atexit.register(shutdown)
         return _runtime
 

@@ -2533,6 +2533,11 @@ class LocalRuntime:
                 from ray_tpu.util import flight_recorder as _frec
 
                 _frec.ingest(worker_key, frec_events)
+            ts_points = rep.pop("timeseries", None)
+            if ts_points:
+                from ray_tpu.util import timeseries as _timeseries
+
+                _timeseries.ingest(worker_key, ts_points)
         if which in ("both", "add"):
             for b in rep.get("ref_add") or ():
                 self.refs.add_borrow(worker_key, ObjectID(b))
@@ -3570,6 +3575,12 @@ class LocalRuntime:
         from ray_tpu.util import metrics as _metrics
 
         _metrics.clear_remote()
+        # Same for the telemetry history plane: stop the driver's
+        # sampler and drop every ring (local + federated) so the next
+        # runtime in this process starts from an empty plane.
+        from ray_tpu.util import timeseries as _timeseries
+
+        _timeseries.shutdown()
         if self._log_monitor is not None:
             # AFTER the pool: stop()'s final sweep then sees everything
             # the dying workers flushed.

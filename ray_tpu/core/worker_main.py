@@ -712,6 +712,15 @@ class _WorkerServer:
                 evs = frec.ship()
                 if evs:
                     rep["flightrec"] = evs
+            # Time-series points ship cursor-style too (util/timeseries
+            # drains its outbox, so every point crosses exactly once);
+            # the worker's 1 Hz sampler bounds the payload to roughly
+            # one tick's points per reply.
+            tser = sys.modules.get("ray_tpu.util.timeseries")
+            if tser is not None:
+                pts = tser.ship()
+                if pts:
+                    rep["timeseries"] = pts
             return rep
         finally:
             with self._busy_lock:
@@ -1028,6 +1037,15 @@ class _WorkerServer:
         from ray_tpu.core import api
 
         api._runtime = self._wr
+        # Always-on telemetry history: sample this process's metric
+        # registry into bounded rings; points ride task replies home
+        # (see _run_op's timeseries ship).
+        try:
+            from ray_tpu.util import timeseries
+
+            timeseries.ensure_started()
+        except Exception:
+            pass
         threading.Thread(target=self._direct_accept_loop,
                          args=(cluster_token,), daemon=True,
                          name="direct-accept").start()

@@ -27,6 +27,12 @@ metrics):
                                  device/control-plane components that
                                  sum to its e2e wall
                                  (serve/latency_attribution)
+  GET /api/v0/timeseries         cluster metric history from the
+                                 telemetry history plane
+                                 (util/timeseries; ?family=&since=
+                                 &step=&proc= — family is a name
+                                 prefix, step picks the 1/10/60 s
+                                 ring); backs `raytpu top`
   GET /api/v0/tasks/summarize
   GET /api/v0/actors/detail      ?id= one actor + its task attempts
                                  (parity: the React client's actor
@@ -116,6 +122,16 @@ class _Handler(BaseHTTPRequestHandler):
                                404)
                 else:
                     self._json({"result": wf})
+            elif url.path == "/api/v0/timeseries":
+                # Also pre-gate: the history plane samples whatever
+                # registry this process has, runtime or not.
+                since = (qs.get("since") or [None])[0]
+                self._json({"result": _state.query_timeseries(
+                    family=(qs.get("family") or [None])[0] or None,
+                    since=float(since) if since else None,
+                    step=float((qs.get("step") or ["1"])[0]),
+                    proc=(qs.get("proc") or [None])[0] or None,
+                )})
             elif not api.is_initialized():
                 self._json({"error": "runtime not initialized"}, 503)
             elif url.path == "/api/cluster_status":

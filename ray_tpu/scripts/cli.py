@@ -244,6 +244,78 @@ def cmd_flightrec(args, out) -> int:
     return 0
 
 
+_TOP_COLUMNS = ["proc", "req/s", "tok/s", "goodput", "qage_s",
+                "kv_free", "kv_cached", "adapters", "spec_acc"]
+
+
+def format_top(payload: Dict[str, Any]) -> str:
+    """Render one `raytpu top` frame from a /api/v0/timeseries payload
+    (family=raytpu_serve_): one row per process — request and token
+    rates are window means, the rest the latest sampled value.  Pure
+    (no clock, no I/O) so the tests can pin the output."""
+    rows_by_proc: Dict[str, Dict[str, Any]] = {}
+    for s in payload.get("series", []):
+        if not s.get("points"):
+            continue
+        row = rows_by_proc.setdefault(s["proc"], {"proc": s["proc"]})
+        fam, last = s["family"], s["points"][-1]
+        if fam == "raytpu_serve_requests_arrived_total":
+            rates = [p["rate"] for p in s["points"]]
+            row["req/s"] = f"{sum(rates) / len(rates):.2f}"
+        elif fam == "raytpu_serve_step_tokens_total":
+            rates = [p["rate"] for p in s["points"]]
+            prev = float(row.get("tok/s") or 0.0)
+            row["tok/s"] = f"{prev + sum(rates) / len(rates):.1f}"
+        elif fam == "raytpu_serve_goodput_ratio":
+            row["goodput"] = f"{last['value']:.3f}"
+        elif fam == "raytpu_serve_admission_queue_age_seconds":
+            row["qage_s"] = f"{last['value']:.3f}"
+        elif fam == "raytpu_serve_kv_pages_free":
+            row["kv_free"] = f"{last['value']:g}"
+        elif fam == "raytpu_serve_kv_pages_cached":
+            row["kv_cached"] = f"{last['value']:g}"
+        elif fam == "raytpu_serve_adapter_pool_resident":
+            row["adapters"] = f"{last['value']:g}"
+        elif fam == "raytpu_serve_spec_accept_ratio":
+            row["spec_acc"] = f"{last['value']:.3f}"
+    import io
+
+    buf = io.StringIO()
+    rows = [rows_by_proc[p] for p in sorted(rows_by_proc)]
+    if not rows:
+        return "(no serving series in the window)"
+    for r in rows:
+        for c in _TOP_COLUMNS:
+            r.setdefault(c, "-")
+    _print_table(rows, _TOP_COLUMNS, buf)
+    return buf.getvalue().rstrip("\n")
+
+
+def cmd_top(args, out) -> int:
+    """`raytpu top`: live refreshing fleet view over the telemetry
+    history plane (GET /api/v0/timeseries) — per-process request and
+    token rates, goodput, queue age, KV/adapter pool occupancy and
+    speculative accept ratio.  `--once` prints a single frame."""
+    import time as _time
+
+    def fetch():
+        path = (f"/api/v0/timeseries?family=raytpu_serve_&step=1"
+                f"&since={_time.time() - args.window:.3f}")
+        return _get_json(_address(args), path)["result"]
+
+    if args.once:
+        print(format_top(fetch()), file=out)
+        return 0
+    try:
+        while True:
+            # ANSI clear + home: a refreshing pane, not a scroll.
+            print("\x1b[2J\x1b[H" + format_top(fetch()),
+                  file=out, flush=True)
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_memory(args, out) -> int:
     rows = _get_json(_address(args),
                      f"/api/v0/objects?limit={args.limit}")["result"]
@@ -387,6 +459,8 @@ def build_parser() -> argparse.ArgumentParser:
                "profile (on-demand jax.profiler capture on every "
                "worker), trace (one request's latency waterfall), "
                "flightrec (dump a flight-recorder bundle), "
+               "top (live fleet view from the telemetry history "
+               "plane; --once for a single frame), "
                "memory, job, serve, start",
     )
     p.add_argument("--address", default=None,
@@ -448,6 +522,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bundle directory (default: the head's "
                          "configured dir / $RAYTPU_FLIGHTREC_DIR)")
 
+    tpp = sub.add_parser(
+        "top",
+        help="live fleet view: per-process req/s, tok/s, goodput, "
+             "queue age, KV/adapter occupancy, spec-accept "
+             "(GET /api/v0/timeseries)")
+    tpp.add_argument("--once", action="store_true", default=False,
+                     help="print one snapshot and exit")
+    tpp.add_argument("--interval", type=float, default=2.0,
+                     help="refresh period in seconds")
+    tpp.add_argument("--window", type=float, default=10.0,
+                     help="trailing window the rate columns average")
+
     mp = sub.add_parser("memory", help="object store contents")
     mp.add_argument("--limit", type=int, default=1000)
 
@@ -507,6 +593,7 @@ _DISPATCH = {
     "profile": cmd_profile,
     "trace": cmd_trace,
     "flightrec": cmd_flightrec,
+    "top": cmd_top,
     "memory": cmd_memory,
     "job": cmd_job,
     "serve": cmd_serve,

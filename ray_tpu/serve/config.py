@@ -40,6 +40,21 @@ class AutoscalingConfig:
     # is already missing its objectives.
     target_queue_age_s: Optional[float] = None
     target_goodput: Optional[float] = None
+    # Predictive scale-up (None = reactive policy only, byte-for-byte
+    # unchanged).  Replicas push their engine's cumulative arrival
+    # count next to ongoing/queue-age/goodput; the controller keeps an
+    # EWMA arrival rate per deployment (serve/signals.ArrivalSignal)
+    # and, when the rate's least-squares slope exceeds this many
+    # requests/s per second, forces one step up (decision reason
+    # "arrival_slope") BEFORE any queue forms — arrival rate leads
+    # queue age, which leads latency, so reacting to the slope buys a
+    # replica's startup time ahead of SLO pressure.  Veto rules and
+    # the DRAINING-only scale-down path are untouched.
+    upscale_slope_threshold: Optional[float] = None
+    # Arrival-signal shape: EWMA half-life and the trailing window the
+    # slope is fit over.
+    arrival_half_life_s: float = 2.0
+    arrival_slope_window_s: float = 5.0
 
     def __post_init__(self):
         if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
@@ -55,6 +70,13 @@ class AutoscalingConfig:
         if (self.target_goodput is not None
                 and not 0.0 < self.target_goodput <= 1.0):
             raise ValueError("target_goodput must be in (0, 1]")
+        if (self.upscale_slope_threshold is not None
+                and self.upscale_slope_threshold <= 0):
+            raise ValueError("upscale_slope_threshold must be positive")
+        if self.arrival_half_life_s <= 0:
+            raise ValueError("arrival_half_life_s must be positive")
+        if self.arrival_slope_window_s <= 0:
+            raise ValueError("arrival_slope_window_s must be positive")
 
 
 @dataclasses.dataclass(frozen=True)

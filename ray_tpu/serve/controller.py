@@ -63,10 +63,13 @@ def _telemetry():
             ),
             "autoscale_decisions": metrics.Counter(
                 "raytpu_serve_autoscale_decisions_total",
-                "Applied autoscaling decisions, by deployment and "
+                "Applied autoscaling decisions, by deployment, "
                 "direction (up = capacity added; down = retirement "
-                "through the DRAINING path).",
-                tag_keys=("deployment", "direction"),
+                "through the DRAINING path) and reason (ongoing / "
+                "queue_age / goodput / arrival_slope — the last is the "
+                "predictive path: scaled on arrival-rate slope before "
+                "any queue formed).",
+                tag_keys=("deployment", "direction", "reason"),
             ),
             "autoscale_target": metrics.Gauge(
                 "raytpu_serve_autoscale_target_groups",
@@ -152,6 +155,15 @@ class _DeploymentState:
         # autoscaling bookkeeping: id -> (ts, ongoing, queue_age, goodput)
         self.metrics: Dict[str, Tuple[float, float, float,
                                       Optional[float]]] = {}
+        # Arrival-rate signal (predictive scale-up): per-replica
+        # cumulative arrival counts fold reset-tolerantly into one
+        # deployment-wide total that feeds an EWMA rate + slope
+        # (serve/signals.ArrivalSignal).  Lazy: only built when the
+        # config enables upscale_slope_threshold, so the reactive-only
+        # path stays byte-for-byte what it was.
+        self._arrival_prev: Dict[str, float] = {}
+        self._arrival_total = 0.0
+        self._arrival_signal = None
         self._scale_intent: Optional[Tuple[int, float]] = None
         # Last APPLIED scale decision ({direction, from, to, reason,
         # ts}) — surfaced on list_replicas rows for `raytpu list
@@ -192,20 +204,51 @@ class _DeploymentState:
 
     # -- autoscaling -------------------------------------------------------
 
+    def _signal(self):
+        cfg = self.config.autoscaling_config
+        if cfg is None or cfg.upscale_slope_threshold is None:
+            return None
+        if self._arrival_signal is None:
+            from ray_tpu.serve.signals import ArrivalSignal
+
+            self._arrival_signal = ArrivalSignal(
+                half_life_s=cfg.arrival_half_life_s,
+                window_s=cfg.arrival_slope_window_s)
+        return self._arrival_signal
+
     def record_metric(self, replica_id: str, ongoing: float, ts: float,
                       queue_age: float = 0.0,
-                      goodput: Optional[float] = None):
+                      goodput: Optional[float] = None,
+                      arrivals: Optional[float] = None):
         self.metrics[replica_id] = (ts, ongoing, queue_age, goodput)
+        if arrivals is None:
+            return
+        # Fold the replica's cumulative arrival count into the
+        # deployment total: first push baselines (a fresh replica's
+        # history is unknown), a count that went backwards means the
+        # replica restarted (the new count IS the delta).
+        prev = self._arrival_prev.get(replica_id)
+        self._arrival_prev[replica_id] = arrivals
+        if prev is None:
+            delta = 0.0
+        else:
+            delta = arrivals if arrivals < prev else arrivals - prev
+        self._arrival_total += delta
+        sig = self._signal()
+        if sig is not None:
+            sig.observe(ts, self._arrival_total)
 
     def autoscale(self, now: float) -> Optional[Dict[str, Any]]:
-        """One reconciliation pass of the scaling policy.  Three
+        """One reconciliation pass of the scaling policy.  Four
         signals, pushed by the replicas: the averaged ongoing-request
         count (the sizing signal — desired = ceil(total/target)), the
         worst admission-queue age (leading SLO pressure: it climbs
-        before any latency bound blows), and the worst goodput ratio
+        before any latency bound blows), the worst goodput ratio
         (trailing guard: a fleet already missing its objectives must
-        not shrink).  SLO pressure forces at least one step up from
-        the current target and vetoes any scale-down this pass.
+        not shrink), and — when upscale_slope_threshold is set — the
+        arrival-rate slope (predictive: it moves before any queue even
+        forms).  Pressure from any of them forces at least one step up
+        from the current target and vetoes any scale-down this pass.
         Returns the applied decision dict, or None."""
         cfg = self.config.autoscaling_config
         if cfg is None or self.deleting:
@@ -236,6 +279,16 @@ class _DeploymentState:
               and worst_goodput is not None
               and worst_goodput < cfg.target_goodput):
             pressure, reason = True, "goodput"
+        elif (cfg.upscale_slope_threshold is not None
+              and self._arrival_signal is not None
+              and self._arrival_signal.slope()
+              > cfg.upscale_slope_threshold):
+            # Predictive scale-up: the arrival RATE is still climbing,
+            # so today's fleet will be undersized by the time a queue
+            # forms — step up now, while queue age and goodput are
+            # still clean.  Reactive reasons keep precedence: once a
+            # queue exists it is the more honest signal.
+            pressure, reason = True, "arrival_slope"
         current = self.target_replicas
         if pressure:
             desired = max(desired, current + 1)
@@ -348,12 +401,14 @@ class ServeController:
     def record_autoscaling_metric(self, app_name: str, deployment_name: str,
                                   replica_id: str, ongoing: float,
                                   ts: float, queue_age: float = 0.0,
-                                  goodput: Optional[float] = None) -> None:
+                                  goodput: Optional[float] = None,
+                                  arrivals: Optional[float] = None) -> None:
         with self._lock:
             st = self._deployments.get((app_name, deployment_name))
             if st is None:
                 return
-            st.record_metric(replica_id, ongoing, ts, queue_age, goodput)
+            st.record_metric(replica_id, ongoing, ts, queue_age, goodput,
+                             arrivals)
             # Live-load routing: broadcast rows carry each replica's
             # last-pushed ongoing count, so rebroadcast when the count
             # moved a whole request away from the broadcast one —
@@ -599,7 +654,9 @@ class ServeController:
                 if decision is not None:
                     self._tm["autoscale_decisions"].inc(
                         tags={"deployment": st.info.name,
-                              "direction": decision["direction"]})
+                              "direction": decision["direction"],
+                              "reason": decision.get("reason",
+                                                     "ongoing")})
                 if (st.config.autoscaling_config is not None
                         and not st.deleting):
                     self._tm["autoscale_target"].set(

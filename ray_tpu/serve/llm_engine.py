@@ -140,6 +140,14 @@ def _telemetry():
                 "(FINISHED / FAILED / CANCELLED / SHED).",
                 tag_keys=("state",),
             ),
+            "arrived": metrics.Counter(
+                "raytpu_serve_requests_arrived_total",
+                "Requests submitted to this engine (admitted, shed or "
+                "rejected alike) — the raw arrival process.  Its rate "
+                "and slope are the LEADING load signal: they move "
+                "before the queue forms, which is what predictive "
+                "autoscaling (reason arrival_slope) keys on.",
+            ),
             "shed": metrics.Counter(
                 "raytpu_serve_shed_total",
                 "Requests refused at admission because the queue was "
@@ -1044,11 +1052,13 @@ class LLMServer:
         """SLO-pressure signals for the autoscaling policy, polled by
         the hosting ReplicaActor's metrics push loop next to
         num_ongoing_requests: the engine's admission-queue age (the
-        leading overload signal) and cumulative goodput ratio (the
-        trailing guard; None until a request reaches a terminal
-        state)."""
+        leading overload signal), cumulative goodput ratio (the
+        trailing guard; None until a request reaches a terminal state)
+        and cumulative arrival count (the predictive signal — its
+        slope moves before any queue forms)."""
         return {"queue_age_s": self.engine.admission_queue_age(),
-                "goodput": self.engine.goodput_ratio()}
+                "goodput": self.engine.goodput_ratio(),
+                "arrivals": self.engine.arrivals_total()}
 
     def prefix_summary(self) -> Optional[Dict[str, Any]]:
         """Prefix-cache routing summary (None when the cache is off).
@@ -1195,6 +1205,10 @@ class LLMEngine:
         self._unprocessed = 0  # dispatched entries not yet emitted
         self._inflight_tokens: Dict[int, int] = {}  # slot → undelivered
         self._req_counter = itertools.count()
+        # Cumulative arrival count (every submit, shed included) —
+        # mirrored by the arrived counter; kept as a plain int so
+        # pressure() reads it without touching the registry.
+        self._arrived = 0
         self._stopped = threading.Event()
         # Preemption-aware drain (see drain()): _draining stops
         # admission, _drain_evict tells the loop to preempt whatever is
@@ -1663,6 +1677,10 @@ class LLMEngine:
                               "temperature": float(temperature),
                               "request_id": request_id or "",
                               "adapter_id": adapter_id})
+        # Count the arrival before any admission decision: the signal
+        # must see offered load, not just what survived shedding.
+        self._arrived += 1
+        self._tm["arrived"].inc()
         shed_after = self.config.shed_queue_age_s
         if shed_after is not None:
             age = self._admission_queue_age()
@@ -1850,6 +1868,11 @@ class LLMEngine:
         if not self._terminal_tokens:
             return None
         return self._good_tokens / self._terminal_tokens
+
+    def arrivals_total(self) -> int:
+        """Cumulative requests submitted (shed included) — the
+        arrival process the predictive autoscaler takes a slope of."""
+        return self._arrived
 
     def prefix_summary(self, max_entries: int = 256) -> Optional[dict]:
         """Compact routing summary of the prefix cache ({"page": …,

@@ -443,18 +443,19 @@ class ReplicaActor:
         while not self._metrics_stop.wait(interval_s):
             try:
                 controller = api.get_actor(CONTROLLER_NAME)
-                qage, goodput = 0.0, None
+                qage, goodput, arrivals = 0.0, None, None
                 if self._pressure_fn is not None:
                     try:
                         p = self._pressure_fn()
                         qage = float(p.get("queue_age_s") or 0.0)
                         goodput = p.get("goodput")
+                        arrivals = p.get("arrivals")
                     except Exception:
                         pass
                 controller.record_autoscaling_metric.remote(
                     self.app_name, self.deployment_name, self.replica_id,
                     self.num_ongoing_requests(), time.monotonic(),
-                    qage, goodput,
+                    qage, goodput, arrivals,
                 )
                 if self._pushes_summary:
                     try:

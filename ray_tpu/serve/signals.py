@@ -1,0 +1,132 @@
+"""Derived operational signals over the telemetry history plane.
+
+The time-series store (util/timeseries) retains raw series; this module
+turns them into the signals the control plane and operators act on:
+
+  * ``ArrivalSignal`` — an EWMA arrival rate plus its least-squares
+    slope, fed with cumulative arrival counts.  The controller's
+    autoscaler consumes the slope to scale up while the queue is still
+    empty (decision reason ``"arrival_slope"``): arrival rate LEADS
+    queue age, which leads latency — reacting to the leading signal
+    buys a replica's startup time before the SLO is at risk.
+  * ``derived_signals`` — per-process SLO burn rate, shed rate and
+    request rate computed from the driver-side store, for the dashboard
+    and ``raytpu top``.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Any, Dict, Optional
+
+
+class ArrivalSignal:
+    """EWMA arrival rate + slope from a cumulative arrival count.
+
+    ``observe(ts, cumulative)`` feeds one observation (timestamps from
+    any monotone clock; cumulative counts are reset-tolerant — a total
+    that went backwards is treated as a restart, the new total being
+    the count since reset).  ``rate()`` is the current EWMA in
+    arrivals/s; ``slope()`` the least-squares slope of the EWMA over
+    the trailing window, in arrivals/s per second."""
+
+    def __init__(self, half_life_s: float = 2.0,
+                 window_s: float = 5.0):
+        if half_life_s <= 0:
+            raise ValueError("half_life_s must be positive")
+        if window_s <= 0:
+            raise ValueError("window_s must be positive")
+        self.half_life_s = float(half_life_s)
+        self.window_s = float(window_s)
+        self._last: Optional[tuple] = None  # (ts, cumulative)
+        self._ewma = 0.0
+        self._points: "collections.deque" = collections.deque()
+
+    def observe(self, ts: float, cumulative: float) -> None:
+        last = self._last
+        self._last = (ts, cumulative)
+        if last is None:
+            return
+        dt = ts - last[0]
+        if dt <= 0:
+            return
+        delta = (cumulative if cumulative < last[1]
+                 else cumulative - last[1])
+        inst = delta / dt
+        # Half-life-parameterised smoothing: after half_life_s of
+        # observations the old rate contributes 50%.
+        alpha = 1.0 - math.pow(0.5, dt / self.half_life_s)
+        self._ewma += alpha * (inst - self._ewma)
+        self._points.append((ts, self._ewma))
+        horizon = ts - self.window_s
+        while self._points and self._points[0][0] < horizon:
+            self._points.popleft()
+
+    def rate(self) -> float:
+        return self._ewma
+
+    def slope(self) -> float:
+        pts = self._points
+        n = len(pts)
+        if n < 3:
+            return 0.0  # not enough evidence to call a trend
+        t0 = pts[0][0]
+        sx = sy = sxx = sxy = 0.0
+        for t, r in pts:
+            x = t - t0
+            sx += x
+            sy += r
+            sxx += x * x
+            sxy += x * r
+        denom = n * sxx - sx * sx
+        if denom <= 0:
+            return 0.0
+        return (n * sxy - sx * sy) / denom
+
+
+def _window_rate(series: list, window_s: float) -> float:
+    """Summed counter deltas over the window / window seconds."""
+    total = sum(p.get("delta", 0.0) for s in series for p in s["points"])
+    return total / window_s if window_s > 0 else 0.0
+
+
+def derived_signals(window_s: float = 60.0) -> Dict[str, Dict[str, Any]]:
+    """Per-process operational signals from the driver-side store:
+
+    ``{proc: {"request_rate", "shed_rate", "slo_burn_rate"}}``
+
+    where slo_burn_rate is the fraction of terminal requests in the
+    window that missed their SLO (0.0 when none terminated) and the
+    rates are requests/second over the window."""
+    import time
+
+    from ray_tpu.util import timeseries
+
+    since = time.time() - float(window_s)
+    payload = timeseries.query(family="raytpu_serve_", since=since,
+                               step=timeseries._rings[0][0])
+    by_proc: Dict[str, Dict[str, list]] = {}
+    for s in payload["series"]:
+        by_proc.setdefault(s["proc"], {}).setdefault(
+            s["family"], []).append(s)
+    out: Dict[str, Dict[str, Any]] = {}
+    for proc, fams in sorted(by_proc.items()):
+        arrived = _window_rate(
+            fams.get("raytpu_serve_requests_arrived_total", []), window_s)
+        shed = _window_rate(fams.get("raytpu_serve_shed_total", []),
+                            window_s)
+        met = missed = 0.0
+        for s in fams.get("raytpu_serve_request_slo_total", []):
+            total = sum(p.get("delta", 0.0) for p in s["points"])
+            if s["tags"].get("outcome") == "met":
+                met += total
+            else:
+                missed += total
+        terminal = met + missed
+        out[proc] = {
+            "request_rate": arrived,
+            "shed_rate": shed,
+            "slo_burn_rate": (missed / terminal) if terminal else 0.0,
+        }
+    return out

@@ -12,9 +12,11 @@ and an autoscale veto.  A trigger stamps a ``trigger`` event into the
 ring, bumps ``raytpu_flightrec_triggers_total{reason=...}``, samples the
 counter deltas since the last sample, and — when a dump directory is
 configured (``configure(dump_dir=...)`` or ``RAYTPU_FLIGHTREC_DIR``) —
-writes a bundle directory containing every process's recent events plus
-a full Prometheus scrape, rate-limited so a storm produces one bundle,
-not one per request.
+writes a bundle directory containing every process's recent events, a
+full Prometheus scrape and a trailing time-series window
+(``history.json``, from util/timeseries — what load was doing in the
+minutes before the incident), rate-limited so a storm produces one
+bundle, not one per request.
 
 Cross-process: worker processes ship their ring incrementally on task
 replies (``core/worker_main._run_op`` → ``rep["flightrec"]`` →
@@ -88,7 +90,14 @@ def configure(window_s: Optional[float] = None,
               dump_dir: Optional[str] = None,
               auto_dump: Optional[bool] = None,
               min_dump_interval_s: Optional[float] = None) -> None:
-    """Adjust the recorder.  All arguments optional; None = keep."""
+    """Adjust the recorder.  All arguments optional; None = keep.
+
+    Idempotently re-trims on every call: remote rings are rebuilt to
+    the (possibly new) capacity — they capture ``_events.maxlen`` at
+    creation, so a mid-session reconfigure would otherwise leave them
+    on the old bound forever — and events older than the current
+    window are physically dropped from every ring, so a shrunk window
+    takes effect immediately rather than only at snapshot time."""
     global _window_s, _events, _dump_dir, _auto_dump, _min_dump_interval_s
     with _lock:
         if window_s is not None:
@@ -101,6 +110,14 @@ def configure(window_s: Optional[float] = None,
             _auto_dump = bool(auto_dump)
         if min_dump_interval_s is not None:
             _min_dump_interval_s = float(min_dump_interval_s)
+        horizon = time.time() - _window_s
+        _events = collections.deque(
+            (e for e in _events if e["ts"] >= horizon),
+            maxlen=_events.maxlen)
+        for proc in list(_remote):
+            _remote[proc] = collections.deque(
+                (e for e in _remote[proc] if e["ts"] >= horizon),
+                maxlen=_events.maxlen)
 
 
 def clear() -> None:
@@ -144,7 +161,7 @@ def _sample_counter_deltas_locked(now: float) -> None:
     for fam, typ, _help, samples in fams:
         if typ != "counter" or fam.startswith("raytpu_flightrec_"):
             continue
-        total = sum(v for _n, _t, v in samples)
+        total = sum(s[2] for s in samples)
         prev = _counter_baseline.get(fam)
         _counter_baseline[fam] = total
         if prev is None or total == prev:
@@ -266,9 +283,22 @@ def dump(reason: str = "manual",
             f.write(metrics.export_prometheus())
     except Exception:
         pass
+    # Trailing time-series window from every process (util/timeseries):
+    # the "what was load doing before this" half of the bundle that
+    # point-in-time events + one scrape cannot answer.
+    history_procs: List[str] = []
+    try:
+        from ray_tpu.util import timeseries
+        hist = timeseries.history(window_s=max(_window_s, 120.0))
+        history_procs = sorted({s["proc"] for s in hist["series"]})
+        with open(os.path.join(path, "history.json"), "w") as f:
+            json.dump(hist, f, indent=1)
+    except Exception:
+        pass
     with open(os.path.join(path, "manifest.json"), "w") as f:
         json.dump({"reason": reason, "created_at": time.time(),
                    "procs": sorted(events),
+                   "history_procs": history_procs,
                    "n_events": sum(len(v) for v in events.values())},
                   f, indent=1)
     try:

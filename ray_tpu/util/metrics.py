@@ -66,7 +66,13 @@ class Metric:
         self._default_tags = dict(tags)
         return self
 
-    def _samples(self) -> List[Tuple[str, _TagTuple, float]]:
+    def _samples(self) -> List[Tuple[str, _TagTuple, float, str]]:
+        """Sample rows ``(sample_name, tags, value, kind)``.  ``kind``
+        is the declared family type (counter|gauge|histogram) carried
+        on every row so consumers (the time-series sampler, the
+        flight-recorder delta pass, scripts/check_metrics.py) never
+        have to re-infer it from ``_bucket``/``_sum``/``_count`` name
+        suffixes."""
         raise NotImplementedError
 
 
@@ -90,7 +96,8 @@ class Counter(Metric):
 
     def _samples(self):
         with self._lock:
-            return [(self.name, k, v) for k, v in self._values.items()]
+            return [(self.name, k, v, "counter")
+                    for k, v in self._values.items()]
 
 
 class Gauge(Metric):
@@ -111,7 +118,8 @@ class Gauge(Metric):
 
     def _samples(self):
         with self._lock:
-            return [(self.name, k, v) for k, v in self._values.items()]
+            return [(self.name, k, v, "gauge")
+                    for k, v in self._values.items()]
 
 
 class Histogram(Metric):
@@ -152,12 +160,16 @@ class Histogram(Metric):
                 for b, c in zip(self.boundaries, counts):
                     cum += c
                     out.append((f"{self.name}_bucket",
-                                key + (("le", repr(float(b))),), float(cum)))
+                                key + (("le", repr(float(b))),), float(cum),
+                                "histogram"))
                 cum += counts[-1]
                 out.append((f"{self.name}_bucket",
-                            key + (("le", "+Inf"),), float(cum)))
-                out.append((f"{self.name}_count", key, float(cum)))
-                out.append((f"{self.name}_sum", key, self._sums[key]))
+                            key + (("le", "+Inf"),), float(cum),
+                            "histogram"))
+                out.append((f"{self.name}_count", key, float(cum),
+                            "histogram"))
+                out.append((f"{self.name}_sum", key, self._sums[key],
+                            "histogram"))
         return out
 
 
@@ -226,8 +238,13 @@ _remote_snapshots: Dict[str, list] = {}
 
 def snapshot_samples() -> list:
     """Absolute sample state of every registered metric:
-    [(family, type, help, [(sample_name, tag_tuple, value), ...]), ...].
-    The worker-side half of the cross-process merge."""
+    [(family, type, help,
+      [(sample_name, tag_tuple, value, kind), ...]), ...].
+    The worker-side half of the cross-process merge.  ``kind`` repeats
+    the family type on every sample row so per-sample consumers need no
+    suffix inference (snapshots from older processes may still carry
+    3-tuples; index access, never unpacking, keeps the merge
+    tolerant)."""
     return [(m.name, m._type, m.description, list(m._samples()))
             for m in _default_registry.collect()]
 
@@ -343,7 +360,7 @@ def export_prometheus(include_internal: bool = True) -> str:
         declared.add(m.name)
         lines.append(f"# HELP {m.name} {m.description}")
         lines.append(f"# TYPE {m.name} {m._type}")
-        for name, tags, value in m._samples():
+        for name, tags, value, _kind in m._samples():
             lines.append(f"{name}{_fmt_tags(tags)} {value}")
     with _remote_lock:
         remote = sorted(_remote_snapshots.items())
@@ -353,11 +370,13 @@ def export_prometheus(include_internal: bool = True) -> str:
                 declared.add(fam)
                 lines.append(f"# HELP {fam} {help_}")
                 lines.append(f"# TYPE {fam} {typ}")
-            for sname, tags, value in samples:
+            for s in samples:
                 # proc distinguishes the same series observed by
                 # different worker processes (federation's instance
-                # label, collapsed into the one driver scrape).
-                tags = tuple(map(tuple, tags)) + (("proc", proc),)
+                # label, collapsed into the one driver scrape).  Index
+                # access: snapshots may be 3- or 4-tuple vintage.
+                sname, value = s[0], s[2]
+                tags = tuple(map(tuple, s[1])) + (("proc", proc),)
                 lines.append(f"{sname}{_fmt_tags(tags)} {value}")
     if include_internal:
         seen_help = set()

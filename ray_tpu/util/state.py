@@ -109,6 +109,22 @@ def request_waterfall(request_id: str) -> Optional[Dict[str, Any]]:
     return latency_attribution.waterfall(request_id)
 
 
+def query_timeseries(family: Optional[str] = None,
+                     since: Optional[float] = None, step: float = 1.0,
+                     proc: Optional[str] = None) -> Dict[str, Any]:
+    """Cluster time-series history (util/timeseries): every process's
+    metric rings, driver-side aggregated — local series under proc
+    ``"driver"``, worker series under their pool key.  ``family`` is a
+    name prefix filter, ``step`` picks the ring resolution (1/10/60 s
+    by default).  Works without an initialized runtime, same contract
+    as ``list_requests``: a directly-driven engine's sampled history
+    still answers."""
+    from ray_tpu.util import timeseries
+
+    return timeseries.query(family=family, since=since, step=step,
+                            proc=proc)
+
+
 def list_replicas(filters: Optional[List[Filter]] = None, *,
                   limit: int = 100,
                   detail: bool = False) -> List[Dict[str, Any]]:

@@ -65,7 +65,9 @@ Checked:
   * the autoscaling chaos leg (extra.serving_chaos): goodput_ratio
     and shed_fraction are fractions in [0, 1], the run shows >= 1
     scale-up, >= 1 drain-based scale-down and >= 1 replica kill, and
-    completed + shed <= offered;
+    completed + shed <= offered; the scale_up_reasons breakdown (which
+    signal fired each up decision) uses known reasons only, counts
+    >= 1, absent-not-zero, summing to scale_ups;
   * the full-8B train rung (extra.llama_8b.train): must be MEASURED
     (measured=true, numeric mfu/toks in (0, 1]/(0, inf)), carry
     zero_sharding=true + dp_shards, and satisfy the memory claim
@@ -702,6 +704,37 @@ CHAOS_REQUIRED = ("mix", "offered", "completed", "shed",
                   "scale_downs", "kills")
 
 
+# Every scale-up decision carries exactly one reason tag: predictive
+# arrival_slope, reactive queue_age/goodput pressure, or the plain
+# averaged-ongoing policy.
+AUTOSCALE_REASONS = ("arrival_slope", "queue_age", "goodput", "ongoing")
+
+
+def _check_autoscale_signals(name: str, d: Any,
+                             problems: List[str]) -> None:
+    """The chaos leg's scale-up reason breakdown (scale_up_reasons):
+    which autoscaling signal fired each up decision.  Absent-not-zero:
+    a reason that never fired must be omitted, not reported as 0 — so
+    readers can tell "predictive arm never ran" (key absent in an old
+    record) from "ran and decided nothing" (key absent in a new one)
+    without a sentinel.  Keys come from AUTOSCALE_REASONS, values are
+    counts >= 1, and the breakdown must sum to scale_ups when both are
+    present (every up decision has exactly one reason)."""
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    for reason, n in d.items():
+        if reason not in AUTOSCALE_REASONS:
+            problems.append(
+                f"{name}: unknown reason {reason!r} (known: "
+                f"{', '.join(AUTOSCALE_REASONS)})")
+        if not (isinstance(n, int) and not isinstance(n, bool)
+                and n >= 1):
+            problems.append(
+                f"{name}: {reason}={n!r} must be an int >= 1 — "
+                f"reasons that never fired are omitted, not zero")
+
+
 def _check_chaos(name: str, d: Any, problems: List[str]) -> None:
     """The autoscaling chaos leg (extra.serving_chaos): ramped+bursty
     zipf_chat arrival against an autoscaled deployment with the
@@ -748,6 +781,17 @@ def _check_chaos(name: str, d: Any, problems: List[str]) -> None:
         problems.append(
             f"{name}: completed={d['completed']} + shed={d['shed']} "
             f"exceeds offered={d['offered']}")
+    if "scale_up_reasons" in d:
+        sub = d["scale_up_reasons"]
+        _check_autoscale_signals(f"{name}.scale_up_reasons", sub,
+                                 problems)
+        if (isinstance(sub, dict) and _num(d.get("scale_ups"))
+                and all(isinstance(v, int) for v in sub.values())
+                and sum(sub.values()) != d["scale_ups"]):
+            problems.append(
+                f"{name}.scale_up_reasons: breakdown sums to "
+                f"{sum(sub.values())} but scale_ups={d['scale_ups']} — "
+                f"every up decision carries exactly one reason")
 
 
 def _check_mixed(name: str, d: Any, problems: List[str]) -> None:

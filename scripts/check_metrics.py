@@ -95,8 +95,13 @@ def check_exposition(text: str,
             problems.append(
                 f"line {lineno}: sample name {sname!r} violates the "
                 f"Prometheus name grammar")
+        # Suffix forms attach only to families DECLARED histogram —
+        # matching on declared type (not name shape) means a counter
+        # that happens to end in _count can never be mistaken for
+        # another family's histogram sample.
         fam = next((f for f in families
-                    if sname == f or (sname.startswith(f + "_")
+                    if sname == f or (families[f] == "histogram"
+                                      and sname.startswith(f + "_")
                                       and sname[len(f):] in
                                       ("_bucket", "_sum", "_count"))),
                    None)

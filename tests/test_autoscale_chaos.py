@@ -24,6 +24,15 @@ against real replica actors:
   ring, the shed counter moves, and the admitted streams still finish
   byte-exact: shedding protects goodput, it doesn't dent it.
 
+- Predictive scale-up (ISSUE 18): with upscale_slope_threshold set and
+  the reactive targets parked out of reach, a ramped arrival pattern
+  must drive a scale-up whose decision reason is "arrival_slope" —
+  the EWMA arrival-rate slope (serve/signals.ArrivalSignal) firing
+  BEFORE any queue forms — while zero queue-age/goodput pressure
+  decisions land, goodput holds, and the streams stay byte-exact.
+  With the knob unset (every other scenario here) the reactive path
+  must never emit an arrival_slope decision.
+
 Deterministic where it matters: greedy (temperature=0) decoding,
 seeded victim choice, bounded waits everywhere.
 """
@@ -236,6 +245,23 @@ def chaos_app(params):
 
 
 @pytest.fixture
+def pred_app(params):
+    """Predictive arm isolated: the reactive targets are parked far out
+    of reach (queue age 30 s, goodput 0.05, ongoing 100) so the ONLY
+    signal that can force a scale-up during the ramp is the arrival
+    slope."""
+    handle = _serve_autoscaled(
+        params, "pred", max_replicas=2,
+        target_ongoing_requests=100.0,
+        target_queue_age_s=30.0, target_goodput=0.05,
+        upscale_slope_threshold=0.5,
+        arrival_half_life_s=0.5, arrival_slope_window_s=3.0)
+    yield handle
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
 def scdn_app(params):
     handle = _serve_autoscaled(params, "scdn", max_replicas=2)
     yield handle
@@ -277,6 +303,8 @@ def test_chaos_scale_up_kill_drain_down_byte_exact(chaos_app,
     downs0 = _metric("raytpu_serve_autoscale_decisions_total",
                      'direction="down"')
     drains0 = _metric("raytpu_serve_replica_drains_total")
+    slope0 = _metric("raytpu_serve_autoscale_decisions_total",
+                     'reason="arrival_slope"')
 
     # Warm the compiled paths off the clock.
     chaos_app.remote({"tokens": [1, 2, 3], "max_new_tokens": 1,
@@ -340,6 +368,74 @@ def test_chaos_scale_up_kill_drain_down_byte_exact(chaos_app,
     assert _wait(lambda: _metric("raytpu_serve_replica_drains_total")
                  >= drains0 + 1, nudge=lambda: _groups("chaos")), \
         "scale-down retired a group without draining it"
+    # Signals off (no upscale_slope_threshold): the reactive path must
+    # never have emitted a predictive decision.
+    assert _metric("raytpu_serve_autoscale_decisions_total",
+                   'reason="arrival_slope"') == slope0, \
+        "arrival_slope decision counted with the predictive knob unset"
+
+
+def test_predictive_scale_up_before_queue_pressure(pred_app,
+                                                   references):
+    """Ramped arrival against the predictive app: wave sizes grow, so
+    the EWMA arrival rate's slope crosses the threshold and the
+    controller scales up with reason "arrival_slope" — while the
+    parked reactive targets record ZERO queue-age/goodput pressure
+    decisions.  The point of the predictive arm: the replica is
+    already warming before any queue exists for the reactive signals
+    to see.  Goodput holds and every stream stays byte-exact."""
+    def ups(reason):
+        return _metric("raytpu_serve_autoscale_decisions_total",
+                       f'direction="up"[^}}]*reason="{reason}"')
+
+    slope0 = ups("arrival_slope")
+    qage0 = ups("queue_age")
+    good0 = ups("goodput")
+
+    # Warm the compiled paths off the clock.
+    pred_app.remote({"tokens": [1, 2, 3], "max_new_tokens": 1,
+                     "temperature": 0.0}).result(timeout_s=300)
+
+    shandle = pred_app.options(stream=True, max_retries=8)
+    recs = []
+    # Ramp: each wave is bigger than the last, so the arrival rate —
+    # and with it the EWMA slope the controller watches — climbs
+    # monotonically through the window.
+    n = 0
+    for wave in range(6):
+        for _ in range(2 * (wave + 1)):
+            _launch_stream(shandle, n % N_STREAMS, recs)
+            n += 1
+        time.sleep(0.4)
+        if _metric("raytpu_serve_autoscale_decisions_total",
+                   'reason="arrival_slope"') > slope0:
+            break
+    assert _wait(lambda: ups("arrival_slope") >= slope0 + 1,
+                 nudge=lambda: _groups("pred")), \
+        "ramped arrival never drove an arrival_slope scale-up"
+    # Predictive means BEFORE pressure: the parked reactive targets
+    # must not have tripped.
+    assert ups("queue_age") == qage0, \
+        "queue-age pressure fired — the scale-up was not predictive"
+    assert ups("goodput") == good0, \
+        "goodput pressure fired — the scale-up was not predictive"
+
+    for rec in recs:
+        rec["thread"].join(timeout=300)
+    hung = [rec["i"] for rec in recs if rec["thread"].is_alive()]
+    assert not hung, f"streams hung during predictive ramp: {hung}"
+    errs = [rec["err"] for rec in recs if rec["err"] is not None]
+    assert not errs, f"streams failed during predictive ramp: {errs}"
+    # Byte-exact goodput, same bar as the chaos ramp.
+    for rec in recs:
+        assert rec["out"] == references[rec["i"]], rec["i"]
+
+    def _touch():
+        pred_app.remote({"tokens": [1, 2, 3], "max_new_tokens": 1,
+                         "temperature": 0.0}).result(timeout_s=60)
+
+    assert _wait(lambda: _metric_max("raytpu_serve_goodput_ratio") >= 0.5,
+                 nudge=_touch), "goodput gauge below target after ramp"
 
 
 def test_policy_scale_down_drains_without_capacity_dip(scdn_app,

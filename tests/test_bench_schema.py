@@ -597,6 +597,36 @@ def test_chaos_sheds_are_not_completions(schema):
     assert any("exceeds offered=24" in p for p in probs)
 
 
+def test_chaos_scale_up_reasons_breakdown(schema):
+    """ISSUE 18 satellite: the scale_up_reasons breakdown uses known
+    reasons only, counts >= 1 (absent-not-zero — a reason that never
+    fired is omitted, never reported as 0), and sums to scale_ups."""
+    rec = _record()
+    blk = _chaos_block()
+    blk["scale_ups"] = 3
+    blk["scale_up_reasons"] = {"arrival_slope": 1, "queue_age": 2}
+    rec["extra"]["serving_chaos"] = blk
+    assert schema.validate_record(rec) == []
+
+    blk["scale_up_reasons"] = {"vibes": 3}
+    probs = schema.validate_record(rec)
+    assert any("unknown reason 'vibes'" in p for p in probs)
+
+    blk["scale_up_reasons"] = {"arrival_slope": 0, "queue_age": 3}
+    probs = schema.validate_record(rec)
+    assert any("arrival_slope=0" in p and "omitted, not zero" in p
+               for p in probs)
+
+    blk["scale_up_reasons"] = {"queue_age": 1}  # sums to 1, not 3
+    probs = schema.validate_record(rec)
+    assert any("breakdown sums to 1" in p and "scale_ups=3" in p
+               for p in probs)
+
+    # Field absent entirely: valid (older records never measured it).
+    del blk["scale_up_reasons"]
+    assert schema.validate_record(rec) == []
+
+
 def test_bench_out_if_present(schema):
     """Whatever BENCH_OUT.json the last bench run left behind must
     satisfy the schema (skips when no run has happened here)."""

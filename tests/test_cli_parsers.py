@@ -62,6 +62,17 @@ def test_profile_parser_defaults():
     assert args.duration == pytest.approx(7.5)
 
 
+def test_top_parser_defaults():
+    args = build_parser().parse_args(["top"])
+    assert args.cmd == "top"
+    assert args.once is False
+    assert args.interval == pytest.approx(2.0)
+    assert args.window == pytest.approx(10.0)
+    args = build_parser().parse_args(["top", "--once", "--window", "30"])
+    assert args.once is True
+    assert args.window == pytest.approx(30.0)
+
+
 def test_unknown_command_exits_nonzero(capsys):
     with pytest.raises(SystemExit) as ei:
         build_parser().parse_args(["definitely-not-a-command"])

@@ -481,6 +481,34 @@ def test_failover_waterfall_and_slo_miss_bundle(params, tmp_path):
              f"procs={sorted(events)}")
         manifest = json.loads((bundles[-1] / "manifest.json").read_text())
         assert len(manifest["procs"]) >= 2
+
+        # ISSUE 18 history proof: the bundle carries the trailing
+        # time-series window, with its procs listed in the manifest.
+        assert (bundles[-1] / "history.json").exists()
+        hist = json.loads((bundles[-1] / "history.json").read_text())
+        assert hist["window_s"] >= 60.0
+        assert manifest["history_procs"] == sorted(
+            {s["proc"] for s in hist["series"]})
+        # The >= 2-process serve-plane claim polls first: worker
+        # sampler points ride the reply cadence (1 s ticks), so nudge
+        # traffic until they federate, then cut a manual bundle.
+        from ray_tpu.util import timeseries
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            sprocs = {s["proc"] for s in timeseries.query(
+                family="raytpu_serve_")["series"]}
+            if len(sprocs) >= 2:
+                break
+            shandle.remote({"tokens": [1, 2], "max_new_tokens": 1,
+                            "temperature": 0.0}).result(timeout_s=300)
+            time.sleep(0.2)
+        hpath = flight_recorder.dump(reason="history")
+        hist = json.loads(
+            (pathlib.Path(hpath) / "history.json").read_text())
+        sprocs = {s["proc"] for s in hist["series"]
+                  if s["family"].startswith("raytpu_serve_")}
+        assert len(sprocs) >= 2, sorted(sprocs)
     finally:
         flight_recorder.configure(dump_dir="", min_dump_interval_s=2.0)
         serve.shutdown()

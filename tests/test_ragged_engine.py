@@ -63,7 +63,7 @@ def _phase_totals():
     from ray_tpu.serve.llm_engine import _telemetry
 
     out = {}
-    for _name, tags, value in _telemetry()["step_tokens"]._samples():
+    for _name, tags, value, _kind in _telemetry()["step_tokens"]._samples():
         out[dict(tags).get("phase")] = value
     return out
 

@@ -189,7 +189,11 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
         <= {e["name"] for e in device_events}
 
     # One registry: every plane's families in a single scrape, with the
-    # request/step observations actually recorded.
+    # request/step observations actually recorded.  Tick the history
+    # plane's sampler explicitly first so its self-metric families are
+    # live regardless of where the 1 s background cadence landed.
+    from ray_tpu.util import timeseries
+    timeseries.sample_now()
     text = metrics.export_prometheus()
     assert 'raytpu_xla_program_flops{program="train.step"}' in text
     assert 'raytpu_xla_program_flops{program="serve.decode"}' in text
@@ -285,6 +289,15 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
                  "raytpu_flightrec_events",
                  "raytpu_flightrec_triggers_total",
                  "raytpu_flightrec_dumps_total",
+                 # Telemetry history plane (util/timeseries): the
+                 # store's self-metrics, live once the sampler ticks,
+                 # plus the offered-load counter the predictive
+                 # autoscaling signal is derived from.
+                 "raytpu_timeseries_points",
+                 "raytpu_timeseries_memory_bytes",
+                 "raytpu_timeseries_samples_total",
+                 "raytpu_timeseries_dropped_series_total",
+                 "raytpu_serve_requests_arrived_total",
                  # Speculative decoding: declared with the engine
                  # telemetry even when the engine never speculates.
                  "raytpu_serve_spec_rounds_total",
