@@ -1071,6 +1071,21 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
                     - ups_by_reason0[r])
             if n >= 1:
                 scale_up_reasons[r] = n
+        # Post-ramp invariant audit: kills + continuation replays +
+        # scale-down drains are exactly the paths that leak KV pages or
+        # adapter borrows, and a leg that leaked would still report
+        # healthy goodput — the doctor's full partition walk is the
+        # difference between "survived" and "survived intact"
+        # (bench_schema._check_doctor requires violations == 0).
+        from ray_tpu.util import state as _state
+
+        t_doc = time.monotonic()
+        doc = _state.doctor_report(deep=True)
+        doctor = {
+            "checks_run": int(doc.get("checks_run", 0)),
+            "violations": int(doc.get("violations", 0)),
+            "audit_seconds": round(time.monotonic() - t_doc, 4),
+        }
     finally:
         serve.shutdown()
         ray_tpu.shutdown()
@@ -1092,6 +1107,7 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
         "max_groups": max_groups,
         "max_replicas": max_replicas,
         "gen": gen,
+        "doctor": doctor,
     }
 
 

@@ -33,6 +33,13 @@ metrics):
                                  &step=&proc= — family is a name
                                  prefix, step picks the 1/10/60 s
                                  ring); backs `raytpu top`
+  GET /api/v0/doctor             cluster invariant audit — engine
+                                 pool/trie/adapter/slot accounting,
+                                 controller census vs broadcast vs
+                                 router tables (?deep=1 for the full
+                                 partition walks, ?replica= to narrow
+                                 the fan-out); backs `raytpu doctor`
+                                 (util/state.doctor_report)
   GET /api/v0/tasks/summarize
   GET /api/v0/actors/detail      ?id= one actor + its task attempts
                                  (parity: the React client's actor
@@ -131,6 +138,15 @@ class _Handler(BaseHTTPRequestHandler):
                     since=float(since) if since else None,
                     step=float((qs.get("step") or ["1"])[0]),
                     proc=(qs.get("proc") or [None])[0] or None,
+                )})
+            elif url.path == "/api/v0/doctor":
+                # Also pre-gate: a directly-driven engine audits
+                # without a runtime (the controller fan-out inside is
+                # already best-effort).
+                self._json({"result": _state.doctor_report(
+                    deep=(qs.get("deep") or ["0"])[0]
+                    in ("1", "true", "yes"),
+                    replica=(qs.get("replica") or [None])[0] or None,
                 )})
             elif not api.is_initialized():
                 self._json({"error": "runtime not initialized"}, 503)

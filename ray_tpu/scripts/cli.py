@@ -316,6 +316,74 @@ def cmd_top(args, out) -> int:
         return 0
 
 
+_DOCTOR_COLUMNS = ["proc", "check", "tier", "status", "violations"]
+
+
+def format_doctor(report: Dict[str, Any]) -> str:
+    """Render one `raytpu doctor` report (GET /api/v0/doctor): the
+    header totals, a check-by-check table sorted by (proc, check), and
+    one detail line per violation.  Pure (no clock, no I/O) and
+    deterministic for a given report, so the tests can pin the output
+    byte-for-byte."""
+    import io
+
+    buf = io.StringIO()
+    reports = report.get("reports", [])
+    print(f"doctor: {len(reports)} proc(s), "
+          f"{report.get('checks_run', 0)} check(s), "
+          f"{report.get('violations', 0)} violation(s)"
+          + ("  [deep]" if report.get("deep") else ""), file=buf)
+    rows: List[Dict[str, Any]] = []
+    details: List[str] = []
+    for rep in reports:
+        proc = str(rep.get("proc", "?"))
+        if rep.get("error"):
+            rows.append({"proc": proc, "check": "(unreachable)",
+                         "tier": "-", "status": "error",
+                         "violations": rep["error"]})
+            continue
+        for row in rep.get("checks", []):
+            rows.append({
+                "proc": proc, "check": row["check"],
+                "tier": row["tier"], "status": row["status"],
+                "violations": len(row["violations"]),
+            })
+            for v in row["violations"]:
+                details.append(
+                    f"{proc}  {v['check']}  [{v['severity']}]  "
+                    f"{v['subject']}: expected {v['expected']!r}, "
+                    f"got {v['actual']!r}")
+    rows.sort(key=lambda r: (r["proc"], r["check"]))
+    if rows:
+        _print_table(rows, _DOCTOR_COLUMNS, buf)
+    else:
+        print("(no checks ran — no engines or controller found)",
+              file=buf)
+    for line in sorted(details):
+        print(line, file=buf)
+    return buf.getvalue().rstrip("\n")
+
+
+def cmd_doctor(args, out) -> int:
+    """`raytpu doctor`: run the cluster invariant audit (GET
+    /api/v0/doctor — engine pool/trie/adapter/slot accounting plus
+    controller census vs broadcast vs router tables) and render the
+    check-by-check verdict.  Exit 1 when any violation was found."""
+    from urllib.parse import quote
+
+    path = "/api/v0/doctor"
+    params = []
+    if args.deep:
+        params.append("deep=1")
+    if args.replica:
+        params.append(f"replica={quote(args.replica)}")
+    if params:
+        path += "?" + "&".join(params)
+    report = _get_json(_address(args), path)["result"]
+    print(format_doctor(report), file=out)
+    return 1 if report.get("violations") else 0
+
+
 def cmd_memory(args, out) -> int:
     rows = _get_json(_address(args),
                      f"/api/v0/objects?limit={args.limit}")["result"]
@@ -461,6 +529,8 @@ def build_parser() -> argparse.ArgumentParser:
                "flightrec (dump a flight-recorder bundle), "
                "top (live fleet view from the telemetry history "
                "plane; --once for a single frame), "
+               "doctor (cluster invariant audit; --deep for the full "
+               "partition walks, exit 1 on violations), "
                "memory, job, serve, start",
     )
     p.add_argument("--address", default=None,
@@ -534,6 +604,17 @@ def build_parser() -> argparse.ArgumentParser:
     tpp.add_argument("--window", type=float, default=10.0,
                      help="trailing window the rate columns average")
 
+    dcp = sub.add_parser(
+        "doctor",
+        help="cluster invariant audit: engine pool/trie/adapter/slot "
+             "accounting + controller/router census sync "
+             "(GET /api/v0/doctor); exits 1 on violations")
+    dcp.add_argument("--deep", action="store_true", default=False,
+                     help="run the full partition/reachability walks")
+    dcp.add_argument("--replica", default="",
+                     help="narrow the controller fan-out to one "
+                          "replica id")
+
     mp = sub.add_parser("memory", help="object store contents")
     mp.add_argument("--limit", type=int, default=1000)
 
@@ -594,6 +675,7 @@ _DISPATCH = {
     "trace": cmd_trace,
     "flightrec": cmd_flightrec,
     "top": cmd_top,
+    "doctor": cmd_doctor,
     "memory": cmd_memory,
     "job": cmd_job,
     "serve": cmd_serve,

@@ -316,6 +316,22 @@ class AdapterPool:
         prefix cache's summary()."""
         return {"adapters": self.resident_ids()}
 
+    def audit_snapshot(self) -> dict:
+        """Consistent allocator view for the doctor plane
+        (serve/audit): the free list, every resident block's pages /
+        refs / ids, and the id→content-hash map — enough to recount
+        the pool partition and borrow balance externally."""
+        with self._lock:
+            return {
+                "num_pages": self.num_pages,
+                "pages_per_adapter": self.pages_per_adapter,
+                "free": list(self._free),
+                "blocks": {h: {"pages": list(b.pages), "refs": b.refs,
+                               "ids": sorted(b.ids)}
+                           for h, b in self._blocks.items()},
+                "entries": dict(self._entries),
+            }
+
     def stats(self) -> dict:
         with self._lock:
             resident = sorted(

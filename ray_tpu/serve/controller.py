@@ -169,6 +169,11 @@ class _DeploymentState:
         # ts}) — surfaced on list_replicas rows for `raytpu list
         # replicas`.  None until the policy first moves the target.
         self.last_decision: Optional[Dict[str, Any]] = None
+        # What the last routing-table broadcast actually announced:
+        # [(replica_id, draining)] — the doctor's census_broadcast
+        # check recomputes the expected table from the replica census
+        # and diffs it against this.
+        self.last_broadcast: List[Tuple[str, bool]] = []
 
     @property
     def config(self) -> DeploymentConfig:
@@ -572,6 +577,52 @@ class ServeController:
         # them routable for retries) — tell them now, not at retirement.
         self._broadcast(st)
         return True
+
+    def doctor(self, deep: bool = False,
+               replica_id: Optional[str] = None) -> Dict[str, Any]:
+        """Cluster invariant audit (the `raytpu doctor` backend): run
+        the controller's own census↔broadcast consistency checks, fan
+        the doctor RPC out to every RUNNING/DRAINING replica (or just
+        ``replica_id``), and merge the per-process reports.  The
+        merged report additionally carries ``census`` —
+        {"app/deployment": [replica ids]} — so the caller can diff its
+        local routers' tables against the same census snapshot."""
+        from ray_tpu.serve import audit as _audit
+        from ray_tpu.util import doctor as _doctor
+
+        fns = []
+        work: List[Tuple[str, Any]] = []
+        census_by_key: Dict[str, List[str]] = {}
+        with self._lock:
+            for (app, dep), st in sorted(self._deployments.items()):
+                key = f"{app}/{dep}"
+                census = [(rid, st.replicas[rid].state == "DRAINING")
+                          for rid in sorted(st.replicas)
+                          if st.replicas[rid].state
+                          in ("RUNNING", "DRAINING")]
+                census_by_key[key] = [rid for rid, _ in census]
+                last = list(st.last_broadcast)
+                fns.append((_audit.CENSUS_BROADCAST,
+                            lambda k=key, c=census, t=last:
+                            _audit.census_broadcast_checks(k, c, t)))
+                for rid, _draining in census:
+                    if replica_id is not None and rid != replica_id:
+                        continue
+                    work.append((rid, st.replicas[rid].handle))
+        reports = [_doctor.run_audit("controller", fns, deep=True)]
+        for rid, handle in work:
+            try:
+                rep = api.get(handle.doctor.remote(deep))
+            except Exception as e:
+                rep = {"proc": rid, "checks_run": 0, "violations": 0,
+                       "audit_seconds": 0.0, "checks": [],
+                       "error": repr(e)}
+            if rep is not None:  # None = callable has no doctor surface
+                rep.setdefault("replica_id", rid)
+                reports.append(rep)
+        out = _doctor.merge_reports(reports, deep=deep)
+        out["census"] = census_by_key
+        return out
 
     def status(self) -> Dict[str, Any]:
         with self._lock:
@@ -1003,6 +1054,14 @@ class ServeController:
                      is_async, r.prefix_summary, r.role, r.adapter_summary,
                      ongoing, r.state == "DRAINING")
                 )
+        from ray_tpu.serve import audit as _audit
+
+        if table and _audit.corrupt(_audit.INJECT_BROADCAST):
+            table = table[:-1]  # drop one row: census/broadcast desync
+        # Record what was ACTUALLY announced (post-injection), so the
+        # doctor's census_broadcast check diffs the real table against
+        # the census rather than our intent.
+        st.last_broadcast = [(row[0], bool(row[8])) for row in table]
         self._host.notify_changed(
             replica_set_key(st.app_name, st.info.name), table
         )

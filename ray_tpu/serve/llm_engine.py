@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.core.exceptions import PreemptedError, ShedError
+from ray_tpu.serve import audit as _audit
 from ray_tpu.serve import request_events as _reqev
 from ray_tpu.util import tracing
 
@@ -249,11 +250,16 @@ def _telemetry():
     from ray_tpu.serve import latency_attribution as _lat
     from ray_tpu.util import flight_recorder as _frec
 
+    # The doctor families (util/doctor) merge the same way so the
+    # tier-1 --require pins see them at zero before any audit runs.
+    from ray_tpu.util import doctor as _doc
+
     out = dict(_TELEMETRY)
     out.update(_kvt._telemetry())
     out.update(_apool._telemetry())
     out.update(_lat._telemetry())
     out.update({f"frec_{k}": v for k, v in _frec._telemetry().items()})
+    out.update({f"doctor_{k}": v for k, v in _doc._telemetry().items()})
     return out
 
 
@@ -1073,9 +1079,28 @@ class LLMServer:
         routing — the same path prefix_summary rides."""
         return self.engine.adapter_summary()
 
+    def doctor(self, deep: bool = True) -> Dict[str, Any]:
+        """Run one invariant audit over the hosted engine and return
+        its report — the per-replica RPC target behind the
+        controller's doctor() fan-out (``GET /api/v0/doctor`` /
+        ``raytpu doctor --deep``)."""
+        return self.engine.doctor(deep=deep)
+
     def check_health(self) -> None:
         if self.engine._stopped.is_set():
             raise RuntimeError("engine stopped")
+        # A critical invariant violation (a corrupted page partition /
+        # refcount) from the most recent audit fails the health
+        # verdict: the controller restarts a replica whose KV pool can
+        # silently corrupt streams.  Leaks and census drift (error /
+        # warning) alert through metrics instead of a restart.
+        critical = self.engine._auditor.last_critical()
+        if critical:
+            v = critical[0]
+            raise RuntimeError(
+                f"doctor: invariant {v['check']} violated "
+                f"({v['subject']}: expected {v['expected']!r}, got "
+                f"{v['actual']!r}; {len(critical)} critical total)")
 
 
 _ENGINE_IDS = itertools.count()
@@ -1237,6 +1262,17 @@ class LLMEngine:
         self._engine_id = f"engine-{next(_ENGINE_IDS)}"
         self._ring = _reqev.RequestEventBuffer(self._engine_id)
         _reqev.register(self._ring)
+        # Invariant audit plane (serve/audit + util/doctor): the
+        # auditor runs O(slots) conservation checks between dispatches
+        # and full partition walks on demand / idle / drain / stop.
+        # doctor() enqueues audit ops exactly like the cancel and
+        # migration queues — the loop owns all audited state.
+        self._auditor = _audit.EngineAuditor(self)
+        self._audit_lock = threading.Lock()
+        self._audit_ops: List[Dict[str, Any]] = []
+        self._crashed = False
+        self._drain_audited = False
+        _audit.register_engine(self)
         # Cancellation handoff: client threads drop ids here; the
         # engine loop resolves them against its registries between
         # dispatches (the loop owns all slot/page state).
@@ -1892,6 +1928,47 @@ class LLMEngine:
         if self._adapters is None:
             return None
         return self._adapters.summary()
+
+    def doctor(self, deep: bool = True,
+               timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Run one invariant audit pass (serve/audit) and return its
+        report.  While the loop runs, the audit is enqueued for IT to
+        execute between jitted dispatches (the loop owns every audited
+        registry — same ownership rule as cancel and migration ops);
+        once the engine is stopped the audit runs inline, because no
+        mutator is left.  ``deep=False`` runs only the O(slots)
+        conservation tier."""
+        if self._stopped.is_set() or not self._thread.is_alive():
+            # Let a stopping loop finish its final-audit/cleanup pass
+            # first so the inline walk never races it.
+            self._thread.join(timeout=5.0)
+            return self._auditor.run(deep=deep)
+        op: Dict[str, Any] = {"deep": bool(deep),
+                              "done": threading.Event(),
+                              "result": None, "error": None}
+        with self._audit_lock:
+            self._audit_ops.append(op)
+        self._work.set()
+        if not op["done"].wait(timeout_s):
+            with self._audit_lock:
+                try:
+                    self._audit_ops.remove(op)
+                except ValueError:
+                    pass
+            if not op["done"].is_set():
+                if self._stopped.is_set():
+                    self._thread.join(timeout=5.0)
+                    return self._auditor.run(deep=deep)
+                raise TimeoutError(
+                    f"doctor audit not serviced within {timeout_s}s")
+        if op["error"] is not None:
+            raise op["error"]
+        return op["result"]
+
+    def doctor_report(self) -> Optional[Dict[str, Any]]:
+        """The most recent audit report without running a new pass
+        (None before the first audit)."""
+        return self._auditor.last_report
 
     def shutdown(self):
         self._stopped.set()
@@ -2854,6 +2931,7 @@ class LLMEngine:
             self._adapters.release(aid)
         self._free_slots.append(slot)
         self._state_dirty = True
+        self._auditor.mark_dirty()
         if self._paged:
             if self._spec_on:
                 self._spec_inflight.discard(slot)
@@ -2861,12 +2939,17 @@ class LLMEngine:
                 self._draft_fed.pop(slot, None)
                 dpages = self._draft_slot_pages.pop(slot, None)
                 if dpages:
+                    if _audit.corrupt(_audit.INJECT_DRAFT_PAGE):
+                        dpages = dpages[1:]  # leak one draft page
                     self._draft_free.extend(dpages)
                     self._draft_bt[slot] = self._draft_pages
             pages = self._slot_pages.pop(slot, [])
             if self._prefix is not None:
                 borrowed = self._slot_borrowed.pop(slot, [])
-                self._prefix.release(borrowed)
+                release = borrowed
+                if borrowed and _audit.corrupt(_audit.INJECT_TRIE_REF):
+                    release = borrowed[1:]  # leak one trie borrow ref
+                self._prefix.release(release)
                 adopted: set = set()
                 if cache_tokens is not None and not self._draining.is_set():
                     full = len(cache_tokens) // self.config.page_size
@@ -3373,6 +3456,18 @@ class LLMEngine:
             self._preempt_request(st["req"], st["slot"])
         for slot, req in list(self._slot_req.items()):
             self._preempt_request(req, slot)
+        # Drain-evict leak fix (mirrors the clean-stop tail): open
+        # migration leases belong to exports that can no longer
+        # complete against a draining replica — release them, then
+        # audit once so scale-down provably hands back a leak-free
+        # pool.
+        if not self._drain_audited:
+            self._drain_audited = True
+            self._release_open_leases()
+            try:
+                self._auditor.run(deep=True)
+            except Exception:
+                log.exception("drain-evict audit failed")
 
     # -- KV page migration (serve/kv_transfer) ------------------------------
 
@@ -3631,6 +3726,39 @@ class LLMEngine:
         self._update_page_gauges()
         return n_in
 
+    # -- invariant audits (serve/audit, util/doctor) ------------------------
+
+    def _process_audits(self) -> None:
+        """Service queued doctor() ops on the loop thread — the only
+        thread allowed to walk slot/page state while the engine
+        runs."""
+        with self._audit_lock:
+            if not self._audit_ops:
+                return
+            ops, self._audit_ops = self._audit_ops, []
+        for op in ops:
+            try:
+                op["result"] = self._auditor.run(deep=op["deep"])
+            except Exception as e:
+                op["error"] = e
+            op["done"].set()
+
+    def _release_open_leases(self) -> None:
+        """Drop every open migration lease (shutdown/drain-evict leak
+        fix): a lease still open here belongs to a client whose export
+        can no longer complete, and an unreleased lease pins its pages
+        against eviction forever — the final audit would rightly call
+        that a leak."""
+        if self._prefix is None or not self._mig_leases:
+            return
+        for lease_id in list(self._mig_leases):
+            lease = self._mig_leases.pop(lease_id)
+            try:
+                self._prefix.lease_release(lease["pages"])
+            except Exception:
+                log.exception("migration lease %s did not release "
+                              "cleanly during shutdown/drain", lease_id)
+
     def _mig_do_hot_prefixes(self, op: dict) -> List[dict]:
         out: List[dict] = []
         for path in self._prefix.hot_paths(op["max_pages"]):
@@ -3660,6 +3788,10 @@ class LLMEngine:
             self._loop_body()
         except BaseException as e:  # engine crash — fail every client
             self._stopped.set()
+            # The conftest deep-audit fixture skips crashed engines: a
+            # loop that died mid-dispatch legitimately strands
+            # allocator state, which is not a leak regression.
+            self._crashed = True
             self._fetchq.put(None)  # release the fetcher thread too
             with self._mig_lock:  # release migration-op waiters too
                 mig_ops, self._mig_ops = self._mig_ops, []
@@ -3667,6 +3799,12 @@ class LLMEngine:
                 op["error"] = RuntimeError(
                     f"engine crashed before migration op "
                     f"{op['kind']!r} ran: {e!r}")
+                op["done"].set()
+            with self._audit_lock:  # release doctor() waiters too
+                audit_ops, self._audit_ops = self._audit_ops, []
+            for op in audit_ops:
+                op["error"] = RuntimeError(
+                    f"engine crashed before audit ran: {e!r}")
                 op["done"].set()
             err = RuntimeError(f"LLM engine loop crashed: {e!r}")
             err.__cause__ = e
@@ -3702,14 +3840,23 @@ class LLMEngine:
             self._process_cancels()
             self._process_drain()
             self._process_migrations()
+            self._process_audits()
             backlog = self._paged and (self._backlog or self._prefilling)
             if (not self._slot_req and self._waiting.empty()
                     and not backlog and self._unprocessed == 0):
+                # Idle: settle the incremental audit debt, and
+                # opportunistically run the rate-limited deep audit —
+                # idle is the one time a full walk costs nobody
+                # latency.
+                self._auditor.maybe_incremental()
+                if not self._draining.is_set():
+                    self._auditor.maybe_idle_deep(time.monotonic())
                 self._work.wait(timeout=0.05)
                 self._work.clear()
                 continue
             self._process_fetched(block=False)
             self._admit()
+            self._auditor.maybe_incremental()
             dispatched = False
             if self._ragged:
                 if ((self._slot_req or self._prefilling)
@@ -3742,3 +3889,20 @@ class LLMEngine:
             op["error"] = RuntimeError(
                 f"engine stopped before migration op {op['kind']!r} ran")
             op["done"].set()
+        # Shutdown leak fix: a clean stop releases every open
+        # migration lease and every still-occupied slot (returning its
+        # pages, adapter borrow, draft pages and borrowed prefix
+        # pages) BEFORE the final deep audit, so clean shutdown is
+        # provably leak-free — anything the audit still finds is a
+        # real accounting bug, not an artifact of stopping mid-flight.
+        self._release_open_leases()
+        leftovers = set(self._slot_req)
+        leftovers.update(st["slot"] for st in self._prefilling)
+        self._prefilling.clear()
+        for slot in sorted(leftovers):
+            self._release_slot(slot)
+        self._process_audits()  # queued doctor() ops still get served
+        try:
+            self._auditor.run(deep=True)
+        except Exception:
+            log.exception("final shutdown audit failed")

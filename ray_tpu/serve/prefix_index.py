@@ -337,6 +337,28 @@ class PrefixIndex:
                 break
         return out
 
+    def audit_snapshot(self) -> dict:
+        """Consistent view for the doctor plane (serve/audit): per-page
+        refcounts/lease counts from the page index plus a reachability
+        walk from the root.  ``pages[p]["reachable"]`` is False for an
+        orphaned node (indexed but detached from the tree);
+        ``unindexed`` lists pages a root walk reaches that the page
+        index has lost — both are corruption, caught by different
+        halves of kv.trie_integrity."""
+        with self._lock:
+            reachable: Set[int] = set()
+            stack = list(self._root_children.values())
+            while stack:
+                node = stack.pop()
+                reachable.add(node.page)
+                stack.extend(node.children.values())
+            return {
+                "pages": {p: {"refs": n.refs, "leases": n.leases,
+                              "reachable": p in reachable}
+                          for p, n in self._by_page.items()},
+                "unindexed": sorted(reachable - set(self._by_page)),
+            }
+
     def stats(self) -> dict:
         with self._lock:
             return {

@@ -425,6 +425,15 @@ class ReplicaActor:
             fn()  # raises on unhealthy (parity: serve health-check contract)
         return True
 
+    def doctor(self, deep: bool = True) -> Optional[Dict[str, Any]]:
+        """Run the invariant doctor on the user callable's engine
+        (LLMServer.doctor → LLMEngine.doctor) and return its report;
+        None when the callable has no doctor surface."""
+        fn = getattr(self._callable, "doctor", None)
+        if fn is None:
+            return None
+        return fn(deep=deep)
+
     def prepare_for_shutdown(self, timeout_s: float) -> None:
         """Drain: wait for ongoing requests to finish (parity:
         graceful_shutdown_timeout_s)."""

@@ -13,6 +13,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import api
@@ -21,6 +22,18 @@ from ray_tpu.serve import request_events as _reqev
 from ray_tpu.util import tracing
 
 _TELEMETRY = None
+
+# Weak registry of live routers so the doctor (serve/audit
+# ``router_sync_checks``) can compare each router's replica table
+# against the controller census without keeping routers alive.
+_ROUTERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_routers() -> List["Router"]:
+    """Every Router object still alive in this process, in a stable
+    (app, deployment) order — the doctor's audit surface."""
+    return sorted(_ROUTERS,
+                  key=lambda r: (r.app_name, r.deployment_name))
 
 # A request reaching this many attempts trips the flight recorder's
 # retry_storm trigger (attempt numbers are 0-based; 3 = 4th try).
@@ -161,6 +174,7 @@ class Router:
         self._ring = _reqev.RequestEventBuffer(
             f"router:{app_name}/{deployment_name}")
         _reqev.register(self._ring)
+        _ROUTERS.add(self)
         self._subscribe()
         threading.Thread(
             target=self._reaper_loop, daemon=True,
@@ -218,6 +232,16 @@ class Router:
                 if rid in fresh
             }
             self._cv.notify_all()
+
+    def audit_view(self) -> Dict[str, Any]:
+        """Point-in-time view of this router's replica table for the
+        doctor's router↔controller sync check."""
+        with self._lock:
+            return {
+                "app": self.app_name,
+                "deployment": self.deployment_name,
+                "replica_ids": sorted(self._replicas),
+            }
 
     # -- assignment --------------------------------------------------------
 

@@ -173,15 +173,21 @@ def _sample_counter_deltas_locked(now: float) -> None:
 
 
 def trigger(reason: str, request_id: Optional[str] = None,
-            **fields: Any) -> Optional[str]:
+            detail: Optional[str] = None, **fields: Any) -> Optional[str]:
     """Record an incident trigger; auto-dump when configured.  Returns
-    the bundle path when a dump was written, else None."""
+    the bundle path when a dump was written, else None.  ``detail``
+    refines the reason without widening the counter's label set (the
+    doctor passes the violated check's name here, so the bundle
+    manifest names the invariant while the reason label stays
+    ``invariant``)."""
     now = time.time()
     with _lock:
         global _seq
         _seq += 1
         ev = {"ts": now, "seq": _seq, "kind": "trigger", "reason": reason,
               "request_id": request_id}
+        if detail is not None:
+            ev["detail"] = detail
         ev.update(fields)
         _events.append(ev)
         _sample_counter_deltas_locked(now)
@@ -189,10 +195,11 @@ def trigger(reason: str, request_id: Optional[str] = None,
         _telemetry()["triggers"].inc(tags={"reason": reason})
     except Exception:
         pass
-    return _maybe_auto_dump(reason)
+    return _maybe_auto_dump(reason, detail=detail)
 
 
-def _maybe_auto_dump(reason: str) -> Optional[str]:
+def _maybe_auto_dump(reason: str,
+                     detail: Optional[str] = None) -> Optional[str]:
     global _last_auto_dump_t
     with _lock:
         if not (_dump_dir and _auto_dump):
@@ -201,7 +208,7 @@ def _maybe_auto_dump(reason: str) -> Optional[str]:
         if now - _last_auto_dump_t < _min_dump_interval_s:
             return None
         _last_auto_dump_t = now
-    return dump(reason=reason)
+    return dump(reason=reason, detail=detail)
 
 
 # -- cross-process federation ----------------------------------------------
@@ -229,10 +236,10 @@ def ingest(proc: str, events: List[Dict[str, Any]]) -> Optional[str]:
             ring = _remote[proc] = collections.deque(
                 maxlen=_events.maxlen)
         ring.extend(dict(e) for e in events)
-    reasons = [e.get("reason", "remote")
-               for e in events if e.get("kind") == "trigger"]
-    if reasons:
-        return _maybe_auto_dump(reasons[0])
+    triggers = [e for e in events if e.get("kind") == "trigger"]
+    if triggers:
+        return _maybe_auto_dump(triggers[0].get("reason", "remote"),
+                                detail=triggers[0].get("detail"))
     return None
 
 
@@ -259,11 +266,13 @@ def snapshot(request_id: Optional[str] = None,
     return {p: evs for p, evs in out.items() if evs or p == "driver"}
 
 
-def dump(reason: str = "manual",
-         dump_dir: Optional[str] = None) -> Optional[str]:
+def dump(reason: str = "manual", dump_dir: Optional[str] = None,
+         detail: Optional[str] = None) -> Optional[str]:
     """Write a bundle directory (events.json + metrics.prom +
     manifest.json) and return its path; None when no directory is
-    configured.  Manual dumps bypass the auto-dump rate limit."""
+    configured.  Manual dumps bypass the auto-dump rate limit.
+    ``detail`` (e.g. the violated invariant's check name) lands in the
+    manifest next to the reason."""
     global _dump_n
     d = dump_dir or _dump_dir
     if not d:
@@ -296,7 +305,8 @@ def dump(reason: str = "manual",
     except Exception:
         pass
     with open(os.path.join(path, "manifest.json"), "w") as f:
-        json.dump({"reason": reason, "created_at": time.time(),
+        json.dump({"reason": reason, "detail": detail,
+                   "created_at": time.time(),
                    "procs": sorted(events),
                    "history_procs": history_procs,
                    "n_events": sum(len(v) for v in events.values())},

@@ -150,6 +150,59 @@ def list_replicas(filters: Optional[List[Filter]] = None, *,
     return _apply_filters(rows, filters, limit)
 
 
+def doctor_report(deep: bool = False,
+                  replica: Optional[str] = None) -> Dict[str, Any]:
+    """Cluster invariant audit (the `raytpu doctor` data source).
+
+    Three planes, merged into one ``doctor.merge_reports`` shape:
+    local engines (directly-driven LLMEngines audit inline — works
+    without an initialized runtime, same contract as
+    ``list_requests``), the serve controller's census/broadcast checks
+    plus its per-replica RPC fan-out (best-effort: skipped when no
+    controller is running), and this process's routers diffed against
+    the controller census.  ``deep`` asks every engine for the full
+    partition/reachability walk; ``replica`` narrows the controller
+    fan-out to one replica id."""
+    from ray_tpu.serve import audit
+    from ray_tpu.util import doctor
+
+    reports: List[Dict[str, Any]] = []
+    audited: set = set()
+    census: Optional[Dict[str, List[str]]] = None
+    try:
+        from ray_tpu.core import api
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        controller = api.get_actor(CONTROLLER_NAME)
+        cluster = api.get(controller.doctor.remote(deep, replica))
+    except Exception:
+        cluster = None
+    if cluster is not None:
+        census = cluster.pop("census", None)
+        reports.extend(cluster.get("reports", ()))
+        # Replica engines live in this process under the local runtime;
+        # don't audit an engine twice when it already answered the
+        # controller fan-out.
+        audited = {r.get("proc") for r in reports}
+    for eng in audit.live_engines():
+        if eng.engine_id in audited:
+            continue
+        try:
+            reports.append(eng.doctor(deep=deep))
+        except Exception as e:
+            reports.append({"proc": eng.engine_id, "checks_run": 0,
+                            "violations": 0, "audit_seconds": 0.0,
+                            "checks": [], "error": repr(e)})
+    if census is not None:
+        census_by_key = {k: set(v) for k, v in census.items()}
+        reports.append(doctor.run_audit(
+            "driver",
+            [(audit.ROUTER_SYNC,
+              lambda: audit.router_sync_checks(census_by_key))],
+            deep=True))
+    return doctor.merge_reports(reports, deep=deep)
+
+
 def summarize_requests() -> Dict[str, Any]:
     """Request counts by lifecycle state and terminal cause (parity
     shape: `ray summary tasks`, one level up the stack)."""

@@ -735,6 +735,31 @@ def _check_autoscale_signals(name: str, d: Any,
                 f"reasons that never fired are omitted, not zero")
 
 
+def _check_doctor(name: str, d: Any, problems: List[str]) -> None:
+    """The chaos leg's post-ramp invariant audit (doctor): a deep
+    cross-plane consistency pass over every surviving engine after the
+    kills, replays and drain-downs settle.  checks_run must be >= 1 (an
+    audit that ran zero checks audited nothing) and violations must be
+    exactly 0 — a nonzero count means the chaos leg corrupted engine
+    state and the record is a failure regardless of goodput."""
+    if not isinstance(d, dict):
+        problems.append(f"{name}: not an object")
+        return
+    n = d.get("checks_run")
+    if not (isinstance(n, int) and not isinstance(n, bool) and n >= 1):
+        problems.append(f"{name}: checks_run={n!r} must be an int >= 1 "
+                        f"— a doctor pass that ran no checks audited "
+                        f"nothing")
+    v = d.get("violations")
+    if not (isinstance(v, int) and not isinstance(v, bool) and v == 0):
+        problems.append(f"{name}: violations={v!r} must be exactly 0 — "
+                        f"the chaos leg left corrupted engine state")
+    s = d.get("audit_seconds")
+    if not (_num(s) and s >= 0):
+        problems.append(f"{name}: audit_seconds={s!r} must be a "
+                        f"number >= 0")
+
+
 def _check_chaos(name: str, d: Any, problems: List[str]) -> None:
     """The autoscaling chaos leg (extra.serving_chaos): ramped+bursty
     zipf_chat arrival against an autoscaled deployment with the
@@ -792,6 +817,8 @@ def _check_chaos(name: str, d: Any, problems: List[str]) -> None:
                 f"{name}.scale_up_reasons: breakdown sums to "
                 f"{sum(sub.values())} but scale_ups={d['scale_ups']} — "
                 f"every up decision carries exactly one reason")
+    if "doctor" in d:
+        _check_doctor(f"{name}.doctor", d["doctor"], problems)
 
 
 def _check_mixed(name: str, d: Any, problems: List[str]) -> None:

@@ -57,6 +57,56 @@ def pytest_configure(config):
         "markers",
         "slow: heavy interpret-mode kernel tests, excluded from the "
         "tier-1 `-m 'not slow'` sweep (run explicitly with -m slow)")
+    config.addinivalue_line(
+        "markers",
+        "doctor_corrupt: test intentionally corrupts engine state "
+        "(RAYTPU_FAILPOINTS injectors) — skip the autouse deep-audit "
+        "teardown that would fail it")
+
+
+@pytest.fixture(autouse=True)
+def _doctor_teardown(request):
+    """Every LLMEngine a test creates gets a deep invariant audit on
+    teardown (util/doctor via serve/audit.live_engines): a test that
+    leaks a KV page, a trie ref or an adapter borrow fails HERE, at
+    the test that caused it, not three tests later when the pool runs
+    dry.  Pre-existing engines (session/module fixtures) are audited
+    by the test that created them only; crashed engines are skipped
+    (their state is arbitrarily torn); @pytest.mark.doctor_corrupt
+    opts intentional-corruption tests out."""
+    import sys
+
+    before = set()
+    if "ray_tpu.serve.audit" in sys.modules:
+        from ray_tpu.serve import audit
+
+        before = {e.engine_id for e in audit.live_engines()}
+    yield
+    if "ray_tpu.serve.llm_engine" not in sys.modules:
+        return
+    if request.node.get_closest_marker("doctor_corrupt"):
+        return
+    from ray_tpu.serve import audit
+
+    problems = []
+    for eng in audit.live_engines():
+        if eng.engine_id in before or getattr(eng, "_crashed", False):
+            continue
+        try:
+            rep = eng.doctor(deep=True)
+        except Exception:
+            # Wedged loop / shutdown race — not this test's verdict.
+            continue
+        for row in rep["checks"]:
+            for v in row["violations"]:
+                problems.append(
+                    f"{eng.engine_id}: {v['check']} [{v['severity']}] "
+                    f"{v['subject']}: expected {v['expected']!r}, "
+                    f"got {v['actual']!r}")
+    if problems:
+        pytest.fail(
+            "doctor: engine invariants violated after test:\n  "
+            + "\n  ".join(problems), pytrace=False)
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):

@@ -549,7 +549,9 @@ def _chaos_block():
             "shed": 3, "failed": 0, "shed_fraction": 0.125,
             "goodput_ratio": 1.0, "scale_ups": 1, "scale_downs": 1,
             "drain_retirements": 2, "kills": 1, "max_groups": 3,
-            "max_replicas": 3, "gen": 10}
+            "max_replicas": 3, "gen": 10,
+            "doctor": {"checks_run": 14, "violations": 0,
+                       "audit_seconds": 0.02}}
 
 
 def test_chaos_block_valid(schema):
@@ -584,6 +586,27 @@ def test_chaos_leg_must_exercise_the_policy(schema):
     assert any("scale_ups=0" in p and "static fleet" in p for p in probs)
     assert any("scale_downs=0" in p and "drain" in p for p in probs)
     assert any("kills=0" in p for p in probs)
+
+
+def test_chaos_doctor_requires_clean_audit(schema):
+    """The post-ramp doctor audit gates the record: any violation, or
+    a pass that ran zero checks, flags the leg no matter how healthy
+    its goodput looks.  A legacy block without a doctor key stays
+    valid (old records predate the audit plane)."""
+    rec = _record()
+    blk = _chaos_block()
+    blk["doctor"] = {"checks_run": 0, "violations": 2,
+                     "audit_seconds": -1.0}
+    rec["extra"]["serving_chaos"] = blk
+    probs = schema.validate_record(rec)
+    assert any("checks_run=0" in p and "audited" in p for p in probs)
+    assert any("violations=2" in p and "corrupted" in p for p in probs)
+    assert any("audit_seconds=-1.0" in p for p in probs)
+    blk["doctor"] = "clean"
+    assert any("not an object" in p
+               for p in schema.validate_record(rec))
+    del blk["doctor"]
+    assert schema.validate_record(rec) == []
 
 
 def test_chaos_sheds_are_not_completions(schema):

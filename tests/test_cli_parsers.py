@@ -73,6 +73,66 @@ def test_top_parser_defaults():
     assert args.window == pytest.approx(30.0)
 
 
+def test_doctor_parser_defaults():
+    args = build_parser().parse_args(["doctor"])
+    assert args.cmd == "doctor"
+    assert args.deep is False
+    assert args.replica == ""
+    args = build_parser().parse_args(
+        ["doctor", "--deep", "--replica", "app#dep#0"])
+    assert args.deep is True
+    assert args.replica == "app#dep#0"
+
+
+def test_format_doctor_is_deterministic():
+    """format_doctor is pure: a static report renders byte-for-byte —
+    sorted (proc, check) rows, sorted detail lines, unreachable
+    fan-out entries as error rows."""
+    from ray_tpu.scripts.cli import format_doctor
+
+    report = {
+        "deep": True, "checks_run": 3, "violations": 1,
+        "reports": [
+            {"proc": "engine:ab12", "checks": [
+                {"check": "kv.pool_partition", "tier": "deep",
+                 "status": "ok", "violations": []},
+                {"check": "kv.trie_integrity", "tier": "deep",
+                 "status": "violated", "violations": [
+                     {"check": "kv.trie_integrity",
+                      "severity": "error", "subject": "page:7",
+                      "expected": 1, "actual": 2}]},
+            ]},
+            {"proc": "controller", "checks": [
+                {"check": "controller.census_broadcast",
+                 "tier": "deep", "status": "ok", "violations": []}]},
+            {"proc": "rep:gone", "error": "RuntimeError('dead')",
+             "checks": []},
+        ],
+    }
+    expected = (
+        "doctor: 3 proc(s), 3 check(s), 1 violation(s)  [deep]\n"
+        "proc         check                        tier  status    "
+        "violations          \n"
+        "-----------------------------------------------------------"
+        "-------------------\n"
+        "controller   controller.census_broadcast  deep  ok        "
+        "0                   \n"
+        "engine:ab12  kv.pool_partition            deep  ok        "
+        "0                   \n"
+        "engine:ab12  kv.trie_integrity            deep  violated  "
+        "1                   \n"
+        "rep:gone     (unreachable)                -     error     "
+        "RuntimeError('dead')\n"
+        "engine:ab12  kv.trie_integrity  [error]  page:7: "
+        "expected 1, got 2")
+    assert format_doctor(report) == expected
+    assert format_doctor(report) == expected  # pure: same bytes again
+    assert format_doctor({"checks_run": 0, "violations": 0,
+                          "reports": []}) == (
+        "doctor: 0 proc(s), 0 check(s), 0 violation(s)\n"
+        "(no checks ran — no engines or controller found)")
+
+
 def test_unknown_command_exits_nonzero(capsys):
     with pytest.raises(SystemExit) as ei:
         build_parser().parse_args(["definitely-not-a-command"])

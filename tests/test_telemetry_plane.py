@@ -303,7 +303,16 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
                  "raytpu_serve_spec_rounds_total",
                  "raytpu_serve_spec_drafted_tokens_total",
                  "raytpu_serve_spec_accepted_tokens_total",
-                 "raytpu_serve_spec_accept_ratio"]) == []
+                 "raytpu_serve_spec_accept_ratio",
+                 # Invariant audit plane (util/doctor): violation and
+                 # audit counters + last-audit gauges, declared with
+                 # the engine telemetry so a scrape always shows the
+                 # doctor families even before any audit runs.
+                 "raytpu_doctor_violations_total",
+                 "raytpu_doctor_audits_total",
+                 "raytpu_doctor_last_audit_violations",
+                 "raytpu_doctor_last_audit_checks",
+                 "raytpu_doctor_last_audit_seconds"]) == []
     assert cm.check_registry() == []
 
 
