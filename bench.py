@@ -905,7 +905,12 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
     schema (bench_schema._check_chaos): the run must show at least one
     scale-up, at least one drain-based scale-down after the ramp ends,
     and at least one replica killed mid-traffic — otherwise the leg
-    measured a static fleet on a sunny day.  Sheds (admission-control
+    measured a static fleet on a sunny day.  One wave after the
+    replica kill the CONTROLLER itself is hard-killed: the routers
+    keep serving on their last broadcast while a replacement rebuilds
+    from the checkpoint, and the record carries controller_kills plus
+    the measured recovery_seconds (kill -> new controller actor
+    answering status).  Sheds (admission-control
     refusals once the queue is over the SLO budget) are counted
     separately from goodput: nothing ran, so nothing failed."""
     import re as _re
@@ -920,8 +925,9 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
         LLMServer,
         llama_paged_adapter,
     )
+    from ray_tpu.serve.controller import CONTROLLER_NAME
     from ray_tpu.util import metrics as _metrics
-    from ray_tpu.utils.test_utils import ReplicaKiller
+    from ray_tpu.utils.test_utils import ReplicaKiller, kill_actor_hard
 
     if params is None:
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
@@ -966,6 +972,8 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
     lock = threading.Lock()
     max_groups = 0
     kills = 0
+    controller_kills = 0
+    recovery_seconds = None
     try:
         ups0 = metric("raytpu_serve_autoscale_decisions_total",
                       'direction="up"')
@@ -1042,6 +1050,29 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
             if kills == 0 and len(killer.victims()) >= 2:
                 if killer.kill_one() is not None:
                     kills += 1
+            # Control-plane chaos arm: one wave after the replica kill,
+            # SIGKILL the controller itself mid-ramp.  The data plane
+            # must keep serving on the last-known routing table while a
+            # replacement controller rebuilds from its checkpoint;
+            # recovery_seconds is kill -> a NEW controller actor (fresh
+            # actor id, bumped epoch) answering status().
+            elif kills >= 1 and controller_kills == 0:
+                old = api.get_actor(CONTROLLER_NAME)
+                t_kill = time.monotonic()
+                kill_actor_hard(api.runtime(), old._actor_id)
+                controller_kills += 1
+                deadline_ctl = time.monotonic() + 60
+                while time.monotonic() < deadline_ctl:
+                    try:
+                        fresh = serve._get_or_create_controller()
+                        if fresh._actor_id != old._actor_id:
+                            api.get(fresh.status.remote(), timeout=5.0)
+                            recovery_seconds = round(
+                                time.monotonic() - t_kill, 4)
+                            break
+                    except Exception:
+                        pass
+                    time.sleep(0.05)
         for th in threads:
             th.join(timeout=300)
         # Ramp over: wait for the policy to drain the extra groups
@@ -1104,6 +1135,8 @@ def _measure_serving_chaos(cfg, *, n_waves: int = 4, wave_size: int = 10,
         "scale_downs": int(downs),
         "drain_retirements": int(drains),
         "kills": kills,
+        "controller_kills": controller_kills,
+        "recovery_seconds": recovery_seconds,
         "max_groups": max_groups,
         "max_replicas": max_replicas,
         "gen": gen,

@@ -169,6 +169,57 @@ class MirroredStore(StoreClient):
                           for s in [self.primary] + self.mirrors)
 
 
+class KvStoreClient(StoreClient):
+    """Snapshot blob stored as one pickled value in the cluster KV.
+
+    The runtime KV lives on the driver's runtime instance, so it
+    survives any ACTOR's death (the serve controller checkpoints through
+    this), and it is itself disk-persisted by :class:`GcsPersistence`
+    when ``gcs_persist_path`` is configured — a checkpoint written here
+    inherits whatever durability tier the cluster's GCS storage has.
+    Unlike :class:`FileStore`, a present-but-unreadable blob is reported
+    loudly: the value existed, so silence would hide corruption.
+    """
+
+    def __init__(self, kv, namespace: str = "serve",
+                 key: bytes = b"controller::checkpoint"):
+        self._kv = kv
+        self.namespace = namespace
+        self.key = key if isinstance(key, bytes) else key.encode()
+
+    def _warn(self, why: str) -> None:
+        import logging
+
+        logging.getLogger("ray_tpu.gcs").warning(
+            "GCS store %s holds an unreadable snapshot (%s) — treating "
+            "it as absent", self.describe(), why)
+
+    def load_blob(self) -> Optional[Dict[str, Any]]:
+        raw = self._kv.get(self.key, namespace=self.namespace)
+        if raw is None:
+            return None
+        try:
+            blob = pickle.loads(raw)
+        except Exception as e:
+            self._warn(f"corrupt pickle: {e!r}")
+            return None
+        if not isinstance(blob, dict):
+            self._warn(f"not a snapshot dict: {type(blob).__name__}")
+            return None
+        if blob.get("version") != _FORMAT_VERSION:
+            self._warn(f"format version {blob.get('version')!r} != "
+                       f"{_FORMAT_VERSION}")
+            return None
+        return blob
+
+    def save_blob(self, blob: Dict[str, Any]) -> None:
+        self._kv.put(self.key, pickle.dumps(blob),
+                     namespace=self.namespace)
+
+    def describe(self) -> str:
+        return f"kv:{self.namespace}/{self.key.decode(errors='replace')}"
+
+
 def make_store(path: str, mirror_paths: Sequence[str] = ()) -> StoreClient:
     """Store from config strings (parity: gcs_server.cc:517-518
     choosing the storage backend from flags)."""
@@ -183,8 +234,13 @@ class GcsPersistence:
     """Snapshot + dirty-flag flusher thread over a StoreClient."""
 
     def __init__(self, path: str, flush_period_s: float = 0.2,
-                 mirror_paths: Sequence[str] = ()):
-        self.store = make_store(path, mirror_paths)
+                 mirror_paths: Sequence[str] = (),
+                 store: Optional[StoreClient] = None):
+        # An explicit store (e.g. KvStoreClient, or a MirroredStore over
+        # one) bypasses path-based construction — the serve controller's
+        # checkpointer reuses this flusher over the cluster KV.
+        self.store = store if store is not None \
+            else make_store(path, mirror_paths)
         self.path = path
         self._period = flush_period_s
         self._dirty = threading.Event()

@@ -17,7 +17,8 @@ metrics):
                                  (state.list_requests; ?limit=)
   GET /api/v0/replicas           serve replicas with disagg role
                                  (prefill|decode|unified), shard-group
-                                 mesh shape and membership
+                                 mesh shape and membership, plus the
+                                 controller epoch + last-recovery time
                                  (state.list_replicas; ?limit=)
   GET /api/v0/requests/summarize request counts by lifecycle state and
                                  terminal cause

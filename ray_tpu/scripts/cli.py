@@ -87,7 +87,8 @@ _LIST_ROUTES = {
     "replicas": ("/api/v0/replicas",
                  ["app", "deployment", "replica_id", "state", "role",
                   "shard_group", "mesh_shape", "members",
-                  "target_groups", "actual_groups", "autoscale"]),
+                  "target_groups", "actual_groups", "autoscale",
+                  "ctl_epoch", "last_recovery"]),
 }
 
 

@@ -51,9 +51,15 @@ def _get_or_create_controller():
     if not _api.is_initialized():
         _api.init(ignore_reinit_error=True)
     cls = _api.remote(ServeController)
+    # Crash-recoverable control plane: max_restarts covers in-place
+    # actor restarts, and a controller that died outright (hard kill,
+    # restarts exhausted) is recreated HERE as a fresh actor — either
+    # way __init__ reloads the persisted checkpoint, re-censuses the
+    # fleet and rebroadcasts before serving, so callers of this
+    # function always get a controller that reflects reality.
     return cls.options(
         name=CONTROLLER_NAME, get_if_exists=True, lifetime="detached",
-        num_cpus=0, max_concurrency=32,
+        num_cpus=0, max_concurrency=32, max_restarts=3,
     ).remote()
 
 

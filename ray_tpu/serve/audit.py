@@ -45,9 +45,13 @@ from ray_tpu.util.doctor import InvariantViolation
 #                              release (draft-pool leak)
 #   doctor.broadcast_desync  - drop one row from a controller
 #                              broadcast (census/table drift)
+#   doctor.stale_checkpoint  - drop one replica row from a controller
+#                              checkpoint write (checkpoint/census
+#                              drift a recovery would act on)
 INJECT_TRIE_REF = "doctor.leak_trie_ref"
 INJECT_DRAFT_PAGE = "doctor.leak_draft_page"
 INJECT_BROADCAST = "doctor.broadcast_desync"
+INJECT_STALE_CHECKPOINT = "doctor.stale_checkpoint"
 
 
 def corrupt(name: str) -> bool:
@@ -146,6 +150,12 @@ ROUTER_SYNC = doctor.register_check(
     "Each live router's replica table names exactly the RUNNING and "
     "DRAINING replicas the controller census holds for its "
     "deployment.")
+CHECKPOINT_CENSUS = doctor.register_check(
+    "controller.checkpoint_census", 1, doctor.DEEP, "warning",
+    "The persisted controller checkpoint (flushed, then read back "
+    "through the store) names exactly the live RUNNING/DRAINING "
+    "census replicas with matching states — what a recovery would "
+    "adopt is what actually exists.")
 
 
 class EngineAuditor:
@@ -559,6 +569,55 @@ def census_broadcast_checks(
                 f"{key}/{rid}",
                 expected=f"draining flag {bool(census[rid])}",
                 actual=bool(table[rid])))
+    return out
+
+
+def checkpoint_census_checks(
+        key: str, census_rows: List[Tuple[str, bool]],
+        ckpt_states: Optional[Dict[str, str]],
+        ckpt_error: Optional[str] = None
+) -> List[InvariantViolation]:
+    """Compare one deployment's live census (``(replica_id, draining)``
+    for RUNNING/DRAINING replicas) against the replica states its
+    freshly-flushed, read-back checkpoint holds (``ckpt_states``:
+    replica_id -> state for the same tiers; None = the deployment is
+    missing from the checkpoint).  ``ckpt_error`` reports a checkpoint
+    that could not be written or read back at all — severity error,
+    because a crash right now would lose the control plane."""
+    out: List[InvariantViolation] = []
+    if ckpt_error is not None:
+        out.append(InvariantViolation(
+            "controller.checkpoint_census", "error", key,
+            expected="checkpoint flushed and readable",
+            actual=ckpt_error))
+        return out
+    if ckpt_states is None:
+        out.append(InvariantViolation(
+            "controller.checkpoint_census", "warning", key,
+            expected="deployment present in checkpoint",
+            actual="missing"))
+        return out
+    census = {rid: ("DRAINING" if draining else "RUNNING")
+              for rid, draining in census_rows}
+    for rid in sorted(set(census) - set(ckpt_states)):
+        out.append(InvariantViolation(
+            "controller.checkpoint_census", "warning",
+            f"{key}/{rid}",
+            expected="census replica present in checkpoint",
+            actual="missing row"))
+    for rid in sorted(set(ckpt_states) - set(census)):
+        out.append(InvariantViolation(
+            "controller.checkpoint_census", "warning",
+            f"{key}/{rid}",
+            expected="checkpointed replica backed by a census replica",
+            actual="phantom row"))
+    for rid in sorted(set(census) & set(ckpt_states)):
+        if census[rid] != ckpt_states[rid]:
+            out.append(InvariantViolation(
+                "controller.checkpoint_census", "warning",
+                f"{key}/{rid}",
+                expected=f"checkpointed state {census[rid]}",
+                actual=ckpt_states[rid]))
     return out
 
 

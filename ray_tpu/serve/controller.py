@@ -32,6 +32,15 @@ ROUTES_KEY = "routes"
 
 RECONCILE_PERIOD_S = 0.05
 
+# Controller-checkpoint blob: layout version INSIDE the GCS snapshot
+# envelope (which carries its own format version + monotonic seq), and
+# the cluster-KV slot it persists through.  The KV lives on the driver
+# runtime, so it survives the controller ACTOR's death — and inherits
+# disk durability when gcs_persist_path is configured.
+CKPT_VERSION = 1
+CKPT_NAMESPACE = "serve"
+CKPT_KEY = b"controller::checkpoint"
+
 _TELEMETRY = None
 
 
@@ -83,6 +92,36 @@ def _telemetry():
                 "deployment — lags the target while replicas start "
                 "or drain.",
                 tag_keys=("deployment",),
+            ),
+            "restarts": metrics.Counter(
+                "raytpu_serve_controller_restarts_total",
+                "Controller recoveries: a replacement controller "
+                "adopted a previous epoch's state from the persisted "
+                "checkpoint after the controller actor died.",
+            ),
+            "ckpt_seq": metrics.Gauge(
+                "raytpu_serve_controller_checkpoint_seq",
+                "Monotonic save counter of the controller checkpoint "
+                "(resumed across controller generations, so it never "
+                "regresses).",
+            ),
+            "ckpt_age": metrics.Gauge(
+                "raytpu_serve_controller_checkpoint_age_seconds",
+                "Seconds since the controller checkpoint was last "
+                "persisted — climbing under traffic means the "
+                "checkpointer is wedged and a crash would lose state.",
+            ),
+            "orphans_adopted": metrics.Counter(
+                "raytpu_serve_orphans_adopted_total",
+                "Checkpointed replicas found alive at controller "
+                "recovery and adopted back into the census.",
+            ),
+            "orphans_killed": metrics.Counter(
+                "raytpu_serve_orphans_killed_total",
+                "Live replica actors from a previous controller epoch "
+                "with no checkpoint record, hard-killed at recovery "
+                "(they are invisible to reconciliation and would leak "
+                "forever).",
             ),
         }
     else:
@@ -263,17 +302,26 @@ class _DeploymentState:
             return None
         cutoff = now - cfg.look_back_period_s
         total = 0.0
+        fresh = 0
         worst_age = 0.0
         worst_goodput: Optional[float] = None
         for r in running:
             m = self.metrics.get(r.replica_id)
             if m is not None and m[0] >= cutoff:
+                fresh += 1
                 total += m[1]
                 if len(m) > 2 and m[2]:
                     worst_age = max(worst_age, m[2])
                 if len(m) > 3 and m[3] is not None:
                     worst_goodput = (m[3] if worst_goodput is None
                                      else min(worst_goodput, m[3]))
+        if fresh == 0:
+            # No live signal at all — e.g. right after a controller
+            # recovery, before the adopted fleet's first metric push.
+            # Make NO decision (and leave any restored intent armed)
+            # rather than sizing a busy fleet from an empty window,
+            # which would read as "scale to min".
+            return None
         desired = math.ceil(total / cfg.target_ongoing_requests)
         reason = "ongoing"
         pressure = False
@@ -346,9 +394,311 @@ class ServeController:
         self._tm = _telemetry()
         self._reconcile_errors_seen: set = set()
         self._shutdown = threading.Event()
+        # Crash recovery (the paper's durable-GCS keystone applied to
+        # the serve control plane): every state mutation checkpoints
+        # through the GCS StoreClient machinery, and a replacement
+        # controller rebuilds itself from that checkpoint — re-census,
+        # adoption, orphan sweep, rebroadcast — BEFORE the reconcile
+        # loop starts, so routers only ever see tables that reflect a
+        # verified fleet.  The epoch increments per generation; it
+        # rides on every long_poll response so clients detect the
+        # replacement and full-resync their snapshot ids.
+        self._epoch = 1
+        self._last_recovery = 0.0  # wall ts of last recovery (0 = never)
+        self._last_ckpt_wall = 0.0
+        self._self_actor_id = None  # resolved lazily by _fenced()
+        self._ckpt = self._make_checkpointer()
+        self._recover()
+        # Persist the adopted state SYNCHRONOUSLY before serving: a
+        # second crash inside the first debounce window would otherwise
+        # recover from the previous generation's blob and reuse its
+        # epoch — and an epoch collision means long-poll clients never
+        # detect the replacement.
+        try:
+            with self._ckpt._save_lock:
+                self._ckpt.save(self._checkpoint_tables())
+        except Exception:
+            pass
+        self._ckpt.start_flusher(self._checkpoint_tables)
         threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"
         ).start()
+
+    # -- checkpointing -----------------------------------------------------
+
+    def _fenced(self) -> bool:
+        """True once this instance's actor shell has died.  A hard kill
+        on a thread-mode actor cannot stop the instance's OWN daemon
+        threads (reconcile loop, checkpoint flusher), so they check
+        this fence and stand down — without it a SIGKILLed controller
+        generation would keep mutating replicas and overwrite its
+        successor's checkpoint.  Local (non-actor) instances never find
+        a shell and never fence."""
+        try:
+            rt = api.runtime()
+            if self._self_actor_id is None:
+                for aid, shell in list(rt._actors.items()):
+                    if shell.instance is self:
+                        self._self_actor_id = aid
+                        return False
+                return False
+            shell = rt._actors.get(self._self_actor_id)
+            return shell is None or shell.dead
+        except Exception:
+            return False
+
+    def _make_checkpointer(self):
+        from ray_tpu.core.gcs_persistence import (
+            FileStore,
+            GcsPersistence,
+            KvStoreClient,
+            MirroredStore,
+        )
+        from ray_tpu.utils.config import get_config
+
+        cfg = get_config()
+        primary = KvStoreClient(api.runtime().kv, namespace=CKPT_NAMESPACE,
+                                key=CKPT_KEY)
+        mirrors = [FileStore(p.strip())
+                   for p in cfg.serve_checkpoint_mirrors.split(",")
+                   if p.strip()]
+        store = MirroredStore(primary, mirrors) if mirrors else primary
+        return GcsPersistence("", cfg.serve_checkpoint_flush_period_s,
+                              store=store)
+
+    def _checkpoint_tables(self) -> Dict[str, Any]:
+        """Collect one checkpoint under the lock.  Plain-picklable end
+        to end: DeploymentInfo (arbitrary user callables) rides as a
+        cloudpickle sub-blob; actor handles, object refs and placement
+        groups reduce to their ids.  Replica metrics are deliberately
+        NOT persisted — a recovered autoscaler must size from live
+        pushes, never from a dead generation's window."""
+        import cloudpickle as _cp
+
+        from ray_tpu.serve import audit as _audit
+
+        if self._fenced():
+            # Dead generation: refuse to collect, so the (best-effort)
+            # flusher can never clobber the replacement controller's
+            # checkpoint with this epoch's stale tables.
+            raise RuntimeError("controller generation is fenced")
+        with self._lock:
+            deployments = []
+            for (app, dep), st in sorted(self._deployments.items()):
+                reps = []
+                for rid in sorted(st.replicas):
+                    r = st.replicas[rid]
+                    reps.append({
+                        "replica_id": rid,
+                        "state": r.state,
+                        "role": r.role,
+                        "mesh_shape": r.mesh_shape,
+                        "prefix_summary": r.prefix_summary,
+                        "adapter_summary": r.adapter_summary,
+                        "handle": r.handle,
+                        # Only STARTING replicas need their creation
+                        # ref back (recovery re-polls it); dropping the
+                        # rest keeps resolved results out of the blob.
+                        "creation_ref": (r.creation_ref
+                                         if r.state == "STARTING"
+                                         else None),
+                        "members": list(r.members),
+                        "pg": r.pg,
+                    })
+                if reps and _audit.corrupt(_audit.INJECT_STALE_CHECKPOINT):
+                    reps = reps[:-1]  # checkpoint↔census drift
+                intent = st._scale_intent
+                deployments.append({
+                    "app": app,
+                    "name": dep,
+                    "info": _cp.dumps(st.info),
+                    "target_replicas": st.target_replicas,
+                    "next_replica_idx": st.next_replica_idx,
+                    "deleting": st.deleting,
+                    "scale_intent_desired": (intent[0]
+                                             if intent is not None
+                                             else None),
+                    "last_decision": (dict(st.last_decision)
+                                      if st.last_decision else None),
+                    "replicas": reps,
+                })
+            tables = {
+                "ckpt_version": CKPT_VERSION,
+                "epoch": self._epoch,
+                "saved_at": time.time(),
+                "deployments": deployments,
+                "routes": dict(self._routes),
+                "app_ingress": dict(self._app_ingress),
+            }
+        self._last_ckpt_wall = tables["saved_at"]
+        return tables
+
+    def _recover(self) -> None:
+        """Rebuild state from the persisted checkpoint, if any: ping
+        every checkpointed replica, adopt the live ones (DRAINING ones
+        resume draining), drop unreachable ones onto the existing
+        replacement path, hard-kill live replica actors the checkpoint
+        has no record of, then rebroadcast routes + tables."""
+        try:
+            tables = self._ckpt.load()
+        except Exception as e:
+            log.warning("controller checkpoint unreadable (%r) — "
+                        "starting fresh", e)
+            return
+        if not tables:
+            return
+        if tables.get("ckpt_version") != CKPT_VERSION:
+            log.warning("controller checkpoint has unknown layout "
+                        "version %r — starting fresh",
+                        tables.get("ckpt_version"))
+            return
+        if tables.get("clean_shutdown"):
+            # The previous generation exited deliberately (serve
+            # shutdown): nothing to recover, keep only epoch continuity.
+            self._epoch = int(tables.get("epoch", 0)) + 1
+            return
+        import cloudpickle as _cp
+
+        self._epoch = int(tables.get("epoch", 0)) + 1
+        self._last_recovery = time.time()
+        now = time.monotonic()
+        self._routes = dict(tables.get("routes") or {})
+        self._app_ingress = dict(tables.get("app_ingress") or {})
+        pings = []
+        for d in tables.get("deployments") or ():
+            try:
+                info = _cp.loads(d["info"])
+            except Exception as e:
+                log.error("checkpointed deployment %s/%s is "
+                          "unrecoverable (%r) — dropping it",
+                          d.get("app"), d.get("name"), e)
+                continue
+            st = _DeploymentState(d["app"], info)
+            st.target_replicas = int(d["target_replicas"])
+            st.next_replica_idx = int(d["next_replica_idx"])
+            st.deleting = bool(d["deleting"])
+            if d.get("last_decision"):
+                st.last_decision = dict(d["last_decision"])
+            desired = d.get("scale_intent_desired")
+            if (desired is not None
+                    and st.config.autoscaling_config is not None):
+                # Restart the intent timer from NOW: the fleet was just
+                # re-censused, so letting a pre-crash countdown expire
+                # immediately would fire a spurious scale event off a
+                # dead generation's signals.
+                st._scale_intent = (int(desired), now)
+            self._deployments[(d["app"], d["name"])] = st
+            for rd in d.get("replicas") or ():
+                if rd.get("handle") is None:
+                    continue
+                ref = None
+                # STARTING replicas may still be in __init__ (a ping
+                # would queue behind it) — adopt them unpinged; their
+                # creation ref resolves through _check_started exactly
+                # as before the crash.  STOPPING ones are adopted
+                # unpinged too: the stop path is idempotent.
+                if rd["state"] in ("RUNNING", "DRAINING"):
+                    try:
+                        ref = rd["handle"].check_health.remote()
+                    except Exception:
+                        ref = None
+                pings.append((st, rd, ref))
+        adopted = 0
+        adopted_ids = set()
+        # Resolve the census pings only after ALL were fired — they
+        # settle concurrently on the replicas' own actor threads.
+        for st, rd, ref in pings:
+            rid = rd["replica_id"]
+            if rd["state"] in ("RUNNING", "DRAINING"):
+                alive = False
+                if ref is not None:
+                    try:
+                        api.get(ref, timeout=5.0)
+                        alive = True
+                    except Exception:
+                        alive = False
+                if not alive:
+                    # Not adopted: the reconcile loop sees live <
+                    # target and starts a replacement — the existing
+                    # replica-death path.
+                    log.warning("recovery: checkpointed replica %s is "
+                                "unreachable — replacing it", rid)
+                    continue
+            r = _Replica(rid, rd["handle"], rd.get("creation_ref"))
+            r.state = rd["state"]
+            r.role = rd.get("role", "unified")
+            r.mesh_shape = rd.get("mesh_shape", "")
+            r.prefix_summary = rd.get("prefix_summary")
+            r.adapter_summary = rd.get("adapter_summary")
+            r.members = list(rd.get("members") or ())
+            r.pg = rd.get("pg")
+            r.last_health_check = now
+            if r.state == "DRAINING":
+                # Resume draining with a re-armed deadline (the drain
+                # RPC was already delivered by the previous epoch).
+                r.drain_deadline = (
+                    now + st.config.graceful_shutdown_timeout_s + 30.0)
+            st.replicas[rid] = r
+            adopted_ids.add(r.handle._actor_id)
+            for _rank, m in r.members:
+                adopted_ids.add(m._actor_id)
+            if r.state in ("RUNNING", "DRAINING", "STARTING"):
+                adopted += 1
+        killed = self._kill_stale_orphans(adopted_ids)
+        # Rebuild + rebroadcast the full routing surface BEFORE the
+        # reconcile loop starts: a router that resyncs against this
+        # epoch must never observe an empty table.
+        self._host.notify_changed(ROUTES_KEY, dict(self._routes))
+        for st in self._deployments.values():
+            self._broadcast(st)
+        self._tm["restarts"].inc()
+        if adopted:
+            self._tm["orphans_adopted"].inc(adopted)
+        if killed:
+            self._tm["orphans_killed"].inc(killed)
+        log.warning(
+            "serve controller recovered from checkpoint: epoch=%d, "
+            "%d deployment(s), %d replica(s) adopted, %d orphan(s) "
+            "killed", self._epoch, len(self._deployments), adopted,
+            killed)
+        try:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.trigger(
+                "controller_recovery", detail=f"epoch={self._epoch}",
+                adopted=adopted, orphans_killed=killed)
+        except Exception:
+            pass
+
+    def _kill_stale_orphans(self, adopted_ids) -> int:
+        """Hard-kill live replica/shard-member actors from the previous
+        controller generation that the checkpoint has no record of
+        (started inside the last flush window, or rows lost to a stale
+        checkpoint copy).  They are invisible to reconciliation — left
+        alone they would hold chips forever."""
+        from ray_tpu.utils.test_utils import kill_actor_hard
+
+        rt = api.runtime()
+        killed = 0
+        try:
+            shells = list(rt._actors.items())
+        except Exception:
+            return 0
+        for actor_id, shell in shells:
+            try:
+                if shell.dead or shell.cls.__name__ not in (
+                        "ReplicaActor", "ShardMemberActor"):
+                    continue
+            except Exception:
+                continue
+            if actor_id in adopted_ids:
+                continue
+            try:
+                kill_actor_hard(rt, actor_id)
+                killed += 1
+            except Exception:
+                pass
+        return killed
 
     # -- API ---------------------------------------------------------------
 
@@ -377,6 +727,7 @@ class ServeController:
                     app_name, self._app_ingress[app_name]
                 )
                 self._host.notify_changed(ROUTES_KEY, dict(self._routes))
+            self._ckpt.mark_dirty()
 
     def delete_application(self, app_name: str) -> None:
         with self._lock:
@@ -388,6 +739,7 @@ class ServeController:
                 p: t for p, t in self._routes.items() if t[0] != app_name
             }
             self._host.notify_changed(ROUTES_KEY, dict(self._routes))
+            self._ckpt.mark_dirty()
 
     def get_ingress(self, app_name: str) -> str:
         with self._lock:
@@ -401,7 +753,12 @@ class ServeController:
         # (The reference blocks in an asyncio handler, which holds no
         # thread; here a blocking listen would pin one controller pool
         # thread per subscriber, starving control RPCs at scale.)
-        return self._host.listen(keys_to_ids, timeout=0.0)
+        # The epoch rides on every response: a replacement controller's
+        # snapshot ids restart at 1, so a client holding the previous
+        # generation's large `seen` values would filter every update
+        # forever — seeing the epoch move tells it to full-resync.
+        return {"epoch": self._epoch,
+                "updates": self._host.listen(keys_to_ids, timeout=0.0)}
 
     def record_autoscaling_metric(self, app_name: str, deployment_name: str,
                                   replica_id: str, ongoing: float,
@@ -461,9 +818,14 @@ class ServeController:
         one row per replica, deterministic order (app, deployment,
         replica id).  Shard-group replicas carry their mesh shape
         ("dcn_tp=S x tp=T") and group membership (rank:actor pairs,
-        rank 0 = the replica actor itself)."""
+        rank 0 = the replica actor itself).  Every row carries the
+        controller epoch + last-recovery wall time so an operator can
+        see at a glance whether this fleet survived a control-plane
+        crash (stable across calls — the determinism tests pin it)."""
         rows: List[Dict[str, Any]] = []
         with self._lock:
+            last_recovery = (round(self._last_recovery, 3)
+                             if self._last_recovery else "")
             for (app, dep), st in sorted(self._deployments.items()):
                 actual = sum(1 for r in st.replicas.values()
                              if r.state == "RUNNING")
@@ -496,6 +858,8 @@ class ServeController:
                         "target_groups": st.target_replicas,
                         "actual_groups": actual,
                         "autoscale": autoscale,
+                        "ctl_epoch": self._epoch,
+                        "last_recovery": last_recovery,
                     })
         return rows
 
@@ -594,6 +958,27 @@ class ServeController:
         work: List[Tuple[str, Any]] = []
         census_by_key: Dict[str, List[str]] = {}
         with self._lock:
+            # checkpoint↔census: flush the pending state synchronously,
+            # read the persisted copy back through the store, and diff
+            # it against the live census — catching a wedged or
+            # corrupted checkpointer (the doctor.stale_checkpoint
+            # injector drops a row to prove detection).  Under the same
+            # lock as the census snapshot so the reconcile loop can't
+            # move the fleet between the two reads.
+            ckpt_rows: Dict[str, Dict[str, str]] = {}
+            ckpt_err: Optional[str] = None
+            try:
+                with self._ckpt._save_lock:
+                    self._ckpt.save(self._checkpoint_tables())
+                blob = self._ckpt.store.load_blob()
+                tables = (blob or {}).get("tables") or {}
+                for d in tables.get("deployments") or ():
+                    ckpt_rows[f"{d['app']}/{d['name']}"] = {
+                        rd["replica_id"]: rd["state"]
+                        for rd in d.get("replicas") or ()
+                        if rd["state"] in ("RUNNING", "DRAINING")}
+            except Exception as e:
+                ckpt_err = repr(e)
             for (app, dep), st in sorted(self._deployments.items()):
                 key = f"{app}/{dep}"
                 census = [(rid, st.replicas[rid].state == "DRAINING")
@@ -605,6 +990,10 @@ class ServeController:
                 fns.append((_audit.CENSUS_BROADCAST,
                             lambda k=key, c=census, t=last:
                             _audit.census_broadcast_checks(k, c, t)))
+                fns.append((_audit.CHECKPOINT_CENSUS,
+                            lambda k=key, c=census,
+                            p=ckpt_rows.get(key), e=ckpt_err:
+                            _audit.checkpoint_census_checks(k, c, p, e)))
                 for rid, _draining in census:
                     if replica_id is not None and rid != replica_id:
                         continue
@@ -654,6 +1043,7 @@ class ServeController:
             for st in self._deployments.values():
                 st.deleting = True
                 st.target_replicas = 0
+            self._ckpt.mark_dirty()
 
     def _num_live(self) -> int:
         with self._lock:
@@ -669,13 +1059,42 @@ class ServeController:
 
     def stop_reconcile(self) -> None:
         """Stop the reconcile thread; called right before the controller
-        actor is killed so no orphan loop keeps mutating state."""
+        actor is killed so no orphan loop keeps mutating state.  Also
+        writes a clean-shutdown tombstone over the checkpoint: a
+        DELIBERATE teardown must not be recovered from — the next
+        controller generation starts fresh (keeping only epoch
+        continuity) instead of resurrecting the torn-down app."""
         self._shutdown.set()
+        try:
+            self._ckpt.close(final_flush=False)
+            with self._ckpt._save_lock:
+                self._ckpt.save({
+                    "ckpt_version": CKPT_VERSION,
+                    "epoch": self._epoch,
+                    "clean_shutdown": True,
+                    "deployments": [],
+                    "routes": {},
+                    "app_ingress": {},
+                })
+        except Exception:
+            pass
 
     # -- reconcile ---------------------------------------------------------
 
     def _reconcile_loop(self):
         while not self._shutdown.wait(RECONCILE_PERIOD_S):
+            if self._fenced():
+                # This generation's actor was hard-killed: stop
+                # reconciling (a replacement controller owns the fleet
+                # now) and stop the checkpoint flusher, WITHOUT the
+                # clean-shutdown tombstone — the successor must
+                # recover, not start fresh.
+                self._shutdown.set()
+                try:
+                    self._ckpt.close(final_flush=False)
+                except Exception:
+                    pass
+                return
             try:
                 self._reconcile_once()
             except Exception:
@@ -697,10 +1116,15 @@ class ServeController:
 
     def _reconcile_once(self):
         now = time.monotonic()
+        self._tm["ckpt_seq"].set(self._ckpt._seq)
+        self._tm["ckpt_age"].set(
+            max(0.0, time.time() - self._last_ckpt_wall)
+            if self._last_ckpt_wall else 0.0)
         with self._lock:
             states = list(self._deployments.items())
         for key, st in states:
             with self._lock:
+                intent_before = st._scale_intent
                 decision = st.autoscale(now)
                 if decision is not None:
                     self._tm["autoscale_decisions"].inc(
@@ -717,12 +1141,18 @@ class ServeController:
                         sum(1 for r in st.replicas.values()
                             if r.state == "RUNNING"),
                         tags={"deployment": st.info.name})
+                if (decision is not None
+                        or st._scale_intent is not intent_before):
+                    # Intent state (armed/cleared/target moved) is part
+                    # of the checkpoint — broadcast won't catch it.
+                    self._ckpt.mark_dirty()
                 self._check_started(st)
                 self._check_health(st, now)
                 changed = self._scale(st)
                 if st.deleting and not st.replicas:
                     self._deployments.pop(key, None)
                     self._host.drop_key(replica_set_key(st.app_name, st.info.name))
+                    self._ckpt.mark_dirty()
                     changed = False
             if changed:
                 self._broadcast(st)
@@ -1065,3 +1495,7 @@ class ServeController:
         self._host.notify_changed(
             replica_set_key(st.app_name, st.info.name), table
         )
+        # Anything worth telling the routers is worth persisting:
+        # membership, drain flags, summaries and load all flow through
+        # here, so the broadcast doubles as the checkpoint dirty edge.
+        self._ckpt.mark_dirty()

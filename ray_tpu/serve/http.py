@@ -53,10 +53,16 @@ class _ProxyBase:
     def _subscribe(self):
         from ray_tpu.serve.controller import CONTROLLER_NAME, ROUTES_KEY
 
-        controller = api.get_actor(CONTROLLER_NAME)
+        def subscribe():
+            # Re-resolve on every (re)connect so the proxy follows a
+            # replacement controller after a crash; between outage and
+            # recovery it keeps serving its last-known route table.
+            controller = api.get_actor(CONTROLLER_NAME)
 
-        def listen(seen):
-            return api.get(controller.long_poll.remote(seen))
+            def listen(seen):
+                return api.get(controller.long_poll.remote(seen))
+
+            return listen
 
         def update(routes: Dict[str, Tuple[str, str]]):
             with self._lock:
@@ -68,8 +74,10 @@ class _ProxyBase:
                     for prefix, (app, dep) in routes.items()
                 }
 
-        self._client = LongPollClient(listen, {ROUTES_KEY: update})
+        self._client = LongPollClient(subscribe(), {ROUTES_KEY: update},
+                                      resubscribe=subscribe)
         # Seed synchronously so requests right after startup route.
+        controller = api.get_actor(CONTROLLER_NAME)
         update(api.get(controller.get_routes.remote()))
 
     def _match(self, path: str) -> Optional[DeploymentHandle]:

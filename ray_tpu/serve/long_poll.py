@@ -15,6 +15,12 @@ from typing import Any, Callable, Dict, Tuple
 # Sentinel returned when a listen times out with no changes.
 LISTEN_TIMEOUT = "__listen_timeout__"
 
+# Client reconnect backoff through controller outages: capped
+# exponential, so a dead controller costs ~a poll tick at first and at
+# most BACKOFF_MAX_S per retry while the outage lasts.
+BACKOFF_MIN_S = 0.05
+BACKOFF_MAX_S = 2.0
+
 
 class LongPollHost:
     """Lives inside the Serve controller actor."""
@@ -61,13 +67,26 @@ class LongPollClient:
 
     ``callbacks`` maps key -> fn(value); each is invoked with the initial
     snapshot (if any) and then on every change.
+
+    Survives controller outages: a failing listen retries with
+    capped-exponential backoff, and ``resubscribe`` (when given) is
+    called on each failure to build a FRESH listen_fn — re-resolving
+    ``CONTROLLER_NAME`` so a replacement controller actor's handle is
+    picked up.  Responses of the shape ``{"epoch": E, "updates": {...}}``
+    carry the controller epoch: when it moves, the new host's snapshot
+    ids restarted from 1 while our ``seen`` values are from the dead
+    generation — the client full-resyncs (seen -> 0) so the rebuilt
+    tables arrive instead of being filtered forever.
     """
 
     def __init__(self, listen_fn: Callable[[Dict[str, int]], Dict],
-                 callbacks: Dict[str, Callable[[Any], None]]):
+                 callbacks: Dict[str, Callable[[Any], None]],
+                 resubscribe: Callable[[], Callable] = None):
         self._listen_fn = listen_fn
         self._callbacks = dict(callbacks)
         self._seen: Dict[str, int] = {k: 0 for k in callbacks}
+        self._resubscribe = resubscribe
+        self._epoch = None
         self._stopped = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="long-poll-client"
@@ -78,14 +97,36 @@ class LongPollClient:
         self._stopped.set()
 
     def _loop(self) -> None:
+        backoff = BACKOFF_MIN_S
         while not self._stopped.is_set():
             try:
-                updates = self._listen_fn(dict(self._seen))
+                resp = self._listen_fn(dict(self._seen))
             except Exception:
                 if self._stopped.is_set():
                     return
-                self._stopped.wait(0.1)
+                self._stopped.wait(backoff)
+                backoff = min(backoff * 2.0, BACKOFF_MAX_S)
+                if self._resubscribe is not None:
+                    try:
+                        self._listen_fn = self._resubscribe()
+                    except Exception:
+                        pass  # controller still down — keep backing off
                 continue
+            backoff = BACKOFF_MIN_S
+            updates = resp
+            if isinstance(resp, dict) and "epoch" in resp \
+                    and "updates" in resp:
+                epoch, updates = resp["epoch"], resp["updates"]
+                if self._epoch is None:
+                    self._epoch = epoch
+                elif epoch != self._epoch:
+                    # Controller restarted: full resync.  Drop this
+                    # response's (seen-filtered, possibly empty) updates
+                    # and re-listen from zero — the next reply carries
+                    # the new generation's complete snapshots.
+                    self._epoch = epoch
+                    self._seen = {k: 0 for k in self._callbacks}
+                    continue
             if not updates:
                 self._stopped.wait(0.02)  # poll cadence
                 continue

@@ -449,9 +449,25 @@ class ReplicaActor:
         from ray_tpu.core import api
         from ray_tpu.serve.controller import CONTROLLER_NAME
 
-        while not self._metrics_stop.wait(interval_s):
+        # Controller-outage tolerance: a failed push backs off
+        # (capped-exponential) and RETRIES instead of killing the loop
+        # — a controller crash would otherwise permanently silence this
+        # replica's autoscaling signal and routing summaries even after
+        # recovery.  The latest summary IS the buffer: on reconnect the
+        # change-detection baselines reset so the new controller epoch
+        # (whose adopted record may predate recent changes) gets a
+        # fresh push of both summaries.
+        backoff = interval_s or 0.05
+        failing = False
+        while not self._metrics_stop.wait(
+                backoff if failing else interval_s):
             try:
                 controller = api.get_actor(CONTROLLER_NAME)
+                if failing:
+                    failing = False
+                    backoff = interval_s or 0.05
+                    self._last_prefix_summary = None
+                    self._last_adapter_summary = None
                 qage, goodput, arrivals = 0.0, None, None
                 if self._pressure_fn is not None:
                     try:
@@ -491,7 +507,8 @@ class ReplicaActor:
                             self.replica_id, asum,
                         )
             except Exception:
-                return  # controller gone — cluster is shutting down
+                failing = True
+                backoff = min(max(backoff, 0.05) * 2.0, 2.0)
 
 
 class ShardMemberActor:

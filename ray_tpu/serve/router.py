@@ -184,16 +184,30 @@ class Router:
     # -- routing table -----------------------------------------------------
 
     def _subscribe(self):
-        from ray_tpu.serve.controller import CONTROLLER_NAME, replica_set_key
+        from ray_tpu.serve.controller import replica_set_key
         from ray_tpu.serve.long_poll import LongPollClient
 
-        controller = api.get_actor(CONTROLLER_NAME)
         key = replica_set_key(self.app_name, self.deployment_name)
 
-        def listen(seen: Dict[str, int]):
-            return api.get(controller.long_poll.remote(seen))
+        def subscribe():
+            # Re-resolve CONTROLLER_NAME on every (re)connect rather
+            # than pinning one handle: a replacement controller is a
+            # NEW actor.  Going through _get_or_create_controller means
+            # the first data-plane client to notice an outage also
+            # RESURRECTS the control plane from its checkpoint — the
+            # router keeps serving its last-known table meanwhile.
+            from ray_tpu.serve import _get_or_create_controller
 
-        self._client = LongPollClient(listen, {key: self._update_replicas})
+            controller = _get_or_create_controller()
+
+            def listen(seen: Dict[str, int]):
+                return api.get(controller.long_poll.remote(seen))
+
+            return listen
+
+        self._client = LongPollClient(
+            subscribe(), {key: self._update_replicas},
+            resubscribe=subscribe)
 
     def _update_replicas(self, table: List[Tuple[str, Any, int]]) -> None:
         """table: [(replica_id, actor_handle, max_ongoing_requests,
@@ -224,6 +238,7 @@ class Router:
                         replica_id, handle, max_ongoing, is_async,
                         summary, role, adapters, ongoing, draining
                     )
+            removed = [rid for rid in self._replicas if rid not in fresh]
             self._replicas = fresh
             # Drop affinity entries pointing at replicas that left the
             # routing table (they'd pin models to ghosts forever).
@@ -231,6 +246,23 @@ class Router:
                 m: rid for m, rid in self._model_affinity.items()
                 if rid in fresh
             }
+            # The broadcast table is AUTHORITATIVE, not a merge input:
+            # replica ids are unique forever, so an id absent from the
+            # new table is retired or dead and never comes back.
+            # Release its outstanding entries now — critical on a
+            # controller-recovery rebroadcast, where a replica that
+            # died DURING the outage would otherwise keep its ghost
+            # in-flight charges (and, via them, the inflight gauge)
+            # until the reaper happened to poll one of its refs.
+            if removed:
+                gone = set(removed)
+                orphaned = [ref for ref, rid in self._outstanding.items()
+                            if rid in gone]
+                for ref in orphaned:
+                    del self._outstanding[ref]
+                self._tm["inflight"].set(
+                    len(self._outstanding),
+                    tags={"deployment": self.deployment_name})
             self._cv.notify_all()
 
     def audit_view(self) -> Dict[str, Any]:

@@ -145,7 +145,8 @@ def list_replicas(filters: Optional[List[Filter]] = None, *,
     if not detail:
         keep = ("app", "deployment", "replica_id", "state", "role",
                 "shard_group", "mesh_shape", "members",
-                "target_groups", "actual_groups", "autoscale")
+                "target_groups", "actual_groups", "autoscale",
+                "ctl_epoch", "last_recovery")
         rows = [{k: r.get(k) for k in keep} for r in rows]
     return _apply_filters(rows, filters, limit)
 

@@ -174,6 +174,18 @@ D("head_reconnect_window_s", float, 60.0,
   "loss (pre-FT behavior).")
 D("head_reconnect_retry_s", float, 0.5,
   "Delay between daemon rejoin attempts while the head is unreachable.")
+D("serve_checkpoint_flush_period_s", float, 0.05,
+  "Serve-controller checkpoint flush period: a controller crash loses "
+  "at most this window of control-state mutations (the recovery "
+  "re-census covers the gap).  The checkpoint persists through the "
+  "cluster KV, so it survives the controller ACTOR's death and "
+  "inherits disk durability whenever gcs_persist_path is set.  "
+  "Env: RAYTPU_SERVE_CHECKPOINT_FLUSH_PERIOD_S.")
+D("serve_checkpoint_mirrors", str, "",
+  "Comma-separated file paths mirrored best-effort on every serve "
+  "controller checkpoint flush (same MirroredStore semantics as "
+  "gcs_persist_mirrors): recovery loads the NEWEST readable copy "
+  "across KV + mirrors.  Env: RAYTPU_SERVE_CHECKPOINT_MIRRORS.")
 
 # --- Fault tolerance ------------------------------------------------------
 D("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")

@@ -67,7 +67,10 @@ Checked:
     scale-up, >= 1 drain-based scale-down and >= 1 replica kill, and
     completed + shed <= offered; the scale_up_reasons breakdown (which
     signal fired each up decision) uses known reasons only, counts
-    >= 1, absent-not-zero, summing to scale_ups;
+    >= 1, absent-not-zero, summing to scale_ups; the control-plane
+    chaos arm (controller_kills/recovery_seconds, present only in
+    FT-era records) requires a measured recovery time whenever a
+    controller was killed;
   * the full-8B train rung (extra.llama_8b.train): must be MEASURED
     (measured=true, numeric mfu/toks in (0, 1]/(0, inf)), carry
     zero_sharding=true + dp_shards, and satisfy the memory claim
@@ -806,6 +809,27 @@ def _check_chaos(name: str, d: Any, problems: List[str]) -> None:
         problems.append(
             f"{name}: completed={d['completed']} + shed={d['shed']} "
             f"exceeds offered={d['offered']}")
+    # Control-plane chaos arm (absent in pre-FT records — validated
+    # only when present, so old BENCH_OUT.json files stay clean):
+    # controller_kills counts mid-ramp controller SIGKILLs, and every
+    # kill must come with a measured recovery — a record claiming a
+    # controller kill without a recovery time either never recovered
+    # (a failure) or never timed it (not a measurement).
+    ck = d.get("controller_kills", None)
+    if "controller_kills" in d and not (_num(ck) and ck >= 0):
+        problems.append(f"{name}: controller_kills={ck!r} must be a "
+                        f"number >= 0")
+    rs = d.get("recovery_seconds", None)
+    if _num(ck) and ck >= 1:
+        if not (_num(rs) and rs >= 0):
+            problems.append(
+                f"{name}: controller_kills={ck} but recovery_seconds="
+                f"{rs!r} — a killed controller must be observed "
+                f"recovering (new actor answering status) with a "
+                f"measured recovery time")
+    elif rs is not None and not _num(rs):
+        problems.append(f"{name}: recovery_seconds={rs!r} is neither "
+                        f"a number nor null")
     if "scale_up_reasons" in d:
         sub = d["scale_up_reasons"]
         _check_autoscale_signals(f"{name}.scale_up_reasons", sub,

@@ -548,8 +548,9 @@ def _chaos_block():
     return {"mix": "zipf_chat", "offered": 24, "completed": 21,
             "shed": 3, "failed": 0, "shed_fraction": 0.125,
             "goodput_ratio": 1.0, "scale_ups": 1, "scale_downs": 1,
-            "drain_retirements": 2, "kills": 1, "max_groups": 3,
-            "max_replicas": 3, "gen": 10,
+            "drain_retirements": 2, "kills": 1,
+            "controller_kills": 1, "recovery_seconds": 1.42,
+            "max_groups": 3, "max_replicas": 3, "gen": 10,
             "doctor": {"checks_run": 14, "violations": 0,
                        "audit_seconds": 0.02}}
 
@@ -647,6 +648,45 @@ def test_chaos_scale_up_reasons_breakdown(schema):
 
     # Field absent entirely: valid (older records never measured it).
     del blk["scale_up_reasons"]
+    assert schema.validate_record(rec) == []
+
+
+def test_chaos_controller_kill_requires_measured_recovery(schema):
+    """ISSUE 20 satellite: the control-plane chaos arm.  A record
+    claiming controller_kills >= 1 must carry a numeric
+    recovery_seconds >= 0 (the kill was observed recovering);
+    legacy records without either key stay valid, and a kill-free
+    record may honestly report recovery_seconds as null."""
+    rec = _record()
+    blk = _chaos_block()
+    rec["extra"]["serving_chaos"] = blk
+    assert schema.validate_record(rec) == []
+
+    # Killed the controller but never measured the recovery: invalid.
+    blk["recovery_seconds"] = None
+    probs = schema.validate_record(rec)
+    assert any("controller_kills=1" in p
+               and "recovery_seconds=None" in p for p in probs)
+    blk["recovery_seconds"] = "fast"
+    probs = schema.validate_record(rec)
+    assert any("recovery_seconds='fast'" in p for p in probs)
+
+    # No controller kill this run: null recovery is honest.
+    blk["controller_kills"] = 0
+    blk["recovery_seconds"] = None
+    assert schema.validate_record(rec) == []
+    blk["recovery_seconds"] = "fast"  # but a non-number still isn't
+    probs = schema.validate_record(rec)
+    assert any("neither a number nor null" in p for p in probs)
+
+    blk["controller_kills"] = -1
+    blk["recovery_seconds"] = None
+    probs = schema.validate_record(rec)
+    assert any("controller_kills=-1" in p for p in probs)
+
+    # Pre-FT record: both keys absent entirely — valid.
+    del blk["controller_kills"]
+    del blk["recovery_seconds"]
     assert schema.validate_record(rec) == []
 
 

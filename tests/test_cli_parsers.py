@@ -133,6 +133,16 @@ def test_format_doctor_is_deterministic():
         "(no checks ran — no engines or controller found)")
 
 
+def test_list_replicas_columns_include_controller_epoch():
+    """`raytpu list replicas` surfaces the control-plane FT columns:
+    the controller epoch and the last-recovery wall time ride at the
+    end of the column list (and thus of every rendered table)."""
+    from ray_tpu.scripts.cli import _LIST_ROUTES
+
+    cols = _LIST_ROUTES["replicas"][1]
+    assert cols[-2:] == ["ctl_epoch", "last_recovery"]
+
+
 def test_unknown_command_exits_nonzero(capsys):
     with pytest.raises(SystemExit) as ei:
         build_parser().parse_args(["definitely-not-a-command"])

@@ -381,7 +381,8 @@ def test_disagg_streams_byte_identical_to_unified_oracle(params):
                                  "state", "role", "shard_group",
                                  "mesh_shape", "members",
                                  "target_groups", "actual_groups",
-                                 "autoscale"}
+                                 "autoscale", "ctl_epoch",
+                                 "last_recovery"}
         assert sorted(r["role"] for r in rows1) == ["decode", "prefill"]
         from ray_tpu.scripts import cli
         assert "role" in cli._LIST_ROUTES["replicas"][1]

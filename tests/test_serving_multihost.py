@@ -171,7 +171,10 @@ def test_shard_group_bf16_byte_identical(mh_app, references):
     r = rows1[0]
     assert set(r) == {"app", "deployment", "replica_id", "state", "role",
                       "shard_group", "mesh_shape", "members",
-                      "target_groups", "actual_groups", "autoscale"}
+                      "target_groups", "actual_groups", "autoscale",
+                      "ctl_epoch", "last_recovery"}
+    assert r["ctl_epoch"] == 1          # never crashed in this test
+    assert r["last_recovery"] == ""     # '' until a recovery happens
     assert r["app"] == APP
     assert r["state"] == "RUNNING"
     # Fixed-size deployment: target==actual and no autoscale decision.

@@ -279,6 +279,14 @@ def test_cross_plane_trace_and_metrics(rt, tmp_path, cpu_devices):
                  "raytpu_serve_autoscale_target_groups",
                  "raytpu_serve_autoscale_actual_groups",
                  "raytpu_serve_shed_total",
+                 # Control-plane fault-tolerance plane: controller
+                 # restart/checkpoint/orphan families, registered with
+                 # the controller even when it never crashes.
+                 "raytpu_serve_controller_restarts_total",
+                 "raytpu_serve_controller_checkpoint_seq",
+                 "raytpu_serve_controller_checkpoint_age_seconds",
+                 "raytpu_serve_orphans_adopted_total",
+                 "raytpu_serve_orphans_killed_total",
                  # Latency-attribution plane: the per-request waterfall
                  # histogram + the control-plane-share gauge (the
                  # ROADMAP item-6 baseline), plus the flight recorder's
